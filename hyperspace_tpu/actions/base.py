@@ -1,0 +1,113 @@
+"""The action state machine: generic begin → op → end protocol with
+optimistic concurrency.
+
+Reference contract: actions/Action.scala:35-105 —
+  - ``base_id`` is captured from the latest log id when the action starts (:35)
+  - ``begin()`` writes a *transient*-state entry at ``base_id + 1`` (:49-55);
+    the create-if-absent write is what detects concurrent writers
+  - ``op()`` does the actual work (:58)
+  - ``end()`` writes the *final*-state entry at ``base_id + 2``, deleting and
+    recreating the ``latestStable`` pointer (:60-75)
+  - ``run()`` wraps the protocol with validation, telemetry, and
+    NoChangesException no-op handling (:84-105)
+
+An action that dies mid-flight leaves the transient entry as the latest log
+record; subsequent actions refuse to run and the user recovers with
+``cancel()`` (actions/CancelAction.scala:25-58).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Type
+
+from hyperspace_tpu.exceptions import ConcurrentWriteError, HyperspaceError, NoChangesError
+from hyperspace_tpu.index.log_entry import IndexLogEntry, States
+from hyperspace_tpu.index.log_manager import IndexLogManager
+from hyperspace_tpu.telemetry.events import HyperspaceEvent, _IndexActionEvent, get_event_logger
+
+
+class Action:
+    # Subclasses set these.
+    transient_state: str = ""
+    final_state: str = ""
+    event_class: Optional[Type[_IndexActionEvent]] = None
+
+    def __init__(self, log_manager: IndexLogManager) -> None:
+        self.log_manager = log_manager
+        # base_id MUST be captured eagerly (Action.scala:35 is a val): the
+        # optimistic-concurrency check works only if begin()/end() write at
+        # ids derived from the state this action validated against.
+        latest = self.log_manager.get_latest_id()
+        self._base_id: int = 0 if latest is None else latest
+        self.previous_log_entry: Optional[IndexLogEntry] = self.log_manager.get_latest_log()
+
+    # -- protocol pieces ----------------------------------------------------
+    @property
+    def base_id(self) -> int:
+        return self._base_id
+
+    @property
+    def index_name(self) -> str:
+        if self.previous_log_entry is not None:
+            return self.previous_log_entry.name
+        return ""
+
+    def validate(self) -> None:
+        """Precondition check; raise HyperspaceError (or NoChangesError for
+        benign no-ops) before any state is written."""
+
+    def op(self) -> None:
+        raise NotImplementedError
+
+    def log_entry(self) -> IndexLogEntry:
+        """The entry to commit at end(); built after op() so it can reference
+        freshly written index data."""
+        raise NotImplementedError
+
+    # -- protocol -----------------------------------------------------------
+    def begin(self) -> None:
+        entry = self.log_entry_for_begin()
+        entry.state = self.transient_state
+        self.log_manager.write_log_or_raise(self.base_id + 1, entry)
+
+    def log_entry_for_begin(self) -> IndexLogEntry:
+        """Entry written at begin(); by default the previous entry (actions on
+        existing indexes).  CreateAction overrides to build a fresh one."""
+        if self.previous_log_entry is None:
+            raise HyperspaceError("No existing index log entry for this action")
+        import copy
+
+        return copy.deepcopy(self.previous_log_entry)
+
+    def end(self) -> None:
+        entry = self.log_entry()
+        entry.state = self.final_state
+        self.log_manager.delete_latest_stable_log()
+        self.log_manager.write_log_or_raise(self.base_id + 2, entry)
+        self.log_manager.create_latest_stable_log(self.base_id + 2)
+
+    def run(self) -> None:
+        """Action.scala:84-105."""
+        logger = get_event_logger()
+
+        def emit(state: str, message: str = "") -> None:
+            if self.event_class is not None:
+                logger.log_event(self.event_class(
+                    index_name=self.index_name, state=state, message=message))
+
+        try:
+            self.validate()
+        except NoChangesError as e:
+            emit(States.ACTIVE, f"No-op: {e}")
+            return
+        try:
+            self.begin()
+            self.op()
+            self.end()
+            emit(self.final_state)
+        except ConcurrentWriteError:
+            emit("FAILURE", "concurrent modification")
+            raise
+        except Exception as e:
+            emit("FAILURE", str(e))
+            raise

@@ -1,0 +1,51 @@
+"""CancelAction: recover a stuck index from a transient state back to its
+last stable state.
+
+Reference contract: actions/CancelAction.scala:35-76 — validate requires the
+latest entry to be in a *transient* (non-stable) state; the final state is
+the last stable log's state, with the special case VACUUMING → DOESNOTEXIST
+(:44-53).  Cancel writes no transient entry of its own: begin() is a no-op
+and end() commits directly at base_id + 1.
+"""
+
+from __future__ import annotations
+
+import copy
+
+from hyperspace_tpu.actions.base import Action
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.index.log_entry import IndexLogEntry, States
+from hyperspace_tpu.telemetry.events import CancelActionEvent
+
+
+class CancelAction(Action):
+    event_class = CancelActionEvent
+
+    def validate(self) -> None:
+        if self.previous_log_entry is None:
+            raise HyperspaceError("Cancel: index does not exist")
+        if self.previous_log_entry.state in States.STABLE:
+            raise HyperspaceError(
+                f"Cancel is not supported in stable state {self.previous_log_entry.state}")
+
+    @property
+    def final_state(self) -> str:  # type: ignore[override]
+        # CancelAction.scala:44-53
+        if self.previous_log_entry.state == States.VACUUMING:
+            return States.DOESNOTEXIST
+        stable = self.log_manager.get_latest_stable_log()
+        return stable.state if stable is not None else States.DOESNOTEXIST
+
+    def op(self) -> None:
+        pass
+
+    def begin(self) -> None:
+        pass
+
+    def end(self) -> None:
+        stable = self.log_manager.get_latest_stable_log()
+        entry = copy.deepcopy(stable if stable is not None else self.previous_log_entry)
+        entry.state = self.final_state
+        self.log_manager.delete_latest_stable_log()
+        self.log_manager.write_log_or_raise(self.base_id + 1, entry)
+        self.log_manager.create_latest_stable_log(self.base_id + 1)

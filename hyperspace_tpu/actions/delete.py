@@ -1,0 +1,31 @@
+"""DeleteAction: metadata-only soft delete, ACTIVE → DELETED.
+
+Reference contract: actions/DeleteAction.scala:24-48 — validate requires the
+index to be ACTIVE; ``op()`` is a no-op (index data is kept for restore);
+the final entry is the previous one with state DELETED.
+"""
+
+from __future__ import annotations
+
+from hyperspace_tpu.actions.base import Action
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.index.log_entry import IndexLogEntry, States
+from hyperspace_tpu.telemetry.events import DeleteActionEvent
+
+
+class DeleteAction(Action):
+    transient_state = States.DELETING
+    final_state = States.DELETED
+    event_class = DeleteActionEvent
+
+    def validate(self) -> None:
+        if self.previous_log_entry is None or self.previous_log_entry.state != States.ACTIVE:
+            raise HyperspaceError(
+                f"Delete is only supported in {States.ACTIVE} state; index is "
+                f"{'missing' if self.previous_log_entry is None else self.previous_log_entry.state}")
+
+    def op(self) -> None:
+        pass
+
+    def log_entry(self) -> IndexLogEntry:
+        return self.log_entry_for_begin()

@@ -1,0 +1,30 @@
+"""RestoreAction: undo a soft delete, DELETED → ACTIVE.
+
+Reference contract: actions/RestoreAction.scala:24-48 — validate requires
+DELETED; ``op()`` is a no-op; final entry is the previous one re-activated.
+"""
+
+from __future__ import annotations
+
+from hyperspace_tpu.actions.base import Action
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.index.log_entry import IndexLogEntry, States
+from hyperspace_tpu.telemetry.events import RestoreActionEvent
+
+
+class RestoreAction(Action):
+    transient_state = States.RESTORING
+    final_state = States.ACTIVE
+    event_class = RestoreActionEvent
+
+    def validate(self) -> None:
+        if self.previous_log_entry is None or self.previous_log_entry.state != States.DELETED:
+            raise HyperspaceError(
+                f"Restore is only supported in {States.DELETED} state; index is "
+                f"{'missing' if self.previous_log_entry is None else self.previous_log_entry.state}")
+
+    def op(self) -> None:
+        pass
+
+    def log_entry(self) -> IndexLogEntry:
+        return self.log_entry_for_begin()

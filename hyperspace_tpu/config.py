@@ -1,0 +1,103 @@
+"""Configuration registry: every tunable in one place, with typed accessors.
+
+Mirrors the reference's key/default registry (index/IndexConstants.scala:21-114)
+and typed accessor layer (util/HyperspaceConf.scala:26-118), collapsed into a
+single dataclass because we own the session object instead of riding Spark's
+string-keyed SQLConf.  String-keyed get/set is still supported (``set``/``get``)
+so tests and the Python API can flip flags the way Spark conf users do.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+# Canonical string keys (kept spark-compatible in spirit so reference users
+# can map their configs 1:1; see docs/_docs/02-ug-configuration.md:9-23).
+SYSTEM_PATH = "hyperspace.system.path"
+NUM_BUCKETS = "hyperspace.index.numBuckets"
+LINEAGE_ENABLED = "hyperspace.index.lineage.enabled"
+HYBRID_SCAN_ENABLED = "hyperspace.index.hybridscan.enabled"
+HYBRID_SCAN_APPENDED_RATIO = "hyperspace.index.hybridscan.maxAppendedRatio"
+HYBRID_SCAN_DELETED_RATIO = "hyperspace.index.hybridscan.maxDeletedRatio"
+OPTIMIZE_FILE_SIZE_THRESHOLD = "hyperspace.index.optimize.fileSizeThreshold"
+FILTER_RULE_USE_BUCKET_SPEC = "hyperspace.index.filterRule.useBucketSpec"
+CACHE_EXPIRY_SECONDS = "hyperspace.index.cache.expiryDurationInSeconds"
+SOURCE_PROVIDERS = "hyperspace.index.sources.fileBasedBuilders"
+SIGNATURE_PROVIDER = "hyperspace.index.signatureProvider"
+EVENT_LOGGER = "hyperspace.eventLoggerClass"
+SUPPORTED_FILE_FORMATS = "hyperspace.index.supportedFileFormats"
+DEVICE_BATCH_ROWS = "hyperspace.tpu.deviceBatchRows"
+
+_DEFAULT_NUM_BUCKETS = 200  # IndexConstants.scala:31-32 (spark.sql.shuffle.partitions default)
+
+
+@dataclasses.dataclass
+class HyperspaceConf:
+    """Session-scoped configuration.
+
+    Defaults follow index/IndexConstants.scala:
+      - num_buckets=200            (:31-32)
+      - hybrid scan off, appended<=0.3 / deleted<=0.2 byte ratios (:40-48)
+      - filter-rule bucket spec off (:52-53)
+      - cache TTL 300 s            (:61-63)
+      - optimize threshold 256 MB  (:91-92)
+      - lineage off                (:97-99)
+    """
+
+    system_path: Optional[str] = None
+    num_buckets: int = _DEFAULT_NUM_BUCKETS
+    lineage_enabled: bool = False
+    hybrid_scan_enabled: bool = False
+    hybrid_scan_max_appended_ratio: float = 0.3
+    hybrid_scan_max_deleted_ratio: float = 0.2
+    optimize_file_size_threshold: int = 256 * 1024 * 1024
+    filter_rule_use_bucket_spec: bool = False
+    cache_expiry_seconds: int = 300
+    source_providers: str = "default"
+    signature_provider: str = "IndexSignatureProvider"
+    event_logger: str = ""
+    supported_file_formats: str = "parquet,csv,json"
+    # TPU data-plane tunable: rows moved to device per compiled batch.  Keeps
+    # XLA shapes static (arrays are padded to this size) so kernels hit the
+    # compile cache across files of different sizes.
+    device_batch_rows: int = 1 << 20
+
+    _FIELD_BY_KEY = {
+        SYSTEM_PATH: "system_path",
+        NUM_BUCKETS: "num_buckets",
+        LINEAGE_ENABLED: "lineage_enabled",
+        HYBRID_SCAN_ENABLED: "hybrid_scan_enabled",
+        HYBRID_SCAN_APPENDED_RATIO: "hybrid_scan_max_appended_ratio",
+        HYBRID_SCAN_DELETED_RATIO: "hybrid_scan_max_deleted_ratio",
+        OPTIMIZE_FILE_SIZE_THRESHOLD: "optimize_file_size_threshold",
+        FILTER_RULE_USE_BUCKET_SPEC: "filter_rule_use_bucket_spec",
+        CACHE_EXPIRY_SECONDS: "cache_expiry_seconds",
+        SOURCE_PROVIDERS: "source_providers",
+        SIGNATURE_PROVIDER: "signature_provider",
+        EVENT_LOGGER: "event_logger",
+        SUPPORTED_FILE_FORMATS: "supported_file_formats",
+        DEVICE_BATCH_ROWS: "device_batch_rows",
+    }
+
+    def set(self, key: str, value: Any) -> None:
+        field = self._FIELD_BY_KEY.get(key)
+        if field is None:
+            raise KeyError(f"Unknown hyperspace conf key: {key}")
+        current = getattr(self, field)
+        if isinstance(current, bool):
+            value = value if isinstance(value, bool) else str(value).lower() == "true"
+        elif isinstance(current, int):
+            value = int(value)
+        elif isinstance(current, float):
+            value = float(value)
+        setattr(self, field, value)
+
+    def get(self, key: str) -> Any:
+        field = self._FIELD_BY_KEY.get(key)
+        if field is None:
+            raise KeyError(f"Unknown hyperspace conf key: {key}")
+        return getattr(self, field)
+
+    def copy(self) -> "HyperspaceConf":
+        return dataclasses.replace(self)

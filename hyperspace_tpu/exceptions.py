@@ -1,0 +1,25 @@
+"""Framework error types.
+
+Reference contract: ``HyperspaceException`` (HyperspaceException.scala:19) and
+the ``NoChangesException`` no-op control-flow signal used by the action state
+machine (actions/RefreshActionBase.scala, Action.scala:84-105).
+"""
+
+from __future__ import annotations
+
+
+class HyperspaceError(Exception):
+    """Base error for all hyperspace_tpu failures."""
+
+
+class NoChangesError(HyperspaceError):
+    """Raised by an action's validate() when the operation would be a no-op.
+
+    The action runner treats this as success-without-commit, mirroring the
+    reference's NoChangesException handling (Action.scala:92-99).
+    """
+
+
+class ConcurrentWriteError(HyperspaceError):
+    """Optimistic-concurrency conflict: a log id was committed by another
+    writer between ``base_id`` capture and ``write_log`` (IndexLogManager.scala:149-165)."""

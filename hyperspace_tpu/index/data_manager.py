@@ -1,0 +1,53 @@
+"""Versioned index-data directory manager.
+
+Reference contract: index/IndexDataManager.scala:23-74 — index data for each
+rebuild lives in a hive-style ``v__=<N>/`` subdirectory of the index path:
+
+    <systemPath>/<indexName>/
+      _hyperspace_log/0,1,...,latestStable
+      v__=0/part-*.parquet
+      v__=1/...
+
+``get_latest_version`` discovers the highest N present; ``delete`` removes a
+version directory (used by VacuumAction, actions/VacuumAction.scala:46-52).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import List, Optional
+
+INDEX_VERSION_DIR_PREFIX = "v__="  # IndexConstants.scala:67
+
+
+class IndexDataManager:
+    def __init__(self, index_path: str) -> None:
+        self.index_path = index_path
+
+    def version_path(self, version: int) -> str:
+        return os.path.join(self.index_path, f"{INDEX_VERSION_DIR_PREFIX}{version}")
+
+    def versions(self) -> List[int]:
+        if not os.path.isdir(self.index_path):
+            return []
+        out = []
+        for name in os.listdir(self.index_path):
+            if name.startswith(INDEX_VERSION_DIR_PREFIX):
+                suffix = name[len(INDEX_VERSION_DIR_PREFIX):]
+                if suffix.isdigit():
+                    out.append(int(suffix))
+        return sorted(out)
+
+    def get_latest_version(self) -> Optional[int]:
+        versions = self.versions()
+        return versions[-1] if versions else None
+
+    def get_next_version(self) -> int:
+        latest = self.get_latest_version()
+        return 0 if latest is None else latest + 1
+
+    def delete(self, version: int) -> None:
+        path = self.version_path(version)
+        if os.path.isdir(path):
+            shutil.rmtree(path)

@@ -1,0 +1,595 @@
+"""The on-disk metadata model: content trees, source snapshot, fingerprints,
+covering-index spec, tags, and the stable file-id tracker.
+
+This is the Python re-expression of the reference's entire metadata schema
+(index/IndexLogEntry.scala:43-686 and index/LogEntry.scala:22-46):
+
+  - ``FileInfo``            — (name, size, mtime, id)          (:321)
+  - ``Directory``/``Content`` — recursive dir tree of index/source files
+                                with ``merge`` (:43-316, merge :149)
+  - ``CoveringIndex``       — derived-dataset spec (:347-360)
+  - ``Signature``/``Fingerprint`` — validity fingerprint (:363-377)
+  - ``Update``              — appended/deleted file lists for quick refresh
+                              and hybrid scan (:379-382)
+  - ``Relation``/``Source`` — snapshot of the source relation (:409-431)
+  - ``IndexLogEntry``       — the versioned log record (:433-612)
+  - ``FileIdTracker``       — stable (path,size,mtime)→id map (:617-686)
+
+Serialization is plain JSON via ``to_dict``/``from_dict`` with a ``version``
+discriminator, like LogEntry.fromJson (index/LogEntry.scala:33-46).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Any, Dict, FrozenSet, Iterable, List, Optional, Sequence, Tuple
+
+from hyperspace_tpu.utils.paths import is_data_file
+
+LOG_ENTRY_VERSION = "0.1"  # IndexLogEntry.scala:609
+
+
+# ---------------------------------------------------------------------------
+# States (actions/Constants.scala:19-33)
+# ---------------------------------------------------------------------------
+class States:
+    ACTIVE = "ACTIVE"
+    CREATING = "CREATING"
+    DELETED = "DELETED"
+    DELETING = "DELETING"
+    REFRESHING = "REFRESHING"
+    VACUUMING = "VACUUMING"
+    RESTORING = "RESTORING"
+    OPTIMIZING = "OPTIMIZING"
+    DOESNOTEXIST = "DOESNOTEXIST"
+
+    STABLE: FrozenSet[str] = frozenset({"ACTIVE", "DELETED", "DOESNOTEXIST"})
+
+
+# ---------------------------------------------------------------------------
+# File / directory / content tree
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class FileInfo:
+    """One leaf file (IndexLogEntry.scala:321-345). ``id`` comes from the
+    FileIdTracker and is stable across log versions."""
+
+    name: str
+    size: int
+    mtime: int
+    id: int = -1
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "size": self.size, "modifiedTime": self.mtime, "id": self.id}
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "FileInfo":
+        return FileInfo(d["name"], d["size"], d["modifiedTime"], d.get("id", -1))
+
+
+@dataclasses.dataclass
+class Directory:
+    """Recursive directory node (IndexLogEntry.scala:118-316)."""
+
+    name: str
+    files: List[FileInfo] = dataclasses.field(default_factory=list)
+    subdirs: List["Directory"] = dataclasses.field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "files": [f.to_dict() for f in self.files],
+            "subDirs": [d.to_dict() for d in self.subdirs],
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "Directory":
+        return Directory(
+            d["name"],
+            [FileInfo.from_dict(f) for f in d.get("files", [])],
+            [Directory.from_dict(s) for s in d.get("subDirs", [])],
+        )
+
+    def merge(self, other: "Directory") -> "Directory":
+        """Merge two trees rooted at the same name (IndexLogEntry.scala:149-171).
+
+        Files are unioned (dedup by full FileInfo); same-named subdirs merge
+        recursively.
+        """
+        if self.name != other.name:
+            raise ValueError(f"Directory merge root mismatch: {self.name!r} vs {other.name!r}")
+        seen = {(f.name, f.size, f.mtime): f for f in self.files}
+        for f in other.files:
+            seen.setdefault((f.name, f.size, f.mtime), f)
+        by_name = {d.name: d for d in self.subdirs}
+        merged_subdirs: List[Directory] = []
+        other_names = set()
+        for sub in other.subdirs:
+            other_names.add(sub.name)
+            if sub.name in by_name:
+                merged_subdirs.append(by_name[sub.name].merge(sub))
+            else:
+                merged_subdirs.append(sub)
+        for sub in self.subdirs:
+            if sub.name not in other_names:
+                merged_subdirs.append(sub)
+        return Directory(self.name, sorted(seen.values(), key=lambda f: f.name),
+                         sorted(merged_subdirs, key=lambda d: d.name))
+
+    @staticmethod
+    def from_leaf_files(files: Sequence[FileInfo]) -> "Directory":
+        """Build the minimal tree containing exactly ``files``
+        (IndexLogEntry.scala:229-275).  File names must be absolute paths;
+        leaves store the basename.
+        """
+        root = Directory(name="/")
+        for f in files:
+            parts = [p for p in os.path.dirname(f.name).split(os.sep) if p]
+            node = root
+            for part in parts:
+                nxt = next((d for d in node.subdirs if d.name == part), None)
+                if nxt is None:
+                    nxt = Directory(name=part)
+                    node.subdirs.append(nxt)
+                node = nxt
+            node.files.append(FileInfo(os.path.basename(f.name), f.size, f.mtime, f.id))
+        return root
+
+    @staticmethod
+    def from_directory(path: str, file_id_tracker: "FileIdTracker",
+                       throw_if_not_exists: bool = False) -> "Directory":
+        """Recursively list ``path`` (IndexLogEntry.scala:193-227), skipping
+        non-data files, registering each leaf with the tracker.  The result is
+        rooted at "/" with the full ancestor chain so absolute leaf paths
+        reconstruct."""
+        path = os.path.abspath(path)
+        if not os.path.isdir(path) and throw_if_not_exists:
+            raise FileNotFoundError(path)
+        node = Directory._scan(path, file_id_tracker)
+        parent = os.path.dirname(path)
+        for part in reversed([p for p in parent.split(os.sep) if p]):
+            node = Directory(part, [], [node])
+        return Directory("/", [], [node]) if node.name != "/" else node
+
+    @staticmethod
+    def _scan(path: str, file_id_tracker: "FileIdTracker") -> "Directory":
+        files: List[FileInfo] = []
+        subdirs: List[Directory] = []
+        if os.path.isdir(path):
+            for entry in sorted(os.scandir(path), key=lambda e: e.name):
+                if entry.is_dir():
+                    subdirs.append(Directory._scan(entry.path, file_id_tracker))
+                elif is_data_file(entry.name):
+                    st = entry.stat()
+                    fid = file_id_tracker.add_file(
+                        os.path.abspath(entry.path), st.st_size, int(st.st_mtime_ns))
+                    files.append(FileInfo(entry.name, st.st_size, int(st.st_mtime_ns), fid))
+        return Directory(os.path.basename(path) or "/", files, subdirs)
+
+
+@dataclasses.dataclass
+class Content:
+    """A directory tree plus accessors over its leaf files
+    (IndexLogEntry.scala:43-113)."""
+
+    root: Directory
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"root": self.root.to_dict()}
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "Content":
+        return Content(Directory.from_dict(d["root"]))
+
+    def files(self) -> List[str]:
+        """All leaf file paths, absolute (IndexLogEntry.scala:56-63)."""
+        return [f.name for f in self.file_infos()]
+
+    def file_infos(self) -> List[FileInfo]:
+        """Leaf files with absolute-path names (IndexLogEntry.scala:65-72)."""
+        out: List[FileInfo] = []
+
+        def walk(node: Directory, prefix: str) -> None:
+            base = node.name if prefix == "" else (
+                prefix if node.name == "/" else os.path.join(prefix, node.name))
+            if node.name == "/":
+                base = "/"
+            for f in node.files:
+                out.append(FileInfo(os.path.join(base, f.name), f.size, f.mtime, f.id))
+            for sub in node.subdirs:
+                walk(sub, base)
+
+        walk(self.root, "")
+        return out
+
+    @staticmethod
+    def from_directory(path: str, file_id_tracker: "FileIdTracker") -> "Content":
+        return Content(Directory.from_directory(path, file_id_tracker))
+
+    @staticmethod
+    def from_leaf_files(files: Sequence[FileInfo]) -> Optional["Content"]:
+        if not files:
+            return None
+        return Content(Directory.from_leaf_files(files))
+
+    def merge(self, other: "Content") -> "Content":
+        return Content(self.root.merge(other.root))
+
+
+# ---------------------------------------------------------------------------
+# Derived dataset (covering index) spec
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class CoveringIndex:
+    """Covering-index spec (IndexLogEntry.scala:347-360): data bucketed by
+    hash of ``indexed_columns`` into ``num_buckets`` files, sorted within
+    buckets by the same columns, plus stored ``included_columns``."""
+
+    KIND = "CoveringIndex"
+    KIND_ABBR = "CI"
+
+    indexed_columns: List[str]
+    included_columns: List[str]
+    num_buckets: int
+    schema: Dict[str, str]  # column name -> dtype string (arrow dtype names)
+    properties: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "kind": self.KIND,
+            "properties": {
+                "columns": {
+                    "indexed": self.indexed_columns,
+                    "included": self.included_columns,
+                },
+                "numBuckets": self.num_buckets,
+                "schema": self.schema,
+                "properties": self.properties,
+            },
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "CoveringIndex":
+        p = d["properties"]
+        return CoveringIndex(
+            list(p["columns"]["indexed"]),
+            list(p["columns"]["included"]),
+            p["numBuckets"],
+            dict(p["schema"]),
+            dict(p.get("properties", {})),
+        )
+
+    @property
+    def all_columns(self) -> List[str]:
+        return self.indexed_columns + self.included_columns
+
+
+# ---------------------------------------------------------------------------
+# Signatures / fingerprints / source snapshot
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class Signature:
+    provider: str
+    value: str
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"provider": self.provider, "value": self.value}
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "Signature":
+        return Signature(d["provider"], d["value"])
+
+
+@dataclasses.dataclass
+class LogicalPlanFingerprint:
+    """Fingerprint of the source plan at index-build time
+    (IndexLogEntry.scala:366-377)."""
+
+    signatures: List[Signature]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "kind": "LogicalPlan",
+            "properties": {"signatures": [s.to_dict() for s in self.signatures]},
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "LogicalPlanFingerprint":
+        return LogicalPlanFingerprint(
+            [Signature.from_dict(s) for s in d["properties"]["signatures"]])
+
+
+@dataclasses.dataclass
+class Update:
+    """Appended/deleted source files recorded by quick refresh
+    (IndexLogEntry.scala:379-382)."""
+
+    appended_files: Optional[Content] = None
+    deleted_files: Optional[Content] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "appendedFiles": self.appended_files.to_dict() if self.appended_files else None,
+            "deletedFiles": self.deleted_files.to_dict() if self.deleted_files else None,
+        }
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> Optional["Update"]:
+        if d is None:
+            return None
+        return Update(
+            Content.from_dict(d["appendedFiles"]) if d.get("appendedFiles") else None,
+            Content.from_dict(d["deletedFiles"]) if d.get("deletedFiles") else None,
+        )
+
+
+@dataclasses.dataclass
+class Relation:
+    """Snapshot of one source relation (IndexLogEntry.scala:409-415):
+    root paths, the file content tree at build time, schema, format, options,
+    and any pending update from a quick refresh."""
+
+    root_paths: List[str]
+    content: Content
+    schema: Dict[str, str]
+    file_format: str
+    options: Dict[str, str] = dataclasses.field(default_factory=dict)
+    update: Optional[Update] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "rootPaths": self.root_paths,
+            "data": {
+                "properties": {
+                    "content": self.content.to_dict(),
+                    "update": self.update.to_dict() if self.update else None,
+                }
+            },
+            "dataSchemaJson": self.schema,
+            "fileFormat": self.file_format,
+            "options": self.options,
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "Relation":
+        props = d["data"]["properties"]
+        return Relation(
+            list(d["rootPaths"]),
+            Content.from_dict(props["content"]),
+            dict(d["dataSchemaJson"]),
+            d["fileFormat"],
+            dict(d.get("options", {})),
+            Update.from_dict(props.get("update")),
+        )
+
+
+@dataclasses.dataclass
+class Source:
+    """Source plan snapshot: relations + fingerprint
+    (IndexLogEntry.scala:417-431)."""
+
+    relations: List[Relation]
+    fingerprint: LogicalPlanFingerprint
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "plan": {
+                "properties": {
+                    "relations": [r.to_dict() for r in self.relations],
+                    "fingerprint": self.fingerprint.to_dict(),
+                }
+            }
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "Source":
+        p = d["plan"]["properties"]
+        return Source(
+            [Relation.from_dict(r) for r in p["relations"]],
+            LogicalPlanFingerprint.from_dict(p["fingerprint"]),
+        )
+
+
+# ---------------------------------------------------------------------------
+# The log entry
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class IndexLogEntry:
+    """One record in the operation log (IndexLogEntry.scala:433-612)."""
+
+    name: str
+    derived_dataset: CoveringIndex
+    content: Content
+    source: Source
+    properties: Dict[str, str] = dataclasses.field(default_factory=dict)
+    state: str = States.DOESNOTEXIST
+    id: int = 0
+    timestamp: int = dataclasses.field(default_factory=lambda: int(time.time() * 1000))
+    # In-memory only (never serialized): per-entry memo tags
+    # (IndexLogEntry.scala:560-603, IndexLogEntryTags.scala:21-56).
+    _tags: Dict[str, Any] = dataclasses.field(default_factory=dict, repr=False, compare=False)
+
+    VERSION = LOG_ENTRY_VERSION
+
+    # -- serialization ------------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "version": self.VERSION,
+            "id": self.id,
+            "state": self.state,
+            "timestamp": self.timestamp,
+            "name": self.name,
+            "derivedDataset": self.derived_dataset.to_dict(),
+            "content": self.content.to_dict(),
+            "source": self.source.to_dict(),
+            "properties": self.properties,
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "IndexLogEntry":
+        if d.get("version") != LOG_ENTRY_VERSION:
+            raise ValueError(f"Unsupported log entry version: {d.get('version')!r}")
+        return IndexLogEntry(
+            name=d["name"],
+            derived_dataset=CoveringIndex.from_dict(d["derivedDataset"]),
+            content=Content.from_dict(d["content"]),
+            source=Source.from_dict(d["source"]),
+            properties=dict(d.get("properties", {})),
+            state=d["state"],
+            id=d["id"],
+            timestamp=d["timestamp"],
+        )
+
+    # -- accessors mirroring the reference ---------------------------------
+    @property
+    def indexed_columns(self) -> List[str]:
+        return self.derived_dataset.indexed_columns
+
+    @property
+    def included_columns(self) -> List[str]:
+        return self.derived_dataset.included_columns
+
+    @property
+    def num_buckets(self) -> int:
+        return self.derived_dataset.num_buckets
+
+    @property
+    def kind_abbr(self) -> str:
+        return self.derived_dataset.KIND_ABBR
+
+    def signature(self) -> Signature:
+        """The (single) stored signature (IndexLogEntry.scala:532-536)."""
+        sigs = self.source.fingerprint.signatures
+        if len(sigs) != 1:
+            raise ValueError(f"Expected exactly one signature, got {len(sigs)}")
+        return sigs[0]
+
+    @property
+    def relations(self) -> List[Relation]:
+        return self.source.relations
+
+    def has_lineage_column(self) -> bool:
+        """IndexLogEntry.scala:538-541."""
+        return self.properties.get("lineage", "false").lower() == "true"
+
+    def source_file_infos(self) -> List[FileInfo]:
+        """All source files recorded at build/refresh time."""
+        out: List[FileInfo] = []
+        for rel in self.relations:
+            out.extend(rel.content.file_infos())
+        return out
+
+    def source_files_size(self) -> int:
+        return sum(f.size for f in self.source_file_infos())
+
+    def appended_files(self) -> List[FileInfo]:
+        """Files recorded as appended by quick refresh (for hybrid scan)."""
+        out: List[FileInfo] = []
+        for rel in self.relations:
+            if rel.update and rel.update.appended_files:
+                out.extend(rel.update.appended_files.file_infos())
+        return out
+
+    def deleted_files(self) -> List[FileInfo]:
+        out: List[FileInfo] = []
+        for rel in self.relations:
+            if rel.update and rel.update.deleted_files:
+                out.extend(rel.update.deleted_files.file_infos())
+        return out
+
+    def copy_with_update(self, fingerprint: LogicalPlanFingerprint,
+                         appended: Sequence[FileInfo],
+                         deleted: Sequence[FileInfo]) -> "IndexLogEntry":
+        """New entry recording appended/deleted files without touching index
+        data (IndexLogEntry.scala:483-505); used by quick refresh."""
+        if len(self.relations) != 1:
+            raise ValueError("copy_with_update supports single-relation sources")
+        rel = self.relations[0]
+        new_rel = dataclasses.replace(
+            rel,
+            update=Update(
+                appended_files=Content.from_leaf_files(list(appended)),
+                deleted_files=Content.from_leaf_files(list(deleted)),
+            ),
+        )
+        return dataclasses.replace(
+            self,
+            source=Source([new_rel], fingerprint),
+            _tags={},
+        )
+
+    # -- tags (in-memory memoization, IndexLogEntry.scala:560-603) ----------
+    def set_tag(self, key: str, value: Any) -> None:
+        self._tags[key] = value
+
+    def get_tag(self, key: str) -> Optional[Any]:
+        return self._tags.get(key)
+
+    def unset_tag(self, key: str) -> None:
+        self._tags.pop(key, None)
+
+
+class IndexLogEntryTags:
+    """Tag keys (index/IndexLogEntryTags.scala:21-56)."""
+
+    SIGNATURE_MATCHED = "signatureMatched"
+    IS_HYBRIDSCAN_CANDIDATE = "isHybridScanCandidate"
+    HYBRIDSCAN_RELATED_CONFIGS = "hybridScanRelatedConfigs"
+    COMMON_BYTES = "commonBytes"
+
+
+# ---------------------------------------------------------------------------
+# FileIdTracker
+# ---------------------------------------------------------------------------
+class FileIdTracker:
+    """Stable (path, size, mtime) → id map (IndexLogEntry.scala:617-686).
+
+    Ids are handed out monotonically and survive refreshes because the
+    tracker is seeded from the previous log entry; a changed (size, mtime)
+    for the same path gets a fresh id, which is what makes lineage-based
+    deleted-row filtering sound.
+    """
+
+    def __init__(self) -> None:
+        self._ids: Dict[Tuple[str, int, int], int] = {}
+        self._max_id = -1
+
+    @property
+    def max_id(self) -> int:
+        return self._max_id
+
+    def add_file(self, path: str, size: int, mtime: int) -> int:
+        key = (path, size, mtime)
+        fid = self._ids.get(key)
+        if fid is None:
+            self._max_id += 1
+            fid = self._max_id
+            self._ids[key] = fid
+        return fid
+
+    def add_file_info(self, f: FileInfo) -> None:
+        """Seed from a previous entry's recorded files, keeping their ids
+        (IndexLogEntry.scala:648-668)."""
+        if f.id < 0:
+            raise ValueError(f"FileInfo without id: {f.name}")
+        key = (f.name, f.size, f.mtime)
+        existing = self._ids.get(key)
+        if existing is not None and existing != f.id:
+            raise ValueError(f"Conflicting id for {f.name}: {existing} vs {f.id}")
+        self._ids[key] = f.id
+        self._max_id = max(self._max_id, f.id)
+
+    def get_file_id(self, path: str, size: int, mtime: int) -> Optional[int]:
+        return self._ids.get((path, size, mtime))
+
+    def file_to_id_map(self) -> Dict[Tuple[str, int, int], int]:
+        return dict(self._ids)
+
+    @staticmethod
+    def from_log_entry(entry: "IndexLogEntry") -> "FileIdTracker":
+        tracker = FileIdTracker()
+        for f in entry.source_file_infos():
+            tracker.add_file_info(f)
+        return tracker

@@ -1,0 +1,131 @@
+"""Append-only, id-numbered JSON operation log with optimistic concurrency.
+
+Reference contract: index/IndexLogManager.scala:33-166 —
+  - log lives under ``<indexPath>/_hyperspace_log/<id>`` (one JSON file per id)
+  - ``write_log(id, entry)`` MUST fail if the id already exists (multi-writer
+    safety comes from exactly this create-if-absent semantic, :149-165)
+  - ``latestStable`` is a copy of the newest entry whose state is stable
+    (:115-147), with ``get_latest_stable_log`` falling back to a reverse scan
+    (:94-113).
+
+On a local POSIX filesystem, ``open(path, 'x')`` gives the atomic
+create-if-absent we need; object-store backends can subclass and use
+conditional puts.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+from hyperspace_tpu.exceptions import ConcurrentWriteError
+from hyperspace_tpu.index.log_entry import IndexLogEntry, States
+
+HYPERSPACE_LOG_DIR = "_hyperspace_log"  # IndexConstants.scala:66
+LATEST_STABLE = "latestStable"
+
+
+class IndexLogManager:
+    """Manages the operation log of one index (IndexLogManager.scala:33-55)."""
+
+    def __init__(self, index_path: str) -> None:
+        self.index_path = index_path
+        self.log_dir = os.path.join(index_path, HYPERSPACE_LOG_DIR)
+
+    # -- reads --------------------------------------------------------------
+    def get_log(self, log_id: int) -> Optional[IndexLogEntry]:
+        path = os.path.join(self.log_dir, str(log_id))
+        if not os.path.isfile(path):
+            return None
+        with open(path, "r", encoding="utf-8") as f:
+            return IndexLogEntry.from_dict(json.load(f))
+
+    def get_latest_id(self) -> Optional[int]:
+        """Highest committed id (IndexLogManager.scala:83-92)."""
+        if not os.path.isdir(self.log_dir):
+            return None
+        ids = [int(n) for n in os.listdir(self.log_dir) if n.isdigit()]
+        return max(ids) if ids else None
+
+    def get_latest_log(self) -> Optional[IndexLogEntry]:
+        latest = self.get_latest_id()
+        return self.get_log(latest) if latest is not None else None
+
+    def get_latest_stable_log(self) -> Optional[IndexLogEntry]:
+        """The latestStable pointer file if valid, else reverse-scan
+        (IndexLogManager.scala:94-113)."""
+        stable_path = os.path.join(self.log_dir, LATEST_STABLE)
+        if os.path.isfile(stable_path):
+            try:
+                with open(stable_path, "r", encoding="utf-8") as f:
+                    entry = IndexLogEntry.from_dict(json.load(f))
+            except (ValueError, KeyError):
+                # Invalid/stale pointer is treated as absent
+                # (IndexLogManager.scala:94-113) — fall through to the scan.
+                entry = None
+            if entry is not None and entry.state in States.STABLE:
+                return entry
+        latest = self.get_latest_id()
+        if latest is None:
+            return None
+        for log_id in range(latest, -1, -1):
+            entry = self.get_log(log_id)
+            if entry is not None and entry.state in States.STABLE:
+                return entry
+        return None
+
+    # -- writes -------------------------------------------------------------
+    def write_log(self, log_id: int, entry: IndexLogEntry) -> bool:
+        """Atomically create log file ``log_id``; False if it already exists
+        (the optimistic-concurrency check, IndexLogManager.scala:149-165)."""
+        os.makedirs(self.log_dir, exist_ok=True)
+        path = os.path.join(self.log_dir, str(log_id))
+        entry.id = log_id
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return False
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                json.dump(entry.to_dict(), f, indent=2)
+                f.flush()
+                os.fsync(f.fileno())
+        except BaseException:
+            os.unlink(path)
+            raise
+        return True
+
+    def write_log_or_raise(self, log_id: int, entry: IndexLogEntry) -> None:
+        if not self.write_log(log_id, entry):
+            raise ConcurrentWriteError(
+                f"Log id {log_id} for index at {self.index_path!r} was "
+                "committed by a concurrent writer")
+
+    def create_latest_stable_log(self, log_id: int) -> bool:
+        """Copy entry ``log_id`` to the latestStable pointer file
+        (IndexLogManager.scala:115-147)."""
+        src = os.path.join(self.log_dir, str(log_id))
+        if not os.path.isfile(src):
+            return False
+        dst = os.path.join(self.log_dir, LATEST_STABLE)
+        tmp = dst + ".tmp"
+        with open(src, "rb") as f_in, open(tmp, "wb") as f_out:
+            f_out.write(f_in.read())
+            f_out.flush()
+            os.fsync(f_out.fileno())
+        os.replace(tmp, dst)  # atomic on POSIX
+        return True
+
+    def delete_latest_stable_log(self) -> bool:
+        path = os.path.join(self.log_dir, LATEST_STABLE)
+        try:
+            os.unlink(path)
+        except FileNotFoundError:
+            pass
+        return True
+
+    def log_ids(self) -> List[int]:
+        if not os.path.isdir(self.log_dir):
+            return []
+        return sorted(int(n) for n in os.listdir(self.log_dir) if n.isdigit())

@@ -1,0 +1,38 @@
+"""Resolve the index system path and per-index paths.
+
+Reference contract: index/PathResolver.scala:30-76 — the system path comes
+from conf (default ``<warehouse>/indexes``); index lookup is
+case-insensitive against existing directory names (:39-63).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from hyperspace_tpu.config import HyperspaceConf
+
+DEFAULT_SYSTEM_DIR = "spark-warehouse/indexes"  # PathResolver.scala:65-75 analog
+
+
+class PathResolver:
+    def __init__(self, conf: HyperspaceConf) -> None:
+        self._conf = conf
+
+    @property
+    def system_path(self) -> str:
+        path = self._conf.system_path
+        if not path:
+            path = os.path.join(os.getcwd(), DEFAULT_SYSTEM_DIR)
+        return os.path.abspath(path)
+
+    def get_index_path(self, name: str) -> str:
+        """Case-insensitive match against existing index dirs
+        (PathResolver.scala:39-63); falls back to the given name."""
+        root = self.system_path
+        if os.path.isdir(root):
+            lowered = name.lower()
+            for existing in os.listdir(root):
+                if existing.lower() == lowered:
+                    return os.path.join(root, existing)
+        return os.path.join(root, name)

@@ -1,0 +1,115 @@
+"""Structured telemetry events, one per lifecycle action and per rule
+application.
+
+Reference contract: telemetry/HyperspaceEvent.scala:28-156 (event hierarchy:
+AppInfo, CRUD events with index name + message, HyperspaceIndexUsageEvent
+carrying the rewritten plan) and telemetry/HyperspaceEventLogging.scala:30-68
+(pluggable logger, default no-op).  Instead of reflective class loading we
+take a logger instance; ``CollectingEventLogger`` is the test double
+(TestUtils.scala:93-109's MockEventLogger analog).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class AppInfo:
+    """Originating app info (HyperspaceEvent.scala:28-34)."""
+
+    sparkUser: str = ""
+    appId: str = ""
+    appName: str = "hyperspace_tpu"
+
+
+@dataclasses.dataclass
+class HyperspaceEvent:
+    app_info: AppInfo = dataclasses.field(default_factory=AppInfo)
+    timestamp_ms: int = dataclasses.field(default_factory=lambda: int(time.time() * 1000))
+    message: str = ""
+
+    @property
+    def kind(self) -> str:
+        return type(self).__name__
+
+
+@dataclasses.dataclass
+class _IndexActionEvent(HyperspaceEvent):
+    index_name: str = ""
+    state: str = ""  # "" while running, final state or "FAILURE: ..." at end
+
+
+class CreateActionEvent(_IndexActionEvent):
+    pass
+
+
+class DeleteActionEvent(_IndexActionEvent):
+    pass
+
+
+class RestoreActionEvent(_IndexActionEvent):
+    pass
+
+
+class VacuumActionEvent(_IndexActionEvent):
+    pass
+
+
+class CancelActionEvent(_IndexActionEvent):
+    pass
+
+
+class RefreshActionEvent(_IndexActionEvent):
+    pass
+
+
+class OptimizeActionEvent(_IndexActionEvent):
+    pass
+
+
+@dataclasses.dataclass
+class HyperspaceIndexUsageEvent(HyperspaceEvent):
+    """Emitted when a rule rewrites a query to use indexes
+    (HyperspaceEvent.scala:150-156)."""
+
+    index_names: List[str] = dataclasses.field(default_factory=list)
+    plan_before: str = ""
+    plan_after: str = ""
+
+
+class EventLogger:
+    def log_event(self, event: HyperspaceEvent) -> None:
+        raise NotImplementedError
+
+
+class NoOpEventLogger(EventLogger):
+    def log_event(self, event: HyperspaceEvent) -> None:
+        pass
+
+
+class CollectingEventLogger(EventLogger):
+    """Buffers events for assertions (MockEventLogger analog)."""
+
+    def __init__(self) -> None:
+        self.events: List[HyperspaceEvent] = []
+
+    def log_event(self, event: HyperspaceEvent) -> None:
+        self.events.append(event)
+
+    def reset(self) -> None:
+        self.events.clear()
+
+
+_logger: EventLogger = NoOpEventLogger()
+
+
+def get_event_logger() -> EventLogger:
+    return _logger
+
+
+def set_event_logger(logger: Optional[EventLogger]) -> None:
+    global _logger
+    _logger = logger if logger is not None else NoOpEventLogger()

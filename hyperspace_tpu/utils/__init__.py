@@ -1,0 +1,2 @@
+from hyperspace_tpu.utils.hashing import md5_hex, fold_md5
+from hyperspace_tpu.utils.paths import normalize_path, is_data_file

@@ -1,0 +1,29 @@
+"""Host-side hashing helpers for signatures/fingerprints.
+
+Reference contract: util/HashingUtils.scala:24-35 (md5 of a string) and the
+fold pattern in index/FileBasedSignatureProvider.scala:38-61 (fold md5 over
+(size, mtime, path) per file).  Device-side bucket hashing lives in
+hyperspace_tpu.ops.hash — the two are deliberately different: signatures are
+host metadata, bucket assignment is a TPU kernel.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Iterable
+
+
+def md5_hex(value: str) -> str:
+    return hashlib.md5(value.encode("utf-8")).hexdigest()
+
+
+def fold_md5(parts: Iterable[str], init: str = "") -> str:
+    """Order-sensitive md5 fold: h_{i+1} = md5(h_i + part_i).
+
+    Matches the accumulate-then-hash shape of
+    FileBasedSignatureProvider.scala:38-61.
+    """
+    acc = init
+    for part in parts:
+        acc = md5_hex(acc + part)
+    return acc

@@ -1,0 +1,21 @@
+"""Path helpers.
+
+Reference contract: util/PathUtils.scala:22-40 — qualify paths and filter out
+non-data files (names starting with ``_`` or ``.``).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def normalize_path(path: str) -> str:
+    """Absolute, symlink-free, scheme-less canonical form of a local path."""
+    return os.path.abspath(os.path.expanduser(path))
+
+
+def is_data_file(name: str) -> bool:
+    """Spark convention: files starting with '_' or '.' are metadata, not data
+    (PathUtils.scala:31-36)."""
+    base = os.path.basename(name)
+    return not (base.startswith("_") or base.startswith("."))

@@ -1,0 +1,25 @@
+"""Test harness configuration.
+
+Runs JAX on a virtual 8-device CPU mesh so distribution tests exercise real
+shardings without TPU hardware (the analog of the reference's local[4] Spark
+with 5 shuffle partitions, build.sbt:94-101 / SparkInvolvedSuite.scala:31-36).
+
+Environment must be set before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest
+
+
+@pytest.fixture()
+def tmp_index_root(tmp_path):
+    """Per-test index system path (HyperspaceSuite.scala:28-121 analog)."""
+    root = tmp_path / "indexes"
+    root.mkdir()
+    return str(root)

@@ -1,0 +1,110 @@
+"""Action state-machine tests: transitions, validation, cancel recovery.
+
+Mirrors actions/ActionTest.scala, DeleteActionTest, RestoreActionTest,
+VacuumActionTest, CancelActionTest.
+"""
+
+import os
+
+import pytest
+
+from hyperspace_tpu.actions.cancel import CancelAction
+from hyperspace_tpu.actions.delete import DeleteAction
+from hyperspace_tpu.actions.restore import RestoreAction
+from hyperspace_tpu.actions.vacuum import VacuumAction
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.index.data_manager import IndexDataManager
+from hyperspace_tpu.index.log_entry import States
+from hyperspace_tpu.index.log_manager import IndexLogManager
+from hyperspace_tpu.telemetry.events import CollectingEventLogger, set_event_logger
+from tests.utils import sample_entry
+
+
+@pytest.fixture()
+def active_index(tmp_index_root):
+    """An index committed as ACTIVE at log id 2 (post-create layout)."""
+    path = os.path.join(tmp_index_root, "idx")
+    mgr = IndexLogManager(path)
+    mgr.write_log(1, sample_entry(state=States.CREATING))
+    mgr.write_log(2, sample_entry(state=States.ACTIVE))
+    mgr.create_latest_stable_log(2)
+    return path, mgr
+
+
+def test_delete_then_restore(active_index):
+    path, mgr = active_index
+    DeleteAction(mgr).run()
+    assert mgr.get_latest_log().state == States.DELETED
+    assert mgr.get_latest_log().id == 4  # begin at 3, end at 4
+    assert mgr.get_latest_stable_log().state == States.DELETED
+
+    RestoreAction(mgr).run()
+    assert mgr.get_latest_log().state == States.ACTIVE
+    assert mgr.get_latest_stable_log().id == 6
+
+
+def test_delete_requires_active(active_index):
+    path, mgr = active_index
+    DeleteAction(mgr).run()
+    with pytest.raises(HyperspaceError):
+        DeleteAction(mgr).run()
+
+
+def test_restore_requires_deleted(active_index):
+    _, mgr = active_index
+    with pytest.raises(HyperspaceError):
+        RestoreAction(mgr).run()
+
+
+def test_vacuum_removes_data(active_index):
+    path, mgr = active_index
+    dm = IndexDataManager(path)
+    os.makedirs(dm.version_path(0))
+    os.makedirs(dm.version_path(1))
+    with pytest.raises(HyperspaceError):
+        VacuumAction(mgr, dm).run()  # must be DELETED first
+    DeleteAction(mgr).run()
+    VacuumAction(mgr, dm).run()
+    assert dm.versions() == []
+    assert mgr.get_latest_log().state == States.DOESNOTEXIST
+
+
+def test_cancel_rolls_back_to_stable(active_index):
+    path, mgr = active_index
+    # Simulate an action dying mid-flight: transient entry is latest.
+    mgr.write_log(3, sample_entry(state=States.REFRESHING))
+    with pytest.raises(HyperspaceError):
+        DeleteAction(mgr).run()  # refuses: not ACTIVE
+    CancelAction(mgr).run()
+    latest = mgr.get_latest_log()
+    assert latest.state == States.ACTIVE
+    assert latest.id == 4
+    # Now normal operation resumes.
+    DeleteAction(mgr).run()
+    assert mgr.get_latest_log().state == States.DELETED
+
+
+def test_cancel_vacuuming_goes_to_doesnotexist(active_index):
+    path, mgr = active_index
+    mgr.write_log(3, sample_entry(state=States.VACUUMING))
+    CancelAction(mgr).run()
+    assert mgr.get_latest_log().state == States.DOESNOTEXIST
+
+
+def test_cancel_rejects_stable(active_index):
+    _, mgr = active_index
+    with pytest.raises(HyperspaceError):
+        CancelAction(mgr).run()
+
+
+def test_action_events_emitted(active_index):
+    _, mgr = active_index
+    logger = CollectingEventLogger()
+    set_event_logger(logger)
+    try:
+        DeleteAction(mgr).run()
+    finally:
+        set_event_logger(None)
+    kinds = [e.kind for e in logger.events]
+    assert "DeleteActionEvent" in kinds
+    assert logger.events[-1].state == States.DELETED

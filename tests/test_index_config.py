@@ -1,0 +1,51 @@
+"""IndexConfig validation tests (index/IndexConfigTest.scala)."""
+
+import pytest
+
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.index.index_config import IndexConfig
+
+
+def test_valid_config():
+    c = IndexConfig("idx1", ["a", "b"], ["c"])
+    assert c.all_columns == ["a", "b", "c"]
+
+
+def test_empty_name_rejected():
+    with pytest.raises(HyperspaceError):
+        IndexConfig("  ", ["a"])
+
+
+def test_empty_indexed_rejected():
+    with pytest.raises(HyperspaceError):
+        IndexConfig("idx", [])
+
+
+def test_duplicate_columns_rejected():
+    with pytest.raises(HyperspaceError):
+        IndexConfig("idx", ["a", "A"])
+    with pytest.raises(HyperspaceError):
+        IndexConfig("idx", ["a"], ["b", "B"])
+    with pytest.raises(HyperspaceError):
+        IndexConfig("idx", ["a"], ["A"])
+
+
+def test_case_insensitive_equality():
+    assert IndexConfig("IDX", ["A"], ["B", "c"]) == IndexConfig("idx", ["a"], ["C", "b"])
+    assert IndexConfig("idx", ["a"]) != IndexConfig("idx", ["b"])
+    assert hash(IndexConfig("IDX", ["A"])) == hash(IndexConfig("idx", ["a"]))
+
+
+def test_conf_registry():
+    from hyperspace_tpu import config as C
+
+    conf = C.HyperspaceConf()
+    assert conf.num_buckets == 200
+    assert conf.hybrid_scan_max_appended_ratio == 0.3
+    assert conf.optimize_file_size_threshold == 256 * 1024 * 1024
+    conf.set(C.NUM_BUCKETS, "8")
+    assert conf.num_buckets == 8
+    conf.set(C.LINEAGE_ENABLED, "true")
+    assert conf.lineage_enabled is True
+    with pytest.raises(KeyError):
+        conf.set("bogus.key", 1)

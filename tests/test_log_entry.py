@@ -1,0 +1,177 @@
+"""Metadata model tests: JSON round-trip, content trees, merge, tracker.
+
+Mirrors index/IndexLogEntryTest.scala (content-tree merge cases) and
+util/JsonUtilsTest.scala.
+"""
+
+import os
+
+from hyperspace_tpu.index.log_entry import (
+    Content,
+    Directory,
+    FileIdTracker,
+    FileInfo,
+    IndexLogEntry,
+    LogicalPlanFingerprint,
+    Signature,
+    States,
+)
+from tests.utils import sample_entry
+
+
+def test_log_entry_json_roundtrip():
+    entry = sample_entry()
+    d = entry.to_dict()
+    back = IndexLogEntry.from_dict(d)
+    assert back.name == entry.name
+    assert back.state == entry.state
+    assert back.indexed_columns == ["id"]
+    assert back.included_columns == ["name"]
+    assert back.num_buckets == 4
+    assert back.signature().value == "sig0"
+    assert back.to_dict() == d
+
+
+def test_content_files_roundtrip():
+    files = [
+        FileInfo("/a/b/f1.parquet", 1, 10, 0),
+        FileInfo("/a/b/f2.parquet", 2, 20, 1),
+        FileInfo("/a/c/f3.parquet", 3, 30, 2),
+    ]
+    content = Content.from_leaf_files(files)
+    assert sorted(content.files()) == ["/a/b/f1.parquet", "/a/b/f2.parquet", "/a/c/f3.parquet"]
+    infos = {f.name: f for f in content.file_infos()}
+    assert infos["/a/b/f2.parquet"].size == 2
+    assert infos["/a/c/f3.parquet"].id == 2
+
+
+def test_directory_merge_unions_files_and_subdirs():
+    c1 = Content.from_leaf_files([
+        FileInfo("/r/x/f1", 1, 1, 0),
+        FileInfo("/r/y/f2", 2, 2, 1),
+    ])
+    c2 = Content.from_leaf_files([
+        FileInfo("/r/x/f1", 1, 1, 0),   # duplicate — must not double
+        FileInfo("/r/x/f3", 3, 3, 2),
+        FileInfo("/r/z/f4", 4, 4, 3),
+    ])
+    merged = c1.merge(c2)
+    assert sorted(merged.files()) == ["/r/x/f1", "/r/x/f3", "/r/y/f2", "/r/z/f4"]
+
+
+def test_from_directory_lists_and_tracks(tmp_path):
+    d = tmp_path / "data"
+    sub = d / "sub"
+    sub.mkdir(parents=True)
+    (d / "a.parquet").write_bytes(b"xx")
+    (d / "_metadata").write_bytes(b"meta")       # skipped: leading underscore
+    (d / ".hidden").write_bytes(b"h")            # skipped: leading dot
+    (sub / "b.parquet").write_bytes(b"yyy")
+    tracker = FileIdTracker()
+    content = Content.from_directory(str(d), tracker)
+    files = sorted(content.files())
+    assert files == [str(d / "a.parquet"), str(sub / "b.parquet")]
+    assert tracker.max_id == 1
+
+
+def test_file_id_tracker_stability():
+    t = FileIdTracker()
+    id1 = t.add_file("/f1", 10, 100)
+    id2 = t.add_file("/f2", 20, 200)
+    assert (id1, id2) == (0, 1)
+    # Same key → same id.
+    assert t.add_file("/f1", 10, 100) == id1
+    # Changed mtime → new id (lineage soundness).
+    assert t.add_file("/f1", 10, 999) == 2
+
+    # Seeding from a previous entry keeps ids.
+    t2 = FileIdTracker()
+    t2.add_file_info(FileInfo("/f2", 20, 200, 7))
+    assert t2.add_file("/f2", 20, 200) == 7
+    assert t2.add_file("/new", 1, 1) == 8
+
+
+def test_copy_with_update_records_appended_deleted():
+    entry = sample_entry()
+    appended = [FileInfo("/data/t/new.parquet", 5, 5, 10)]
+    deleted = [FileInfo("/data/t/f1.parquet", 100, 100, 0)]
+    fp = LogicalPlanFingerprint([Signature("IndexSignatureProvider", "sig1")])
+    updated = entry.copy_with_update(fp, appended, deleted)
+    assert [f.name for f in updated.appended_files()] == ["/data/t/new.parquet"]
+    assert [f.id for f in updated.deleted_files()] == [0]
+    assert updated.signature().value == "sig1"
+    # Round-trips through JSON.
+    back = IndexLogEntry.from_dict(updated.to_dict())
+    assert [f.name for f in back.appended_files()] == ["/data/t/new.parquet"]
+
+
+def test_tags_are_memory_only():
+    entry = sample_entry()
+    entry.set_tag("signatureMatched", True)
+    assert entry.get_tag("signatureMatched") is True
+    back = IndexLogEntry.from_dict(entry.to_dict())
+    assert back.get_tag("signatureMatched") is None
+
+
+def test_from_directory_tree_shape_and_merge(tmp_path):
+    # Regression: subdirs must not be re-wrapped in ancestor chains.
+    d = tmp_path / "X"
+    (d / "a").mkdir(parents=True)
+    (d / "a" / "f2.parquet").write_bytes(b"22")
+    tracker = FileIdTracker()
+    c1 = Content.from_directory(str(d), tracker)
+    leaf = str(d / "a" / "f2.parquet")
+    assert c1.files() == [leaf]
+    # Merging with a same-leaf tree must not duplicate files.
+    infos = c1.file_infos()
+    c2 = Content.from_leaf_files(infos)
+    assert sorted(c1.merge(c2).files()) == [leaf]
+
+
+def test_from_directory_relative_path_tracker_stability(tmp_path, monkeypatch):
+    # Regression: tracker keys must be absolute regardless of input path form.
+    d = tmp_path / "rel"
+    d.mkdir()
+    (d / "f1.parquet").write_bytes(b"x")
+    monkeypatch.chdir(tmp_path)
+    t1 = FileIdTracker()
+    c = Content.from_directory("rel", t1)
+    t2 = FileIdTracker()
+    for f in c.file_infos():
+        t2.add_file_info(f)
+    c2 = Content.from_directory("rel", t2)
+    assert c2.file_infos()[0].id == c.file_infos()[0].id
+
+
+def test_stale_action_base_id_conflict(tmp_index_root):
+    # Regression: an action constructed before a concurrent commit must hit
+    # ConcurrentWriteError, not silently overwrite the other writer.
+    import os
+    import pytest
+    from hyperspace_tpu.actions.delete import DeleteAction
+    from hyperspace_tpu.actions.restore import RestoreAction
+    from hyperspace_tpu.exceptions import ConcurrentWriteError
+    from hyperspace_tpu.index.log_manager import IndexLogManager
+
+    mgr = IndexLogManager(os.path.join(tmp_index_root, "idx"))
+    mgr.write_log(1, sample_entry(state=States.CREATING))
+    mgr.write_log(2, sample_entry(state=States.ACTIVE))
+    mgr.create_latest_stable_log(2)
+    stale = DeleteAction(mgr)       # captures base_id=2
+    DeleteAction(mgr).run()         # concurrent writer commits ids 3,4
+    with pytest.raises(ConcurrentWriteError):
+        stale.run()
+
+
+def test_bad_latest_stable_pointer_falls_back(tmp_index_root):
+    import os
+    from hyperspace_tpu.index.log_manager import IndexLogManager, LATEST_STABLE
+
+    mgr = IndexLogManager(os.path.join(tmp_index_root, "idx"))
+    mgr.write_log(1, sample_entry(state=States.CREATING))
+    mgr.write_log(2, sample_entry(state=States.ACTIVE))
+    mgr.create_latest_stable_log(2)
+    # Corrupt the pointer (e.g. version bump leftovers): must fall back to scan.
+    with open(os.path.join(mgr.log_dir, LATEST_STABLE), "w") as f:
+        f.write('{"version": "9.9"}')
+    assert mgr.get_latest_stable_log().id == 2

@@ -1,0 +1,43 @@
+"""Operation-log manager tests: create-if-absent, latestStable fallback.
+
+Mirrors index/IndexLogManagerImplTest.scala.
+"""
+
+import os
+
+from hyperspace_tpu.index.log_entry import States
+from hyperspace_tpu.index.log_manager import IndexLogManager
+from tests.utils import sample_entry
+
+
+def test_write_log_create_if_absent(tmp_index_root):
+    mgr = IndexLogManager(os.path.join(tmp_index_root, "idx"))
+    e = sample_entry(state=States.CREATING)
+    assert mgr.write_log(1, e) is True
+    # Second write to the same id must fail — optimistic concurrency.
+    assert mgr.write_log(1, e) is False
+    assert mgr.get_latest_id() == 1
+    assert mgr.get_log(1).state == States.CREATING
+
+
+def test_latest_stable_pointer_and_fallback(tmp_index_root):
+    mgr = IndexLogManager(os.path.join(tmp_index_root, "idx"))
+    mgr.write_log(1, sample_entry(state=States.CREATING))
+    mgr.write_log(2, sample_entry(state=States.ACTIVE))
+    mgr.create_latest_stable_log(2)
+    assert mgr.get_latest_stable_log().state == States.ACTIVE
+
+    # A transient entry beyond the pointer does not change latestStable.
+    mgr.write_log(3, sample_entry(state=States.REFRESHING))
+    assert mgr.get_latest_stable_log().id == 2
+
+    # Without the pointer file, reverse scan still finds the stable entry.
+    mgr.delete_latest_stable_log()
+    assert mgr.get_latest_stable_log().id == 2
+
+
+def test_get_latest_log_empty(tmp_index_root):
+    mgr = IndexLogManager(os.path.join(tmp_index_root, "nope"))
+    assert mgr.get_latest_id() is None
+    assert mgr.get_latest_log() is None
+    assert mgr.get_latest_stable_log() is None

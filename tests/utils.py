@@ -1,0 +1,91 @@
+"""Shared test fixtures: fabricated log entries and sample datasets.
+
+Mirrors the reference's TestUtils.scala:27-88 (log helpers) and
+SampleData.scala:24-50 (canonical small dataset).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+from hyperspace_tpu.index.log_entry import (
+    Content,
+    CoveringIndex,
+    Directory,
+    FileInfo,
+    IndexLogEntry,
+    LogicalPlanFingerprint,
+    Relation,
+    Signature,
+    Source,
+    States,
+)
+
+SAMPLE_ROWS = [
+    # (date str, hour, id, name, other)  — SampleData.scala:24-50 analog
+    ("2017-09-03", 810, 3810024, "donde", 332057),
+    ("2017-09-03", 650, 3810012, "down", 820164),
+    ("2017-09-04", 340, 3810076, "take", 757795),
+    ("2017-09-05", 820, 3810024, "cart", 832047),
+    ("2017-09-06", 800, 3810024, "down", 832047),
+    ("2017-09-07", 100, 3810024, "down", 832047),
+    ("2017-09-03", 200, 3810048, "donde", 832047),
+    ("2017-09-08", 100, 3810024, "donde", 832047),
+    ("2017-09-09", 340, 3810024, "donde", 832047),
+    ("2017-09-01", 400, 3810025, "down", 832047),
+]
+SAMPLE_COLUMNS = ["date", "hour", "id", "name", "other"]
+
+
+def write_sample_parquet(path: str, n_files: int = 2) -> List[str]:
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    os.makedirs(path, exist_ok=True)
+    cols = list(zip(*SAMPLE_ROWS))
+    table = pa.table({name: list(vals) for name, vals in zip(SAMPLE_COLUMNS, cols)})
+    paths = []
+    rows_per = max(1, len(SAMPLE_ROWS) // n_files)
+    for i in range(n_files):
+        chunk = table.slice(i * rows_per, rows_per if i < n_files - 1 else len(SAMPLE_ROWS))
+        out = os.path.join(path, f"part-{i:05d}.parquet")
+        pq.write_table(chunk, out)
+        paths.append(out)
+    return paths
+
+
+def sample_entry(name: str = "myIndex",
+                 state: str = States.ACTIVE,
+                 source_files: Optional[List[FileInfo]] = None,
+                 indexed: Optional[List[str]] = None,
+                 included: Optional[List[str]] = None,
+                 num_buckets: int = 4,
+                 signature_value: str = "sig0",
+                 index_files: Optional[List[FileInfo]] = None) -> IndexLogEntry:
+    """Fabricate a log entry without building an index
+    (IndexLogManagerImplTest.scala:30-80 / HyperspaceRuleSuite.scala:31-111)."""
+    source_files = source_files or [FileInfo("/data/t/f1.parquet", 100, 100, 0)]
+    index_files = index_files or [FileInfo("/idx/v__=0/part-0.parquet", 10, 10, -1)]
+    schema: Dict[str, str] = {c: "int64" for c in (indexed or ["id"]) + (included or ["name"])}
+    return IndexLogEntry(
+        name=name,
+        derived_dataset=CoveringIndex(
+            indexed_columns=indexed or ["id"],
+            included_columns=included or ["name"],
+            num_buckets=num_buckets,
+            schema=schema,
+        ),
+        content=Content(Directory.from_leaf_files(index_files)),
+        source=Source(
+            relations=[Relation(
+                root_paths=["/data/t"],
+                content=Content(Directory.from_leaf_files(source_files)),
+                schema=schema,
+                file_format="parquet",
+            )],
+            fingerprint=LogicalPlanFingerprint(
+                [Signature("IndexSignatureProvider", signature_value)]),
+        ),
+        state=state,
+    )
