@@ -1,0 +1,32 @@
+"""hyperspace_tpu: a TPU-native indexing subsystem for lake-resident data.
+
+Users build covering indexes — bucket-hashed, sorted, column-pruned copies
+of source datasets — and optimizer rules transparently rewrite filter/join
+queries to scan the index instead of the raw data.  The data plane (hash,
+sort, predicate, join) runs on TPU via JAX/XLA; the metadata/control plane
+(operation log, action state machine, signatures, hybrid scan) is host-side.
+
+Public API mirrors the reference surface (Hyperspace.scala:26-166,
+package.scala:47-79, python/hyperspace/hyperspace.py:9).
+"""
+
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.dataset import Dataset
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.plan.expr import col, lit
+from hyperspace_tpu.session import HyperspaceSession
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Hyperspace",
+    "HyperspaceSession",
+    "HyperspaceConf",
+    "HyperspaceError",
+    "IndexConfig",
+    "Dataset",
+    "col",
+    "lit",
+]

@@ -1,0 +1,233 @@
+"""CreateAction: validate config/plan, build the covering index on device,
+commit the log entry.
+
+Reference contract: actions/CreateAction.scala:30-90 (validate :45-66 —
+supported relation, resolvable columns, free name) and
+actions/CreateActionBase.scala:56-222 —
+  - ``write``: select columns → repartition(numBuckets, indexedCols) →
+    saveWithBuckets (:124-142).  Here that whole pipeline is the fused TPU
+    kernel ``bucket_sort_permutation`` (hash + lexsort on device) plus a
+    host-side bucketed Parquet writer — no cluster shuffle exists because
+    the permutation materializes the shuffle's effect directly.
+  - lineage (:177-222): the reference joins ``input_file_name()`` against a
+    broadcast file→id map; we attach ``_data_file_id`` per file at read
+    time — same result, no join needed, because the engine owns the reader.
+  - ``getIndexLogEntry`` (:56-105): signature of the source plan, content
+    tree of the written files, provider-enriched properties.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from hyperspace_tpu.actions.base import Action
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.index.data_manager import IndexDataManager
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.index.log_entry import (
+    Content,
+    CoveringIndex,
+    FileIdTracker,
+    IndexLogEntry,
+    LogicalPlanFingerprint,
+    Signature,
+    Source,
+    States,
+)
+from hyperspace_tpu.index.log_manager import IndexLogManager
+from hyperspace_tpu.index.signatures import get_provider
+from hyperspace_tpu.io import columnar
+from hyperspace_tpu.io.parquet import read_table, write_bucketed
+from hyperspace_tpu.plan.nodes import LogicalPlan, Scan
+from hyperspace_tpu.telemetry.events import CreateActionEvent
+from hyperspace_tpu.utils.resolver import resolve_or_raise
+
+DATA_FILE_ID_COLUMN = "_data_file_id"  # IndexConstants.scala lineage column
+
+
+class CreateActionBase(Action):
+    """Shared by Create and the data-rebuilding Refresh actions."""
+
+    def __init__(self, log_manager: IndexLogManager, data_manager: IndexDataManager,
+                 session, plan: LogicalPlan, config: IndexConfig) -> None:
+        super().__init__(log_manager)
+        self.data_manager = data_manager
+        self.session = session
+        self.plan = plan
+        self.config = config
+        self._written_version: Optional[int] = None
+        self._file_id_tracker = FileIdTracker()
+        self._relation_cache = None
+
+    @property
+    def conf(self) -> HyperspaceConf:
+        return self.session.conf
+
+    @property
+    def index_name(self) -> str:
+        return self.config.index_name
+
+    @property
+    def num_buckets(self) -> int:
+        return self.conf.num_buckets
+
+    @property
+    def lineage_enabled(self) -> bool:
+        # Refresh actions override both properties to pin the previous
+        # entry's values (RefreshActionBase.scala:56-64).
+        return self.conf.lineage_enabled
+
+    def _relation(self):
+        # Cached for the action's lifetime: the plan is fixed, and the
+        # relation's file listing must not be re-walked per accessor call.
+        if self._relation_cache is None:
+            leaves = self.plan.leaf_relations()
+            if len(leaves) != 1:
+                # CreateAction.scala:52-58: exactly one supported relation.
+                raise HyperspaceError(
+                    f"Only plans over exactly one relation are supported for "
+                    f"indexing; found {len(leaves)}")
+            self._relation_cache = \
+                self.session.source_provider_manager.get_relation(leaves[0])
+        return self._relation_cache
+
+    def _resolved_config(self) -> IndexConfig:
+        """Resolve config columns against the relation schema
+        (CreateActionBase.resolveConfig:155-175)."""
+        schema = self._relation().schema()
+        indexed = resolve_or_raise(self.config.indexed_columns, schema, "indexed column")
+        included = resolve_or_raise(self.config.included_columns, schema, "included column")
+        return IndexConfig(self.config.index_name, indexed, included)
+
+    # -- the build (CreateActionBase.write:124-142, TPU-style) --------------
+    def _build_index_data(self, file_names: Optional[List[str]] = None) -> None:
+        """Read source columns, run the fused hash+sort kernel, write one
+        sorted Parquet file per bucket into the next ``v__=N`` directory."""
+        relation = self._relation()
+        resolved = self._resolved_config()
+        lineage = self.lineage_enabled
+        files = relation.all_files(self._file_id_tracker)
+        if file_names is not None:
+            wanted = set(file_names)
+            files = [f for f in files if f.name in wanted]
+        if not files:
+            raise HyperspaceError("No source data files to index")
+
+        columns = resolved.all_columns
+        tables: List[pa.Table] = []
+        for f in files:
+            t = read_table([f.name], relation.file_format, columns, relation.options)
+            if lineage:
+                # Lineage column: constant file id per source file
+                # (CreateActionBase.scala:177-222 without the broadcast join).
+                fid = np.full(t.num_rows, f.id, dtype=np.int64)
+                t = t.append_column(DATA_FILE_ID_COLUMN, pa.array(fid))
+            tables.append(t)
+        table = pa.concat_tables(tables, promote_options="default")
+        self._write_table_bucketed(table, resolved)
+
+    def _write_table_bucketed(self, table: pa.Table, resolved: IndexConfig,
+                              version: Optional[int] = None) -> None:
+        from hyperspace_tpu.ops.sort import bucket_sort_permutation
+
+        word_cols = [columnar.to_hash_words(table.column(c))
+                     for c in resolved.indexed_columns]
+        order_keys = [columnar.to_order_key(table.column(c))
+                      for c in resolved.indexed_columns]
+        buckets, perm = bucket_sort_permutation(
+            [np.asarray(w) for w in word_cols],
+            [np.asarray(k) for k in order_keys],
+            self.num_buckets)
+        version = self.data_manager.get_next_version() if version is None else version
+        out_dir = self.data_manager.version_path(version)
+        write_bucketed(table, np.asarray(buckets), np.asarray(perm),
+                       self.num_buckets, out_dir)
+        self._written_version = version
+        self._index_schema = {name: str(t) for name, t in
+                              zip(table.column_names, table.schema.types)}
+
+    # -- log entry (CreateActionBase.getIndexLogEntry:56-105) ---------------
+    def _signature(self) -> Signature:
+        provider_name = self.conf.signature_provider
+        provider = get_provider(provider_name)
+        value = provider.signature(
+            self.plan,
+            lambda scan: self.session.source_provider_manager
+            .get_relation(scan).all_files())
+        if value is None:
+            raise HyperspaceError("Could not compute plan signature")
+        return Signature(provider_name, value)
+
+    def _build_log_entry(self) -> IndexLogEntry:
+        relation = self._relation()
+        resolved = self._resolved_config()
+        rel_meta = relation.create_relation_metadata(self._file_id_tracker)
+        properties: Dict[str, str] = {"lineage": str(self.lineage_enabled).lower()}
+        properties = self.session.source_provider_manager.enrich_index_properties(
+            rel_meta, properties)
+        content = Content.from_directory(
+            self.data_manager.version_path(self._written_version), FileIdTracker())
+        return IndexLogEntry(
+            name=self.config.index_name,
+            derived_dataset=CoveringIndex(
+                indexed_columns=resolved.indexed_columns,
+                included_columns=resolved.included_columns,
+                num_buckets=self.num_buckets,
+                schema=getattr(self, "_index_schema", {}),
+            ),
+            content=content,
+            source=Source(relations=[rel_meta],
+                          fingerprint=LogicalPlanFingerprint([self._signature()])),
+            properties=properties,
+        )
+
+
+class CreateAction(CreateActionBase):
+    transient_state = States.CREATING
+    final_state = States.ACTIVE
+    event_class = CreateActionEvent
+
+    def validate(self) -> None:
+        # CreateAction.scala:45-66
+        if self.previous_log_entry is not None and \
+                self.previous_log_entry.state not in (States.DOESNOTEXIST,):
+            raise HyperspaceError(
+                f"Another index with name {self.config.index_name!r} already "
+                f"exists in state {self.previous_log_entry.state}")
+        leaves = self.plan.leaf_relations()
+        if len(leaves) != 1 or not \
+                self.session.source_provider_manager.is_supported_relation(leaves[0]):
+            raise HyperspaceError("Only plans over one supported file-based "
+                                  "relation can be indexed")
+        self._resolved_config()  # raises on unresolvable columns
+
+    def log_entry_for_begin(self) -> IndexLogEntry:
+        # Fresh entry: the index data hasn't been written yet, so content is
+        # a placeholder tree of the (empty) v0 dir.
+        relation = self._relation()
+        resolved = self._resolved_config()
+        rel_meta = relation.create_relation_metadata(FileIdTracker())
+        return IndexLogEntry(
+            name=self.config.index_name,
+            derived_dataset=CoveringIndex(
+                indexed_columns=resolved.indexed_columns,
+                included_columns=resolved.included_columns,
+                num_buckets=self.num_buckets,
+                schema={},
+            ),
+            content=Content.from_leaf_files(
+                []) or Content.from_directory(self.data_manager.index_path, FileIdTracker()),
+            source=Source(relations=[rel_meta],
+                          fingerprint=LogicalPlanFingerprint([self._signature()])),
+        )
+
+    def op(self) -> None:
+        self._build_index_data()
+
+    def log_entry(self) -> IndexLogEntry:
+        return self._build_log_entry()

@@ -1,0 +1,111 @@
+"""OptimizeAction: compact small index files bucket-wise.
+
+Reference contract: actions/OptimizeAction.scala:46-175 —
+  - mode "quick": only files below ``optimizeFileSizeThreshold`` (256 MB
+    default, IndexConstants.scala:91-92) are compaction candidates; mode
+    "full": every file (:70-83);
+  - buckets with a single candidate file are skipped — nothing to merge
+    (:115-133, using the bucket id recovered from the file name);
+  - ``op()`` reads each bucket's candidate files, merges them sorted, and
+    writes one file per bucket into a new version dir (:85-99);
+  - the committed entry's content keeps non-optimized files and swaps the
+    merged ones (:139-170); the source snapshot/fingerprint are untouched —
+    this is an index-only operation.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+from collections import defaultdict
+from typing import Dict, List
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from hyperspace_tpu.actions.base import Action
+from hyperspace_tpu.exceptions import HyperspaceError, NoChangesError
+from hyperspace_tpu.index.data_manager import IndexDataManager
+from hyperspace_tpu.index.log_entry import (
+    Content,
+    FileIdTracker,
+    FileInfo,
+    IndexLogEntry,
+    States,
+)
+from hyperspace_tpu.index.log_manager import IndexLogManager
+from hyperspace_tpu.io import columnar
+from hyperspace_tpu.io.parquet import bucket_file_name, bucket_id_of_file
+from hyperspace_tpu.telemetry.events import OptimizeActionEvent
+
+
+class OptimizeAction(Action):
+    transient_state = States.OPTIMIZING
+    final_state = States.ACTIVE
+    event_class = OptimizeActionEvent
+
+    def __init__(self, log_manager: IndexLogManager, data_manager: IndexDataManager,
+                 session, mode: str = "quick") -> None:
+        super().__init__(log_manager)
+        self.data_manager = data_manager
+        self.session = session
+        self.mode = mode
+        self._new_files: List[str] = []
+        self._retained: List[FileInfo] = []
+
+    def _candidates(self) -> Dict[int, List[FileInfo]]:
+        """Bucket → files worth merging (OptimizeAction.scala:115-133)."""
+        entry = self.previous_log_entry
+        threshold = self.session.conf.optimize_file_size_threshold
+        by_bucket: Dict[int, List[FileInfo]] = defaultdict(list)
+        retained: List[FileInfo] = []
+        for f in entry.content.file_infos():
+            bucket = bucket_id_of_file(f.name)
+            if bucket is None or (self.mode == "quick" and f.size >= threshold):
+                retained.append(f)
+            else:
+                by_bucket[bucket].append(f)
+        mergeable = {b: fs for b, fs in by_bucket.items() if len(fs) > 1}
+        for b, fs in by_bucket.items():
+            if len(fs) <= 1:
+                retained.extend(fs)
+        self._retained = retained
+        return mergeable
+
+    def validate(self) -> None:
+        if self.previous_log_entry is None or \
+                self.previous_log_entry.state != States.ACTIVE:
+            raise HyperspaceError(
+                f"Optimize is only supported in {States.ACTIVE} state")
+        if not self._candidates():
+            raise NoChangesError(
+                "No index files eligible for optimization (every bucket has "
+                "a single file or files exceed the size threshold)")
+
+    def op(self) -> None:
+        entry = self.previous_log_entry
+        mergeable = self._candidates()
+        version = self.data_manager.get_next_version()
+        out_dir = self.data_manager.version_path(version)
+        os.makedirs(out_dir, exist_ok=True)
+        sort_cols = entry.indexed_columns
+        for bucket, files in sorted(mergeable.items()):
+            merged = pa.concat_tables(
+                [pq.read_table(f.name) for f in files], promote_options="default")
+            keys = [columnar.to_order_key(merged.column(c)) for c in sort_cols]
+            perm = np.lexsort(tuple(reversed(keys)))
+            merged = merged.take(pa.array(perm))
+            path = os.path.join(out_dir, bucket_file_name(bucket))
+            pq.write_table(merged, path)
+            self._new_files.append(path)
+
+    def log_entry(self) -> IndexLogEntry:
+        entry = copy.deepcopy(self.previous_log_entry)
+        tracker = FileIdTracker()
+        new_infos = []
+        for path in self._new_files:
+            st = os.stat(path)
+            new_infos.append(FileInfo(path, st.st_size, int(st.st_mtime_ns), -1))
+        entry.content = Content.from_leaf_files(self._retained + new_infos)
+        return entry

@@ -1,0 +1,186 @@
+"""Refresh actions: bring an index up to date with mutated source data.
+
+Reference contract:
+  - RefreshActionBase (actions/RefreshActionBase.scala:33-145): reconstructs
+    the source dataset from the *stored* relation metadata via the provider
+    (:71-89), diffs current files vs the entry's recorded files into
+    appended/deleted sets (:115-144), and pins numBuckets + lineage to the
+    previous entry (:56-64) so a refreshed index stays self-consistent.
+  - RefreshAction (full rebuild; no-op when source unchanged,
+    actions/RefreshAction.scala:33-59).
+  - RefreshIncrementalAction (actions/RefreshIncrementalAction.scala:54-145):
+    appended files → index just those into a new version; deleted files →
+    rewrite the old index minus rows whose lineage id is deleted; the log
+    entry merges old+new content trees only when no deletes occurred.
+  - RefreshQuickAction (actions/RefreshQuickAction.scala:37-80): metadata-only
+    — records appended/deleted lists + the new fingerprint and defers data
+    handling to Hybrid Scan at query time.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from hyperspace_tpu.actions.create import DATA_FILE_ID_COLUMN, CreateActionBase
+from hyperspace_tpu.exceptions import HyperspaceError, NoChangesError
+from hyperspace_tpu.index.data_manager import IndexDataManager
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.index.log_entry import (
+    Content,
+    FileIdTracker,
+    FileInfo,
+    IndexLogEntry,
+    LogicalPlanFingerprint,
+    States,
+)
+from hyperspace_tpu.index.log_manager import IndexLogManager
+from hyperspace_tpu.io.parquet import read_table
+from hyperspace_tpu.plan.nodes import Scan, ScanRelation
+from hyperspace_tpu.telemetry.events import RefreshActionEvent
+
+
+class RefreshActionBase(CreateActionBase):
+    transient_state = States.REFRESHING
+    final_state = States.ACTIVE
+    event_class = RefreshActionEvent
+
+    def __init__(self, log_manager: IndexLogManager, data_manager: IndexDataManager,
+                 session) -> None:
+        prev = log_manager.get_latest_stable_log()
+        if prev is None:
+            raise HyperspaceError("Refresh: index does not exist")
+        if len(prev.relations) != 1:
+            raise HyperspaceError("Refresh supports single-relation indexes")
+        # Reconstruct the source plan from stored metadata
+        # (RefreshActionBase.scala:71-89).
+        rel_meta = session.source_provider_manager.refresh_relation_metadata(
+            prev.relations[0])
+        plan = Scan(ScanRelation(
+            root_paths=tuple(rel_meta.root_paths),
+            file_format=rel_meta.file_format,
+            options=tuple(sorted(rel_meta.options.items())),
+        ))
+        config = IndexConfig(prev.name, prev.indexed_columns, prev.included_columns)
+        super().__init__(log_manager, data_manager, session, plan, config)
+        self._previous_entry = prev
+        # Seed the tracker with previous ids so unchanged files keep theirs
+        # (lineage soundness, FileIdTracker semantics).
+        self._file_id_tracker = FileIdTracker.from_log_entry(prev)
+
+    # numBuckets/lineage pinned to the previous entry
+    # (RefreshActionBase.scala:56-64).
+    @property
+    def num_buckets(self) -> int:
+        return self._previous_entry.num_buckets
+
+    @property
+    def lineage_enabled(self) -> bool:
+        return self._previous_entry.has_lineage_column()
+
+    # -- the diff (RefreshActionBase.scala:115-144) -------------------------
+    def current_files(self) -> List[FileInfo]:
+        return self._relation().all_files(self._file_id_tracker)
+
+    def appended_files(self) -> List[FileInfo]:
+        recorded = {(f.name, f.size, f.mtime) for f in
+                    self._previous_entry.source_file_infos()}
+        return [f for f in self.current_files()
+                if (f.name, f.size, f.mtime) not in recorded]
+
+    def deleted_files(self) -> List[FileInfo]:
+        current = {(f.name, f.size, f.mtime) for f in self.current_files()}
+        return [f for f in self._previous_entry.source_file_infos()
+                if (f.name, f.size, f.mtime) not in current]
+
+    def validate(self) -> None:
+        if self.previous_log_entry is None or \
+                self.previous_log_entry.state != States.ACTIVE:
+            raise HyperspaceError(
+                f"Refresh is only supported in {States.ACTIVE} state")
+        if not self.appended_files() and not self.deleted_files():
+            raise NoChangesError("Source data is unchanged; refresh is a no-op")
+
+    def log_entry_for_begin(self) -> IndexLogEntry:
+        import copy
+
+        return copy.deepcopy(self._previous_entry)
+
+
+class RefreshAction(RefreshActionBase):
+    """Full rebuild (RefreshAction.scala:33-59)."""
+
+    def op(self) -> None:
+        self._build_index_data()
+
+    def log_entry(self) -> IndexLogEntry:
+        return self._build_log_entry()
+
+
+class RefreshIncrementalAction(RefreshActionBase):
+    """Index only what changed (RefreshIncrementalAction.scala:54-145)."""
+
+    def validate(self) -> None:
+        super().validate()
+        if self.deleted_files() and not self.lineage_enabled:
+            # Deleted-row exclusion needs the lineage column
+            # (RefreshIncrementalAction.scala:44-52).
+            raise HyperspaceError(
+                "Refreshing an index incrementally with deleted source files "
+                "requires lineage (hyperspace.index.lineage.enabled=true at "
+                "creation time)")
+
+    def op(self) -> None:
+        appended = self.appended_files()
+        deleted = self.deleted_files()
+        resolved = self._resolved_config()
+        parts: List[pa.Table] = []
+        if deleted:
+            # Rewrite the old index excluding rows from deleted files
+            # (RefreshIncrementalAction.scala:70-97).
+            old_files = [f.name for f in self._previous_entry.content.file_infos()]
+            old = read_table(old_files, "parquet")
+            deleted_ids = pa.array(sorted({f.id for f in deleted}),
+                                   type=old.schema.field(DATA_FILE_ID_COLUMN).type)
+            import pyarrow.compute as pc
+
+            keep = pc.invert(pc.is_in(old.column(DATA_FILE_ID_COLUMN),
+                                      value_set=deleted_ids))
+            parts.append(old.filter(keep))
+        if appended:
+            relation = self._relation()
+            for f in appended:
+                t = read_table([f.name], relation.file_format,
+                               resolved.all_columns, relation.options)
+                if self.lineage_enabled:
+                    t = t.append_column(
+                        DATA_FILE_ID_COLUMN,
+                        pa.array(np.full(t.num_rows, f.id, dtype=np.int64)))
+                parts.append(t)
+        if not parts:
+            raise NoChangesError("Nothing to refresh")
+        combined = pa.concat_tables(parts, promote_options="default")
+        self._write_table_bucketed(combined, resolved)
+        self._had_deletes = bool(deleted)
+
+    def log_entry(self) -> IndexLogEntry:
+        entry = self._build_log_entry()
+        if not self._had_deletes:
+            # Old index files remain valid: merge content trees
+            # (RefreshIncrementalAction.scala:130-145 / Directory.merge).
+            entry.content = self._previous_entry.content.merge(entry.content)
+        return entry
+
+
+class RefreshQuickAction(RefreshActionBase):
+    """Metadata-only refresh (RefreshQuickAction.scala:37-80)."""
+
+    def op(self) -> None:
+        pass  # log-only
+
+    def log_entry(self) -> IndexLogEntry:
+        fingerprint = LogicalPlanFingerprint([self._signature()])
+        return self._previous_entry.copy_with_update(
+            fingerprint, self.appended_files(), self.deleted_files())

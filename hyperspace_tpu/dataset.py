@@ -1,0 +1,53 @@
+"""User-facing Dataset: a logical plan + session, with DataFrame-style verbs.
+
+The DataFrame analog the reference operates on.  ``collect()`` runs the
+optimizer (rules apply only when hyperspace is enabled on the session,
+package.scala:47-79) and then the executor.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import pyarrow as pa
+
+from hyperspace_tpu.plan.expr import Expr
+from hyperspace_tpu.plan.nodes import Filter, Join, LogicalPlan, Project
+
+
+class Dataset:
+    def __init__(self, plan: LogicalPlan, session) -> None:
+        self.plan = plan
+        self.session = session
+
+    # -- verbs --------------------------------------------------------------
+    def filter(self, condition: Expr) -> "Dataset":
+        return Dataset(Filter(condition, self.plan), self.session)
+
+    def select(self, *columns: str) -> "Dataset":
+        return Dataset(Project(list(columns), self.plan), self.session)
+
+    def join(self, other: "Dataset", condition: Expr, how: str = "inner") -> "Dataset":
+        return Dataset(Join(self.plan, other.plan, condition, how), self.session)
+
+    # -- execution ----------------------------------------------------------
+    def optimized_plan(self) -> LogicalPlan:
+        return self.session.optimize(self.plan)
+
+    def collect(self) -> pa.Table:
+        from hyperspace_tpu.execution.executor import Executor
+
+        return Executor(self.session).execute(self.optimized_plan())
+
+    def to_pandas(self):
+        return self.collect().to_pandas()
+
+    def count(self) -> int:
+        return self.collect().num_rows
+
+    @property
+    def columns(self) -> List[str]:
+        return self.plan.output_columns(self.session.schema_of)
+
+    def explain_string(self) -> str:
+        return self.plan.tree_string()

@@ -1,0 +1,264 @@
+"""Physical execution: walk the logical plan, produce an arrow Table.
+
+This plays the role of Spark's physical planning + execution for the tiny
+operator set the rules target (§1 L2: FileSourceScanExec, SMJ,
+BucketUnionExec).  The data plane routes to TPU kernels where the data is
+numeric (predicates: ops/filter.py; equi-joins: ops/join.py) and falls back
+to arrow/pandas host compute for variable-length data — mirroring how the
+reference delegates string-heavy work to the JVM while we keep the MXU/VPU
+fed with columnar numerics.
+
+Scan semantics:
+  - ``relation.file_paths`` overrides root-path listing (index scans and
+    hybrid-scan subsets, RuleUtils.scala:255-286).
+  - ``relation.prune_to_buckets`` drops index files whose bucket id (from
+    the file name) is not needed — the bucket-pruning read
+    (FilterIndexRule.scala:62-68).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from hyperspace_tpu.io import columnar
+from hyperspace_tpu.io.files import list_data_files
+from hyperspace_tpu.io.parquet import bucket_id_of_file, read_table
+from hyperspace_tpu.plan.expr import And, BinOp, Col, Expr, IsIn, Lit, Not, Or
+from hyperspace_tpu.plan.nodes import (
+    BucketUnion,
+    Filter,
+    Join,
+    LogicalPlan,
+    Project,
+    Scan,
+    Union,
+)
+
+
+class Executor:
+    def __init__(self, session) -> None:
+        self.session = session
+
+    def execute(self, plan: LogicalPlan) -> pa.Table:
+        if isinstance(plan, Scan):
+            return self._scan(plan)
+        if isinstance(plan, Filter):
+            return self._filter(plan)
+        if isinstance(plan, Project):
+            table = self.execute(plan.child)
+            return table.select(plan.columns)
+        if isinstance(plan, Join):
+            return self._join(plan)
+        if isinstance(plan, (BucketUnion, Union)):
+            tables = [self.execute(c) for c in plan.children]
+            return pa.concat_tables(tables, promote_options="default")
+        raise ValueError(f"Unknown plan node: {type(plan).__name__}")
+
+    # -- scan ---------------------------------------------------------------
+    def _scan(self, plan: Scan) -> pa.Table:
+        rel = plan.relation
+        if rel.file_paths is not None:
+            paths = list(rel.file_paths)
+        else:
+            paths = [f.name for f in list_data_files(rel.root_paths)]
+        all_paths = paths
+        if rel.prune_to_buckets is not None:
+            wanted = set(rel.prune_to_buckets)
+            paths = [p for p in paths
+                     if (b := bucket_id_of_file(p)) is None or b in wanted]
+        if not paths:
+            # Bucket pruning removed every file (key hashes to an empty
+            # bucket): the result is empty but MUST keep the scan schema so
+            # downstream Project/Filter still resolve.
+            if all_paths:
+                from hyperspace_tpu.io.parquet import read_schema, schema_to_arrow
+
+                schema = schema_to_arrow(read_schema(
+                    all_paths[0], rel.file_format, rel.options_dict))
+                return schema.empty_table()
+            return pa.table({})
+        return read_table(paths, rel.file_format, None, rel.options_dict)
+
+    # -- filter -------------------------------------------------------------
+    def _filter(self, plan: Filter) -> pa.Table:
+        table = self.execute(plan.child)
+        if table.num_rows == 0:
+            return table
+        mask = self._eval_predicate(plan.condition, table)
+        return table.filter(pa.array(mask))
+
+    def _eval_predicate(self, expr: Expr, table: pa.Table) -> np.ndarray:
+        cols = expr.referenced_columns()
+        # Device path requires at least one column and all referenced columns
+        # numeric and null-free; everything else (strings, nullables,
+        # constant predicates) takes the arrow path, which owns SQL
+        # three-valued-logic semantics.
+        numeric = bool(cols) and all(
+            columnar.is_numeric_type(table.schema.field(c).type)
+            and table.column(c).null_count == 0
+            for c in cols
+        ) and self._device_compatible(expr, table)
+        if numeric:
+            return self._eval_device(expr, table)
+        return self._eval_arrow(expr, table)
+
+    def _device_compatible(self, expr: Expr, table: pa.Table) -> bool:
+        if isinstance(expr, BinOp):
+            for side in (expr.left, expr.right):
+                if isinstance(side, Lit) and not isinstance(side.value, (int, float, bool)):
+                    # Temporal/string literals: host path normalizes them.
+                    t = table.schema.field(
+                        (expr.left if isinstance(expr.left, Col) else expr.right).name).type
+                    if columnar.literal_to_numeric(side.value, t) is None:
+                        return False
+            return True
+        if isinstance(expr, (And, Or)):
+            return (self._device_compatible(expr.left, table)
+                    and self._device_compatible(expr.right, table))
+        if isinstance(expr, Not):
+            return self._device_compatible(expr.child, table)
+        if isinstance(expr, IsIn):
+            return all(isinstance(v, (int, float, bool)) for v in expr.values)
+        return False
+
+    def _eval_device(self, expr: Expr, table: pa.Table) -> np.ndarray:
+        from hyperspace_tpu.ops.filter import compile_predicate
+
+        order = sorted(expr.referenced_columns())
+        norm = self._normalize_literals(expr, table)
+        fn, literals = compile_predicate(norm, order)
+        device_cols = [columnar.to_device_numeric(table.column(c)) for c in order]
+        mask = fn(device_cols, literals)
+        return np.asarray(mask)
+
+    def _normalize_literals(self, expr: Expr, table: pa.Table) -> Expr:
+        """Rewrite temporal/bool literals to their int64 device domain."""
+        if isinstance(expr, BinOp):
+            left, right = expr.left, expr.right
+            if isinstance(left, Col) and isinstance(right, Lit):
+                t = table.schema.field(left.name).type
+                v = columnar.literal_to_numeric(right.value, t)
+                return BinOp(expr.op, left, Lit(v))
+            if isinstance(right, Col) and isinstance(left, Lit):
+                t = table.schema.field(right.name).type
+                v = columnar.literal_to_numeric(left.value, t)
+                return BinOp(expr.op, Lit(v), right)
+            return expr
+        if isinstance(expr, And):
+            return And(self._normalize_literals(expr.left, table),
+                       self._normalize_literals(expr.right, table))
+        if isinstance(expr, Or):
+            return Or(self._normalize_literals(expr.left, table),
+                      self._normalize_literals(expr.right, table))
+        if isinstance(expr, Not):
+            return Not(self._normalize_literals(expr.child, table))
+        return expr
+
+    def _eval_arrow(self, expr: Expr, table: pa.Table) -> np.ndarray:
+        """Host fallback: arrow compute (reference semantics for strings)."""
+        result = _arrow_eval(expr, table)
+        if isinstance(result, pa.Scalar):
+            # Constant predicate: broadcast (null ⇒ no rows, SQL semantics).
+            value = result.as_py()
+            return np.full(table.num_rows, bool(value) if value is not None else False)
+        mask = np.asarray(result.to_numpy(zero_copy_only=False))
+        if mask.dtype != np.bool_:
+            # Kleene nulls surface as None in an object array: null ⇒ False.
+            mask = np.array([bool(v) if v is not None else False for v in mask])
+        return mask
+
+    # -- join ---------------------------------------------------------------
+    def _join(self, plan: Join) -> pa.Table:
+        from hyperspace_tpu.plan.expr import as_equi_join_pairs
+
+        left = self.execute(plan.left)
+        right = self.execute(plan.right)
+        pairs = as_equi_join_pairs(plan.condition)
+        if pairs is None:
+            raise ValueError(f"Non-equi join condition: {plan.condition!r}")
+        # Resolve which side each column belongs to.
+        l_keys, r_keys = [], []
+        for a, b in pairs:
+            if a in left.column_names and b in right.column_names:
+                l_keys.append(a)
+                r_keys.append(b)
+            elif b in left.column_names and a in right.column_names:
+                l_keys.append(b)
+                r_keys.append(a)
+            else:
+                raise ValueError(f"Join columns {a!r}/{b!r} not found")
+        # SQL inner-join semantics: null keys never match — drop them up
+        # front so neither the device kernel nor pandas (which matches
+        # NaN==NaN) ever sees a null key.
+        for k in l_keys:
+            if left.column(k).null_count > 0:
+                left = left.filter(pc.is_valid(left.column(k)))
+        for k in r_keys:
+            if right.column(k).null_count > 0:
+                right = right.filter(pc.is_valid(right.column(k)))
+        single_numeric = (
+            len(l_keys) == 1
+            and columnar.is_numeric_type(left.schema.field(l_keys[0]).type)
+            and columnar.is_numeric_type(right.schema.field(r_keys[0]).type))
+        if single_numeric:
+            from hyperspace_tpu.ops.join import sorted_equi_join
+
+            li, ri = sorted_equi_join(
+                columnar.to_device_numeric(left.column(l_keys[0])),
+                columnar.to_device_numeric(right.column(r_keys[0])))
+            lt = left.take(pa.array(li))
+            rt = right.take(pa.array(ri))
+        else:
+            # Host fallback: pandas hash join for multi-column/string keys.
+            import pandas as pd
+
+            ldf = left.to_pandas()
+            rdf = right.to_pandas()
+            ldf["__li"] = np.arange(len(ldf))
+            rdf["__ri"] = np.arange(len(rdf))
+            merged = ldf.merge(rdf, left_on=l_keys, right_on=r_keys,
+                               how="inner", suffixes=("", "__r"))
+            lt = left.take(pa.array(merged["__li"].to_numpy()))
+            rt = right.take(pa.array(merged["__ri"].to_numpy()))
+        return _concat_horizontal(lt, rt)
+
+
+def _concat_horizontal(left: pa.Table, right: pa.Table) -> pa.Table:
+    names = list(left.column_names)
+    cols = list(left.columns)
+    for name, col in zip(right.column_names, right.columns):
+        out_name = name
+        n = 1
+        while out_name in names:
+            out_name = f"{name}__{n}"
+            n += 1
+        names.append(out_name)
+        cols.append(col)
+    return pa.table(dict(zip(names, cols)))
+
+
+def _arrow_eval(expr: Expr, table: pa.Table):
+    if isinstance(expr, Col):
+        return table.column(expr.name)
+    if isinstance(expr, Lit):
+        return pa.scalar(expr.value)
+    if isinstance(expr, BinOp):
+        left = _arrow_eval(expr.left, table)
+        right = _arrow_eval(expr.right, table)
+        ops = {"==": pc.equal, "<": pc.less, "<=": pc.less_equal,
+               ">": pc.greater, ">=": pc.greater_equal}
+        return ops[expr.op](left, right)
+    if isinstance(expr, And):
+        return pc.and_kleene(_arrow_eval(expr.left, table), _arrow_eval(expr.right, table))
+    if isinstance(expr, Or):
+        return pc.or_kleene(_arrow_eval(expr.left, table), _arrow_eval(expr.right, table))
+    if isinstance(expr, Not):
+        return pc.invert(_arrow_eval(expr.child, table))
+    if isinstance(expr, IsIn):
+        return pc.is_in(_arrow_eval(expr.child, table),
+                        value_set=pa.array(expr.values))
+    raise ValueError(f"Unsupported expression: {expr!r}")

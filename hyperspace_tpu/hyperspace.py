@@ -1,0 +1,59 @@
+"""Top-level user API.
+
+Reference contract: Hyperspace.scala:26-166 — createIndex/deleteIndex/
+restoreIndex/vacuumIndex/refreshIndex/optimizeIndex/cancel/indexes/index/
+explain, each delegating to the IndexCollectionManager; ``explain`` renders
+the with/without-index plan comparison (PlanAnalyzer).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import pyarrow as pa
+
+from hyperspace_tpu.dataset import Dataset
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.index.manager import IndexCollectionManager
+from hyperspace_tpu.session import HyperspaceSession
+
+
+class Hyperspace:
+    def __init__(self, session: HyperspaceSession) -> None:
+        self.session = session
+        self.index_manager = session.index_collection_manager
+
+    def create_index(self, dataset: Dataset, config: IndexConfig) -> None:
+        self.index_manager.create(dataset, config)
+
+    def delete_index(self, name: str) -> None:
+        self.index_manager.delete(name)
+
+    def restore_index(self, name: str) -> None:
+        self.index_manager.restore(name)
+
+    def vacuum_index(self, name: str) -> None:
+        self.index_manager.vacuum(name)
+
+    def refresh_index(self, name: str, mode: str = "full") -> None:
+        self.index_manager.refresh(name, mode)
+
+    def optimize_index(self, name: str, mode: str = "quick") -> None:
+        self.index_manager.optimize(name, mode)
+
+    def cancel(self, name: str) -> None:
+        self.index_manager.cancel(name)
+
+    def indexes(self) -> pa.Table:
+        return self.index_manager.indexes()
+
+    def index(self, name: str) -> pa.Table:
+        from hyperspace_tpu.index.statistics import index_statistics_table
+
+        entry = self.index_manager.get_index(name)
+        return index_statistics_table([entry] if entry else [], extended=True)
+
+    def explain(self, dataset: Dataset, verbose: bool = False) -> str:
+        from hyperspace_tpu.plananalysis.explain import explain_string
+
+        return explain_string(dataset, self.session, verbose=verbose)

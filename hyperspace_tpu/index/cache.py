@@ -1,0 +1,97 @@
+"""Metadata cache: TTL-cached index log entries, cleared on mutation.
+
+Reference contract: index/CachingIndexCollectionManager.scala:38-170 — a
+creation-time-based cache of the latest stable entries with a 300 s default
+TTL (IndexConstants.scala:61-63), cleared by every mutating API so the same
+session always sees its own writes.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from hyperspace_tpu.index.log_entry import IndexLogEntry
+from hyperspace_tpu.index.manager import IndexCollectionManager
+
+
+class CreationTimeBasedCache:
+    """Cache[T] analog (index/Cache.scala:22,
+    CachingIndexCollectionManager.scala:124-170)."""
+
+    def __init__(self) -> None:
+        self._entries: Optional[List[IndexLogEntry]] = None
+        self._created_at: float = 0.0
+
+    def get(self, expiry_seconds: float) -> Optional[List[IndexLogEntry]]:
+        if self._entries is None:
+            return None
+        if time.monotonic() - self._created_at > expiry_seconds:
+            return None
+        return self._entries
+
+    def set(self, entries: List[IndexLogEntry]) -> None:
+        self._entries = entries
+        self._created_at = time.monotonic()
+
+    def clear(self) -> None:
+        self._entries = None
+
+
+class CachingIndexCollectionManager(IndexCollectionManager):
+    """IndexCollectionManager whose get_indexes serves from a session-scoped
+    TTL cache; every mutating API clears it first
+    (CachingIndexCollectionManager.scala:38-105)."""
+
+    def __init__(self, session) -> None:
+        super().__init__(session)
+        if not hasattr(session, "_index_entry_cache"):
+            session._index_entry_cache = CreationTimeBasedCache()
+        self._cache: CreationTimeBasedCache = session._index_entry_cache
+
+    def get_indexes(self, states=None) -> List[IndexLogEntry]:
+        cached = self._cache.get(self.session.conf.cache_expiry_seconds)
+        if cached is None:
+            cached = super().get_indexes(None)
+            self._cache.set(cached)
+        if states is None:
+            return list(cached)
+        return [e for e in cached if e.state in states]
+
+    def clear_cache(self) -> None:
+        self._cache.clear()
+
+    def create(self, dataset, config) -> None:
+        self.clear_cache()
+        super().create(dataset, config)
+        self.clear_cache()
+
+    def delete(self, name: str) -> None:
+        self.clear_cache()
+        super().delete(name)
+        self.clear_cache()
+
+    def restore(self, name: str) -> None:
+        self.clear_cache()
+        super().restore(name)
+        self.clear_cache()
+
+    def vacuum(self, name: str) -> None:
+        self.clear_cache()
+        super().vacuum(name)
+        self.clear_cache()
+
+    def cancel(self, name: str) -> None:
+        self.clear_cache()
+        super().cancel(name)
+        self.clear_cache()
+
+    def refresh(self, name: str, mode: str = "full") -> None:
+        self.clear_cache()
+        super().refresh(name, mode)
+        self.clear_cache()
+
+    def optimize(self, name: str, mode: str = "quick") -> None:
+        self.clear_cache()
+        super().optimize(name, mode)
+        self.clear_cache()

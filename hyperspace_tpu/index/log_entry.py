@@ -499,6 +499,10 @@ class IndexLogEntry:
                 out.extend(rel.update.deleted_files.file_infos())
         return out
 
+    def has_source_update(self) -> bool:
+        """True when a quick refresh recorded pending appends/deletes."""
+        return bool(self.appended_files() or self.deleted_files())
+
     def copy_with_update(self, fingerprint: LogicalPlanFingerprint,
                          appended: Sequence[FileInfo],
                          deleted: Sequence[FileInfo]) -> "IndexLogEntry":
@@ -521,14 +525,17 @@ class IndexLogEntry:
         )
 
     # -- tags (in-memory memoization, IndexLogEntry.scala:560-603) ----------
-    def set_tag(self, key: str, value: Any) -> None:
-        self._tags[key] = value
+    # Tags are keyed by (tag, plan node) like the reference's
+    # setTagValue(plan, tag, value): the same entry can be a signature match
+    # for one relation and not another within a single rule invocation.
+    def set_tag(self, key: str, value: Any, plan: Any = None) -> None:
+        self._tags[(key, id(plan))] = value
 
-    def get_tag(self, key: str) -> Optional[Any]:
-        return self._tags.get(key)
+    def get_tag(self, key: str, plan: Any = None) -> Optional[Any]:
+        return self._tags.get((key, id(plan)))
 
-    def unset_tag(self, key: str) -> None:
-        self._tags.pop(key, None)
+    def unset_tag(self, key: str, plan: Any = None) -> None:
+        self._tags.pop((key, id(plan)), None)
 
 
 class IndexLogEntryTags:

@@ -1,0 +1,104 @@
+"""Index collection manager: name → managers, dispatch to actions.
+
+Reference contract: index/IndexManager.scala:24-116 (trait) and
+index/IndexCollectionManager.scala:28-170 — create/delete/restore/vacuum/
+refresh/optimize/cancel dispatch to Action instances over per-index log/data
+managers; ``get_indexes`` scans the system path for latest stable entries.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.index.data_manager import IndexDataManager
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.index.log_entry import IndexLogEntry, States
+from hyperspace_tpu.index.log_manager import IndexLogManager
+from hyperspace_tpu.index.path_resolver import PathResolver
+
+
+class IndexCollectionManager:
+    def __init__(self, session) -> None:
+        self.session = session
+        self.path_resolver = PathResolver(session.conf)
+
+    # -- manager plumbing (index/factories.scala:24-54) ---------------------
+    def _log_manager(self, name: str) -> IndexLogManager:
+        return IndexLogManager(self.path_resolver.get_index_path(name))
+
+    def _data_manager(self, name: str) -> IndexDataManager:
+        return IndexDataManager(self.path_resolver.get_index_path(name))
+
+    # -- lifecycle APIs (IndexCollectionManager.scala:36-107) ---------------
+    def create(self, dataset, config: IndexConfig) -> None:
+        from hyperspace_tpu.actions.create import CreateAction
+
+        CreateAction(self._log_manager(config.index_name),
+                     self._data_manager(config.index_name),
+                     self.session, dataset.plan, config).run()
+
+    def delete(self, name: str) -> None:
+        from hyperspace_tpu.actions.delete import DeleteAction
+
+        DeleteAction(self._log_manager(name)).run()
+
+    def restore(self, name: str) -> None:
+        from hyperspace_tpu.actions.restore import RestoreAction
+
+        RestoreAction(self._log_manager(name)).run()
+
+    def vacuum(self, name: str) -> None:
+        from hyperspace_tpu.actions.vacuum import VacuumAction
+
+        VacuumAction(self._log_manager(name), self._data_manager(name)).run()
+
+    def cancel(self, name: str) -> None:
+        from hyperspace_tpu.actions.cancel import CancelAction
+
+        CancelAction(self._log_manager(name)).run()
+
+    def refresh(self, name: str, mode: str = "full") -> None:
+        from hyperspace_tpu.actions.refresh import (
+            RefreshAction,
+            RefreshIncrementalAction,
+            RefreshQuickAction,
+        )
+
+        cls = {"full": RefreshAction,
+               "incremental": RefreshIncrementalAction,
+               "quick": RefreshQuickAction}.get(mode)
+        if cls is None:
+            raise HyperspaceError(f"Unknown refresh mode {mode!r}")
+        cls(self._log_manager(name), self._data_manager(name), self.session).run()
+
+    def optimize(self, name: str, mode: str = "quick") -> None:
+        from hyperspace_tpu.actions.optimize import OptimizeAction
+
+        if mode not in ("quick", "full"):
+            raise HyperspaceError(f"Unknown optimize mode {mode!r}")
+        OptimizeAction(self._log_manager(name), self._data_manager(name),
+                       self.session, mode).run()
+
+    # -- queries (IndexCollectionManager.scala:109-170) ---------------------
+    def get_indexes(self, states: Optional[List[str]] = None) -> List[IndexLogEntry]:
+        root = self.path_resolver.system_path
+        if not os.path.isdir(root):
+            return []
+        out: List[IndexLogEntry] = []
+        for name in sorted(os.listdir(root)):
+            entry = self._log_manager(name).get_latest_stable_log()
+            if entry is not None and (states is None or entry.state in states):
+                out.append(entry)
+        return out
+
+    def get_index(self, name: str) -> Optional[IndexLogEntry]:
+        return self._log_manager(name).get_latest_stable_log()
+
+    def indexes(self):
+        """Summary table of all indexes (IndexStatistics DataFrame analog,
+        IndexCollectionManager.scala:109-118)."""
+        from hyperspace_tpu.index.statistics import index_statistics_table
+
+        return index_statistics_table(self.get_indexes())

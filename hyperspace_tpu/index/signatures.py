@@ -1,0 +1,98 @@
+"""Index-validity fingerprinting: is this index still valid for this plan?
+
+Reference contract: index/LogicalPlanSignatureProvider.scala:27-63 (pluggable
+provider registry), index/FileBasedSignatureProvider.scala:30-62 (md5 fold
+over (size, mtime, path) of every file of every supported leaf relation),
+index/PlanSignatureProvider.scala:28-44 (hash of the operator-type chain),
+index/IndexSignatureProvider.scala:33-51 (default: md5(file-sig + plan-sig)).
+
+Providers are looked up by name (the conf-driven pluggability of
+LogicalPlanSignatureProvider.scala:55-62) from ``PROVIDERS``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from hyperspace_tpu.index.log_entry import FileInfo
+from hyperspace_tpu.plan.nodes import LogicalPlan, Scan
+from hyperspace_tpu.utils.hashing import fold_md5, md5_hex
+
+
+class SignatureProvider:
+    name: str = ""
+
+    def signature(self, plan: LogicalPlan, all_files_of: Callable[[Scan], List[FileInfo]]
+                  ) -> Optional[str]:
+        """None when the plan contains an unsupported leaf
+        (FileBasedSignatureProvider.scala:40-47)."""
+        raise NotImplementedError
+
+
+class FileBasedSignatureProvider(SignatureProvider):
+    """md5 fold over (size, mtime, name) of every leaf file
+    (FileBasedSignatureProvider.scala:38-61)."""
+
+    name = "FileBasedSignatureProvider"
+
+    def signature(self, plan, all_files_of):
+        leaves = plan.leaf_relations()
+        if not leaves:
+            return None
+        parts: List[str] = []
+        for scan in leaves:
+            files = all_files_of(scan)
+            if files is None:
+                return None
+            for f in files:
+                parts.append(f"{f.size}{f.mtime}{f.name}")
+        return fold_md5(parts)
+
+
+class PlanSignatureProvider(SignatureProvider):
+    """Hash of the operator-type chain (PlanSignatureProvider.scala:28-44)."""
+
+    name = "PlanSignatureProvider"
+
+    def signature(self, plan, all_files_of):
+        types: List[str] = []
+
+        def walk(node: LogicalPlan) -> None:
+            types.append(type(node).__name__)
+            for c in node.children:
+                walk(c)
+
+        walk(plan)
+        return md5_hex("".join(types))
+
+
+class IndexSignatureProvider(SignatureProvider):
+    """Default provider: md5(file_signature + plan_signature)
+    (IndexSignatureProvider.scala:33-51)."""
+
+    name = "IndexSignatureProvider"
+
+    def __init__(self) -> None:
+        self._files = FileBasedSignatureProvider()
+        self._plan = PlanSignatureProvider()
+
+    def signature(self, plan, all_files_of):
+        fs = self._files.signature(plan, all_files_of)
+        if fs is None:
+            return None
+        ps = self._plan.signature(plan, all_files_of)
+        return md5_hex(fs + ps)
+
+
+PROVIDERS: Dict[str, Callable[[], SignatureProvider]] = {
+    FileBasedSignatureProvider.name: FileBasedSignatureProvider,
+    PlanSignatureProvider.name: PlanSignatureProvider,
+    IndexSignatureProvider.name: IndexSignatureProvider,
+}
+
+
+def get_provider(name: str) -> SignatureProvider:
+    try:
+        return PROVIDERS[name]()
+    except KeyError:
+        raise ValueError(f"Unknown signature provider: {name!r}") from None

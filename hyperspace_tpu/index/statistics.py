@@ -1,0 +1,45 @@
+"""User-visible index statistics.
+
+Reference contract: index/IndexStatistics.scala:43-196 — one summary row per
+index: name, indexed/included columns, bucket count, state, size, file
+counts, appended/deleted counts, location.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+import pyarrow as pa
+
+from hyperspace_tpu.index.log_entry import IndexLogEntry
+
+INDEX_SUMMARY_COLUMNS = [
+    "name", "indexedColumns", "includedColumns", "numBuckets", "schema",
+    "indexLocation", "state",
+]
+
+EXTENDED_COLUMNS = INDEX_SUMMARY_COLUMNS + [
+    "numIndexFiles", "sizeInBytes", "numAppendedFiles", "numDeletedFiles",
+]
+
+
+def index_statistics_table(entries: List[IndexLogEntry],
+                           extended: bool = False) -> pa.Table:
+    rows = {c: [] for c in (EXTENDED_COLUMNS if extended else INDEX_SUMMARY_COLUMNS)}
+    for e in entries:
+        index_files = e.content.file_infos()
+        location = os.path.dirname(index_files[0].name) if index_files else ""
+        rows["name"].append(e.name)
+        rows["indexedColumns"].append(e.indexed_columns)
+        rows["includedColumns"].append(e.included_columns)
+        rows["numBuckets"].append(e.num_buckets)
+        rows["schema"].append(str(e.derived_dataset.schema))
+        rows["indexLocation"].append(location)
+        rows["state"].append(e.state)
+        if extended:
+            rows["numIndexFiles"].append(len(index_files))
+            rows["sizeInBytes"].append(sum(f.size for f in index_files))
+            rows["numAppendedFiles"].append(len(e.appended_files()))
+            rows["numDeletedFiles"].append(len(e.deleted_files()))
+    return pa.table(rows)

@@ -1,0 +1,149 @@
+"""Host↔device columnar bridge.
+
+Converts arrow columns into the dtype-monomorphic device representations the
+kernels consume:
+
+  - ``to_hash_words``: any column → (n, 2) uint32 words for the bucket-hash
+    kernel.  Numerics bitcast on the host (cheap views); strings/binary are
+    hashed host-side with pandas' vectorized C hasher (stable across calls)
+    because variable-length data can't live in XLA's static-shape world
+    (SURVEY.md §7 hard parts: dictionary-encode strings host-side).
+  - ``to_order_key``: any column → (n,) numeric order key for the sort
+    kernel.  Strings become order-preserving dense ranks via np.unique.
+  - ``to_device_numeric``: numeric column → host array for predicate/join
+    kernels; None for non-numeric or nullable (those evaluate host-side).
+
+Temporal columns are normalized through ONE helper (``_temporal_to_int64``)
+everywhere — build, query, and literal paths must agree on the integer
+domain (the column's own storage unit) or identical values would hash to
+different buckets.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+# Sentinel hash words for NULL: all nulls land in one deterministic bucket.
+_NULL_WORDS = (np.uint32(0x9E3779B9), np.uint32(0x7F4A7C15))
+
+
+def _combine(column: "pa.ChunkedArray | pa.Array") -> pa.Array:
+    if isinstance(column, pa.ChunkedArray):
+        column = column.combine_chunks()
+    if pa.types.is_dictionary(column.type):
+        column = column.cast(column.type.value_type)
+    return column
+
+
+def _null_mask(column: pa.Array) -> Optional[np.ndarray]:
+    """Boolean mask of null positions, or None when the column has no nulls."""
+    if column.null_count == 0:
+        return None
+    return np.asarray(pc.is_null(column).to_numpy(zero_copy_only=False), dtype=bool)
+
+
+def _temporal_to_int64(column: pa.Array) -> pa.Array:
+    """Temporal → int64 in the column's OWN storage unit (date32 stays days,
+    timestamp[us] stays micros): unit-consistent for any one column type,
+    which is all bucketing/ordering/compare need."""
+    t = column.type
+    if pa.types.is_date32(t) or pa.types.is_time32(t):
+        return column.cast(pa.int32()).cast(pa.int64())
+    return column.cast(pa.int64())
+
+
+def _numeric_int64(column: pa.Array, fill_null_zero: bool) -> np.ndarray:
+    """int/bool/temporal column → int64 numpy array in the native domain."""
+    t = column.type
+    if pa.types.is_temporal(t):
+        column = _temporal_to_int64(column)
+    elif pa.types.is_boolean(t) or not pa.types.is_int64(t):
+        column = column.cast(pa.int64())
+    if fill_null_zero and column.null_count > 0:
+        column = pc.fill_null(column, 0)
+    return column.to_numpy(zero_copy_only=False).astype(np.int64, copy=False)
+
+
+def is_numeric_type(t: pa.DataType) -> bool:
+    return (pa.types.is_integer(t) or pa.types.is_floating(t)
+            or pa.types.is_boolean(t) or pa.types.is_temporal(t))
+
+
+def to_hash_words(column: "pa.ChunkedArray | pa.Array") -> np.ndarray:
+    """(n, 2) uint32 hash words; equal values always map to equal words;
+    nulls all map to one sentinel word pair (one deterministic bucket)."""
+    column = _combine(column)
+    t = column.type
+    nulls = _null_mask(column)
+    if pa.types.is_floating(t):
+        if nulls is not None:
+            column = pc.fill_null(column, 0.0)
+        arr = column.to_numpy(zero_copy_only=False).astype(np.float64)
+        arr = np.where(arr == 0.0, 0.0, arr)  # -0.0 == 0.0 must hash equal
+        bits = arr.view(np.uint64)
+    elif is_numeric_type(t):
+        bits = _numeric_int64(column, fill_null_zero=True).view(np.uint64)
+    else:
+        # Variable-length (string/binary/decimal): vectorized stable hash.
+        import pandas.util
+
+        arr = column.to_numpy(zero_copy_only=False)
+        bits = pandas.util.hash_array(np.asarray(arr, dtype=object))
+    out = np.empty((len(bits), 2), dtype=np.uint32)
+    out[:, 0] = (bits >> np.uint64(32)).astype(np.uint32)
+    out[:, 1] = (bits & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    if nulls is not None:
+        out[nulls, 0] = _NULL_WORDS[0]
+        out[nulls, 1] = _NULL_WORDS[1]
+    return out
+
+
+def to_order_key(column: "pa.ChunkedArray | pa.Array") -> np.ndarray:
+    """(n,) numeric key whose ordering equals the column's value ordering.
+    Nulls sort with the placeholder value (ordering among them is not
+    semantically observable — within-bucket sort is a layout property)."""
+    column = _combine(column)
+    t = column.type
+    if pa.types.is_floating(t):
+        if column.null_count > 0:
+            column = pc.fill_null(column, 0.0)
+        return column.to_numpy(zero_copy_only=False).astype(np.float64)
+    if is_numeric_type(t):
+        return _numeric_int64(column, fill_null_zero=True)
+    # Strings: dense rank (np.unique inverse is rank-ordered).
+    arr = column.to_numpy(zero_copy_only=False)
+    _, inverse = np.unique(np.asarray(arr, dtype=object), return_inverse=True)
+    return inverse.astype(np.int64)
+
+
+def to_device_numeric(column: "pa.ChunkedArray | pa.Array") -> Optional[np.ndarray]:
+    """Numeric host array suitable for jnp.asarray, or None if non-numeric
+    OR nullable — SQL null semantics (null != null, three-valued predicates)
+    are handled by the arrow host path, not the device kernels."""
+    column = _combine(column)
+    t = column.type
+    if not is_numeric_type(t) or column.null_count > 0:
+        return None
+    if pa.types.is_floating(t):
+        return column.to_numpy(zero_copy_only=False).astype(np.float64)
+    return _numeric_int64(column, fill_null_zero=False)
+
+
+def literal_to_numeric(value, t: pa.DataType) -> Optional[float]:
+    """Normalize a literal to ``to_device_numeric``'s domain for a column of
+    type ``t``; None if the literal doesn't fit that domain."""
+    if pa.types.is_temporal(t):
+        try:
+            arr = pa.array([value], type=t)
+        except (pa.ArrowInvalid, pa.ArrowTypeError):
+            return None
+        return int(_temporal_to_int64(arr)[0].as_py())
+    if isinstance(value, bool):
+        return int(value)
+    if isinstance(value, (int, float)):
+        return value
+    return None

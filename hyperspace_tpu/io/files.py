@@ -1,0 +1,45 @@
+"""File listing over source root paths.
+
+Reference contract: the relation ``allFiles`` listing
+(sources/default/DefaultFileBasedRelation.scala:57-71) plus PathUtils'
+data-file filter.  Listing is recursive; results are sorted for
+deterministic signatures.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+from hyperspace_tpu.index.log_entry import FileIdTracker, FileInfo
+from hyperspace_tpu.utils.paths import is_data_file, normalize_path
+
+
+def list_data_files(root_paths: Sequence[str],
+                    tracker: Optional[FileIdTracker] = None,
+                    extension: Optional[str] = None) -> List[FileInfo]:
+    """All data files under ``root_paths`` (each a file or directory),
+    registered with ``tracker`` when given."""
+    out: List[FileInfo] = []
+    for root in root_paths:
+        root = normalize_path(root)
+        if os.path.isfile(root):
+            out.append(_file_info(root, tracker))
+        elif os.path.isdir(root):
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames.sort()
+                for name in sorted(filenames):
+                    if not is_data_file(name):
+                        continue
+                    if extension and not name.endswith(extension):
+                        continue
+                    out.append(_file_info(os.path.join(dirpath, name), tracker))
+    out.sort(key=lambda f: f.name)
+    return out
+
+
+def _file_info(path: str, tracker: Optional[FileIdTracker]) -> FileInfo:
+    st = os.stat(path)
+    mtime = int(st.st_mtime_ns)
+    fid = tracker.add_file(path, st.st_size, mtime) if tracker is not None else -1
+    return FileInfo(path, st.st_size, mtime, fid)

@@ -1,0 +1,54 @@
+"""Device-side bucket-hash kernel.
+
+This is the TPU re-expression of the reference's bucket assignment
+(``repartition(numBuckets, indexedCols)`` = Murmur3Hash pmod numBuckets,
+actions/CreateActionBase.scala:131-132).  We use our own murmur3-style mix —
+self-consistent hashing is sufficient because indexes are only ever read by
+this engine (SURVEY.md §7 "hard parts"); there is no interop with
+Spark-written buckets.
+
+Every key column is first normalized host-side to an ``(n, 2)`` uint32
+"hash words" array (hyperspace_tpu.io.columnar.to_hash_words) so the device
+kernel is dtype-monomorphic: one compiled program serves any key schema,
+which keeps XLA's compile cache hot across heterogeneous datasets.  The
+kernel itself is pure elementwise uint32 math — XLA fuses the whole chain
+into a single VPU pass over HBM-resident batches.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+_C1 = jnp.uint32(0x85EBCA6B)
+_C2 = jnp.uint32(0xC2B2AE35)
+_SEED = jnp.uint32(0x3C074A61)
+
+
+def _fmix32(h: jnp.ndarray) -> jnp.ndarray:
+    """Murmur3 finalizer (public algorithm)."""
+    h = h ^ (h >> 16)
+    h = h * _C1
+    h = h ^ (h >> 13)
+    h = h * _C2
+    h = h ^ (h >> 16)
+    return h
+
+
+def combine_hashes(word_cols: Sequence[jnp.ndarray]) -> jnp.ndarray:
+    """uint32 row hash from per-column (n, 2) uint32 hash words."""
+    h = jnp.full(word_cols[0].shape[0], _SEED, dtype=jnp.uint32)
+    for words in word_cols:
+        h = _fmix32(h * jnp.uint32(31) ^ _fmix32(words[:, 0]))
+        h = _fmix32(h * jnp.uint32(31) ^ _fmix32(words[:, 1]))
+    return h
+
+
+@partial(jax.jit, static_argnames=("num_buckets",))
+def bucket_ids(word_cols: Sequence[jnp.ndarray], num_buckets: int) -> jnp.ndarray:
+    """Per-row bucket assignment in [0, num_buckets) as int32."""
+    h = combine_hashes(word_cols)
+    return (h % jnp.uint32(num_buckets)).astype(jnp.int32)

@@ -1,0 +1,65 @@
+"""Device-side equi-join kernel over sorted keys.
+
+Reference analog: the shuffle-free sort-merge join the covering indexes
+enable (JoinIndexRule.scala:36-50).  Spark's SMJ streams row iterators; the
+XLA-native formulation is vectorized:
+
+  1. sort the right side by key (one ``jnp.sort`` — on bucketed index data
+     the input is already sorted, making this a near-no-op merge),
+  2. ``searchsorted`` left keys into the right keys → per-left-row match
+     ranges [lo, hi),
+  3. expand to output pairs with ``jnp.repeat(..., total_repeat_length=N)``.
+
+Step 3 needs the total match count N as a static shape, so the kernel is
+two-phase with one host sync in between (count → materialize) — the standard
+XLA pattern for dynamic-size outputs.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def _match_ranges(left_keys: jnp.ndarray, right_keys_sorted: jnp.ndarray
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    lo = jnp.searchsorted(right_keys_sorted, left_keys, side="left")
+    hi = jnp.searchsorted(right_keys_sorted, left_keys, side="right")
+    return lo, hi
+
+
+@partial(jax.jit, static_argnames=("total",))
+def _expand(lo: jnp.ndarray, hi: jnp.ndarray, total: int
+            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    counts = hi - lo
+    left_idx = jnp.repeat(jnp.arange(lo.shape[0]), counts, total_repeat_length=total)
+    # Offset of each output row within its left-row group.
+    starts = jnp.cumsum(counts) - counts
+    within = jnp.arange(total) - jnp.repeat(starts, counts, total_repeat_length=total)
+    right_pos = lo[left_idx] + within
+    return left_idx, right_pos
+
+
+def sorted_equi_join(left_keys: np.ndarray, right_keys: np.ndarray
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+    """Inner equi-join on single numeric keys.
+
+    Returns (left_indices, right_indices) into the ORIGINAL (unsorted)
+    inputs.  Right side is sorted on device; left side order is preserved.
+    """
+    lk = jnp.asarray(left_keys)
+    rk = jnp.asarray(right_keys)
+    r_perm = jnp.argsort(rk)
+    rk_sorted = rk[r_perm]
+    lo, hi = _match_ranges(lk, rk_sorted)
+    total = int(jnp.sum(hi - lo))  # host sync: the one dynamic-shape point
+    if total == 0:
+        return np.empty(0, dtype=np.int64), np.empty(0, dtype=np.int64)
+    left_idx, right_pos = _expand(lo, hi, total)
+    right_idx = r_perm[right_pos]
+    return np.asarray(left_idx), np.asarray(right_idx)

@@ -1,0 +1,56 @@
+"""Device-side bucket/sort permutation kernel — the heart of the index build.
+
+Reference contract: ``repartition(numBuckets, cols)`` + sort-within-bucket
+(actions/CreateActionBase.scala:124-142 and the bucketed writer
+DataFrameWriterExtensions.scala:49-67).  Spark does this as a cluster-wide
+hash shuffle followed by per-task sorts; on TPU the whole thing is ONE fused
+XLA program: hash → lexicographic sort by (bucket, key columns) → output a
+gather permutation.  The host then applies the permutation to the arrow
+table (zero-copy take) and slices per-bucket runs for the writer.
+
+Sort keys are normalized host-side to numeric arrays (order-preserving ranks
+for strings, hyperspace_tpu.io.columnar.to_order_key), so the kernel is
+dtype-monomorphic like the hash kernel.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from hyperspace_tpu.ops.hash import combine_hashes
+
+
+@partial(jax.jit, static_argnames=("num_buckets",))
+def bucket_sort_permutation(
+    word_cols: Sequence[jnp.ndarray],
+    order_keys: Sequence[jnp.ndarray],
+    num_buckets: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Fused hash + sort kernel.
+
+    Args:
+      word_cols: per key column (n, 2) uint32 hash words.
+      order_keys: per key column (n,) numeric ordering keys.
+      num_buckets: static bucket count.
+
+    Returns:
+      (bucket_ids int32 (n,), perm int32 (n,)) where perm orders rows by
+      (bucket, *order_keys) — ready for ``write_bucketed``.
+    """
+    h = combine_hashes(word_cols)
+    buckets = (h % jnp.uint32(num_buckets)).astype(jnp.int32)
+    # lexsort: last key is the primary. Order: bucket first, then keys.
+    keys = tuple(reversed(order_keys)) + (buckets,)
+    perm = jnp.lexsort(keys).astype(jnp.int32)
+    return buckets, perm
+
+
+@partial(jax.jit, static_argnames=("num_buckets",))
+def bucket_counts(buckets: jnp.ndarray, num_buckets: int) -> jnp.ndarray:
+    """Rows per bucket — one segment-sum over HBM."""
+    return jax.ops.segment_sum(
+        jnp.ones_like(buckets, dtype=jnp.int32), buckets, num_segments=num_buckets)

@@ -1,0 +1,171 @@
+"""Expression mini-language for the logical plan IR.
+
+The reference rides Catalyst expressions; we need only the subset its rules
+understand: column refs, literals, comparisons, boolean connectives, and IN
+(FilterIndexRule.scala:99-129 checks predicate column coverage;
+RuleUtils.scala:399-408 builds Not(In(lineage, ids)) filters;
+JoinIndexRule.scala:134-140 requires CNF of EqualTo over columns).
+
+Expressions evaluate over a pyarrow RecordBatch/Table host-side for the
+fallback path; the TPU executor compiles them to a JAX predicate instead
+(hyperspace_tpu/execution — evaluation here is the reference semantics).
+"""
+
+from __future__ import annotations
+
+from typing import Any, FrozenSet, Iterable, List, Sequence, Set, Union
+
+
+class Expr:
+    def __and__(self, other: "Expr") -> "Expr":
+        return And(self, _lift(other))
+
+    def __or__(self, other: "Expr") -> "Expr":
+        return Or(self, _lift(other))
+
+    def __invert__(self) -> "Expr":
+        return Not(self)
+
+    def __eq__(self, other: Any) -> "Expr":  # type: ignore[override]
+        return BinOp("==", self, _lift(other))
+
+    def __ne__(self, other: Any) -> "Expr":  # type: ignore[override]
+        return Not(BinOp("==", self, _lift(other)))
+
+    def __lt__(self, other: Any) -> "Expr":
+        return BinOp("<", self, _lift(other))
+
+    def __le__(self, other: Any) -> "Expr":
+        return BinOp("<=", self, _lift(other))
+
+    def __gt__(self, other: Any) -> "Expr":
+        return BinOp(">", self, _lift(other))
+
+    def __ge__(self, other: Any) -> "Expr":
+        return BinOp(">=", self, _lift(other))
+
+    def isin(self, values: Iterable[Any]) -> "Expr":
+        return IsIn(self, list(values))
+
+    def __hash__(self) -> int:
+        return hash(repr(self))
+
+    # -- analysis helpers ---------------------------------------------------
+    def referenced_columns(self) -> Set[str]:
+        out: Set[str] = set()
+        _collect_columns(self, out)
+        return out
+
+
+class Col(Expr):
+    def __init__(self, name: str) -> None:
+        self.name = name
+
+    def __repr__(self) -> str:
+        return f"col({self.name!r})"
+
+
+class Lit(Expr):
+    def __init__(self, value: Any) -> None:
+        self.value = value
+
+    def __repr__(self) -> str:
+        return f"lit({self.value!r})"
+
+
+class BinOp(Expr):
+    """Comparison: ==, <, <=, >, >=."""
+
+    OPS = ("==", "<", "<=", ">", ">=")
+
+    def __init__(self, op: str, left: Expr, right: Expr) -> None:
+        if op not in self.OPS:
+            raise ValueError(f"Unsupported op {op!r}")
+        self.op = op
+        self.left = left
+        self.right = right
+
+    def __repr__(self) -> str:
+        return f"({self.left!r} {self.op} {self.right!r})"
+
+
+class And(Expr):
+    def __init__(self, left: Expr, right: Expr) -> None:
+        self.left = left
+        self.right = right
+
+    def __repr__(self) -> str:
+        return f"({self.left!r} & {self.right!r})"
+
+
+class Or(Expr):
+    def __init__(self, left: Expr, right: Expr) -> None:
+        self.left = left
+        self.right = right
+
+    def __repr__(self) -> str:
+        return f"({self.left!r} | {self.right!r})"
+
+
+class Not(Expr):
+    def __init__(self, child: Expr) -> None:
+        self.child = child
+
+    def __repr__(self) -> str:
+        return f"~{self.child!r}"
+
+
+class IsIn(Expr):
+    def __init__(self, child: Expr, values: List[Any]) -> None:
+        self.child = child
+        self.values = values
+
+    def __repr__(self) -> str:
+        return f"{self.child!r}.isin({self.values!r})"
+
+
+def col(name: str) -> Col:
+    return Col(name)
+
+
+def lit(value: Any) -> Lit:
+    return Lit(value)
+
+
+def _lift(v: Any) -> Expr:
+    return v if isinstance(v, Expr) else Lit(v)
+
+
+def _collect_columns(e: Expr, out: Set[str]) -> None:
+    if isinstance(e, Col):
+        out.add(e.name)
+    elif isinstance(e, BinOp):
+        _collect_columns(e.left, out)
+        _collect_columns(e.right, out)
+    elif isinstance(e, (And, Or)):
+        _collect_columns(e.left, out)
+        _collect_columns(e.right, out)
+    elif isinstance(e, Not):
+        _collect_columns(e.child, out)
+    elif isinstance(e, IsIn):
+        _collect_columns(e.child, out)
+
+
+def split_conjuncts(e: Expr) -> List[Expr]:
+    """Flatten a CNF chain of Ands (JoinIndexRule.scala:134-140)."""
+    if isinstance(e, And):
+        return split_conjuncts(e.left) + split_conjuncts(e.right)
+    return [e]
+
+
+def as_equi_join_pairs(condition: Expr) -> Union[List[tuple], None]:
+    """If ``condition`` is a CNF of column==column equalities, return the
+    (left_name, right_name) pairs; else None (JoinIndexRule.scala:134-166)."""
+    pairs = []
+    for conj in split_conjuncts(condition):
+        if (isinstance(conj, BinOp) and conj.op == "=="
+                and isinstance(conj.left, Col) and isinstance(conj.right, Col)):
+            pairs.append((conj.left.name, conj.right.name))
+        else:
+            return None
+    return pairs

@@ -1,0 +1,213 @@
+"""Logical plan IR: the minimal operator set the rewrite rules reason over.
+
+The reference matches Catalyst trees of Scan/Filter/Project/Join
+(FilterIndexRule.scala:158-197's ExtractFilterNode/ExtractRelation;
+JoinIndexRule.scala:165-166's linear-plan requirement).  We model exactly
+that: a small immutable tree, leaf ``Scan`` nodes carrying relation metadata,
+and helpers (``leaf_relations``, ``is_linear``, ``output_columns``) the rules
+need.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from hyperspace_tpu.plan.expr import Expr
+
+
+@dataclasses.dataclass(frozen=True)
+class ScanRelation:
+    """Leaf relation metadata: where and how to read the data.
+
+    ``index_scan_of`` marks a scan that was already rewritten to an index
+    (the marker the rules use to avoid double application — the reference's
+    ``indexRelation→true`` option, IndexConstants.scala:59 /
+    RuleUtils.scala:173-183).  ``bucket_spec`` carries (num_buckets,
+    bucket_columns, sort_columns) for bucketed index data.
+    ``file_paths``, when set, overrides root-path listing (used for index
+    scans and hybrid-scan file subsets).
+    """
+
+    root_paths: Tuple[str, ...]
+    file_format: str = "parquet"
+    options: Tuple[Tuple[str, str], ...] = ()
+    index_scan_of: Optional[str] = None
+    bucket_spec: Optional[Tuple[int, Tuple[str, ...], Tuple[str, ...]]] = None
+    file_paths: Optional[Tuple[str, ...]] = None
+    # Predicate-derived bucket pruning: only these buckets need scanning
+    # (FilterIndexRule bucket pruning, IndexConstants.scala:52-53).
+    prune_to_buckets: Optional[Tuple[int, ...]] = None
+
+    @property
+    def options_dict(self) -> Dict[str, str]:
+        return dict(self.options)
+
+
+class LogicalPlan:
+    children: Tuple["LogicalPlan", ...] = ()
+
+    def leaf_relations(self) -> List["Scan"]:
+        if isinstance(self, Scan):
+            return [self]
+        out: List[Scan] = []
+        for c in self.children:
+            out.extend(c.leaf_relations())
+        return out
+
+    def is_linear(self) -> bool:
+        """True if no node has more than one child (JoinIndexRule.scala:165-166
+        requires each join side to be a linear chain over one relation)."""
+        if len(self.children) > 1:
+            return False
+        return all(c.is_linear() for c in self.children)
+
+    def output_columns(self, schema_of) -> List[str]:
+        """Columns this plan produces; ``schema_of(scan)`` resolves leaf
+        schemas (host callback so the IR stays IO-free)."""
+        raise NotImplementedError
+
+    def transform_up(self, fn) -> "LogicalPlan":
+        new_children = tuple(c.transform_up(fn) for c in self.children)
+        node = self.with_children(new_children) if new_children != self.children else self
+        return fn(node)
+
+    def with_children(self, children: Tuple["LogicalPlan", ...]) -> "LogicalPlan":
+        raise NotImplementedError
+
+    def simple_string(self) -> str:
+        raise NotImplementedError
+
+    def tree_string(self, indent: int = 0) -> str:
+        lines = ["  " * indent + self.simple_string()]
+        for c in self.children:
+            lines.append(c.tree_string(indent + 1))
+        return "\n".join(lines)
+
+
+class Scan(LogicalPlan):
+    def __init__(self, relation: ScanRelation) -> None:
+        self.relation = relation
+        self.children = ()
+
+    def output_columns(self, schema_of) -> List[str]:
+        return schema_of(self)
+
+    def with_children(self, children) -> "Scan":
+        assert not children
+        return self
+
+    def simple_string(self) -> str:
+        rel = self.relation
+        if rel.index_scan_of:
+            tag = f"Hyperspace(Type: CI, Name: {rel.index_scan_of})"
+            if rel.prune_to_buckets is not None:
+                tag += f" [buckets: {len(rel.prune_to_buckets)}/{rel.bucket_spec[0]}]"
+            return f"Scan {tag}"
+        return f"Scan {','.join(rel.root_paths)} ({rel.file_format})"
+
+
+class Filter(LogicalPlan):
+    def __init__(self, condition: Expr, child: LogicalPlan) -> None:
+        self.condition = condition
+        self.children = (child,)
+
+    @property
+    def child(self) -> LogicalPlan:
+        return self.children[0]
+
+    def output_columns(self, schema_of) -> List[str]:
+        return self.child.output_columns(schema_of)
+
+    def with_children(self, children) -> "Filter":
+        (child,) = children
+        return Filter(self.condition, child)
+
+    def simple_string(self) -> str:
+        return f"Filter {self.condition!r}"
+
+
+class Project(LogicalPlan):
+    def __init__(self, columns: Sequence[str], child: LogicalPlan) -> None:
+        self.columns = list(columns)
+        self.children = (child,)
+
+    @property
+    def child(self) -> LogicalPlan:
+        return self.children[0]
+
+    def output_columns(self, schema_of) -> List[str]:
+        return list(self.columns)
+
+    def with_children(self, children) -> "Project":
+        (child,) = children
+        return Project(self.columns, child)
+
+    def simple_string(self) -> str:
+        return f"Project [{', '.join(self.columns)}]"
+
+
+class Join(LogicalPlan):
+    def __init__(self, left: LogicalPlan, right: LogicalPlan,
+                 condition: Expr, how: str = "inner") -> None:
+        if how != "inner":
+            raise ValueError("Only inner joins are supported (JoinIndexRule scope)")
+        self.condition = condition
+        self.how = how
+        self.children = (left, right)
+
+    @property
+    def left(self) -> LogicalPlan:
+        return self.children[0]
+
+    @property
+    def right(self) -> LogicalPlan:
+        return self.children[1]
+
+    def output_columns(self, schema_of) -> List[str]:
+        return (self.left.output_columns(schema_of)
+                + self.right.output_columns(schema_of))
+
+    def with_children(self, children) -> "Join":
+        left, right = children
+        return Join(left, right, self.condition, self.how)
+
+    def simple_string(self) -> str:
+        return f"Join {self.how} on {self.condition!r}"
+
+
+class BucketUnion(LogicalPlan):
+    """Partition-preserving union of identically bucketed children
+    (index/plans/logical/BucketUnion.scala:31-68).  In this engine a bucketed
+    dataset is per-bucket batches; the physical op concatenates per-bucket
+    batches without re-hashing (BucketUnionExec.scala:52-81)."""
+
+    def __init__(self, children: Sequence[LogicalPlan],
+                 bucket_spec: Tuple[int, Tuple[str, ...], Tuple[str, ...]]) -> None:
+        self.bucket_spec = bucket_spec
+        self.children = tuple(children)
+
+    def output_columns(self, schema_of) -> List[str]:
+        return self.children[0].output_columns(schema_of)
+
+    def with_children(self, children) -> "BucketUnion":
+        return BucketUnion(children, self.bucket_spec)
+
+    def simple_string(self) -> str:
+        return f"BucketUnion (buckets={self.bucket_spec[0]})"
+
+
+class Union(LogicalPlan):
+    """Plain union (the non-bucketed hybrid-scan merge, RuleUtils.scala:422-439)."""
+
+    def __init__(self, children: Sequence[LogicalPlan]) -> None:
+        self.children = tuple(children)
+
+    def output_columns(self, schema_of) -> List[str]:
+        return self.children[0].output_columns(schema_of)
+
+    def with_children(self, children) -> "Union":
+        return Union(children)
+
+    def simple_string(self) -> str:
+        return "Union"

@@ -1,0 +1,83 @@
+"""Explain: side-by-side plans with and without indexes.
+
+Reference contract: index/plananalysis/PlanAnalyzer.scala:46-130 — compile
+the plan twice (hyperspace enabled/disabled around the optimizer,
+:167-182), render both trees, list the indexes used, and in verbose mode a
+physical-operator count comparison (PhysicalOperatorAnalyzer.scala:30-58 —
+the operators the rewrite removes, e.g. shuffles, are what users look for).
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, List
+
+from hyperspace_tpu.plan.nodes import LogicalPlan, Scan
+
+
+def _used_indexes(plan: LogicalPlan) -> List[str]:
+    return sorted({s.relation.index_scan_of for s in plan.leaf_relations()
+                   if s.relation.index_scan_of})
+
+
+def _operator_counts(plan: LogicalPlan) -> Counter:
+    counts: Counter = Counter()
+
+    def walk(node: LogicalPlan) -> None:
+        counts[type(node).__name__] += 1
+        for c in node.children:
+            walk(c)
+
+    walk(plan)
+    return counts
+
+
+def explain_string(dataset, session, verbose: bool = False) -> str:
+    """Hyperspace.explain analog (Hyperspace.scala:152-155)."""
+    was_enabled = session.is_hyperspace_enabled()
+    try:
+        session.enable_hyperspace()
+        plan_with = session.optimize(dataset.plan)
+        session.disable_hyperspace()
+        plan_without = dataset.plan
+    finally:
+        if was_enabled:
+            session.enable_hyperspace()
+        else:
+            session.disable_hyperspace()
+
+    lines: List[str] = []
+    bar = "=" * 64
+    lines += [bar, "Plan with indexes:", bar, plan_with.tree_string(), ""]
+    lines += [bar, "Plan without indexes:", bar, plan_without.tree_string(), ""]
+    lines += [bar, "Indexes used:", bar]
+    used = _used_indexes(plan_with)
+    if used:
+        from hyperspace_tpu.index.manager import IndexCollectionManager
+
+        mgr = IndexCollectionManager(session)
+        for name in used:
+            entry = mgr.get_index(name)
+            location = ""
+            if entry is not None:
+                files = entry.content.file_infos()
+                if files:
+                    import os
+
+                    location = os.path.dirname(files[0].name)
+            lines.append(f"{name}:{location}")
+    else:
+        lines.append("(none)")
+    lines.append("")
+    if verbose:
+        lines += [bar, "Physical operator stats:", bar]
+        with_counts = _operator_counts(plan_with)
+        without_counts = _operator_counts(plan_without)
+        ops = sorted(set(with_counts) | set(without_counts))
+        header = f"{'Physical Operator':<24}{'Hyperspace Disabled':>22}{'Enabled':>10}{'Diff':>8}"
+        lines.append(header)
+        for op in ops:
+            a, b = without_counts.get(op, 0), with_counts.get(op, 0)
+            lines.append(f"{op:<24}{a:>22}{b:>10}{b - a:>+8}")
+        lines.append("")
+    return "\n".join(lines)

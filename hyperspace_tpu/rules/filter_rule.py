@@ -1,0 +1,175 @@
+"""FilterIndexRule: rewrite Scan→Filter[→Project] to an index-only scan.
+
+Reference contract: index/rules/FilterIndexRule.scala —
+  - pattern extraction (:158-197): Filter directly over a supported Scan,
+    optionally under a Project;
+  - applicability (:99-155): the index's FIRST indexed column must appear in
+    the predicate, and the index must cover filter + output columns;
+  - rewrite (:43-88): swap the scan, optionally with bucket spec
+    (IndexConstants.scala:52-53).
+
+TPU extension with reference semantics intact: when the predicate pins every
+indexed column with equality/IN, we precompute the matching hash buckets with
+the SAME device kernel the build used and prune the index files read
+(the bucket-pruning effect Spark gets from its bucketed FileSourceScan).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from hyperspace_tpu.index.log_entry import IndexLogEntry, States
+from hyperspace_tpu.plan.expr import And, BinOp, Col, Expr, IsIn, Lit, Not, Or, split_conjuncts
+from hyperspace_tpu.plan.nodes import Filter, LogicalPlan, Project, Scan
+from hyperspace_tpu.rules import rule_utils
+from hyperspace_tpu.rules.rankers import rank_filter_indexes
+from hyperspace_tpu.telemetry.events import HyperspaceIndexUsageEvent, get_event_logger
+from hyperspace_tpu.utils.resolver import resolve
+
+
+class FilterIndexRule:
+    def __init__(self, session, entries: Optional[List[IndexLogEntry]] = None) -> None:
+        self.session = session
+        self._entries = entries
+
+    def apply(self, plan: LogicalPlan) -> LogicalPlan:
+        matched = _extract_filter_node(plan)
+        if matched is None:
+            return plan
+        scan, filter_node, project_cols = matched
+        if rule_utils.is_index_applied(scan):
+            return plan
+        if not self.session.source_provider_manager.is_supported_relation(scan):
+            return plan
+
+        schema = self.session.schema_of(scan)
+        filter_cols = sorted(filter_node.condition.referenced_columns())
+        output_cols = project_cols if project_cols is not None else schema
+        if resolve(filter_cols, schema) is None:
+            return plan
+
+        entries = self._entries
+        if entries is None:
+            entries = self.session.index_collection_manager.get_indexes([States.ACTIVE])
+        candidates = rule_utils.get_candidate_indexes(self.session, entries, scan)
+        covering = _find_covering_indexes(candidates, filter_cols, output_cols)
+        best = rank_filter_indexes(covering, scan, self.session.conf.hybrid_scan_enabled)
+        if best is None:
+            return plan
+
+        hybrid_needed = False
+        if self.session.conf.hybrid_scan_enabled:
+            from hyperspace_tpu.rules.hybrid import hybrid_file_lists
+
+            appended, deleted = hybrid_file_lists(best, scan)
+            hybrid_needed = bool(appended or deleted)
+        if hybrid_needed:
+            from hyperspace_tpu.rules.hybrid import transform_plan_to_use_hybrid_scan
+
+            new_plan = transform_plan_to_use_hybrid_scan(
+                self.session, plan, scan, best, bucket_union=False)
+        else:
+            prune = _bucket_pruning(filter_node.condition, best)
+            use_bucket_spec = (self.session.conf.filter_rule_use_bucket_spec
+                               or prune is not None)
+            new_plan = rule_utils.transform_plan_to_use_index_only_scan(
+                plan, scan, best, use_bucket_spec, prune)
+        get_event_logger().log_event(HyperspaceIndexUsageEvent(
+            index_names=[best.name],
+            plan_before=plan.tree_string(),
+            plan_after=new_plan.tree_string(),
+            message="FilterIndexRule applied"))
+        return new_plan
+
+
+def _extract_filter_node(plan: LogicalPlan
+                         ) -> Optional[Tuple[Scan, Filter, Optional[List[str]]]]:
+    """Match Project(Filter(Scan)) / Filter(Scan) (ExtractFilterNode,
+    FilterIndexRule.scala:158-186).  The rule applies at the plan root only —
+    mirroring the reference, which matches the operator pattern anywhere but
+    we keep single-query plans linear."""
+    if isinstance(plan, Project) and isinstance(plan.child, Filter) \
+            and isinstance(plan.child.child, Scan):
+        return plan.child.child, plan.child, list(plan.columns)
+    if isinstance(plan, Filter) and isinstance(plan.child, Scan):
+        return plan.child, plan, None
+    # Recurse into children so filters under joins/unions also rewrite.
+    for child in plan.children:
+        hit = _extract_filter_node(child)
+        if hit is not None:
+            return hit
+    return None
+
+
+def _find_covering_indexes(candidates: Sequence[IndexLogEntry],
+                           filter_cols: List[str],
+                           output_cols: List[str]) -> List[IndexLogEntry]:
+    """FilterIndexRule.scala:99-155: first indexed column in the predicate;
+    index covers filter+output columns (case-insensitive)."""
+    out = []
+    for entry in candidates:
+        first_indexed = entry.indexed_columns[0].lower()
+        if first_indexed not in {c.lower() for c in filter_cols}:
+            continue
+        index_cols = {c.lower() for c in entry.derived_dataset.all_columns}
+        needed = {c.lower() for c in filter_cols} | {c.lower() for c in output_cols}
+        if needed <= index_cols:
+            out.append(entry)
+    return out
+
+
+def _bucket_pruning(condition: Expr, entry: IndexLogEntry
+                    ) -> Optional[Tuple[int, ...]]:
+    """Buckets that can possibly hold matching rows, or None if not prunable.
+
+    Only sound when every indexed column is pinned to a finite value set by
+    top-level conjuncts (equality or IN).  The bucket for each value tuple is
+    computed with the build kernel itself, so pruning can never disagree with
+    bucket assignment.
+    """
+    pinned: dict = {}
+    for conj in split_conjuncts(condition):
+        if isinstance(conj, BinOp) and conj.op == "==":
+            if isinstance(conj.left, Col) and isinstance(conj.right, Lit):
+                pinned.setdefault(conj.left.name.lower(), set()).add(conj.right.value)
+            elif isinstance(conj.right, Col) and isinstance(conj.left, Lit):
+                pinned.setdefault(conj.right.name.lower(), set()).add(conj.left.value)
+        elif isinstance(conj, IsIn) and isinstance(conj.child, Col):
+            pinned.setdefault(conj.child.name.lower(), set()).update(conj.values)
+    indexed = [c.lower() for c in entry.indexed_columns]
+    if not all(c in pinned for c in indexed):
+        return None
+    value_sets = [sorted(pinned[c], key=repr) for c in indexed]
+    n_combos = 1
+    for vs in value_sets:
+        n_combos *= len(vs)
+    if n_combos == 0 or n_combos > 1024:
+        return None
+
+    import itertools
+
+    from hyperspace_tpu.io.columnar import to_hash_words
+    from hyperspace_tpu.io.parquet import schema_to_arrow
+    from hyperspace_tpu.ops.hash import bucket_ids
+
+    # Literals MUST be hashed with the indexed column's stored type, not the
+    # literal's inferred type: an int literal probing a float64 column would
+    # otherwise hash different bits than the build did and prune the wrong
+    # bucket.
+    index_schema = schema_to_arrow(entry.derived_dataset.schema)
+    schema_by_lower = {f.name.lower(): f.type for f in index_schema}
+    combos = list(itertools.product(*value_sets))
+    word_cols = []
+    for col_i, col_name in enumerate(indexed):
+        col_type = schema_by_lower.get(col_name)
+        try:
+            col_vals = pa.array([c[col_i] for c in combos], type=col_type)
+        except (pa.ArrowInvalid, pa.ArrowTypeError):
+            return None  # literal not castable to the column type: no pruning
+        word_cols.append(to_hash_words(col_vals))
+    buckets = np.asarray(bucket_ids([np.asarray(w) for w in word_cols],
+                                    entry.num_buckets))
+    return tuple(sorted(set(int(b) for b in buckets)))

@@ -1,0 +1,131 @@
+"""Hybrid Scan: use a stale index over mutated source data by merging the
+index with appended files and filtering out deleted rows via lineage.
+
+Reference contract: index/rules/RuleUtils.scala —
+  - candidate math (:79-133): an index whose signature no longer matches is
+    still usable when the byte overlap is high enough: appended-bytes ratio
+    ≤ conf threshold (0.3), deleted-bytes ratio ≤ threshold (0.2, deletes
+    additionally require the lineage column); common bytes are tagged for
+    the rankers.
+  - plan transform (:302-443): index side gets a Filter(~isin(lineage_col,
+    deleted_ids)) when rows were deleted (:399-408); appended files are read
+    through a separate scan and merged with BucketUnion (join side, so
+    bucketing survives, :422-439) or plain Union (filter side).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from hyperspace_tpu.actions.create import DATA_FILE_ID_COLUMN
+from hyperspace_tpu.index.log_entry import FileInfo, IndexLogEntry, IndexLogEntryTags
+from hyperspace_tpu.plan.expr import Col, IsIn, Not
+from hyperspace_tpu.plan.nodes import (
+    BucketUnion,
+    Filter,
+    LogicalPlan,
+    Project,
+    Scan,
+    ScanRelation,
+    Union,
+)
+from hyperspace_tpu.rules import rule_utils
+
+_HYBRID_INFO_TAG = "hybridScanFileLists"  # (appended, deleted) FileInfo lists
+
+
+def _file_key(f: FileInfo) -> Tuple[str, int, int]:
+    return (f.name, f.size, f.mtime)
+
+
+def get_hybrid_scan_candidates(session, entries: Sequence[IndexLogEntry],
+                               scan: Scan) -> List[IndexLogEntry]:
+    """RuleUtils.scala:79-133."""
+    relation = session.source_provider_manager.get_relation(scan)
+    current = relation.all_files()
+    current_by_key = {_file_key(f): f for f in current}
+    conf = session.conf
+    out: List[IndexLogEntry] = []
+    for entry in entries:
+        cached = entry.get_tag(IndexLogEntryTags.IS_HYBRIDSCAN_CANDIDATE, scan)
+        if cached is not None:
+            if cached:
+                out.append(entry)
+            continue
+        indexed_keys = {_file_key(f): f for f in entry.source_file_infos()}
+        common_keys = indexed_keys.keys() & current_by_key.keys()
+        common_bytes = sum(k[1] for k in common_keys)
+        appended = [f for k, f in current_by_key.items() if k not in common_keys]
+        deleted = [f for k, f in indexed_keys.items() if k not in common_keys]
+        appended_bytes = sum(f.size for f in appended)
+        deleted_bytes = sum(f.size for f in deleted)
+        total_current = common_bytes + appended_bytes
+        total_indexed = common_bytes + deleted_bytes
+        ok = common_bytes > 0
+        if ok and appended_bytes:
+            ok = appended_bytes / total_current <= conf.hybrid_scan_max_appended_ratio
+        if ok and deleted_bytes:
+            ok = (entry.has_lineage_column()
+                  and deleted_bytes / total_indexed <= conf.hybrid_scan_max_deleted_ratio)
+        entry.set_tag(IndexLogEntryTags.IS_HYBRIDSCAN_CANDIDATE, ok, scan)
+        entry.set_tag(IndexLogEntryTags.COMMON_BYTES, common_bytes, scan)
+        entry.set_tag(_HYBRID_INFO_TAG, (appended, deleted), scan)
+        if ok:
+            out.append(entry)
+    return out
+
+
+def hybrid_file_lists(entry: IndexLogEntry, scan: Scan
+                      ) -> Tuple[List[FileInfo], List[FileInfo]]:
+    """(appended, deleted) for this entry vs this scan: the candidate-math
+    tag when present (set by get_hybrid_scan_candidates), else the lists a
+    quick refresh recorded in the entry itself."""
+    info = entry.get_tag(_HYBRID_INFO_TAG, scan)
+    if info is not None:
+        return info
+    return entry.appended_files(), entry.deleted_files()
+
+
+def transform_plan_to_use_hybrid_scan(session, plan: LogicalPlan, target: Scan,
+                                      entry: IndexLogEntry,
+                                      bucket_union: bool) -> LogicalPlan:
+    """RuleUtils.scala:302-443: build the merged index∪appended subtree and
+    swap it for ``target``."""
+    appended, deleted = hybrid_file_lists(entry, target)
+    visible_cols = entry.derived_dataset.all_columns
+
+    index_side: LogicalPlan = Scan(rule_utils.index_scan_relation(
+        entry, use_bucket_spec=bucket_union))
+    if deleted:
+        # Filter(Not(In(lineage, deleted ids))) (RuleUtils.scala:399-408).
+        deleted_ids = sorted({f.id for f in deleted})
+        index_side = Filter(Not(IsIn(Col(DATA_FILE_ID_COLUMN), deleted_ids)),
+                            index_side)
+    index_side = Project(visible_cols, index_side)
+
+    if appended:
+        src_rel = target.relation
+        appended_scan = Scan(ScanRelation(
+            root_paths=src_rel.root_paths,
+            file_format=src_rel.file_format,
+            options=src_rel.options,
+            file_paths=tuple(f.name for f in appended),
+        ))
+        appended_side: LogicalPlan = Project(visible_cols, appended_scan)
+        cols = tuple(entry.indexed_columns)
+        if bucket_union:
+            # Join side: appended rows must be routed into the same bucket
+            # space so the bucketed merge stays shuffle-free for the index
+            # side (RuleUtils.scala:511-570's on-the-fly shuffle).
+            merged: LogicalPlan = BucketUnion(
+                [index_side, appended_side],
+                (entry.num_buckets, cols, cols))
+        else:
+            merged = Union([index_side, appended_side])
+    else:
+        merged = index_side
+
+    def swap(node: LogicalPlan) -> LogicalPlan:
+        return merged if node is target else node
+
+    return plan.transform_up(swap)

@@ -1,0 +1,173 @@
+"""JoinIndexRule: rewrite both sides of an equi-join to bucketed index scans.
+
+Reference contract: index/rules/JoinIndexRule.scala —
+  - applicability (:108-140, 165-166, 233-272): inner join, condition is a
+    CNF of column==column equalities, each side a linear plan over one
+    supported relation, every equality spanning the two sides 1:1;
+  - index selection (:282-334, 448-530): per side, usable indexes must have
+    indexed columns == that side's join keys (same set; compatible pairs
+    require the same order) and cover that side's required columns;
+  - ranking: JoinIndexRanker (rankers.py);
+  - rewrite (:57-98): both scans become index scans WITH bucket spec —
+    giving the shuffle-free sort-merge join (JoinIndexRule.scala:36-50); the
+    executor's merge join then runs directly over per-bucket sorted data.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from hyperspace_tpu.index.log_entry import IndexLogEntry, States
+from hyperspace_tpu.plan.expr import as_equi_join_pairs
+from hyperspace_tpu.plan.nodes import Join, LogicalPlan, Scan
+from hyperspace_tpu.rules import rule_utils
+from hyperspace_tpu.rules.rankers import rank_join_index_pairs
+from hyperspace_tpu.telemetry.events import HyperspaceIndexUsageEvent, get_event_logger
+from hyperspace_tpu.utils.resolver import resolve
+
+
+class JoinIndexRule:
+    def __init__(self, session, entries: Optional[List[IndexLogEntry]] = None) -> None:
+        self.session = session
+        self._entries = entries
+
+    def apply(self, plan: LogicalPlan) -> LogicalPlan:
+        if isinstance(plan, Join):
+            rewritten = self._try_rewrite(plan)
+            if rewritten is not None:
+                return rewritten
+        new_children = tuple(self.apply(c) for c in plan.children)
+        if new_children != plan.children:
+            return plan.with_children(new_children)
+        return plan
+
+    def _try_rewrite(self, join: Join) -> Optional[LogicalPlan]:
+        spm = self.session.source_provider_manager
+        pairs = as_equi_join_pairs(join.condition)
+        if not pairs:
+            return None
+        if not (join.left.is_linear() and join.right.is_linear()):
+            return None
+        left_leaves = join.left.leaf_relations()
+        right_leaves = join.right.leaf_relations()
+        if len(left_leaves) != 1 or len(right_leaves) != 1:
+            return None
+        l_scan, r_scan = left_leaves[0], right_leaves[0]
+        if rule_utils.is_index_applied(l_scan) or rule_utils.is_index_applied(r_scan):
+            return None
+        if not (spm.is_supported_relation(l_scan) and spm.is_supported_relation(r_scan)):
+            return None
+
+        l_schema = self.session.schema_of(l_scan)
+        r_schema = self.session.schema_of(r_scan)
+        # Orient every equality pair as (left column, right column); the 1:1
+        # requirement (JoinIndexRule.scala:233-272).
+        l_keys: List[str] = []
+        r_keys: List[str] = []
+        for a, b in pairs:
+            if resolve([a], l_schema) and resolve([b], r_schema):
+                l_keys.append(a)
+                r_keys.append(b)
+            elif resolve([b], l_schema) and resolve([a], r_schema):
+                l_keys.append(b)
+                r_keys.append(a)
+            else:
+                return None
+        l_map: Dict[str, str] = {}
+        r_map: Dict[str, str] = {}
+        for lk, rk in zip(l_keys, r_keys):
+            lk_l, rk_l = lk.lower(), rk.lower()
+            if l_map.get(lk_l, rk_l) != rk_l or r_map.get(rk_l, lk_l) != lk_l:
+                return None  # one left column equated to two right columns
+            l_map[lk_l] = rk_l
+            r_map[rk_l] = lk_l
+
+        l_required = self._required_columns(join.left, l_schema)
+        r_required = self._required_columns(join.right, r_schema)
+
+        entries = self._entries
+        if entries is None:
+            entries = self.session.index_collection_manager.get_indexes([States.ACTIVE])
+        l_candidates = rule_utils.get_candidate_indexes(self.session, entries, l_scan)
+        r_candidates = rule_utils.get_candidate_indexes(self.session, entries, r_scan)
+        l_usable = _usable_indexes(l_candidates, l_keys, l_required)
+        r_usable = _usable_indexes(r_candidates, r_keys, r_required)
+        compatible = _compatible_pairs(l_usable, r_usable, l_keys, r_keys)
+        best = rank_join_index_pairs(compatible, l_scan, r_scan,
+                                     self.session.conf.hybrid_scan_enabled)
+        if best is None:
+            return None
+        l_entry, r_entry = best
+
+        hybrid = self.session.conf.hybrid_scan_enabled
+
+        def rewrite_side(side_plan, scan, entry):
+            if hybrid:
+                from hyperspace_tpu.rules.hybrid import (
+                    hybrid_file_lists,
+                    transform_plan_to_use_hybrid_scan,
+                )
+
+                appended, deleted = hybrid_file_lists(entry, scan)
+                if appended or deleted:
+                    return transform_plan_to_use_hybrid_scan(
+                        self.session, side_plan, scan, entry, bucket_union=True)
+            return rule_utils.transform_plan_to_use_index_only_scan(
+                side_plan, scan, entry, use_bucket_spec=True)
+
+        new_left = rewrite_side(join.left, l_scan, l_entry)
+        new_right = rewrite_side(join.right, r_scan, r_entry)
+        new_plan = Join(new_left, new_right, join.condition, join.how)
+        get_event_logger().log_event(HyperspaceIndexUsageEvent(
+            index_names=[l_entry.name, r_entry.name],
+            plan_before=Join(join.left, join.right, join.condition, join.how).tree_string(),
+            plan_after=new_plan.tree_string(),
+            message="JoinIndexRule applied"))
+        return new_plan
+
+    def _required_columns(self, side_plan: LogicalPlan, schema: List[str]) -> List[str]:
+        """All columns this side must provide: its output plus any columns
+        referenced by intermediate filters (JoinIndexRule.scala:371-383)."""
+        from hyperspace_tpu.plan.nodes import Filter
+
+        needed: Set[str] = set(side_plan.output_columns(self.session.schema_of))
+
+        def walk(node: LogicalPlan) -> None:
+            if isinstance(node, Filter):
+                needed.update(node.condition.referenced_columns())
+            for c in node.children:
+                walk(c)
+
+        walk(side_plan)
+        return sorted(needed)
+
+
+def _usable_indexes(candidates: List[IndexLogEntry], keys: List[str],
+                    required: List[str]) -> List[IndexLogEntry]:
+    """JoinIndexRule.scala:448-460: indexed columns == join keys (as sets),
+    and all required columns covered."""
+    keyset = {k.lower() for k in keys}
+    req = {c.lower() for c in required}
+    out = []
+    for e in candidates:
+        if {c.lower() for c in e.indexed_columns} != keyset:
+            continue
+        if not req <= {c.lower() for c in e.derived_dataset.all_columns}:
+            continue
+        out.append(e)
+    return out
+
+
+def _compatible_pairs(left: List[IndexLogEntry], right: List[IndexLogEntry],
+                      l_keys: List[str], r_keys: List[str]
+                      ) -> List[Tuple[IndexLogEntry, IndexLogEntry]]:
+    """JoinIndexRule.scala:483-530: pair up indexes whose indexed-column
+    ORDER is mutually consistent with the join-key mapping."""
+    key_map = {lk.lower(): rk.lower() for lk, rk in zip(l_keys, r_keys)}
+    out = []
+    for le in left:
+        expected_right_order = [key_map[c.lower()] for c in le.indexed_columns]
+        for re in right:
+            if [c.lower() for c in re.indexed_columns] == expected_right_order:
+                out.append((le, re))
+    return out

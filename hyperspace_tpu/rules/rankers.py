@@ -1,0 +1,49 @@
+"""Candidate rankers: choose the best index(es) among candidates.
+
+Reference contract: index/rankers/FilterIndexRanker.scala:43-58 (hybrid scan:
+max common bytes, else head) and index/rankers/JoinIndexRanker.scala:52-90
+(prefer equal-bucket pairs, then more buckets, then more common bytes).
+Common-bytes tags are keyed by the scan they were computed against
+(IndexLogEntry tag semantics, IndexLogEntry.scala:560-603).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from hyperspace_tpu.index.log_entry import IndexLogEntry, IndexLogEntryTags
+from hyperspace_tpu.plan.nodes import Scan
+
+
+def _common_bytes(entry: IndexLogEntry, scan: Scan) -> int:
+    v = entry.get_tag(IndexLogEntryTags.COMMON_BYTES, scan)
+    return v if v is not None else 0
+
+
+def rank_filter_indexes(candidates: List[IndexLogEntry], scan: Scan,
+                        hybrid_scan: bool) -> Optional[IndexLogEntry]:
+    if not candidates:
+        return None
+    if hybrid_scan:
+        return max(candidates, key=lambda e: _common_bytes(e, scan))
+    return candidates[0]
+
+
+def rank_join_index_pairs(
+        pairs: List[Tuple[IndexLogEntry, IndexLogEntry]],
+        l_scan: Scan, r_scan: Scan,
+        hybrid_scan: bool) -> Optional[Tuple[IndexLogEntry, IndexLogEntry]]:
+    if not pairs:
+        return None
+
+    def key(pair: Tuple[IndexLogEntry, IndexLogEntry]):
+        l, r = pair
+        equal_buckets = l.num_buckets == r.num_buckets
+        if hybrid_scan:
+            # Under hybrid scan, maximizing common bytes minimizes the
+            # appended/deleted data that must be merged on the fly
+            # (JoinIndexRanker.scala:52-72): it outranks bucket count.
+            return (equal_buckets, _common_bytes(l, l_scan) + _common_bytes(r, r_scan))
+        return (equal_buckets, l.num_buckets + r.num_buckets)
+
+    return max(pairs, key=key)

@@ -1,0 +1,105 @@
+"""Shared rule machinery: candidate index selection and plan rewriting.
+
+Reference contract: index/rules/RuleUtils.scala —
+  - ``get_candidate_indexes`` (:52-164): an ACTIVE index is a candidate when
+    its stored fingerprint matches the recomputed signature of the current
+    leaf relation (signature memoized per provider per rule invocation,
+    :59-74); under hybrid scan, file-overlap math replaces exact matching
+    (:79-133, implemented in hybrid.py).
+  - ``transform_plan_to_use_index_only_scan`` (:255-286): swap the leaf scan
+    for a scan over the index's bucketed Parquet files, optionally carrying
+    the bucket spec.
+  - already-applied detection via the index-scan marker on the relation
+    (:173-183 / IndexConstants.scala:59 — here ``ScanRelation.index_scan_of``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from hyperspace_tpu.index.log_entry import IndexLogEntry, IndexLogEntryTags, States
+from hyperspace_tpu.index.signatures import get_provider
+from hyperspace_tpu.plan.nodes import LogicalPlan, Scan, ScanRelation
+
+
+def is_index_applied(scan: Scan) -> bool:
+    return scan.relation.index_scan_of is not None
+
+
+def get_candidate_indexes(session, entries: Sequence[IndexLogEntry],
+                          scan: Scan) -> List[IndexLogEntry]:
+    """Filter ACTIVE entries down to those valid for ``scan``."""
+    if is_index_applied(scan):
+        return []
+    if session.conf.hybrid_scan_enabled:
+        from hyperspace_tpu.rules.hybrid import get_hybrid_scan_candidates
+
+        return get_hybrid_scan_candidates(session, entries, scan)
+    # Signature-exact path: recompute per provider once (RuleUtils.scala:59-74).
+    signature_cache: Dict[str, Optional[str]] = {}
+
+    def current_signature(provider_name: str) -> Optional[str]:
+        if provider_name not in signature_cache:
+            provider = get_provider(provider_name)
+            signature_cache[provider_name] = provider.signature(
+                scan,
+                lambda s: session.source_provider_manager.get_relation(s).all_files())
+        return signature_cache[provider_name]
+
+    out: List[IndexLogEntry] = []
+    for entry in entries:
+        if entry.has_source_update():
+            # Quick-refreshed entries record appended/deleted files; they are
+            # only usable through Hybrid Scan — the index data alone is stale.
+            continue
+        cached = entry.get_tag(IndexLogEntryTags.SIGNATURE_MATCHED, scan)
+        if cached is None:
+            sig = entry.signature()
+            matched = current_signature(sig.provider) == sig.value
+            entry.set_tag(IndexLogEntryTags.SIGNATURE_MATCHED, matched, scan)
+        else:
+            matched = cached
+        if matched:
+            out.append(entry)
+    return out
+
+
+def index_scan_relation(entry: IndexLogEntry,
+                        use_bucket_spec: bool,
+                        prune_to_buckets: Optional[Tuple[int, ...]] = None,
+                        file_paths: Optional[Sequence[str]] = None) -> ScanRelation:
+    """The ScanRelation for reading an index's bucketed Parquet data
+    (RuleUtils.scala:255-286; display marker IndexHadoopFsRelation.scala:29-50)."""
+    files = list(file_paths) if file_paths is not None \
+        else [f.name for f in entry.content.file_infos()]
+    root = os.path.dirname(files[0]) if files else ""
+    cols = tuple(entry.indexed_columns)
+    return ScanRelation(
+        root_paths=(root,),
+        file_format="parquet",
+        index_scan_of=entry.name,
+        bucket_spec=(entry.num_buckets, cols, cols) if use_bucket_spec else None,
+        file_paths=tuple(files),
+        prune_to_buckets=prune_to_buckets,
+    )
+
+
+def transform_plan_to_use_index_only_scan(
+        plan: LogicalPlan, target: Scan, entry: IndexLogEntry,
+        use_bucket_spec: bool,
+        prune_to_buckets: Optional[Tuple[int, ...]] = None) -> LogicalPlan:
+    """Swap ``target`` for an index-only scan throughout ``plan``."""
+    new_node: LogicalPlan = Scan(
+        index_scan_relation(entry, use_bucket_spec, prune_to_buckets))
+    if entry.has_lineage_column():
+        # The stored lineage column is an implementation detail: project it
+        # away so enabling hyperspace never changes a query's output schema.
+        from hyperspace_tpu.plan.nodes import Project
+
+        new_node = Project(entry.derived_dataset.all_columns, new_node)
+
+    def swap(node: LogicalPlan) -> LogicalPlan:
+        return new_node if node is target else node
+
+    return plan.transform_up(swap)

@@ -1,0 +1,124 @@
+"""The engine session: conf, readers, optimizer hook, and schema resolution.
+
+Plays the role SparkSession plays for the reference: holds configuration
+(HyperspaceConf), the source provider manager, and the optimizer-extension
+switch ``enable_hyperspace()/disable_hyperspace()/is_hyperspace_enabled()``
+(package.scala:47-79).  Datasets created from ``session.read`` carry the
+session, and ``Dataset.collect()`` consults the switch to decide whether the
+rewrite rules run.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.plan.nodes import LogicalPlan, Scan, ScanRelation
+from hyperspace_tpu.sources.manager import FileBasedSourceProviderManager
+
+
+class DataReader:
+    """``session.read.parquet(path)`` etc., the DataFrameReader analog."""
+
+    def __init__(self, session: "HyperspaceSession") -> None:
+        self._session = session
+
+    def _make(self, fmt: str, *paths: str, **options: str):
+        from hyperspace_tpu.dataset import Dataset
+
+        rel = ScanRelation(
+            root_paths=tuple(paths),
+            file_format=fmt,
+            options=tuple(sorted(options.items())),
+        )
+        return Dataset(Scan(rel), self._session)
+
+    def parquet(self, *paths: str, **options: str):
+        return self._make("parquet", *paths, **options)
+
+    def csv(self, *paths: str, **options: str):
+        return self._make("csv", *paths, **options)
+
+    def json(self, *paths: str, **options: str):
+        return self._make("json", *paths, **options)
+
+
+class HyperspaceSession:
+    def __init__(self, system_path: Optional[str] = None,
+                 conf: Optional[HyperspaceConf] = None) -> None:
+        self.conf = conf if conf is not None else HyperspaceConf()
+        if system_path is not None:
+            self.conf.system_path = system_path
+        self._hyperspace_enabled = False
+        self._schema_cache: Dict[object, Dict[str, str]] = {}
+
+    # -- plumbing -----------------------------------------------------------
+    @property
+    def read(self) -> DataReader:
+        return DataReader(self)
+
+    @property
+    def source_provider_manager(self) -> FileBasedSourceProviderManager:
+        # Rebuilt per access so conf changes take effect (CacheWithTransform
+        # analog, util/CacheWithTransform.scala:31-45, without the cache —
+        # construction is cheap here).
+        return FileBasedSourceProviderManager(self.conf)
+
+    def schema_of(self, scan: Scan) -> List[str]:
+        return list(self.schema_map_of(scan).keys())
+
+    def schema_map_of(self, scan: Scan) -> Dict[str, str]:
+        # Keyed by the frozen relation value, not object identity: id() can
+        # be recycled after GC, and equal relations share one listing.
+        key = scan.relation
+        if key not in self._schema_cache:
+            if scan.relation.file_paths is not None:
+                from hyperspace_tpu.io.parquet import read_schema
+
+                self._schema_cache[key] = read_schema(
+                    scan.relation.file_paths[0], scan.relation.file_format,
+                    scan.relation.options_dict)
+            else:
+                rel = self.source_provider_manager.get_relation(scan)
+                self._schema_cache[key] = rel.schema()
+        return self._schema_cache[key]
+
+    # -- the optimizer switch (package.scala:47-79) -------------------------
+    def enable_hyperspace(self) -> "HyperspaceSession":
+        self._hyperspace_enabled = True
+        return self
+
+    def disable_hyperspace(self) -> "HyperspaceSession":
+        self._hyperspace_enabled = False
+        return self
+
+    def is_hyperspace_enabled(self) -> bool:
+        return self._hyperspace_enabled
+
+    @property
+    def index_collection_manager(self):
+        """TTL-cached manager (HyperspaceContext analog,
+        Hyperspace.scala:168-204)."""
+        from hyperspace_tpu.index.cache import CachingIndexCollectionManager
+
+        return CachingIndexCollectionManager(self)
+
+    def optimize(self, plan: LogicalPlan) -> LogicalPlan:
+        """Apply the rewrite rules if enabled — Join before Filter, the fixed
+        order with the rationale in package.scala:25-35.  ACTIVE entries are
+        loaded once and shared across both rules so per-scan signature
+        memoization (tags) carries over (RuleUtils.scala:59-74)."""
+        if not self._hyperspace_enabled:
+            return plan
+        from hyperspace_tpu.index.log_entry import States
+        from hyperspace_tpu.rules.filter_rule import FilterIndexRule
+        from hyperspace_tpu.rules.join_rule import JoinIndexRule
+
+        entries = self.index_collection_manager.get_indexes([States.ACTIVE])
+        # Cached entries outlive a query; tags memoize per-plan-node state and
+        # id()s can be recycled across queries, so start each pass clean.
+        for e in entries:
+            e._tags.clear()
+        plan = JoinIndexRule(self, entries).apply(plan)
+        plan = FilterIndexRule(self, entries).apply(plan)
+        return plan

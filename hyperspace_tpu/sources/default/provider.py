@@ -1,0 +1,98 @@
+"""Default file-based source: plain parquet/csv/json directories.
+
+Reference contract: sources/default/DefaultFileBasedSource.scala:37-148 and
+DefaultFileBasedRelation — supports any allow-listed format
+(HyperspaceConf.scala:93-98), signature = md5 fold over file metadata,
+listing via recursive walk.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.index.log_entry import Content, FileIdTracker, FileInfo, Relation
+from hyperspace_tpu.io.files import list_data_files
+from hyperspace_tpu.io.parquet import read_schema
+from hyperspace_tpu.plan.nodes import Scan
+from hyperspace_tpu.sources.interfaces import FileBasedRelation, FileBasedSourceProvider
+from hyperspace_tpu.utils.hashing import fold_md5
+
+
+class DefaultFileBasedRelation(FileBasedRelation):
+    def __init__(self, scan: Scan, conf: HyperspaceConf) -> None:
+        super().__init__(scan)
+        self._conf = conf
+        self._files_cache: Optional[List[FileInfo]] = None
+        self._schema_cache: Optional[Dict[str, str]] = None
+
+    def all_files(self, tracker: Optional[FileIdTracker] = None) -> List[FileInfo]:
+        # List once per relation object; registering with a tracker reuses
+        # the cached (name, size, mtime) triples instead of re-walking.
+        if self._files_cache is None:
+            self._files_cache = list_data_files(self.root_paths, None)
+        if tracker is None:
+            return self._files_cache
+        return [FileInfo(f.name, f.size, f.mtime,
+                         tracker.add_file(f.name, f.size, f.mtime))
+                for f in self._files_cache]
+
+    def schema(self) -> Dict[str, str]:
+        if self._schema_cache is None:
+            files = self.all_files()
+            if not files:
+                raise FileNotFoundError(
+                    f"No data files under {self.root_paths!r}")
+            self._schema_cache = read_schema(
+                files[0].name, self.file_format, self.options)
+        return self._schema_cache
+
+    def signature(self) -> str:
+        """md5 fold over (size, mtime, name) of all files
+        (DefaultFileBasedRelation.scala:45-52)."""
+        return fold_md5(f"{f.size}{f.mtime}{f.name}" for f in self.all_files())
+
+    def create_relation_metadata(self, tracker: FileIdTracker) -> Relation:
+        files = self.all_files(tracker)
+        return Relation(
+            root_paths=self.root_paths,
+            content=Content.from_leaf_files(files) or Content.from_directory(
+                self.root_paths[0], tracker),
+            schema=self.schema(),
+            file_format=self.file_format,
+            options=self.options,
+        )
+
+
+class DefaultFileBasedSource(FileBasedSourceProvider):
+    name = "default"
+
+    def __init__(self, conf: HyperspaceConf) -> None:
+        self._conf = conf
+
+    def _supported_formats(self) -> List[str]:
+        return [f.strip().lower() for f in self._conf.supported_file_formats.split(",")]
+
+    def is_supported_relation(self, scan: Scan) -> Optional[bool]:
+        # Index scans are "supported" too: rules re-derive signatures over
+        # rewritten plans (DefaultFileBasedSource.scala:55-68).
+        return scan.relation.file_format.lower() in self._supported_formats()
+
+    def get_relation(self, scan: Scan) -> Optional[FileBasedRelation]:
+        if not self.is_supported_relation(scan):
+            return None
+        return DefaultFileBasedRelation(scan, self._conf)
+
+    def internal_file_format_name(self, relation: Relation) -> Optional[str]:
+        if relation.file_format.lower() in self._supported_formats():
+            return relation.file_format.lower()
+        return None
+
+    def refresh_relation_metadata(self, relation: Relation) -> Optional[Relation]:
+        if relation.file_format.lower() not in self._supported_formats():
+            return None
+        return relation  # no snapshot-pinning options for plain files
+
+    def enrich_index_properties(self, relation: Relation,
+                                properties: Dict[str, str]) -> Optional[Dict[str, str]]:
+        return properties

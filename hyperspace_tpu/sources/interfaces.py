@@ -1,0 +1,89 @@
+"""Source provider abstraction: how the engine talks to concrete data
+formats/lakes.
+
+Reference contract: sources/interfaces.scala —
+  - ``FileBasedRelation`` (:43-146): wraps one plan leaf; exposes file
+    listing, signature, partition info, relation-metadata creation for the
+    log, lineage pairs, and the ``closest_index`` hook (Delta time travel).
+  - ``FileBasedSourceProvider`` (:184-234): decides whether it supports a
+    relation, reconstructs relations from logged metadata for refresh, names
+    the internal file format, and enriches index properties.
+
+Each provider answers each API with Some/None; the manager dispatches to
+exactly one (FileBasedSourceProviderManager.scala:117-155).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from hyperspace_tpu.index.log_entry import Content, FileIdTracker, FileInfo, IndexLogEntry, Relation
+from hyperspace_tpu.plan.nodes import Scan
+
+
+class FileBasedRelation:
+    """One supported leaf relation of a plan (interfaces.scala:43-146)."""
+
+    def __init__(self, scan: Scan) -> None:
+        self.scan = scan
+
+    @property
+    def root_paths(self) -> List[str]:
+        return list(self.scan.relation.root_paths)
+
+    @property
+    def file_format(self) -> str:
+        return self.scan.relation.file_format
+
+    @property
+    def options(self) -> Dict[str, str]:
+        return self.scan.relation.options_dict
+
+    def all_files(self, tracker: Optional[FileIdTracker] = None) -> List[FileInfo]:
+        """Every data file of this relation (interfaces.scala:60-66)."""
+        raise NotImplementedError
+
+    def schema(self) -> Dict[str, str]:
+        raise NotImplementedError
+
+    def signature(self) -> str:
+        """Relation-level validity signature (interfaces.scala:52-58)."""
+        raise NotImplementedError
+
+    def create_relation_metadata(self, tracker: FileIdTracker) -> Relation:
+        """Snapshot for the log entry (interfaces.scala:101-110)."""
+        raise NotImplementedError
+
+    def lineage_pairs(self, tracker: FileIdTracker) -> List[Tuple[str, int]]:
+        """(file path, file id) pairs for the lineage column
+        (interfaces.scala:120-126)."""
+        return [(f.name, f.id) for f in self.all_files(tracker)]
+
+    def closest_index(self, entry: IndexLogEntry) -> IndexLogEntry:
+        """Hook for multi-version index selection (Delta time travel,
+        interfaces.scala:138-146); default: the entry itself."""
+        return entry
+
+
+class FileBasedSourceProvider:
+    """Format plug-in (interfaces.scala:184-234)."""
+
+    name: str = ""
+
+    def is_supported_relation(self, scan: Scan) -> Optional[bool]:
+        raise NotImplementedError
+
+    def get_relation(self, scan: Scan) -> Optional[FileBasedRelation]:
+        raise NotImplementedError
+
+    def internal_file_format_name(self, relation: Relation) -> Optional[str]:
+        raise NotImplementedError
+
+    def refresh_relation_metadata(self, relation: Relation) -> Optional[Relation]:
+        """Drop snapshot-pinning options so refresh sees latest data
+        (interfaces.scala:193-201)."""
+        raise NotImplementedError
+
+    def enrich_index_properties(self, relation: Relation,
+                                properties: Dict[str, str]) -> Optional[Dict[str, str]]:
+        raise NotImplementedError

@@ -1,0 +1,39 @@
+"""Column-name resolution honoring case-insensitivity.
+
+Reference contract: util/ResolverUtils.scala:25-74 — requested column names
+resolve against the schema case-insensitively (Spark's default resolver),
+returning the schema's own spelling; unresolvable names are an error
+surfaced with the full list.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from hyperspace_tpu.exceptions import HyperspaceError
+
+
+def resolve(requested: Sequence[str], available: Iterable[str]) -> Optional[List[str]]:
+    """Resolve all of ``requested`` against ``available`` (case-insensitive);
+    None if any fail."""
+    lookup: Dict[str, str] = {}
+    for name in available:
+        lookup.setdefault(name.lower(), name)
+    out: List[str] = []
+    for name in requested:
+        hit = lookup.get(name.lower())
+        if hit is None:
+            return None
+        out.append(hit)
+    return out
+
+
+def resolve_or_raise(requested: Sequence[str], available: Iterable[str],
+                     what: str = "column") -> List[str]:
+    available = list(available)
+    resolved = resolve(requested, available)
+    if resolved is None:
+        missing = [n for n in requested if resolve([n], available) is None]
+        raise HyperspaceError(
+            f"Could not resolve {what}(s) {missing} against schema {available}")
+    return resolved

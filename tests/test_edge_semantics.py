@@ -1,0 +1,136 @@
+"""Regression tests for SQL-semantics edges: literal typing in bucket
+pruning, null handling on the device path, empty-bucket lookups, null join
+keys, and lineage-column hygiene."""
+
+import os
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
+
+
+@pytest.fixture()
+def env(tmp_path):
+    data = str(tmp_path / "d")
+    os.makedirs(data)
+    pq.write_table(pa.table({
+        "price": [100.0, 5.5, 17.0, 250.0, None],
+        "x": pa.array([1, None, -5, 3, 0], type=pa.int64()),
+        "name": ["a", "b", "c", "d", "e"],
+    }), os.path.join(data, "f.parquet"))
+    session = HyperspaceSession(system_path=str(tmp_path / "idx"))
+    session.conf.num_buckets = 64
+    return session, Hyperspace(session), data
+
+
+def test_int_literal_probes_float_indexed_column(env):
+    session, hs, data = env
+    hs.create_index(session.read.parquet(data), IndexConfig("pidx", ["price"], ["name"]))
+    session.enable_hyperspace()
+    r = session.read.parquet(data).filter(col("price") == 100) \
+        .select("price", "name").collect()
+    assert r.to_pylist() == [{"price": 100.0, "name": "a"}]
+
+
+def test_null_rows_never_match_equality(env):
+    session, hs, data = env
+    hs.create_index(session.read.parquet(data), IndexConfig("xidx", ["x"], ["name"]))
+    session.enable_hyperspace()
+    r = session.read.parquet(data).filter(col("x") == 0).select("x", "name").collect()
+    assert r.to_pylist() == [{"x": 0, "name": "e"}]
+
+
+def test_absent_key_empty_bucket_returns_empty(env):
+    session, hs, data = env
+    hs.create_index(session.read.parquet(data), IndexConfig("xidx", ["x"], ["name"]))
+    session.enable_hyperspace()
+    r = session.read.parquet(data).filter(col("x") == 777).select("x", "name").collect()
+    assert r.num_rows == 0
+    assert set(r.column_names) == {"x", "name"}
+
+
+def test_null_join_keys_do_not_match(env, tmp_path):
+    session, hs, data = env
+    d2 = str(tmp_path / "d2")
+    os.makedirs(d2)
+    pq.write_table(pa.table({
+        "x": pa.array([None, 3, 1], type=pa.int64()),
+        "z": ["n", "t", "o"],
+    }), os.path.join(d2, "g.parquet"))
+    l = session.read.parquet(data).select("x", "name")
+    r = session.read.parquet(d2).select("x", "z")
+    out = l.join(r, col("x") == col("x")).select("name", "z").collect()
+    assert sorted(map(tuple, (tuple(row.values()) for row in out.to_pylist()))) == \
+        [("a", "o"), ("d", "t")]
+
+
+def test_lineage_never_leaks_without_select(env, tmp_path):
+    session, hs, data = env
+    session.conf.lineage_enabled = True
+    hs.create_index(session.read.parquet(data), IndexConfig("lidx", ["x"], ["name", "price"]))
+    session.enable_hyperspace()
+    q = session.read.parquet(data).filter(col("x") >= -100)
+    plan = q.optimized_plan()
+    assert "Hyperspace" in plan.tree_string()
+    out = q.collect()
+    assert "_data_file_id" not in out.column_names
+
+
+def test_date_column_index_and_literal_filter(tmp_path):
+    import datetime
+
+    data = str(tmp_path / "dates")
+    os.makedirs(data)
+    days = [datetime.date(2024, 1, d) for d in (1, 2, 3, 1, 2)]
+    pq.write_table(pa.table({
+        "d": pa.array(days, type=pa.date32()),
+        "v": [10, 20, 30, 40, 50],
+    }), os.path.join(data, "f.parquet"))
+    session = HyperspaceSession(system_path=str(tmp_path / "idx"))
+    session.conf.num_buckets = 8
+    hs = Hyperspace(session)
+    hs.create_index(session.read.parquet(data), IndexConfig("didx", ["d"], ["v"]))
+    session.enable_hyperspace()
+    q = lambda: session.read.parquet(data) \
+        .filter(col("d") == datetime.date(2024, 1, 1)).select("d", "v")
+    session.disable_hyperspace()
+    expected = q().collect()
+    session.enable_hyperspace()
+    plan = q().optimized_plan()
+    assert "Hyperspace" in plan.tree_string()
+    got = q().collect()
+    assert sorted(got.column("v").to_pylist()) == sorted(expected.column("v").to_pylist()) == [10, 40]
+
+
+def test_date_column_with_nulls_indexes_cleanly(tmp_path):
+    import datetime
+
+    data = str(tmp_path / "dates2")
+    os.makedirs(data)
+    pq.write_table(pa.table({
+        "d": pa.array([datetime.date(2024, 1, 1), None, datetime.date(2024, 1, 3)],
+                      type=pa.date32()),
+        "v": [1, 2, 3],
+    }), os.path.join(data, "f.parquet"))
+    session = HyperspaceSession(system_path=str(tmp_path / "idx"))
+    session.conf.num_buckets = 4
+    hs = Hyperspace(session)
+    hs.create_index(session.read.parquet(data), IndexConfig("didx", ["d"], ["v"]))
+    session.enable_hyperspace()
+    got = session.read.parquet(data) \
+        .filter(col("d") == datetime.date(2024, 1, 3)).select("v").collect()
+    assert got.column("v").to_pylist() == [3]
+
+
+def test_constant_predicate_routes_to_host(tmp_path):
+    from hyperspace_tpu import lit
+
+    data = str(tmp_path / "c")
+    os.makedirs(data)
+    pq.write_table(pa.table({"a": [1, 2]}), os.path.join(data, "f.parquet"))
+    session = HyperspaceSession(system_path=str(tmp_path / "idx"))
+    ds = session.read.parquet(data)
+    assert ds.filter(lit(1) == lit(2)).collect().num_rows == 0
+    assert ds.filter(lit("a") == lit("a")).collect().num_rows == 2

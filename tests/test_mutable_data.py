@@ -1,0 +1,235 @@
+"""Mutable-data tests: refresh full/incremental/quick, hybrid scan, optimize.
+
+Mirrors RefreshIndexTest.scala (494 LoC), HybridScanSuite.scala:35-215
+(setupIndexAndChangeData / checkDeletedFiles idioms), OptimizeActionTest.
+"""
+
+import os
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
+from hyperspace_tpu.exceptions import HyperspaceError
+from tests.utils import SAMPLE_ROWS, write_sample_parquet
+
+
+@pytest.fixture()
+def env(tmp_path):
+    data_dir = str(tmp_path / "data")
+    write_sample_parquet(data_dir, n_files=2)
+    session = HyperspaceSession(system_path=str(tmp_path / "indexes"))
+    session.conf.num_buckets = 4
+    hs = Hyperspace(session)
+    return session, hs, data_dir
+
+
+def _append_file(data_dir, ids=(111, 222)):
+    path = os.path.join(data_dir, f"appended-{len(ids)}-{ids[0]}.parquet")
+    pq.write_table(pa.table({
+        "date": ["2020-01-01"] * len(ids),
+        "hour": [1] * len(ids),
+        "id": list(ids),
+        "name": ["zzz"] * len(ids),
+        "other": [0] * len(ids),
+    }), path)
+    return path
+
+
+def _index_scans(plan):
+    return [s for s in plan.leaf_relations() if s.relation.index_scan_of]
+
+
+def _rows(table):
+    return sorted(zip(*[table.column(c).to_pylist() for c in table.column_names]),
+                  key=repr)
+
+
+def test_refresh_full_revalidates_index(env):
+    session, hs, data_dir = env
+    hs.create_index(session.read.parquet(data_dir), IndexConfig("idx", ["id"], ["name"]))
+    session.enable_hyperspace()
+    q = lambda: session.read.parquet(data_dir).filter(col("id") == 111).select("id", "name")
+    _append_file(data_dir)
+    assert not _index_scans(q().optimized_plan())  # stale
+
+    hs.refresh_index("idx", "full")
+    plan = q().optimized_plan()
+    assert _index_scans(plan)
+    got = q().collect()
+    assert got.num_rows == 1
+    assert got.column("name").to_pylist() == ["zzz"]
+
+
+def test_refresh_noop_when_unchanged(env):
+    session, hs, data_dir = env
+    hs.create_index(session.read.parquet(data_dir), IndexConfig("idx", ["id"], ["name"]))
+    mgr = hs.index_manager
+    before = mgr.get_index("idx").id
+    hs.refresh_index("idx", "full")  # NoChangesError swallowed as no-op
+    assert mgr.get_index("idx").id == before
+
+
+def test_refresh_incremental_appends(env):
+    session, hs, data_dir = env
+    hs.create_index(session.read.parquet(data_dir), IndexConfig("idx", ["id"], ["name"]))
+    entry0 = hs.index_manager.get_index("idx")
+    n_files_0 = len(entry0.content.file_infos())
+    _append_file(data_dir)
+    hs.refresh_index("idx", "incremental")
+    entry1 = hs.index_manager.get_index("idx")
+    # Old index files retained (content merge), new version files added.
+    assert len(entry1.content.file_infos()) > n_files_0
+    session.enable_hyperspace()
+    q = session.read.parquet(data_dir).filter(col("id") == 111).select("id", "name")
+    assert _index_scans(q.optimized_plan())
+    assert q.collect().num_rows == 1
+
+
+def test_refresh_incremental_deletes_require_lineage(env):
+    session, hs, data_dir = env
+    hs.create_index(session.read.parquet(data_dir), IndexConfig("idx", ["id"], ["name"]))
+    files = sorted(os.listdir(data_dir))
+    os.remove(os.path.join(data_dir, files[0]))
+    with pytest.raises(HyperspaceError):
+        hs.refresh_index("idx", "incremental")
+
+
+def test_refresh_incremental_with_deletes_and_lineage(env):
+    session, hs, data_dir = env
+    session.conf.lineage_enabled = True
+    hs.create_index(session.read.parquet(data_dir), IndexConfig("idx", ["id"], ["name"]))
+    # Delete one source file, append another.
+    files = sorted(f for f in os.listdir(data_dir) if f.startswith("part"))
+    os.remove(os.path.join(data_dir, files[0]))
+    _append_file(data_dir)
+    hs.refresh_index("idx", "incremental")
+
+    session.enable_hyperspace()
+    q = lambda: session.read.parquet(data_dir).filter(col("id") >= 0).select("id", "name")
+    session.disable_hyperspace()
+    expected = q().collect()
+    session.enable_hyperspace()
+    plan = q().optimized_plan()
+    assert _index_scans(plan)
+    actual = q().collect()
+    assert _rows(actual) == _rows(expected)
+    # Lineage column never leaks into results.
+    assert "_data_file_id" not in actual.column_names
+
+
+def test_quick_refresh_defers_to_hybrid_scan(env):
+    session, hs, data_dir = env
+    hs.create_index(session.read.parquet(data_dir), IndexConfig("idx", ["id"], ["name"]))
+    _append_file(data_dir)
+    hs.refresh_index("idx", "quick")
+    entry = hs.index_manager.get_index("idx")
+    assert entry.has_source_update()
+    assert len(entry.appended_files()) == 1
+
+    q = lambda: session.read.parquet(data_dir).filter(col("id") == 111).select("id", "name")
+    # Without hybrid scan: quick-refreshed index is NOT used (data is stale).
+    session.enable_hyperspace()
+    assert not _index_scans(q().optimized_plan())
+    # With hybrid scan (thresholds widened for the tiny test files, the
+    # reference's TestConfig idiom): used, and appended rows appear.
+    session.conf.hybrid_scan_enabled = True
+    session.conf.hybrid_scan_max_appended_ratio = 0.9
+    plan = q().optimized_plan()
+    assert _index_scans(plan)
+    got = q().collect()
+    assert got.num_rows == 1
+    assert got.column("name").to_pylist() == ["zzz"]
+
+
+def test_hybrid_scan_without_refresh(env):
+    """Appended files within ratio → index still used via hybrid scan."""
+    session, hs, data_dir = env
+    hs.create_index(session.read.parquet(data_dir), IndexConfig("idx", ["id"], ["name"]))
+    _append_file(data_dir, ids=(111,))
+    session.conf.hybrid_scan_enabled = True
+    session.conf.hybrid_scan_max_appended_ratio = 0.9
+    session.enable_hyperspace()
+    q = lambda: session.read.parquet(data_dir).filter(col("id") >= 0).select("id", "name")
+    session.disable_hyperspace()
+    expected = q().collect()
+    session.enable_hyperspace()
+    plan = q().optimized_plan()
+    assert _index_scans(plan)
+    assert _rows(q().collect()) == _rows(expected)
+
+
+def test_hybrid_scan_deleted_files_lineage(env):
+    session, hs, data_dir = env
+    session.conf.lineage_enabled = True
+    hs.create_index(session.read.parquet(data_dir), IndexConfig("idx", ["id"], ["name"]))
+    files = sorted(f for f in os.listdir(data_dir) if f.startswith("part"))
+    os.remove(os.path.join(data_dir, files[-1]))
+    session.conf.hybrid_scan_enabled = True
+    session.conf.hybrid_scan_max_deleted_ratio = 0.9
+    session.enable_hyperspace()
+    q = lambda: session.read.parquet(data_dir).filter(col("id") >= 0).select("id", "name")
+    session.disable_hyperspace()
+    expected = q().collect()
+    session.enable_hyperspace()
+    plan = q().optimized_plan()
+    assert _index_scans(plan)
+    actual = q().collect()
+    assert _rows(actual) == _rows(expected)
+    assert "_data_file_id" not in actual.column_names
+
+
+def test_hybrid_scan_ratio_threshold(env):
+    session, hs, data_dir = env
+    hs.create_index(session.read.parquet(data_dir), IndexConfig("idx", ["id"], ["name"]))
+    session.conf.hybrid_scan_enabled = True
+    session.conf.hybrid_scan_max_appended_ratio = 0.0001
+    _append_file(data_dir)
+    session.enable_hyperspace()
+    plan = session.read.parquet(data_dir).filter(col("id") == 1) \
+        .select("id", "name").optimized_plan()
+    assert not _index_scans(plan)  # over threshold → no candidate
+
+
+def test_optimize_compacts_bucket_files(env):
+    session, hs, data_dir = env
+    hs.create_index(session.read.parquet(data_dir), IndexConfig("idx", ["id"], ["name"]))
+    _append_file(data_dir, ids=(111,))
+    hs.refresh_index("idx", "incremental")
+    _append_file(data_dir, ids=(222, 333))
+    hs.refresh_index("idx", "incremental")
+    entry = hs.index_manager.get_index("idx")
+    n_before = len(entry.content.file_infos())
+
+    hs.optimize_index("idx", "quick")
+    entry2 = hs.index_manager.get_index("idx")
+    n_after = len(entry2.content.file_infos())
+    assert n_after < n_before
+    # Data still correct after compaction.
+    session.enable_hyperspace()
+    q = lambda: session.read.parquet(data_dir).filter(col("id") >= 0).select("id", "name")
+    session.disable_hyperspace()
+    expected = q().collect()
+    session.enable_hyperspace()
+    assert _index_scans(q().optimized_plan())
+    assert _rows(q().collect()) == _rows(expected)
+
+
+def test_optimize_noop_when_single_files(env):
+    session, hs, data_dir = env
+    hs.create_index(session.read.parquet(data_dir), IndexConfig("idx", ["id"], ["name"]))
+    before = hs.index_manager.get_index("idx").id
+    hs.optimize_index("idx", "quick")  # nothing to merge → no-op
+    assert hs.index_manager.get_index("idx").id == before
+
+
+def test_explain_lists_indexes(env):
+    session, hs, data_dir = env
+    hs.create_index(session.read.parquet(data_dir), IndexConfig("idx", ["id"], ["name"]))
+    q = session.read.parquet(data_dir).filter(col("id") == 1).select("id", "name")
+    out = hs.explain(q, verbose=True)
+    assert "idx" in out
+    assert "Plan with indexes" in out
+    assert "Physical operator stats" in out
+    assert "Hyperspace(Type: CI, Name: idx)" in out
