@@ -131,18 +131,42 @@ class CreateActionBase(Action):
         table = pa.concat_tables(tables, promote_options="default")
         self._write_table_bucketed(table, resolved)
 
+    def _use_distributed_build(self) -> bool:
+        import jax
+
+        mode = str(self.conf.parallel_build).lower()
+        if mode in ("on", "true"):
+            return True
+        if mode in ("off", "false"):
+            return False
+        if mode != "auto":
+            raise HyperspaceError(
+                f"Invalid {self.conf.parallel_build!r} for parallel_build; "
+                f"expected 'auto', 'on', or 'off'")
+        return len(jax.devices()) > 1
+
     def _write_table_bucketed(self, table: pa.Table, resolved: IndexConfig,
                               version: Optional[int] = None) -> None:
-        from hyperspace_tpu.ops.sort import bucket_sort_permutation
+        if self._use_distributed_build():
+            from hyperspace_tpu.parallel import (
+                build_mesh,
+                distributed_bucket_sort_permutation,
+            )
 
-        word_cols = [columnar.to_hash_words(table.column(c))
-                     for c in resolved.indexed_columns]
-        order_keys = [columnar.to_order_key(table.column(c))
-                      for c in resolved.indexed_columns]
-        buckets, perm = bucket_sort_permutation(
-            [np.asarray(w) for w in word_cols],
-            [np.asarray(k) for k in order_keys],
-            self.num_buckets)
+            buckets, perm = distributed_bucket_sort_permutation(
+                table, resolved.indexed_columns, self.num_buckets,
+                build_mesh(), slack=self.conf.shuffle_capacity_slack)
+        else:
+            from hyperspace_tpu.ops.sort import bucket_sort_permutation
+
+            word_cols = [columnar.to_hash_words(table.column(c))
+                         for c in resolved.indexed_columns]
+            order_words = [columnar.to_order_words(table.column(c))
+                           for c in resolved.indexed_columns]
+            buckets, perm = bucket_sort_permutation(
+                [np.asarray(w) for w in word_cols],
+                [np.asarray(k) for k in order_words],
+                self.num_buckets)
         version = self.data_manager.get_next_version() if version is None else version
         out_dir = self.data_manager.version_path(version)
         write_bucketed(table, np.asarray(buckets), np.asarray(perm),

@@ -28,6 +28,8 @@ SIGNATURE_PROVIDER = "hyperspace.index.signatureProvider"
 EVENT_LOGGER = "hyperspace.eventLoggerClass"
 SUPPORTED_FILE_FORMATS = "hyperspace.index.supportedFileFormats"
 DEVICE_BATCH_ROWS = "hyperspace.tpu.deviceBatchRows"
+PARALLEL_BUILD = "hyperspace.tpu.parallelBuild"
+SHUFFLE_CAPACITY_SLACK = "hyperspace.tpu.shuffleCapacitySlack"
 
 _DEFAULT_NUM_BUCKETS = 200  # IndexConstants.scala:31-32 (spark.sql.shuffle.partitions default)
 
@@ -62,6 +64,12 @@ class HyperspaceConf:
     # XLA shapes static (arrays are padded to this size) so kernels hit the
     # compile cache across files of different sizes.
     device_batch_rows: int = 1 << 20
+    # Distributed build over the device mesh: "auto" uses it when more than
+    # one accelerator is visible; "on"/"off" force it.  The shuffle uses
+    # capacity-padded all_to_all; slack is the initial headroom factor over
+    # the perfectly-balanced per-destination row count (doubled on overflow).
+    parallel_build: str = "auto"
+    shuffle_capacity_slack: float = 1.5
 
     _FIELD_BY_KEY = {
         SYSTEM_PATH: "system_path",
@@ -78,6 +86,8 @@ class HyperspaceConf:
         EVENT_LOGGER: "event_logger",
         SUPPORTED_FILE_FORMATS: "supported_file_formats",
         DEVICE_BATCH_ROWS: "device_batch_rows",
+        PARALLEL_BUILD: "parallel_build",
+        SHUFFLE_CAPACITY_SLACK: "shuffle_capacity_slack",
     }
 
     def set(self, key: str, value: Any) -> None:

@@ -126,13 +126,18 @@ class Executor:
         return False
 
     def _eval_device(self, expr: Expr, table: pa.Table) -> np.ndarray:
+        import jax
+
         from hyperspace_tpu.ops.filter import compile_predicate
 
         order = sorted(expr.referenced_columns())
         norm = self._normalize_literals(expr, table)
         fn, literals = compile_predicate(norm, order)
         device_cols = [columnar.to_device_numeric(table.column(c)) for c in order]
-        mask = fn(device_cols, literals)
+        # Scoped x64 so int64 columns keep full width on device (global x64
+        # would leak dtype defaults into the embedding application's JAX).
+        with jax.enable_x64():
+            mask = fn(device_cols, literals)
         return np.asarray(mask)
 
     def _normalize_literals(self, expr: Expr, table: pa.Table) -> Expr:

@@ -93,9 +93,7 @@ def to_hash_words(column: "pa.ChunkedArray | pa.Array") -> np.ndarray:
 
         arr = column.to_numpy(zero_copy_only=False)
         bits = pandas.util.hash_array(np.asarray(arr, dtype=object))
-    out = np.empty((len(bits), 2), dtype=np.uint32)
-    out[:, 0] = (bits >> np.uint64(32)).astype(np.uint32)
-    out[:, 1] = (bits & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    out = split_words64(bits.view(np.uint64) if bits.dtype != np.uint64 else bits)
     if nulls is not None:
         out[nulls, 0] = _NULL_WORDS[0]
         out[nulls, 1] = _NULL_WORDS[1]
@@ -118,6 +116,44 @@ def to_order_key(column: "pa.ChunkedArray | pa.Array") -> np.ndarray:
     arr = column.to_numpy(zero_copy_only=False)
     _, inverse = np.unique(np.asarray(arr, dtype=object), return_inverse=True)
     return inverse.astype(np.int64)
+
+
+def _monotone_uint64(keys: np.ndarray) -> np.ndarray:
+    """Order-preserving map of an int64/float64 key array into uint64.
+
+    int64: flip the sign bit.  float64: IEEE total-order trick (non-negative
+    floats get the sign bit set; negative floats are bit-inverted), which
+    ranks -0.0 immediately below +0.0 — an unobservable layout property
+    (within-bucket sort order, see ``to_order_key``).
+    """
+    if keys.dtype == np.float64:
+        bits = keys.view(np.int64)
+        return np.where(bits >= 0,
+                        bits.view(np.uint64) + np.uint64(1 << 63),
+                        ~bits.view(np.uint64))
+    assert keys.dtype == np.int64, keys.dtype
+    return keys.view(np.uint64) ^ np.uint64(1 << 63)
+
+
+def split_words64(values: np.ndarray) -> np.ndarray:
+    """(n,) uint64 → (n, 2) uint32 (hi, lo) — the ONE word layout shared by
+    hash words, order words, and the shuffle's row-id words."""
+    out = np.empty((len(values), 2), dtype=np.uint32)
+    out[:, 0] = (values >> np.uint64(32)).astype(np.uint32)
+    out[:, 1] = (values & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    return out
+
+
+def join_words64(hi: np.ndarray, lo: np.ndarray) -> np.ndarray:
+    """Inverse of ``split_words64``."""
+    return (hi.astype(np.uint64) << np.uint64(32)) | lo.astype(np.uint64)
+
+
+def to_order_words(column: "pa.ChunkedArray | pa.Array") -> np.ndarray:
+    """(n, 2) uint32 monotone words: lexicographic (hi, lo) order equals the
+    column's value order.  This keeps the sort kernel pure 32-bit — TPU's
+    native lane width — instead of relying on x64 int64 emulation."""
+    return split_words64(_monotone_uint64(to_order_key(column)))
 
 
 def to_device_numeric(column: "pa.ChunkedArray | pa.Array") -> Optional[np.ndarray]:

@@ -52,14 +52,18 @@ def sorted_equi_join(left_keys: np.ndarray, right_keys: np.ndarray
     Returns (left_indices, right_indices) into the ORIGINAL (unsorted)
     inputs.  Right side is sorted on device; left side order is preserved.
     """
-    lk = jnp.asarray(left_keys)
-    rk = jnp.asarray(right_keys)
-    r_perm = jnp.argsort(rk)
-    rk_sorted = rk[r_perm]
-    lo, hi = _match_ranges(lk, rk_sorted)
-    total = int(jnp.sum(hi - lo))  # host sync: the one dynamic-shape point
-    if total == 0:
-        return np.empty(0, dtype=np.int64), np.empty(0, dtype=np.int64)
-    left_idx, right_pos = _expand(lo, hi, total)
-    right_idx = r_perm[right_pos]
-    return np.asarray(left_idx), np.asarray(right_idx)
+    # Scoped x64: int64 keys (TPC-H orderkey at SF100 exceeds 2^31) must not
+    # truncate inside jnp.asarray, but flipping x64 globally would change
+    # dtype defaults for every other JAX user in the process.
+    with jax.enable_x64():
+        lk = jnp.asarray(left_keys)
+        rk = jnp.asarray(right_keys)
+        r_perm = jnp.argsort(rk)
+        rk_sorted = rk[r_perm]
+        lo, hi = _match_ranges(lk, rk_sorted)
+        total = int(jnp.sum(hi - lo))  # host sync: the one dynamic-shape point
+        if total == 0:
+            return np.empty(0, dtype=np.int64), np.empty(0, dtype=np.int64)
+        left_idx, right_pos = _expand(lo, hi, total)
+        right_idx = r_perm[right_pos]
+        return np.asarray(left_idx), np.asarray(right_idx)

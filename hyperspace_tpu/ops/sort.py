@@ -8,9 +8,10 @@ XLA program: hash → lexicographic sort by (bucket, key columns) → output a
 gather permutation.  The host then applies the permutation to the arrow
 table (zero-copy take) and slices per-bucket runs for the writer.
 
-Sort keys are normalized host-side to numeric arrays (order-preserving ranks
-for strings, hyperspace_tpu.io.columnar.to_order_key), so the kernel is
-dtype-monomorphic like the hash kernel.
+All kernel inputs are uint32 words (hash words from
+``hyperspace_tpu.io.columnar.to_hash_words``; monotone order words from
+``to_order_words``): the kernel is dtype-monomorphic AND pure 32-bit, so it
+never leans on x64 int64 emulation — TPU's VPU lanes are 32-bit native.
 """
 
 from __future__ import annotations
@@ -27,25 +28,30 @@ from hyperspace_tpu.ops.hash import combine_hashes
 @partial(jax.jit, static_argnames=("num_buckets",))
 def bucket_sort_permutation(
     word_cols: Sequence[jnp.ndarray],
-    order_keys: Sequence[jnp.ndarray],
+    order_words: Sequence[jnp.ndarray],
     num_buckets: int,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Fused hash + sort kernel.
 
     Args:
       word_cols: per key column (n, 2) uint32 hash words.
-      order_keys: per key column (n,) numeric ordering keys.
+      order_words: per key column (n, 2) uint32 monotone order words.
       num_buckets: static bucket count.
 
     Returns:
       (bucket_ids int32 (n,), perm int32 (n,)) where perm orders rows by
-      (bucket, *order_keys) — ready for ``write_bucketed``.
+      (bucket, *key columns) — ready for ``write_bucketed``.
     """
     h = combine_hashes(word_cols)
     buckets = (h % jnp.uint32(num_buckets)).astype(jnp.int32)
-    # lexsort: last key is the primary. Order: bucket first, then keys.
-    keys = tuple(reversed(order_keys)) + (buckets,)
-    perm = jnp.lexsort(keys).astype(jnp.int32)
+    # jnp.lexsort: LAST key is the primary.  Order: bucket first, then key
+    # columns in config order, each (hi, lo) word pair hi-major.
+    keys = []
+    for w in reversed(order_words):
+        keys.append(w[:, 1])
+        keys.append(w[:, 0])
+    keys.append(buckets)
+    perm = jnp.lexsort(tuple(keys)).astype(jnp.int32)
     return buckets, perm
 
 

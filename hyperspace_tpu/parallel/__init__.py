@@ -1,0 +1,25 @@
+"""Distributed data plane: mesh construction, the all_to_all bucket shuffle,
+and the zero-communication co-partitioned join.
+
+This package replaces the reference's Spark-cluster distribution substrate
+(driver-planned shuffles over the TCP block manager, SURVEY.md §2.4) with
+``jax.sharding.Mesh`` + ``shard_map`` + XLA collectives riding ICI/DCN.
+"""
+
+from hyperspace_tpu.parallel.build import distributed_bucket_sort_permutation
+from hyperspace_tpu.parallel.join import (
+    copartitioned_join,
+    copartitioned_join_ragged,
+)
+from hyperspace_tpu.parallel.mesh import SHARD_AXIS, build_mesh
+from hyperspace_tpu.parallel.shuffle import ShuffleResult, bucket_shuffle
+
+__all__ = [
+    "SHARD_AXIS",
+    "build_mesh",
+    "bucket_shuffle",
+    "ShuffleResult",
+    "distributed_bucket_sort_permutation",
+    "copartitioned_join",
+    "copartitioned_join_ragged",
+]

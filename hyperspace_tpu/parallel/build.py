@@ -1,0 +1,45 @@
+"""Distributed covering-index build over the device mesh.
+
+Single chip, the build is one fused kernel (ops/sort.bucket_sort_permutation).
+Across a mesh it becomes: shard rows over devices → hash → all_to_all bucket
+shuffle → per-device lexsort (parallel/shuffle.py) — the direct analog of
+Spark's scan + hash-shuffle + per-task sort (actions/CreateActionBase.scala:
+124-142), with ICI in place of the TCP shuffle service (SURVEY.md §2.4).
+
+The host-facing contract matches the single-chip kernel: a (bucket_ids,
+perm) pair feeding ``io.parquet.write_bucketed``, so the action layer is
+agnostic to how many chips did the work.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from hyperspace_tpu.io import columnar
+from hyperspace_tpu.parallel.shuffle import bucket_shuffle
+
+
+def distributed_bucket_sort_permutation(
+    table: pa.Table,
+    indexed_columns: Sequence[str],
+    num_buckets: int,
+    mesh,
+    slack: float = 1.5,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """(bucket_ids, perm) for ``table`` computed over ``mesh``.
+
+    Equivalent ordering contract to ``ops.sort.bucket_sort_permutation``:
+    ``perm`` orders rows by (bucket, indexed columns); ``bucket_ids`` are
+    per-row (pre-permutation) bucket assignments.
+    """
+    hash_words = [columnar.to_hash_words(table.column(c)) for c in indexed_columns]
+    order_words = [columnar.to_order_words(table.column(c)) for c in indexed_columns]
+    result, _ = bucket_shuffle(hash_words, order_words, num_buckets, mesh,
+                               slack=slack)
+    n = table.num_rows
+    bucket_ids = np.empty(n, dtype=np.int32)
+    bucket_ids[result.perm] = result.buckets_sorted
+    return bucket_ids, result.perm

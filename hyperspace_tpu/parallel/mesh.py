@@ -1,0 +1,33 @@
+"""Device-mesh construction for the distributed data plane.
+
+The reference's distribution substrate is the Spark cluster (driver plans,
+executors shuffle over TCP — SURVEY.md §2.4); ours is a
+``jax.sharding.Mesh`` whose collectives ride ICI within a slice and DCN
+across slices.  One axis name is used throughout the engine:
+
+  - ``"shard"`` — the data axis.  Rows are sharded over it during the build
+    scan; buckets are range-partitioned over it after the shuffle, and index
+    shards stay aligned to it so the bucketed join needs no communication.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+SHARD_AXIS = "shard"
+
+
+def build_mesh(n_devices: Optional[int] = None,
+               devices: Optional[Sequence] = None) -> Mesh:
+    """A 1-D mesh over the first ``n_devices`` visible devices (all by
+    default).  Multi-host: ``jax.devices()`` already enumerates the full
+    slice, so the same call scales from one chip to a pod."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (SHARD_AXIS,))

@@ -9,10 +9,18 @@ Environment must be set before jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when a real TPU is attached: the suite needs a deterministic
+# 8-device mesh (bench.py is what exercises the real chip).  The platform is
+# pinned via jax.config, not JAX_PLATFORMS, because the environment's TPU
+# tunnel re-sets the env var at interpreter startup.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 

@@ -51,7 +51,7 @@ def test_bucket_sort_permutation_orders_by_bucket_then_key():
     rng = np.random.default_rng(0)
     vals = rng.integers(0, 1000, size=5000)
     words = _words(vals)
-    keys = columnar.to_order_key(pa.array(vals))
+    keys = columnar.to_order_words(pa.array(vals))
     buckets, perm = bucket_sort_permutation([words], [keys], 8)
     buckets, perm = np.asarray(buckets), np.asarray(perm)
     sorted_buckets = buckets[perm]
@@ -64,6 +64,18 @@ def test_bucket_sort_permutation_orders_by_bucket_then_key():
     counts = np.asarray(bucket_counts(buckets, 8))
     assert counts.sum() == 5000
     assert (counts == np.bincount(buckets, minlength=8)).all()
+
+
+def test_order_words_monotone_over_int_and_float():
+    """(hi, lo) uint32 word pairs must order exactly like the values — the
+    32-bit representation that keeps the sort kernel off x64 emulation."""
+    for vals in (
+        pa.array([-(2**62), -5, -1, 0, 1, 7, 2**40, 2**62]),
+        pa.array([-1e300, -2.5, -0.0, 0.0, 1e-9, 3.14, 1e300]),
+    ):
+        w = columnar.to_order_words(vals)
+        as_u64 = (w[:, 0].astype(np.uint64) << np.uint64(32)) | w[:, 1]
+        assert (np.diff(as_u64.astype(object)) >= 0).all()
 
 
 def test_string_order_key_preserves_order():
