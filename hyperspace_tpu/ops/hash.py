@@ -22,10 +22,14 @@ from typing import Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-_C1 = jnp.uint32(0x85EBCA6B)
-_C2 = jnp.uint32(0xC2B2AE35)
-_SEED = jnp.uint32(0x3C074A61)
+# numpy scalars, NOT jnp: a module-scope jnp constant would initialize the
+# JAX backend at import time — with a TPU attached over a tunnel that is a
+# multi-second (or, tunnel down, hanging) import of the whole package.
+_C1 = np.uint32(0x85EBCA6B)
+_C2 = np.uint32(0xC2B2AE35)
+_SEED = np.uint32(0x3C074A61)
 
 
 def _fmix32(h: jnp.ndarray) -> jnp.ndarray:

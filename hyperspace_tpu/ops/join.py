@@ -23,6 +23,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from hyperspace_tpu.utils.shapes import round_up_pow2
 
 
 @jax.jit
@@ -33,14 +34,20 @@ def _match_ranges(left_keys: jnp.ndarray, right_keys_sorted: jnp.ndarray
     return lo, hi
 
 
-@partial(jax.jit, static_argnames=("total",))
-def _expand(lo: jnp.ndarray, hi: jnp.ndarray, total: int
+@partial(jax.jit, static_argnames=("capacity",))
+def _expand(lo: jnp.ndarray, hi: jnp.ndarray, capacity: int
             ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    # ``capacity`` is the match count rounded UP to a power of two (caller
+    # slices to the true count): the static output shape must not track the
+    # exact count or every distinct query result size costs a fresh XLA
+    # compile — ruinous over a real-chip tunnel at 20-40 s per compile.
     counts = hi - lo
-    left_idx = jnp.repeat(jnp.arange(lo.shape[0]), counts, total_repeat_length=total)
+    left_idx = jnp.repeat(jnp.arange(lo.shape[0]), counts,
+                          total_repeat_length=capacity)
     # Offset of each output row within its left-row group.
     starts = jnp.cumsum(counts) - counts
-    within = jnp.arange(total) - jnp.repeat(starts, counts, total_repeat_length=total)
+    within = jnp.arange(capacity) - jnp.repeat(starts, counts,
+                                               total_repeat_length=capacity)
     right_pos = lo[left_idx] + within
     return left_idx, right_pos
 
@@ -52,9 +59,26 @@ def sorted_equi_join(left_keys: np.ndarray, right_keys: np.ndarray
     Returns (left_indices, right_indices) into the ORIGINAL (unsorted)
     inputs.  Right side is sorted on device; left side order is preserved.
     """
-    # Scoped x64: int64 keys (TPC-H orderkey at SF100 exceeds 2^31) must not
-    # truncate inside jnp.asarray, but flipping x64 globally would change
-    # dtype defaults for every other JAX user in the process.
+    # Narrow integer keys to int32 when every value fits: TPU has no native
+    # int64 (XLA emulates it as two u32 passes), so a 32-bit sort/searchsorted
+    # is the fast path.  Keys that genuinely need 64 bits (TPC-H orderkey at
+    # SF100 exceeds 2^31) take the scoped-x64 path — scoped, not global,
+    # because flipping x64 globally would change dtype defaults for every
+    # other JAX user in the process.
+    left_keys = np.asarray(left_keys)
+    right_keys = np.asarray(right_keys)
+    if (np.issubdtype(left_keys.dtype, np.integer)
+            and np.issubdtype(right_keys.dtype, np.integer)
+            and left_keys.size and right_keys.size):
+
+        def fits32(a: np.ndarray) -> bool:
+            if np.can_cast(a.dtype, np.int32):
+                return True  # dtype already guarantees it: skip the scan
+            return bool(a.min() >= -2**31 and a.max() <= 2**31 - 1)
+
+        if fits32(left_keys) and fits32(right_keys):
+            left_keys = left_keys.astype(np.int32, copy=False)
+            right_keys = right_keys.astype(np.int32, copy=False)
     with jax.enable_x64():
         lk = jnp.asarray(left_keys)
         rk = jnp.asarray(right_keys)
@@ -64,6 +88,7 @@ def sorted_equi_join(left_keys: np.ndarray, right_keys: np.ndarray
         total = int(jnp.sum(hi - lo))  # host sync: the one dynamic-shape point
         if total == 0:
             return np.empty(0, dtype=np.int64), np.empty(0, dtype=np.int64)
-        left_idx, right_pos = _expand(lo, hi, total)
-        right_idx = r_perm[right_pos]
-        return np.asarray(left_idx), np.asarray(right_idx)
+        capacity = round_up_pow2(total)
+        left_idx, right_pos = _expand(lo, hi, capacity)
+        right_idx = r_perm[jnp.clip(right_pos, 0, rk.shape[0] - 1)]
+        return np.asarray(left_idx)[:total], np.asarray(right_idx)[:total]

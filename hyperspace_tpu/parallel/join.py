@@ -29,6 +29,7 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map as _shard_map
 
 from hyperspace_tpu.parallel.mesh import SHARD_AXIS
+from hyperspace_tpu.utils.shapes import round_up_pow2
 
 
 def _ranges_local(lk, lvalid, rk, rvalid):
@@ -88,12 +89,12 @@ def _materialize_program(lk, lvalid, rk, rvalid, *, capacity, mesh):
 def copartitioned_join(
     left_keys: np.ndarray, right_keys: np.ndarray, mesh,
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Inner equi-join of co-partitioned key shards.
+    """Inner equi-join of DENSE co-partitioned key shards.
 
     ``left_keys``/``right_keys`` are (D, L) / (D, R) arrays: row i of each
-    holds device i's shard, padded arbitrarily beyond the valid counts
-    implied by NaN/sentinel — here both sides are dense (callers pad with
-    the per-side ``pad_shards`` helper).  Returns GLOBAL (left, right) index
+    holds device i's shard and EVERY slot is a real key (all slots join).
+    For ragged shards with trailing padding use ``copartitioned_join_ragged``,
+    which tracks per-slot validity.  Returns GLOBAL (left, right) index
     pairs into the flattened (D*L,) / (D*R,) arrays.
     """
     D, L = left_keys.shape
@@ -138,6 +139,7 @@ def _copartitioned_join_padded(lk, lvalid, rk, rvalid, D, L, R, mesh):
         capacity = int(counts.max()) if counts.size else 0
         if capacity == 0:
             return np.empty(0, np.int64), np.empty(0, np.int64)
+        capacity = round_up_pow2(capacity)
         li, ri, totals = _materialize_program(
             lk, lvalid, rk, rvalid, capacity=capacity, mesh=mesh)
     li = np.asarray(li).reshape(D, capacity)

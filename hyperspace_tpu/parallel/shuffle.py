@@ -40,7 +40,7 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map as _shard_map
 
 from hyperspace_tpu.io.columnar import join_words64, split_words64
-from hyperspace_tpu.ops.hash import combine_hashes
+from hyperspace_tpu.ops.hash import bucket_ids
 from hyperspace_tpu.parallel.mesh import SHARD_AXIS
 
 
@@ -69,8 +69,7 @@ def _route_kernel(num_buckets: int, num_devices: int, capacity: int,
     (L, E), valid (L,) int32."""
     L = hash_words.shape[0]
     word_cols = [hash_words[:, 2 * k:2 * k + 2] for k in range(n_key_cols)]
-    h = combine_hashes(word_cols)
-    bucket = (h % jnp.uint32(num_buckets)).astype(jnp.int32)
+    bucket = bucket_ids(word_cols, num_buckets)
     buckets_per_device = -(-num_buckets // num_devices)  # ceil
     dest = bucket // buckets_per_device
     dest = jnp.where(valid.astype(bool), dest, num_devices)  # sentinel: drop
@@ -158,6 +157,15 @@ def bucket_shuffle(
     """
     n = hash_words[0].shape[0]
     n_devices = mesh.devices.size
+    if n == 0:
+        # Zero-row build (empty source): nothing to route.
+        return ShuffleResult(
+            perm=np.empty(0, np.int64),
+            buckets_sorted=np.empty(0, np.int32),
+            device_row_counts=np.zeros(n_devices, np.int32),
+            capacity=0,
+        ), (np.empty((0, payload_words.shape[1]), np.uint32)
+            if payload_words is not None else None)
     n_key_cols = len(hash_words)
     local = -(-n // n_devices)  # rows per device, ceil
     padded = local * n_devices

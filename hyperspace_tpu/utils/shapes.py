@@ -1,0 +1,16 @@
+"""Static-shape capacity policy for dynamic-size kernel outputs.
+
+XLA traces one program per static output shape, so kernels with
+data-dependent result sizes (join materialization) must pick a padded
+capacity.  The policy lives here, in one place: round up to the next power
+of two, so distinct result sizes collapse onto O(log n) compiled programs —
+a fresh compile costs 20-40 s on a real chip.  Callers slice the padded
+output back to the true count host-side.
+"""
+
+from __future__ import annotations
+
+
+def round_up_pow2(n: int) -> int:
+    """Smallest power of two >= n (n >= 1)."""
+    return 1 << (n - 1).bit_length()

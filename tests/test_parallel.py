@@ -37,6 +37,18 @@ def _key_arrays(values):
 
 
 class TestBucketShuffle:
+    def test_zero_rows(self, mesh):
+        """Empty source build must not crash the distributed path."""
+        empty = np.empty((0, 2), np.uint32)
+        result, payload = bucket_shuffle([empty], [empty], 8, mesh)
+        assert result.perm.size == 0
+        assert int(result.device_row_counts.sum()) == 0
+        assert payload is None
+        result, payload = bucket_shuffle(
+            [empty], [empty], 8, mesh,
+            payload_words=np.empty((0, 3), np.uint32))
+        assert payload.shape == (0, 3)
+
     def test_matches_single_device_assignment(self, mesh):
         rng = np.random.default_rng(0)
         vals = rng.integers(0, 10_000, size=5_000)
