@@ -130,6 +130,15 @@ def main() -> None:
 
         probe_key = 123_457
 
+        def _tables_equal(a, b):
+            if a.num_rows != b.num_rows or set(a.column_names) != set(b.column_names):
+                return False
+            cols = sorted(a.column_names)
+            keys = [(c, "ascending") for c in cols]
+            a = a.select(cols).sort_by(keys)
+            b = b.select(cols).sort_by(keys)
+            return a.equals(b)
+
         def q_filter():
             return (session.read.parquet(lineitem_dir)
                     .filter(col("l_orderkey") == probe_key)
@@ -152,11 +161,13 @@ def main() -> None:
             base_s = _time(q)
             session.enable_hyperspace()
             got = q()
-            # Correctness gate: speedup only counts if answers match.
-            if got.num_rows != expected.num_rows:
+            # Correctness gate: speedup only counts if answers match —
+            # full content equality after canonical ordering, not just row
+            # counts (a pruning bug can return the right COUNT of wrong rows).
+            if not _tables_equal(got, expected):
                 raise SystemExit(
-                    f"{name}: indexed answer has {got.num_rows} rows, "
-                    f"scan has {expected.num_rows}")
+                    f"{name}: indexed answer differs from full scan "
+                    f"({got.num_rows} vs {expected.num_rows} rows)")
             idx_s = _time(q)
             results[name] = (base_s, idx_s)
 

@@ -17,6 +17,7 @@ into a single VPU pass over HBM-resident batches.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Sequence
 
@@ -42,8 +43,22 @@ def _fmix32(h: jnp.ndarray) -> jnp.ndarray:
     return h
 
 
-def combine_hashes(word_cols: Sequence[jnp.ndarray]) -> jnp.ndarray:
-    """uint32 row hash from per-column (n, 2) uint32 hash words."""
+def use_pallas() -> bool:
+    """Route per-row kernels through pallas?  ``HYPERSPACE_TPU_PALLAS`` =
+    on | off | auto (default).  Auto: pallas on real TPU, plain XLA
+    elsewhere — interpret-mode pallas on CPU is a correctness tool, not a
+    fast path, so CPU CI opts in explicitly (tests/test_pallas_kernels.py).
+    """
+    mode = os.environ.get("HYPERSPACE_TPU_PALLAS", "auto").lower()
+    if mode == "on":
+        return True
+    if mode == "off":
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def combine_hashes_xla(word_cols: Sequence[jnp.ndarray]) -> jnp.ndarray:
+    """Reference XLA implementation (kept for parity testing + fallback)."""
     h = jnp.full(word_cols[0].shape[0], _SEED, dtype=jnp.uint32)
     for words in word_cols:
         h = _fmix32(h * jnp.uint32(31) ^ _fmix32(words[:, 0]))
@@ -51,8 +66,34 @@ def combine_hashes(word_cols: Sequence[jnp.ndarray]) -> jnp.ndarray:
     return h
 
 
-@partial(jax.jit, static_argnames=("num_buckets",))
-def bucket_ids(word_cols: Sequence[jnp.ndarray], num_buckets: int) -> jnp.ndarray:
-    """Per-row bucket assignment in [0, num_buckets) as int32."""
-    h = combine_hashes(word_cols)
+def combine_hashes(word_cols: Sequence[jnp.ndarray]) -> jnp.ndarray:
+    """uint32 row hash from per-column (n, 2) uint32 hash words.
+
+    On TPU this is the fused pallas kernel (ops/pallas_kernels.py) — one
+    VMEM pass over the word columns; elsewhere the plain XLA chain.  Both
+    are bit-identical.
+    """
+    if use_pallas():
+        from hyperspace_tpu.ops.pallas_kernels import hash_buckets
+
+        return hash_buckets(tuple(word_cols), 0)
+    return combine_hashes_xla(word_cols)
+
+
+@partial(jax.jit, static_argnames=("num_buckets", "pallas"))
+def _bucket_ids_impl(word_cols, num_buckets: int, pallas: bool) -> jnp.ndarray:
+    if pallas:
+        from hyperspace_tpu.ops.pallas_kernels import hash_buckets
+
+        return hash_buckets(word_cols, num_buckets).astype(jnp.int32)
+    h = combine_hashes_xla(word_cols)
     return (h % jnp.uint32(num_buckets)).astype(jnp.int32)
+
+
+def bucket_ids(word_cols: Sequence[jnp.ndarray], num_buckets: int) -> jnp.ndarray:
+    """Per-row bucket assignment in [0, num_buckets) as int32.
+
+    The pallas/XLA choice is part of the jit cache key (static arg): env
+    flips between calls retrace instead of silently reusing the old path.
+    """
+    return _bucket_ids_impl(tuple(word_cols), num_buckets, use_pallas())

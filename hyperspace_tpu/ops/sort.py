@@ -22,10 +22,31 @@ from typing import Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-from hyperspace_tpu.ops.hash import combine_hashes
+from hyperspace_tpu.ops.hash import _bucket_ids_impl, use_pallas
 
 
-@partial(jax.jit, static_argnames=("num_buckets",))
+@partial(jax.jit, static_argnames=("num_buckets", "pallas"))
+def _bucket_sort_impl(
+    word_cols,
+    order_words,
+    num_buckets: int,
+    pallas: bool,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    # One bucket-assignment implementation for build and query paths —
+    # duplicating it risks the two silently diverging, which corrupts the
+    # durable on-disk bucket layout.
+    buckets = _bucket_ids_impl(word_cols, num_buckets, pallas)
+    # jnp.lexsort: LAST key is the primary.  Order: bucket first, then key
+    # columns in config order, each (hi, lo) word pair hi-major.
+    keys = []
+    for w in reversed(order_words):
+        keys.append(w[:, 1])
+        keys.append(w[:, 0])
+    keys.append(buckets)
+    perm = jnp.lexsort(tuple(keys)).astype(jnp.int32)
+    return buckets, perm
+
+
 def bucket_sort_permutation(
     word_cols: Sequence[jnp.ndarray],
     order_words: Sequence[jnp.ndarray],
@@ -41,22 +62,26 @@ def bucket_sort_permutation(
     Returns:
       (bucket_ids int32 (n,), perm int32 (n,)) where perm orders rows by
       (bucket, *key columns) — ready for ``write_bucketed``.
+
+    On TPU the hash stage runs as the fused pallas kernel; the choice is a
+    static jit arg so env flips retrace (see ``ops.hash.use_pallas``).
     """
-    h = combine_hashes(word_cols)
-    buckets = (h % jnp.uint32(num_buckets)).astype(jnp.int32)
-    # jnp.lexsort: LAST key is the primary.  Order: bucket first, then key
-    # columns in config order, each (hi, lo) word pair hi-major.
-    keys = []
-    for w in reversed(order_words):
-        keys.append(w[:, 1])
-        keys.append(w[:, 0])
-    keys.append(buckets)
-    perm = jnp.lexsort(tuple(keys)).astype(jnp.int32)
-    return buckets, perm
+    return _bucket_sort_impl(
+        tuple(word_cols), tuple(order_words), num_buckets, use_pallas())
 
 
 @partial(jax.jit, static_argnames=("num_buckets",))
-def bucket_counts(buckets: jnp.ndarray, num_buckets: int) -> jnp.ndarray:
-    """Rows per bucket — one segment-sum over HBM."""
+def _bucket_counts_xla(buckets: jnp.ndarray, num_buckets: int) -> jnp.ndarray:
     return jax.ops.segment_sum(
         jnp.ones_like(buckets, dtype=jnp.int32), buckets, num_segments=num_buckets)
+
+
+def bucket_counts(buckets: jnp.ndarray, num_buckets: int) -> jnp.ndarray:
+    """Rows per bucket.  On TPU: the pallas one-hot histogram kernel
+    (ops/pallas_kernels.py) — VPU compares instead of segment_sum's
+    serialized scatter-add; elsewhere one XLA segment-sum over HBM."""
+    if use_pallas():
+        from hyperspace_tpu.ops.pallas_kernels import bucket_histogram
+
+        return bucket_histogram(buckets, num_buckets)
+    return _bucket_counts_xla(buckets, num_buckets)

@@ -40,7 +40,7 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map as _shard_map
 
 from hyperspace_tpu.io.columnar import join_words64, split_words64
-from hyperspace_tpu.ops.hash import bucket_ids
+from hyperspace_tpu.ops.hash import _bucket_ids_impl, use_pallas
 from hyperspace_tpu.parallel.mesh import SHARD_AXIS
 
 
@@ -62,14 +62,14 @@ class ShuffleResult(NamedTuple):
 
 
 def _route_kernel(num_buckets: int, num_devices: int, capacity: int,
-                  n_key_cols: int,
+                  n_key_cols: int, pallas: bool,
                   hash_words, order_words, row_words, payload, valid):
     """Per-device body run under shard_map.  All inputs are the LOCAL shard:
     hash_words (L, 2K), order_words (L, 2K), row_words (L, 2), payload
     (L, E), valid (L,) int32."""
     L = hash_words.shape[0]
-    word_cols = [hash_words[:, 2 * k:2 * k + 2] for k in range(n_key_cols)]
-    bucket = bucket_ids(word_cols, num_buckets)
+    word_cols = tuple(hash_words[:, 2 * k:2 * k + 2] for k in range(n_key_cols))
+    bucket = _bucket_ids_impl(word_cols, num_buckets, pallas)
     buckets_per_device = -(-num_buckets // num_devices)  # ceil
     dest = bucket // buckets_per_device
     dest = jnp.where(valid.astype(bool), dest, num_devices)  # sentinel: drop
@@ -117,11 +117,14 @@ def _route_kernel(num_buckets: int, num_devices: int, capacity: int,
 @functools.partial(
     jax.jit,
     static_argnames=("num_buckets", "num_devices", "capacity", "n_key_cols",
-                     "mesh"))
+                     "mesh", "pallas"))
 def _shuffle_program(hash_words, order_words, row_words, payload, valid, *,
-                     num_buckets, num_devices, capacity, n_key_cols, mesh):
+                     num_buckets, num_devices, capacity, n_key_cols, mesh,
+                     pallas):
+    # ``pallas`` is part of the jit cache key so HYPERSPACE_TPU_PALLAS flips
+    # between calls retrace instead of silently reusing the old kernel path.
     body = functools.partial(_route_kernel, num_buckets, num_devices,
-                             capacity, n_key_cols)
+                             capacity, n_key_cols, pallas)
     spec = P(SHARD_AXIS)
     return _shard_map(
         body, mesh=mesh,
@@ -192,7 +195,7 @@ def bucket_shuffle(
         out, counts, overflow = _shuffle_program(
             hw, ow, rw, pl, valid,
             num_buckets=num_buckets, num_devices=n_devices, capacity=capacity,
-            n_key_cols=n_key_cols, mesh=mesh)
+            n_key_cols=n_key_cols, mesh=mesh, pallas=use_pallas())
         overflow_total = int(np.sum(np.asarray(overflow)))
         if overflow_total == 0:
             break
