@@ -121,7 +121,7 @@ class CreateActionBase(Action):
         columns = resolved.all_columns
         tables: List[pa.Table] = []
         for f in files:
-            t = read_table([f.name], relation.file_format, columns, relation.options)
+            t = read_table([f.name], relation.read_format, columns, relation.options)
             if lineage:
                 # Lineage column: constant file id per source file
                 # (CreateActionBase.scala:177-222 without the broadcast join).
@@ -191,7 +191,15 @@ class CreateActionBase(Action):
         relation = self._relation()
         resolved = self._resolved_config()
         rel_meta = relation.create_relation_metadata(self._file_id_tracker)
-        properties: Dict[str, str] = {"lineage": str(self.lineage_enabled).lower()}
+        # Refresh actions carry forward the previous entry's properties so
+        # provider-accumulated state (e.g. the deltaVersions history) survives
+        # (CreateActionBase.scala:56-105 + DeltaLakeFileBasedSource enrich).
+        prev = getattr(self, "_previous_entry", None)
+        properties: Dict[str, str] = dict(prev.properties) if prev is not None else {}
+        properties["lineage"] = str(self.lineage_enabled).lower()
+        # The log version this entry will commit at (Action end() writes at
+        # base_id + 2) — providers record it in their version histories.
+        properties["indexLogVersion"] = str(self.base_id + 2)
         properties = self.session.source_provider_manager.enrich_index_properties(
             rel_meta, properties)
         content = Content.from_directory(

@@ -152,7 +152,7 @@ class RefreshIncrementalAction(RefreshActionBase):
         if appended:
             relation = self._relation()
             for f in appended:
-                t = read_table([f.name], relation.file_format,
+                t = read_table([f.name], relation.read_format,
                                resolved.all_columns, relation.options)
                 if self.lineage_enabled:
                     t = t.append_column(

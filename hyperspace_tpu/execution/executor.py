@@ -39,6 +39,9 @@ from hyperspace_tpu.plan.nodes import (
 )
 
 
+from hyperspace_tpu.sources.interfaces import LAKE_DATA_FORMATS, physical_read_format
+
+
 class Executor:
     def __init__(self, session) -> None:
         self.session = session
@@ -61,8 +64,15 @@ class Executor:
     # -- scan ---------------------------------------------------------------
     def _scan(self, plan: Scan) -> pa.Table:
         rel = plan.relation
+        read_format = physical_read_format(rel.file_format)
         if rel.file_paths is not None:
             paths = list(rel.file_paths)
+        elif rel.file_format.lower() in LAKE_DATA_FORMATS:
+            # Lake formats resolve files through the provider's snapshot —
+            # a directory walk would see removed/overwritten files too.
+            relation = self.session.source_provider_manager.get_relation(plan)
+            paths = [f.name for f in relation.all_files()]
+            read_format = relation.read_format
         else:
             paths = [f.name for f in list_data_files(rel.root_paths)]
         all_paths = paths
@@ -78,10 +88,10 @@ class Executor:
                 from hyperspace_tpu.io.parquet import read_schema, schema_to_arrow
 
                 schema = schema_to_arrow(read_schema(
-                    all_paths[0], rel.file_format, rel.options_dict))
+                    all_paths[0], read_format, rel.options_dict))
                 return schema.empty_table()
             return pa.table({})
-        return read_table(paths, rel.file_format, None, rel.options_dict)
+        return read_table(paths, read_format, None, rel.options_dict)
 
     # -- filter -------------------------------------------------------------
     def _filter(self, plan: Filter) -> pa.Table:

@@ -93,8 +93,13 @@ class IndexCollectionManager:
                 out.append(entry)
         return out
 
-    def get_index(self, name: str) -> Optional[IndexLogEntry]:
-        return self._log_manager(name).get_latest_stable_log()
+    def get_index(self, name: str,
+                  version: Optional[int] = None) -> Optional[IndexLogEntry]:
+        """Latest stable entry, or a specific log version
+        (IndexCollectionManager.scala:165-170)."""
+        if version is None:
+            return self._log_manager(name).get_latest_stable_log()
+        return self._log_manager(name).get_log(version)
 
     def indexes(self):
         """Summary table of all indexes (IndexStatistics DataFrame analog,

@@ -46,6 +46,10 @@ def get_hybrid_scan_candidates(session, entries: Sequence[IndexLogEntry],
     current_by_key = {_file_key(f): f for f in current}
     conf = session.conf
     out: List[IndexLogEntry] = []
+    # Multi-version index selection: a time-traveled lake read swaps each
+    # candidate for its closest indexed version before the overlap math
+    # (RuleUtils.scala:96-101 / DeltaLakeRelation.closestIndex).
+    entries = [relation.closest_index(e) for e in entries]
     for entry in entries:
         cached = entry.get_tag(IndexLogEntryTags.IS_HYBRIDSCAN_CANDIDATE, scan)
         if cached is not None:

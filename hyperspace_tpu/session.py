@@ -42,6 +42,20 @@ class DataReader:
     def json(self, *paths: str, **options: str):
         return self._make("json", *paths, **options)
 
+    def delta(self, path: str, **options: str):
+        """Read a Delta table; ``versionAsOf``/``timestampAsOf`` options time
+        travel (the df.read.format("delta") path of DeltaLakeIntegrationTest)."""
+        return self._make("delta", path, **options)
+
+    def format(self, fmt: str):
+        reader = self
+
+        class _FormatReader:
+            def load(self, *paths: str, **options: str):
+                return reader._make(fmt, *paths, **options)
+
+        return _FormatReader()
+
 
 class HyperspaceSession:
     def __init__(self, system_path: Optional[str] = None,
@@ -62,7 +76,7 @@ class HyperspaceSession:
         # Rebuilt per access so conf changes take effect (CacheWithTransform
         # analog, util/CacheWithTransform.scala:31-45, without the cache —
         # construction is cheap here).
-        return FileBasedSourceProviderManager(self.conf)
+        return FileBasedSourceProviderManager(self.conf, session=self)
 
     def schema_of(self, scan: Scan) -> List[str]:
         return list(self.schema_map_of(scan).keys())
@@ -75,8 +89,11 @@ class HyperspaceSession:
             if scan.relation.file_paths is not None:
                 from hyperspace_tpu.io.parquet import read_schema
 
+                from hyperspace_tpu.sources.interfaces import physical_read_format
+
                 self._schema_cache[key] = read_schema(
-                    scan.relation.file_paths[0], scan.relation.file_format,
+                    scan.relation.file_paths[0],
+                    physical_read_format(scan.relation.file_format),
                     scan.relation.options_dict)
             else:
                 rel = self.source_provider_manager.get_relation(scan)

@@ -95,4 +95,6 @@ class DefaultFileBasedSource(FileBasedSourceProvider):
 
     def enrich_index_properties(self, relation: Relation,
                                 properties: Dict[str, str]) -> Optional[Dict[str, str]]:
+        if relation.file_format.lower() not in self._supported_formats():
+            return None  # another provider owns this relation
         return properties

@@ -1,0 +1,5 @@
+from hyperspace_tpu.sources.delta.log import DeltaLog
+from hyperspace_tpu.sources.delta.provider import DeltaLakeRelation, DeltaLakeSource
+from hyperspace_tpu.sources.delta.writer import write_delta
+
+__all__ = ["DeltaLog", "DeltaLakeRelation", "DeltaLakeSource", "write_delta"]

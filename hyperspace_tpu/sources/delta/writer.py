@@ -1,0 +1,163 @@
+"""Minimal Delta table writer: append / overwrite commits.
+
+Produces protocol-compliant tables (Parquet part files + JSON commits) that
+both this engine and standard Delta readers understand.  Exists because the
+TPU engine owns its IO path end to end — the reference leans on delta-core's
+writer; our tests and users need a native way to fabricate and mutate Delta
+tables (the role ``spark.write.format("delta")`` plays in
+HybridScanForDeltaLakeTest / DeltaLakeIntegrationTest).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import Dict, List, Optional
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from hyperspace_tpu.sources.delta.log import DeltaLog
+
+_ARROW_TO_SPARK = {
+    "int8": "byte",
+    "int16": "short",
+    "int32": "integer",
+    "int64": "long",
+    "float": "float",
+    "double": "double",
+    "bool": "boolean",
+    "string": "string",
+    "large_string": "string",
+    "date32[day]": "date",
+    "binary": "binary",
+}
+
+_SPARK_TO_ARROW = {v: k for k, v in _ARROW_TO_SPARK.items() if v != "string"}
+_SPARK_TO_ARROW["string"] = "string"
+
+
+def spark_schema_string(schema: pa.Schema) -> str:
+    """Arrow schema → Spark StructType JSON (the metaData.schemaString
+    format every Delta reader expects)."""
+    fields = []
+    for f in schema:
+        t = _ARROW_TO_SPARK.get(str(f.type))
+        if t is None:
+            if str(f.type).startswith("timestamp"):
+                t = "timestamp"
+            elif str(f.type).startswith("decimal128"):
+                import re
+
+                m = re.match(r"decimal128\((\d+),\s*(\d+)\)", str(f.type))
+                t = f"decimal({m.group(1)},{m.group(2)})" if m else "string"
+            else:
+                t = "string"
+        fields.append({"name": f.name, "type": t, "nullable": True,
+                       "metadata": {}})
+    return json.dumps({"type": "struct", "fields": fields})
+
+
+def arrow_schema_from_spark(schema_string: str) -> Dict[str, str]:
+    """Spark StructType JSON → our name→arrow-type-string schema dict."""
+    parsed = json.loads(schema_string)
+    out: Dict[str, str] = {}
+    for f in parsed.get("fields", []):
+        t = f["type"]
+        if isinstance(t, str):
+            if t == "timestamp":
+                arrow = "timestamp[us]"
+            elif t.startswith("decimal"):
+                import re
+
+                m = re.match(r"decimal\((\d+),\s*(\d+)\)", t)
+                arrow = f"decimal128({m.group(1)}, {m.group(2)})" if m \
+                    else "string"
+            else:
+                arrow = _SPARK_TO_ARROW.get(t, "string")
+        else:
+            arrow = "string"  # nested types surface as strings for now
+        out[f["name"]] = arrow
+    return out
+
+
+def write_delta(table: pa.Table, path: str, mode: str = "append") -> int:
+    """Write ``table`` to the Delta table at ``path``; returns the committed
+    version.  ``mode``: "append" adds files; "overwrite" removes every active
+    file and adds the new ones.  Tables are unpartitioned (hive-partitioned
+    Delta writes are not supported yet)."""
+    if mode not in ("append", "overwrite"):
+        raise ValueError(f"Unknown write mode {mode!r}")
+    log = DeltaLog(path)
+    now_ms = int(time.time() * 1000)
+    exists = log.exists()
+    version = log.latest_version() + 1 if exists else 0
+    if exists:
+        # Commit timestamps must be strictly monotonic for timestampAsOf to
+        # resolve unambiguously (Spark's writer adjusts the same way).
+        prev_ts = log._commit_timestamp(version - 1)
+        if prev_ts is not None and now_ms <= prev_ts:
+            now_ms = prev_ts + 1
+
+    actions: List[dict] = []
+    if version == 0:
+        actions.append({"protocol": {"minReaderVersion": 1,
+                                     "minWriterVersion": 2}})
+        actions.append({"metaData": {
+            "id": uuid.uuid4().hex,
+            "format": {"provider": "parquet", "options": {}},
+            "schemaString": spark_schema_string(table.schema),
+            "partitionColumns": [],
+            "configuration": {},
+            "createdTime": now_ms,
+        }})
+    elif mode == "overwrite":
+        for f in log.snapshot().files:
+            rel = _relativize(f.path, log.table_path)
+            actions.append({"remove": {"path": rel,
+                                       "deletionTimestamp": now_ms,
+                                       "dataChange": True}})
+
+    name = f"part-00000-{uuid.uuid4().hex}-c000.snappy.parquet"
+    data_path = f"{log.table_path}/{name}"
+    import os
+
+    os.makedirs(log.table_path, exist_ok=True)
+    pq.write_table(table, data_path)
+
+    actions.append({"add": {
+        "path": name,
+        "partitionValues": {},
+        "size": os.stat(data_path).st_size,
+        "modificationTime": now_ms,
+        "dataChange": True,
+    }})
+    actions.append({"commitInfo": {"timestamp": now_ms,
+                                   "operation": "WRITE",
+                                   "operationParameters": {"mode": mode}}})
+    log.write_commit(version, actions)
+    return version
+
+
+def delete_where_file(path: str, file_path: str) -> int:
+    """Commit a remove of one data file (simulates row deletion at file
+    granularity — the unit HybridScan's deleted-files handling works at)."""
+    log = DeltaLog(path)
+    now_ms = int(time.time() * 1000)
+    version = log.latest_version() + 1
+    rel = _relativize(file_path, log.table_path)
+    log.write_commit(version, [
+        {"remove": {"path": rel, "deletionTimestamp": now_ms,
+                    "dataChange": True}},
+        {"commitInfo": {"timestamp": now_ms, "operation": "DELETE"}},
+    ])
+    return version
+
+
+def _relativize(path: str, root: str) -> str:
+    import os
+
+    if path.startswith(root.rstrip("/") + "/"):
+        return path[len(root.rstrip("/")) + 1:]
+    return path

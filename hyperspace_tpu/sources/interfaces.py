@@ -21,6 +21,18 @@ from hyperspace_tpu.index.log_entry import Content, FileIdTracker, FileInfo, Ind
 from hyperspace_tpu.plan.nodes import Scan
 
 
+# Lake formats whose data files are a different physical format than the
+# table format name.  Single source of truth for the engine's read paths
+# (executor scans, hybrid-scan file subsets, schema resolution) — mirrors
+# internalFileFormatName (interfaces.scala:210).
+LAKE_DATA_FORMATS = {"delta": "parquet", "iceberg": "parquet"}
+
+
+def physical_read_format(file_format: str) -> str:
+    """Format to read a relation's data files with."""
+    return LAKE_DATA_FORMATS.get(file_format.lower(), file_format)
+
+
 class FileBasedRelation:
     """One supported leaf relation of a plan (interfaces.scala:43-146)."""
 
@@ -38,6 +50,12 @@ class FileBasedRelation:
     @property
     def options(self) -> Dict[str, str]:
         return self.scan.relation.options_dict
+
+    @property
+    def read_format(self) -> str:
+        """Format to READ data files with (Delta/Iceberg data files are
+        Parquet — internalFileFormatName, interfaces.scala:210)."""
+        return physical_read_format(self.file_format)
 
     def all_files(self, tracker: Optional[FileIdTracker] = None) -> List[FileInfo]:
         """Every data file of this relation (interfaces.scala:60-66)."""

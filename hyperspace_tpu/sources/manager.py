@@ -32,10 +32,14 @@ def _builtin_providers() -> None:
         from hyperspace_tpu.sources.default.provider import DefaultFileBasedSource
 
         register_provider("default", DefaultFileBasedSource)
+    if "delta" not in PROVIDER_REGISTRY:
+        from hyperspace_tpu.sources.delta.provider import DeltaLakeSource
+
+        register_provider("delta", DeltaLakeSource)
 
 
 class FileBasedSourceProviderManager:
-    def __init__(self, conf: HyperspaceConf) -> None:
+    def __init__(self, conf: HyperspaceConf, session=None) -> None:
         _builtin_providers()
         self._conf = conf
         names = [n.strip() for n in conf.source_providers.split(",") if n.strip()]
@@ -44,6 +48,12 @@ class FileBasedSourceProviderManager:
             raise HyperspaceError(f"Unknown source providers: {unknown}")
         self._providers: List[FileBasedSourceProvider] = [
             PROVIDER_REGISTRY[n](conf) for n in names]
+        if session is not None:
+            # Providers that need session context (index-manager lookups for
+            # closest_index) opt in via bind_session.
+            for p in self._providers:
+                if hasattr(p, "bind_session"):
+                    p.bind_session(session)
 
     def _run(self, api: str, fn: Callable[[FileBasedSourceProvider], Optional[T]]) -> T:
         """Exactly-one-provider dispatch
