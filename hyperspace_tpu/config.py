@@ -56,7 +56,7 @@ class HyperspaceConf:
     optimize_file_size_threshold: int = 256 * 1024 * 1024
     filter_rule_use_bucket_spec: bool = False
     cache_expiry_seconds: int = 300
-    source_providers: str = "default,delta"
+    source_providers: str = "default,delta,iceberg"
     signature_provider: str = "IndexSignatureProvider"
     event_logger: str = ""
     supported_file_formats: str = "parquet,csv,json"

@@ -65,14 +65,15 @@ class Executor:
     def _scan(self, plan: Scan) -> pa.Table:
         rel = plan.relation
         read_format = physical_read_format(rel.file_format)
+        lake_relation = None
         if rel.file_paths is not None:
             paths = list(rel.file_paths)
         elif rel.file_format.lower() in LAKE_DATA_FORMATS:
             # Lake formats resolve files through the provider's snapshot —
             # a directory walk would see removed/overwritten files too.
-            relation = self.session.source_provider_manager.get_relation(plan)
-            paths = [f.name for f in relation.all_files()]
-            read_format = relation.read_format
+            lake_relation = self.session.source_provider_manager.get_relation(plan)
+            paths = [f.name for f in lake_relation.all_files()]
+            read_format = lake_relation.read_format
         else:
             paths = [f.name for f in list_data_files(rel.root_paths)]
         all_paths = paths
@@ -84,12 +85,17 @@ class Executor:
             # Bucket pruning removed every file (key hashes to an empty
             # bucket): the result is empty but MUST keep the scan schema so
             # downstream Project/Filter still resolve.
-            if all_paths:
-                from hyperspace_tpu.io.parquet import read_schema, schema_to_arrow
+            from hyperspace_tpu.io.parquet import read_schema, schema_to_arrow
 
+            if all_paths:
                 schema = schema_to_arrow(read_schema(
                     all_paths[0], read_format, rel.options_dict))
                 return schema.empty_table()
+            if lake_relation is not None:
+                # A lake table whose active file set is empty still has a
+                # schema in its metadata — downstream Project/Filter must
+                # resolve against it, not against a column-less table.
+                return schema_to_arrow(lake_relation.schema()).empty_table()
             return pa.table({})
         return read_table(paths, read_format, None, rel.options_dict)
 

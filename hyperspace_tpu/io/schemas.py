@@ -1,0 +1,136 @@
+"""Schema vocabulary bridges: arrow <-> Spark StructType <-> Iceberg types.
+
+Single source of truth for the primitive-type tables and the
+timestamp/decimal fallbacks; the Delta writer (metaData.schemaString), the
+Iceberg writer (schema JSON with field ids), and both lake readers map
+through here so a new engine type lands in exactly one place.
+
+The engine's own schema vocabulary is arrow type strings (io/columnar.py);
+Spark's is StructType JSON (what every Delta reader expects in
+``metaData.schemaString``); Iceberg's is its schema JSON with field ids.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Dict
+
+import pyarrow as pa
+
+_ARROW_TO_SPARK = {
+    "int8": "byte",
+    "int16": "short",
+    "int32": "integer",
+    "int64": "long",
+    "float": "float",
+    "double": "double",
+    "bool": "boolean",
+    "string": "string",
+    "large_string": "string",
+    "date32[day]": "date",
+    "binary": "binary",
+}
+
+_SPARK_TO_ARROW = {v: k for k, v in _ARROW_TO_SPARK.items() if v != "string"}
+_SPARK_TO_ARROW["string"] = "string"
+
+_ARROW_TO_ICEBERG = {
+    "bool": "boolean",
+    "int8": "int",
+    "int16": "int",
+    "int32": "int",
+    "int64": "long",
+    "float": "float",
+    "double": "double",
+    "string": "string",
+    "large_string": "string",
+    "date32[day]": "date",
+    "binary": "binary",
+}
+
+_ICEBERG_TO_ARROW = {
+    "boolean": "bool",
+    "int": "int32",
+    "long": "int64",
+    "float": "float",
+    "double": "double",
+    "date": "date32[day]",
+    "string": "string",
+    "binary": "binary",
+    "timestamp": "timestamp[us]",
+    "timestamptz": "timestamp[us, tz=UTC]",
+}
+
+_DECIMAL_ARROW_RE = re.compile(r"^decimal128\((\d+),\s*(\d+)\)$")
+_DECIMAL_RE = re.compile(r"^decimal\((\d+),\s*(\d+)\)$")
+
+
+def _arrow_fallback(arrow_type: str, decimal_fmt: str) -> str:
+    """Shared timestamp/decimal handling for arrow -> X mappings."""
+    if arrow_type.startswith("timestamp"):
+        return "timestamp"
+    m = _DECIMAL_ARROW_RE.match(arrow_type)
+    if m:
+        return decimal_fmt.format(p=m.group(1), s=m.group(2))
+    return "string"
+
+
+def arrow_type_to_spark(arrow_type: str) -> str:
+    t = _ARROW_TO_SPARK.get(arrow_type)
+    return t if t is not None else _arrow_fallback(arrow_type, "decimal({p},{s})")
+
+
+def spark_type_to_arrow(spark_type: Any) -> str:
+    if not isinstance(spark_type, str):
+        return "string"  # nested types surface as strings for now
+    if spark_type == "timestamp":
+        return "timestamp[us]"
+    m = _DECIMAL_RE.match(spark_type)
+    if m:
+        return f"decimal128({m.group(1)}, {m.group(2)})"
+    return _SPARK_TO_ARROW.get(spark_type, "string")
+
+
+def arrow_type_to_iceberg(arrow_type: str) -> str:
+    t = _ARROW_TO_ICEBERG.get(arrow_type)
+    return t if t is not None else _arrow_fallback(arrow_type, "decimal({p},{s})")
+
+
+def iceberg_type_to_arrow(iceberg_type: Any) -> str:
+    if isinstance(iceberg_type, str):
+        if iceberg_type in _ICEBERG_TO_ARROW:
+            return _ICEBERG_TO_ARROW[iceberg_type]
+        m = _DECIMAL_RE.match(iceberg_type)
+        if m:
+            return f"decimal128({m.group(1)}, {m.group(2)})"
+    return "string"
+
+
+def spark_schema_string(schema: pa.Schema) -> str:
+    """Arrow schema -> Spark StructType JSON (the ``metaData.schemaString``
+    format every Delta reader expects)."""
+    fields = [{"name": f.name, "type": arrow_type_to_spark(str(f.type)),
+               "nullable": True, "metadata": {}} for f in schema]
+    return json.dumps({"type": "struct", "fields": fields})
+
+
+def arrow_schema_from_spark(schema_string: str) -> Dict[str, str]:
+    """Spark StructType JSON -> our name -> arrow-type-string schema dict."""
+    parsed = json.loads(schema_string)
+    return {f["name"]: spark_type_to_arrow(f["type"])
+            for f in parsed.get("fields", [])}
+
+
+def iceberg_schema(schema: pa.Schema) -> Dict[str, Any]:
+    """Arrow schema -> Iceberg schema JSON with sequential field ids."""
+    fields = [{"id": i, "name": f.name, "required": False,
+               "type": arrow_type_to_iceberg(str(f.type))}
+              for i, f in enumerate(schema, start=1)]
+    return {"type": "struct", "schema-id": 0, "fields": fields}
+
+
+def arrow_schema_from_iceberg(schema: Dict[str, Any]) -> Dict[str, str]:
+    """Iceberg schema JSON -> our name -> arrow-type-string schema dict."""
+    return {f["name"]: iceberg_type_to_arrow(f.get("type"))
+            for f in schema.get("fields", [])}

@@ -15,6 +15,7 @@ Reference contract: index/rules/RuleUtils.scala —
 
 from __future__ import annotations
 
+import os
 from typing import List, Optional, Sequence, Tuple
 
 from hyperspace_tpu.actions.create import DATA_FILE_ID_COLUMN
@@ -48,8 +49,18 @@ def get_hybrid_scan_candidates(session, entries: Sequence[IndexLogEntry],
     out: List[IndexLogEntry] = []
     # Multi-version index selection: a time-traveled lake read swaps each
     # candidate for its closest indexed version before the overlap math
-    # (RuleUtils.scala:96-101 / DeltaLakeRelation.closestIndex).
-    entries = [relation.closest_index(e) for e in entries]
+    # (RuleUtils.scala:96-101 / DeltaLakeRelation.closestIndex).  Only for
+    # entries over THIS relation — swapping an unrelated table's index would
+    # load its old log versions per query and discard cached tags for
+    # nothing (the overlap math excludes it anyway).
+    scan_roots = {os.path.abspath(p) for p in relation.root_paths}
+
+    def _same_relation(e: IndexLogEntry) -> bool:
+        return any(os.path.abspath(p) in scan_roots
+                   for r in e.relations for p in r.root_paths)
+
+    entries = [relation.closest_index(e) if _same_relation(e) else e
+               for e in entries]
     for entry in entries:
         cached = entry.get_tag(IndexLogEntryTags.IS_HYBRIDSCAN_CANDIDATE, scan)
         if cached is not None:

@@ -47,6 +47,13 @@ class DataReader:
         travel (the df.read.format("delta") path of DeltaLakeIntegrationTest)."""
         return self._make("delta", path, **options)
 
+    def iceberg(self, path: str, **options: str):
+        """Read an Iceberg table; ``snapshot_id``/``as_of_timestamp`` options
+        time travel (the df.read.format("iceberg") path of
+        IcebergIntegrationTest; option names per IcebergRelation.scala:50-55)."""
+        renamed = {k.replace("_", "-"): v for k, v in options.items()}
+        return self._make("iceberg", path, **renamed)
+
     def format(self, fmt: str):
         reader = self
 

@@ -42,6 +42,7 @@ class DeltaMetadata:
     schema_string: str = ""
     partition_columns: List[str] = dataclasses.field(default_factory=list)
     configuration: Dict[str, str] = dataclasses.field(default_factory=dict)
+    id: str = ""  # stable table id; a schema-changing commit must keep it
 
 
 @dataclasses.dataclass
@@ -168,6 +169,7 @@ class DeltaLog:
             metadata.schema_string = m.get("schemaString", "")
             metadata.partition_columns = list(m.get("partitionColumns", []))
             metadata.configuration = dict(m.get("configuration", {}))
+            metadata.id = m.get("id", "")
 
     def _absolute(self, path: str) -> str:
         path = urllib.parse.unquote(path)

@@ -18,68 +18,11 @@ from typing import Dict, List, Optional
 import pyarrow as pa
 import pyarrow.parquet as pq
 
+from hyperspace_tpu.io.schemas import arrow_schema_from_spark, spark_schema_string
 from hyperspace_tpu.sources.delta.log import DeltaLog
 
-_ARROW_TO_SPARK = {
-    "int8": "byte",
-    "int16": "short",
-    "int32": "integer",
-    "int64": "long",
-    "float": "float",
-    "double": "double",
-    "bool": "boolean",
-    "string": "string",
-    "large_string": "string",
-    "date32[day]": "date",
-    "binary": "binary",
-}
-
-_SPARK_TO_ARROW = {v: k for k, v in _ARROW_TO_SPARK.items() if v != "string"}
-_SPARK_TO_ARROW["string"] = "string"
-
-
-def spark_schema_string(schema: pa.Schema) -> str:
-    """Arrow schema → Spark StructType JSON (the metaData.schemaString
-    format every Delta reader expects)."""
-    fields = []
-    for f in schema:
-        t = _ARROW_TO_SPARK.get(str(f.type))
-        if t is None:
-            if str(f.type).startswith("timestamp"):
-                t = "timestamp"
-            elif str(f.type).startswith("decimal128"):
-                import re
-
-                m = re.match(r"decimal128\((\d+),\s*(\d+)\)", str(f.type))
-                t = f"decimal({m.group(1)},{m.group(2)})" if m else "string"
-            else:
-                t = "string"
-        fields.append({"name": f.name, "type": t, "nullable": True,
-                       "metadata": {}})
-    return json.dumps({"type": "struct", "fields": fields})
-
-
-def arrow_schema_from_spark(schema_string: str) -> Dict[str, str]:
-    """Spark StructType JSON → our name→arrow-type-string schema dict."""
-    parsed = json.loads(schema_string)
-    out: Dict[str, str] = {}
-    for f in parsed.get("fields", []):
-        t = f["type"]
-        if isinstance(t, str):
-            if t == "timestamp":
-                arrow = "timestamp[us]"
-            elif t.startswith("decimal"):
-                import re
-
-                m = re.match(r"decimal\((\d+),\s*(\d+)\)", t)
-                arrow = f"decimal128({m.group(1)}, {m.group(2)})" if m \
-                    else "string"
-            else:
-                arrow = _SPARK_TO_ARROW.get(t, "string")
-        else:
-            arrow = "string"  # nested types surface as strings for now
-        out[f["name"]] = arrow
-    return out
+__all__ = ["write_delta", "delete_where_file", "spark_schema_string",
+           "arrow_schema_from_spark"]
 
 
 def write_delta(table: pa.Table, path: str, mode: str = "append") -> int:
@@ -113,11 +56,24 @@ def write_delta(table: pa.Table, path: str, mode: str = "append") -> int:
             "createdTime": now_ms,
         }})
     elif mode == "overwrite":
-        for f in log.snapshot().files:
+        snapshot = log.snapshot()
+        for f in snapshot.files:
             rel = _relativize(f.path, log.table_path)
             actions.append({"remove": {"path": rel,
                                        "deletionTimestamp": now_ms,
                                        "dataChange": True}})
+        # Overwrite may change the schema: commit a fresh metaData action
+        # (keeping the stable table id) so readers don't resolve against the
+        # replaced schema.
+        new_schema = spark_schema_string(table.schema)
+        if new_schema != snapshot.metadata.schema_string:
+            actions.append({"metaData": {
+                "id": snapshot.metadata.id or uuid.uuid4().hex,
+                "format": {"provider": "parquet", "options": {}},
+                "schemaString": new_schema,
+                "partitionColumns": [],
+                "configuration": dict(snapshot.metadata.configuration),
+            }})
 
     name = f"part-00000-{uuid.uuid4().hex}-c000.snappy.parquet"
     data_path = f"{log.table_path}/{name}"

@@ -1,0 +1,6 @@
+from hyperspace_tpu.sources.iceberg.metadata import IcebergTable
+from hyperspace_tpu.sources.iceberg.provider import IcebergRelation, IcebergSource
+from hyperspace_tpu.sources.iceberg.writer import delete_file_iceberg, write_iceberg
+
+__all__ = ["IcebergTable", "IcebergRelation", "IcebergSource",
+           "write_iceberg", "delete_file_iceberg"]

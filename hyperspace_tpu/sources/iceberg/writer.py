@@ -1,0 +1,182 @@
+"""Minimal Iceberg table writer: append / overwrite / file-delete commits.
+
+Produces spec-shaped HadoopTables-style tables (Parquet data files, Avro
+manifest lists + manifests, ``v<N>.metadata.json`` + ``version-hint.text``)
+that our reader understands.  Exists because the TPU engine owns its IO path
+end to end — the reference leans on the iceberg-spark-runtime writer; our
+tests and users need a native way to fabricate and mutate Iceberg tables
+(the role ``df.write.format("iceberg")`` plays in IcebergIntegrationTest /
+HybridScanForIcebergTest).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Dict, List, Optional
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from hyperspace_tpu.io import avro
+from hyperspace_tpu.io.schemas import iceberg_schema
+from hyperspace_tpu.sources.iceberg.metadata import (
+    MANIFEST_ENTRY_SCHEMA,
+    MANIFEST_LIST_SCHEMA,
+    METADATA_DIR,
+    STATUS_ADDED,
+    STATUS_DELETED,
+    STATUS_EXISTING,
+    VERSION_HINT,
+    DataFile,
+    IcebergTable,
+    TableMetadata,
+)
+
+def _new_snapshot_id() -> int:
+    return uuid.uuid4().int & ((1 << 62) - 1)
+
+
+def _write_manifest(table_path: str, entries: List[Dict],
+                    snapshot_id: int) -> Dict:
+    name = f"{uuid.uuid4().hex}-m0.avro"
+    path = os.path.join(table_path, METADATA_DIR, name)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    avro.write_container(path, MANIFEST_ENTRY_SCHEMA, entries,
+                         metadata={"schema": json.dumps(MANIFEST_ENTRY_SCHEMA),
+                                   "format-version": "1"})
+    added = sum(1 for e in entries if e["status"] == STATUS_ADDED)
+    existing = sum(1 for e in entries if e["status"] == STATUS_EXISTING)
+    deleted = sum(1 for e in entries if e["status"] == STATUS_DELETED)
+    return {
+        "manifest_path": path,
+        "manifest_length": os.stat(path).st_size,
+        "partition_spec_id": 0,
+        "added_snapshot_id": snapshot_id,
+        "added_data_files_count": added,
+        "existing_data_files_count": existing,
+        "deleted_data_files_count": deleted,
+    }
+
+
+def _commit(table: IcebergTable, metadata: TableMetadata,
+            manifest_files: List[Dict], snapshot_id: int, now_ms: int,
+            schema: Dict, properties: Dict[str, str],
+            operation: str, table_uuid: str) -> int:
+    """Write the manifest list + next metadata version (create-if-absent on
+    the metadata file = the optimistic commit point, as in HadoopTables)."""
+    md_dir = os.path.join(table.table_path, METADATA_DIR)
+    os.makedirs(md_dir, exist_ok=True)
+    list_path = os.path.join(
+        md_dir, f"snap-{snapshot_id}-1-{uuid.uuid4().hex}.avro")
+    avro.write_container(list_path, MANIFEST_LIST_SCHEMA, manifest_files,
+                         metadata={"format-version": "1"})
+
+    snapshots = [
+        {"snapshot-id": s.snapshot_id, "timestamp-ms": s.timestamp_ms,
+         "manifest-list": s.manifest_list, "summary": s.summary}
+        for s in (metadata.snapshots if metadata else [])
+    ]
+    snapshots.append({
+        "snapshot-id": snapshot_id,
+        "timestamp-ms": now_ms,
+        "manifest-list": list_path,
+        "summary": {"operation": operation},
+    })
+    version = (metadata.metadata_version + 1) if metadata else 1
+    doc = {
+        "format-version": 1,
+        "table-uuid": table_uuid,
+        "location": table.table_path,
+        "last-updated-ms": now_ms,
+        "last-column-id": max((f["id"] for f in schema["fields"]), default=0),
+        "schema": schema,
+        "partition-spec": [],
+        "properties": properties,
+        "current-snapshot-id": snapshot_id,
+        "snapshots": snapshots,
+    }
+    md_path = os.path.join(md_dir, f"v{version}.metadata.json")
+    # 'x' = exclusive create: racing writers on the same version — one wins.
+    with open(md_path, "x", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2)
+    with open(os.path.join(md_dir, VERSION_HINT), "w", encoding="utf-8") as f:
+        f.write(str(version))
+    return version
+
+
+def _entry(status: int, snapshot_id: int, f: DataFile) -> Dict:
+    return {"status": status, "snapshot_id": snapshot_id,
+            "data_file": {"file_path": f.path, "file_format": "PARQUET",
+                          "record_count": f.record_count,
+                          "file_size_in_bytes": f.size}}
+
+
+def write_iceberg(data: pa.Table, path: str, mode: str = "append") -> int:
+    """Write ``data`` to the Iceberg table at ``path``; returns the new
+    snapshot id.  ``mode``: "append" adds files; "overwrite" replaces the
+    live file set.  Tables are unpartitioned."""
+    if mode not in ("append", "overwrite"):
+        raise ValueError(f"Unknown write mode {mode!r}")
+    table = IcebergTable(path)
+    now_ms = int(time.time() * 1000)
+    exists = table.exists()
+    metadata = table.load_metadata() if exists else None
+    if metadata and metadata.snapshots:
+        latest_ts = max(s.timestamp_ms for s in metadata.snapshots)
+        if now_ms <= latest_ts:  # keep as-of-timestamp resolution unambiguous
+            now_ms = latest_ts + 1
+    # Overwrite may change the schema (appends must conform to the table's);
+    # stale schema metadata would make readers resolve the wrong column set.
+    if metadata and mode == "append":
+        schema = metadata.schema
+    else:
+        schema = iceberg_schema(data.schema)
+    table_uuid = metadata.table_uuid if metadata else str(uuid.uuid4())
+    properties = metadata.properties if metadata else {}
+
+    data_dir = os.path.join(table.table_path, "data")
+    os.makedirs(data_dir, exist_ok=True)
+    file_path = os.path.join(
+        data_dir, f"{uuid.uuid4().hex}-00000.parquet")
+    pq.write_table(data, file_path)
+    new_file = DataFile(file_path, os.stat(file_path).st_size, data.num_rows)
+
+    snapshot_id = _new_snapshot_id()
+    carried: List[DataFile] = []
+    if exists and mode == "append":
+        carried = table.plan_files(metadata=metadata)
+    entries = [_entry(STATUS_EXISTING, snapshot_id, f) for f in carried]
+    entries.append(_entry(STATUS_ADDED, snapshot_id, new_file))
+    manifest = _write_manifest(table.table_path, entries, snapshot_id)
+    _commit(table, metadata, [manifest], snapshot_id, now_ms, schema,
+            properties, mode, table_uuid)
+    return snapshot_id
+
+
+def delete_file_iceberg(path: str, file_path: str) -> int:
+    """Commit a snapshot that drops one data file (simulates row deletion at
+    file granularity — the unit Hybrid Scan's deleted-files handling works
+    at)."""
+    table = IcebergTable(path)
+    metadata = table.load_metadata()
+    now_ms = int(time.time() * 1000)
+    if metadata.snapshots:
+        latest_ts = max(s.timestamp_ms for s in metadata.snapshots)
+        if now_ms <= latest_ts:
+            now_ms = latest_ts + 1
+    live = table.plan_files(metadata=metadata)
+    target = os.path.abspath(file_path)
+    if not any(f.path == target for f in live):
+        raise FileNotFoundError(f"{file_path} is not a live file of {path}")
+    snapshot_id = _new_snapshot_id()
+    entries = [_entry(STATUS_EXISTING, snapshot_id, f)
+               for f in live if f.path != target]
+    entries.extend(_entry(STATUS_DELETED, snapshot_id, f)
+                   for f in live if f.path == target)
+    manifest = _write_manifest(table.table_path, entries, snapshot_id)
+    _commit(table, metadata, [manifest], snapshot_id, now_ms, metadata.schema,
+            metadata.properties, "delete", metadata.table_uuid)
+    return snapshot_id

@@ -36,6 +36,10 @@ def _builtin_providers() -> None:
         from hyperspace_tpu.sources.delta.provider import DeltaLakeSource
 
         register_provider("delta", DeltaLakeSource)
+    if "iceberg" not in PROVIDER_REGISTRY:
+        from hyperspace_tpu.sources.iceberg.provider import IcebergSource
+
+        register_provider("iceberg", IcebergSource)
 
 
 class FileBasedSourceProviderManager:

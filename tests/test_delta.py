@@ -255,3 +255,32 @@ class TestDeltaProvider:
         expected = q()
         assert got.sort_by("id").equals(expected.sort_by("id"))
         assert got.num_rows == 30  # one file's rows gone
+
+
+# ---------------------------------------------------------------------------
+# Regressions from review: schema handling on empty/overwritten tables
+# ---------------------------------------------------------------------------
+class TestDeltaSchemaEdges:
+    def test_empty_active_file_set_keeps_schema(self, session, tmp_path):
+        """A lake table whose every file was removed still scans with its
+        metadata schema — downstream projections must resolve."""
+        path = str(tmp_path / "t")
+        write_delta(_table([1, 2]), path)
+        f = DeltaLog(path).snapshot().files[0]
+        delete_where_file(path, f.path)
+        out = session.read.delta(path).select("id", "name").collect()
+        assert out.num_rows == 0
+        assert set(out.schema.names) == {"id", "name"}
+
+    def test_overwrite_commits_schema_change(self, session, tmp_path):
+        path = str(tmp_path / "t")
+        write_delta(pa.table({"a": pa.array([1], type=pa.int64())}), path)
+        write_delta(pa.table({"b": pa.array(["x"]),
+                              "c": pa.array([2], type=pa.int64())}),
+                    path, mode="overwrite")
+        snap = DeltaLog(path).snapshot()
+        names = [f["name"]
+                 for f in json.loads(snap.metadata.schema_string)["fields"]]
+        assert names == ["b", "c"]
+        out = session.read.delta(path).select("b", "c").collect()
+        assert out.num_rows == 1

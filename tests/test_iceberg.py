@@ -1,0 +1,322 @@
+"""Iceberg source provider tests.
+
+Mirrors the reference's IcebergIntegrationTest.scala (create/refresh/
+snapshot time travel) and HybridScanForIcebergTest.scala over our native
+metadata reader — no Spark, no iceberg-spark-runtime.  Also unit-tests the
+Avro object-container codec the manifests ride on.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+
+import pyarrow as pa
+import pytest
+
+from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
+from hyperspace_tpu.io import avro
+from hyperspace_tpu.sources.iceberg import (
+    IcebergTable,
+    delete_file_iceberg,
+    write_iceberg,
+)
+
+
+def _table(ids, names=None):
+    names = names or [f"n{i}" for i in ids]
+    return pa.table({"id": pa.array(ids, type=pa.int64()),
+                     "name": pa.array(names),
+                     "other": pa.array([i * 10 for i in ids], type=pa.int64())})
+
+
+@pytest.fixture()
+def session(tmp_index_root):
+    s = HyperspaceSession(system_path=tmp_index_root)
+    s.conf.num_buckets = 4
+    return s
+
+
+# ---------------------------------------------------------------------------
+# Avro codec unit tests
+# ---------------------------------------------------------------------------
+class TestAvro:
+    SCHEMA = {
+        "type": "record", "name": "rec",
+        "fields": [
+            {"name": "s", "type": "string"},
+            {"name": "n", "type": "long"},
+            {"name": "maybe", "type": ["null", "long"], "default": None},
+            {"name": "xs", "type": {"type": "array", "items": "int"}},
+            {"name": "kv", "type": {"type": "map", "values": "string"}},
+            {"name": "inner", "type": {
+                "type": "record", "name": "inner_rec",
+                "fields": [{"name": "d", "type": "double"},
+                           {"name": "b", "type": "boolean"}]}},
+        ],
+    }
+
+    def test_roundtrip(self, tmp_path):
+        recs = [
+            {"s": "héllo", "n": -(2**40), "maybe": None, "xs": [1, 2, 3],
+             "kv": {"a": "1"}, "inner": {"d": 2.5, "b": True}},
+            {"s": "", "n": 0, "maybe": 7, "xs": [],
+             "kv": {}, "inner": {"d": -0.5, "b": False}},
+        ]
+        path = str(tmp_path / "t.avro")
+        avro.write_container(path, self.SCHEMA, recs)
+        back, meta = avro.read_container_with_metadata(path)
+        assert back == recs
+        assert "avro.schema" in meta
+
+    def test_zigzag_varint(self):
+        for n in (0, -1, 1, 63, -64, 2**31, -(2**31), 2**62, -(2**62)):
+            buf = io.BytesIO()
+            avro.write_long(buf, n)
+            buf.seek(0)
+            assert avro.read_long(buf) == n
+
+    def test_bad_magic_raises(self, tmp_path):
+        path = str(tmp_path / "bad.avro")
+        with open(path, "wb") as f:
+            f.write(b"nope")
+        with pytest.raises(ValueError, match="container"):
+            avro.read_container(path)
+
+
+# ---------------------------------------------------------------------------
+# Table metadata unit tests
+# ---------------------------------------------------------------------------
+class TestIcebergTable:
+    def test_write_read_roundtrip(self, tmp_path):
+        path = str(tmp_path / "t")
+        snap_id = write_iceberg(_table([1, 2, 3]), path)
+        table = IcebergTable(path)
+        md = table.load_metadata()
+        assert md.current_snapshot_id == snap_id
+        files = table.plan_files()
+        assert len(files) == 1
+        assert all(os.path.isfile(f.path) for f in files)
+        assert files[0].record_count == 3
+        # Schema carries field ids (the Iceberg invariant).
+        assert [f["id"] for f in md.schema["fields"]] == [1, 2, 3]
+
+    def test_append_accumulates_files(self, tmp_path):
+        path = str(tmp_path / "t")
+        s0 = write_iceberg(_table([1, 2]), path)
+        s1 = write_iceberg(_table([3, 4]), path)
+        table = IcebergTable(path)
+        md = table.load_metadata()
+        assert len(md.snapshots) == 2
+        assert len(table.plan_files(md.snapshot_by_id(s0), md)) == 1
+        assert len(table.plan_files(md.snapshot_by_id(s1), md)) == 2
+
+    def test_overwrite_replaces_files(self, tmp_path):
+        path = str(tmp_path / "t")
+        write_iceberg(_table([1, 2]), path)
+        old = {f.path for f in IcebergTable(path).plan_files()}
+        write_iceberg(_table([9]), path, mode="overwrite")
+        new = {f.path for f in IcebergTable(path).plan_files()}
+        assert new.isdisjoint(old)
+        # Old files still exist on disk — only the metadata says they're gone.
+        assert all(os.path.isfile(p) for p in old)
+
+    def test_delete_file_commit(self, tmp_path):
+        path = str(tmp_path / "t")
+        write_iceberg(_table([1, 2]), path)
+        write_iceberg(_table([3, 4]), path)
+        files = IcebergTable(path).plan_files()
+        delete_file_iceberg(path, files[0].path)
+        left = IcebergTable(path).plan_files()
+        assert len(left) == 1
+        assert left[0].path != files[0].path
+
+    def test_snapshot_for_timestamp(self, tmp_path):
+        path = str(tmp_path / "t")
+        s0 = write_iceberg(_table([1]), path)
+        s1 = write_iceberg(_table([2]), path)
+        md = IcebergTable(path).load_metadata()
+        t0 = md.snapshot_by_id(s0).timestamp_ms
+        assert md.snapshot_for_timestamp(t0).snapshot_id == s0
+        t1 = md.snapshot_by_id(s1).timestamp_ms
+        assert md.snapshot_for_timestamp(t1).snapshot_id == s1
+        with pytest.raises(ValueError, match="No snapshot"):
+            md.snapshot_for_timestamp(t0 - 1)
+
+    def test_concurrent_metadata_commit_loses(self, tmp_path):
+        path = str(tmp_path / "t")
+        write_iceberg(_table([1]), path)
+        # Re-creating the same metadata version must fail (optimistic commit).
+        md_path = os.path.join(path, "metadata", "v1.metadata.json")
+        assert os.path.isfile(md_path)
+        with pytest.raises(FileExistsError):
+            with open(md_path, "x") as f:
+                f.write("{}")
+
+
+# ---------------------------------------------------------------------------
+# Provider integration (IcebergIntegrationTest analog)
+# ---------------------------------------------------------------------------
+class TestIcebergProvider:
+    def test_create_index_pins_snapshot(self, session, tmp_path):
+        path = str(tmp_path / "t")
+        snap = write_iceberg(_table([1, 2, 3, 4]), path)
+        hs = Hyperspace(session)
+        hs.create_index(session.read.iceberg(path),
+                        IndexConfig("iidx", ["id"], ["name"]))
+        entry = session.index_collection_manager.get_index("iidx")
+        rel = entry.relations[0]
+        assert rel.file_format == "iceberg"
+        assert rel.options["snapshot-id"] == str(snap)
+        assert "as-of-timestamp" in rel.options
+
+    def test_signature_is_snapshot_plus_location(self, session, tmp_path):
+        from hyperspace_tpu.plan.nodes import Scan
+
+        path = str(tmp_path / "t")
+        snap = write_iceberg(_table([1, 2]), path)
+        scan = session.read.iceberg(path).plan
+        assert isinstance(scan, Scan)
+        rel = session.source_provider_manager.get_relation(scan)
+        assert rel.signature() == f"{snap}{os.path.abspath(path)}"
+
+    def test_query_rewrite_and_answer_parity(self, session, tmp_path):
+        path = str(tmp_path / "t")
+        write_iceberg(_table(list(range(100))), path)
+        hs = Hyperspace(session)
+        hs.create_index(session.read.iceberg(path),
+                        IndexConfig("iidx", ["id"], ["name"]))
+
+        def q():
+            return (session.read.iceberg(path)
+                    .filter(col("id") == 42).select("id", "name").collect())
+
+        session.disable_hyperspace()
+        expected = q()
+        session.enable_hyperspace()
+        got = q()
+        assert got.equals(expected)
+        plan = (session.read.iceberg(path).filter(col("id") == 42)
+                .select("id", "name").optimized_plan())
+        scans = [s for s in plan.leaf_relations() if s.relation.index_scan_of]
+        assert scans, "index rewrite did not fire on an iceberg scan"
+
+    def test_stale_after_append_then_refresh(self, session, tmp_path):
+        path = str(tmp_path / "t")
+        write_iceberg(_table([1, 2, 3]), path)
+        hs = Hyperspace(session)
+        hs.create_index(session.read.iceberg(path),
+                        IndexConfig("iidx", ["id"], ["name"]))
+        write_iceberg(_table([4, 5]), path)
+        # Stale: signature (snapshot id) changed, so no rewrite.
+        session.enable_hyperspace()
+        plan = (session.read.iceberg(path).filter(col("id") == 4)
+                .select("id", "name").optimized_plan())
+        assert not [s for s in plan.leaf_relations() if s.relation.index_scan_of]
+        # Incremental refresh indexes only the appended file.
+        hs.refresh_index("iidx", "incremental")
+        plan = (session.read.iceberg(path).filter(col("id") == 4)
+                .select("id", "name").optimized_plan())
+        assert [s for s in plan.leaf_relations() if s.relation.index_scan_of]
+        got = (session.read.iceberg(path).filter(col("id") == 4)
+               .select("id", "name").collect())
+        assert got.num_rows == 1
+
+    def test_time_travel_snapshot_id_read(self, session, tmp_path):
+        path = str(tmp_path / "t")
+        s0 = write_iceberg(_table(list(range(20))), path)
+        write_iceberg(_table([100, 101]), path)
+        ds = session.read.iceberg(path, snapshot_id=str(s0))
+        got = ds.select("id").collect()
+        assert got.num_rows == 20  # no 100/101
+
+    def test_time_travel_as_of_timestamp_read(self, session, tmp_path):
+        path = str(tmp_path / "t")
+        s0 = write_iceberg(_table([1, 2]), path)
+        md = IcebergTable(path).load_metadata()
+        t0 = md.snapshot_by_id(s0).timestamp_ms
+        write_iceberg(_table([3]), path)
+        ds = session.read.iceberg(path, as_of_timestamp=str(t0))
+        assert ds.select("id").collect().num_rows == 2
+
+    def test_hybrid_scan_on_appended_iceberg(self, session, tmp_path):
+        path = str(tmp_path / "t")
+        write_iceberg(_table(list(range(50))), path)
+        hs = Hyperspace(session)
+        hs.create_index(session.read.iceberg(path),
+                        IndexConfig("iidx", ["id"], ["name"]))
+        write_iceberg(_table([100]), path)
+        session.conf.hybrid_scan_enabled = True
+        session.enable_hyperspace()
+
+        def q():
+            return (session.read.iceberg(path)
+                    .filter(col("id") >= 49).select("id", "name").collect())
+
+        got = q()
+        session.disable_hyperspace()
+        expected = q()
+        assert got.sort_by("id").equals(expected.sort_by("id"))
+
+    def test_deleted_file_hybrid_scan_with_lineage(self, session, tmp_path):
+        path = str(tmp_path / "t")
+        write_iceberg(_table(list(range(30))), path)
+        write_iceberg(_table(list(range(30, 60))), path)
+        session.conf.lineage_enabled = True
+        hs = Hyperspace(session)
+        hs.create_index(session.read.iceberg(path),
+                        IndexConfig("iidx", ["id"], ["name"]))
+        first = IcebergTable(path).plan_files()[0]
+        delete_file_iceberg(path, first.path)
+        session.conf.hybrid_scan_enabled = True
+        session.enable_hyperspace()
+
+        def q():
+            return (session.read.iceberg(path)
+                    .filter(col("id") >= 0).select("id", "name").collect())
+
+        got = q()
+        session.disable_hyperspace()
+        expected = q()
+        assert got.sort_by("id").equals(expected.sort_by("id"))
+        assert got.num_rows == 30
+
+    def test_refresh_drops_snapshot_pin(self, session, tmp_path):
+        from hyperspace_tpu.index.log_entry import Relation
+
+        path = str(tmp_path / "t")
+        write_iceberg(_table([1]), path)
+        mgr = session.source_provider_manager
+        rel = Relation(root_paths=[path], content=None, schema={},
+                       file_format="iceberg",
+                       options={"snapshot-id": "5", "as-of-timestamp": "7",
+                                "keep": "me"})
+        out = mgr.refresh_relation_metadata(rel)
+        assert "snapshot-id" not in out.options
+        assert "as-of-timestamp" not in out.options
+        assert out.options["keep"] == "me"
+
+
+# ---------------------------------------------------------------------------
+# Regressions from review: schema handling on empty/overwritten tables
+# ---------------------------------------------------------------------------
+class TestIcebergSchemaEdges:
+    def test_empty_active_file_set_keeps_schema(self, session, tmp_path):
+        path = str(tmp_path / "t")
+        write_iceberg(_table([1, 2]), path)
+        f = IcebergTable(path).plan_files()[0]
+        delete_file_iceberg(path, f.path)
+        out = session.read.iceberg(path).select("id", "name").collect()
+        assert out.num_rows == 0
+        assert set(out.schema.names) == {"id", "name"}
+
+    def test_overwrite_commits_schema_change(self, session, tmp_path):
+        path = str(tmp_path / "t")
+        write_iceberg(pa.table({"a": pa.array([1], type=pa.int64())}), path)
+        write_iceberg(pa.table({"b": pa.array(["x"]),
+                                "c": pa.array([2], type=pa.int64())}),
+                      path, mode="overwrite")
+        md = IcebergTable(path).load_metadata()
+        assert [f["name"] for f in md.schema["fields"]] == ["b", "c"]
+        out = session.read.iceberg(path).select("b", "c").collect()
+        assert out.num_rows == 1
