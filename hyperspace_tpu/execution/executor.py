@@ -52,6 +52,10 @@ class Executor:
         if isinstance(plan, Filter):
             return self._filter(plan)
         if isinstance(plan, Project):
+            if isinstance(plan.child, Scan):
+                # Scan pushdown: read only the projected columns from disk
+                # (the payoff of plan/pruning.py).
+                return self._scan(plan.child, columns=plan.columns)
             table = self.execute(plan.child)
             return table.select(plan.columns)
         if isinstance(plan, Join):
@@ -62,7 +66,7 @@ class Executor:
         raise ValueError(f"Unknown plan node: {type(plan).__name__}")
 
     # -- scan ---------------------------------------------------------------
-    def _scan(self, plan: Scan) -> pa.Table:
+    def _scan(self, plan: Scan, columns: Optional[List[str]] = None) -> pa.Table:
         rel = plan.relation
         read_format = physical_read_format(rel.file_format)
         lake_relation = None
@@ -90,14 +94,17 @@ class Executor:
             if all_paths:
                 schema = schema_to_arrow(read_schema(
                     all_paths[0], read_format, rel.options_dict))
-                return schema.empty_table()
-            if lake_relation is not None:
+                empty = schema.empty_table()
+            elif lake_relation is not None:
                 # A lake table whose active file set is empty still has a
                 # schema in its metadata — downstream Project/Filter must
                 # resolve against it, not against a column-less table.
-                return schema_to_arrow(lake_relation.schema()).empty_table()
-            return pa.table({})
-        return read_table(paths, read_format, None, rel.options_dict)
+                empty = schema_to_arrow(lake_relation.schema()).empty_table()
+            else:
+                return pa.table({})
+            return empty.select(columns) if columns else empty
+        out = read_table(paths, read_format, columns, rel.options_dict)
+        return out.select(columns) if columns else out
 
     # -- filter -------------------------------------------------------------
     def _filter(self, plan: Filter) -> pa.Table:

@@ -1,0 +1,106 @@
+"""Column pruning: push minimal Projects down to each relation.
+
+The reference's rules run AFTER Catalyst's ColumnPruning, so a join side's
+"required columns" (JoinIndexRule.scala:371-383) are already minimal; this
+engine owns its optimizer, so it needs the pass itself — without it a bare
+``orders.join(lineitem, ...)`` side demands every source column and no
+covering index can apply.  It is also what lets the executor push column
+selection into the Parquet reads (scan pushdown), which benefits the
+non-indexed path equally.
+
+Top-down pass: track the columns each subtree must produce; insert a Project
+directly above a Scan when the scan yields more than needed.  The plan ROOT's
+output is never changed — pruning only happens below nodes that declare
+their needs (Project) or split them (Join/Filter).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Set
+
+from hyperspace_tpu.plan.nodes import (
+    BucketUnion,
+    Filter,
+    Join,
+    LogicalPlan,
+    Project,
+    Scan,
+    Union,
+)
+from hyperspace_tpu.utils.resolver import resolve
+
+
+def prune_columns(plan: LogicalPlan, schema_of) -> LogicalPlan:
+    """``schema_of(scan)`` resolves leaf schemas (host callback, the same one
+    the rules use)."""
+    return _prune(plan, None, schema_of)
+
+
+def _prune(plan: LogicalPlan, required: Optional[Set[str]],
+           schema_of) -> LogicalPlan:
+    if isinstance(plan, Project):
+        # The project defines exactly what its subtree must produce.
+        child_required = set(plan.columns)
+        new_child = _prune(plan.child, child_required, schema_of)
+        if new_child is not plan.child:
+            return Project(plan.columns, new_child)
+        return plan
+    if isinstance(plan, Filter):
+        child_required = None if required is None else (
+            required | set(plan.condition.referenced_columns()))
+        new_child = _prune(plan.child, child_required, schema_of)
+        if new_child is not plan.child:
+            return Filter(plan.condition, new_child)
+        return plan
+    if isinstance(plan, Join):
+        cond_cols = set(plan.condition.referenced_columns())
+        left_schema = plan.left.output_columns(schema_of)
+        right_schema = plan.right.output_columns(schema_of)
+        if required is None:
+            side_requireds = [None, None]  # root output must keep every column
+        else:
+            side_requireds = [set(), set()]
+            for c in required | cond_cols:
+                on_left = resolve([c], left_schema) is not None
+                on_right = resolve([c], right_schema) is not None
+                if not on_left and not on_right:
+                    # Unresolvable column — pruning it away would silently
+                    # change semantics; leave both sides alone and let
+                    # execution surface the real error (same stance as the
+                    # Scan branch below).
+                    side_requireds = [None, None]
+                    break
+                if on_left:
+                    side_requireds[0].add(c)
+                if on_right:
+                    side_requireds[1].add(c)
+        sides = []
+        changed = False
+        for side, side_required in zip((plan.left, plan.right), side_requireds):
+            new_side = _prune(side, side_required, schema_of)
+            changed = changed or new_side is not side
+            sides.append(new_side)
+        if changed:
+            return Join(sides[0], sides[1], plan.condition, plan.how)
+        return plan
+    if isinstance(plan, (BucketUnion, Union)):
+        new_children = tuple(_prune(c, required, schema_of)
+                             for c in plan.children)
+        if any(n is not o for n, o in zip(new_children, plan.children)):
+            return plan.with_children(new_children)
+        return plan
+    if isinstance(plan, Scan):
+        if required is None:
+            return plan
+        schema = plan.output_columns(schema_of)
+        resolved = resolve(sorted(required), schema)
+        if resolved is None:
+            # Unresolvable columns — leave the scan alone; execution will
+            # surface the real error with full context.
+            return plan
+        if len(set(resolved)) >= len(schema):
+            return plan
+        # Keep schema order so the projected output is deterministic.
+        keep: List[str] = [c for c in schema if c in set(resolved)]
+        return Project(keep, plan)
+    return plan

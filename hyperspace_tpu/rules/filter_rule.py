@@ -88,19 +88,35 @@ class FilterIndexRule:
 def _extract_filter_node(plan: LogicalPlan
                          ) -> Optional[Tuple[Scan, Filter, Optional[List[str]]]]:
     """Match Project(Filter(Scan)) / Filter(Scan) (ExtractFilterNode,
-    FilterIndexRule.scala:158-186).  The rule applies at the plan root only —
-    mirroring the reference, which matches the operator pattern anywhere but
-    we keep single-query plans linear."""
-    if isinstance(plan, Project) and isinstance(plan.child, Filter) \
-            and isinstance(plan.child.child, Scan):
-        return plan.child.child, plan.child, list(plan.columns)
-    if isinstance(plan, Filter) and isinstance(plan.child, Scan):
-        return plan.child, plan, None
+    FilterIndexRule.scala:158-186), seeing through a pruning Project directly
+    over the Scan (plan/pruning.py inserts those; Catalyst instead embeds
+    pruning in the relation, so the reference never needed this)."""
+    if isinstance(plan, Project) and isinstance(plan.child, Filter):
+        scan = _scan_below(plan.child.child)
+        if scan is not None:
+            return scan, plan.child, list(plan.columns)
+    if isinstance(plan, Filter):
+        scan = _scan_below(plan.child)
+        if scan is not None:
+            # With no outer Project, the pruning Project (if any) defines the
+            # output columns.
+            cols = list(plan.child.columns) \
+                if isinstance(plan.child, Project) else None
+            return scan, plan, cols
     # Recurse into children so filters under joins/unions also rewrite.
     for child in plan.children:
         hit = _extract_filter_node(child)
         if hit is not None:
             return hit
+    return None
+
+
+def _scan_below(node: LogicalPlan) -> Optional[Scan]:
+    """The scan at ``node``, unwrapping at most one pruning Project."""
+    if isinstance(node, Scan):
+        return node
+    if isinstance(node, Project) and isinstance(node.child, Scan):
+        return node.child
     return None
 
 

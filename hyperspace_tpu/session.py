@@ -91,6 +91,15 @@ class HyperspaceSession:
     def schema_map_of(self, scan: Scan) -> Dict[str, str]:
         # Keyed by the frozen relation value, not object identity: id() can
         # be recycled after GC, and equal relations share one listing.
+        # Lake formats are NOT cached: the same relation value (path +
+        # options) can point at a different snapshot after an overwrite that
+        # changes the schema, so a value-keyed entry would go stale within a
+        # session.  Their schema read is metadata-only (no file listing).
+        from hyperspace_tpu.sources.interfaces import LAKE_DATA_FORMATS
+
+        if scan.relation.file_format.lower() in LAKE_DATA_FORMATS \
+                and scan.relation.file_paths is None:
+            return self.source_provider_manager.get_relation(scan).schema()
         key = scan.relation
         if key not in self._schema_cache:
             if scan.relation.file_paths is not None:
@@ -131,7 +140,15 @@ class HyperspaceSession:
         """Apply the rewrite rules if enabled — Join before Filter, the fixed
         order with the rationale in package.scala:25-35.  ACTIVE entries are
         loaded once and shared across both rules so per-scan signature
-        memoization (tags) carries over (RuleUtils.scala:59-74)."""
+        memoization (tags) carries over (RuleUtils.scala:59-74).
+
+        Column pruning always runs first — the reference's rules sit after
+        Catalyst's ColumnPruning, so minimal per-side column requirements are
+        a precondition the engine must establish itself (plan/pruning.py); it
+        also enables scan-level column pushdown for the non-indexed path."""
+        from hyperspace_tpu.plan.pruning import prune_columns
+
+        plan = prune_columns(plan, self.schema_of)
         if not self._hyperspace_enabled:
             return plan
         from hyperspace_tpu.index.log_entry import States

@@ -236,8 +236,3 @@ class IcebergTable:
 
 def iceberg_schema_fields(schema: Dict[str, Any]) -> List[Dict[str, Any]]:
     return list(schema.get("fields", []))
-
-
-# Iceberg primitive type name -> arrow type string (the engine's schema
-# vocabulary, io/columnar.py); shared table in io/schemas.py.
-from hyperspace_tpu.io.schemas import iceberg_type_to_arrow as arrow_type_for  # noqa: E402

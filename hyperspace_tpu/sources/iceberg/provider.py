@@ -28,11 +28,11 @@ from hyperspace_tpu.index.log_entry import (
     Relation,
 )
 from hyperspace_tpu.plan.nodes import Scan
+from hyperspace_tpu.io.schemas import arrow_schema_from_iceberg
 from hyperspace_tpu.sources.iceberg.metadata import (
     IcebergSnapshot,
     IcebergTable,
     TableMetadata,
-    arrow_type_for,
 )
 from hyperspace_tpu.sources.interfaces import FileBasedRelation, FileBasedSourceProvider
 
@@ -98,10 +98,8 @@ class IcebergRelation(FileBasedRelation):
                 for f in self._files_cache]
 
     def schema(self) -> Dict[str, str]:
-        fields = self._metadata().schema.get("fields", [])
-        if fields:
-            return {f["name"]: arrow_type_for(f.get("type"))
-                    for f in fields}
+        if self._metadata().schema.get("fields"):
+            return arrow_schema_from_iceberg(self._metadata().schema)
         files = self.all_files()
         if not files:
             raise FileNotFoundError(

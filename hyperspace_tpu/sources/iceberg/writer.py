@@ -39,6 +39,27 @@ def _new_snapshot_id() -> int:
     return uuid.uuid4().int & ((1 << 62) - 1)
 
 
+def _evolve_schema(metadata: TableMetadata, arrow_schema: pa.Schema) -> Dict:
+    """Schema for an overwrite with possibly-changed columns.  The spec
+    requires field ids to be unique across table HISTORY: a surviving column
+    (same name + type) keeps its id; anything else takes a fresh id above
+    last-column-id — reusing a dropped column's id would bind its historical
+    data to the new column in field-id-based readers."""
+    fresh = iceberg_schema(arrow_schema)
+    old_by_name = {f["name"]: f for f in metadata.schema.get("fields", [])}
+    next_id = max(metadata.last_column_id,
+                  max((f["id"] for f in old_by_name.values()), default=0))
+    fields = []
+    for f in fresh["fields"]:
+        old = old_by_name.get(f["name"])
+        if old is not None and old.get("type") == f["type"]:
+            fields.append({**f, "id": old["id"]})
+        else:
+            next_id += 1
+            fields.append({**f, "id": next_id})
+    return {"type": "struct", "schema-id": 0, "fields": fields}
+
+
 def _write_manifest(table_path: str, entries: List[Dict],
                     snapshot_id: int) -> Dict:
     name = f"{uuid.uuid4().hex}-m0.avro"
@@ -91,7 +112,10 @@ def _commit(table: IcebergTable, metadata: TableMetadata,
         "table-uuid": table_uuid,
         "location": table.table_path,
         "last-updated-ms": now_ms,
-        "last-column-id": max((f["id"] for f in schema["fields"]), default=0),
+        # Monotonic across history even if the highest-id column was dropped.
+        "last-column-id": max(
+            [f["id"] for f in schema["fields"]]
+            + [metadata.last_column_id if metadata else 0]),
         "schema": schema,
         "partition-spec": [],
         "properties": properties,
@@ -132,6 +156,8 @@ def write_iceberg(data: pa.Table, path: str, mode: str = "append") -> int:
     # stale schema metadata would make readers resolve the wrong column set.
     if metadata and mode == "append":
         schema = metadata.schema
+    elif metadata:
+        schema = _evolve_schema(metadata, data.schema)
     else:
         schema = iceberg_schema(data.schema)
     table_uuid = metadata.table_uuid if metadata else str(uuid.uuid4())

@@ -284,3 +284,22 @@ class TestDeltaSchemaEdges:
         assert names == ["b", "c"]
         out = session.read.delta(path).select("b", "c").collect()
         assert out.num_rows == 1
+
+    def test_join_resolves_schema_added_mid_session(self, session, tmp_path):
+        """A column added by overwrite must resolve in later queries of the
+        SAME session (lake schemas are not value-cached) — including through
+        the column-pruning pass over a join."""
+        t1, t2 = str(tmp_path / "t1"), str(tmp_path / "t2")
+        write_delta(pa.table({"k": pa.array([1, 2], type=pa.int64()),
+                              "a": pa.array([10, 20], type=pa.int64())}), t1)
+        write_delta(pa.table({"k": pa.array([1], type=pa.int64()),
+                              "v": pa.array([7], type=pa.int64())}), t2)
+        from hyperspace_tpu import col
+        session.read.delta(t1).select("k", "a").collect()  # warm caches
+        write_delta(pa.table({"k": pa.array([1], type=pa.int64()),
+                              "a": pa.array([30], type=pa.int64()),
+                              "b": pa.array(["x"])}), t1, mode="overwrite")
+        out = (session.read.delta(t1)
+               .join(session.read.delta(t2), col("k") == col("k"))
+               .select("b", "v").collect())
+        assert out.to_pydict() == {"b": ["x"], "v": [7]}

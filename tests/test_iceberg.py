@@ -320,3 +320,21 @@ class TestIcebergSchemaEdges:
         assert [f["name"] for f in md.schema["fields"]] == ["b", "c"]
         out = session.read.iceberg(path).select("b", "c").collect()
         assert out.num_rows == 1
+
+    def test_overwrite_keeps_field_id_history(self, tmp_path):
+        """Spec invariant: field ids are unique across table history —
+        surviving columns keep theirs, new columns take fresh ids above
+        last-column-id (never reusing a dropped column's id)."""
+        path = str(tmp_path / "t")
+        write_iceberg(pa.table({"a": pa.array([1], type=pa.int64())}), path)
+        write_iceberg(pa.table({"b": pa.array(["x"]),
+                                "a": pa.array([2], type=pa.int64())}),
+                      path, mode="overwrite")
+        md = IcebergTable(path).load_metadata()
+        ids = {f["name"]: f["id"] for f in md.schema["fields"]}
+        assert ids == {"b": 2, "a": 1}
+        write_iceberg(pa.table({"c": pa.array([1.5])}), path,
+                      mode="overwrite")
+        md = IcebergTable(path).load_metadata()
+        assert md.schema["fields"][0]["id"] == 3
+        assert md.last_column_id == 3

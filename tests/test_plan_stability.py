@@ -1,0 +1,234 @@
+"""Golden-file plan-stability suite.
+
+Mirrors the reference's goldstandard/PlanStabilitySuite.scala:81-283: a fixed
+query corpus is optimized against a fixed catalog of tables + indexes; the
+simplified plan string is compared byte-for-byte with a checked-in approved
+plan.  Any rule change that alters a plan shape fails here until the golden
+file is consciously regenerated:
+
+    HS_GENERATE_GOLDEN_FILES=1 python -m pytest tests/test_plan_stability.py
+
+Simplification (PlanStabilitySuite.scala:174-230 analog): absolute table
+paths are replaced by logical table names and index-data file lists by their
+count, so the string is machine- and tmpdir-independent.  The corpus is
+TPC-H-shaped (lineitem/orders/customer/part) — the reference uses TPC-DS
+table DDL the same way (goldstandard/TPCDSBase.scala:35+), with data
+generated deterministically (seed 0) so bucket-pruning decisions are stable.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
+
+APPROVED_DIR = os.path.join(os.path.dirname(__file__), "resources",
+                            "approved-plans-v1")
+GENERATE = os.environ.get("HS_GENERATE_GOLDEN_FILES") == "1"
+
+N_ROWS = 400
+NUM_BUCKETS = 4
+
+
+def _write(dirpath: str, table: pa.Table, n_files: int = 2) -> None:
+    os.makedirs(dirpath, exist_ok=True)
+    step = (table.num_rows + n_files - 1) // n_files
+    for i in range(n_files):
+        pq.write_table(table.slice(i * step, step),
+                       os.path.join(dirpath, f"part-{i:05d}.parquet"))
+
+
+@pytest.fixture(scope="module")
+def catalog(tmp_path_factory):
+    """TPC-H-shaped tables + the index set the corpus queries run against
+    (the TPCDSBase.scala:35+ role)."""
+    root = str(tmp_path_factory.mktemp("tpch"))
+    rng = np.random.default_rng(0)
+
+    okey = np.arange(N_ROWS, dtype=np.int64)
+    orders = pa.table({
+        "o_orderkey": okey,
+        "o_custkey": pa.array(rng.integers(0, 50, N_ROWS), type=pa.int64()),
+        "o_totalprice": pa.array(rng.uniform(1, 1000, N_ROWS),
+                                 type=pa.float64()),
+        "o_orderstatus": pa.array(
+            [("O", "F", "P")[i % 3] for i in range(N_ROWS)]),
+    })
+    lineitem = pa.table({
+        "l_orderkey": pa.array(rng.integers(0, N_ROWS, 4 * N_ROWS),
+                               type=pa.int64()),
+        "l_partkey": pa.array(rng.integers(0, 100, 4 * N_ROWS),
+                              type=pa.int64()),
+        "l_quantity": pa.array(rng.integers(1, 50, 4 * N_ROWS),
+                               type=pa.int64()),
+        "l_extendedprice": pa.array(rng.uniform(1, 100, 4 * N_ROWS),
+                                    type=pa.float64()),
+    })
+    customer = pa.table({
+        "c_custkey": np.arange(50, dtype=np.int64),
+        "c_name": pa.array([f"Customer#{i:09d}" for i in range(50)]),
+        "c_mktsegment": pa.array(
+            [("BUILDING", "MACHINERY", "AUTOMOBILE")[i % 3]
+             for i in range(50)]),
+    })
+    part = pa.table({
+        "p_partkey": np.arange(100, dtype=np.int64),
+        "p_name": pa.array([f"part {i}" for i in range(100)]),
+    })
+
+    paths = {name: os.path.join(root, name)
+             for name in ("orders", "lineitem", "customer", "part")}
+    _write(paths["orders"], orders)
+    _write(paths["lineitem"], lineitem, n_files=4)
+    _write(paths["customer"], customer, n_files=1)
+    _write(paths["part"], part, n_files=1)
+
+    session = HyperspaceSession(system_path=os.path.join(root, "indexes"))
+    session.conf.num_buckets = NUM_BUCKETS
+    hs = Hyperspace(session)
+    read = session.read
+    hs.create_index(read.parquet(paths["orders"]),
+                    IndexConfig("idx_orders_okey", ["o_orderkey"],
+                                ["o_totalprice", "o_custkey"]))
+    hs.create_index(read.parquet(paths["orders"]),
+                    IndexConfig("idx_orders_ckey", ["o_custkey"],
+                                ["o_orderkey", "o_orderstatus"]))
+    hs.create_index(read.parquet(paths["lineitem"]),
+                    IndexConfig("idx_line_okey", ["l_orderkey"],
+                                ["l_quantity", "l_extendedprice"]))
+    hs.create_index(read.parquet(paths["lineitem"]),
+                    IndexConfig("idx_line_pkey", ["l_partkey"],
+                                ["l_quantity"]))
+    hs.create_index(read.parquet(paths["customer"]),
+                    IndexConfig("idx_cust_ckey", ["c_custkey"],
+                                ["c_name", "c_mktsegment"]))
+    session.enable_hyperspace()
+    return session, paths
+
+
+def _queries(session, paths):
+    """The corpus: name -> Dataset.  Shapes chosen to pin every rule branch:
+    filter rewrites (point/range/conjunction), join rewrites (equi-join both
+    sides indexed, join-then-filter), and negative cases that must NOT
+    rewrite (uncovered column, first-indexed-col missing)."""
+    read = session.read
+    orders = lambda: read.parquet(paths["orders"])  # noqa: E731
+    lineitem = lambda: read.parquet(paths["lineitem"])  # noqa: E731
+    customer = lambda: read.parquet(paths["customer"])  # noqa: E731
+    part = lambda: read.parquet(paths["part"])  # noqa: E731
+    return {
+        # FilterIndexRule family
+        "q01_point_filter": orders()
+            .filter(col("o_orderkey") == 42)
+            .select("o_orderkey", "o_totalprice"),
+        "q02_range_filter": lineitem()
+            .filter(col("l_orderkey") >= 100)
+            .select("l_orderkey", "l_quantity"),
+        "q03_conjunctive_filter": orders()
+            .filter((col("o_orderkey") == 7) & (col("o_totalprice") > 10.0))
+            .select("o_orderkey", "o_totalprice"),
+        "q04_filter_second_index": orders()
+            .filter(col("o_custkey") == 3)
+            .select("o_custkey", "o_orderstatus"),
+        # negative: filtered column is not the first indexed column
+        "q05_no_rewrite_not_first_col": orders()
+            .filter(col("o_totalprice") > 500.0)
+            .select("o_orderkey", "o_totalprice"),
+        # negative: output needs a column no index covers
+        "q06_no_rewrite_uncovered": part()
+            .filter(col("p_partkey") == 5)
+            .select("p_partkey", "p_name"),
+        # JoinIndexRule family
+        "q07_join_orders_lineitem": orders().join(
+            lineitem(), col("o_orderkey") == col("l_orderkey"))
+            .select("o_orderkey", "l_quantity"),
+        "q08_join_customer_orders": customer().join(
+            orders(), col("c_custkey") == col("o_custkey"))
+            .select("c_name", "o_orderkey"),
+        "q09_join_then_filter": orders().join(
+            lineitem(), col("o_orderkey") == col("l_orderkey"))
+            .filter(col("l_quantity") >= 25)
+            .select("o_orderkey", "l_quantity"),
+        # negative: join side needs an uncovered column
+        "q10_join_no_rewrite_uncovered": part().join(
+            lineitem(), col("p_partkey") == col("l_partkey"))
+            .select("p_name", "l_quantity"),
+        # filter on top of a projected join input (linear-side check)
+        "q11_filtered_join_side": orders()
+            .filter(col("o_orderkey") >= 0).join(
+                lineitem(), col("o_orderkey") == col("l_orderkey"))
+            .select("o_orderkey", "l_extendedprice"),
+        # point filter that prunes to a single bucket
+        "q12_bucket_pruned_point": lineitem()
+            .filter(col("l_partkey") == 33)
+            .select("l_partkey", "l_quantity"),
+    }
+
+
+def _simplify(plan_string: str, paths) -> str:
+    """Make the plan string machine-independent
+    (PlanStabilitySuite.scala:174-230): table paths -> logical names; any
+    other absolute path -> <path>."""
+    out = plan_string
+    for name, p in sorted(paths.items(), key=lambda kv: -len(kv[1])):
+        out = out.replace(os.path.abspath(p), f"<{name}>")
+    # Only multi-segment paths — a bare "/N" (e.g. "[buckets: 1/4]") stays.
+    out = re.sub(r"/(?:[^\s,)\]/]+/)+[^\s,)\]/]*", "<path>", out)
+    return out + "\n"
+
+
+QUERY_NAMES = [f"q{i:02d}" for i in range(1, 13)]
+
+
+def _query_by_prefix(queries, prefix):
+    matches = [k for k in queries if k.startswith(prefix)]
+    assert len(matches) == 1, f"{prefix}: {matches}"
+    return matches[0]
+
+
+@pytest.mark.parametrize("prefix", QUERY_NAMES)
+def test_plan_stability(catalog, prefix):
+    session, paths = catalog
+    queries = _queries(session, paths)
+    name = _query_by_prefix(queries, prefix)
+    plan = queries[name].optimized_plan()
+    simplified = _simplify(plan.tree_string(), paths)
+
+    approved_path = os.path.join(APPROVED_DIR, name, "simplified.txt")
+    if GENERATE:
+        os.makedirs(os.path.dirname(approved_path), exist_ok=True)
+        with open(approved_path, "w", encoding="utf-8") as f:
+            f.write(simplified)
+        return
+    assert os.path.isfile(approved_path), (
+        f"No approved plan for {name}; run with HS_GENERATE_GOLDEN_FILES=1 "
+        f"to create it")
+    with open(approved_path, "r", encoding="utf-8") as f:
+        approved = f.read()
+    assert simplified == approved, (
+        f"Plan for {name} changed.\n--- approved ---\n{approved}\n"
+        f"--- current ---\n{simplified}\n"
+        f"If intentional, regenerate with HS_GENERATE_GOLDEN_FILES=1")
+
+
+def test_expected_rewrites_fired(catalog):
+    """Sanity net under the goldens: the positive queries must be rewritten,
+    the negative ones must not (E2EHyperspaceRulesTest's verifyIndexUsage
+    analog, so a golden regenerated from a silently-broken optimizer can't
+    freeze the breakage in)."""
+    session, paths = catalog
+    queries = _queries(session, paths)
+    must_rewrite = {k for k in queries if "no_rewrite" not in k}
+    for name, ds in queries.items():
+        plan = ds.optimized_plan()
+        used = [s for s in plan.leaf_relations() if s.relation.index_scan_of]
+        if name in must_rewrite:
+            assert used, f"{name}: expected an index rewrite"
+        else:
+            assert not used, f"{name}: unexpected index rewrite"
