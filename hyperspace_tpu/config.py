@@ -30,6 +30,9 @@ SUPPORTED_FILE_FORMATS = "hyperspace.index.supportedFileFormats"
 DEVICE_BATCH_ROWS = "hyperspace.tpu.deviceBatchRows"
 PARALLEL_BUILD = "hyperspace.tpu.parallelBuild"
 SHUFFLE_CAPACITY_SLACK = "hyperspace.tpu.shuffleCapacitySlack"
+DISPLAY_MODE = "hyperspace.explain.displayMode"
+HIGHLIGHT_BEGIN_TAG = "hyperspace.explain.displayMode.highlight.beginTag"
+HIGHLIGHT_END_TAG = "hyperspace.explain.displayMode.highlight.endTag"
 
 _DEFAULT_NUM_BUCKETS = 200  # IndexConstants.scala:31-32 (spark.sql.shuffle.partitions default)
 
@@ -70,6 +73,11 @@ class HyperspaceConf:
     # the perfectly-balanced per-destination row count (doubled on overflow).
     parallel_build: str = "auto"
     shuffle_capacity_slack: float = 1.5
+    # Explain output rendering (IndexConstants.scala:69-80): "plaintext",
+    # "html", or "console"; custom highlight tags override the mode default.
+    display_mode: str = "plaintext"
+    highlight_begin_tag: str = ""
+    highlight_end_tag: str = ""
 
     _FIELD_BY_KEY = {
         SYSTEM_PATH: "system_path",
@@ -88,6 +96,9 @@ class HyperspaceConf:
         DEVICE_BATCH_ROWS: "device_batch_rows",
         PARALLEL_BUILD: "parallel_build",
         SHUFFLE_CAPACITY_SLACK: "shuffle_capacity_slack",
+        DISPLAY_MODE: "display_mode",
+        HIGHLIGHT_BEGIN_TAG: "highlight_begin_tag",
+        HIGHLIGHT_END_TAG: "highlight_end_tag",
     }
 
     def set(self, key: str, value: Any) -> None:

@@ -101,7 +101,7 @@ class Executor:
                 # resolve against it, not against a column-less table.
                 empty = schema_to_arrow(lake_relation.schema()).empty_table()
             else:
-                return pa.table({})
+                empty = pa.table({})
             return empty.select(columns) if columns else empty
         out = read_table(paths, read_format, columns, rel.options_dict)
         return out.select(columns) if columns else out

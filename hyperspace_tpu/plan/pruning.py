@@ -42,6 +42,13 @@ def _prune(plan: LogicalPlan, required: Optional[Set[str]],
         # The project defines exactly what its subtree must produce.
         child_required = set(plan.columns)
         new_child = _prune(plan.child, child_required, schema_of)
+        # Collapse Project(A, Project(B, x)) when A ⊆ B — in particular the
+        # pruning Project this pass just inserted under a user Project (B=A).
+        # Keeps optimize() idempotent and leaves scans one Project away for
+        # the rules' pattern matching.
+        if isinstance(new_child, Project) \
+                and set(plan.columns) <= set(new_child.columns):
+            new_child = new_child.child
         if new_child is not plan.child:
             return Project(plan.columns, new_child)
         return plan

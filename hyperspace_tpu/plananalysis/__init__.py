@@ -1,0 +1,13 @@
+from hyperspace_tpu.plananalysis.display import (
+    BufferStream,
+    ConsoleMode,
+    DisplayMode,
+    HTMLMode,
+    PlainTextMode,
+    Tag,
+    get_display_mode,
+)
+from hyperspace_tpu.plananalysis.explain import explain_string
+
+__all__ = ["BufferStream", "ConsoleMode", "DisplayMode", "HTMLMode",
+           "PlainTextMode", "Tag", "get_display_mode", "explain_string"]

@@ -1,18 +1,25 @@
-"""Explain: side-by-side plans with and without indexes.
+"""Explain: side-by-side plans with and without indexes, diff-highlighted.
 
 Reference contract: index/plananalysis/PlanAnalyzer.scala:46-130 — compile
-the plan twice (hyperspace enabled/disabled around the optimizer,
-:167-182), render both trees, list the indexes used, and in verbose mode a
-physical-operator count comparison (PhysicalOperatorAnalyzer.scala:30-58 —
-the operators the rewrite removes, e.g. shuffles, are what users look for).
+the plan twice (hyperspace enabled/disabled around the optimizer, :167-182),
+diff the two trees top-down and highlight the differing subtrees (:60-105:
+when nodes differ, the whole subtrees from the first differing node are
+highlighted), list the indexes used with their locations (:212-223), and in
+verbose mode a physical-operator count comparison
+(PhysicalOperatorAnalyzer.scala:30-58).  Output rendering goes through the
+display modes (plananalysis/display.py).
 """
 
 from __future__ import annotations
 
 from collections import Counter
-from typing import Dict, List
+from typing import List, Optional, Tuple
 
 from hyperspace_tpu.plan.nodes import LogicalPlan, Scan
+from hyperspace_tpu.plananalysis.display import BufferStream, get_display_mode
+
+# (text, highlighted) per rendered plan line.
+_Line = Tuple[str, bool]
 
 
 def _used_indexes(plan: LogicalPlan) -> List[str]:
@@ -32,6 +39,48 @@ def _operator_counts(plan: LogicalPlan) -> Counter:
     return counts
 
 
+def _subtree_lines(node: LogicalPlan, indent: int,
+                   highlighted: bool) -> List[_Line]:
+    lines = [("  " * indent + node.simple_string(), highlighted)]
+    for c in node.children:
+        lines.extend(_subtree_lines(c, indent + 1, highlighted))
+    return lines
+
+
+def _diff_lines(a: Optional[LogicalPlan], b: Optional[LogicalPlan],
+                indent: int = 0) -> Tuple[List[_Line], List[_Line]]:
+    """Render both trees, highlighting differing subtrees — once two nodes
+    differ, their whole subtrees are highlighted (the reference's
+    moveNextSubtree behavior, PlanAnalyzer.scala:88-97)."""
+    if a is None and b is None:
+        return [], []
+    if a is None or b is None or a.simple_string() != b.simple_string() \
+            or len(a.children) != len(b.children):
+        return (_subtree_lines(a, indent, True) if a else [],
+                _subtree_lines(b, indent, True) if b else [])
+    out_a = [("  " * indent + a.simple_string(), False)]
+    out_b = [("  " * indent + b.simple_string(), False)]
+    for ca, cb in zip(a.children, b.children):
+        la, lb = _diff_lines(ca, cb, indent + 1)
+        out_a.extend(la)
+        out_b.extend(lb)
+    return out_a, out_b
+
+
+def _write_plan(stream: BufferStream, lines: List[_Line]) -> None:
+    for text, highlighted in lines:
+        if highlighted:
+            stream.highlight(text)
+            stream.write_line()
+        else:
+            stream.write_line(text)
+
+
+def _build_header(stream: BufferStream, title: str) -> None:
+    bar = "=" * 64
+    stream.write_line(bar).write_line(title).write_line(bar)
+
+
 def explain_string(dataset, session, verbose: bool = False) -> str:
     """Hyperspace.explain analog (Hyperspace.scala:152-155)."""
     was_enabled = session.is_hyperspace_enabled()
@@ -39,18 +88,28 @@ def explain_string(dataset, session, verbose: bool = False) -> str:
         session.enable_hyperspace()
         plan_with = session.optimize(dataset.plan)
         session.disable_hyperspace()
-        plan_without = dataset.plan
+        # Optimized without the index rules (column pruning still runs), the
+        # same both-sides-compiled comparison as PlanAnalyzer.scala:167-182.
+        plan_without = session.optimize(dataset.plan)
     finally:
         if was_enabled:
             session.enable_hyperspace()
         else:
             session.disable_hyperspace()
 
-    lines: List[str] = []
-    bar = "=" * 64
-    lines += [bar, "Plan with indexes:", bar, plan_with.tree_string(), ""]
-    lines += [bar, "Plan without indexes:", bar, plan_without.tree_string(), ""]
-    lines += [bar, "Indexes used:", bar]
+    mode = get_display_mode(session.conf)
+    stream = BufferStream(mode)
+    lines_with, lines_without = _diff_lines(plan_with, plan_without)
+
+    _build_header(stream, "Plan with indexes:")
+    _write_plan(stream, lines_with)
+    stream.write_line()
+
+    _build_header(stream, "Plan without indexes:")
+    _write_plan(stream, lines_without)
+    stream.write_line()
+
+    _build_header(stream, "Indexes used:")
     used = _used_indexes(plan_with)
     if used:
         from hyperspace_tpu.index.manager import IndexCollectionManager
@@ -65,19 +124,21 @@ def explain_string(dataset, session, verbose: bool = False) -> str:
                     import os
 
                     location = os.path.dirname(files[0].name)
-            lines.append(f"{name}:{location}")
+            stream.write_line(f"{name}:{location}")
     else:
-        lines.append("(none)")
-    lines.append("")
+        stream.write_line("(none)")
+    stream.write_line()
+
     if verbose:
-        lines += [bar, "Physical operator stats:", bar]
+        _build_header(stream, "Physical operator stats:")
         with_counts = _operator_counts(plan_with)
         without_counts = _operator_counts(plan_without)
         ops = sorted(set(with_counts) | set(without_counts))
-        header = f"{'Physical Operator':<24}{'Hyperspace Disabled':>22}{'Enabled':>10}{'Diff':>8}"
-        lines.append(header)
+        stream.write_line(
+            f"{'Physical Operator':<24}{'Hyperspace Disabled':>22}"
+            f"{'Enabled':>10}{'Diff':>8}")
         for op in ops:
             a, b = without_counts.get(op, 0), with_counts.get(op, 0)
-            lines.append(f"{op:<24}{a:>22}{b:>10}{b - a:>+8}")
-        lines.append("")
-    return "\n".join(lines)
+            stream.write_line(f"{op:<24}{a:>22}{b:>10}{b - a:>+8}")
+        stream.write_line()
+    return stream.with_tag()

@@ -72,6 +72,10 @@ class HyperspaceSession:
             self.conf.system_path = system_path
         self._hyperspace_enabled = False
         self._schema_cache: Dict[object, Dict[str, str]] = {}
+        # Lake-schema memo, live only inside one optimize() pass: a query
+        # sees one snapshot, so memoizing there is safe; across queries it
+        # would go stale (overwrites can change the schema mid-session).
+        self._lake_schema_memo: Optional[Dict[object, Dict[str, str]]] = None
 
     # -- plumbing -----------------------------------------------------------
     @property
@@ -99,7 +103,13 @@ class HyperspaceSession:
 
         if scan.relation.file_format.lower() in LAKE_DATA_FORMATS \
                 and scan.relation.file_paths is None:
-            return self.source_provider_manager.get_relation(scan).schema()
+            memo = self._lake_schema_memo
+            if memo is None:
+                return self.source_provider_manager.get_relation(scan).schema()
+            if scan.relation not in memo:
+                memo[scan.relation] = \
+                    self.source_provider_manager.get_relation(scan).schema()
+            return memo[scan.relation]
         key = scan.relation
         if key not in self._schema_cache:
             if scan.relation.file_paths is not None:
@@ -148,18 +158,23 @@ class HyperspaceSession:
         also enables scan-level column pushdown for the non-indexed path."""
         from hyperspace_tpu.plan.pruning import prune_columns
 
-        plan = prune_columns(plan, self.schema_of)
-        if not self._hyperspace_enabled:
-            return plan
-        from hyperspace_tpu.index.log_entry import States
-        from hyperspace_tpu.rules.filter_rule import FilterIndexRule
-        from hyperspace_tpu.rules.join_rule import JoinIndexRule
+        self._lake_schema_memo = {}
+        try:
+            plan = prune_columns(plan, self.schema_of)
+            if not self._hyperspace_enabled:
+                return plan
+            from hyperspace_tpu.index.log_entry import States
+            from hyperspace_tpu.rules.filter_rule import FilterIndexRule
+            from hyperspace_tpu.rules.join_rule import JoinIndexRule
 
-        entries = self.index_collection_manager.get_indexes([States.ACTIVE])
-        # Cached entries outlive a query; tags memoize per-plan-node state and
-        # id()s can be recycled across queries, so start each pass clean.
-        for e in entries:
-            e._tags.clear()
-        plan = JoinIndexRule(self, entries).apply(plan)
-        plan = FilterIndexRule(self, entries).apply(plan)
-        return plan
+            entries = self.index_collection_manager.get_indexes([States.ACTIVE])
+            # Cached entries outlive a query; tags memoize per-plan-node
+            # state and id()s can be recycled across queries, so start each
+            # pass clean.
+            for e in entries:
+                e._tags.clear()
+            plan = JoinIndexRule(self, entries).apply(plan)
+            plan = FilterIndexRule(self, entries).apply(plan)
+            return plan
+        finally:
+            self._lake_schema_memo = None

@@ -303,3 +303,15 @@ class TestDeltaSchemaEdges:
                .join(session.read.delta(t2), col("k") == col("k"))
                .select("b", "v").collect())
         assert out.to_pydict() == {"b": ["x"], "v": [7]}
+
+    def test_mixed_schema_pushdown_promotes_nulls(self, session, tmp_path):
+        """Column added by a later append: pushdown reads each file's
+        available subset and concat fills nulls (no per-file crash)."""
+        path = str(tmp_path / "t")
+        write_delta(pa.table({"k": pa.array([1, 2], type=pa.int64())}), path)
+        write_delta(pa.table({"k": pa.array([3], type=pa.int64()),
+                              "v": pa.array([9], type=pa.int64())}),
+                    path, mode="append")
+        out = session.read.delta(path).select("k", "v").collect()
+        assert out.sort_by("k").to_pydict() == {"k": [1, 2, 3],
+                                                "v": [None, None, 9]}

@@ -239,3 +239,29 @@ def test_join_rule_two_different_relations(env, tmp_path):
     assert {s.relation.index_scan_of for s in scans} == {"idxL", "idxR"}
     assert _sorted_rows(query().collect()) == _sorted_rows(expected)
     assert expected.num_rows > 0
+
+
+class TestPruningInteraction:
+    """Regressions: the pruning pass must not stack Projects or hide scans
+    from the rules' pattern matching."""
+
+    def test_select_then_filter_rewrites(self, env):
+        session, hs, data_dir = env
+        hs.create_index(session.read.parquet(data_dir),
+                        IndexConfig("pidx", ["id"], ["name"]))
+        session.enable_hyperspace()
+        ds = (session.read.parquet(data_dir)
+              .select("id", "name").filter(col("id") == 1))
+        plan = ds.optimized_plan()
+        assert _index_scans(plan), plan.tree_string()
+
+    def test_optimize_is_idempotent(self, env):
+        session, hs, data_dir = env
+        hs.create_index(session.read.parquet(data_dir),
+                        IndexConfig("pidx", ["id"], ["name"]))
+        session.enable_hyperspace()
+        ds = (session.read.parquet(data_dir)
+              .select("id", "name").filter(col("id") == 1))
+        once = ds.optimized_plan()
+        twice = session.optimize(once)
+        assert twice.tree_string() == once.tree_string()

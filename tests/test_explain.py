@@ -1,0 +1,154 @@
+"""Explain / plan-analysis tests.
+
+Mirrors the reference's ExplainTest.scala (side-by-side output shape,
+highlight markers, used-index list, verbose operator stats),
+DisplayModeTest.scala (mode tags + custom highlight overrides), and
+BufferStreamTest.scala (highlight keeps indentation outside the tags).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
+from hyperspace_tpu.plananalysis import (
+    BufferStream,
+    ConsoleMode,
+    HTMLMode,
+    PlainTextMode,
+    get_display_mode,
+)
+
+
+@pytest.fixture()
+def session(tmp_index_root, tmp_path):
+    s = HyperspaceSession(system_path=tmp_index_root)
+    s.conf.num_buckets = 4
+    n = 100
+    table = pa.table({
+        "id": np.arange(n, dtype=np.int64),
+        "name": pa.array([f"n{i}" for i in range(n)]),
+        "other": pa.array(np.arange(n) * 2, type=pa.int64()),
+    })
+    data = tmp_path / "data"
+    data.mkdir()
+    pq.write_table(table, str(data / "part-0.parquet"))
+    s.data_path = str(data)
+    return s
+
+
+class TestDisplayModes:
+    def test_plaintext_default_tags(self):
+        mode = PlainTextMode()
+        assert mode.highlight_tag.open == "<----"
+        assert mode.highlight_tag.close == "---->"
+        assert mode.begin_end_tag.open == ""
+        assert mode.new_line == "\n"
+
+    def test_html_tags(self):
+        mode = HTMLMode()
+        assert mode.begin_end_tag.open == "<pre>"
+        assert mode.begin_end_tag.close == "</pre>"
+        assert mode.new_line == "<br>"
+        assert "LightGreen" in mode.highlight_tag.open
+
+    def test_console_tags(self):
+        mode = ConsoleMode()
+        assert mode.highlight_tag.open == "\033[42m"
+        assert mode.highlight_tag.close == "\033[0m"
+
+    def test_custom_highlight_override(self):
+        from hyperspace_tpu.config import HyperspaceConf
+
+        conf = HyperspaceConf()
+        conf.display_mode = "html"
+        conf.highlight_begin_tag = "**"
+        conf.highlight_end_tag = "**"
+        mode = get_display_mode(conf)
+        assert isinstance(mode, HTMLMode)
+        assert mode.highlight_tag.open == "**"
+
+    def test_unknown_mode_raises(self):
+        from hyperspace_tpu.config import HyperspaceConf
+
+        conf = HyperspaceConf()
+        conf.display_mode = "nope"
+        with pytest.raises(ValueError, match="display mode"):
+            get_display_mode(conf)
+
+
+class TestBufferStream:
+    def test_highlight_keeps_indentation_outside_tags(self):
+        stream = BufferStream(PlainTextMode())
+        stream.highlight("    Scan foo  ")
+        assert str(stream) == "    <----Scan foo---->  "
+
+    def test_highlight_blank_passthrough(self):
+        stream = BufferStream(PlainTextMode())
+        stream.highlight("   ")
+        assert str(stream) == "   "
+
+    def test_with_tag_wraps_html(self):
+        stream = BufferStream(HTMLMode())
+        stream.write_line("x")
+        assert stream.with_tag() == "<pre>x<br></pre>"
+
+
+class TestExplain:
+    def _indexed_session(self, session):
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(session.data_path),
+                        IndexConfig("eidx", ["id"], ["name"]))
+        return hs
+
+    def test_explain_shape_and_highlight(self, session):
+        hs = self._indexed_session(session)
+        ds = (session.read.parquet(session.data_path)
+              .filter(col("id") == 1).select("id", "name"))
+        out = hs.explain(ds)
+        assert "Plan with indexes:" in out
+        assert "Plan without indexes:" in out
+        assert "Indexes used:" in out
+        assert "eidx" in out
+        # The differing scans are highlighted; shared nodes are not.
+        assert "<----Scan Hyperspace(Type: CI, Name: eidx)" in out
+        with_section = out.split("Plan without indexes:")[0]
+        assert "<----Filter" not in with_section
+
+    def test_explain_no_indexes_used(self, session):
+        hs = Hyperspace(session)
+        ds = session.read.parquet(session.data_path).filter(col("id") == 1)
+        out = hs.explain(ds)
+        assert "(none)" in out
+
+    def test_explain_verbose_operator_stats(self, session):
+        hs = self._indexed_session(session)
+        ds = (session.read.parquet(session.data_path)
+              .filter(col("id") == 1).select("id", "name"))
+        out = hs.explain(ds, verbose=True)
+        assert "Physical operator stats:" in out
+        assert "Scan" in out
+
+    def test_explain_html_mode(self, session):
+        hs = self._indexed_session(session)
+        session.conf.display_mode = "html"
+        ds = (session.read.parquet(session.data_path)
+              .filter(col("id") == 1).select("id", "name"))
+        out = hs.explain(ds)
+        assert out.startswith("<pre>")
+        assert out.endswith("</pre>")
+        assert "<br>" in out
+        assert "LightGreen" in out
+
+    def test_explain_restores_enabled_state(self, session):
+        hs = self._indexed_session(session)
+        ds = session.read.parquet(session.data_path).filter(col("id") == 1)
+        session.enable_hyperspace()
+        hs.explain(ds)
+        assert session.is_hyperspace_enabled()
+        session.disable_hyperspace()
+        hs.explain(ds)
+        assert not session.is_hyperspace_enabled()
