@@ -39,14 +39,19 @@ class FileBasedSignatureProvider(SignatureProvider):
         leaves = plan.leaf_relations()
         if not leaves:
             return None
-        parts: List[str] = []
+        infos: List[FileInfo] = []
         for scan in leaves:
             files = all_files_of(scan)
             if files is None:
                 return None
-            for f in files:
-                parts.append(f"{f.size}{f.mtime}{f.name}")
-        return fold_md5(parts)
+            infos.extend(files)
+        from hyperspace_tpu import native
+
+        folded = native.fold_md5_files(
+            [(f.name, f.size, f.mtime) for f in infos])
+        if folded is not None:
+            return folded
+        return fold_md5(f"{f.size}{f.mtime}{f.name}" for f in infos)
 
 
 class PlanSignatureProvider(SignatureProvider):

@@ -19,10 +19,29 @@ def list_data_files(root_paths: Sequence[str],
                     tracker: Optional[FileIdTracker] = None,
                     extension: Optional[str] = None) -> List[FileInfo]:
     """All data files under ``root_paths`` (each a file or directory),
-    registered with ``tracker`` when given."""
+    registered with ``tracker`` when given.
+
+    Walk + stat go through the native runtime when available
+    (native/hs_native.cc — the per-query signature check makes this the
+    metadata hot loop); the Python fallback below is byte-identical.
+    """
+    from hyperspace_tpu import native
+
+    normalized = [normalize_path(r) for r in root_paths]
+    scanned = native.scan_files(normalized)
+    if scanned is not None:
+        out = []
+        for path, size, mtime in scanned:
+            if extension and not path.endswith(extension):
+                continue
+            fid = tracker.add_file(path, size, mtime) \
+                if tracker is not None else -1
+            out.append(FileInfo(path, size, mtime, fid))
+        out.sort(key=lambda f: f.name)
+        return out
+
     out: List[FileInfo] = []
-    for root in root_paths:
-        root = normalize_path(root)
+    for root in normalized:
         if os.path.isfile(root):
             out.append(_file_info(root, tracker))
         elif os.path.isdir(root):

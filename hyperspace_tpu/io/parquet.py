@@ -52,13 +52,17 @@ def read_table(paths: Sequence[str], file_format: str = "parquet",
 def _read_one(path: str, file_format: str, columns, options: Dict[str, str]) -> pa.Table:
     if file_format == "parquet":
         if columns:
-            # Only request columns the file actually has: mixed-schema file
-            # sets (a column added by a later append) must read with null
-            # promotion at concat, not crash the per-file read.  An empty
-            # intersection still reads zero columns (row count preserved).
-            present = set(pq.read_schema(path).names)
-            return pq.read_table(path,
-                                 columns=[c for c in columns if c in present])
+            try:
+                return pq.read_table(path, columns=list(columns))
+            except (pa.ArrowInvalid, KeyError):
+                # Mixed-schema file set (a column added by a later append):
+                # read the columns this file has; concat promotes the rest
+                # to nulls.  An empty intersection still reads zero columns
+                # (row count preserved).  The footer is only read twice on
+                # this rare path, not per file in the uniform-schema case.
+                present = set(pq.read_schema(path).names)
+                return pq.read_table(
+                    path, columns=[c for c in columns if c in present])
         return pq.read_table(path)
     if file_format == "csv":
         import pyarrow.csv as pacsv

@@ -24,6 +24,8 @@ class DisplayMode:
     new_line: str = "\n"
 
     def __init__(self, conf=None) -> None:
+        # Both tags must be set for the override to apply — a lone tag keeps
+        # the mode default (getHighlightTagOrElse, DisplayMode.scala:46-55).
         begin = getattr(conf, "highlight_begin_tag", "") if conf else ""
         end = getattr(conf, "highlight_end_tag", "") if conf else ""
         if begin and end:

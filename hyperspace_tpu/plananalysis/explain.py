@@ -112,9 +112,7 @@ def explain_string(dataset, session, verbose: bool = False) -> str:
     _build_header(stream, "Indexes used:")
     used = _used_indexes(plan_with)
     if used:
-        from hyperspace_tpu.index.manager import IndexCollectionManager
-
-        mgr = IndexCollectionManager(session)
+        mgr = session.index_collection_manager  # TTL-cached accessor
         for name in used:
             entry = mgr.get_index(name)
             location = ""

@@ -1,0 +1,267 @@
+// Native host runtime for hyperspace_tpu: the metadata-side hot loops.
+//
+// The per-query index-validity check folds an md5 over (size, mtime, path)
+// of EVERY source file (the reference does this on the Spark driver,
+// FileBasedSignatureProvider.scala:38-61, flagged in SURVEY §3.2 as the
+// metadata-side scaling bottleneck).  In Python that is one os.walk + stat
+// + hashlib round-trip per file; this library does walk + stat + sort +
+// fold in one C++ pass, exposed through a C ABI consumed via ctypes
+// (hyperspace_tpu/native/__init__.py).  Results are byte-identical to the
+// Python implementations (same decimal formatting, same lexicographic
+// ordering, same data-file filter), so signatures computed with and without
+// the native path agree.
+//
+// Build: g++ -O2 -std=c++17 -shared -fPIC -o libhs_native.so hs_native.cc
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <dirent.h>
+#include <string>
+#include <sys/stat.h>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// MD5 (RFC 1321).  Self-contained so the library has zero dependencies.
+// ---------------------------------------------------------------------------
+struct Md5 {
+  uint32_t a = 0x67452301, b = 0xefcdab89, c = 0x98badcfe, d = 0x10325476;
+  uint64_t total = 0;
+  unsigned char buf[64];
+  size_t buf_len = 0;
+
+  static uint32_t rotl(uint32_t x, int s) { return (x << s) | (x >> (32 - s)); }
+
+  void process(const unsigned char* p) {
+    static const uint32_t K[64] = {
+        0xd76aa478, 0xe8c7b756, 0x242070db, 0xc1bdceee, 0xf57c0faf,
+        0x4787c62a, 0xa8304613, 0xfd469501, 0x698098d8, 0x8b44f7af,
+        0xffff5bb1, 0x895cd7be, 0x6b901122, 0xfd987193, 0xa679438e,
+        0x49b40821, 0xf61e2562, 0xc040b340, 0x265e5a51, 0xe9b6c7aa,
+        0xd62f105d, 0x02441453, 0xd8a1e681, 0xe7d3fbc8, 0x21e1cde6,
+        0xc33707d6, 0xf4d50d87, 0x455a14ed, 0xa9e3e905, 0xfcefa3f8,
+        0x676f02d9, 0x8d2a4c8a, 0xfffa3942, 0x8771f681, 0x6d9d6122,
+        0xfde5380c, 0xa4beea44, 0x4bdecfa9, 0xf6bb4b60, 0xbebfbc70,
+        0x289b7ec6, 0xeaa127fa, 0xd4ef3085, 0x04881d05, 0xd9d4d039,
+        0xe6db99e5, 0x1fa27cf8, 0xc4ac5665, 0xf4292244, 0x432aff97,
+        0xab9423a7, 0xfc93a039, 0x655b59c3, 0x8f0ccc92, 0xffeff47d,
+        0x85845dd1, 0x6fa87e4f, 0xfe2ce6e0, 0xa3014314, 0x4e0811a1,
+        0xf7537e82, 0xbd3af235, 0x2ad7d2bb, 0xeb86d391};
+    static const int S[64] = {7, 12, 17, 22, 7, 12, 17, 22, 7, 12, 17, 22,
+                              7, 12, 17, 22, 5, 9,  14, 20, 5, 9,  14, 20,
+                              5, 9,  14, 20, 5, 9,  14, 20, 4, 11, 16, 23,
+                              4, 11, 16, 23, 4, 11, 16, 23, 4, 11, 16, 23,
+                              6, 10, 15, 21, 6, 10, 15, 21, 6, 10, 15, 21,
+                              6, 10, 15, 21};
+    uint32_t m[16];
+    for (int i = 0; i < 16; i++)
+      m[i] = (uint32_t)p[i * 4] | ((uint32_t)p[i * 4 + 1] << 8) |
+             ((uint32_t)p[i * 4 + 2] << 16) | ((uint32_t)p[i * 4 + 3] << 24);
+    uint32_t A = a, B = b, C = c, D = d;
+    for (int i = 0; i < 64; i++) {
+      uint32_t f;
+      int g;
+      if (i < 16) {
+        f = (B & C) | (~B & D);
+        g = i;
+      } else if (i < 32) {
+        f = (D & B) | (~D & C);
+        g = (5 * i + 1) % 16;
+      } else if (i < 48) {
+        f = B ^ C ^ D;
+        g = (3 * i + 5) % 16;
+      } else {
+        f = C ^ (B | ~D);
+        g = (7 * i) % 16;
+      }
+      uint32_t tmp = D;
+      D = C;
+      C = B;
+      B = B + rotl(A + f + K[i] + m[g], S[i]);
+      A = tmp;
+    }
+    a += A;
+    b += B;
+    c += C;
+    d += D;
+  }
+
+  void update(const void* data, size_t len) {
+    const unsigned char* p = (const unsigned char*)data;
+    total += len;
+    if (buf_len) {
+      size_t need = 64 - buf_len;
+      size_t take = len < need ? len : need;
+      memcpy(buf + buf_len, p, take);
+      buf_len += take;
+      p += take;
+      len -= take;
+      if (buf_len == 64) {
+        process(buf);
+        buf_len = 0;
+      }
+    }
+    while (len >= 64) {
+      process(p);
+      p += 64;
+      len -= 64;
+    }
+    if (len) {
+      memcpy(buf, p, len);
+      buf_len = len;
+    }
+  }
+
+  void hex(char out[33]) {
+    unsigned char pad[72];
+    size_t pad_len = 0;
+    pad[pad_len++] = 0x80;
+    size_t rem = (buf_len + 1) % 64;
+    size_t zeros = (rem <= 56) ? 56 - rem : 120 - rem;
+    memset(pad + pad_len, 0, zeros);
+    pad_len += zeros;
+    uint64_t bits = total * 8;
+    for (int i = 0; i < 8; i++) pad[pad_len++] = (bits >> (8 * i)) & 0xff;
+    update(pad, pad_len);  // total is now wrong, but we're done
+    uint32_t out_words[4] = {a, b, c, d};
+    for (int i = 0; i < 16; i++) {
+      snprintf(out + 2 * i, 3, "%02x",
+               (out_words[i / 4] >> (8 * (i % 4))) & 0xff);
+    }
+    out[32] = 0;
+  }
+};
+
+void md5_string(const std::string& s, char out[33]) {
+  Md5 h;
+  h.update(s.data(), s.size());
+  h.hex(out);
+}
+
+// ---------------------------------------------------------------------------
+// Directory walk with the engine's data-file filter
+// ---------------------------------------------------------------------------
+struct Entry {
+  std::string path;
+  long long size;
+  long long mtime_ns;
+};
+
+bool is_data_file(const char* name) {
+  // Spark convention (util/PathUtils.scala:31-36): '_'/'.' prefixed names
+  // are metadata.
+  return name[0] != '_' && name[0] != '.';
+}
+
+void walk(const std::string& root, std::vector<Entry>& out) {
+  struct stat st;
+  if (stat(root.c_str(), &st) != 0) return;
+  if (S_ISREG(st.st_mode)) {
+    out.push_back({root, (long long)st.st_size,
+                   (long long)st.st_mtim.tv_sec * 1000000000LL +
+                       st.st_mtim.tv_nsec});
+    return;
+  }
+  if (!S_ISDIR(st.st_mode)) return;
+  DIR* dir = opendir(root.c_str());
+  if (!dir) return;
+  std::vector<std::string> subdirs, files;
+  for (struct dirent* e; (e = readdir(dir)) != nullptr;) {
+    if (strcmp(e->d_name, ".") == 0 || strcmp(e->d_name, "..") == 0) continue;
+    std::string child = root + "/" + e->d_name;
+    // Match Python os.walk(followlinks=False): a symlink to a file is
+    // listed (stat follows it), a symlink to a directory is NOT recursed.
+    struct stat lst;
+    if (lstat(child.c_str(), &lst) != 0) continue;
+    bool is_link = S_ISLNK(lst.st_mode);
+    struct stat cst;
+    if (stat(child.c_str(), &cst) != 0) continue;
+    if (S_ISDIR(cst.st_mode)) {
+      if (!is_link) subdirs.push_back(child);
+    } else if (S_ISREG(cst.st_mode) && is_data_file(e->d_name)) {
+      files.push_back(child);
+    }
+  }
+  closedir(dir);
+  std::sort(files.begin(), files.end());
+  for (const auto& f : files) {
+    struct stat fst;
+    if (stat(f.c_str(), &fst) != 0) continue;
+    out.push_back({f, (long long)fst.st_size,
+                   (long long)fst.st_mtim.tv_sec * 1000000000LL +
+                       fst.st_mtim.tv_nsec});
+  }
+  std::sort(subdirs.begin(), subdirs.end());
+  for (const auto& d : subdirs) walk(d, out);
+}
+
+void fold(const std::vector<Entry>& entries, const char* init, char out[33]) {
+  // h_{i+1} = md5(h_i + "{size}{mtime}{name}") — identical to
+  // utils/hashing.fold_md5 over io/files.list_data_files output.
+  std::string acc = init ? init : "";
+  char hex[33];
+  for (const auto& e : entries) {
+    char nums[48];
+    snprintf(nums, sizeof(nums), "%lld%lld", e.size, e.mtime_ns);
+    std::string part = acc + nums + e.path;
+    md5_string(part, hex);
+    acc.assign(hex, 32);
+  }
+  memcpy(out, acc.c_str(), acc.size() + 1);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Walk every root (file or directory), calling cb once per data file.
+// Emission order: per-directory sorted, directories recursed in sorted
+// order (callers re-sort globally by path, as the Python path does).
+int hs_scan_files(const char** roots, int n_roots,
+                  void (*cb)(void* ctx, const char* path, long long size,
+                             long long mtime_ns),
+                  void* ctx) {
+  std::vector<Entry> out;
+  for (int i = 0; i < n_roots; i++) walk(roots[i], out);
+  for (const auto& e : out) cb(ctx, e.path.c_str(), e.size, e.mtime_ns);
+  return (int)out.size();
+}
+
+// One-shot fingerprint: walk + global path sort + md5 fold.  Returns the
+// file count; out_hex must hold 33 bytes; out_total_bytes may be null.
+long long hs_scan_fingerprint(const char** roots, int n_roots,
+                              const char* init, char* out_hex,
+                              long long* out_total_bytes) {
+  std::vector<Entry> entries;
+  for (int i = 0; i < n_roots; i++) walk(roots[i], entries);
+  std::sort(entries.begin(), entries.end(),
+            [](const Entry& a, const Entry& b) { return a.path < b.path; });
+  long long total = 0;
+  for (const auto& e : entries) total += e.size;
+  fold(entries, init, out_hex);
+  if (out_total_bytes) *out_total_bytes = total;
+  return (long long)entries.size();
+}
+
+// Fold md5 over caller-provided (size, mtime, path) triples, in order.
+void hs_fold_md5(const char** paths, const long long* sizes,
+                 const long long* mtimes, long long n, const char* init,
+                 char* out_hex) {
+  std::vector<Entry> entries;
+  entries.reserve((size_t)n);
+  for (long long i = 0; i < n; i++)
+    entries.push_back({paths[i], sizes[i], mtimes[i]});
+  fold(entries, init, out_hex);
+}
+
+// md5 of a UTF-8 string (util/HashingUtils.scala:24-35 analog).
+void hs_md5(const char* data, long long len, char* out_hex) {
+  Md5 h;
+  h.update(data, (size_t)len);
+  h.hex(out_hex);
+}
+
+}  // extern "C"

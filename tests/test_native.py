@@ -1,0 +1,135 @@
+"""Native host-runtime tests: the C++ scanner/fingerprint library must be
+byte-identical to the pure-Python fallbacks, and everything must keep
+working when the library is unavailable."""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+import pytest
+
+from hyperspace_tpu import native
+from hyperspace_tpu.io.files import list_data_files
+from hyperspace_tpu.utils.hashing import fold_md5
+
+
+def _make_tree(root):
+    os.makedirs(os.path.join(root, "a"))
+    os.makedirs(os.path.join(root, "b", "c"))
+    files = ["a/f1.parquet", "a/f2.parquet", "b/c/g.parquet", "top.parquet"]
+    for i, rel in enumerate(files):
+        with open(os.path.join(root, rel), "wb") as f:
+            f.write(b"x" * (i + 1) * 10)
+    # Metadata files that must be filtered out.
+    for rel in ["_SUCCESS", ".hidden", "a/_meta.json"]:
+        with open(os.path.join(root, rel), "wb") as f:
+            f.write(b"m")
+    return files
+
+
+needs_native = pytest.mark.skipif(not native.available(),
+                                  reason="native library unavailable")
+
+
+@needs_native
+class TestNativeParity:
+    def test_scan_matches_python_walk(self, tmp_path, monkeypatch):
+        root = str(tmp_path / "t")
+        os.makedirs(root)
+        _make_tree(root)
+        nat = sorted(native.scan_files([root]))
+        monkeypatch.setenv("HS_NATIVE", "0")
+        py = list_data_files([root])
+        assert [(f.name, f.size, f.mtime) for f in py] == nat
+        assert len(nat) == 4  # filtered _/. files
+
+    def test_fingerprint_matches_python_fold(self, tmp_path):
+        root = str(tmp_path / "t")
+        os.makedirs(root)
+        _make_tree(root)
+        files = list_data_files([root])
+        py_sig = fold_md5(f"{f.size}{f.mtime}{f.name}" for f in files)
+        assert native.fold_md5_files(
+            [(f.name, f.size, f.mtime) for f in files]) == py_sig
+        hex_, count, total = native.scan_fingerprint([root])
+        assert hex_ == py_sig
+        assert count == len(files)
+        assert total == sum(f.size for f in files)
+
+    def test_md5_boundary_lengths(self):
+        import ctypes
+
+        lib = native.get_lib()
+        for s in ["", "a" * 55, "b" * 56, "c" * 63, "d" * 64, "e" * 65,
+                  "héllo wörld", "x" * 1000]:
+            out = ctypes.create_string_buffer(33)
+            data = s.encode("utf-8")
+            lib.hs_md5(data, len(data), out)
+            assert out.value.decode() == hashlib.md5(data).hexdigest()
+
+    def test_file_root_and_missing_root(self, tmp_path):
+        f = tmp_path / "one.parquet"
+        f.write_bytes(b"abc")
+        got = native.scan_files([str(f), str(tmp_path / "nope")])
+        assert len(got) == 1
+        assert got[0][0] == str(f)
+        assert got[0][1] == 3
+
+    def test_signature_identical_with_and_without_native(
+            self, tmp_path, monkeypatch):
+        """The end-to-end index signature must not depend on which
+        implementation computed it — indexes built on a machine without g++
+        stay valid on one with it."""
+        from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig
+        from tests.utils import write_sample_parquet
+
+        data = str(tmp_path / "data")
+        write_sample_parquet(data, n_files=2)
+        sigs = {}
+        for native_flag in ("1", "0"):
+            monkeypatch.setenv("HS_NATIVE", native_flag)
+            s = HyperspaceSession(system_path=str(tmp_path / f"ix{native_flag}"))
+            s.conf.num_buckets = 2
+            hs = Hyperspace(s)
+            hs.create_index(s.read.parquet(data),
+                            IndexConfig("i", ["id"], ["name"]))
+            entry = s.index_collection_manager.get_index("i")
+            sigs[native_flag] = entry.signature().value
+        assert sigs["1"] == sigs["0"]
+
+
+class TestFallback:
+    def test_disabled_by_env(self, monkeypatch):
+        monkeypatch.setenv("HS_NATIVE", "0")
+        assert native.get_lib() is None
+        assert native.scan_files(["/tmp"]) is None
+        assert native.fold_md5_files([]) is None
+
+    def test_listing_still_works_disabled(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HS_NATIVE", "0")
+        root = str(tmp_path / "t")
+        os.makedirs(root)
+        _make_tree(root)
+        assert len(list_data_files([root])) == 4
+
+
+@needs_native
+class TestSymlinkParity:
+    def test_symlinks_match_python_walk(self, tmp_path, monkeypatch):
+        """os.walk(followlinks=False) semantics: symlinked files listed,
+        symlinked directories not recursed."""
+        real = tmp_path / "real"
+        real.mkdir()
+        (real / "f.parquet").write_bytes(b"abc")
+        data = tmp_path / "data"
+        data.mkdir()
+        (data / "g.parquet").write_bytes(b"de")
+        os.symlink(str(real), str(data / "linkdir"))
+        os.symlink(str(real / "f.parquet"), str(data / "linkfile.parquet"))
+        nat = sorted(native.scan_files([str(data)]))
+        monkeypatch.setenv("HS_NATIVE", "0")
+        py = [(f.name, f.size, f.mtime) for f in list_data_files([str(data)])]
+        assert nat == sorted(py)
+        names = [os.path.basename(p) for p, _, _ in nat]
+        assert names == ["g.parquet", "linkfile.parquet"]
