@@ -16,6 +16,7 @@ from typing import Any, Callable, Dict, Optional
 # can map their configs 1:1; see docs/_docs/02-ug-configuration.md:9-23).
 SYSTEM_PATH = "hyperspace.system.path"
 NUM_BUCKETS = "hyperspace.index.numBuckets"
+NUM_BUCKETS_LEGACY = "hyperspace.index.num.buckets"  # HyperspaceConf.scala:109-117
 LINEAGE_ENABLED = "hyperspace.index.lineage.enabled"
 HYBRID_SCAN_ENABLED = "hyperspace.index.hybridscan.enabled"
 HYBRID_SCAN_APPENDED_RATIO = "hyperspace.index.hybridscan.maxAppendedRatio"
@@ -30,6 +31,7 @@ SUPPORTED_FILE_FORMATS = "hyperspace.index.supportedFileFormats"
 DEVICE_BATCH_ROWS = "hyperspace.tpu.deviceBatchRows"
 PARALLEL_BUILD = "hyperspace.tpu.parallelBuild"
 SHUFFLE_CAPACITY_SLACK = "hyperspace.tpu.shuffleCapacitySlack"
+GLOBBING_PATTERN = "hyperspace.source.globbingPattern"
 DISPLAY_MODE = "hyperspace.explain.displayMode"
 HIGHLIGHT_BEGIN_TAG = "hyperspace.explain.displayMode.highlight.beginTag"
 HIGHLIGHT_END_TAG = "hyperspace.explain.displayMode.highlight.endTag"
@@ -73,15 +75,25 @@ class HyperspaceConf:
     # the perfectly-balanced per-destination row count (doubled on overflow).
     parallel_build: str = "auto"
     shuffle_capacity_slack: float = 1.5
+    # Comma-separated glob pattern(s); when set, createIndex records the
+    # pattern as the indexed root paths so later-appearing directories that
+    # match are picked up by refresh (IndexConstants.scala:108-114).
+    globbing_pattern: str = ""
     # Explain output rendering (IndexConstants.scala:69-80): "plaintext",
     # "html", or "console"; custom highlight tags override the mode default.
     display_mode: str = "plaintext"
     highlight_begin_tag: str = ""
     highlight_end_tag: str = ""
+    # Keys explicitly applied through set(); drives canonical-vs-legacy key
+    # precedence.
+    _set_keys: set = dataclasses.field(default_factory=set, repr=False,
+                                       compare=False)
 
     _FIELD_BY_KEY = {
         SYSTEM_PATH: "system_path",
         NUM_BUCKETS: "num_buckets",
+        NUM_BUCKETS_LEGACY: "num_buckets",
+        GLOBBING_PATTERN: "globbing_pattern",
         LINEAGE_ENABLED: "lineage_enabled",
         HYBRID_SCAN_ENABLED: "hybrid_scan_enabled",
         HYBRID_SCAN_APPENDED_RATIO: "hybrid_scan_max_appended_ratio",
@@ -105,6 +117,12 @@ class HyperspaceConf:
         field = self._FIELD_BY_KEY.get(key)
         if field is None:
             raise KeyError(f"Unknown hyperspace conf key: {key}")
+        # Canonical-key precedence (HyperspaceConf.scala:109-117): a value
+        # set via the canonical numBuckets key is never overwritten by the
+        # legacy key, regardless of apply order.
+        if key == NUM_BUCKETS_LEGACY and NUM_BUCKETS in self._set_keys:
+            return
+        self._set_keys.add(key)
         current = getattr(self, field)
         if isinstance(current, bool):
             value = value if isinstance(value, bool) else str(value).lower() == "true"

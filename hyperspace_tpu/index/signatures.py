@@ -36,22 +36,47 @@ class FileBasedSignatureProvider(SignatureProvider):
     name = "FileBasedSignatureProvider"
 
     def signature(self, plan, all_files_of):
+        from hyperspace_tpu import native
+
         leaves = plan.leaf_relations()
         if not leaves:
             return None
+        fused = self._fused_native_signature(leaves)
+        if fused is not None:
+            return fused
         infos: List[FileInfo] = []
         for scan in leaves:
             files = all_files_of(scan)
             if files is None:
                 return None
             infos.extend(files)
-        from hyperspace_tpu import native
-
         folded = native.fold_md5_files(
             [(f.name, f.size, f.mtime) for f in infos])
         if folded is not None:
             return folded
         return fold_md5(f"{f.size}{f.mtime}{f.name}" for f in infos)
+
+    @staticmethod
+    def _fused_native_signature(leaves: List[Scan]) -> Optional[str]:
+        """Walk + stat + sort + fold in ONE native pass — no per-file Python
+        objects.  Applies to the common hot case only: a single plain-file
+        leaf whose listing is a directory walk (lake formats resolve files
+        through their snapshot metadata; multi-leaf plans fold per leaf, a
+        different order than one global sort)."""
+        from hyperspace_tpu import native
+        from hyperspace_tpu.io.files import expand_globs
+        from hyperspace_tpu.sources.interfaces import LAKE_DATA_FORMATS
+        from hyperspace_tpu.utils.paths import normalize_path
+
+        if len(leaves) != 1:
+            return None
+        rel = leaves[0].relation
+        if rel.file_paths is not None or rel.index_scan_of \
+                or rel.file_format.lower() in LAKE_DATA_FORMATS:
+            return None
+        roots = [normalize_path(r) for r in expand_globs(rel.root_paths)]
+        fp = native.scan_fingerprint(roots)
+        return fp[0] if fp is not None else None
 
 
 class PlanSignatureProvider(SignatureProvider):

@@ -8,11 +8,31 @@ deterministic signatures.
 
 from __future__ import annotations
 
+import glob as _glob
 import os
 from typing import List, Optional, Sequence
 
 from hyperspace_tpu.index.log_entry import FileIdTracker, FileInfo
 from hyperspace_tpu.utils.paths import is_data_file, normalize_path
+
+_GLOB_CHARS = ("*", "?", "[")
+
+
+def expand_globs(root_paths: Sequence[str]) -> List[str]:
+    """Expand glob patterns among ``root_paths`` (sorted matches); plain
+    paths pass through.  Globbing patterns let an index cover directories
+    that appear later (GLOBBING_PATTERN_KEY, IndexConstants.scala:108-114).
+
+    A path that EXISTS literally is never treated as a pattern, so a
+    directory whose name happens to contain ``*``/``?``/``[`` still reads
+    as itself."""
+    out: List[str] = []
+    for root in root_paths:
+        if any(c in root for c in _GLOB_CHARS) and not os.path.exists(root):
+            out.extend(sorted(_glob.glob(root)))
+        else:
+            out.append(root)
+    return out
 
 
 def list_data_files(root_paths: Sequence[str],
@@ -27,7 +47,7 @@ def list_data_files(root_paths: Sequence[str],
     """
     from hyperspace_tpu import native
 
-    normalized = [normalize_path(r) for r in root_paths]
+    normalized = [normalize_path(r) for r in expand_globs(root_paths)]
     scanned = native.scan_files(normalized)
     if scanned is not None:
         out = []

@@ -29,6 +29,7 @@ _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _lib_failed = False
 _compile_thread: Optional[threading.Thread] = None
+_waited_for_compile = False
 # How long the FIRST caller waits for an in-flight compile before falling
 # back to pure Python (the compile keeps running; a later call picks up the
 # result).  Keeps a cold cache from stalling a user query on g++ -O2.
@@ -111,14 +112,18 @@ def get_lib() -> Optional[ctypes.CDLL]:
             _lib_failed = True
             return None
         cached = _cache_so_path()
+        thread = None
         if not os.path.isfile(cached):
             if _compile_thread is None:
                 _compile_thread = threading.Thread(
                     target=_compile, args=(cached,), daemon=True)
                 _compile_thread.start()
-            thread = _compile_thread
-        else:
-            thread = None
+            # Only ONE caller pays the bounded wait; while the compile is
+            # still running everyone else gets the Python fallback at once.
+            global _waited_for_compile
+            if not _waited_for_compile:
+                _waited_for_compile = True
+                thread = _compile_thread
     if thread is not None:
         thread.join(_FIRST_CALL_WAIT_S)
     with _lock:
@@ -126,9 +131,14 @@ def get_lib() -> Optional[ctypes.CDLL]:
             return _lib
         cached = _cache_so_path()
         if not os.path.isfile(cached):
-            if _compile_thread is not None and not _compile_thread.is_alive():
-                _lib_failed = True  # compile finished and produced nothing
-            return None  # still compiling (or failed): Python fallback
+            # Observe the thread dead FIRST, then re-check the file —
+            # os.replace may land between the two looks otherwise.
+            thread_dead = (_compile_thread is not None
+                           and not _compile_thread.is_alive())
+            if not os.path.isfile(cached):
+                if thread_dead:
+                    _lib_failed = True  # finished and produced nothing
+                return None  # failed, or still compiling: Python fallback
         try:
             _lib = _declare(ctypes.CDLL(cached))
         except OSError:

@@ -55,13 +55,38 @@ class DefaultFileBasedRelation(FileBasedRelation):
     def create_relation_metadata(self, tracker: FileIdTracker) -> Relation:
         files = self.all_files(tracker)
         return Relation(
-            root_paths=self.root_paths,
+            root_paths=self._logged_root_paths(),
             content=Content.from_leaf_files(files) or Content.from_directory(
                 self.root_paths[0], tracker),
             schema=self.schema(),
             file_format=self.file_format,
             options=self.options,
         )
+
+    def _logged_root_paths(self) -> List[str]:
+        """Root paths to record in the log entry.  When the globbing-pattern
+        conf is set, validate the pattern covers every scanned root and
+        record the PATTERN instead, so refresh re-expands it and picks up
+        directories that appear later
+        (DefaultFileBasedSource.scala:118-180's pattern validation)."""
+        pattern = (self._conf.globbing_pattern or "").strip()
+        if not pattern:
+            return list(self.root_paths)
+        from hyperspace_tpu.exceptions import HyperspaceError
+        from hyperspace_tpu.io.files import expand_globs
+        from hyperspace_tpu.utils.paths import normalize_path
+
+        patterns = [p.strip() for p in pattern.split(",") if p.strip()]
+        expanded = {normalize_path(p) for p in expand_globs(patterns)}
+        # A root that IS one of the patterns (a refresh reconstructing a
+        # pattern-rooted relation) trivially matches.
+        unmatched = [r for r in self.root_paths
+                     if r not in patterns and normalize_path(r) not in expanded]
+        if unmatched:
+            raise HyperspaceError(
+                f"Some root paths of the relation do not match the globbing "
+                f"pattern {pattern!r}: {unmatched}")
+        return patterns
 
 
 class DefaultFileBasedSource(FileBasedSourceProvider):

@@ -168,7 +168,8 @@ void walk(const std::string& root, std::vector<Entry>& out) {
   if (!S_ISDIR(st.st_mode)) return;
   DIR* dir = opendir(root.c_str());
   if (!dir) return;
-  std::vector<std::string> subdirs, files;
+  std::vector<std::string> subdirs;
+  std::vector<Entry> files;
   for (struct dirent* e; (e = readdir(dir)) != nullptr;) {
     if (strcmp(e->d_name, ".") == 0 || strcmp(e->d_name, "..") == 0) continue;
     std::string child = root + "/" + e->d_name;
@@ -182,18 +183,16 @@ void walk(const std::string& root, std::vector<Entry>& out) {
     if (S_ISDIR(cst.st_mode)) {
       if (!is_link) subdirs.push_back(child);
     } else if (S_ISREG(cst.st_mode) && is_data_file(e->d_name)) {
-      files.push_back(child);
+      // One stat per file: keep size/mtime from this look.
+      files.push_back({child, (long long)cst.st_size,
+                       (long long)cst.st_mtim.tv_sec * 1000000000LL +
+                           cst.st_mtim.tv_nsec});
     }
   }
   closedir(dir);
-  std::sort(files.begin(), files.end());
-  for (const auto& f : files) {
-    struct stat fst;
-    if (stat(f.c_str(), &fst) != 0) continue;
-    out.push_back({f, (long long)fst.st_size,
-                   (long long)fst.st_mtim.tv_sec * 1000000000LL +
-                       fst.st_mtim.tv_nsec});
-  }
+  std::sort(files.begin(), files.end(),
+            [](const Entry& a, const Entry& b) { return a.path < b.path; });
+  for (auto& f : files) out.push_back(std::move(f));
   std::sort(subdirs.begin(), subdirs.end());
   for (const auto& d : subdirs) walk(d, out);
 }

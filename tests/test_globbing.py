@@ -1,0 +1,106 @@
+"""Globbing-pattern support tests.
+
+Mirrors the reference's globbing behavior (GLOBBING_PATTERN_KEY,
+IndexConstants.scala:108-114; validation in
+DefaultFileBasedSource.scala:118-180): an index created with the pattern
+conf records the PATTERN as its root paths, so a directory that appears
+later and matches is picked up by refresh; a pattern that does not cover
+the indexed paths is rejected.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
+from hyperspace_tpu.exceptions import HyperspaceError
+
+
+def _write(dirpath, start, n):
+    os.makedirs(dirpath, exist_ok=True)
+    pq.write_table(pa.table({
+        "id": np.arange(start, start + n, dtype=np.int64),
+        "name": pa.array([f"n{i}" for i in range(start, start + n)]),
+    }), os.path.join(dirpath, "part-0.parquet"))
+
+
+@pytest.fixture()
+def session(tmp_index_root):
+    s = HyperspaceSession(system_path=tmp_index_root)
+    s.conf.num_buckets = 2
+    return s
+
+
+class TestGlobRead:
+    def test_glob_path_reads_all_matching_dirs(self, session, tmp_path):
+        _write(str(tmp_path / "data" / "d1"), 0, 5)
+        _write(str(tmp_path / "data" / "d2"), 5, 5)
+        out = session.read.parquet(str(tmp_path / "data" / "*")).collect()
+        assert out.num_rows == 10
+
+
+class TestGlobbingPattern:
+    def test_create_records_pattern_and_refresh_picks_up_new_dir(
+            self, session, tmp_path):
+        d1 = str(tmp_path / "data" / "2024")
+        _write(d1, 0, 10)
+        pattern = str(tmp_path / "data" / "*")
+        session.conf.globbing_pattern = pattern
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(d1),
+                        IndexConfig("gidx", ["id"], ["name"]))
+        entry = session.index_collection_manager.get_index("gidx")
+        assert entry.relations[0].root_paths == [pattern]
+
+        # A new partition directory appears under the pattern.
+        _write(str(tmp_path / "data" / "2025"), 100, 5)
+        hs.refresh_index("gidx", "incremental")
+        session.conf.globbing_pattern = ""
+        session.enable_hyperspace()
+        ds = (session.read.parquet(pattern)
+              .filter(col("id") == 104).select("id", "name"))
+        plan = ds.optimized_plan()
+        assert [s for s in plan.leaf_relations() if s.relation.index_scan_of], \
+            plan.tree_string()
+        assert ds.collect().num_rows == 1
+
+    def test_pattern_not_covering_roots_rejected(self, session, tmp_path):
+        d1 = str(tmp_path / "data" / "d1")
+        elsewhere = str(tmp_path / "other" / "d2")
+        _write(d1, 0, 5)
+        _write(elsewhere, 5, 5)
+        session.conf.globbing_pattern = str(tmp_path / "data" / "*")
+        hs = Hyperspace(session)
+        with pytest.raises(HyperspaceError, match="globbing pattern"):
+            hs.create_index(session.read.parquet(elsewhere),
+                            IndexConfig("gidx", ["id"], ["name"]))
+
+    def test_legacy_num_buckets_key(self, session):
+        session.conf.set("hyperspace.index.num.buckets", 7)
+        assert session.conf.num_buckets == 7
+        assert session.conf.get("hyperspace.index.numBuckets") == 7
+
+    def test_literal_path_with_glob_chars_not_expanded(self, tmp_path):
+        """A directory that EXISTS with */?/[ in its name reads as itself —
+        never reinterpreted as a pattern."""
+        from hyperspace_tpu.io.files import list_data_files
+
+        weird = tmp_path / "run[1]"
+        weird.mkdir()
+        (weird / "f.parquet").write_bytes(b"x")
+        decoy = tmp_path / "run1"
+        decoy.mkdir()
+        (decoy / "g.parquet").write_bytes(b"y")
+        got = list_data_files([str(weird)])
+        assert len(got) == 1
+        assert "run[1]" in got[0].name
+
+    def test_canonical_key_beats_legacy_any_order(self, session):
+        session.conf.set("hyperspace.index.numBuckets", 100)
+        session.conf.set("hyperspace.index.num.buckets", 50)
+        assert session.conf.num_buckets == 100  # HyperspaceConf.scala:109-117
