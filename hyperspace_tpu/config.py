@@ -29,6 +29,8 @@ SIGNATURE_PROVIDER = "hyperspace.index.signatureProvider"
 EVENT_LOGGER = "hyperspace.eventLoggerClass"
 SUPPORTED_FILE_FORMATS = "hyperspace.index.supportedFileFormats"
 DEVICE_BATCH_ROWS = "hyperspace.tpu.deviceBatchRows"
+DEVICE_FILTER_MIN_ROWS = "hyperspace.tpu.deviceFilterMinRows"
+DEVICE_JOIN_MIN_ROWS = "hyperspace.tpu.deviceJoinMinRows"
 PARALLEL_BUILD = "hyperspace.tpu.parallelBuild"
 SHUFFLE_CAPACITY_SLACK = "hyperspace.tpu.shuffleCapacitySlack"
 GLOBBING_PATTERN = "hyperspace.source.globbingPattern"
@@ -69,6 +71,14 @@ class HyperspaceConf:
     # XLA shapes static (arrays are padded to this size) so kernels hit the
     # compile cache across files of different sizes.
     device_batch_rows: int = 1 << 20
+    # Below this row count a filter evaluates host-side (arrow compute): a
+    # device round trip costs fixed transfer latency (~100 ms over a remote
+    # tunnel) that a vectorized host pass over a small batch never repays.
+    # Raise toward 0 on locally attached chips with resident data.
+    device_filter_min_rows: int = 1 << 22
+    # Same cost model for joins: below this (max-side) row count the
+    # sorted-merge join runs in numpy on host.
+    device_join_min_rows: int = 1 << 22
     # Distributed build over the device mesh: "auto" uses it when more than
     # one accelerator is visible; "on"/"off" force it.  The shuffle uses
     # capacity-padded all_to_all; slack is the initial headroom factor over
@@ -106,6 +116,8 @@ class HyperspaceConf:
         EVENT_LOGGER: "event_logger",
         SUPPORTED_FILE_FORMATS: "supported_file_formats",
         DEVICE_BATCH_ROWS: "device_batch_rows",
+        DEVICE_FILTER_MIN_ROWS: "device_filter_min_rows",
+        DEVICE_JOIN_MIN_ROWS: "device_join_min_rows",
         PARALLEL_BUILD: "parallel_build",
         SHUFFLE_CAPACITY_SLACK: "shuffle_capacity_slack",
         DISPLAY_MODE: "display_mode",
@@ -130,7 +142,9 @@ class HyperspaceConf:
             value = int(value)
         elif isinstance(current, float):
             value = float(value)
-        setattr(self, field, value)
+        # Bypass __setattr__: a LEGACY set must not mark the CANONICAL key
+        # as explicitly set (later legacy writes still apply).
+        object.__setattr__(self, field, value)
 
     def get(self, key: str) -> Any:
         field = self._FIELD_BY_KEY.get(key)
@@ -138,5 +152,19 @@ class HyperspaceConf:
             raise KeyError(f"Unknown hyperspace conf key: {key}")
         return getattr(self, field)
 
+    def __setattr__(self, name: str, value: Any) -> None:
+        # Direct attribute assignment of num_buckets counts as setting the
+        # canonical key for legacy-key precedence.  During __init__ the
+        # tracking set doesn't exist yet — defaults are not "explicitly set".
+        object.__setattr__(self, name, value)
+        if name == "num_buckets":
+            tracked = getattr(self, "_set_keys", None)
+            if tracked is not None:
+                tracked.add(NUM_BUCKETS)
+
     def copy(self) -> "HyperspaceConf":
-        return dataclasses.replace(self)
+        c = dataclasses.replace(self)
+        # replace() aliases mutable fields; precedence state must not leak
+        # between the copy and the original.
+        object.__setattr__(c, "_set_keys", set(self._set_keys))
+        return c

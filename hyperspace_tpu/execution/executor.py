@@ -120,11 +120,15 @@ class Executor:
         # numeric and null-free; everything else (strings, nullables,
         # constant predicates) takes the arrow path, which owns SQL
         # three-valued-logic semantics.
-        numeric = bool(cols) and all(
-            columnar.is_numeric_type(table.schema.field(c).type)
-            and table.column(c).null_count == 0
-            for c in cols
-        ) and self._device_compatible(expr, table)
+        # Small batches stay on host: the device round trip's fixed latency
+        # dwarfs a vectorized arrow pass (conf device_filter_min_rows).
+        numeric = bool(cols) \
+            and table.num_rows >= self.session.conf.device_filter_min_rows \
+            and all(
+                columnar.is_numeric_type(table.schema.field(c).type)
+                and table.column(c).null_count == 0
+                for c in cols
+            ) and self._device_compatible(expr, table)
         if numeric:
             return self._eval_device(expr, table)
         return self._eval_arrow(expr, table)
@@ -233,11 +237,18 @@ class Executor:
             and columnar.is_numeric_type(left.schema.field(l_keys[0]).type)
             and columnar.is_numeric_type(right.schema.field(r_keys[0]).type))
         if single_numeric:
-            from hyperspace_tpu.ops.join import sorted_equi_join
+            from hyperspace_tpu.ops.join import sorted_equi_join, sorted_equi_join_np
 
-            li, ri = sorted_equi_join(
-                columnar.to_device_numeric(left.column(l_keys[0])),
-                columnar.to_device_numeric(right.column(r_keys[0])))
+            lk = columnar.to_device_numeric(left.column(l_keys[0]))
+            rk = columnar.to_device_numeric(right.column(r_keys[0]))
+            # Small joins stay on host (same cost model as filters): the
+            # device kernel's two transfers + one sync are pure latency
+            # until the batch is large (conf device_join_min_rows).
+            if max(left.num_rows, right.num_rows) \
+                    >= self.session.conf.device_join_min_rows:
+                li, ri = sorted_equi_join(lk, rk)
+            else:
+                li, ri = sorted_equi_join_np(lk, rk)
             lt = left.take(pa.array(li))
             rt = right.take(pa.array(ri))
         else:

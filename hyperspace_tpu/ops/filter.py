@@ -30,6 +30,47 @@ _CMP = {
 }
 
 
+# Structure-keyed cache of jitted predicate programs.  jax.jit caches
+# compiled executables PER FUNCTION OBJECT: without this memo every query
+# would build a fresh lambda and pay a full XLA compile (~0.5 s/query on a
+# real chip).  Keys are (expression structure + baked IsIn values, column
+# order); literal VALUES are not in the key — they are traced arguments.
+_PREDICATE_CACHE: Dict[Tuple, Callable] = {}
+_PREDICATE_CACHE_MAX = 512  # queries have few distinct shapes; safety bound
+
+
+def _structure_key(e: Expr, parts: List, literals: List[float]) -> None:
+    """Pre-order structural fingerprint of ``e``; collects literals in the
+    SAME traversal order ``_build`` appends them."""
+    if isinstance(e, BinOp):
+        if isinstance(e.left, Col) and isinstance(e.right, Lit):
+            parts += ("b", e.op, "c", e.left.name, "L")
+            literals.append(e.right.value)
+        elif isinstance(e.left, Lit) and isinstance(e.right, Col):
+            parts += ("b", e.op, "L", "c", e.right.name)
+            literals.append(e.left.value)
+        elif isinstance(e.left, Col) and isinstance(e.right, Col):
+            parts += ("b", e.op, "c", e.left.name, "c", e.right.name)
+        else:
+            raise ValueError(f"Unsupported comparison operands: {e!r}")
+        return
+    if isinstance(e, (And, Or)):
+        parts.append("&" if isinstance(e, And) else "|")
+        _structure_key(e.left, parts, literals)
+        _structure_key(e.right, parts, literals)
+        return
+    if isinstance(e, Not):
+        parts.append("~")
+        _structure_key(e.child, parts, literals)
+        return
+    if isinstance(e, IsIn):
+        if not isinstance(e.child, Col):
+            raise ValueError(f"IsIn over non-column: {e!r}")
+        parts += ("in", e.child.name, tuple(e.values))
+        return
+    raise ValueError(f"Unsupported predicate node: {e!r}")
+
+
 def compile_predicate(expr: Expr, column_order: Sequence[str]
                       ) -> Tuple[Callable, List[float]]:
     """Build (jitted_fn, literals) where ``jitted_fn(columns, literals)``
@@ -37,8 +78,17 @@ def compile_predicate(expr: Expr, column_order: Sequence[str]
     ``column_order``; literals are scalars traced as arguments so the
     compiled program is reusable across queries with different constants.
     ``IsIn`` value lists are static (baked in): their length changes the
-    program shape anyway.
+    program shape anyway.  The jitted function is memoized by expression
+    structure so repeated queries hit XLA's compile cache.
     """
+    parts: List = []
+    extracted: List[float] = []
+    _structure_key(expr, parts, extracted)
+    key = (tuple(parts), tuple(column_order))
+    cached = _PREDICATE_CACHE.get(key)
+    if cached is not None:
+        return cached, extracted
+
     col_ix = {name: i for i, name in enumerate(column_order)}
     literals: List[float] = []
 
@@ -79,4 +129,8 @@ def compile_predicate(expr: Expr, column_order: Sequence[str]
 
     fn = build(expr)
     jitted = jax.jit(lambda cols, lits: fn(cols, lits))
+    if len(_PREDICATE_CACHE) >= _PREDICATE_CACHE_MAX:
+        _PREDICATE_CACHE.clear()  # degenerate workload: reset, don't grow
+    _PREDICATE_CACHE[key] = jitted
+    assert literals == extracted, "literal traversal order diverged"
     return jitted, literals

@@ -66,6 +66,30 @@ def combine_hashes_xla(word_cols: Sequence[jnp.ndarray]) -> jnp.ndarray:
     return h
 
 
+def _fmix32_np(h: np.ndarray) -> np.ndarray:
+    h = h ^ (h >> np.uint32(16))
+    h = h * _C1
+    h = h ^ (h >> np.uint32(13))
+    h = h * _C2
+    h = h ^ (h >> np.uint32(16))
+    return h
+
+
+def bucket_ids_np(word_cols: Sequence[np.ndarray], num_buckets: int) -> np.ndarray:
+    """Host mirror of ``bucket_ids`` — bit-identical uint32 math in numpy
+    (wrap-around multiplication is exact in both).  For tiny inputs (bucket
+    pruning probes a handful of key combinations per query) a device round
+    trip costs pure latency; this keeps pruning on host while provably
+    agreeing with device placement (parity-tested in tests/test_ops.py)."""
+    with np.errstate(over="ignore"):
+        h = np.full(np.asarray(word_cols[0]).shape[0], _SEED, dtype=np.uint32)
+        for words in word_cols:
+            words = np.asarray(words, dtype=np.uint32)
+            h = _fmix32_np(h * np.uint32(31) ^ _fmix32_np(words[:, 0]))
+            h = _fmix32_np(h * np.uint32(31) ^ _fmix32_np(words[:, 1]))
+    return (h % np.uint32(num_buckets)).astype(np.int32)
+
+
 def combine_hashes(word_cols: Sequence[jnp.ndarray]) -> jnp.ndarray:
     """uint32 row hash from per-column (n, 2) uint32 hash words.
 

@@ -52,6 +52,31 @@ def _expand(lo: jnp.ndarray, hi: jnp.ndarray, capacity: int
     return left_idx, right_pos
 
 
+def sorted_equi_join_np(left_keys: np.ndarray, right_keys: np.ndarray
+                        ) -> Tuple[np.ndarray, np.ndarray]:
+    """Host mirror of ``sorted_equi_join`` — the same sort/searchsorted/
+    expand formulation in numpy.  Below the device row threshold a device
+    round trip is pure tunnel latency; covering-index data arrives sorted
+    within buckets, so the mergesort argsort here is near-linear."""
+    left_keys = np.asarray(left_keys)
+    right_keys = np.asarray(right_keys)
+    if left_keys.size == 0 or right_keys.size == 0:
+        return np.empty(0, dtype=np.int64), np.empty(0, dtype=np.int64)
+    r_perm = np.argsort(right_keys, kind="stable")
+    rk_sorted = right_keys[r_perm]
+    lo = np.searchsorted(rk_sorted, left_keys, side="left")
+    hi = np.searchsorted(rk_sorted, left_keys, side="right")
+    counts = hi - lo
+    total = int(counts.sum())
+    if total == 0:
+        return np.empty(0, dtype=np.int64), np.empty(0, dtype=np.int64)
+    left_idx = np.repeat(np.arange(left_keys.shape[0]), counts)
+    starts = np.cumsum(counts) - counts
+    within = np.arange(total) - np.repeat(starts, counts)
+    right_idx = r_perm[lo[left_idx] + within]
+    return left_idx.astype(np.int64), right_idx.astype(np.int64)
+
+
 def sorted_equi_join(left_keys: np.ndarray, right_keys: np.ndarray
                      ) -> Tuple[np.ndarray, np.ndarray]:
     """Inner equi-join on single numeric keys.

@@ -10,7 +10,8 @@ Reference contract: index/rules/FilterIndexRule.scala —
 
 TPU extension with reference semantics intact: when the predicate pins every
 indexed column with equality/IN, we precompute the matching hash buckets with
-the SAME device kernel the build used and prune the index files read
+a bit-identical host mirror of the build kernel (ops/hash.bucket_ids_np;
+parity-tested against the device kernel) and prune the index files read
 (the bucket-pruning effect Spark gets from its bucketed FileSourceScan).
 """
 
@@ -169,7 +170,7 @@ def _bucket_pruning(condition: Expr, entry: IndexLogEntry
 
     from hyperspace_tpu.io.columnar import to_hash_words
     from hyperspace_tpu.io.parquet import schema_to_arrow
-    from hyperspace_tpu.ops.hash import bucket_ids
+    from hyperspace_tpu.ops.hash import bucket_ids_np
 
     # Literals MUST be hashed with the indexed column's stored type, not the
     # literal's inferred type: an int literal probing a float64 column would
@@ -186,6 +187,8 @@ def _bucket_pruning(condition: Expr, entry: IndexLogEntry
         except (pa.ArrowInvalid, pa.ArrowTypeError):
             return None  # literal not castable to the column type: no pruning
         word_cols.append(to_hash_words(col_vals))
-    buckets = np.asarray(bucket_ids([np.asarray(w) for w in word_cols],
-                                    entry.num_buckets))
+    # Host mirror of the build kernel (bit-identical; parity-tested): a
+    # device round trip for <=1024 probe rows would be pure latency.
+    buckets = bucket_ids_np([np.asarray(w) for w in word_cols],
+                            entry.num_buckets)
     return tuple(sorted(set(int(b) for b in buckets)))

@@ -265,3 +265,32 @@ class TestPruningInteraction:
         once = ds.optimized_plan()
         twice = session.optimize(once)
         assert twice.tree_string() == once.tree_string()
+
+
+class TestDeviceRouting:
+    """The device kernels must stay exercised END TO END through the
+    executor (the default thresholds route small test tables to host):
+    forcing the thresholds to 0 must give identical answers."""
+
+    def test_device_filter_and_join_answer_parity(self, env):
+        session, hs, data_dir = env
+        hs.create_index(session.read.parquet(data_dir),
+                        IndexConfig("didx", ["id"], ["name"]))
+        session.enable_hyperspace()
+
+        def run_queries():
+            f = (session.read.parquet(data_dir)
+                 .filter(col("id") >= 2).select("id", "name").collect())
+            j = (session.read.parquet(data_dir)
+                 .join(session.read.parquet(data_dir),
+                       col("id") == col("id"))
+                 .select("id", "name").collect())
+            return f, j
+
+        host_f, host_j = run_queries()
+        session.conf.device_filter_min_rows = 0
+        session.conf.device_join_min_rows = 0
+        dev_f, dev_j = run_queries()
+        keys = [("id", "ascending"), ("name", "ascending")]
+        assert dev_f.sort_by(keys).equals(host_f.sort_by(keys))
+        assert dev_j.sort_by(keys).equals(host_j.sort_by(keys))

@@ -80,10 +80,13 @@ class TestGlobbingPattern:
             hs.create_index(session.read.parquet(elsewhere),
                             IndexConfig("gidx", ["id"], ["name"]))
 
-    def test_legacy_num_buckets_key(self, session):
-        session.conf.set("hyperspace.index.num.buckets", 7)
-        assert session.conf.num_buckets == 7
-        assert session.conf.get("hyperspace.index.numBuckets") == 7
+    def test_legacy_num_buckets_key(self):
+        from hyperspace_tpu.config import HyperspaceConf
+
+        conf = HyperspaceConf()
+        conf.set("hyperspace.index.num.buckets", 7)
+        assert conf.num_buckets == 7
+        assert conf.get("hyperspace.index.numBuckets") == 7
 
     def test_literal_path_with_glob_chars_not_expanded(self, tmp_path):
         """A directory that EXISTS with */?/[ in its name reads as itself —
@@ -104,3 +107,26 @@ class TestGlobbingPattern:
         session.conf.set("hyperspace.index.numBuckets", 100)
         session.conf.set("hyperspace.index.num.buckets", 50)
         assert session.conf.num_buckets == 100  # HyperspaceConf.scala:109-117
+
+    def test_attribute_assignment_counts_as_canonical(self, session):
+        session.conf.num_buckets = 100  # the idiomatic Python API
+        session.conf.set("hyperspace.index.num.buckets", 50)
+        assert session.conf.num_buckets == 100
+
+    def test_repeated_legacy_sets_apply(self):
+        from hyperspace_tpu.config import HyperspaceConf
+
+        conf = HyperspaceConf()
+        conf.set("hyperspace.index.num.buckets", 7)
+        conf.set("hyperspace.index.num.buckets", 9)
+        assert conf.num_buckets == 9  # last legacy write wins
+
+    def test_copy_does_not_alias_precedence_state(self):
+        from hyperspace_tpu.config import HyperspaceConf
+
+        conf = HyperspaceConf()
+        c2 = conf.copy()
+        c2.set("hyperspace.index.numBuckets", 10)
+        conf.set("hyperspace.index.num.buckets", 50)
+        assert conf.num_buckets == 50  # original never saw the canonical set
+        assert c2.num_buckets == 10

@@ -122,3 +122,54 @@ def test_compile_predicate_reuses_literals():
     # Different literals, same compiled structure.
     mask2 = np.asarray(fn([a, b], [20, 4]))
     assert mask2.tolist() == [False, False, True]
+
+
+class TestHostHashMirror:
+    def test_bucket_ids_np_matches_device_kernel(self):
+        """bucket_ids_np (the host mirror bucket pruning uses) must agree
+        bit-for-bit with the device kernel that placed the rows — pruning
+        must never disagree with placement."""
+        import numpy as np
+
+        from hyperspace_tpu.ops.hash import bucket_ids, bucket_ids_np
+
+        rng = np.random.default_rng(3)
+        n = 4096
+        cols = [rng.integers(0, 2**32, size=(n, 2), dtype=np.uint32)
+                for _ in range(3)]
+        for nb in (1, 2, 16, 200):
+            device = np.asarray(bucket_ids([c for c in cols], nb))
+            host = bucket_ids_np(cols, nb)
+            assert np.array_equal(device, host), nb
+
+    def test_predicate_cache_reuses_jitted_fn(self):
+        from hyperspace_tpu.ops.filter import _PREDICATE_CACHE, compile_predicate
+        from hyperspace_tpu.plan.expr import BinOp, Col, Lit
+
+        _PREDICATE_CACHE.clear()
+        f1, lits1 = compile_predicate(BinOp("==", Col("x"), Lit(1)), ["x"])
+        f2, lits2 = compile_predicate(BinOp("==", Col("x"), Lit(999)), ["x"])
+        assert f1 is f2  # same structure, different literal: same program
+        assert lits1 == [1] and lits2 == [999]
+        f3, _ = compile_predicate(BinOp(">", Col("x"), Lit(1)), ["x"])
+        assert f3 is not f1  # different op: different program
+
+    def test_host_join_matches_device_join(self):
+        import numpy as np
+
+        from hyperspace_tpu.ops.join import sorted_equi_join, sorted_equi_join_np
+
+        rng = np.random.default_rng(5)
+        lk = rng.integers(0, 100, 500).astype(np.int64)
+        rk = rng.integers(0, 100, 700).astype(np.int64)
+        li_d, ri_d = sorted_equi_join(lk, rk)
+        li_h, ri_h = sorted_equi_join_np(lk, rk)
+        pairs_d = sorted(zip(lk[li_d].tolist(), rk[ri_d].tolist(),
+                             li_d.tolist(), ri_d.tolist()))
+        pairs_h = sorted(zip(lk[li_h].tolist(), rk[ri_h].tolist(),
+                             li_h.tolist(), ri_h.tolist()))
+        assert pairs_d == pairs_h
+        # Empty sides
+        e = np.empty(0, dtype=np.int64)
+        assert sorted_equi_join_np(e, rk)[0].size == 0
+        assert sorted_equi_join_np(lk, e)[1].size == 0
