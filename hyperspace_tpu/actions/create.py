@@ -155,7 +155,8 @@ class CreateActionBase(Action):
 
             buckets, perm = distributed_bucket_sort_permutation(
                 table, resolved.indexed_columns, self.num_buckets,
-                build_mesh(), slack=self.conf.shuffle_capacity_slack)
+                build_mesh(), slack=self.conf.shuffle_capacity_slack,
+                pad_to=self.conf.device_batch_rows)
         else:
             from hyperspace_tpu.ops.sort import bucket_sort_permutation
 
@@ -166,7 +167,8 @@ class CreateActionBase(Action):
             buckets, perm = bucket_sort_permutation(
                 [np.asarray(w) for w in word_cols],
                 [np.asarray(k) for k in order_words],
-                self.num_buckets)
+                self.num_buckets,
+                pad_to=self.conf.device_batch_rows)
         version = self.data_manager.get_next_version() if version is None else version
         out_dir = self.data_manager.version_path(version)
         write_bucketed(table, np.asarray(buckets), np.asarray(perm),

@@ -10,6 +10,7 @@ so tests and the Python API can flip flags the way Spark conf users do.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Any, Callable, Dict, Optional
 
 # Canonical string keys (kept spark-compatible in spirit so reference users
@@ -67,10 +68,14 @@ class HyperspaceConf:
     signature_provider: str = "IndexSignatureProvider"
     event_logger: str = ""
     supported_file_formats: str = "parquet,csv,json"
-    # TPU data-plane tunable: rows moved to device per compiled batch.  Keeps
-    # XLA shapes static (arrays are padded to this size) so kernels hit the
-    # compile cache across files of different sizes.
-    device_batch_rows: int = 1 << 20
+    # TPU data-plane tunable: kernel row dimensions are padded up to the
+    # next multiple of this, so builds of different datasets share one
+    # compiled program per capacity instead of paying a fresh XLA compile
+    # per distinct row count.  Env HS_DEVICE_BATCH_ROWS overrides the
+    # default (the test suite shrinks it so tiny CPU builds stay tiny).
+    device_batch_rows: int = dataclasses.field(
+        default_factory=lambda: int(
+            os.environ.get("HS_DEVICE_BATCH_ROWS", 1 << 20)))
     # Below this row count a filter evaluates host-side (arrow compute): a
     # device round trip costs fixed transfer latency (~100 ms over a remote
     # tunnel) that a vectorized host pass over a small batch never repays.

@@ -81,6 +81,9 @@ def compile_predicate(expr: Expr, column_order: Sequence[str]
     program shape anyway.  The jitted function is memoized by expression
     structure so repeated queries hit XLA's compile cache.
     """
+    from hyperspace_tpu.utils.xla_cache import ensure_persistent_xla_cache
+
+    ensure_persistent_xla_cache()
     parts: List = []
     extracted: List[float] = []
     _structure_key(expr, parts, extracted)
@@ -129,8 +132,10 @@ def compile_predicate(expr: Expr, column_order: Sequence[str]
 
     fn = build(expr)
     jitted = jax.jit(lambda cols, lits: fn(cols, lits))
+    # Order matters: a diverged entry must never reach the cache — later
+    # identical queries would hit it and bind literals to wrong positions.
+    assert literals == extracted, "literal traversal order diverged"
     if len(_PREDICATE_CACHE) >= _PREDICATE_CACHE_MAX:
         _PREDICATE_CACHE.clear()  # degenerate workload: reset, don't grow
     _PREDICATE_CACHE[key] = jitted
-    assert literals == extracted, "literal traversal order diverged"
     return jitted, literals

@@ -90,6 +90,9 @@ def sorted_equi_join(left_keys: np.ndarray, right_keys: np.ndarray
     # SF100 exceeds 2^31) take the scoped-x64 path — scoped, not global,
     # because flipping x64 globally would change dtype defaults for every
     # other JAX user in the process.
+    from hyperspace_tpu.utils.xla_cache import ensure_persistent_xla_cache
+
+    ensure_persistent_xla_cache()
     left_keys = np.asarray(left_keys)
     right_keys = np.asarray(right_keys)
     if (np.issubdtype(left_keys.dtype, np.integer)

@@ -29,6 +29,7 @@ from hyperspace_tpu.ops.hash import _bucket_ids_impl, use_pallas
 def _bucket_sort_impl(
     word_cols,
     order_words,
+    n_valid,
     num_buckets: int,
     pallas: bool,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -36,6 +37,13 @@ def _bucket_sort_impl(
     # duplicating it risks the two silently diverging, which corrupts the
     # durable on-disk bucket layout.
     buckets = _bucket_ids_impl(word_cols, num_buckets, pallas)
+    # Capacity padding: rows at positions >= n_valid get bucket id
+    # ``num_buckets`` — past every real bucket, so the stable lexsort parks
+    # them after all real rows and ``perm[:n]`` is the real permutation.
+    # ``n_valid`` is a TRACED scalar: row count changes don't retrace.
+    n = word_cols[0].shape[0]
+    buckets = jnp.where(jnp.arange(n) < n_valid, buckets,
+                        jnp.int32(num_buckets))
     # jnp.lexsort: LAST key is the primary.  Order: bucket first, then key
     # columns in config order, each (hi, lo) word pair hi-major.
     keys = []
@@ -47,10 +55,21 @@ def _bucket_sort_impl(
     return buckets, perm
 
 
+def _pad_rows(arr, capacity: int):
+    import numpy as np
+
+    arr = np.asarray(arr)
+    if arr.shape[0] == capacity:
+        return arr
+    pad = np.zeros((capacity - arr.shape[0],) + arr.shape[1:], dtype=arr.dtype)
+    return np.concatenate([arr, pad], axis=0)
+
+
 def bucket_sort_permutation(
     word_cols: Sequence[jnp.ndarray],
     order_words: Sequence[jnp.ndarray],
     num_buckets: int,
+    pad_to: int = 0,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Fused hash + sort kernel.
 
@@ -58,6 +77,11 @@ def bucket_sort_permutation(
       word_cols: per key column (n, 2) uint32 hash words.
       order_words: per key column (n, 2) uint32 monotone order words.
       num_buckets: static bucket count.
+      pad_to: when > 0, pad the row dimension up to the next multiple so
+        every build shares one compiled program per (capacity, key count) —
+        without this each distinct dataset size pays a fresh XLA compile
+        (tens of seconds on a real chip).  The conf knob is
+        ``device_batch_rows``.
 
     Returns:
       (bucket_ids int32 (n,), perm int32 (n,)) where perm orders rows by
@@ -66,8 +90,20 @@ def bucket_sort_permutation(
     On TPU the hash stage runs as the fused pallas kernel; the choice is a
     static jit arg so env flips retrace (see ``ops.hash.use_pallas``).
     """
-    return _bucket_sort_impl(
-        tuple(word_cols), tuple(order_words), num_buckets, use_pallas())
+    from hyperspace_tpu.utils.xla_cache import ensure_persistent_xla_cache
+
+    ensure_persistent_xla_cache()
+    n = int(word_cols[0].shape[0])
+    if pad_to and pad_to > 0:
+        capacity = -(-max(n, 1) // pad_to) * pad_to
+        word_cols = [_pad_rows(w, capacity) for w in word_cols]
+        order_words = [_pad_rows(w, capacity) for w in order_words]
+    buckets, perm = _bucket_sort_impl(
+        tuple(word_cols), tuple(order_words), n, num_buckets, use_pallas())
+    if buckets.shape[0] != n:
+        buckets = buckets[:n]
+        perm = perm[:n]
+    return buckets, perm
 
 
 @partial(jax.jit, static_argnames=("num_buckets",))

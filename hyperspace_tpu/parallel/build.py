@@ -28,17 +28,20 @@ def distributed_bucket_sort_permutation(
     num_buckets: int,
     mesh,
     slack: float = 1.5,
+    pad_to: int = 0,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """(bucket_ids, perm) for ``table`` computed over ``mesh``.
 
     Equivalent ordering contract to ``ops.sort.bucket_sort_permutation``:
     ``perm`` orders rows by (bucket, indexed columns); ``bucket_ids`` are
-    per-row (pre-permutation) bucket assignments.
+    per-row (pre-permutation) bucket assignments.  ``pad_to`` quantizes the
+    per-device shard length so different dataset sizes share one compiled
+    program (same knob as the single-chip kernel).
     """
     hash_words = [columnar.to_hash_words(table.column(c)) for c in indexed_columns]
     order_words = [columnar.to_order_words(table.column(c)) for c in indexed_columns]
     result, _ = bucket_shuffle(hash_words, order_words, num_buckets, mesh,
-                               slack=slack)
+                               slack=slack, pad_local_to=pad_to)
     n = table.num_rows
     bucket_ids = np.empty(n, dtype=np.int32)
     bucket_ids[result.perm] = result.buckets_sorted

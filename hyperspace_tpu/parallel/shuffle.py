@@ -141,6 +141,7 @@ def bucket_shuffle(
     payload_words: Optional[np.ndarray] = None,
     capacity: Optional[int] = None,
     slack: float = 1.5,
+    pad_local_to: int = 0,
 ) -> Tuple[ShuffleResult, Optional[np.ndarray]]:
     """Run the distributed shuffle for ``n`` global rows.
 
@@ -153,11 +154,18 @@ def bucket_shuffle(
         (numeric column data for all-device pipelines).
       capacity: per-(src,dst) row capacity; None = balanced estimate with
         ``slack`` headroom, doubled on overflow until the shuffle fits.
+      pad_local_to: when > 0, round the per-device shard length up to the
+        next multiple so builds of different dataset sizes share one
+        compiled program (the ``valid`` mask drops the padding) — the same
+        capacity-padding contract as the single-chip kernel's ``pad_to``.
 
     Returns:
       (ShuffleResult, routed_payload) — routed_payload is (n, E) uint32 in
       ``perm`` order (None when no payload was given).
     """
+    from hyperspace_tpu.utils.xla_cache import ensure_persistent_xla_cache
+
+    ensure_persistent_xla_cache()
     n = hash_words[0].shape[0]
     n_devices = mesh.devices.size
     if n == 0:
@@ -171,6 +179,9 @@ def bucket_shuffle(
             if payload_words is not None else None)
     n_key_cols = len(hash_words)
     local = -(-n // n_devices)  # rows per device, ceil
+    if pad_local_to and pad_local_to > 0:
+        quantum = max(1, -(-pad_local_to // n_devices))
+        local = -(-local // quantum) * quantum
     padded = local * n_devices
 
     def pad(a: np.ndarray) -> np.ndarray:

@@ -1,0 +1,41 @@
+"""Persistent XLA compilation cache, applied lazily at first kernel dispatch.
+
+First compile of the build/query kernels costs tens of seconds on a real
+chip; the on-disk cache makes that a once-per-machine cost instead of
+once-per-process.  Applied from the engine's own kernel entry points — NOT
+at package import — so embedding applications that merely import
+hyperspace_tpu never have their own JAX programs redirected into our cache
+directory.  ``HS_XLA_CACHE=0`` disables; an app-configured
+``jax_compilation_cache_dir`` is always honored.
+"""
+
+from __future__ import annotations
+
+import os
+
+_applied = False
+
+
+def ensure_persistent_xla_cache() -> None:
+    global _applied
+    if _applied:
+        return
+    _applied = True
+    if os.environ.get("HS_XLA_CACHE", "1") == "0":
+        return
+    try:
+        import jax
+
+        if jax.config.jax_compilation_cache_dir:
+            return  # the application already chose a cache; keep it
+        cache_dir = os.path.join(
+            os.environ.get("XDG_CACHE_HOME",
+                           os.path.join(os.path.expanduser("~"), ".cache")),
+            "hyperspace_tpu", "xla-cache")
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # Cache every kernel: the default min-entry threshold skips exactly
+        # the small-but-slow-to-compile programs we care about.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:  # pragma: no cover - cache is an optimization only
+        pass

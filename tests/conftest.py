@@ -14,6 +14,11 @@ import os
 # pinned via jax.config, not JAX_PLATFORMS, because the environment's TPU
 # tunnel re-sets the env var at interpreter startup.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Tiny kernel capacity: tests build 10-row indexes; padding them to the
+# production 1M-row batch would lexsort a million rows per create.
+os.environ.setdefault("HS_DEVICE_BATCH_ROWS", "4096")
+# Keep the persistent XLA cache out of the developer cache dir during tests.
+os.environ.setdefault("HS_XLA_CACHE", "0")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

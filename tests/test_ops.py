@@ -173,3 +173,21 @@ class TestHostHashMirror:
         e = np.empty(0, dtype=np.int64)
         assert sorted_equi_join_np(e, rk)[0].size == 0
         assert sorted_equi_join_np(lk, e)[1].size == 0
+
+    def test_padded_bucket_sort_matches_exact(self):
+        """Capacity padding must not change the result: padded rows park
+        after all real rows, so buckets[:n]/perm[:n] equal the unpadded
+        kernel's output."""
+        import numpy as np
+
+        from hyperspace_tpu.ops.sort import bucket_sort_permutation
+
+        rng = np.random.default_rng(9)
+        for n in (1, 7, 100, 1000):
+            wc = [rng.integers(0, 2**32, size=(n, 2), dtype=np.uint32)]
+            ow = [rng.integers(0, 2**32, size=(n, 2), dtype=np.uint32)]
+            b0, p0 = bucket_sort_permutation(wc, ow, 8)
+            b1, p1 = bucket_sort_permutation(wc, ow, 8, pad_to=256)
+            assert np.array_equal(np.asarray(b0), np.asarray(b1)), n
+            assert np.array_equal(np.asarray(p0), np.asarray(p1)), n
+            assert np.asarray(p1).max() < n  # no padded index leaks
