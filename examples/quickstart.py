@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""End-to-end example: build a covering index, watch a filter and a join get
+rewritten, inspect explain output, and walk the mutable-data lifecycle.
+
+Run: python examples/quickstart.py   (writes under a temp directory)
+The reference's examples/ plays the same role (csharp/HyperspaceApp +
+notebooks); this is the Python-native equivalent.
+"""
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col  # noqa: E402
+
+
+def main() -> None:
+    root = tempfile.mkdtemp(prefix="hs_example_")
+    events = os.path.join(root, "events")
+    os.makedirs(events)
+    n = 100_000
+    pq.write_table(pa.table({
+        "id": np.arange(n, dtype=np.int64),
+        "name": pa.array([f"name-{i}" for i in range(n)]),
+        "payload": np.arange(n, dtype=np.int64),
+    }), os.path.join(events, "part-0.parquet"))
+
+    session = HyperspaceSession(system_path=os.path.join(root, "indexes"))
+    session.conf.num_buckets = 8
+    hs = Hyperspace(session)
+
+    df = session.read.parquet(events)
+    hs.create_index(df, IndexConfig("events_by_id", ["id"], ["name"]))
+    print(hs.indexes())
+
+    session.enable_hyperspace()
+    q = df.filter(col("id") == 42_000).select("id", "name")
+    print(hs.explain(q, verbose=True))
+    print("point lookup:", q.collect().to_pydict())
+
+    other = session.read.parquet(events)
+    joined = (df.join(other, col("id") == col("id"))
+              .select("id", "name").collect())
+    print("self-join rows:", joined.num_rows)
+
+    # Mutable data: append a file, refresh incrementally, query again.
+    pq.write_table(pa.table({
+        "id": pa.array([n + 1], type=pa.int64()),
+        "name": pa.array(["appended"]),
+        "payload": pa.array([0], type=pa.int64()),
+    }), os.path.join(events, "part-1.parquet"))
+    hs.refresh_index("events_by_id", "incremental")
+    got = (session.read.parquet(events).filter(col("id") == n + 1)
+           .select("name").collect())
+    print("after refresh:", got.to_pydict())
+
+    hs.optimize_index("events_by_id")
+    hs.delete_index("events_by_id")
+    hs.restore_index("events_by_id")
+    print("lifecycle complete; index root:", session.conf.system_path)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,18 @@
+#!/usr/bin/env python
+"""Test runner (the reference's root run-tests.py analog): runs the suite on
+the virtual 8-device CPU mesh the conftest configures, then the plan-
+stability suite in verification mode."""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+
+
+def main() -> int:
+    return subprocess.call(
+        [sys.executable, "-m", "pytest", "tests/", "-q"] + sys.argv[1:])
+
+
+if __name__ == "__main__":
+    sys.exit(main())
