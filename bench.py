@@ -58,6 +58,9 @@ def _gen_data(root: str):
         "l_quantity": rng.integers(1, 50, N_LINEITEM).astype(np.float64),
         "l_extendedprice": rng.random(N_LINEITEM) * 1e4,
         "l_discount": rng.random(N_LINEITEM) * 0.1,
+        # Time-correlated column (monotone across the dataset, so each file
+        # covers a disjoint date range — the layout data skipping exploits).
+        "l_shipdate": np.arange(N_LINEITEM, dtype=np.int64),
     }
     for i in range(10):
         li[f"l_pad{i}"] = rng.random(N_LINEITEM)
@@ -126,6 +129,10 @@ def main() -> None:
         hs.create_index(session.read.parquet(orders_dir),
                         IndexConfig("ord_idx", ["o_orderkey"],
                                     ["o_totalprice"]))
+        from hyperspace_tpu import DataSkippingIndexConfig
+
+        hs.create_index(session.read.parquet(lineitem_dir),
+                        DataSkippingIndexConfig("li_ds", ["l_shipdate"]))
         build_s = time.perf_counter() - t_build0
 
         probe_key = 123_457
@@ -154,8 +161,18 @@ def main() -> None:
                             "l_extendedprice")
                     .collect())
 
+        def q_ds_range():
+            # BASELINE.json's data-skipping config: a date-range scan over
+            # the wide table; min/max file pruning reads 1/8 of the files.
+            lo, hi = 300_000, 390_000
+            return (session.read.parquet(lineitem_dir)
+                    .filter((col("l_shipdate") >= lo) & (col("l_shipdate") < hi))
+                    .select("l_shipdate", "l_extendedprice", "l_discount")
+                    .collect())
+
         results = {}
-        for name, q in (("filter", q_filter), ("join", q_join)):
+        for name, q in (("filter", q_filter), ("join", q_join),
+                        ("ds_range", q_ds_range)):
             session.disable_hyperspace()
             expected = q()
             base_s = _time(q)
@@ -193,6 +210,8 @@ def main() -> None:
                 "filter_indexed_s": round(results["filter"][1], 4),
                 "join_scan_s": round(results["join"][0], 4),
                 "join_indexed_s": round(results["join"][1], 4),
+                "ds_range_scan_s": round(results["ds_range"][0], 4),
+                "ds_range_indexed_s": round(results["ds_range"][1], 4),
                 "index_build_s": round(build_s, 3),
                 "platform": _platform(),
             },

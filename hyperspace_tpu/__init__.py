@@ -14,7 +14,7 @@ from hyperspace_tpu.config import HyperspaceConf
 from hyperspace_tpu.dataset import Dataset
 from hyperspace_tpu.exceptions import HyperspaceError
 from hyperspace_tpu.hyperspace import Hyperspace
-from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.index.index_config import DataSkippingIndexConfig, IndexConfig
 from hyperspace_tpu.plan.expr import col, lit
 from hyperspace_tpu.session import HyperspaceSession
 
@@ -26,6 +26,7 @@ __all__ = [
     "HyperspaceConf",
     "HyperspaceError",
     "IndexConfig",
+    "DataSkippingIndexConfig",
     "Dataset",
     "col",
     "lit",

@@ -1,0 +1,300 @@
+"""Data-skipping index actions: build and refresh per-file sketches.
+
+A data-skipping index stores one row per source data file with min/max (and
+row/null counts) for each sketched column, persisted as a single Parquet
+sketch file under the index's ``v__=N`` directory.  The query rule
+(rules/data_skipping.py) intersects predicates with the per-file intervals
+and shrinks the scan's file list — no source data is copied or rewritten.
+
+Capability beyond the reference snapshot (its v0.5 has only the covering
+index; ROADMAP.md:92-94 plans "more index types"); lifecycle plumbing (log
+states, versioned data dirs, signatures) is shared with the covering-index
+actions so every other subsystem treats both kinds uniformly.
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+from typing import Dict, List, Optional, Sequence
+
+import pyarrow as pa
+import pyarrow.compute as pc
+import pyarrow.parquet as pq
+
+from hyperspace_tpu.actions.create import CreateActionBase
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.index.index_config import DataSkippingIndexConfig
+from hyperspace_tpu.index.log_entry import (
+    Content,
+    DataSkippingIndex,
+    FileIdTracker,
+    FileInfo,
+    IndexLogEntry,
+    LogicalPlanFingerprint,
+    Source,
+    States,
+)
+from hyperspace_tpu.io.parquet import read_table
+from hyperspace_tpu.telemetry.events import CreateActionEvent
+from hyperspace_tpu.utils.resolver import resolve_or_raise
+
+# Sketch-table metadata columns (underscored like the lineage column).
+SKETCH_FILE_NAME = "_ds_file_name"
+SKETCH_FILE_SIZE = "_ds_file_size"
+SKETCH_FILE_MTIME = "_ds_file_mtime"
+SKETCH_ROW_COUNT = "_ds_row_count"
+
+
+def _min_col(c: str) -> str:
+    return f"min__{c}"
+
+
+def _max_col(c: str) -> str:
+    return f"max__{c}"
+
+
+def _null_col(c: str) -> str:
+    return f"nulls__{c}"
+
+
+def _sketch_from_parquet_footer(path: str,
+                                columns: Sequence[str]) -> Optional[Dict]:
+    """min/max/null counts from the Parquet footer's row-group statistics —
+    O(footer) instead of O(data).  None when any sketched column lacks
+    statistics in any row group (caller falls back to a full read)."""
+    md = pq.ParquetFile(path).metadata
+    name_to_ix = {md.schema.column(i).name: i for i in range(md.num_columns)}
+    out: Dict = {SKETCH_ROW_COUNT: md.num_rows}
+    for c in columns:
+        ix = name_to_ix.get(c)
+        if ix is None:
+            out[_min_col(c)] = None
+            out[_max_col(c)] = None
+            out[_null_col(c)] = md.num_rows
+            continue
+        mins, maxs, nulls = [], [], 0
+        for rg in range(md.num_row_groups):
+            stats = md.row_group(rg).column(ix).statistics
+            if stats is None or not stats.has_min_max \
+                    or stats.null_count is None:
+                return None
+            nulls += stats.null_count
+            if md.row_group(rg).num_rows > stats.null_count:
+                mins.append(stats.min)
+                maxs.append(stats.max)
+        out[_min_col(c)] = min(mins) if mins else None
+        out[_max_col(c)] = max(maxs) if maxs else None
+        out[_null_col(c)] = nulls
+    return out
+
+
+def sketch_rows_for_files(files: Sequence[FileInfo], columns: Sequence[str],
+                          read_format: str,
+                          options: Dict[str, str]) -> List[Dict]:
+    """One sketch row per file: min/max/null-count per sketched column.
+    Parquet files are sketched from footer statistics when available."""
+    rows: List[Dict] = []
+    for f in files:
+        row: Dict = {
+            SKETCH_FILE_NAME: f.name,
+            SKETCH_FILE_SIZE: f.size,
+            SKETCH_FILE_MTIME: f.mtime,
+        }
+        stats = _sketch_from_parquet_footer(f.name, columns) \
+            if read_format == "parquet" else None
+        if stats is not None:
+            row.update(stats)
+            rows.append(row)
+            continue
+        t = read_table([f.name], read_format, list(columns), options)
+        row[SKETCH_ROW_COUNT] = t.num_rows
+        for c in columns:
+            col = t.column(c) if c in t.column_names else None
+            if col is None or col.null_count == len(col) or t.num_rows == 0:
+                row[_min_col(c)] = None
+                row[_max_col(c)] = None
+                row[_null_col(c)] = t.num_rows
+            else:
+                mm = pc.min_max(col)
+                row[_min_col(c)] = mm["min"].as_py()
+                row[_max_col(c)] = mm["max"].as_py()
+                row[_null_col(c)] = col.null_count
+        rows.append(row)
+    return rows
+
+
+def write_sketch(rows: List[Dict], out_dir: str) -> str:
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, f"sketch-{uuid.uuid4().hex[:12]}.parquet")
+    pq.write_table(pa.Table.from_pylist(rows), path)
+    return path
+
+
+def read_sketch(entry: IndexLogEntry) -> pa.Table:
+    files = [f.name for f in entry.content.file_infos()]
+    if not files:
+        return pa.table({})
+    return pa.concat_tables([pq.read_table(p) for p in files],
+                            promote_options="default")
+
+
+class CreateDataSkippingAction(CreateActionBase):
+    transient_state = States.CREATING
+    final_state = States.ACTIVE
+    event_class = CreateActionEvent
+
+    # -- config resolution (sketched columns, not indexed/included) --------
+    def _resolved_config(self) -> DataSkippingIndexConfig:
+        schema = self._relation().schema()
+        sketched = resolve_or_raise(self.config.sketched_columns, schema,
+                                    "sketched column")
+        return DataSkippingIndexConfig(self.config.index_name, sketched)
+
+    def validate(self) -> None:
+        if self.previous_log_entry is not None and \
+                self.previous_log_entry.state not in (States.DOESNOTEXIST,):
+            raise HyperspaceError(
+                f"Another index with name {self.config.index_name!r} already "
+                f"exists in state {self.previous_log_entry.state}")
+        leaves = self.plan.leaf_relations()
+        if len(leaves) != 1 or not \
+                self.session.source_provider_manager.is_supported_relation(leaves[0]):
+            raise HyperspaceError("Only plans over one supported file-based "
+                                  "relation can be indexed")
+        self._resolved_config()
+
+    # -- build -------------------------------------------------------------
+    def _build_sketch(self, file_names: Optional[List[str]] = None,
+                      carry_rows: Optional[List[Dict]] = None) -> None:
+        relation = self._relation()
+        resolved = self._resolved_config()
+        files = relation.all_files(self._file_id_tracker)
+        if file_names is not None:
+            wanted = set(file_names)
+            files = [f for f in files if f.name in wanted]
+        rows = list(carry_rows or [])
+        rows.extend(sketch_rows_for_files(
+            files, resolved.sketched_columns, relation.read_format,
+            relation.options))
+        if not rows:
+            raise HyperspaceError("No source data files to sketch")
+        version = self.data_manager.get_next_version()
+        write_sketch(rows, self.data_manager.version_path(version))
+        self._written_version = version
+        schema = self._relation().schema()
+        self._index_schema = {c: schema[c] for c in resolved.sketched_columns
+                              if c in schema}
+
+    def _derived_dataset(self) -> DataSkippingIndex:
+        resolved = self._resolved_config()
+        return DataSkippingIndex(
+            sketched_columns=resolved.sketched_columns,
+            sketch_types=["MinMax"] * len(resolved.sketched_columns),
+            schema=getattr(self, "_index_schema", {}),
+        )
+
+    def log_entry_for_begin(self) -> IndexLogEntry:
+        relation = self._relation()
+        rel_meta = relation.create_relation_metadata(FileIdTracker())
+        return IndexLogEntry(
+            name=self.config.index_name,
+            derived_dataset=self._derived_dataset(),
+            content=Content.from_leaf_files([]) or Content.from_directory(
+                self.data_manager.index_path, FileIdTracker()),
+            source=Source(relations=[rel_meta],
+                          fingerprint=LogicalPlanFingerprint([self._signature()])),
+        )
+
+    def op(self) -> None:
+        self._build_sketch()
+
+    def log_entry(self) -> IndexLogEntry:
+        relation = self._relation()
+        rel_meta = relation.create_relation_metadata(self._file_id_tracker)
+        properties: Dict[str, str] = {"lineage": "false"}
+        properties["indexLogVersion"] = str(self.base_id + 2)
+        properties = self.session.source_provider_manager.enrich_index_properties(
+            rel_meta, properties)
+        content = Content.from_directory(
+            self.data_manager.version_path(self._written_version), FileIdTracker())
+        return IndexLogEntry(
+            name=self.config.index_name,
+            derived_dataset=self._derived_dataset(),
+            content=content,
+            source=Source(relations=[rel_meta],
+                          fingerprint=LogicalPlanFingerprint([self._signature()])),
+            properties=properties,
+        )
+
+
+class RefreshDataSkippingAction(CreateDataSkippingAction):
+    """Refresh a data-skipping sketch: re-sketch appended files, drop rows
+    for deleted files, carry everything else forward unchanged.  One action
+    serves full and incremental modes — per-file sketches make incremental
+    the natural implementation (re-sketching unchanged files would produce
+    identical rows)."""
+
+    transient_state = States.REFRESHING
+
+    def __init__(self, log_manager, data_manager, session,
+                 previous: Optional[IndexLogEntry] = None) -> None:
+        from hyperspace_tpu.plan.nodes import Scan, ScanRelation
+        from hyperspace_tpu.telemetry.events import RefreshActionEvent
+
+        prev = previous if previous is not None \
+            else log_manager.get_latest_stable_log()
+        if prev is None:
+            raise HyperspaceError("Refresh: index does not exist")
+        rel_meta = session.source_provider_manager.refresh_relation_metadata(
+            prev.relations[0])
+        plan = Scan(ScanRelation(
+            root_paths=tuple(rel_meta.root_paths),
+            file_format=rel_meta.file_format,
+            options=tuple(sorted(rel_meta.options.items())),
+        ))
+        config = DataSkippingIndexConfig(
+            prev.name, prev.derived_dataset.sketched_columns)
+        super().__init__(log_manager, data_manager, session, plan, config)
+        self.event_class = RefreshActionEvent
+        self._previous_entry = prev
+        self._file_id_tracker = FileIdTracker.from_log_entry(prev)
+
+    def _changed_files(self):
+        recorded = {(f.name, f.size, f.mtime)
+                    for f in self._previous_entry.source_file_infos()}
+        current = self._relation().all_files(self._file_id_tracker)
+        current_keys = {(f.name, f.size, f.mtime) for f in current}
+        appended = [f for f in current
+                    if (f.name, f.size, f.mtime) not in recorded]
+        deleted_keys = recorded - current_keys
+        return appended, deleted_keys
+
+    def validate(self) -> None:
+        from hyperspace_tpu.exceptions import NoChangesError
+
+        if self.previous_log_entry is None or \
+                self.previous_log_entry.state != States.ACTIVE:
+            raise HyperspaceError(
+                f"Refresh is only supported in {States.ACTIVE} state")
+        appended, deleted = self._changed_files()
+        if not appended and not deleted:
+            raise NoChangesError("Source data is unchanged; refresh is a no-op")
+
+    def log_entry_for_begin(self) -> IndexLogEntry:
+        import copy
+
+        return copy.deepcopy(self._previous_entry)
+
+    def op(self) -> None:
+        appended, deleted_keys = self._changed_files()
+        old = read_sketch(self._previous_entry)
+        carry: List[Dict] = []
+        if old.num_rows:
+            for row in old.to_pylist():
+                key = (row[SKETCH_FILE_NAME], row[SKETCH_FILE_SIZE],
+                       row[SKETCH_FILE_MTIME])
+                if key not in deleted_keys:
+                    carry.append(row)
+        self._build_sketch(file_names=[f.name for f in appended],
+                           carry_rows=carry)

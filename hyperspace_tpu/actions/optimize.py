@@ -78,6 +78,11 @@ class OptimizeAction(Action):
                 self.previous_log_entry.state != States.ACTIVE:
             raise HyperspaceError(
                 f"Optimize is only supported in {States.ACTIVE} state")
+        if not self.previous_log_entry.is_covering:
+            # A data-skipping sketch is one small file per version; there is
+            # nothing to compact.
+            raise HyperspaceError(
+                "Optimize applies to covering indexes only")
         if not self._candidates():
             raise NoChangesError(
                 "No index files eligible for optimization (every bucket has "

@@ -48,8 +48,11 @@ class RefreshActionBase(CreateActionBase):
     event_class = RefreshActionEvent
 
     def __init__(self, log_manager: IndexLogManager, data_manager: IndexDataManager,
-                 session) -> None:
-        prev = log_manager.get_latest_stable_log()
+                 session, previous: Optional[IndexLogEntry] = None) -> None:
+        # ``previous`` lets the dispatching manager hand over the stable
+        # entry it already read instead of parsing the log twice.
+        prev = previous if previous is not None \
+            else log_manager.get_latest_stable_log()
         if prev is None:
             raise HyperspaceError("Refresh: index does not exist")
         if len(prev.relations) != 1:

@@ -64,3 +64,42 @@ class IndexConfig:
     @property
     def all_columns(self) -> List[str]:
         return list(self.indexed_columns) + list(self.included_columns)
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSkippingIndexConfig:
+    """Spec for a data-skipping index: per-source-file sketches over
+    ``sketched_columns`` (min/max today).  Unlike the covering index, no
+    data is copied — queries scan the source with a pruned file list."""
+
+    index_name: str
+    sketched_columns: List[str]
+
+    def __init__(self, index_name: str,
+                 sketched_columns: Sequence[str]) -> None:
+        object.__setattr__(self, "index_name", index_name)
+        object.__setattr__(self, "sketched_columns", list(sketched_columns))
+        self._validate()
+
+    def _validate(self) -> None:
+        if not self.index_name or not self.index_name.strip():
+            raise HyperspaceError("Index name cannot be empty")
+        if not self.sketched_columns:
+            raise HyperspaceError("Sketched columns cannot be empty")
+        lowered = [c.lower() for c in self.sketched_columns]
+        if len(set(lowered)) != len(lowered):
+            raise HyperspaceError("Duplicate sketched column names are not allowed")
+
+    # Case-insensitive equality/hash — the same contract as IndexConfig
+    # (IndexConfig.scala:55-66); the generated dataclass pair would be
+    # case-sensitive and unhashable (list field).
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, DataSkippingIndexConfig):
+            return NotImplemented
+        return (self.index_name.lower() == other.index_name.lower()
+                and [c.lower() for c in self.sketched_columns]
+                == [c.lower() for c in other.sketched_columns])
+
+    def __hash__(self) -> int:
+        return hash((self.index_name.lower(),
+                     tuple(c.lower() for c in self.sketched_columns)))

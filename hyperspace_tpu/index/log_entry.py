@@ -266,6 +266,65 @@ class CoveringIndex:
         return self.indexed_columns + self.included_columns
 
 
+@dataclasses.dataclass
+class DataSkippingIndex:
+    """Data-skipping index spec: per-source-file sketches (min/max today)
+    over ``sketched_columns``.  Queries keep scanning the SOURCE data; the
+    rule only shrinks the file list.  This kind is the reference roadmap's
+    "more index types" (ROADMAP.md:92-94) realized — the v0.5 snapshot has
+    only the covering index, so this is capability beyond reference parity
+    (BASELINE.json's Z-order/data-skipping config)."""
+
+    KIND = "DataSkippingIndex"
+    KIND_ABBR = "DS"
+
+    sketched_columns: List[str]
+    sketch_types: List[str]  # per-column family; "MinMax" today
+    schema: Dict[str, str]  # sketched column name -> arrow dtype string
+    properties: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "kind": self.KIND,
+            "properties": {
+                "sketches": [
+                    {"column": c, "type": t}
+                    for c, t in zip(self.sketched_columns, self.sketch_types)
+                ],
+                "schema": self.schema,
+                "properties": self.properties,
+            },
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "DataSkippingIndex":
+        p = d["properties"]
+        return DataSkippingIndex(
+            [s["column"] for s in p["sketches"]],
+            [s["type"] for s in p["sketches"]],
+            dict(p.get("schema", {})),
+            dict(p.get("properties", {})),
+        )
+
+    @property
+    def all_columns(self) -> List[str]:
+        return list(self.sketched_columns)
+
+
+_DERIVED_DATASET_KINDS = {
+    CoveringIndex.KIND: CoveringIndex,
+    DataSkippingIndex.KIND: DataSkippingIndex,
+}
+
+
+def derived_dataset_from_dict(d: Dict[str, Any]):
+    kind = d.get("kind")
+    cls = _DERIVED_DATASET_KINDS.get(kind)
+    if cls is None:
+        raise ValueError(f"Unknown derived dataset kind: {kind!r}")
+    return cls.from_dict(d)
+
+
 # ---------------------------------------------------------------------------
 # Signatures / fingerprints / source snapshot
 # ---------------------------------------------------------------------------
@@ -433,7 +492,7 @@ class IndexLogEntry:
             raise ValueError(f"Unsupported log entry version: {d.get('version')!r}")
         return IndexLogEntry(
             name=d["name"],
-            derived_dataset=CoveringIndex.from_dict(d["derivedDataset"]),
+            derived_dataset=derived_dataset_from_dict(d["derivedDataset"]),
             content=Content.from_dict(d["content"]),
             source=Source.from_dict(d["source"]),
             properties=dict(d.get("properties", {})),
@@ -444,16 +503,27 @@ class IndexLogEntry:
 
     # -- accessors mirroring the reference ---------------------------------
     @property
+    def is_covering(self) -> bool:
+        return isinstance(self.derived_dataset, CoveringIndex)
+
+    @property
     def indexed_columns(self) -> List[str]:
+        # Data-skipping entries expose their sketched columns here so
+        # kind-agnostic display code (statistics, explain) works; the
+        # rewrite rules filter by kind before touching these.
+        if not self.is_covering:
+            return list(self.derived_dataset.sketched_columns)
         return self.derived_dataset.indexed_columns
 
     @property
     def included_columns(self) -> List[str]:
+        if not self.is_covering:
+            return []
         return self.derived_dataset.included_columns
 
     @property
     def num_buckets(self) -> int:
-        return self.derived_dataset.num_buckets
+        return getattr(self.derived_dataset, "num_buckets", 0)
 
     @property
     def kind_abbr(self) -> str:

@@ -34,10 +34,14 @@ class IndexCollectionManager:
     # -- lifecycle APIs (IndexCollectionManager.scala:36-107) ---------------
     def create(self, dataset, config: IndexConfig) -> None:
         from hyperspace_tpu.actions.create import CreateAction
+        from hyperspace_tpu.actions.data_skipping import CreateDataSkippingAction
+        from hyperspace_tpu.index.index_config import DataSkippingIndexConfig
 
-        CreateAction(self._log_manager(config.index_name),
-                     self._data_manager(config.index_name),
-                     self.session, dataset.plan, config).run()
+        action_cls = CreateDataSkippingAction \
+            if isinstance(config, DataSkippingIndexConfig) else CreateAction
+        action_cls(self._log_manager(config.index_name),
+                   self._data_manager(config.index_name),
+                   self.session, dataset.plan, config).run()
 
     def delete(self, name: str) -> None:
         from hyperspace_tpu.actions.delete import DeleteAction
@@ -60,6 +64,7 @@ class IndexCollectionManager:
         CancelAction(self._log_manager(name)).run()
 
     def refresh(self, name: str, mode: str = "full") -> None:
+        from hyperspace_tpu.actions.data_skipping import RefreshDataSkippingAction
         from hyperspace_tpu.actions.refresh import (
             RefreshAction,
             RefreshIncrementalAction,
@@ -71,7 +76,14 @@ class IndexCollectionManager:
                "quick": RefreshQuickAction}.get(mode)
         if cls is None:
             raise HyperspaceError(f"Unknown refresh mode {mode!r}")
-        cls(self._log_manager(name), self._data_manager(name), self.session).run()
+        # Data-skipping sketches are rebuilt/patched by their own action
+        # (quick refresh is kind-agnostic: metadata only).  The stable entry
+        # read here is handed to the action so the log parses once.
+        stable = self._log_manager(name).get_latest_stable_log()
+        if stable is not None and not stable.is_covering and mode != "quick":
+            cls = RefreshDataSkippingAction
+        cls(self._log_manager(name), self._data_manager(name), self.session,
+            previous=stable).run()
 
     def optimize(self, name: str, mode: str = "quick") -> None:
         from hyperspace_tpu.actions.optimize import OptimizeAction

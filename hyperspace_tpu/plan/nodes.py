@@ -38,6 +38,11 @@ class ScanRelation:
     # Predicate-derived bucket pruning: only these buckets need scanning
     # (FilterIndexRule bucket pruning, IndexConstants.scala:52-53).
     prune_to_buckets: Optional[Tuple[int, ...]] = None
+    # Data-skipping: the scan still reads the SOURCE relation, but its file
+    # list was pruned by this sketch index (rules/data_skipping.py).
+    # (pruned files, total files) for display.
+    data_skipping_of: Optional[str] = None
+    data_skipping_stats: Optional[Tuple[int, int]] = None
 
     @property
     def options_dict(self) -> Dict[str, str]:
@@ -104,7 +109,14 @@ class Scan(LogicalPlan):
             if rel.prune_to_buckets is not None:
                 tag += f" [buckets: {len(rel.prune_to_buckets)}/{rel.bucket_spec[0]}]"
             return f"Scan {tag}"
-        return f"Scan {','.join(rel.root_paths)} ({rel.file_format})"
+        base = f"Scan {','.join(rel.root_paths)} ({rel.file_format})"
+        if rel.data_skipping_of:
+            tag = f"Hyperspace(Type: DS, Name: {rel.data_skipping_of})"
+            if rel.data_skipping_stats is not None:
+                kept, total = rel.data_skipping_stats
+                tag += f" [files: {kept}/{total}]"
+            return f"{base} {tag}"
+        return base
 
 
 class Filter(LogicalPlan):

@@ -23,8 +23,11 @@ _Line = Tuple[str, bool]
 
 
 def _used_indexes(plan: LogicalPlan) -> List[str]:
-    return sorted({s.relation.index_scan_of for s in plan.leaf_relations()
-                   if s.relation.index_scan_of})
+    used = {s.relation.index_scan_of for s in plan.leaf_relations()
+            if s.relation.index_scan_of}
+    used |= {s.relation.data_skipping_of for s in plan.leaf_relations()
+             if s.relation.data_skipping_of}
+    return sorted(used)
 
 
 def _operator_counts(plan: LogicalPlan) -> Counter:

@@ -1,0 +1,214 @@
+"""DataSkippingFilterRule: shrink a scan's file list using per-file sketches.
+
+Runs after the covering-index rules (a full rewrite beats file pruning).
+Pattern: the same Filter-over-Scan shapes FilterIndexRule matches.  For each
+top-level conjunct of the predicate that constrains exactly one sketched
+column with ==/</<=/>/>=/IN, a file whose [min, max] interval cannot satisfy
+the constraint is dropped from the scan's file list.  The scan still reads
+the SOURCE data — only fewer files of it.
+
+Staleness safety WITHOUT signatures: pruning only ever drops a file that is
+(a) present in the sketch under the exact (name, size, mtime) it was
+sketched with, and (b) provably non-matching.  Files the sketch has never
+seen (appends) or whose stats changed (rewrites) always survive, so a stale
+sketch can only prune less, never wrongly — the index stays useful through
+source mutations with no hybrid-scan machinery.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from hyperspace_tpu.actions.data_skipping import (
+    SKETCH_FILE_MTIME,
+    SKETCH_FILE_NAME,
+    SKETCH_FILE_SIZE,
+    _max_col,
+    _min_col,
+    read_sketch,
+)
+from hyperspace_tpu.index.log_entry import IndexLogEntry, States
+from hyperspace_tpu.plan.expr import BinOp, Col, Expr, IsIn, Lit, split_conjuncts
+from hyperspace_tpu.plan.nodes import Filter, LogicalPlan, Project, Scan, ScanRelation
+from hyperspace_tpu.rules import rule_utils
+from hyperspace_tpu.rules.filter_rule import _extract_filter_node
+from hyperspace_tpu.telemetry.events import HyperspaceIndexUsageEvent, get_event_logger
+
+# In-process memo of loaded sketches keyed by the sketch files' identity
+# (name, size, mtime): correct across rebuilds AND across same-name indexes
+# in different system paths — (name, log id) would collide there.
+_SKETCH_CACHE: Dict[Tuple, List[dict]] = {}
+_SKETCH_CACHE_MAX = 64
+
+
+class _Constraint:
+    """Closed-interval + optional value-set constraint on one column."""
+
+    def __init__(self) -> None:
+        self.lo = None          # value, inclusive unless lo_open
+        self.lo_open = False
+        self.hi = None
+        self.hi_open = False
+        self.values: Optional[set] = None  # IN / == value set
+
+    def add_cmp(self, op: str, value) -> None:
+        if op == "==":
+            self.values = {value} if self.values is None \
+                else self.values & {value}
+        elif op in (">", ">="):
+            if self.lo is None or value > self.lo or \
+                    (value == self.lo and op == ">"):
+                self.lo, self.lo_open = value, op == ">"
+        elif op in ("<", "<="):
+            if self.hi is None or value < self.hi or \
+                    (value == self.hi and op == "<"):
+                self.hi, self.hi_open = value, op == "<"
+
+    def add_values(self, values) -> None:
+        vs = set(values)
+        self.values = vs if self.values is None else self.values & vs
+
+    def file_may_match(self, fmin, fmax) -> bool:
+        """Could a file with non-null range [fmin, fmax] hold a matching
+        row?  ``None`` min/max means the file has no non-null values — no
+        predicate matches null, so it cannot."""
+        if fmin is None or fmax is None:
+            return False
+        try:
+            if self.values is not None:
+                if not any(fmin <= v <= fmax for v in self.values):
+                    return False
+            if self.lo is not None:
+                if fmax < self.lo or (self.lo_open and fmax == self.lo):
+                    return False
+            if self.hi is not None:
+                if fmin > self.hi or (self.hi_open and fmin == self.hi):
+                    return False
+        except TypeError:
+            return True  # incomparable literal/stat types: never mis-prune
+        return True
+
+
+def extract_constraints(condition: Expr,
+                        sketched: List[str]) -> Dict[str, _Constraint]:
+    """Per-column constraints from top-level conjuncts (OR branches and
+    other shapes contribute nothing — pruning stays conservative)."""
+    lowered = {c.lower(): c for c in sketched}
+    out: Dict[str, _Constraint] = {}
+
+    def constraint_for(name: str) -> Optional[_Constraint]:
+        canonical = lowered.get(name.lower())
+        if canonical is None:
+            return None
+        return out.setdefault(canonical, _Constraint())
+
+    _MIRROR = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "==": "=="}
+    for conj in split_conjuncts(condition):
+        if isinstance(conj, BinOp):
+            if isinstance(conj.left, Col) and isinstance(conj.right, Lit):
+                c = constraint_for(conj.left.name)
+                if c is not None and conj.op in _MIRROR:
+                    c.add_cmp(conj.op, conj.right.value)
+            elif isinstance(conj.right, Col) and isinstance(conj.left, Lit):
+                c = constraint_for(conj.right.name)
+                if c is not None and conj.op in _MIRROR:
+                    c.add_cmp(_MIRROR[conj.op], conj.left.value)
+        elif isinstance(conj, IsIn) and isinstance(conj.child, Col):
+            c = constraint_for(conj.child.name)
+            if c is not None:
+                c.add_values(conj.values)
+    return out
+
+
+def _sketch_rows(entry: IndexLogEntry) -> List[dict]:
+    key = tuple(sorted((f.name, f.size, f.mtime)
+                       for f in entry.content.file_infos()))
+    rows = _SKETCH_CACHE.get(key)
+    if rows is None:
+        rows = read_sketch(entry).to_pylist()
+        if len(_SKETCH_CACHE) >= _SKETCH_CACHE_MAX:
+            _SKETCH_CACHE.clear()
+        _SKETCH_CACHE[key] = rows
+    return rows
+
+
+class DataSkippingFilterRule:
+    def __init__(self, session,
+                 entries: Optional[List[IndexLogEntry]] = None) -> None:
+        self.session = session
+        self._entries = entries
+
+    def apply(self, plan: LogicalPlan) -> LogicalPlan:
+        matched = _extract_filter_node(plan)
+        if matched is None:
+            return plan
+        scan, filter_node, _ = matched
+        if rule_utils.is_index_applied(scan) or \
+                scan.relation.data_skipping_of is not None:
+            return plan
+        spm = self.session.source_provider_manager
+        if not spm.is_supported_relation(scan):
+            return plan
+
+        entries = self._entries
+        if entries is None:
+            entries = self.session.index_collection_manager.get_indexes(
+                [States.ACTIVE])
+        ds_entries = [e for e in entries if not e.is_covering]
+        if not ds_entries:
+            return plan
+
+        relation = spm.get_relation(scan)
+        current = relation.all_files()
+        best: Optional[Tuple[IndexLogEntry, List[str]]] = None
+        for entry in ds_entries:
+            constraints = extract_constraints(
+                filter_node.condition, entry.derived_dataset.sketched_columns)
+            if not constraints:
+                continue
+            sketch_by_key = {
+                (r[SKETCH_FILE_NAME], r[SKETCH_FILE_SIZE],
+                 r[SKETCH_FILE_MTIME]): r
+                for r in _sketch_rows(entry)
+            }
+            surviving: List[str] = []
+            for f in current:
+                row = sketch_by_key.get((f.name, f.size, f.mtime))
+                if row is None:
+                    surviving.append(f.name)  # unknown to the sketch: keep
+                    continue
+                ok = all(
+                    c.file_may_match(row.get(_min_col(col)),
+                                     row.get(_max_col(col)))
+                    for col, c in constraints.items())
+                if ok:
+                    surviving.append(f.name)
+            if len(surviving) < len(current):
+                if best is None or len(surviving) < len(best[1]):
+                    best = (entry, surviving)
+        if best is None:
+            return plan
+        entry, surviving = best
+        if not surviving:
+            # Provably empty result; keep one file so the scan retains its
+            # schema — the filter yields zero rows from it.
+            surviving = [current[0].name]
+
+        import dataclasses as dc
+
+        new_rel = dc.replace(scan.relation,
+                             file_paths=tuple(surviving),
+                             data_skipping_of=entry.name,
+                             data_skipping_stats=(len(surviving), len(current)))
+        new_scan = Scan(new_rel)
+
+        def swap(node: LogicalPlan) -> LogicalPlan:
+            return new_scan if node is scan else node
+
+        new_plan = plan.transform_up(swap)
+        get_event_logger().log_event(HyperspaceIndexUsageEvent(
+            index_names=[entry.name],
+            plan_before=plan.tree_string(),
+            plan_after=new_plan.tree_string(),
+            message="DataSkippingFilterRule applied"))
+        return new_plan

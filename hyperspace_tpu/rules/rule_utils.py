@@ -29,7 +29,9 @@ def is_index_applied(scan: Scan) -> bool:
 
 def get_candidate_indexes(session, entries: Sequence[IndexLogEntry],
                           scan: Scan) -> List[IndexLogEntry]:
-    """Filter ACTIVE entries down to those valid for ``scan``."""
+    """Filter ACTIVE entries down to COVERING indexes valid for ``scan``
+    (data-skipping entries have their own rule + validity model)."""
+    entries = [e for e in entries if e.is_covering]
     if is_index_applied(scan):
         return []
     if session.conf.hybrid_scan_enabled:

@@ -175,6 +175,11 @@ class HyperspaceSession:
                 e._tags.clear()
             plan = JoinIndexRule(self, entries).apply(plan)
             plan = FilterIndexRule(self, entries).apply(plan)
+            # Data skipping last: a covering rewrite beats file pruning, and
+            # the rule skips scans the other rules already rewrote.
+            from hyperspace_tpu.rules.data_skipping import DataSkippingFilterRule
+
+            plan = DataSkippingFilterRule(self, entries).apply(plan)
             return plan
         finally:
             self._lake_schema_memo = None

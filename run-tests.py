@@ -1,7 +1,7 @@
 #!/usr/bin/env python
-"""Test runner (the reference's root run-tests.py analog): runs the suite on
-the virtual 8-device CPU mesh the conftest configures, then the plan-
-stability suite in verification mode."""
+"""Test runner (the reference's root run-tests.py analog): runs the full
+suite — including the plan-stability golden-file tests — on the virtual
+8-device CPU mesh the conftest configures."""
 
 from __future__ import annotations
 

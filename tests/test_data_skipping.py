@@ -1,0 +1,275 @@
+"""DataSkippingIndex tests: per-file min/max sketches + file pruning.
+
+Capability beyond the reference snapshot (SURVEY.md §2.2 / ROADMAP.md:92-94);
+test idioms follow the §4 playbook: plan-shape assertions, answer
+equivalence vs the unindexed path, and file-mutation fixtures."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import (
+    DataSkippingIndexConfig,
+    Hyperspace,
+    HyperspaceSession,
+    IndexConfig,
+    col,
+)
+from hyperspace_tpu.exceptions import HyperspaceError
+
+
+def _write_partitioned(root, n_files=5, rows_per_file=100):
+    """Files with DISJOINT id ranges so min/max pruning is decisive."""
+    os.makedirs(root, exist_ok=True)
+    paths = []
+    for i in range(n_files):
+        start = i * rows_per_file
+        t = pa.table({
+            "id": np.arange(start, start + rows_per_file, dtype=np.int64),
+            "name": pa.array([f"n{j}" for j in range(start, start + rows_per_file)]),
+            "v": np.arange(start, start + rows_per_file, dtype=np.int64) * 2,
+        })
+        p = os.path.join(root, f"part-{i:05d}.parquet")
+        pq.write_table(t, p)
+        paths.append(p)
+    return paths
+
+
+@pytest.fixture()
+def session(tmp_index_root):
+    s = HyperspaceSession(system_path=tmp_index_root)
+    s.conf.num_buckets = 4
+    return s
+
+
+def _ds_scans(plan):
+    return [s for s in plan.leaf_relations() if s.relation.data_skipping_of]
+
+
+class TestBuild:
+    def test_create_writes_sketch_and_log(self, session, tmp_path):
+        root = str(tmp_path / "data")
+        _write_partitioned(root)
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(root),
+                        DataSkippingIndexConfig("ds1", ["id"]))
+        entry = session.index_collection_manager.get_index("ds1")
+        assert not entry.is_covering
+        assert entry.kind_abbr == "DS"
+        assert entry.derived_dataset.sketched_columns == ["id"]
+        assert entry.derived_dataset.sketch_types == ["MinMax"]
+        files = entry.content.file_infos()
+        assert len(files) == 1 and "sketch-" in files[0].name
+        sketch = pq.read_table(files[0].name)
+        assert sketch.num_rows == 5
+        assert set(sketch.column_names) >= {"_ds_file_name", "min__id", "max__id"}
+
+    def test_json_roundtrip(self, session, tmp_path):
+        root = str(tmp_path / "data")
+        _write_partitioned(root, n_files=2)
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(root),
+                        DataSkippingIndexConfig("ds1", ["id", "v"]))
+        # Reload through the log manager: kind dispatch must reconstruct DS.
+        entry = session.index_collection_manager.get_index("ds1")
+        assert entry.derived_dataset.sketched_columns == ["id", "v"]
+
+    def test_listed_alongside_covering(self, session, tmp_path):
+        root = str(tmp_path / "data")
+        _write_partitioned(root, n_files=2)
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(root),
+                        IndexConfig("ci1", ["id"], ["name"]))
+        hs.create_index(session.read.parquet(root),
+                        DataSkippingIndexConfig("ds1", ["id"]))
+        table = hs.indexes()
+        names = table.column("name").to_pylist()
+        assert sorted(names) == ["ci1", "ds1"]
+
+    def test_unresolvable_column_rejected(self, session, tmp_path):
+        root = str(tmp_path / "data")
+        _write_partitioned(root, n_files=1)
+        hs = Hyperspace(session)
+        with pytest.raises(HyperspaceError, match="sketched column"):
+            hs.create_index(session.read.parquet(root),
+                            DataSkippingIndexConfig("ds1", ["nope"]))
+
+    def test_optimize_rejected(self, session, tmp_path):
+        root = str(tmp_path / "data")
+        _write_partitioned(root, n_files=2)
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(root),
+                        DataSkippingIndexConfig("ds1", ["id"]))
+        with pytest.raises(HyperspaceError, match="covering"):
+            hs.optimize_index("ds1")
+
+
+class TestRule:
+    def _setup(self, session, tmp_path, **cfg):
+        root = str(tmp_path / "data")
+        _write_partitioned(root, **cfg)
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(root),
+                        DataSkippingIndexConfig("ds1", ["id"]))
+        session.enable_hyperspace()
+        return hs, root
+
+    def test_point_filter_prunes_to_one_file(self, session, tmp_path):
+        hs, root = self._setup(session, tmp_path)
+        ds = (session.read.parquet(root)
+              .filter(col("id") == 123).select("id", "name"))
+        plan = ds.optimized_plan()
+        scans = _ds_scans(plan)
+        assert scans, plan.tree_string()
+        assert scans[0].relation.data_skipping_stats == (1, 5)
+        got = ds.collect()
+        session.disable_hyperspace()
+        assert got.equals(ds.collect())
+        assert got.num_rows == 1
+
+    def test_range_filter_prunes(self, session, tmp_path):
+        hs, root = self._setup(session, tmp_path)
+        ds = (session.read.parquet(root)
+              .filter((col("id") >= 150) & (col("id") < 250))
+              .select("id", "v"))
+        plan = ds.optimized_plan()
+        scans = _ds_scans(plan)
+        assert scans and scans[0].relation.data_skipping_stats == (2, 5)
+        got = ds.collect()
+        session.disable_hyperspace()
+        assert got.sort_by("id").equals(ds.collect().sort_by("id"))
+        assert got.num_rows == 100
+
+    def test_isin_prunes(self, session, tmp_path):
+        hs, root = self._setup(session, tmp_path)
+        ds = (session.read.parquet(root)
+              .filter(col("id").isin([5, 450])).select("id"))
+        plan = ds.optimized_plan()
+        scans = _ds_scans(plan)
+        assert scans and scans[0].relation.data_skipping_stats == (2, 5)
+        assert ds.collect().num_rows == 2
+
+    def test_no_match_keeps_schema(self, session, tmp_path):
+        hs, root = self._setup(session, tmp_path)
+        ds = (session.read.parquet(root)
+              .filter(col("id") == 10_000).select("id", "name"))
+        got = ds.collect()
+        assert got.num_rows == 0
+        assert set(got.column_names) == {"id", "name"}
+
+    def test_unsketchable_predicate_no_pruning(self, session, tmp_path):
+        hs, root = self._setup(session, tmp_path)
+        ds = session.read.parquet(root).filter(col("name") == "n3").select("id")
+        plan = ds.optimized_plan()
+        assert not _ds_scans(plan)
+        assert ds.collect().num_rows == 1
+
+    def test_or_predicate_is_conservative(self, session, tmp_path):
+        hs, root = self._setup(session, tmp_path)
+        ds = (session.read.parquet(root)
+              .filter((col("id") == 1) | (col("id") == 499)).select("id"))
+        # OR contributes no constraint: no pruning, but answers stay right.
+        got = ds.collect()
+        assert got.num_rows == 2
+
+    def test_covering_index_wins_over_ds(self, session, tmp_path):
+        root = str(tmp_path / "data")
+        _write_partitioned(root)
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(root),
+                        IndexConfig("ci1", ["id"], ["name"]))
+        hs.create_index(session.read.parquet(root),
+                        DataSkippingIndexConfig("ds1", ["id"]))
+        session.enable_hyperspace()
+        plan = (session.read.parquet(root).filter(col("id") == 3)
+                .select("id", "name").optimized_plan())
+        covering = [s for s in plan.leaf_relations() if s.relation.index_scan_of]
+        assert covering and not _ds_scans(plan)
+
+    def test_explain_shows_ds_usage(self, session, tmp_path):
+        hs, root = self._setup(session, tmp_path)
+        out = hs.explain(session.read.parquet(root)
+                         .filter(col("id") == 1).select("id"))
+        assert "Type: DS, Name: ds1" in out
+        assert "ds1" in out.split("Indexes used:")[1]
+
+
+class TestMutation:
+    def test_appended_files_always_survive(self, session, tmp_path):
+        """Staleness safety: files the sketch never saw are scanned."""
+        root = str(tmp_path / "data")
+        _write_partitioned(root, n_files=3)
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(root),
+                        DataSkippingIndexConfig("ds1", ["id"]))
+        # Append a file whose ids overlap nothing sketched.
+        pq.write_table(pa.table({
+            "id": pa.array([10_000], type=pa.int64()),
+            "name": pa.array(["new"]),
+            "v": pa.array([0], type=pa.int64()),
+        }), os.path.join(root, "part-99999.parquet"))
+        session.enable_hyperspace()
+        ds = (session.read.parquet(root)
+              .filter(col("id") == 10_000).select("id", "name"))
+        got = ds.collect()
+        assert got.num_rows == 1  # pruning kept the unknown file
+
+    def test_refresh_incremental_updates_sketch(self, session, tmp_path):
+        root = str(tmp_path / "data")
+        paths = _write_partitioned(root, n_files=3)
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(root),
+                        DataSkippingIndexConfig("ds1", ["id"]))
+        os.remove(paths[0])
+        pq.write_table(pa.table({
+            "id": pa.array([900], type=pa.int64()),
+            "name": pa.array(["x"]),
+            "v": pa.array([1], type=pa.int64()),
+        }), os.path.join(root, "part-00009.parquet"))
+        hs.refresh_index("ds1", "incremental")
+        entry = session.index_collection_manager.get_index("ds1")
+        from hyperspace_tpu.actions.data_skipping import read_sketch
+
+        sketch = read_sketch(entry)
+        names = [os.path.basename(n)
+                 for n in sketch.column("_ds_file_name").to_pylist()]
+        assert "part-00000.parquet" not in names  # deleted row dropped
+        assert "part-00009.parquet" in names      # appended row sketched
+        assert sketch.num_rows == 3
+        # And the refreshed sketch prunes for the new file's range.
+        session.enable_hyperspace()
+        ds = (session.read.parquet(root)
+              .filter(col("id") == 900).select("id", "name"))
+        plan = ds.optimized_plan()
+        scans = _ds_scans(plan)
+        assert scans and scans[0].relation.data_skipping_stats == (1, 3)
+        assert ds.collect().num_rows == 1
+
+    def test_refresh_noop_when_unchanged(self, session, tmp_path):
+        root = str(tmp_path / "data")
+        _write_partitioned(root, n_files=2)
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(root),
+                        DataSkippingIndexConfig("ds1", ["id"]))
+        hs.refresh_index("ds1", "incremental")  # NoChanges: swallowed no-op
+        entry = session.index_collection_manager.get_index("ds1")
+        assert entry.state == "ACTIVE"
+
+    def test_lifecycle_delete_restore_vacuum(self, session, tmp_path):
+        root = str(tmp_path / "data")
+        _write_partitioned(root, n_files=2)
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(root),
+                        DataSkippingIndexConfig("ds1", ["id"]))
+        hs.delete_index("ds1")
+        hs.restore_index("ds1")
+        hs.delete_index("ds1")
+        hs.vacuum_index("ds1")
+        assert session.index_collection_manager.get_index("ds1") is None \
+            or session.index_collection_manager.get_index("ds1").state \
+            == "DOESNOTEXIST"
