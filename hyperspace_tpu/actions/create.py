@@ -102,7 +102,8 @@ class CreateActionBase(Action):
         schema = self._relation().schema()
         indexed = resolve_or_raise(self.config.indexed_columns, schema, "indexed column")
         included = resolve_or_raise(self.config.included_columns, schema, "included column")
-        return IndexConfig(self.config.index_name, indexed, included)
+        return IndexConfig(self.config.index_name, indexed, included,
+                           layout=getattr(self.config, "layout", "lexicographic"))
 
     # -- the build (CreateActionBase.write:124-142, TPU-style) --------------
     def _build_index_data(self, file_names: Optional[List[str]] = None) -> None:
@@ -156,7 +157,8 @@ class CreateActionBase(Action):
             buckets, perm = distributed_bucket_sort_permutation(
                 table, resolved.indexed_columns, self.num_buckets,
                 build_mesh(), slack=self.conf.shuffle_capacity_slack,
-                pad_to=self.conf.device_batch_rows)
+                pad_to=self.conf.device_batch_rows,
+                zorder=resolved.layout == "zorder")
         else:
             from hyperspace_tpu.ops.sort import bucket_sort_permutation
 
@@ -168,14 +170,38 @@ class CreateActionBase(Action):
                 [np.asarray(w) for w in word_cols],
                 [np.asarray(k) for k in order_words],
                 self.num_buckets,
-                pad_to=self.conf.device_batch_rows)
+                pad_to=self.conf.device_batch_rows,
+                zorder=resolved.layout == "zorder")
         version = self.data_manager.get_next_version() if version is None else version
         out_dir = self.data_manager.version_path(version)
         write_bucketed(table, np.asarray(buckets), np.asarray(perm),
-                       self.num_buckets, out_dir)
+                       self.num_buckets, out_dir,
+                       max_rows_per_file=self.conf.index_max_rows_per_file)
+        self._write_index_file_sketch(out_dir, resolved)
         self._written_version = version
         self._index_schema = {name: str(t) for name, t in
                               zip(table.column_names, table.schema.types)}
+
+    def _write_index_file_sketch(self, out_dir: str,
+                                 resolved: IndexConfig) -> None:
+        """Per-index-file min/max over the indexed columns, written as
+        ``_sketch.parquet`` next to the bucket files (underscore-prefixed =
+        excluded from data-file listings).  Read from footers — O(footer).
+        FilterIndexRule uses it to prune index FILES for range predicates;
+        with the Z-order layout every indexed dimension's ranges are narrow
+        so the pruning bites on all of them."""
+        import pyarrow.parquet as pq
+
+        from hyperspace_tpu.actions.data_skipping import sketch_rows_for_files
+        from hyperspace_tpu.io.files import list_data_files
+
+        files = list_data_files([out_dir], extension=".parquet")
+        if not files:
+            return
+        rows = sketch_rows_for_files(files, resolved.indexed_columns,
+                                     "parquet", {})
+        pq.write_table(pa.Table.from_pylist(rows),
+                       os.path.join(out_dir, "_sketch.parquet"))
 
     # -- log entry (CreateActionBase.getIndexLogEntry:56-105) ---------------
     def _signature(self) -> Signature:
@@ -213,6 +239,7 @@ class CreateActionBase(Action):
                 included_columns=resolved.included_columns,
                 num_buckets=self.num_buckets,
                 schema=getattr(self, "_index_schema", {}),
+                properties={"layout": resolved.layout},
             ),
             content=content,
             source=Source(relations=[rel_meta],

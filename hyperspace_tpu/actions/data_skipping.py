@@ -212,7 +212,13 @@ class CreateDataSkippingAction(CreateActionBase):
     def log_entry(self) -> IndexLogEntry:
         relation = self._relation()
         rel_meta = relation.create_relation_metadata(self._file_id_tracker)
-        properties: Dict[str, str] = {"lineage": "false"}
+        # Refresh carries the previous entry's properties forward so
+        # provider-accumulated state (e.g. the deltaVersions history)
+        # survives — same contract as the covering _build_log_entry.
+        prev = getattr(self, "_previous_entry", None)
+        properties: Dict[str, str] = dict(prev.properties) \
+            if prev is not None else {}
+        properties["lineage"] = "false"
         properties["indexLogVersion"] = str(self.base_id + 2)
         properties = self.session.source_provider_manager.enrich_index_properties(
             rel_meta, properties)

@@ -66,7 +66,12 @@ class RefreshActionBase(CreateActionBase):
             file_format=rel_meta.file_format,
             options=tuple(sorted(rel_meta.options.items())),
         ))
-        config = IndexConfig(prev.name, prev.indexed_columns, prev.included_columns)
+        # Layout pinned like numBuckets/lineage below: a refresh must not
+        # silently rebuild a Z-ordered index lexicographic.
+        config = IndexConfig(
+            prev.name, prev.indexed_columns, prev.included_columns,
+            layout=prev.derived_dataset.properties.get("layout",
+                                                       "lexicographic"))
         super().__init__(log_manager, data_manager, session, plan, config)
         self._previous_entry = prev
         # Seed the tracker with previous ids so unchanged files keep theirs

@@ -23,6 +23,7 @@ HYBRID_SCAN_ENABLED = "hyperspace.index.hybridscan.enabled"
 HYBRID_SCAN_APPENDED_RATIO = "hyperspace.index.hybridscan.maxAppendedRatio"
 HYBRID_SCAN_DELETED_RATIO = "hyperspace.index.hybridscan.maxDeletedRatio"
 OPTIMIZE_FILE_SIZE_THRESHOLD = "hyperspace.index.optimize.fileSizeThreshold"
+INDEX_MAX_ROWS_PER_FILE = "hyperspace.index.maxRowsPerFile"
 FILTER_RULE_USE_BUCKET_SPEC = "hyperspace.index.filterRule.useBucketSpec"
 CACHE_EXPIRY_SECONDS = "hyperspace.index.cache.expiryDurationInSeconds"
 SOURCE_PROVIDERS = "hyperspace.index.sources.fileBasedBuilders"
@@ -62,6 +63,10 @@ class HyperspaceConf:
     hybrid_scan_max_appended_ratio: float = 0.3
     hybrid_scan_max_deleted_ratio: float = 0.2
     optimize_file_size_threshold: int = 256 * 1024 * 1024
+    # Split each bucket's sorted run into files of at most this many rows
+    # (0 = one file per bucket).  Smaller files = finer per-file min/max
+    # pruning granularity (and bounded Parquet sizes at scale).
+    index_max_rows_per_file: int = 0
     filter_rule_use_bucket_spec: bool = False
     cache_expiry_seconds: int = 300
     source_providers: str = "default,delta,iceberg"
@@ -114,6 +119,7 @@ class HyperspaceConf:
         HYBRID_SCAN_APPENDED_RATIO: "hybrid_scan_max_appended_ratio",
         HYBRID_SCAN_DELETED_RATIO: "hybrid_scan_max_deleted_ratio",
         OPTIMIZE_FILE_SIZE_THRESHOLD: "optimize_file_size_threshold",
+        INDEX_MAX_ROWS_PER_FILE: "index_max_rows_per_file",
         FILTER_RULE_USE_BUCKET_SPEC: "filter_rule_use_bucket_spec",
         CACHE_EXPIRY_SECONDS: "cache_expiry_seconds",
         SOURCE_PROVIDERS: "source_providers",

@@ -13,17 +13,27 @@ from typing import List, Sequence
 from hyperspace_tpu.exceptions import HyperspaceError
 
 
+LAYOUTS = ("lexicographic", "zorder")
+
+
 @dataclasses.dataclass(frozen=True)
 class IndexConfig:
     index_name: str
     indexed_columns: List[str]
     included_columns: List[str] = dataclasses.field(default_factory=list)
+    # Row order within buckets: "lexicographic" (the reference's layout) or
+    # "zorder" — Morton-interleaved indexed columns, clustering EVERY
+    # indexed dimension so per-file min/max pruning works for range queries
+    # on any of them (ops/zorder.py; beyond reference parity).
+    layout: str = "lexicographic"
 
     def __init__(self, index_name: str, indexed_columns: Sequence[str],
-                 included_columns: Sequence[str] = ()) -> None:
+                 included_columns: Sequence[str] = (),
+                 layout: str = "lexicographic") -> None:
         object.__setattr__(self, "index_name", index_name)
         object.__setattr__(self, "indexed_columns", list(indexed_columns))
         object.__setattr__(self, "included_columns", list(included_columns))
+        object.__setattr__(self, "layout", layout)
         self._validate()
 
     def _validate(self) -> None:
@@ -32,6 +42,11 @@ class IndexConfig:
             raise HyperspaceError("Index name cannot be empty")
         if not self.indexed_columns:
             raise HyperspaceError("Indexed columns cannot be empty")
+        if self.layout not in LAYOUTS:
+            raise HyperspaceError(
+                f"Unknown layout {self.layout!r}; expected one of {LAYOUTS}")
+        if self.layout == "zorder" and len(self.indexed_columns) > 4:
+            raise HyperspaceError("Z-order supports at most 4 indexed columns")
         lowered_indexed = [c.lower() for c in self.indexed_columns]
         lowered_included = [c.lower() for c in self.included_columns]
         if len(set(lowered_indexed)) != len(lowered_indexed):

@@ -112,13 +112,18 @@ def _dtype_from_string(t: str) -> pa.DataType:
 
 
 def write_bucketed(table: pa.Table, bucket_ids: np.ndarray, sort_perm: np.ndarray,
-                   num_buckets: int, out_dir: str) -> List[str]:
-    """Write ``table`` as one sorted Parquet file per non-empty bucket.
+                   num_buckets: int, out_dir: str,
+                   max_rows_per_file: int = 0) -> List[str]:
+    """Write ``table`` as sorted Parquet files, one or more per non-empty
+    bucket.
 
     ``sort_perm`` is a permutation ordering rows by (bucket, sort columns) —
     computed on device by the build kernel; ``bucket_ids`` are per-row bucket
     assignments (pre-permutation).  Empty buckets get no file, matching
-    Spark's bucketed write behavior.
+    Spark's bucketed write behavior.  ``max_rows_per_file`` > 0 splits each
+    bucket's sorted run into chunks — consecutive key (or Z-code) ranges per
+    file, which is what gives the per-file min/max sketch its pruning
+    granularity within a bucket.
     """
     os.makedirs(out_dir, exist_ok=True)
     sorted_buckets = np.asarray(bucket_ids)[sort_perm]
@@ -131,7 +136,11 @@ def write_bucketed(table: pa.Table, bucket_ids: np.ndarray, sort_perm: np.ndarra
         n = int(ends[b] - starts[b])
         if n == 0:
             continue
-        path = os.path.join(out_dir, bucket_file_name(b))
-        pq.write_table(sorted_table.slice(int(starts[b]), n), path)
-        out_paths.append(path)
+        chunk = max_rows_per_file if max_rows_per_file > 0 else n
+        for off in range(0, n, chunk):
+            path = os.path.join(out_dir, bucket_file_name(b))
+            pq.write_table(
+                sorted_table.slice(int(starts[b]) + off, min(chunk, n - off)),
+                path)
+            out_paths.append(path)
     return out_paths

@@ -25,13 +25,14 @@ import jax.numpy as jnp
 from hyperspace_tpu.ops.hash import _bucket_ids_impl, use_pallas
 
 
-@partial(jax.jit, static_argnames=("num_buckets", "pallas"))
+@partial(jax.jit, static_argnames=("num_buckets", "pallas", "zorder"))
 def _bucket_sort_impl(
     word_cols,
     order_words,
     n_valid,
     num_buckets: int,
     pallas: bool,
+    zorder: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     # One bucket-assignment implementation for build and query paths —
     # duplicating it risks the two silently diverging, which corrupts the
@@ -45,11 +46,19 @@ def _bucket_sort_impl(
     buckets = jnp.where(jnp.arange(n) < n_valid, buckets,
                         jnp.int32(num_buckets))
     # jnp.lexsort: LAST key is the primary.  Order: bucket first, then key
-    # columns in config order, each (hi, lo) word pair hi-major.
+    # columns in config order, each (hi, lo) word pair hi-major — or the
+    # Morton code when the layout is Z-order (ops/zorder.py).
     keys = []
-    for w in reversed(order_words):
-        keys.append(w[:, 1])
-        keys.append(w[:, 0])
+    if zorder:
+        from hyperspace_tpu.ops.zorder import zorder_words
+
+        z_hi, z_lo = zorder_words(order_words, n_valid)
+        keys.append(z_lo)
+        keys.append(z_hi)
+    else:
+        for w in reversed(order_words):
+            keys.append(w[:, 1])
+            keys.append(w[:, 0])
     keys.append(buckets)
     perm = jnp.lexsort(tuple(keys)).astype(jnp.int32)
     return buckets, perm
@@ -70,6 +79,7 @@ def bucket_sort_permutation(
     order_words: Sequence[jnp.ndarray],
     num_buckets: int,
     pad_to: int = 0,
+    zorder: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Fused hash + sort kernel.
 
@@ -99,7 +109,8 @@ def bucket_sort_permutation(
         word_cols = [_pad_rows(w, capacity) for w in word_cols]
         order_words = [_pad_rows(w, capacity) for w in order_words]
     buckets, perm = _bucket_sort_impl(
-        tuple(word_cols), tuple(order_words), n, num_buckets, use_pallas())
+        tuple(word_cols), tuple(order_words), n, num_buckets, use_pallas(),
+        zorder)
     if buckets.shape[0] != n:
         buckets = buckets[:n]
         perm = perm[:n]

@@ -108,6 +108,9 @@ class Scan(LogicalPlan):
             tag = f"Hyperspace(Type: CI, Name: {rel.index_scan_of})"
             if rel.prune_to_buckets is not None:
                 tag += f" [buckets: {len(rel.prune_to_buckets)}/{rel.bucket_spec[0]}]"
+            if rel.data_skipping_stats is not None:
+                kept, total = rel.data_skipping_stats
+                tag += f" [files: {kept}/{total}]"
             return f"Scan {tag}"
         base = f"Scan {','.join(rel.root_paths)} ({rel.file_format})"
         if rel.data_skipping_of:

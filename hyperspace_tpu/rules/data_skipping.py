@@ -104,14 +104,17 @@ def extract_constraints(condition: Expr,
 
     _MIRROR = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "==": "=="}
     for conj in split_conjuncts(condition):
-        if isinstance(conj, BinOp):
+        # The op check comes BEFORE constraint_for: an unsupported operator
+        # must not setdefault an empty constraint (it would defeat callers'
+        # "no constraints -> skip all sketch IO" fast path).
+        if isinstance(conj, BinOp) and conj.op in _MIRROR:
             if isinstance(conj.left, Col) and isinstance(conj.right, Lit):
                 c = constraint_for(conj.left.name)
-                if c is not None and conj.op in _MIRROR:
+                if c is not None:
                     c.add_cmp(conj.op, conj.right.value)
             elif isinstance(conj.right, Col) and isinstance(conj.left, Lit):
                 c = constraint_for(conj.right.name)
-                if c is not None and conj.op in _MIRROR:
+                if c is not None:
                     c.add_cmp(_MIRROR[conj.op], conj.left.value)
         elif isinstance(conj, IsIn) and isinstance(conj.child, Col):
             c = constraint_for(conj.child.name)
@@ -158,14 +161,21 @@ class DataSkippingFilterRule:
         if not ds_entries:
             return plan
 
-        relation = spm.get_relation(scan)
-        current = relation.all_files()
-        best: Optional[Tuple[IndexLogEntry, List[str]]] = None
+        # Cheap predicate check FIRST: the file listing (a full directory
+        # walk + stat) only happens when some entry can actually constrain.
+        with_constraints = []
         for entry in ds_entries:
             constraints = extract_constraints(
                 filter_node.condition, entry.derived_dataset.sketched_columns)
-            if not constraints:
-                continue
+            if constraints:
+                with_constraints.append((entry, constraints))
+        if not with_constraints:
+            return plan
+
+        relation = spm.get_relation(scan)
+        current = relation.all_files()
+        best: Optional[Tuple[IndexLogEntry, List[str]]] = None
+        for entry, constraints in with_constraints:
             sketch_by_key = {
                 (r[SKETCH_FILE_NAME], r[SKETCH_FILE_SIZE],
                  r[SKETCH_FILE_MTIME]): r
@@ -212,3 +222,71 @@ class DataSkippingFilterRule:
             plan_after=new_plan.tree_string(),
             message="DataSkippingFilterRule applied"))
         return new_plan
+
+
+# ---------------------------------------------------------------------------
+# Index-file pruning for covering indexes (the Z-order payoff)
+# ---------------------------------------------------------------------------
+_INDEX_SKETCH_CACHE: Dict[Tuple, List[dict]] = {}
+
+
+def _load_index_sketch(path: str) -> List[dict]:
+    import os
+
+    import pyarrow.parquet as pq
+
+    st = os.stat(path)
+    key = (path, st.st_size, st.st_mtime_ns)
+    rows = _INDEX_SKETCH_CACHE.get(key)
+    if rows is None:
+        rows = pq.read_table(path).to_pylist()
+        if len(_INDEX_SKETCH_CACHE) >= _SKETCH_CACHE_MAX:
+            _INDEX_SKETCH_CACHE.clear()
+        _INDEX_SKETCH_CACHE[key] = rows
+    return rows
+
+
+def prune_index_files_by_sketch(entry: IndexLogEntry, condition: Expr
+                                ) -> Optional[Tuple[List[str], int]]:
+    """For a covering index, drop index FILES whose per-file min/max (the
+    ``_sketch.parquet`` each build version writes) provably excludes the
+    predicate.  Returns (surviving file paths, total) or None when nothing
+    prunes (no constraints, no sketches, or everything survives).  Versions
+    without a sketch keep all their files — always conservative."""
+    import os
+
+    if not entry.is_covering:
+        return None
+    constraints = extract_constraints(condition, entry.indexed_columns)
+    if not constraints:
+        return None
+    files = [f.name for f in entry.content.file_infos()]
+    by_dir: Dict[str, List[str]] = {}
+    for f in files:
+        by_dir.setdefault(os.path.dirname(f), []).append(f)
+    surviving: List[str] = []
+    any_sketch = False
+    for d, fs in by_dir.items():
+        sketch_path = os.path.join(d, "_sketch.parquet")
+        if not os.path.isfile(sketch_path):
+            surviving.extend(fs)
+            continue
+        any_sketch = True
+        by_name = {r[SKETCH_FILE_NAME]: r
+                   for r in _load_index_sketch(sketch_path)}
+        for f in fs:
+            row = by_name.get(f)
+            if row is None:
+                surviving.append(f)
+                continue
+            ok = all(
+                c.file_may_match(row.get(_min_col(col)),
+                                 row.get(_max_col(col)))
+                for col, c in constraints.items())
+            if ok:
+                surviving.append(f)
+    if not any_sketch or len(surviving) >= len(files):
+        return None
+    if not surviving:
+        surviving = [files[0]]  # keep schema; filter yields zero rows
+    return surviving, len(files)

@@ -76,8 +76,17 @@ class FilterIndexRule:
             prune = _bucket_pruning(filter_node.condition, best)
             use_bucket_spec = (self.session.conf.filter_rule_use_bucket_spec
                                or prune is not None)
+            # Per-index-file min/max pruning (_sketch.parquet written at
+            # build): bites on range predicates — on every indexed dimension
+            # when the layout is Z-order (ops/zorder.py).
+            from hyperspace_tpu.rules.data_skipping import prune_index_files_by_sketch
+
+            pruned = prune_index_files_by_sketch(best, filter_node.condition)
+            file_paths, file_stats = (None, None) if pruned is None \
+                else (pruned[0], (len(pruned[0]), pruned[1]))
             new_plan = rule_utils.transform_plan_to_use_index_only_scan(
-                plan, scan, best, use_bucket_spec, prune)
+                plan, scan, best, use_bucket_spec, prune, file_paths,
+                file_stats)
         get_event_logger().log_event(HyperspaceIndexUsageEvent(
             index_names=[best.name],
             plan_before=plan.tree_string(),
@@ -125,11 +134,20 @@ def _find_covering_indexes(candidates: Sequence[IndexLogEntry],
                            filter_cols: List[str],
                            output_cols: List[str]) -> List[IndexLogEntry]:
     """FilterIndexRule.scala:99-155: first indexed column in the predicate;
-    index covers filter+output columns (case-insensitive)."""
+    index covers filter+output columns (case-insensitive).
+
+    Z-order-layout indexes relax the first-column rule to ANY indexed
+    column: the Morton clustering makes per-file pruning effective on every
+    indexed dimension, which is the point of that layout (lexicographic
+    data only clusters the first column, hence the reference's rule)."""
     out = []
     for entry in candidates:
-        first_indexed = entry.indexed_columns[0].lower()
-        if first_indexed not in {c.lower() for c in filter_cols}:
+        filter_set = {c.lower() for c in filter_cols}
+        indexed_lower = [c.lower() for c in entry.indexed_columns]
+        if entry.derived_dataset.properties.get("layout") == "zorder":
+            if not filter_set & set(indexed_lower):
+                continue
+        elif indexed_lower[0] not in filter_set:
             continue
         index_cols = {c.lower() for c in entry.derived_dataset.all_columns}
         needed = {c.lower() for c in filter_cols} | {c.lower() for c in output_cols}

@@ -70,9 +70,11 @@ def get_candidate_indexes(session, entries: Sequence[IndexLogEntry],
 def index_scan_relation(entry: IndexLogEntry,
                         use_bucket_spec: bool,
                         prune_to_buckets: Optional[Tuple[int, ...]] = None,
-                        file_paths: Optional[Sequence[str]] = None) -> ScanRelation:
+                        file_paths: Optional[Sequence[str]] = None,
+                        file_stats: Optional[Tuple[int, int]] = None) -> ScanRelation:
     """The ScanRelation for reading an index's bucketed Parquet data
-    (RuleUtils.scala:255-286; display marker IndexHadoopFsRelation.scala:29-50)."""
+    (RuleUtils.scala:255-286; display marker IndexHadoopFsRelation.scala:29-50).
+    ``file_paths``/``file_stats`` carry a sketch-pruned file subset."""
     files = list(file_paths) if file_paths is not None \
         else [f.name for f in entry.content.file_infos()]
     root = os.path.dirname(files[0]) if files else ""
@@ -84,16 +86,20 @@ def index_scan_relation(entry: IndexLogEntry,
         bucket_spec=(entry.num_buckets, cols, cols) if use_bucket_spec else None,
         file_paths=tuple(files),
         prune_to_buckets=prune_to_buckets,
+        data_skipping_stats=file_stats,
     )
 
 
 def transform_plan_to_use_index_only_scan(
         plan: LogicalPlan, target: Scan, entry: IndexLogEntry,
         use_bucket_spec: bool,
-        prune_to_buckets: Optional[Tuple[int, ...]] = None) -> LogicalPlan:
+        prune_to_buckets: Optional[Tuple[int, ...]] = None,
+        file_paths: Optional[Sequence[str]] = None,
+        file_stats: Optional[Tuple[int, int]] = None) -> LogicalPlan:
     """Swap ``target`` for an index-only scan throughout ``plan``."""
     new_node: LogicalPlan = Scan(
-        index_scan_relation(entry, use_bucket_spec, prune_to_buckets))
+        index_scan_relation(entry, use_bucket_spec, prune_to_buckets,
+                            file_paths, file_stats))
     if entry.has_lineage_column():
         # The stored lineage column is an implementation detail: project it
         # away so enabling hyperspace never changes a query's output schema.

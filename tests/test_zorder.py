@@ -1,0 +1,206 @@
+"""Z-order layout tests: Morton clustering + per-index-file pruning.
+
+The payoff under test: with ``layout="zorder"`` a multi-column covering
+index keeps EVERY indexed dimension's per-file value range narrow, so range
+predicates on the second (or any) indexed column prune index files — with
+the lexicographic layout only the first column clusters.  Capability beyond
+the reference snapshot (BASELINE.json's Z-order config)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
+from hyperspace_tpu.exceptions import HyperspaceError
+
+
+@pytest.fixture()
+def session(tmp_index_root):
+    s = HyperspaceSession(system_path=tmp_index_root)
+    s.conf.num_buckets = 1  # one bucket => file pruning is the only lever
+    return s
+
+
+def _grid_data(tmp_path, n=4096):
+    """Two independent uniform dimensions — the classic Z-order workload."""
+    rng = np.random.default_rng(0)
+    t = pa.table({
+        "x": pa.array(rng.integers(0, 1 << 16, n), type=pa.int64()),
+        "y": pa.array(rng.integers(0, 1 << 16, n), type=pa.int64()),
+        "payload": pa.array(rng.random(n)),
+    })
+    root = tmp_path / "data"
+    root.mkdir()
+    pq.write_table(t, str(root / "part-0.parquet"))
+    return str(root)
+
+
+class TestKernel:
+    def test_interleave_parity_with_host_reference(self):
+        import jax.numpy as jnp
+
+        from hyperspace_tpu.ops.zorder import interleave16_np, zorder_words
+
+        rng = np.random.default_rng(1)
+        n = 512
+        # Monotone words whose hi word IS the value (lo zero): ranks follow
+        # the values, so we can compute expected codes host-side.
+        cols = []
+        for _ in range(3):
+            v = rng.permutation(n).astype(np.uint32)
+            w = np.zeros((n, 2), np.uint32)
+            w[:, 0] = v
+            cols.append(w)
+        hi, lo = zorder_words([jnp.asarray(c) for c in cols], n)
+        # Expected: rank of each value is the value itself (a permutation of
+        # 0..n-1), scaled to 16 bits, then interleaved.
+        codes = [np.clip(c[:, 0].astype(np.float32) * (65535.0 / (n - 1)),
+                         0, 65535).astype(np.uint32) for c in cols]
+        ehi, elo = interleave16_np(codes)
+        assert np.array_equal(np.asarray(hi), ehi)
+        assert np.array_equal(np.asarray(lo), elo)
+
+    def test_too_many_columns_rejected(self):
+        with pytest.raises(HyperspaceError, match="at most 4"):
+            IndexConfig("z", ["a", "b", "c", "d", "e"], layout="zorder")
+        with pytest.raises(HyperspaceError, match="layout"):
+            IndexConfig("z", ["a"], layout="diagonal")
+
+
+class TestZorderIndex:
+    def _count_files_read(self, session, root, predicate, select):
+        plan = (session.read.parquet(root).filter(predicate)
+                .select(*select).optimized_plan())
+        scans = [s for s in plan.leaf_relations() if s.relation.index_scan_of]
+        assert scans, plan.tree_string()
+        stats = scans[0].relation.data_skipping_stats
+        return stats if stats is not None else (None, None)
+
+    def test_zorder_prunes_on_every_dimension(self, session, tmp_path):
+        """The Z-order claim, quantified: with 16 files along the Z-curve, a
+        1/8-of-space range on EITHER dimension must prune index files; the
+        lexicographic layout clusters only the first column, so its y-range
+        query reads every file."""
+        root = _grid_data(tmp_path)
+        session.conf.index_max_rows_per_file = 256  # 4096 rows -> 16 files
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(root),
+                        IndexConfig("zi", ["x", "y"], ["payload"],
+                                    layout="zorder"))
+        hs.create_index(session.read.parquet(root),
+                        IndexConfig("li", ["x", "y"], ["payload"]))
+        session.enable_hyperspace()
+        lo, hi = 1000, 9000  # 1/8 of the 16-bit space
+
+        def files_read(index_name, dim):
+            ds = (session.read.parquet(root)
+                  .filter((col(dim) >= lo) & (col(dim) < hi))
+                  .select("x", "y", "payload"))
+            plan = ds.optimized_plan()
+            scans = [s for s in plan.leaf_relations()
+                     if s.relation.index_scan_of == index_name]
+            assert scans, plan.tree_string()
+            stats = scans[0].relation.data_skipping_stats
+            kept = stats[0] if stats else len(scans[0].relation.file_paths)
+            # Answer parity regardless of layout.
+            got = ds.collect()
+            session.disable_hyperspace()
+            expected = ds.collect()
+            session.enable_hyperspace()
+            keys = [("x", "ascending"), ("y", "ascending"),
+                    ("payload", "ascending")]
+            assert got.sort_by(keys).equals(expected.sort_by(keys))
+            return kept
+
+        # Only one index can win per query; delete the other to isolate.
+        hs.delete_index("li")
+        z_x = files_read("zi", "x")
+        z_y = files_read("zi", "y")
+        hs.restore_index("li")
+        hs.delete_index("zi")
+        # The lexicographic index cannot even APPLY to a y-only predicate
+        # (first-indexed-column rule, FilterIndexRule.scala:144-155) — the
+        # relaxation is zorder-layout-only.
+        ds = (session.read.parquet(root)
+              .filter((col("y") >= lo) & (col("y") < hi)).select("x", "y"))
+        plan = ds.optimized_plan()
+        assert not [s for s in plan.leaf_relations()
+                    if s.relation.index_scan_of], plan.tree_string()
+        hs.restore_index("zi")
+        # Z-order prunes on BOTH dimensions.
+        assert z_x < 16 and z_y < 16, (z_x, z_y)
+        assert max(z_x, z_y) <= 8, (z_x, z_y)
+
+    def test_zorder_layout_recorded(self, session, tmp_path):
+        root = _grid_data(tmp_path)
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(root),
+                        IndexConfig("zi", ["x", "y"], layout="zorder"))
+        entry = session.index_collection_manager.get_index("zi")
+        assert entry.derived_dataset.properties["layout"] == "zorder"
+
+    def test_lexicographic_unchanged_by_default(self, session, tmp_path):
+        root = _grid_data(tmp_path)
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(root), IndexConfig("li", ["x"]))
+        entry = session.index_collection_manager.get_index("li")
+        assert entry.derived_dataset.properties.get("layout") == "lexicographic"
+
+
+class TestIndexFileSketchPruning:
+    def test_range_on_first_column_prunes_index_files(self, session, tmp_path):
+        """Even lexicographic indexes gain file pruning on the first
+        indexed column from the build-time _sketch.parquet."""
+        rng = np.random.default_rng(2)
+        n = 2000
+        root = tmp_path / "data"
+        root.mkdir()
+        pq.write_table(pa.table({
+            "k": pa.array(np.arange(n, dtype=np.int64)),
+            "v": pa.array(rng.random(n)),
+        }), str(root / "p.parquet"))
+        session.conf.num_buckets = 8
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(str(root)),
+                        IndexConfig("ki", ["k"], ["v"]))
+        session.enable_hyperspace()
+        ds = (session.read.parquet(str(root))
+              .filter(col("k") == 77).select("k", "v"))
+        plan = ds.optimized_plan()
+        scans = [s for s in plan.leaf_relations() if s.relation.index_scan_of]
+        assert scans
+        # Bucket pruning picked 1/8 buckets; the file sketch may prune too —
+        # either way the answer is exact.
+        assert ds.collect().num_rows == 1
+
+
+class TestZorderRefresh:
+    def test_refresh_keeps_zorder_layout(self, session, tmp_path):
+        """Refresh must not silently rebuild a Z-ordered index
+        lexicographic (layout pinned like numBuckets/lineage)."""
+        root = _grid_data(tmp_path)
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(root),
+                        IndexConfig("zi", ["x", "y"], ["payload"],
+                                    layout="zorder"))
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        pq.write_table(pa.table({
+            "x": pa.array([1], type=pa.int64()),
+            "y": pa.array([2], type=pa.int64()),
+            "payload": pa.array([0.5]),
+        }), root + "/part-append.parquet")
+        hs.refresh_index("zi", "full")
+        entry = session.index_collection_manager.get_index("zi")
+        assert entry.derived_dataset.properties["layout"] == "zorder"
+        # A y-only predicate still matches (the zorder relaxation keys off
+        # that property).
+        session.enable_hyperspace()
+        plan = (session.read.parquet(root)
+                .filter(col("y") >= 0).select("x", "y").optimized_plan())
+        assert [s for s in plan.leaf_relations() if s.relation.index_scan_of], \
+            plan.tree_string()
