@@ -19,8 +19,12 @@ INDEX_SUMMARY_COLUMNS = [
     "indexLocation", "state",
 ]
 
+# Extended field set mirrors IndexStatistics.scala:43-61.
 EXTENDED_COLUMNS = INDEX_SUMMARY_COLUMNS + [
-    "numIndexFiles", "sizeInBytes", "numAppendedFiles", "numDeletedFiles",
+    "kind", "hasLineage", "numIndexFiles", "sizeIndexFiles",
+    "numSourceFiles", "sizeSourceFiles", "numAppendedFiles",
+    "sizeAppendedFiles", "numDeletedFiles", "sizeDeletedFiles",
+    "indexContentPaths",
 ]
 
 
@@ -38,8 +42,19 @@ def index_statistics_table(entries: List[IndexLogEntry],
         rows["indexLocation"].append(location)
         rows["state"].append(e.state)
         if extended:
+            source_files = e.source_file_infos()
+            appended = e.appended_files()
+            deleted = e.deleted_files()
+            rows["kind"].append(e.derived_dataset.KIND)
+            rows["hasLineage"].append(e.has_lineage_column())
             rows["numIndexFiles"].append(len(index_files))
-            rows["sizeInBytes"].append(sum(f.size for f in index_files))
-            rows["numAppendedFiles"].append(len(e.appended_files()))
-            rows["numDeletedFiles"].append(len(e.deleted_files()))
+            rows["sizeIndexFiles"].append(sum(f.size for f in index_files))
+            rows["numSourceFiles"].append(len(source_files))
+            rows["sizeSourceFiles"].append(sum(f.size for f in source_files))
+            rows["numAppendedFiles"].append(len(appended))
+            rows["sizeAppendedFiles"].append(sum(f.size for f in appended))
+            rows["numDeletedFiles"].append(len(deleted))
+            rows["sizeDeletedFiles"].append(sum(f.size for f in deleted))
+            rows["indexContentPaths"].append(
+                sorted({os.path.dirname(f.name) for f in index_files}))
     return pa.table(rows)

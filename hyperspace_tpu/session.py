@@ -71,6 +71,12 @@ class HyperspaceSession:
         if system_path is not None:
             self.conf.system_path = system_path
         self._hyperspace_enabled = False
+        if self.conf.event_logger:
+            # The reflective eventLoggerClass conf
+            # (HyperspaceEventLogging.scala:42-64).
+            from hyperspace_tpu.telemetry.events import apply_conf_event_logger
+
+            apply_conf_event_logger(self.conf.event_logger)
         self._schema_cache: Dict[object, Dict[str, str]] = {}
         # Lake-schema memo, live only inside one optimize() pass: a query
         # sees one snapshot, so memoizing there is safe; across queries it

@@ -104,6 +104,7 @@ class CollectingEventLogger(EventLogger):
 
 
 _logger: EventLogger = NoOpEventLogger()
+_logger_explicit = False  # set_event_logger was called (even with None/NoOp)
 
 
 def get_event_logger() -> EventLogger:
@@ -111,5 +112,55 @@ def get_event_logger() -> EventLogger:
 
 
 def set_event_logger(logger: Optional[EventLogger]) -> None:
+    """Install a logger programmatically — this wins over the conf key;
+    passing ``NoOpEventLogger()`` is an explicit opt-out.  ``None`` resets
+    to the default state (conf resolution applies again)."""
+    global _logger, _logger_explicit
+    if logger is None:
+        _logger = NoOpEventLogger()
+        _logger_explicit = False
+    else:
+        _logger = logger
+        _logger_explicit = True
+
+
+# Named registry + dotted-path loading (the reflective
+# spark.hyperspace.eventLoggerClass conf, HyperspaceEventLogging.scala:42-64).
+LOGGER_REGISTRY: Dict[str, type] = {
+    "": NoOpEventLogger,
+    "NoOpEventLogger": NoOpEventLogger,
+    "CollectingEventLogger": CollectingEventLogger,
+}
+
+
+def resolve_event_logger(name: str) -> EventLogger:
+    """Instantiate a logger by registered name or ``module:Class`` /
+    ``module.Class`` dotted path.  Raises ValueError (with context) for
+    anything that does not resolve to an EventLogger subclass."""
+    cls = LOGGER_REGISTRY.get(name)
+    if cls is None:
+        import importlib
+
+        module_name, _, cls_name = name.replace(":", ".").rpartition(".")
+        if not module_name:
+            raise ValueError(f"Unknown event logger: {name!r}")
+        try:
+            cls = getattr(importlib.import_module(module_name), cls_name)
+        except (ImportError, AttributeError) as e:
+            raise ValueError(f"Unknown event logger: {name!r} ({e})") from e
+        if not (isinstance(cls, type) and issubclass(cls, EventLogger)):
+            raise ValueError(
+                f"{name!r} is not an EventLogger subclass")
+    return cls()
+
+
+def apply_conf_event_logger(name: str) -> None:
+    """Install the conf-selected logger unless the application already
+    called set_event_logger — the explicit act wins even when it installed
+    a NoOp (an opt-out), matching the reference's first-resolution-wins
+    singleton (HyperspaceEventLogging.scala:42-64)."""
+    if not name or _logger_explicit:
+        return
     global _logger
-    _logger = logger if logger is not None else NoOpEventLogger()
+    _logger = resolve_event_logger(name)  # not via set_event_logger: conf
+    # application must stay overridable by a later explicit set.

@@ -108,3 +108,62 @@ def test_action_events_emitted(active_index):
     kinds = [e.kind for e in logger.events]
     assert "DeleteActionEvent" in kinds
     assert logger.events[-1].state == States.DELETED
+
+
+class TestConfEventLogger:
+    def test_conf_selected_logger_receives_events(self, tmp_path):
+        """The eventLoggerClass conf analog: a logger named in conf is
+        installed at session construction and sees action events."""
+        from hyperspace_tpu import Hyperspace, HyperspaceConf, HyperspaceSession, IndexConfig
+        from hyperspace_tpu.telemetry.events import (
+            get_event_logger,
+            set_event_logger,
+        )
+        from tests.utils import write_sample_parquet
+
+        set_event_logger(None)  # reset so conf resolution applies
+        try:
+            conf = HyperspaceConf()
+            conf.event_logger = "CollectingEventLogger"
+            s = HyperspaceSession(system_path=str(tmp_path / "ix"), conf=conf)
+            logger = get_event_logger()
+            assert type(logger).__name__ == "CollectingEventLogger"
+            data = str(tmp_path / "data")
+            write_sample_parquet(data, n_files=1)
+            s.conf.num_buckets = 2
+            Hyperspace(s).create_index(s.read.parquet(data),
+                                       IndexConfig("i", ["id"], ["name"]))
+            kinds = [e.kind for e in logger.events]
+            assert "CreateActionEvent" in kinds
+        finally:
+            set_event_logger(None)
+
+    def test_explicit_noop_beats_conf(self, tmp_path):
+        from hyperspace_tpu import HyperspaceConf, HyperspaceSession
+        from hyperspace_tpu.telemetry.events import (
+            NoOpEventLogger,
+            get_event_logger,
+            set_event_logger,
+        )
+
+        set_event_logger(None)
+        try:
+            explicit = NoOpEventLogger()
+            set_event_logger(explicit)  # explicit opt-out
+            conf = HyperspaceConf()
+            conf.event_logger = "CollectingEventLogger"
+            HyperspaceSession(system_path=str(tmp_path / "ix"), conf=conf)
+            assert get_event_logger() is explicit
+        finally:
+            set_event_logger(None)
+
+    def test_dotted_path_and_unknown_name(self):
+        from hyperspace_tpu.telemetry.events import resolve_event_logger
+
+        logger = resolve_event_logger(
+            "hyperspace_tpu.telemetry.events.CollectingEventLogger")
+        assert type(logger).__name__ == "CollectingEventLogger"
+        import pytest
+
+        with pytest.raises(ValueError, match="Unknown event logger"):
+            resolve_event_logger("nope")
