@@ -190,18 +190,9 @@ class CreateActionBase(Action):
         FilterIndexRule uses it to prune index FILES for range predicates;
         with the Z-order layout every indexed dimension's ranges are narrow
         so the pruning bites on all of them."""
-        import pyarrow.parquet as pq
+        from hyperspace_tpu.actions.data_skipping import write_index_file_sketch
 
-        from hyperspace_tpu.actions.data_skipping import sketch_rows_for_files
-        from hyperspace_tpu.io.files import list_data_files
-
-        files = list_data_files([out_dir], extension=".parquet")
-        if not files:
-            return
-        rows = sketch_rows_for_files(files, resolved.indexed_columns,
-                                     "parquet", {})
-        pq.write_table(pa.Table.from_pylist(rows),
-                       os.path.join(out_dir, "_sketch.parquet"))
+        write_index_file_sketch(out_dir, resolved.indexed_columns)
 
     # -- log entry (CreateActionBase.getIndexLogEntry:56-105) ---------------
     def _signature(self) -> Signature:

@@ -124,6 +124,20 @@ def sketch_rows_for_files(files: Sequence[FileInfo], columns: Sequence[str],
     return rows
 
 
+def write_index_file_sketch(out_dir: str, columns: Sequence[str]) -> None:
+    """Per-index-file min/max sketch (``_sketch.parquet``) for a version
+    directory of bucket files — shared by create/refresh builds and
+    optimize compaction so the format can never drift between them."""
+    from hyperspace_tpu.io.files import list_data_files
+
+    files = list_data_files([out_dir], extension=".parquet")
+    if not files:
+        return
+    rows = sketch_rows_for_files(files, columns, "parquet", {})
+    pq.write_table(pa.Table.from_pylist(rows),
+                   os.path.join(out_dir, "_sketch.parquet"))
+
+
 def write_sketch(rows: List[Dict], out_dir: str) -> str:
     os.makedirs(out_dir, exist_ok=True)
     path = os.path.join(out_dir, f"sketch-{uuid.uuid4().hex[:12]}.parquet")

@@ -104,6 +104,12 @@ class OptimizeAction(Action):
             path = os.path.join(out_dir, bucket_file_name(bucket))
             pq.write_table(merged, path)
             self._new_files.append(path)
+        # Per-file min/max sketch for the compacted version, like every
+        # build writes — keeps FilterIndexRule's file pruning effective on
+        # optimized indexes.
+        from hyperspace_tpu.actions.data_skipping import write_index_file_sketch
+
+        write_index_file_sketch(out_dir, sort_cols)
 
     def log_entry(self) -> IndexLogEntry:
         entry = copy.deepcopy(self.previous_log_entry)

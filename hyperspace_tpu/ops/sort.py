@@ -33,7 +33,7 @@ def _bucket_sort_impl(
     num_buckets: int,
     pallas: bool,
     zorder: bool = False,
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
+) -> jnp.ndarray:  # (2, n) stacked [buckets, perm] — one host transfer
     # One bucket-assignment implementation for build and query paths —
     # duplicating it risks the two silently diverging, which corrupts the
     # durable on-disk bucket layout.
@@ -61,7 +61,9 @@ def _bucket_sort_impl(
             keys.append(w[:, 0])
     keys.append(buckets)
     perm = jnp.lexsort(tuple(keys)).astype(jnp.int32)
-    return buckets, perm
+    # One stacked output = ONE device->host transfer for both arrays (the
+    # pull dominates build latency on a remote-tunnel chip).
+    return jnp.stack([buckets, perm])
 
 
 def _pad_rows(arr, capacity: int):
@@ -80,7 +82,7 @@ def bucket_sort_permutation(
     num_buckets: int,
     pad_to: int = 0,
     zorder: bool = False,
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
+) -> "Tuple[np.ndarray, np.ndarray]":
     """Fused hash + sort kernel.
 
     Args:
@@ -94,8 +96,9 @@ def bucket_sort_permutation(
         ``device_batch_rows``.
 
     Returns:
-      (bucket_ids int32 (n,), perm int32 (n,)) where perm orders rows by
-      (bucket, *key columns) — ready for ``write_bucketed``.
+      (bucket_ids int32 (n,), perm int32 (n,)) HOST numpy arrays (pulled in
+      one transfer) where perm orders rows by (bucket, *key columns) —
+      ready for ``write_bucketed``.
 
     On TPU the hash stage runs as the fused pallas kernel; the choice is a
     static jit arg so env flips retrace (see ``ops.hash.use_pallas``).
@@ -108,13 +111,12 @@ def bucket_sort_permutation(
         capacity = -(-max(n, 1) // pad_to) * pad_to
         word_cols = [_pad_rows(w, capacity) for w in word_cols]
         order_words = [_pad_rows(w, capacity) for w in order_words]
-    buckets, perm = _bucket_sort_impl(
+    import numpy as np
+
+    stacked = np.asarray(_bucket_sort_impl(
         tuple(word_cols), tuple(order_words), n, num_buckets, use_pallas(),
-        zorder)
-    if buckets.shape[0] != n:
-        buckets = buckets[:n]
-        perm = perm[:n]
-    return buckets, perm
+        zorder))
+    return stacked[0, :n], stacked[1, :n]
 
 
 @partial(jax.jit, static_argnames=("num_buckets",))

@@ -104,7 +104,8 @@ class CollectingEventLogger(EventLogger):
 
 
 _logger: EventLogger = NoOpEventLogger()
-_logger_explicit = False  # set_event_logger was called (even with None/NoOp)
+_logger_explicit = False  # set_event_logger installed a logger
+_conf_applied = False     # a conf key already resolved a logger
 
 
 def get_event_logger() -> EventLogger:
@@ -115,10 +116,11 @@ def set_event_logger(logger: Optional[EventLogger]) -> None:
     """Install a logger programmatically — this wins over the conf key;
     passing ``NoOpEventLogger()`` is an explicit opt-out.  ``None`` resets
     to the default state (conf resolution applies again)."""
-    global _logger, _logger_explicit
+    global _logger, _logger_explicit, _conf_applied
     if logger is None:
         _logger = NoOpEventLogger()
         _logger_explicit = False
+        _conf_applied = False
     else:
         _logger = logger
         _logger_explicit = True
@@ -159,8 +161,9 @@ def apply_conf_event_logger(name: str) -> None:
     called set_event_logger — the explicit act wins even when it installed
     a NoOp (an opt-out), matching the reference's first-resolution-wins
     singleton (HyperspaceEventLogging.scala:42-64)."""
-    if not name or _logger_explicit:
-        return
-    global _logger
+    global _logger, _conf_applied
+    if not name or _logger_explicit or _conf_applied:
+        return  # first resolution wins; explicit set always wins
     _logger = resolve_event_logger(name)  # not via set_event_logger: conf
     # application must stay overridable by a later explicit set.
+    _conf_applied = True
