@@ -1,0 +1,29 @@
+"""Profiling surface: XLA traces for the device data plane.
+
+SURVEY.md §5: the reference inherits its observability from the Spark UI;
+the TPU build's equivalent is the JAX/XLA profiler.  ``profiler_trace``
+wraps a region (an index build, a query) and writes a TensorBoard-loadable
+trace of every XLA program launch, transfer, and kernel.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator
+
+
+@contextlib.contextmanager
+def profiler_trace(log_dir: str) -> Iterator[None]:
+    """Trace device activity in the with-block into ``log_dir`` (view with
+    TensorBoard's profile plugin or Perfetto).
+
+    >>> with profiler_trace("/tmp/hs-trace"):
+    ...     hs.create_index(df, config)
+    """
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,90 @@
+"""CSV/JSON source-format coverage: the default source's allow-listed
+non-parquet formats must support the full index lifecycle (the reference's
+format-parameterized suites, e.g. SampleData written as parquet/json)."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
+from hyperspace_tpu.exceptions import HyperspaceError
+
+
+@pytest.fixture()
+def session(tmp_index_root):
+    s = HyperspaceSession(system_path=tmp_index_root)
+    s.conf.num_buckets = 2
+    return s
+
+
+def _write_csv(root, n=50):
+    os.makedirs(root)
+    with open(os.path.join(root, "part-0.csv"), "w") as f:
+        f.write("id,name\n")
+        for i in range(n):
+            f.write(f"{i},n{i}\n")
+
+
+def _write_json(root, n=50):
+    os.makedirs(root)
+    with open(os.path.join(root, "part-0.json"), "w") as f:
+        for i in range(n):
+            f.write(json.dumps({"id": i, "name": f"n{i}"}) + "\n")
+
+
+@pytest.mark.parametrize("fmt,writer", [("csv", _write_csv),
+                                        ("json", _write_json)])
+def test_index_lifecycle_over_format(session, tmp_path, fmt, writer):
+    root = str(tmp_path / "data")
+    writer(root)
+    hs = Hyperspace(session)
+    df = getattr(session.read, fmt)(root)
+    hs.create_index(df, IndexConfig("fi", ["id"], ["name"]))
+    entry = session.index_collection_manager.get_index("fi")
+    assert entry.relations[0].file_format == fmt
+    # Index data is ALWAYS parquet regardless of source format
+    # (IndexLogEntry.scala:347).
+    assert all(f.name.endswith(".parquet")
+               for f in entry.content.file_infos())
+    session.enable_hyperspace()
+    ds = df.filter(col("id") == 7).select("id", "name")
+    plan = ds.optimized_plan()
+    assert [s for s in plan.leaf_relations() if s.relation.index_scan_of], \
+        plan.tree_string()
+    got = ds.collect()
+    session.disable_hyperspace()
+    assert got.equals(ds.collect())
+    assert got.num_rows == 1
+    hs.delete_index("fi")
+    hs.vacuum_index("fi")
+
+
+def test_unsupported_format_rejected(session, tmp_path):
+    from hyperspace_tpu.plan.nodes import Scan, ScanRelation
+    from hyperspace_tpu.dataset import Dataset
+
+    session.conf.supported_file_formats = "parquet"
+    ds = Dataset(Scan(ScanRelation(root_paths=(str(tmp_path),),
+                                   file_format="csv")), session)
+    with pytest.raises(HyperspaceError):
+        Hyperspace(session).create_index(ds, IndexConfig("x", ["id"]))
+
+
+def test_profiler_trace_writes_output(tmp_path):
+    """utils.profiling.profiler_trace produces a TensorBoard-loadable trace
+    directory around device work (SURVEY §5's observability surface)."""
+    from hyperspace_tpu.ops.hash import bucket_ids
+    from hyperspace_tpu.utils.profiling import profiler_trace
+
+    out = str(tmp_path / "trace")
+    with profiler_trace(out):
+        words = np.zeros((16, 2), np.uint32)
+        bucket_ids([words], 4)
+    found = []
+    for dirpath, _, files in os.walk(out):
+        found.extend(files)
+    assert found, "no trace files written"
