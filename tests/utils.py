@@ -89,3 +89,11 @@ def sample_entry(name: str = "myIndex",
         ),
         state=state,
     )
+
+
+def canonical_rows(table) -> list:
+    """Order-independent row view for answer-equivalence assertions: rows as
+    tuples over name-sorted columns, sorted by repr (stable across mixed
+    types).  Shared so comparison semantics (nulls, NaN) have ONE home."""
+    cols = sorted(table.column_names)
+    return sorted(zip(*[table.column(c).to_pylist() for c in cols]), key=repr)
