@@ -122,7 +122,9 @@ class CreateActionBase(Action):
         columns = resolved.all_columns
         tables: List[pa.Table] = []
         for f in files:
-            t = read_table([f.name], relation.read_format, columns, relation.options)
+            t = read_table([f.name], relation.read_format, columns,
+                           relation.options,
+                           partition_roots=relation.root_paths)
             if lineage:
                 # Lineage column: constant file id per source file
                 # (CreateActionBase.scala:177-222 without the broadcast join).

@@ -91,9 +91,21 @@ def _sketch_from_parquet_footer(path: str,
 
 def sketch_rows_for_files(files: Sequence[FileInfo], columns: Sequence[str],
                           read_format: str,
-                          options: Dict[str, str]) -> List[Dict]:
+                          options: Dict[str, str],
+                          partition_roots: Optional[Sequence[str]] = None
+                          ) -> List[Dict]:
     """One sketch row per file: min/max/null-count per sketched column.
-    Parquet files are sketched from footer statistics when available."""
+    Parquet files are sketched from footer statistics when available.
+    Hive partition columns (constant per file, absent from the data) sketch
+    as min == max == the path value."""
+    from hyperspace_tpu.io.partitions import (
+        partition_spec_for_roots,
+        partition_values,
+        typed_value,
+    )
+
+    spec = partition_spec_for_roots(partition_roots) \
+        if partition_roots else {}
     rows: List[Dict] = []
     for f in files:
         row: Dict = {
@@ -101,13 +113,23 @@ def sketch_rows_for_files(files: Sequence[FileInfo], columns: Sequence[str],
             SKETCH_FILE_SIZE: f.size,
             SKETCH_FILE_MTIME: f.mtime,
         }
-        stats = _sketch_from_parquet_footer(f.name, columns) \
+        stats = _sketch_from_parquet_footer(
+            f.name, [c for c in columns if c not in spec]) \
             if read_format == "parquet" else None
         if stats is not None:
+            raw = partition_values(f.name, partition_roots or [])
+            for c in columns:
+                if c in spec:
+                    value = typed_value(raw.get(c), spec[c])
+                    stats[_min_col(c)] = value
+                    stats[_max_col(c)] = value
+                    stats[_null_col(c)] = stats[SKETCH_ROW_COUNT] \
+                        if value is None else 0
             row.update(stats)
             rows.append(row)
             continue
-        t = read_table([f.name], read_format, list(columns), options)
+        t = read_table([f.name], read_format, list(columns), options,
+                       partition_roots=partition_roots)
         row[SKETCH_ROW_COUNT] = t.num_rows
         for c in columns:
             col = t.column(c) if c in t.column_names else None
@@ -190,7 +212,7 @@ class CreateDataSkippingAction(CreateActionBase):
         rows = list(carry_rows or [])
         rows.extend(sketch_rows_for_files(
             files, resolved.sketched_columns, relation.read_format,
-            relation.options))
+            relation.options, partition_roots=relation.root_paths))
         if not rows:
             raise HyperspaceError("No source data files to sketch")
         version = self.data_manager.get_next_version()

@@ -161,7 +161,8 @@ class RefreshIncrementalAction(RefreshActionBase):
             relation = self._relation()
             for f in appended:
                 t = read_table([f.name], relation.read_format,
-                               resolved.all_columns, relation.options)
+                               resolved.all_columns, relation.options,
+                               partition_roots=relation.root_paths)
                 if self.lineage_enabled:
                     t = t.append_column(
                         DATA_FILE_ID_COLUMN,

@@ -103,7 +103,11 @@ class Executor:
             else:
                 empty = pa.table({})
             return empty.select(columns) if columns else empty
-        out = read_table(paths, read_format, columns, rel.options_dict)
+        # Source scans materialize hive partition columns from paths; index
+        # data reads (index_scan_of) never do — v__=N is not a partition.
+        roots = rel.root_paths if rel.index_scan_of is None else None
+        out = read_table(paths, read_format, columns, rel.options_dict,
+                         partition_roots=roots)
         return out.select(columns) if columns else out
 
     # -- filter -------------------------------------------------------------
@@ -290,7 +294,24 @@ def _arrow_eval(expr: Expr, table: pa.Table):
         right = _arrow_eval(expr.right, table)
         ops = {"==": pc.equal, "<": pc.less, "<=": pc.less_equal,
                ">": pc.greater, ">=": pc.greater_equal}
-        return ops[expr.op](left, right)
+        try:
+            return ops[expr.op](left, right)
+        except pa.ArrowNotImplementedError:
+            # Spark-style literal coercion: a scalar of a different type is
+            # cast to the column's type (e.g. "2024" vs an int64 partition
+            # column).  Uncastable literals re-raise the original error.
+            def cast_scalar(scalar, target):
+                # pc.cast parses, e.g. string "2024" -> int64 2024.
+                return pc.cast(scalar, target.type)
+
+            try:
+                if isinstance(left, pa.Scalar) and not isinstance(right, pa.Scalar):
+                    return ops[expr.op](cast_scalar(left, right), right)
+                if isinstance(right, pa.Scalar) and not isinstance(left, pa.Scalar):
+                    return ops[expr.op](left, cast_scalar(right, left))
+            except (pa.ArrowInvalid, pa.ArrowTypeError, ValueError, TypeError):
+                pass
+            raise
     if isinstance(expr, And):
         return pc.and_kleene(_arrow_eval(expr.left, table), _arrow_eval(expr.right, table))
     if isinstance(expr, Or):

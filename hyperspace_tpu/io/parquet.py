@@ -39,11 +39,41 @@ def bucket_id_of_file(path: str) -> Optional[int]:
 
 def read_table(paths: Sequence[str], file_format: str = "parquet",
                columns: Optional[Sequence[str]] = None,
-               options: Optional[Dict[str, str]] = None) -> pa.Table:
-    """Read and concatenate files into one arrow Table."""
+               options: Optional[Dict[str, str]] = None,
+               partition_roots: Optional[Sequence[str]] = None) -> pa.Table:
+    """Read and concatenate files into one arrow Table.
+
+    ``partition_roots``: when given, hive-style ``key=value`` directory
+    segments below these roots materialize as constant columns per file
+    (io/partitions.py) — source scans pass their root paths; index-data
+    reads never do."""
+    spec: Dict[str, str] = {}
+    file_columns = columns
+    if partition_roots:
+        from hyperspace_tpu.io.partitions import (
+            attach_partition_columns,
+            partition_spec_for_roots,
+        )
+
+        # Spec comes from the directory TREE, not this call's file subset:
+        # types must resolve identically for every caller (schema, build,
+        # hybrid subsets) or concatenation breaks.
+        spec = partition_spec_for_roots(partition_roots)
+        if spec and paths and file_format == "parquet":
+            # A column present in the data files wins over the path value —
+            # consistently, whether or not a projection is pushed down.
+            in_file = set(pq.read_schema(paths[0]).names)
+            spec = {k: t for k, t in spec.items() if k not in in_file}
+        if spec and columns:
+            # Partition columns come from paths, not file data.
+            file_columns = [c for c in columns if c not in spec]
     tables: List[pa.Table] = []
     for path in paths:
-        tables.append(_read_one(path, file_format, columns, options or {}))
+        t = _read_one(path, file_format, file_columns, options or {})
+        if spec:
+            t = attach_partition_columns(t, path, partition_roots, spec,
+                                         columns)
+        tables.append(t)
     if not tables:
         return pa.table({})
     return pa.concat_tables(tables, promote_options="default")
@@ -51,7 +81,9 @@ def read_table(paths: Sequence[str], file_format: str = "parquet",
 
 def _read_one(path: str, file_format: str, columns, options: Dict[str, str]) -> pa.Table:
     if file_format == "parquet":
-        if columns:
+        # columns=[] is meaningful: read NO data columns but keep the row
+        # count (a projection of partition-only columns).
+        if columns is not None:
             try:
                 return pq.read_table(path, columns=list(columns))
             except (pa.ArrowInvalid, KeyError):

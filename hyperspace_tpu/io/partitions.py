@@ -1,0 +1,138 @@
+"""Hive-style partition discovery: ``key=value`` path segments as columns.
+
+Reference contract: partitioned relations are first-class — the relation
+exposes a partition schema and base path (interfaces.scala:75-99,
+DefaultFileBasedRelation.scala:73-86) and the hybrid-scan suites run over
+partitioned datasets.  Spark materializes partition values from directory
+names into columns; this module does the same for our reader.
+
+Only segments BETWEEN a known root path and the file name are considered —
+paths outside the roots (index ``v__=N`` version dirs, lake metadata) never
+contribute columns.  Types are inferred per key over the whole file set:
+int64 when every value parses as an integer, else string (Spark's inference
+minus dates).  ``__HIVE_DEFAULT_PARTITION__`` decodes to null.
+"""
+
+from __future__ import annotations
+
+import os
+import urllib.parse
+from typing import Dict, List, Optional, Sequence
+
+HIVE_NULL = "__HIVE_DEFAULT_PARTITION__"
+
+
+def _relative_segments(path: str, roots: Sequence[str]) -> List[str]:
+    path = os.path.abspath(path)
+    for root in roots:
+        root = os.path.abspath(root).rstrip("/")
+        if path.startswith(root + "/"):
+            rel = path[len(root) + 1:]
+            return rel.split("/")[:-1]  # directories only, not the file name
+    return []
+
+
+def partition_values(path: str, roots: Sequence[str]) -> Dict[str, Optional[str]]:
+    """Raw (string-or-null) partition values parsed from ``path``."""
+    out: Dict[str, Optional[str]] = {}
+    for seg in _relative_segments(path, roots):
+        if "=" not in seg:
+            continue
+        key, _, value = seg.partition("=")
+        if not key:
+            continue
+        value = urllib.parse.unquote(value)
+        out[key] = None if value == HIVE_NULL else value
+    return out
+
+
+def _infer_types(values_by_key: Dict[str, List[Optional[str]]]) -> Dict[str, str]:
+    spec: Dict[str, str] = {}
+    for k, vals in values_by_key.items():
+        non_null = [v for v in vals if v is not None]
+
+        def is_int(v: str) -> bool:
+            try:
+                int(v)
+                return True
+            except ValueError:
+                return False
+
+        spec[k] = "int64" if non_null and all(is_int(v) for v in non_null) \
+            else "string"
+    return spec
+
+
+def partition_spec(paths: Sequence[str],
+                   roots: Sequence[str]) -> Dict[str, str]:
+    """Partition column -> arrow type string over the given file set.
+    Empty when the layout is not partitioned."""
+    values_by_key: Dict[str, List[Optional[str]]] = {}
+    for p in paths:
+        for k, v in partition_values(p, roots).items():
+            values_by_key.setdefault(k, []).append(v)
+    return _infer_types(values_by_key)
+
+
+def partition_spec_for_roots(roots: Sequence[str]) -> Dict[str, str]:
+    """Partition column -> arrow type inferred from the DIRECTORY tree under
+    ``roots`` — independent of which file subset a caller happens to read,
+    so every code path (full scans, hybrid-scan subsets, per-file build
+    reads, sketches) resolves identical types.  A per-subset inference would
+    let ``k=1`` read as int64 in one call and string (because ``k=x`` also
+    exists) in another, and the concat of the two would fail or corrupt."""
+    from hyperspace_tpu.io.files import expand_globs
+
+    values_by_key: Dict[str, List[Optional[str]]] = {}
+
+    def walk(d: str) -> None:
+        try:
+            entries = sorted(os.listdir(d))
+        except OSError:
+            return
+        for name in entries:
+            child = os.path.join(d, name)
+            if not os.path.isdir(child) or os.path.islink(child):
+                continue
+            if "=" in name:
+                key, _, value = name.partition("=")
+                if key:
+                    value = urllib.parse.unquote(value)
+                    values_by_key.setdefault(key, []).append(
+                        None if value == HIVE_NULL else value)
+            walk(child)
+
+    for root in expand_globs(roots):
+        if os.path.isdir(root):
+            walk(os.path.abspath(root))
+    return _infer_types(values_by_key)
+
+
+def typed_value(raw: Optional[str], arrow_type: str):
+    if raw is None:
+        return None
+    return int(raw) if arrow_type == "int64" else raw
+
+
+def attach_partition_columns(table, path: str, roots: Sequence[str],
+                             spec: Dict[str, str],
+                             columns: Optional[Sequence[str]] = None):
+    """Append this file's partition values as constant columns (only those
+    in ``columns`` when a projection was pushed down).  File columns win on
+    a name clash — the data file is the source of truth."""
+    import pyarrow as pa
+
+    from hyperspace_tpu.io.parquet import _dtype_from_string
+
+    raw = partition_values(path, roots)
+    wanted = None if columns is None else {c for c in columns}
+    for key, arrow_type in spec.items():
+        if key in table.column_names:
+            continue
+        if wanted is not None and key not in wanted:
+            continue
+        value = typed_value(raw.get(key), arrow_type)
+        table = table.append_column(
+            key, pa.array([value] * table.num_rows,
+                          type=_dtype_from_string(arrow_type)))
+    return table

@@ -123,10 +123,19 @@ class HyperspaceSession:
 
                 from hyperspace_tpu.sources.interfaces import physical_read_format
 
-                self._schema_cache[key] = read_schema(
+                schema = read_schema(
                     scan.relation.file_paths[0],
                     physical_read_format(scan.relation.file_format),
                     scan.relation.options_dict)
+                if scan.relation.index_scan_of is None:
+                    # Source-file subsets (hybrid scan) still carry hive
+                    # partition columns parsed below the root paths.
+                    from hyperspace_tpu.io.partitions import partition_spec_for_roots
+
+                    for k, t in partition_spec_for_roots(
+                            scan.relation.root_paths).items():
+                        schema.setdefault(k, t)
+                self._schema_cache[key] = schema
             else:
                 rel = self.source_provider_manager.get_relation(scan)
                 self._schema_cache[key] = rel.schema()

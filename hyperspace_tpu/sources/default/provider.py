@@ -43,8 +43,14 @@ class DefaultFileBasedRelation(FileBasedRelation):
             if not files:
                 raise FileNotFoundError(
                     f"No data files under {self.root_paths!r}")
-            self._schema_cache = read_schema(
-                files[0].name, self.file_format, self.options)
+            schema = read_schema(files[0].name, self.file_format, self.options)
+            # Hive partition columns live in the paths, not the files
+            # (partitionSchema, DefaultFileBasedRelation.scala:73-86).
+            from hyperspace_tpu.io.partitions import partition_spec_for_roots
+
+            for k, t in partition_spec_for_roots(self.root_paths).items():
+                schema.setdefault(k, t)
+            self._schema_cache = schema
         return self._schema_cache
 
     def signature(self) -> str:

@@ -1,0 +1,209 @@
+"""Hive-partitioned source data: partition columns materialize from
+``key=value`` directories and participate everywhere — reads, filters,
+index builds (as indexed OR included columns), hybrid scan, data skipping.
+
+Reference parity: partitionSchema/partitionBasePath
+(DefaultFileBasedRelation.scala:73-86) and the partitioned hybrid-scan
+suite (HybridScanForPartitionedDataTest.scala)."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import (
+    DataSkippingIndexConfig,
+    Hyperspace,
+    HyperspaceSession,
+    IndexConfig,
+    col,
+)
+from tests.utils import canonical_rows
+
+
+def _write_partitioned(root, dates=("2024", "2025"), rows_per=5):
+    n = 0
+    for d in dates:
+        part = os.path.join(root, f"date={d}")
+        os.makedirs(part, exist_ok=True)
+        pq.write_table(pa.table({
+            "id": pa.array(np.arange(n, n + rows_per, dtype=np.int64)),
+            "v": pa.array(np.arange(n, n + rows_per, dtype=np.int64) * 10),
+        }), os.path.join(part, "part-0.parquet"))
+        n += rows_per
+    return root
+
+
+@pytest.fixture()
+def session(tmp_index_root):
+    s = HyperspaceSession(system_path=tmp_index_root)
+    s.conf.num_buckets = 2
+    return s
+
+
+class TestReads:
+    def test_partition_column_materializes(self, session, tmp_path):
+        root = _write_partitioned(str(tmp_path / "data"))
+        out = session.read.parquet(root).collect()
+        assert "date" in out.column_names
+        # All-numeric partition values infer int64 (Spark's inference).
+        assert sorted(set(out.column("date").to_pylist())) == [2024, 2025]
+
+    def test_filter_on_partition_column(self, session, tmp_path):
+        root = _write_partitioned(str(tmp_path / "data"))
+        out = (session.read.parquet(root)
+               .filter(col("date") == 2024).select("id", "date").collect())
+        assert out.num_rows == 5
+        assert set(out.column("date").to_pylist()) == {2024}
+
+    def test_string_literal_coerces_to_partition_type(self, session, tmp_path):
+        """Spark-style coercion: a string literal against the int-inferred
+        partition column still compares."""
+        root = _write_partitioned(str(tmp_path / "data"))
+        out = (session.read.parquet(root)
+               .filter(col("date") == "2024").select("id").collect())
+        assert out.num_rows == 5
+
+    def test_int_partition_type_inference(self, session, tmp_path):
+        root = str(tmp_path / "data")
+        for y in (2024, 2025):
+            os.makedirs(os.path.join(root, f"year={y}"))
+            pq.write_table(pa.table({"id": pa.array([1], type=pa.int64())}),
+                           os.path.join(root, f"year={y}", "p.parquet"))
+        out = session.read.parquet(root).filter(col("year") >= 2025).collect()
+        assert out.num_rows == 1
+        assert out.schema.field("year").type == pa.int64()
+
+    def test_hive_null_partition(self, session, tmp_path):
+        root = str(tmp_path / "data")
+        os.makedirs(os.path.join(root, "k=__HIVE_DEFAULT_PARTITION__"))
+        pq.write_table(pa.table({"id": pa.array([1], type=pa.int64())}),
+                       os.path.join(root, "k=__HIVE_DEFAULT_PARTITION__",
+                                    "p.parquet"))
+        out = session.read.parquet(root).collect()
+        assert out.column("k").to_pylist() == [None]
+
+    def test_index_version_dirs_are_not_partitions(self, session, tmp_path):
+        """The v__=N hive-style index layout must NOT leak a v__ column
+        into index scans."""
+        root = _write_partitioned(str(tmp_path / "data"))
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(root),
+                        IndexConfig("pi", ["id"], ["v"]))
+        session.enable_hyperspace()
+        out = (session.read.parquet(root)
+               .filter(col("id") == 3).select("id", "v").collect())
+        assert set(out.column_names) == {"id", "v"}
+        assert out.num_rows == 1
+
+
+class TestIndexing:
+    def test_partition_column_as_included(self, session, tmp_path):
+        root = _write_partitioned(str(tmp_path / "data"))
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(root),
+                        IndexConfig("pi", ["id"], ["date"]))
+        session.enable_hyperspace()
+        ds = (session.read.parquet(root)
+              .filter(col("id") == 7).select("id", "date"))
+        plan = ds.optimized_plan()
+        assert [s for s in plan.leaf_relations() if s.relation.index_scan_of]
+        got = ds.collect()
+        session.disable_hyperspace()
+        assert canonical_rows(got) == canonical_rows(ds.collect())
+        assert got.column("date").to_pylist() == [2025]
+
+    def test_partition_column_as_indexed(self, session, tmp_path):
+        root = _write_partitioned(str(tmp_path / "data"))
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(root),
+                        IndexConfig("pd", ["date"], ["id"]))
+        session.enable_hyperspace()
+        ds = (session.read.parquet(root)
+              .filter(col("date") == 2024).select("date", "id"))
+        plan = ds.optimized_plan()
+        assert [s for s in plan.leaf_relations() if s.relation.index_scan_of]
+        assert ds.collect().num_rows == 5
+
+    def test_hybrid_scan_new_partition(self, session, tmp_path):
+        root = _write_partitioned(str(tmp_path / "data"))
+        session.conf.hybrid_scan_enabled = True
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(root),
+                        IndexConfig("pi", ["id"], ["date"]))
+        # A new partition directory appears.
+        part = os.path.join(root, "date=2026")
+        os.makedirs(part)
+        pq.write_table(pa.table({
+            "id": pa.array([100], type=pa.int64()),
+            "v": pa.array([0], type=pa.int64()),
+        }), os.path.join(part, "part-0.parquet"))
+        session.enable_hyperspace()
+        ds = (session.read.parquet(root)
+              .filter(col("id") >= 0).select("id", "date"))
+        got = ds.collect()
+        session.disable_hyperspace()
+        expected = ds.collect()
+        assert canonical_rows(got) == canonical_rows(expected)
+        assert 2026 in got.column("date").to_pylist()
+
+    def test_data_skipping_on_partition_column(self, session, tmp_path):
+        root = _write_partitioned(str(tmp_path / "data"),
+                                  dates=("2021", "2022", "2023", "2024"))
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(root),
+                        DataSkippingIndexConfig("dsp", ["date"]))
+        session.enable_hyperspace()
+        ds = (session.read.parquet(root)
+              .filter(col("date") == 2023).select("id", "date"))
+        plan = ds.optimized_plan()
+        scans = [s for s in plan.leaf_relations()
+                 if s.relation.data_skipping_of]
+        assert scans and scans[0].relation.data_skipping_stats == (1, 4), \
+            plan.tree_string()
+        got = ds.collect()
+        session.disable_hyperspace()
+        assert canonical_rows(got) == canonical_rows(ds.collect())
+        assert got.num_rows == 5
+
+
+class TestSpecConsistency:
+    def test_mixed_type_partition_values_build(self, session, tmp_path):
+        """k=1 and k=x must resolve ONE type (string) for every caller —
+        per-file-subset inference would make the per-file build reads
+        disagree and the concat explode."""
+        root = str(tmp_path / "data")
+        for k in ("1", "x"):
+            os.makedirs(os.path.join(root, f"k={k}"))
+            pq.write_table(pa.table({"id": pa.array([1], type=pa.int64())}),
+                           os.path.join(root, f"k={k}", "p.parquet"))
+        out = session.read.parquet(root).collect()
+        assert out.schema.field("k").type == pa.string()
+        assert sorted(out.column("k").to_pylist()) == ["1", "x"]
+        # The index build reads file-by-file; types must still agree.
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(root),
+                        IndexConfig("mi", ["id"], ["k"]))
+        session.enable_hyperspace()
+        got = (session.read.parquet(root)
+               .filter(col("id") == 1).select("id", "k").collect())
+        assert sorted(got.column("k").to_pylist()) == ["1", "x"]
+
+    def test_file_column_wins_over_path_value(self, session, tmp_path):
+        """A column physically present in the file beats the directory
+        value — identically with and without a pushed-down projection."""
+        d = os.path.join(str(tmp_path / "data"), "date=2024")
+        os.makedirs(d)
+        pq.write_table(pa.table({
+            "id": pa.array([1], type=pa.int64()),
+            "date": pa.array([1999], type=pa.int64()),
+        }), os.path.join(d, "p.parquet"))
+        root = str(tmp_path / "data")
+        full = session.read.parquet(root).collect()
+        projected = session.read.parquet(root).select("id", "date").collect()
+        assert full.column("date").to_pylist() == [1999]
+        assert projected.column("date").to_pylist() == [1999]
