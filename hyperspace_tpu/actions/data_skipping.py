@@ -106,8 +106,8 @@ def sketch_rows_for_files(files: Sequence[FileInfo], columns: Sequence[str],
 
     spec = partition_spec_for_roots(partition_roots) \
         if partition_roots else {}
-    rows: List[Dict] = []
-    for f in files:
+
+    def sketch_one(f: FileInfo) -> Dict:
         row: Dict = {
             SKETCH_FILE_NAME: f.name,
             SKETCH_FILE_SIZE: f.size,
@@ -126,10 +126,9 @@ def sketch_rows_for_files(files: Sequence[FileInfo], columns: Sequence[str],
                     stats[_null_col(c)] = stats[SKETCH_ROW_COUNT] \
                         if value is None else 0
             row.update(stats)
-            rows.append(row)
-            continue
+            return row
         t = read_table([f.name], read_format, list(columns), options,
-                       partition_roots=partition_roots)
+                       partition_roots=partition_roots, partition_spec=spec)
         row[SKETCH_ROW_COUNT] = t.num_rows
         for c in columns:
             col = t.column(c) if c in t.column_names else None
@@ -142,8 +141,11 @@ def sketch_rows_for_files(files: Sequence[FileInfo], columns: Sequence[str],
                 row[_min_col(c)] = mm["min"].as_py()
                 row[_max_col(c)] = mm["max"].as_py()
                 row[_null_col(c)] = col.null_count
-        rows.append(row)
-    return rows
+        return row
+
+    from hyperspace_tpu.utils.parallel_map import parallel_map_ordered
+
+    return parallel_map_ordered(sketch_one, list(files))
 
 
 def write_index_file_sketch(out_dir: str, columns: Sequence[str]) -> None:

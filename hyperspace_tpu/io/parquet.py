@@ -23,6 +23,8 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as pq
 
+from hyperspace_tpu.utils.parallel_map import parallel_map_ordered
+
 _BUCKET_FILE_RE = re.compile(r"part-b(\d{5})-")
 
 
@@ -40,13 +42,15 @@ def bucket_id_of_file(path: str) -> Optional[int]:
 def read_table(paths: Sequence[str], file_format: str = "parquet",
                columns: Optional[Sequence[str]] = None,
                options: Optional[Dict[str, str]] = None,
-               partition_roots: Optional[Sequence[str]] = None) -> pa.Table:
+               partition_roots: Optional[Sequence[str]] = None,
+               partition_spec: Optional[Dict[str, str]] = None) -> pa.Table:
     """Read and concatenate files into one arrow Table.
 
     ``partition_roots``: when given, hive-style ``key=value`` directory
     segments below these roots materialize as constant columns per file
     (io/partitions.py) — source scans pass their root paths; index-data
-    reads never do."""
+    reads never do.  ``partition_spec`` lets a caller that already walked
+    the directory tree pass the inferred spec instead of re-walking."""
     spec: Dict[str, str] = {}
     file_columns = columns
     if partition_roots:
@@ -58,7 +62,8 @@ def read_table(paths: Sequence[str], file_format: str = "parquet",
         # Spec comes from the directory TREE, not this call's file subset:
         # types must resolve identically for every caller (schema, build,
         # hybrid subsets) or concatenation breaks.
-        spec = partition_spec_for_roots(partition_roots)
+        spec = partition_spec if partition_spec is not None \
+            else partition_spec_for_roots(partition_roots)
         if spec and paths and file_format == "parquet":
             # A column present in the data files wins over the path value —
             # consistently, whether or not a projection is pushed down.
@@ -67,13 +72,14 @@ def read_table(paths: Sequence[str], file_format: str = "parquet",
         if spec and columns:
             # Partition columns come from paths, not file data.
             file_columns = [c for c in columns if c not in spec]
-    tables: List[pa.Table] = []
-    for path in paths:
+    def load(path: str) -> pa.Table:
         t = _read_one(path, file_format, file_columns, options or {})
         if spec:
             t = attach_partition_columns(t, path, partition_roots, spec,
                                          columns)
-        tables.append(t)
+        return t
+
+    tables = parallel_map_ordered(load, paths)
     if not tables:
         return pa.table({})
     return pa.concat_tables(tables, promote_options="default")
@@ -163,16 +169,19 @@ def write_bucketed(table: pa.Table, bucket_ids: np.ndarray, sort_perm: np.ndarra
     # Bucket boundaries within the sorted order.
     starts = np.searchsorted(sorted_buckets, np.arange(num_buckets), side="left")
     ends = np.searchsorted(sorted_buckets, np.arange(num_buckets), side="right")
-    out_paths: List[str] = []
+    jobs: List = []  # (path, start, rows)
     for b in range(num_buckets):
         n = int(ends[b] - starts[b])
         if n == 0:
             continue
         chunk = max_rows_per_file if max_rows_per_file > 0 else n
         for off in range(0, n, chunk):
-            path = os.path.join(out_dir, bucket_file_name(b))
-            pq.write_table(
-                sorted_table.slice(int(starts[b]) + off, min(chunk, n - off)),
-                path)
-            out_paths.append(path)
-    return out_paths
+            jobs.append((os.path.join(out_dir, bucket_file_name(b)),
+                         int(starts[b]) + off, min(chunk, n - off)))
+
+    def write(job) -> str:
+        path, start, rows = job
+        pq.write_table(sorted_table.slice(start, rows), path)
+        return path
+
+    return parallel_map_ordered(write, jobs)

@@ -1,0 +1,33 @@
+"""Order-preserving threaded map for per-file IO.
+
+pyarrow's readers and writers release the GIL, so scans/writes of many
+files overlap decode and filesystem latency instead of serializing on one
+core.  Fail-fast: the first exception cancels not-yet-started work and
+propagates immediately.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Sequence, TypeVar
+
+T = TypeVar("T")
+R = TypeVar("R")
+
+
+def parallel_map_ordered(fn: Callable[[T], R], items: Sequence[T],
+                         max_workers: int = 16) -> List[R]:
+    if len(items) <= 1:
+        return [fn(x) for x in items]
+    from concurrent.futures import FIRST_EXCEPTION, ThreadPoolExecutor, wait
+
+    workers = min(len(items), os.cpu_count() or 4, max_workers)
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        futures = [pool.submit(fn, x) for x in items]
+        done, not_done = wait(futures, return_when=FIRST_EXCEPTION)
+        failed = next((f for f in done if f.exception() is not None), None)
+        if failed is not None:
+            for f in not_done:
+                f.cancel()
+            raise failed.exception()
+        return [f.result() for f in futures]
