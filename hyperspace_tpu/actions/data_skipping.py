@@ -145,7 +145,9 @@ def sketch_rows_for_files(files: Sequence[FileInfo], columns: Sequence[str],
 
     from hyperspace_tpu.utils.parallel_map import parallel_map_ordered
 
-    return parallel_map_ordered(sketch_one, list(files))
+    # Low worker cap: the non-parquet fallback materializes a full table per
+    # in-flight file, so concurrency multiplies peak memory.
+    return parallel_map_ordered(sketch_one, list(files), max_workers=4)
 
 
 def write_index_file_sketch(out_dir: str, columns: Sequence[str]) -> None:
