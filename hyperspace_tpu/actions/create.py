@@ -108,7 +108,14 @@ class CreateActionBase(Action):
     # -- the build (CreateActionBase.write:124-142, TPU-style) --------------
     def _build_index_data(self, file_names: Optional[List[str]] = None) -> None:
         """Read source columns, run the fused hash+sort kernel, write one
-        sorted Parquet file per bucket into the next ``v__=N`` directory."""
+        sorted Parquet file per bucket into the next ``v__=N`` directory.
+
+        Datasets bigger than one device batch take the EXTERNAL build
+        (SURVEY §7's "sort at SF100 exceeds HBM" hard part): source files
+        stream through the hash kernel one batch at a time, rows spill into
+        per-bucket run files, and each bucket is then sorted independently —
+        peak memory is bounded by max(batch, largest bucket), not the
+        dataset."""
         relation = self._relation()
         resolved = self._resolved_config()
         lineage = self.lineage_enabled
@@ -120,19 +127,63 @@ class CreateActionBase(Action):
             raise HyperspaceError("No source data files to index")
 
         columns = resolved.all_columns
-        tables: List[pa.Table] = []
+        batch_rows = max(1, int(self.conf.device_batch_rows))
+        # The mesh build shards rows across devices itself — streaming spill
+        # is the SINGLE-chip answer to datasets beyond one batch.
+        streaming = not self._use_distributed_build()
+        spill = _BucketSpill(self, resolved)
+        try:
+            self._stream_build(files, columns, relation, lineage, resolved,
+                               batch_rows, streaming, spill)
+        except BaseException:
+            spill.cleanup()
+            raise
+
+    def _stream_build(self, files, columns, relation, lineage, resolved,
+                      batch_rows, streaming, spill) -> None:
+        buffer: List[pa.Table] = []
+        buffered = 0
         for f in files:
             t = read_table([f.name], relation.read_format, columns,
                            relation.options,
                            partition_roots=relation.root_paths)
+            # Schema evolution: a file predating an added column yields a
+            # table without it; the monolithic concat used to null-promote,
+            # so the streaming path must normalize per file the same way.
+            missing = [col_name for col_name in columns
+                       if col_name not in t.column_names]
+            if missing:
+                from hyperspace_tpu.io.parquet import _dtype_from_string
+
+                rel_schema = relation.schema()
+                for col_name in missing:
+                    t = t.append_column(col_name, pa.nulls(
+                        t.num_rows,
+                        type=_dtype_from_string(
+                            rel_schema.get(col_name, "string"))))
             if lineage:
                 # Lineage column: constant file id per source file
                 # (CreateActionBase.scala:177-222 without the broadcast join).
                 fid = np.full(t.num_rows, f.id, dtype=np.int64)
                 t = t.append_column(DATA_FILE_ID_COLUMN, pa.array(fid))
-            tables.append(t)
-        table = pa.concat_tables(tables, promote_options="default")
-        self._write_table_bucketed(table, resolved)
+            buffer.append(t)
+            buffered += t.num_rows
+            while streaming and buffered > batch_rows:
+                combined = pa.concat_tables(buffer, promote_options="default")
+                spill.add_chunk(combined.slice(0, batch_rows))
+                rest = combined.slice(batch_rows)
+                buffer = [rest] if rest.num_rows else []
+                buffered = rest.num_rows
+        remainder = pa.concat_tables(buffer, promote_options="default") \
+            if buffer else None
+        if not spill.spilled:
+            # Everything fit in one batch (or the mesh owns the sharding):
+            # the fused monolithic/distributed kernel.
+            self._write_table_bucketed(remainder, resolved)
+            return
+        if remainder is not None and remainder.num_rows:
+            spill.add_chunk(remainder)
+        spill.finish()
 
     def _use_distributed_build(self) -> bool:
         import jax
@@ -239,6 +290,134 @@ class CreateActionBase(Action):
                           fingerprint=LogicalPlanFingerprint([self._signature()])),
             properties=properties,
         )
+
+
+class _BucketSpill:
+    """External-build spill state: per-chunk bucket routing to run files,
+    then a per-bucket sort into the final layout.
+
+    Phase 1 runs the SAME device hash kernel as the monolithic build on
+    fixed-capacity batches (one compiled program, every chunk), so bucket
+    assignment can never diverge between build sizes.  Phase 2 sorts each
+    bucket on host (run sizes are dynamic; per-bucket device compiles would
+    storm the cache) — runs are concatenated in chunk order, so the stable
+    sort reproduces the monolithic build's tie order exactly."""
+
+    def __init__(self, action: "CreateActionBase", resolved: IndexConfig) -> None:
+        self.action = action
+        self.resolved = resolved
+        self.spilled = False
+        self._chunk_no = 0
+        self._schema = None
+        self._dir = None  # created on first spill; non-spilling builds
+        # never touch disk
+
+    def cleanup(self) -> None:
+        if self._dir is not None:
+            import shutil
+
+            shutil.rmtree(self._dir, ignore_errors=True)
+            self._dir = None
+
+    def add_chunk(self, table: pa.Table) -> None:
+        import pyarrow.parquet as pq
+
+        from hyperspace_tpu.ops.hash import bucket_ids
+        from hyperspace_tpu.ops.sort import _pad_rows
+
+        if self._dir is None:
+            import tempfile
+
+            self._dir = tempfile.mkdtemp(prefix="hs_build_spill_")
+        self.spilled = True
+        if self._schema is None:
+            self._schema = table.schema
+        n = table.num_rows
+        capacity = max(1, int(self.action.conf.device_batch_rows))
+        capacity = -(-max(n, 1) // capacity) * capacity
+        word_cols = [
+            _pad_rows(np.asarray(columnar.to_hash_words(table.column(c))),
+                      capacity)
+            for c in self.resolved.indexed_columns
+        ]
+        num_buckets = self.action.num_buckets
+        buckets = np.asarray(bucket_ids(word_cols, num_buckets))[:n]
+        order = np.argsort(buckets, kind="stable")
+        sorted_buckets = buckets[order]
+        routed = table.take(pa.array(order))
+        starts = np.searchsorted(sorted_buckets, np.arange(num_buckets), "left")
+        ends = np.searchsorted(sorted_buckets, np.arange(num_buckets), "right")
+        for b in range(num_buckets):
+            rows = int(ends[b] - starts[b])
+            if rows == 0:
+                continue
+            bdir = os.path.join(self._dir, f"bucket={b:05d}")
+            os.makedirs(bdir, exist_ok=True)
+            pq.write_table(routed.slice(int(starts[b]), rows),
+                           os.path.join(bdir, f"run-{self._chunk_no:05d}.parquet"))
+        self._chunk_no += 1
+
+    def finish(self) -> None:
+        import shutil
+
+        import pyarrow.parquet as pq
+
+        from hyperspace_tpu.io.parquet import bucket_file_name
+
+        action = self.action
+        resolved = self.resolved
+        version = action.data_manager.get_next_version()
+        out_dir = action.data_manager.version_path(version)
+        os.makedirs(out_dir, exist_ok=True)
+        max_rows = action.conf.index_max_rows_per_file
+
+        def finish_bucket(bname: str) -> None:
+            bdir = os.path.join(self._dir, bname)
+            bucket = int(bname.split("=")[1])
+            runs = sorted(os.listdir(bdir))  # chunk order = stable ties
+            btable = pa.concat_tables(
+                [pq.read_table(os.path.join(bdir, r)) for r in runs],
+                promote_options="default")
+            perm = self._sort_permutation(btable)
+            btable = btable.take(pa.array(perm))
+            n = btable.num_rows
+            chunk = max_rows if max_rows > 0 else n
+            for off in range(0, n, chunk):
+                pq.write_table(
+                    btable.slice(off, min(chunk, n - off)),
+                    os.path.join(out_dir, bucket_file_name(bucket)))
+
+        from hyperspace_tpu.utils.parallel_map import parallel_map_ordered
+
+        try:
+            # Low cap: each in-flight bucket holds its full table in memory.
+            parallel_map_ordered(finish_bucket, sorted(os.listdir(self._dir)),
+                                 max_workers=4)
+        finally:
+            shutil.rmtree(self._dir, ignore_errors=True)
+            self._dir = None
+        action._write_index_file_sketch(out_dir, resolved)
+        action._written_version = version
+        action._index_schema = {name: str(t) for name, t in
+                                zip(self._schema.names, self._schema.types)}
+
+    def _sort_permutation(self, btable: pa.Table) -> np.ndarray:
+        if self.resolved.layout == "zorder":
+            from hyperspace_tpu.ops.zorder import zorder_order_words_np
+
+            # Ranks are per bucket here (global ranks would need another
+            # pass); clustering quality within each bucket is what the
+            # per-file sketches consume, so pruning power is preserved.
+            z = zorder_order_words_np([
+                np.asarray(columnar.to_order_words(btable.column(c)))
+                for c in self.resolved.indexed_columns])
+            return np.lexsort((z[:, 1], z[:, 0]))
+        keys: List[np.ndarray] = []
+        for c in reversed(self.resolved.indexed_columns):
+            w = np.asarray(columnar.to_order_words(btable.column(c)))
+            keys.append(w[:, 1])
+            keys.append(w[:, 0])
+        return np.lexsort(tuple(keys))
 
 
 class CreateAction(CreateActionBase):

@@ -21,9 +21,12 @@ import tempfile
 import threading
 from typing import List, Optional, Sequence, Tuple
 
-_SOURCE = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__)))), "native", "hs_native.cc")
-_PREBUILT = os.path.join(os.path.dirname(_SOURCE), "build", "libhs_native.so")
+# The C++ source ships INSIDE the package so pip installs keep the native
+# fast path (it compiles on demand wherever g++ exists).
+_SOURCE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "hs_native.cc")
+_PREBUILT = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "native", "build", "libhs_native.so")
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
