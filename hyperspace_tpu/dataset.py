@@ -51,3 +51,21 @@ class Dataset:
 
     def explain_string(self) -> str:
         return self.plan.tree_string()
+
+    def show(self, n: int = 20) -> None:
+        """Print the first ``n`` rows (df.show analog; the reference shims
+        Spark's showString, org/apache/spark/sql/hyperspace/utils).
+
+        Materializes the full result like ``collect()`` does (there is no
+        limit pushdown); use a selective filter for large datasets."""
+        table = self.collect()
+        head = table.slice(0, n)
+        names = head.column_names
+        rows = [[str(v) for v in row.values()] for row in head.to_pylist()]
+        widths = [max(len(name), *(len(r[i]) for r in rows), 1) if rows
+                  else len(name) for i, name in enumerate(names)]
+        print(" ".join(name.rjust(w) for name, w in zip(names, widths)))
+        for r in rows:
+            print(" ".join(v.rjust(w) for v, w in zip(r, widths)))
+        if table.num_rows > n:
+            print(f"... ({table.num_rows - n} more rows)")
