@@ -63,6 +63,7 @@ def catalog(tmp_path_factory):
     lineitem = pa.table({
         "l_orderkey": pa.array(rng.integers(0, N_ROWS, 4 * N_ROWS),
                                type=pa.int64()),
+        "l_shipdate": pa.array(np.arange(4 * N_ROWS, dtype=np.int64) % 1600),
         "l_partkey": pa.array(rng.integers(0, 100, 4 * N_ROWS),
                               type=pa.int64()),
         "l_quantity": pa.array(rng.integers(1, 50, 4 * N_ROWS),
@@ -108,6 +109,15 @@ def catalog(tmp_path_factory):
     hs.create_index(read.parquet(paths["customer"]),
                     IndexConfig("idx_cust_ckey", ["c_custkey"],
                                 ["c_name", "c_mktsegment"]))
+    # Feature coverage: a data-skipping index on a time-correlated column
+    # and a Z-order index over two dimensions.
+    from hyperspace_tpu import DataSkippingIndexConfig
+
+    hs.create_index(read.parquet(paths["lineitem"]),
+                    DataSkippingIndexConfig("ds_line_ship", ["l_shipdate"]))
+    hs.create_index(read.parquet(paths["orders"]),
+                    IndexConfig("idx_orders_z", ["o_custkey", "o_totalprice"],
+                                ["o_orderkey"], layout="zorder"))
     session.enable_hyperspace()
     return session, paths
 
@@ -136,8 +146,9 @@ def _queries(session, paths):
         "q04_filter_second_index": orders()
             .filter(col("o_custkey") == 3)
             .select("o_custkey", "o_orderstatus"),
-        # negative: filtered column is not the first indexed column
-        "q05_no_rewrite_not_first_col": orders()
+        # the lexicographic indexes can't serve a non-first-column filter,
+        # but the Z-order index (any-indexed-column rule) rescues it
+        "q05_zorder_rescues_non_first_col": orders()
             .filter(col("o_totalprice") > 500.0)
             .select("o_orderkey", "o_totalprice"),
         # negative: output needs a column no index covers
@@ -168,6 +179,15 @@ def _queries(session, paths):
         "q12_bucket_pruned_point": lineitem()
             .filter(col("l_partkey") == 33)
             .select("l_partkey", "l_quantity"),
+        # data-skipping: range on a column no covering index serves;
+        # l_shipdate is monotone so the per-file sketch prunes
+        "q13_data_skipping_range": lineitem()
+            .filter((col("l_shipdate") >= 100) & (col("l_shipdate") < 500))
+            .select("l_shipdate", "l_extendedprice"),
+        # zorder: range on the SECOND indexed dimension still applies
+        "q14_zorder_second_dim_range": orders()
+            .filter(col("o_totalprice") >= 990.0)
+            .select("o_custkey", "o_totalprice"),
     }
 
 
@@ -183,7 +203,7 @@ def _simplify(plan_string: str, paths) -> str:
     return out + "\n"
 
 
-QUERY_NAMES = [f"q{i:02d}" for i in range(1, 13)]
+QUERY_NAMES = [f"q{i:02d}" for i in range(1, 15)]
 
 
 def _query_by_prefix(queries, prefix):
@@ -227,7 +247,8 @@ def test_expected_rewrites_fired(catalog):
     must_rewrite = {k for k in queries if "no_rewrite" not in k}
     for name, ds in queries.items():
         plan = ds.optimized_plan()
-        used = [s for s in plan.leaf_relations() if s.relation.index_scan_of]
+        used = [s for s in plan.leaf_relations()
+                if s.relation.index_scan_of or s.relation.data_skipping_of]
         if name in must_rewrite:
             assert used, f"{name}: expected an index rewrite"
         else:
