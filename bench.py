@@ -9,6 +9,9 @@ mini dataset (wide lineitem + orders, multiple parquet files):
     FilterIndexRule.scala:62-68 analog) and only the covered columns.
   - JoinIndexRule orders ⋈ lineitem on orderkey: both sides rewritten to
     bucketed, column-pruned index scans (JoinIndexRule.scala:36-50 analog).
+  - Hybrid Scan over a Delta table with appended files (BASELINE config 4).
+  - Z-order two-column covering index, range query on the SECOND dimension
+    (BASELINE config 5's Z-order shape).
 
 The baseline is the same engine with hyperspace disabled (full scan), per
 BASELINE.md: the reference publishes no numbers, so the baseline is
@@ -133,7 +136,45 @@ def main() -> None:
 
         hs.create_index(session.read.parquet(lineitem_dir),
                         DataSkippingIndexConfig("li_ds", ["l_shipdate"]))
+        # Z-order over (shipdate, extendedprice): range queries on the
+        # second dimension prune files (BASELINE config 5's shape).  One
+        # bucket + 32 files along the Z-curve: the file split must cut BOTH
+        # dimensions' top bits for second-dimension pruning to bite.
+        session.conf.index_max_rows_per_file = N_LINEITEM // 32
+        session.conf.num_buckets = 1
+        hs.create_index(session.read.parquet(lineitem_dir),
+                        IndexConfig("li_z", ["l_shipdate", "l_extendedprice"],
+                                    ["l_quantity"], layout="zorder"))
+        session.conf.num_buckets = NUM_BUCKETS
+        session.conf.index_max_rows_per_file = 0
         build_s = time.perf_counter() - t_build0
+
+        # Delta table + index + append: the Hybrid Scan workload
+        # (BASELINE config 4).
+        from hyperspace_tpu.sources.delta import write_delta
+        import pyarrow as pa
+
+        delta_dir = os.path.join(root, "dorders")
+        d_n = N_LINEITEM  # big enough that a full scan actually costs
+        import numpy as np
+
+        rng2 = np.random.default_rng(3)
+        keys = np.arange(d_n)
+        for part in range(8):  # multi-file table, like the parquet side
+            sl = slice(part * d_n // 8, (part + 1) * d_n // 8)
+            write_delta(pa.table({
+                "o_orderkey": keys[sl],
+                "o_totalprice": rng2.random(d_n // 8) * 1e5,
+                "o_pad": rng2.random(d_n // 8),
+            }), delta_dir, mode="append")
+        hs.create_index(session.read.delta(delta_dir),
+                        IndexConfig("dord_idx", ["o_orderkey"],
+                                    ["o_totalprice"]))
+        write_delta(pa.table({
+            "o_orderkey": np.arange(d_n, d_n + d_n // 20),
+            "o_totalprice": rng2.random(d_n // 20) * 1e5,
+            "o_pad": rng2.random(d_n // 20),
+        }), delta_dir, mode="append")
 
         probe_key = 123_457
 
@@ -161,6 +202,23 @@ def main() -> None:
                             "l_extendedprice")
                     .collect())
 
+        def q_zorder_second_dim():
+            lo, hi = 2500.0, 3000.0
+            return (session.read.parquet(lineitem_dir)
+                    .filter((col("l_extendedprice") >= lo)
+                            & (col("l_extendedprice") < hi))
+                    .select("l_shipdate", "l_extendedprice", "l_quantity")
+                    .collect())
+
+        def q_hybrid_delta():
+            session.conf.hybrid_scan_enabled = True
+            try:
+                return (session.read.delta(delta_dir)
+                        .filter(col("o_orderkey") == probe_key)
+                        .select("o_orderkey", "o_totalprice").collect())
+            finally:
+                session.conf.hybrid_scan_enabled = False
+
         def q_ds_range():
             # BASELINE.json's data-skipping config: a date-range scan over
             # the wide table; min/max file pruning reads 1/8 of the files.
@@ -172,7 +230,9 @@ def main() -> None:
 
         results = {}
         for name, q in (("filter", q_filter), ("join", q_join),
-                        ("ds_range", q_ds_range)):
+                        ("ds_range", q_ds_range),
+                        ("zorder", q_zorder_second_dim),
+                        ("hybrid", q_hybrid_delta)):
             session.disable_hyperspace()
             expected = q()
             base_s = _time(q)
@@ -188,14 +248,36 @@ def main() -> None:
             idx_s = _time(q)
             results[name] = (base_s, idx_s)
 
-        # Verify the rewrite actually fired (plan uses index scans).
+        # Verify EVERY workload's rewrite actually fired — a silent
+        # scan-vs-scan measurement must fail, not report ~1x as valid.
         session.enable_hyperspace()
-        plan = (session.read.parquet(lineitem_dir)
-                .filter(col("l_orderkey") == probe_key)
-                .select("l_orderkey", "l_quantity").optimized_plan())
-        used = [s for s in plan.leaf_relations() if s.relation.index_scan_of]
-        if not used:
-            raise SystemExit("index rewrite did not fire; bench invalid")
+        checks = {
+            "filter": (session.read.parquet(lineitem_dir)
+                       .filter(col("l_orderkey") == probe_key)
+                       .select("l_orderkey", "l_quantity")),
+            "ds_range": (session.read.parquet(lineitem_dir)
+                         .filter((col("l_shipdate") >= 300_000)
+                                 & (col("l_shipdate") < 390_000))
+                         .select("l_shipdate", "l_extendedprice",
+                                 "l_discount")),
+            "zorder": (session.read.parquet(lineitem_dir)
+                       .filter((col("l_extendedprice") >= 2500.0)
+                               & (col("l_extendedprice") < 3000.0))
+                       .select("l_shipdate", "l_extendedprice",
+                               "l_quantity")),
+            "hybrid": None,
+        }
+        session.conf.hybrid_scan_enabled = True
+        checks["hybrid"] = (session.read.delta(delta_dir)
+                            .filter(col("o_orderkey") == probe_key)
+                            .select("o_orderkey", "o_totalprice"))
+        for name, ds in checks.items():
+            plan = ds.optimized_plan()
+            used = [s for s in plan.leaf_relations()
+                    if s.relation.index_scan_of or s.relation.data_skipping_of]
+            if not used:
+                raise SystemExit(f"{name}: rewrite did not fire; bench invalid")
+        session.conf.hybrid_scan_enabled = False
 
         speedups = {k: b / i for k, (b, i) in results.items()}
         geomean = math.exp(sum(math.log(s) for s in speedups.values())
@@ -212,6 +294,10 @@ def main() -> None:
                 "join_indexed_s": round(results["join"][1], 4),
                 "ds_range_scan_s": round(results["ds_range"][0], 4),
                 "ds_range_indexed_s": round(results["ds_range"][1], 4),
+                "zorder_scan_s": round(results["zorder"][0], 4),
+                "zorder_indexed_s": round(results["zorder"][1], 4),
+                "hybrid_scan_s": round(results["hybrid"][0], 4),
+                "hybrid_indexed_s": round(results["hybrid"][1], 4),
                 "index_build_s": round(build_s, 3),
                 "platform": _platform(),
             },

@@ -70,8 +70,11 @@ class FilterIndexRule:
         if hybrid_needed:
             from hyperspace_tpu.rules.hybrid import transform_plan_to_use_hybrid_scan
 
+            # Bucket pruning applies to the index PORTION of a hybrid scan
+            # too — only the appended raw files must always be read.
             new_plan = transform_plan_to_use_hybrid_scan(
-                self.session, plan, scan, best, bucket_union=False)
+                self.session, plan, scan, best, bucket_union=False,
+                prune_to_buckets=_bucket_pruning(filter_node.condition, best))
         else:
             prune = _bucket_pruning(filter_node.condition, best)
             use_bucket_spec = (self.session.conf.filter_rule_use_bucket_spec

@@ -103,14 +103,17 @@ def hybrid_file_lists(entry: IndexLogEntry, scan: Scan
 
 def transform_plan_to_use_hybrid_scan(session, plan: LogicalPlan, target: Scan,
                                       entry: IndexLogEntry,
-                                      bucket_union: bool) -> LogicalPlan:
+                                      bucket_union: bool,
+                                      prune_to_buckets=None) -> LogicalPlan:
     """RuleUtils.scala:302-443: build the merged index∪appended subtree and
-    swap it for ``target``."""
+    swap it for ``target``.  ``prune_to_buckets`` restricts the INDEX side's
+    buckets (the appended side is unbucketed raw data and always scans)."""
     appended, deleted = hybrid_file_lists(entry, target)
     visible_cols = entry.derived_dataset.all_columns
 
     index_side: LogicalPlan = Scan(rule_utils.index_scan_relation(
-        entry, use_bucket_spec=bucket_union))
+        entry, use_bucket_spec=bucket_union or prune_to_buckets is not None,
+        prune_to_buckets=prune_to_buckets))
     if deleted:
         # Filter(Not(In(lineage, deleted ids))) (RuleUtils.scala:399-408).
         deleted_ids = sorted({f.id for f in deleted})
