@@ -187,11 +187,13 @@ def main() -> None:
             b = b.select(cols).sort_by(keys)
             return a.equals(b)
 
-        def q_filter():
+        def ds_filter():
             return (session.read.parquet(lineitem_dir)
                     .filter(col("l_orderkey") == probe_key)
-                    .select("l_orderkey", "l_quantity")
-                    .collect())
+                    .select("l_orderkey", "l_quantity"))
+
+        def q_filter():
+            return ds_filter().collect()
 
         def q_join():
             orders = session.read.parquet(orders_dir)
@@ -202,31 +204,38 @@ def main() -> None:
                             "l_extendedprice")
                     .collect())
 
-        def q_zorder_second_dim():
+        def ds_zorder_second_dim():
             lo, hi = 2500.0, 3000.0
             return (session.read.parquet(lineitem_dir)
                     .filter((col("l_extendedprice") >= lo)
                             & (col("l_extendedprice") < hi))
-                    .select("l_shipdate", "l_extendedprice", "l_quantity")
-                    .collect())
+                    .select("l_shipdate", "l_extendedprice", "l_quantity"))
+
+        def q_zorder_second_dim():
+            return ds_zorder_second_dim().collect()
+
+        def ds_hybrid_delta():
+            return (session.read.delta(delta_dir)
+                    .filter(col("o_orderkey") == probe_key)
+                    .select("o_orderkey", "o_totalprice"))
 
         def q_hybrid_delta():
             session.conf.hybrid_scan_enabled = True
             try:
-                return (session.read.delta(delta_dir)
-                        .filter(col("o_orderkey") == probe_key)
-                        .select("o_orderkey", "o_totalprice").collect())
+                return ds_hybrid_delta().collect()
             finally:
                 session.conf.hybrid_scan_enabled = False
 
-        def q_ds_range():
+        def ds_ds_range():
             # BASELINE.json's data-skipping config: a date-range scan over
             # the wide table; min/max file pruning reads 1/8 of the files.
             lo, hi = 300_000, 390_000
             return (session.read.parquet(lineitem_dir)
                     .filter((col("l_shipdate") >= lo) & (col("l_shipdate") < hi))
-                    .select("l_shipdate", "l_extendedprice", "l_discount")
-                    .collect())
+                    .select("l_shipdate", "l_extendedprice", "l_discount"))
+
+        def q_ds_range():
+            return ds_ds_range().collect()
 
         results = {}
         for name, q in (("filter", q_filter), ("join", q_join),
@@ -250,34 +259,25 @@ def main() -> None:
 
         # Verify EVERY workload's rewrite actually fired — a silent
         # scan-vs-scan measurement must fail, not report ~1x as valid.
+        # Each check optimizes the SAME dataset builder the timing used,
+        # under the SAME optimizer configuration (hybrid flag included).
         session.enable_hyperspace()
-        checks = {
-            "filter": (session.read.parquet(lineitem_dir)
-                       .filter(col("l_orderkey") == probe_key)
-                       .select("l_orderkey", "l_quantity")),
-            "ds_range": (session.read.parquet(lineitem_dir)
-                         .filter((col("l_shipdate") >= 300_000)
-                                 & (col("l_shipdate") < 390_000))
-                         .select("l_shipdate", "l_extendedprice",
-                                 "l_discount")),
-            "zorder": (session.read.parquet(lineitem_dir)
-                       .filter((col("l_extendedprice") >= 2500.0)
-                               & (col("l_extendedprice") < 3000.0))
-                       .select("l_shipdate", "l_extendedprice",
-                               "l_quantity")),
-            "hybrid": None,
-        }
-        session.conf.hybrid_scan_enabled = True
-        checks["hybrid"] = (session.read.delta(delta_dir)
-                            .filter(col("o_orderkey") == probe_key)
-                            .select("o_orderkey", "o_totalprice"))
-        for name, ds in checks.items():
+
+        def assert_rewrites(name, ds):
             plan = ds.optimized_plan()
             used = [s for s in plan.leaf_relations()
                     if s.relation.index_scan_of or s.relation.data_skipping_of]
             if not used:
                 raise SystemExit(f"{name}: rewrite did not fire; bench invalid")
-        session.conf.hybrid_scan_enabled = False
+
+        assert_rewrites("filter", ds_filter())
+        assert_rewrites("ds_range", ds_ds_range())
+        assert_rewrites("zorder", ds_zorder_second_dim())
+        session.conf.hybrid_scan_enabled = True
+        try:
+            assert_rewrites("hybrid", ds_hybrid_delta())
+        finally:
+            session.conf.hybrid_scan_enabled = False
 
         speedups = {k: b / i for k, (b, i) in results.items()}
         geomean = math.exp(sum(math.log(s) for s in speedups.values())

@@ -18,7 +18,7 @@ Scan semantics:
 
 from __future__ import annotations
 
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 import pyarrow as pa
@@ -211,6 +211,9 @@ class Executor:
     def _join(self, plan: Join) -> pa.Table:
         from hyperspace_tpu.plan.expr import as_equi_join_pairs
 
+        bucketed = self._try_bucketed_join(plan)
+        if bucketed is not None:
+            return bucketed
         left = self.execute(plan.left)
         right = self.execute(plan.right)
         pairs = as_equi_join_pairs(plan.condition)
@@ -268,6 +271,102 @@ class Executor:
             lt = left.take(pa.array(merged["__li"].to_numpy()))
             rt = right.take(pa.array(merged["__ri"].to_numpy()))
         return _concat_horizontal(lt, rt)
+
+    # -- bucket-aligned join (the shuffle-free SMJ payoff on one chip) ------
+    def _try_bucketed_join(self, plan: Join) -> Optional[pa.Table]:
+        """When both sides are (Project|Filter)* chains over index scans
+        with MATCHING bucket specs on the join keys (what JoinIndexRule
+        constructs), execute and join bucket by bucket: equal keys can only
+        meet inside one bucket, so each per-bucket merge works on 1/B of the
+        data — the single-chip analog of Spark's exchange-free SMJ over
+        matching bucketSpecs (JoinIndexRule.scala:36-50)."""
+        from hyperspace_tpu.plan.expr import as_equi_join_pairs
+
+        pairs = as_equi_join_pairs(plan.condition)
+        if pairs is None or len(pairs) != 1:
+            return None
+        aligned = [_bucketed_chain(side) for side in (plan.left, plan.right)]
+        if any(a is None for a in aligned):
+            return None
+        (l_scan, l_wrap), (r_scan, r_wrap) = aligned
+        l_spec, r_spec = l_scan.relation.bucket_spec, r_scan.relation.bucket_spec
+        if l_spec[0] != r_spec[0]:
+            return None
+        a, b = pairs[0]
+        l_cols = tuple(c.lower() for c in l_spec[1])
+        r_cols = tuple(c.lower() for c in r_spec[1])
+        la, rb = a.lower(), b.lower()
+        if not ((l_cols == (la,) and r_cols == (rb,))
+                or (l_cols == (rb,) and r_cols == (la,))):
+            return None
+        # Bucket ids only align when both sides hashed the SAME bit
+        # patterns: an int64 key on one side and float64 on the other put
+        # equal VALUES in different buckets (to_hash_words hashes raw
+        # bits), while the plain join path matches them by value — so a
+        # type mismatch must fall back, or results silently change.
+        l_type = self.session.schema_map_of(l_scan).get(l_spec[1][0])
+        r_type = self.session.schema_map_of(r_scan).get(r_spec[1][0])
+        if l_type is None or r_type is None or l_type != r_type:
+            return None
+        l_by_bucket = _files_by_bucket(l_scan)
+        r_by_bucket = _files_by_bucket(r_scan)
+        if l_by_bucket is None or r_by_bucket is None:
+            return None
+        shared = sorted(set(l_by_bucket) & set(r_by_bucket))
+        if not shared:
+            return None  # rare: plain path produces the empty result with
+            # the correct joined schema
+        parts: List[pa.Table] = []
+        for bucket in shared:
+            sub = Join(
+                _rewrap(l_scan, l_wrap, l_by_bucket[bucket]),
+                _rewrap(r_scan, r_wrap, r_by_bucket[bucket]),
+                plan.condition, plan.how)
+            # _rewrap strips bucket_spec, so this recursion takes the plain
+            # per-bucket join path — no re-entry.
+            parts.append(self._join(sub))
+        return pa.concat_tables(parts, promote_options="default")
+
+
+def _bucketed_chain(node: LogicalPlan):
+    """(scan, wrappers) when ``node`` is a (Project|Filter)* chain over a
+    bucketed index scan with explicit file paths; None otherwise."""
+    wrappers: List[LogicalPlan] = []
+    while isinstance(node, (Project, Filter)):
+        wrappers.append(node)
+        node = node.children[0]
+    if isinstance(node, Scan) and node.relation.bucket_spec \
+            and node.relation.file_paths is not None \
+            and node.relation.index_scan_of:
+        return node, wrappers
+    return None
+
+
+def _files_by_bucket(scan: Scan):
+    """Bucket id -> files, honoring the scan's own bucket pruning (a
+    filter under the join may have restricted the buckets already)."""
+    allowed = None if scan.relation.prune_to_buckets is None \
+        else set(scan.relation.prune_to_buckets)
+    out: Dict[int, List[str]] = {}
+    for p in scan.relation.file_paths:
+        b = bucket_id_of_file(p)
+        if b is None:
+            return None
+        if allowed is not None and b not in allowed:
+            continue
+        out.setdefault(b, []).append(p)
+    return out
+
+
+def _rewrap(scan: Scan, wrappers, files) -> LogicalPlan:
+    import dataclasses as dc
+
+    rel = dc.replace(scan.relation, file_paths=tuple(files),
+                     bucket_spec=None, prune_to_buckets=None)
+    node: LogicalPlan = Scan(rel)
+    for w in reversed(wrappers):
+        node = w.with_children((node,))
+    return node
 
 
 def _concat_horizontal(left: pa.Table, right: pa.Table) -> pa.Table:
