@@ -72,7 +72,7 @@ class HyperspaceConf:
     source_providers: str = "default,delta,iceberg"
     signature_provider: str = "IndexSignatureProvider"
     event_logger: str = ""
-    supported_file_formats: str = "parquet,csv,json"
+    supported_file_formats: str = "parquet,csv,json,orc"
     # TPU data-plane tunable: kernel row dimensions are padded up to the
     # next multiple of this, so builds of different datasets share one
     # compiled program per capacity instead of paying a fresh XLA compile

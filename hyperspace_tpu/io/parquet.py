@@ -113,6 +113,14 @@ def _read_one(path: str, file_format: str, columns, options: Dict[str, str]) -> 
         import pyarrow.json as pajson
 
         table = pajson.read_json(path)
+    elif file_format == "orc":
+        import pyarrow.orc as paorc
+
+        if columns is not None:
+            present = set(paorc.ORCFile(path).schema.names)
+            return paorc.read_table(
+                path, columns=[c for c in columns if c in present])
+        return paorc.read_table(path)
     else:
         raise ValueError(f"Unsupported file format: {file_format!r}")
     if columns:
@@ -126,6 +134,11 @@ def read_schema(path: str, file_format: str = "parquet",
     if file_format == "parquet":
         schema = pq.read_schema(path)
         return {f.name: str(f.type) for f in schema}
+    if file_format == "orc":
+        import pyarrow.orc as paorc
+
+        # ORC footers carry the schema — no data read needed.
+        return {f.name: str(f.type) for f in paorc.ORCFile(path).schema}
     table = _read_one(path, file_format, None, options or {})
     return {f.name: str(f.type) for f in table.schema}
 

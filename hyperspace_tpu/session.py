@@ -42,6 +42,9 @@ class DataReader:
     def json(self, *paths: str, **options: str):
         return self._make("json", *paths, **options)
 
+    def orc(self, *paths: str, **options: str):
+        return self._make("orc", *paths, **options)
+
     def delta(self, path: str, **options: str):
         """Read a Delta table; ``versionAsOf``/``timestampAsOf`` options time
         travel (the df.read.format("delta") path of DeltaLakeIntegrationTest)."""

@@ -29,6 +29,17 @@ def _write_csv(root, n=50):
             f.write(f"{i},n{i}\n")
 
 
+def _write_orc(root, n=50):
+    import pyarrow as pa
+    import pyarrow.orc as paorc
+
+    os.makedirs(root)
+    paorc.write_table(pa.table({
+        "id": pa.array(list(range(n)), type=pa.int64()),
+        "name": pa.array([f"n{i}" for i in range(n)]),
+    }), os.path.join(root, "part-0.orc"))
+
+
 def _write_json(root, n=50):
     os.makedirs(root)
     with open(os.path.join(root, "part-0.json"), "w") as f:
@@ -37,7 +48,8 @@ def _write_json(root, n=50):
 
 
 @pytest.mark.parametrize("fmt,writer", [("csv", _write_csv),
-                                        ("json", _write_json)])
+                                        ("json", _write_json),
+                                        ("orc", _write_orc)])
 def test_index_lifecycle_over_format(session, tmp_path, fmt, writer):
     root = str(tmp_path / "data")
     writer(root)

@@ -96,7 +96,10 @@ def predicates(draw, depth=2):
     return (left & right) if kind == "and" else (left | right)
 
 
-@settings(max_examples=60, deadline=None,
+_EXAMPLES = int(os.environ.get("HS_FUZZ_EXAMPLES", "60"))
+
+
+@settings(max_examples=_EXAMPLES, deadline=None,
           suppress_health_check=[HealthCheck.function_scoped_fixture])
 @given(pred=predicates(), projection=st.sampled_from(
     [("a", "b"), ("a", "b", "f"), ("b", "f"), ("a",)]))
@@ -114,7 +117,7 @@ def test_filter_answer_equivalence(catalog, pred, projection):
             f"{ds.optimized_plan().tree_string()}")
 
 
-@settings(max_examples=20, deadline=None,
+@settings(max_examples=max(20, _EXAMPLES // 3), deadline=None,
           suppress_health_check=[HealthCheck.function_scoped_fixture])
 @given(pred=predicates(depth=1))
 def test_join_then_filter_equivalence(catalog, pred):
