@@ -31,7 +31,7 @@ from hyperspace_tpu.index.log_entry import IndexLogEntry, States
 from hyperspace_tpu.plan.expr import BinOp, Col, Expr, IsIn, Lit, split_conjuncts
 from hyperspace_tpu.plan.nodes import Filter, LogicalPlan, Project, Scan, ScanRelation
 from hyperspace_tpu.rules import rule_utils
-from hyperspace_tpu.rules.filter_rule import _extract_filter_node
+from hyperspace_tpu.rules.filter_rule import _extract_filter_nodes
 from hyperspace_tpu.telemetry.events import HyperspaceIndexUsageEvent, get_event_logger
 
 # In-process memo of loaded sketches keyed by the sketch files' identity
@@ -142,16 +142,22 @@ class DataSkippingFilterRule:
         self._entries = entries
 
     def apply(self, plan: LogicalPlan) -> LogicalPlan:
-        matched = _extract_filter_node(plan)
-        if matched is None:
-            return plan
+        """Prune EVERY matching filter site in one forward pass
+        (transform_up keeps untouched subtrees' identities)."""
+        for matched in _extract_filter_nodes(plan):
+            new_plan = self._try_apply(plan, matched)
+            if new_plan is not None:
+                plan = new_plan
+        return plan
+
+    def _try_apply(self, plan: LogicalPlan, matched) -> Optional[LogicalPlan]:
         scan, filter_node, _ = matched
         if rule_utils.is_index_applied(scan) or \
                 scan.relation.data_skipping_of is not None:
-            return plan
+            return None
         spm = self.session.source_provider_manager
         if not spm.is_supported_relation(scan):
-            return plan
+            return None
 
         entries = self._entries
         if entries is None:
@@ -159,7 +165,7 @@ class DataSkippingFilterRule:
                 [States.ACTIVE])
         ds_entries = [e for e in entries if not e.is_covering]
         if not ds_entries:
-            return plan
+            return None
 
         # Cheap predicate check FIRST: the file listing (a full directory
         # walk + stat) only happens when some entry can actually constrain.
@@ -170,7 +176,7 @@ class DataSkippingFilterRule:
             if constraints:
                 with_constraints.append((entry, constraints))
         if not with_constraints:
-            return plan
+            return None
 
         relation = spm.get_relation(scan)
         current = relation.all_files()
@@ -197,7 +203,7 @@ class DataSkippingFilterRule:
                 if best is None or len(surviving) < len(best[1]):
                     best = (entry, surviving)
         if best is None:
-            return plan
+            return None
         entry, surviving = best
         if not surviving:
             # Provably empty result; keep one file so the scan retains its

@@ -37,20 +37,29 @@ class FilterIndexRule:
         self._entries = entries
 
     def apply(self, plan: LogicalPlan) -> LogicalPlan:
-        matched = _extract_filter_node(plan)
-        if matched is None:
-            return plan
+        """Rewrite EVERY matching Filter-over-Scan site, not just the first
+        — a join of two filtered relations should use both sides' indexes.
+        One forward pass suffices: transform_up keeps untouched subtrees'
+        identities, so later matches still locate their nodes in the
+        rewritten plan."""
+        for matched in _extract_filter_nodes(plan):
+            new_plan = self._try_rewrite(plan, matched)
+            if new_plan is not None:
+                plan = new_plan
+        return plan
+
+    def _try_rewrite(self, plan: LogicalPlan, matched) -> Optional[LogicalPlan]:
         scan, filter_node, project_cols = matched
         if rule_utils.is_index_applied(scan):
-            return plan
+            return None
         if not self.session.source_provider_manager.is_supported_relation(scan):
-            return plan
+            return None
 
         schema = self.session.schema_of(scan)
         filter_cols = sorted(filter_node.condition.referenced_columns())
         output_cols = project_cols if project_cols is not None else schema
         if resolve(filter_cols, schema) is None:
-            return plan
+            return None
 
         entries = self._entries
         if entries is None:
@@ -59,7 +68,7 @@ class FilterIndexRule:
         covering = _find_covering_indexes(candidates, filter_cols, output_cols)
         best = rank_filter_indexes(covering, scan, self.session.conf.hybrid_scan_enabled)
         if best is None:
-            return plan
+            return None
 
         hybrid_needed = False
         if self.session.conf.hybrid_scan_enabled:
@@ -98,30 +107,38 @@ class FilterIndexRule:
         return new_plan
 
 
-def _extract_filter_node(plan: LogicalPlan
-                         ) -> Optional[Tuple[Scan, Filter, Optional[List[str]]]]:
-    """Match Project(Filter(Scan)) / Filter(Scan) (ExtractFilterNode,
-    FilterIndexRule.scala:158-186), seeing through a pruning Project directly
-    over the Scan (plan/pruning.py inserts those; Catalyst instead embeds
-    pruning in the relation, so the reference never needed this)."""
+def _extract_filter_nodes(plan: LogicalPlan
+                          ) -> List[Tuple[Scan, Filter, Optional[List[str]]]]:
+    """ALL Project(Filter(Scan)) / Filter(Scan) matches in the plan
+    (ExtractFilterNode, FilterIndexRule.scala:158-186), seeing through a
+    pruning Project directly over the Scan (plan/pruning.py inserts those;
+    Catalyst instead embeds pruning in the relation, so the reference never
+    needed this)."""
+    out: List[Tuple[Scan, Filter, Optional[List[str]]]] = []
+    claimed: Optional[LogicalPlan] = None  # Filter consumed by a
+    # Project-over-Filter match: skip re-matching it as a bare Filter.
     if isinstance(plan, Project) and isinstance(plan.child, Filter):
         scan = _scan_below(plan.child.child)
         if scan is not None:
-            return scan, plan.child, list(plan.columns)
-    if isinstance(plan, Filter):
+            out.append((scan, plan.child, list(plan.columns)))
+            claimed = plan.child
+    elif isinstance(plan, Filter):
         scan = _scan_below(plan.child)
         if scan is not None:
             # With no outer Project, the pruning Project (if any) defines the
             # output columns.
             cols = list(plan.child.columns) \
                 if isinstance(plan.child, Project) else None
-            return scan, plan, cols
-    # Recurse into children so filters under joins/unions also rewrite.
+            out.append((scan, plan, cols))
+    # Recurse so filters under joins/unions also rewrite; a claimed Filter
+    # is skipped itself but its interior is still searched.
     for child in plan.children:
-        hit = _extract_filter_node(child)
-        if hit is not None:
-            return hit
-    return None
+        if child is claimed:
+            for sub in child.children:
+                out.extend(_extract_filter_nodes(sub))
+        else:
+            out.extend(_extract_filter_nodes(child))
+    return out
 
 
 def _scan_below(node: LogicalPlan) -> Optional[Scan]:

@@ -351,3 +351,24 @@ class TestBucketedJoinExecution:
 
         assert canonical_rows(got) == canonical_rows(expected)
         assert got.num_rows > 0
+
+    def test_both_filtered_join_sides_rewrite(self, env, tmp_path):
+        """Multi-site rule application: a join of two filtered relations
+        uses both sides' indexes (not just the first matching site)."""
+        session, hs, _ = env
+        ld, rd = self._two_indexed_tables(session, hs, tmp_path)
+        session.enable_hyperspace()
+        ds = (session.read.parquet(ld).filter(col("k") >= 10)
+              .join(session.read.parquet(rd).filter(col("k") < 40),
+                    col("k") == col("k"))
+              .select("k", "lv", "rv"))
+        plan = ds.optimized_plan()
+        rewritten = [s for s in plan.leaf_relations()
+                     if s.relation.index_scan_of]
+        assert len(rewritten) == 2, plan.tree_string()
+        got = ds.collect()
+        session.disable_hyperspace()
+        expected = ds.collect()
+        from tests.utils import canonical_rows
+
+        assert canonical_rows(got) == canonical_rows(expected)
