@@ -58,6 +58,13 @@ def _null_col(c: str) -> str:
     return f"nulls__{c}"
 
 
+def _values_col(c: str) -> str:
+    return f"values__{c}"
+
+
+VALUE_LIST_MAX = 64  # beyond this, the list is null and min/max governs
+
+
 def _sketch_from_parquet_footer(path: str,
                                 columns: Sequence[str]) -> Optional[Dict]:
     """min/max/null counts from the Parquet footer's row-group statistics —
@@ -92,12 +99,18 @@ def _sketch_from_parquet_footer(path: str,
 def sketch_rows_for_files(files: Sequence[FileInfo], columns: Sequence[str],
                           read_format: str,
                           options: Dict[str, str],
-                          partition_roots: Optional[Sequence[str]] = None
+                          partition_roots: Optional[Sequence[str]] = None,
+                          sketch_types: Optional[Sequence[str]] = None
                           ) -> List[Dict]:
     """One sketch row per file: min/max/null-count per sketched column.
     Parquet files are sketched from footer statistics when available.
     Hive partition columns (constant per file, absent from the data) sketch
-    as min == max == the path value."""
+    as min == max == the path value.  Columns whose sketch type is
+    "ValueList" additionally record their distinct values when there are at
+    most VALUE_LIST_MAX of them (reading just that column)."""
+    types = list(sketch_types) if sketch_types is not None \
+        else ["MinMax"] * len(columns)
+    value_list_cols = [c for c, t in zip(columns, types) if t == "ValueList"]
     from hyperspace_tpu.io.partitions import (
         partition_spec_for_roots,
         partition_values,
@@ -126,6 +139,8 @@ def sketch_rows_for_files(files: Sequence[FileInfo], columns: Sequence[str],
                     stats[_null_col(c)] = stats[SKETCH_ROW_COUNT] \
                         if value is None else 0
             row.update(stats)
+            _add_value_lists(row, f, value_list_cols, read_format, options,
+                             partition_roots, spec)
             return row
         t = read_table([f.name], read_format, list(columns), options,
                        partition_roots=partition_roots, partition_spec=spec)
@@ -141,6 +156,9 @@ def sketch_rows_for_files(files: Sequence[FileInfo], columns: Sequence[str],
                 row[_min_col(c)] = mm["min"].as_py()
                 row[_max_col(c)] = mm["max"].as_py()
                 row[_null_col(c)] = col.null_count
+        for c in value_list_cols:
+            col = t.column(c) if c in t.column_names else None
+            row[_values_col(c)] = _distinct_or_none(col)
         return row
 
     from hyperspace_tpu.utils.parallel_map import parallel_map_ordered
@@ -148,6 +166,28 @@ def sketch_rows_for_files(files: Sequence[FileInfo], columns: Sequence[str],
     # Low worker cap: the non-parquet fallback materializes a full table per
     # in-flight file, so concurrency multiplies peak memory.
     return parallel_map_ordered(sketch_one, list(files), max_workers=4)
+
+
+def _distinct_or_none(col) -> Optional[List]:
+    """Sorted distinct non-null values, or None when absent/too many."""
+    if col is None:
+        return None
+    vals = pc.unique(col).drop_null()
+    if len(vals) > VALUE_LIST_MAX:
+        return None
+    return sorted(vals.to_pylist())
+
+
+def _add_value_lists(row: Dict, f: FileInfo, value_list_cols: Sequence[str],
+                     read_format: str, options: Dict[str, str],
+                     partition_roots, spec) -> None:
+    if not value_list_cols:
+        return
+    t = read_table([f.name], read_format, list(value_list_cols), options,
+                   partition_roots=partition_roots, partition_spec=spec)
+    for c in value_list_cols:
+        col = t.column(c) if c in t.column_names else None
+        row[_values_col(c)] = _distinct_or_none(col)
 
 
 def write_index_file_sketch(out_dir: str, columns: Sequence[str]) -> None:
@@ -189,7 +229,8 @@ class CreateDataSkippingAction(CreateActionBase):
         schema = self._relation().schema()
         sketched = resolve_or_raise(self.config.sketched_columns, schema,
                                     "sketched column")
-        return DataSkippingIndexConfig(self.config.index_name, sketched)
+        return DataSkippingIndexConfig(self.config.index_name, sketched,
+                                       self.config.sketch_types)
 
     def validate(self) -> None:
         if self.previous_log_entry is not None and \
@@ -216,7 +257,8 @@ class CreateDataSkippingAction(CreateActionBase):
         rows = list(carry_rows or [])
         rows.extend(sketch_rows_for_files(
             files, resolved.sketched_columns, relation.read_format,
-            relation.options, partition_roots=relation.root_paths))
+            relation.options, partition_roots=relation.root_paths,
+            sketch_types=resolved.sketch_types))
         if not rows:
             raise HyperspaceError("No source data files to sketch")
         version = self.data_manager.get_next_version()
@@ -230,7 +272,7 @@ class CreateDataSkippingAction(CreateActionBase):
         resolved = self._resolved_config()
         return DataSkippingIndex(
             sketched_columns=resolved.sketched_columns,
-            sketch_types=["MinMax"] * len(resolved.sketched_columns),
+            sketch_types=list(resolved.sketch_types),
             schema=getattr(self, "_index_schema", {}),
         )
 
@@ -300,7 +342,8 @@ class RefreshDataSkippingAction(CreateDataSkippingAction):
             options=tuple(sorted(rel_meta.options.items())),
         ))
         config = DataSkippingIndexConfig(
-            prev.name, prev.derived_dataset.sketched_columns)
+            prev.name, prev.derived_dataset.sketched_columns,
+            prev.derived_dataset.sketch_types)
         super().__init__(log_manager, data_manager, session, plan, config)
         self.event_class = RefreshActionEvent
         self._previous_entry = prev

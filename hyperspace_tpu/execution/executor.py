@@ -316,15 +316,20 @@ class Executor:
         if not shared:
             return None  # rare: plain path produces the empty result with
             # the correct joined schema
-        parts: List[pa.Table] = []
-        for bucket in shared:
+        def join_bucket(bucket: int) -> pa.Table:
             sub = Join(
                 _rewrap(l_scan, l_wrap, l_by_bucket[bucket]),
                 _rewrap(r_scan, r_wrap, r_by_bucket[bucket]),
                 plan.condition, plan.how)
             # _rewrap strips bucket_spec, so this recursion takes the plain
             # per-bucket join path — no re-entry.
-            parts.append(self._join(sub))
+            return self._join(sub)
+
+        from hyperspace_tpu.utils.parallel_map import parallel_map_ordered
+
+        # Buckets are independent; parquet decode + numpy merge release the
+        # GIL.  Low cap: each in-flight bucket holds both sides + output.
+        parts = parallel_map_ordered(join_bucket, shared, max_workers=4)
         return pa.concat_tables(parts, promote_options="default")
 
 

@@ -8,7 +8,7 @@ columns across the two lists, case-insensitive) and a builder-style API.
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Sequence
+from typing import List, Optional, Sequence
 
 from hyperspace_tpu.exceptions import HyperspaceError
 
@@ -81,19 +81,35 @@ class IndexConfig:
         return list(self.indexed_columns) + list(self.included_columns)
 
 
+SKETCH_TYPES = ("MinMax", "ValueList")
+
+
 @dataclasses.dataclass(frozen=True)
 class DataSkippingIndexConfig:
     """Spec for a data-skipping index: per-source-file sketches over
-    ``sketched_columns`` (min/max today).  Unlike the covering index, no
-    data is copied — queries scan the source with a pruned file list."""
+    ``sketched_columns``.  Unlike the covering index, no data is copied —
+    queries scan the source with a pruned file list.
+
+    Per-column sketch families:
+      - "MinMax" (default): value range from Parquet footers — O(footer)
+        build, prunes range and point predicates on clustered columns.
+      - "ValueList": the distinct values when few (<=64) — reads the column
+        at build, prunes EQUALITY/IN on low-cardinality columns whose
+        min/max spans everything (category/status columns)."""
 
     index_name: str
     sketched_columns: List[str]
+    sketch_types: List[str] = dataclasses.field(default_factory=list)
 
     def __init__(self, index_name: str,
-                 sketched_columns: Sequence[str]) -> None:
+                 sketched_columns: Sequence[str],
+                 sketch_types: Optional[Sequence[str]] = None) -> None:
         object.__setattr__(self, "index_name", index_name)
         object.__setattr__(self, "sketched_columns", list(sketched_columns))
+        object.__setattr__(
+            self, "sketch_types",
+            list(sketch_types) if sketch_types is not None
+            else ["MinMax"] * len(self.sketched_columns))
         self._validate()
 
     def _validate(self) -> None:
@@ -104,6 +120,13 @@ class DataSkippingIndexConfig:
         lowered = [c.lower() for c in self.sketched_columns]
         if len(set(lowered)) != len(lowered):
             raise HyperspaceError("Duplicate sketched column names are not allowed")
+        if len(self.sketch_types) != len(self.sketched_columns):
+            raise HyperspaceError(
+                "sketch_types must match sketched_columns in length")
+        bad = [t for t in self.sketch_types if t not in SKETCH_TYPES]
+        if bad:
+            raise HyperspaceError(
+                f"Unknown sketch type(s) {bad}; expected {SKETCH_TYPES}")
 
     # Case-insensitive equality/hash — the same contract as IndexConfig
     # (IndexConfig.scala:55-66); the generated dataclass pair would be
@@ -113,8 +136,10 @@ class DataSkippingIndexConfig:
             return NotImplemented
         return (self.index_name.lower() == other.index_name.lower()
                 and [c.lower() for c in self.sketched_columns]
-                == [c.lower() for c in other.sketched_columns])
+                == [c.lower() for c in other.sketched_columns]
+                and self.sketch_types == other.sketch_types)
 
     def __hash__(self) -> int:
         return hash((self.index_name.lower(),
-                     tuple(c.lower() for c in self.sketched_columns)))
+                     tuple(c.lower() for c in self.sketched_columns),
+                     tuple(self.sketch_types)))

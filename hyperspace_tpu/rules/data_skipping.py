@@ -25,6 +25,7 @@ from hyperspace_tpu.actions.data_skipping import (
     SKETCH_FILE_SIZE,
     _max_col,
     _min_col,
+    _values_col,
     read_sketch,
 )
 from hyperspace_tpu.index.log_entry import IndexLogEntry, States
@@ -68,15 +69,21 @@ class _Constraint:
         vs = set(values)
         self.values = vs if self.values is None else self.values & vs
 
-    def file_may_match(self, fmin, fmax) -> bool:
+    def file_may_match(self, fmin, fmax, fvalues=None) -> bool:
         """Could a file with non-null range [fmin, fmax] hold a matching
         row?  ``None`` min/max means the file has no non-null values — no
-        predicate matches null, so it cannot."""
+        predicate matches null, so it cannot.  ``fvalues`` is the file's
+        ValueList sketch (complete distinct set) when recorded: an
+        equality/IN constraint then prunes by exact membership, which bites
+        on low-cardinality columns whose min/max spans everything."""
         if fmin is None or fmax is None:
             return False
         try:
             if self.values is not None:
-                if not any(fmin <= v <= fmax for v in self.values):
+                if fvalues is not None:
+                    if not (set(fvalues) & self.values):
+                        return False
+                elif not any(fmin <= v <= fmax for v in self.values):
                     return False
             if self.lo is not None:
                 if fmax < self.lo or (self.lo_open and fmax == self.lo):
@@ -143,14 +150,17 @@ class DataSkippingFilterRule:
 
     def apply(self, plan: LogicalPlan) -> LogicalPlan:
         """Prune EVERY matching filter site in one forward pass
-        (transform_up keeps untouched subtrees' identities)."""
+        (transform_up keeps untouched subtrees' identities; the session
+        uniquifies the plan, so identity swaps touch exactly one site)."""
+        files_memo: Dict = {}  # relation value -> listed files, per pass
         for matched in _extract_filter_nodes(plan):
-            new_plan = self._try_apply(plan, matched)
+            new_plan = self._try_apply(plan, matched, files_memo)
             if new_plan is not None:
                 plan = new_plan
         return plan
 
-    def _try_apply(self, plan: LogicalPlan, matched) -> Optional[LogicalPlan]:
+    def _try_apply(self, plan: LogicalPlan, matched,
+                   files_memo: Dict) -> Optional[LogicalPlan]:
         scan, filter_node, _ = matched
         if rule_utils.is_index_applied(scan) or \
                 scan.relation.data_skipping_of is not None:
@@ -178,8 +188,10 @@ class DataSkippingFilterRule:
         if not with_constraints:
             return None
 
-        relation = spm.get_relation(scan)
-        current = relation.all_files()
+        memo_key = scan.relation
+        if memo_key not in files_memo:
+            files_memo[memo_key] = spm.get_relation(scan).all_files()
+        current = files_memo[memo_key]
         best: Optional[Tuple[IndexLogEntry, List[str]]] = None
         for entry, constraints in with_constraints:
             sketch_by_key = {
@@ -195,7 +207,8 @@ class DataSkippingFilterRule:
                     continue
                 ok = all(
                     c.file_may_match(row.get(_min_col(col)),
-                                     row.get(_max_col(col)))
+                                     row.get(_max_col(col)),
+                                     row.get(_values_col(col)))
                     for col, c in constraints.items())
                 if ok:
                     surviving.append(f.name)

@@ -178,6 +178,12 @@ class HyperspaceSession:
 
         self._lake_schema_memo = {}
         try:
+            # Reused Dataset objects make the user's plan a DAG (one Scan
+            # object under several branches).  Every rewrite below swaps
+            # nodes BY IDENTITY, which on a DAG would install one branch's
+            # pruning into its siblings — so first rebuild the plan as a
+            # tree with a distinct node object per occurrence.
+            plan = _uniquify(plan)
             plan = prune_columns(plan, self.schema_of)
             if not self._hyperspace_enabled:
                 return plan
@@ -201,3 +207,12 @@ class HyperspaceSession:
             return plan
         finally:
             self._lake_schema_memo = None
+
+
+def _uniquify(plan: LogicalPlan) -> LogicalPlan:
+    """A structurally identical plan in which no node object appears twice
+    (frozen ScanRelation values stay shared — only plan NODES are remade)."""
+    new_children = tuple(_uniquify(c) for c in plan.children)
+    if isinstance(plan, Scan):
+        return Scan(plan.relation)
+    return plan.with_children(new_children)

@@ -273,3 +273,113 @@ class TestMutation:
         assert session.index_collection_manager.get_index("ds1") is None \
             or session.index_collection_manager.get_index("ds1").state \
             == "DOESNOTEXIST"
+
+
+class TestValueListSketch:
+    def test_value_list_prunes_where_minmax_cannot(self, session, tmp_path):
+        """Low-cardinality categorical data interleaved so every file's
+        min/max spans the whole domain — only the distinct-value sketch can
+        prune equality probes."""
+        root = str(tmp_path / "data")
+        os.makedirs(root)
+        # File i holds categories {2i, 2i+1} PLUS the extremes 0 and 99, so
+        # min/max is [0, 99] for every file.
+        for i in range(4):
+            cats = [0, 99, 2 * i, 2 * i + 1] * 25
+            pq.write_table(pa.table({
+                "cat": pa.array(cats, type=pa.int64()),
+                "v": pa.array(np.arange(100, dtype=np.int64)),
+            }), os.path.join(root, f"part-{i:05d}.parquet"))
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(root),
+                        DataSkippingIndexConfig("vls", ["cat"],
+                                                ["ValueList"]))
+        entry = session.index_collection_manager.get_index("vls")
+        assert entry.derived_dataset.sketch_types == ["ValueList"]
+        from hyperspace_tpu.actions.data_skipping import read_sketch
+
+        sketch = read_sketch(entry)
+        assert "values__cat" in sketch.column_names
+        session.enable_hyperspace()
+        # cat == 5 lives only in file 2 ({0,99,4,5}).
+        ds = (session.read.parquet(root)
+              .filter(col("cat") == 5).select("cat", "v"))
+        plan = ds.optimized_plan()
+        scans = [s for s in plan.leaf_relations()
+                 if s.relation.data_skipping_of]
+        assert scans and scans[0].relation.data_skipping_stats == (1, 4), \
+            plan.tree_string()
+        got = ds.collect()
+        session.disable_hyperspace()
+        from tests.utils import canonical_rows
+
+        assert canonical_rows(got) == canonical_rows(ds.collect())
+
+    def test_high_cardinality_falls_back_to_minmax(self, session, tmp_path):
+        """>64 distincts: the list is null and min/max governs (still
+        correct, range pruning still applies)."""
+        root = str(tmp_path / "data")
+        os.makedirs(root)
+        for i in range(2):
+            pq.write_table(pa.table({
+                "k": pa.array(np.arange(i * 1000, (i + 1) * 1000,
+                                        dtype=np.int64)),
+            }), os.path.join(root, f"part-{i:05d}.parquet"))
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(root),
+                        DataSkippingIndexConfig("hc", ["k"], ["ValueList"]))
+        from hyperspace_tpu.actions.data_skipping import read_sketch
+
+        entry = session.index_collection_manager.get_index("hc")
+        sketch = read_sketch(entry)
+        assert all(v is None
+                   for v in sketch.column("values__k").to_pylist())
+        session.enable_hyperspace()
+        ds = session.read.parquet(root).filter(col("k") == 1500).select("k")
+        plan = ds.optimized_plan()
+        scans = [s for s in plan.leaf_relations()
+                 if s.relation.data_skipping_of]
+        assert scans and scans[0].relation.data_skipping_stats == (1, 2)
+        assert ds.collect().num_rows == 1
+
+    def test_bad_sketch_type_rejected(self):
+        from hyperspace_tpu.exceptions import HyperspaceError
+
+        with pytest.raises(HyperspaceError, match="Unknown sketch type"):
+            DataSkippingIndexConfig("x", ["a"], ["Bloom"])
+        with pytest.raises(HyperspaceError, match="length"):
+            DataSkippingIndexConfig("x", ["a", "b"], ["MinMax"])
+
+
+class TestSharedScanObjects:
+    def test_reused_dataset_branches_prune_independently(
+            self, session, tmp_path):
+        """A reused Dataset makes the plan a DAG (one Scan object under two
+        join branches); each branch must get ITS OWN pruning — one branch's
+        file list must never be installed into its sibling."""
+        root = str(tmp_path / "data")
+        _write_partitioned(root)  # ids 0..499 over 5 disjoint files
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(root),
+                        DataSkippingIndexConfig("ds1", ["id"]))
+        session.enable_hyperspace()
+        base = session.read.parquet(root)  # ONE Dataset, reused
+        ds = (base.filter(col("id") < 10)
+              .join(base.filter(col("id") >= 490), col("id") == col("id"))
+              .select("id"))
+        got = ds.collect()
+        session.disable_hyperspace()
+        expected = ds.collect()
+        # Disjoint halves: the self-join on id matches nothing, but BOTH
+        # branches must have read their own files (the bug returned one
+        # branch's rows pruned by the other's predicate).
+        assert got.num_rows == expected.num_rows == 0
+        # And overlapping case returns real rows identically.
+        session.enable_hyperspace()
+        ds2 = (base.filter(col("id") < 200)
+               .join(base.filter(col("id") >= 100), col("id") == col("id"))
+               .select("id"))
+        got2 = ds2.collect()
+        session.disable_hyperspace()
+        expected2 = ds2.collect()
+        assert got2.num_rows == expected2.num_rows == 100
