@@ -62,7 +62,50 @@ def _values_col(c: str) -> str:
     return f"values__{c}"
 
 
+def _bloom_col(c: str) -> str:
+    return f"bloom__{c}"
+
+
 VALUE_LIST_MAX = 64  # beyond this, the list is null and min/max governs
+BLOOM_BITS = 8192    # 1 KiB per file per column: ~0.3% false
+# positives at 500 distincts with 4 hashes
+BLOOM_HASHES = 4
+
+
+def bloom_positions(values_array) -> "np.ndarray":
+    """Bit positions for each value of an arrow array — shared by build and
+    probe so membership can never false-negative.  Double hashing over the
+    engine's canonical hash words (io/columnar.to_hash_words), which already
+    makes equal VALUES hash equal across chunking/encodings."""
+    import numpy as np
+
+    from hyperspace_tpu.io.columnar import to_hash_words
+
+    words = np.asarray(to_hash_words(values_array), dtype=np.uint64)
+    h1, h2 = words[:, 0], words[:, 1] | np.uint64(1)  # odd step
+    i = np.arange(BLOOM_HASHES, dtype=np.uint64)[:, None]
+    return ((h1[None, :] + i * h2[None, :]) % np.uint64(BLOOM_BITS)).T
+
+
+def _bloom_bytes(col) -> Optional[bytes]:
+    """Bloom filter over the column's distinct non-null values."""
+    import numpy as np
+
+    if col is None:
+        return None
+    vals = pc.unique(col).drop_null()
+    bits = np.zeros(BLOOM_BITS, dtype=bool)
+    if len(vals):
+        bits[bloom_positions(vals).ravel()] = True
+    return np.packbits(bits).tobytes()
+
+
+def bloom_may_contain(bloom: bytes, probe_positions) -> bool:
+    """True when every hash position of SOME probe value is set."""
+    import numpy as np
+
+    bits = np.unpackbits(np.frombuffer(bloom, dtype=np.uint8)).astype(bool)
+    return bool(np.all(bits[probe_positions], axis=1).any())
 
 
 def _sketch_from_parquet_footer(path: str,
@@ -111,6 +154,7 @@ def sketch_rows_for_files(files: Sequence[FileInfo], columns: Sequence[str],
     types = list(sketch_types) if sketch_types is not None \
         else ["MinMax"] * len(columns)
     value_list_cols = [c for c, t in zip(columns, types) if t == "ValueList"]
+    bloom_cols = [c for c, t in zip(columns, types) if t == "BloomFilter"]
     from hyperspace_tpu.io.partitions import (
         partition_spec_for_roots,
         partition_values,
@@ -139,8 +183,8 @@ def sketch_rows_for_files(files: Sequence[FileInfo], columns: Sequence[str],
                     stats[_null_col(c)] = stats[SKETCH_ROW_COUNT] \
                         if value is None else 0
             row.update(stats)
-            _add_value_lists(row, f, value_list_cols, read_format, options,
-                             partition_roots, spec)
+            _add_data_sketches(row, f, value_list_cols, bloom_cols,
+                               read_format, options, partition_roots, spec)
             return row
         t = read_table([f.name], read_format, list(columns), options,
                        partition_roots=partition_roots, partition_spec=spec)
@@ -156,9 +200,7 @@ def sketch_rows_for_files(files: Sequence[FileInfo], columns: Sequence[str],
                 row[_min_col(c)] = mm["min"].as_py()
                 row[_max_col(c)] = mm["max"].as_py()
                 row[_null_col(c)] = col.null_count
-        for c in value_list_cols:
-            col = t.column(c) if c in t.column_names else None
-            row[_values_col(c)] = _distinct_or_none(col)
+        _fill_data_sketches(row, t, value_list_cols, bloom_cols)
         return row
 
     from hyperspace_tpu.utils.parallel_map import parallel_map_ordered
@@ -178,16 +220,28 @@ def _distinct_or_none(col) -> Optional[List]:
     return sorted(vals.to_pylist())
 
 
-def _add_value_lists(row: Dict, f: FileInfo, value_list_cols: Sequence[str],
-                     read_format: str, options: Dict[str, str],
-                     partition_roots, spec) -> None:
-    if not value_list_cols:
-        return
-    t = read_table([f.name], read_format, list(value_list_cols), options,
-                   partition_roots=partition_roots, partition_spec=spec)
+def _fill_data_sketches(row: Dict, t, value_list_cols: Sequence[str],
+                        bloom_cols: Sequence[str]) -> None:
+    """One home for the data-reading sketch families (ValueList, Bloom)."""
     for c in value_list_cols:
         col = t.column(c) if c in t.column_names else None
         row[_values_col(c)] = _distinct_or_none(col)
+    for c in bloom_cols:
+        col = t.column(c) if c in t.column_names else None
+        row[_bloom_col(c)] = _bloom_bytes(col)
+
+
+def _add_data_sketches(row: Dict, f: FileInfo,
+                       value_list_cols: Sequence[str],
+                       bloom_cols: Sequence[str],
+                       read_format: str, options: Dict[str, str],
+                       partition_roots, spec) -> None:
+    wanted = list(value_list_cols) + list(bloom_cols)
+    if not wanted:
+        return
+    t = read_table([f.name], read_format, wanted, options,
+                   partition_roots=partition_roots, partition_spec=spec)
+    _fill_data_sketches(row, t, value_list_cols, bloom_cols)
 
 
 def write_index_file_sketch(out_dir: str, columns: Sequence[str]) -> None:

@@ -81,7 +81,7 @@ class IndexConfig:
         return list(self.indexed_columns) + list(self.included_columns)
 
 
-SKETCH_TYPES = ("MinMax", "ValueList")
+SKETCH_TYPES = ("MinMax", "ValueList", "BloomFilter")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -95,7 +95,10 @@ class DataSkippingIndexConfig:
         build, prunes range and point predicates on clustered columns.
       - "ValueList": the distinct values when few (<=64) — reads the column
         at build, prunes EQUALITY/IN on low-cardinality columns whose
-        min/max spans everything (category/status columns)."""
+        min/max spans everything (category/status columns).
+      - "BloomFilter": an 8192-bit bloom over the distinct values — reads
+        the column at build, prunes EQUALITY/IN at ANY cardinality with
+        false positives only (never false negatives)."""
 
     index_name: str
     sketched_columns: List[str]

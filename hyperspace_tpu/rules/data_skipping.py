@@ -23,9 +23,12 @@ from hyperspace_tpu.actions.data_skipping import (
     SKETCH_FILE_MTIME,
     SKETCH_FILE_NAME,
     SKETCH_FILE_SIZE,
+    _bloom_col,
     _max_col,
     _min_col,
     _values_col,
+    bloom_may_contain,
+    bloom_positions,
     read_sketch,
 )
 from hyperspace_tpu.index.log_entry import IndexLogEntry, States
@@ -69,21 +72,15 @@ class _Constraint:
         vs = set(values)
         self.values = vs if self.values is None else self.values & vs
 
-    def file_may_match(self, fmin, fmax, fvalues=None) -> bool:
+    def file_may_match(self, fmin, fmax) -> bool:
         """Could a file with non-null range [fmin, fmax] hold a matching
         row?  ``None`` min/max means the file has no non-null values — no
-        predicate matches null, so it cannot.  ``fvalues`` is the file's
-        ValueList sketch (complete distinct set) when recorded: an
-        equality/IN constraint then prunes by exact membership, which bites
-        on low-cardinality columns whose min/max spans everything."""
+        predicate matches null, so it cannot."""
         if fmin is None or fmax is None:
             return False
         try:
             if self.values is not None:
-                if fvalues is not None:
-                    if not (set(fvalues) & self.values):
-                        return False
-                elif not any(fmin <= v <= fmax for v in self.values):
+                if not any(fmin <= v <= fmax for v in self.values):
                     return False
             if self.lo is not None:
                 if fmax < self.lo or (self.lo_open and fmax == self.lo):
@@ -128,6 +125,55 @@ def extract_constraints(condition: Expr,
             if c is not None:
                 c.add_values(conj.values)
     return out
+
+
+class _TypedProbe:
+    """The constraint's equality/IN probe values COERCED to the sketched
+    column's stored type — the same coercion execution applies to literals
+    (executor's _arrow_eval cast), so membership tests agree with what a
+    scan would actually match.  Uncoercible probes disable value-based
+    pruning for the column (always conservative)."""
+
+    def __init__(self, values=None, positions=None) -> None:
+        self.values = values        # set of typed python values, or None
+        self.positions = positions  # bloom bit positions, or None
+
+
+def _typed_probe(entry: IndexLogEntry, col_name: str,
+                 constraint: _Constraint, sketch_type: str) -> _TypedProbe:
+    if not constraint.values:
+        return _TypedProbe()
+    type_str = entry.derived_dataset.schema.get(col_name)
+    if not type_str:
+        return _TypedProbe()
+    import pyarrow as pa
+
+    from hyperspace_tpu.io.parquet import _dtype_from_string
+
+    try:
+        arr = pa.array(sorted(constraint.values, key=repr),
+                       type=_dtype_from_string(type_str))
+    except (pa.ArrowInvalid, pa.ArrowTypeError, ValueError, TypeError):
+        return _TypedProbe()
+    positions = bloom_positions(arr) if sketch_type == "BloomFilter" else None
+    return _TypedProbe(set(arr.to_pylist()), positions)
+
+
+def _file_ok(row: dict, col_name: str, constraint: _Constraint,
+             probe: _TypedProbe) -> bool:
+    fvalues = row.get(_values_col(col_name))
+    if constraint.values is not None and fvalues is not None \
+            and probe.values is not None:
+        if not (set(fvalues) & probe.values):
+            return False
+    if not constraint.file_may_match(row.get(_min_col(col_name)),
+                                     row.get(_max_col(col_name))):
+        return False
+    bloom = row.get(_bloom_col(col_name))
+    if bloom is not None and probe.positions is not None \
+            and not bloom_may_contain(bloom, probe.positions):
+        return False
+    return True
 
 
 def _sketch_rows(entry: IndexLogEntry) -> List[dict]:
@@ -199,17 +245,19 @@ class DataSkippingFilterRule:
                  r[SKETCH_FILE_MTIME]): r
                 for r in _sketch_rows(entry)
             }
+            type_by_col = dict(zip(entry.derived_dataset.sketched_columns,
+                                   entry.derived_dataset.sketch_types))
+            probes = {col: _typed_probe(entry, col, c,
+                                        type_by_col.get(col, "MinMax"))
+                      for col, c in constraints.items()}
             surviving: List[str] = []
             for f in current:
                 row = sketch_by_key.get((f.name, f.size, f.mtime))
                 if row is None:
                     surviving.append(f.name)  # unknown to the sketch: keep
                     continue
-                ok = all(
-                    c.file_may_match(row.get(_min_col(col)),
-                                     row.get(_max_col(col)),
-                                     row.get(_values_col(col)))
-                    for col, c in constraints.items())
+                ok = all(_file_ok(row, col, c, probes[col])
+                         for col, c in constraints.items())
                 if ok:
                     surviving.append(f.name)
             if len(surviving) < len(current):

@@ -383,3 +383,82 @@ class TestSharedScanObjects:
         session.disable_hyperspace()
         expected2 = ds2.collect()
         assert got2.num_rows == expected2.num_rows == 100
+
+
+class TestBloomFilterSketch:
+    def test_bloom_prunes_high_cardinality_equality(self, session, tmp_path):
+        """Interleaved high-cardinality string ids: min/max spans every
+        file and >64 distincts defeat ValueList — only the bloom prunes."""
+        root = str(tmp_path / "data")
+        os.makedirs(root)
+        for i in range(4):
+            ids = [f"user-{i:02d}-{j:04d}" for j in range(500)]
+            ids += ["aaa", "zzz"]  # force identical min/max everywhere
+            pq.write_table(pa.table({
+                "uid": pa.array(ids),
+                "v": pa.array(np.arange(len(ids), dtype=np.int64)),
+            }), os.path.join(root, f"part-{i:05d}.parquet"))
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(root),
+                        DataSkippingIndexConfig("bf", ["uid"],
+                                                ["BloomFilter"]))
+        from hyperspace_tpu.actions.data_skipping import read_sketch
+
+        sketch = read_sketch(session.index_collection_manager.get_index("bf"))
+        assert "bloom__uid" in sketch.column_names
+        assert all(len(b) == 1024 for b in sketch.column("bloom__uid").to_pylist())
+        session.enable_hyperspace()
+        ds = (session.read.parquet(root)
+              .filter(col("uid") == "user-02-0123").select("uid", "v"))
+        plan = ds.optimized_plan()
+        scans = _ds_scans(plan)
+        assert scans, plan.tree_string()
+        kept, total = scans[0].relation.data_skipping_stats
+        assert total == 4 and kept <= 2, (kept, total)  # fp-rate slack
+        got = ds.collect()
+        session.disable_hyperspace()
+        assert got.equals(ds.collect())
+        assert got.num_rows == 1
+
+    def test_bloom_never_false_negative(self, session, tmp_path):
+        """Every existing key must be found through the bloom — sweep a
+        sample of keys across all files."""
+        root = str(tmp_path / "data")
+        os.makedirs(root)
+        rng = np.random.default_rng(8)
+        for i in range(3):
+            pq.write_table(pa.table({
+                "k": pa.array(rng.integers(0, 1_000_000, 400),
+                              type=pa.int64()),
+            }), os.path.join(root, f"part-{i:05d}.parquet"))
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(root),
+                        DataSkippingIndexConfig("bfk", ["k"],
+                                                ["BloomFilter"]))
+        session.enable_hyperspace()
+        all_keys = (session.read.parquet(root).select("k").collect()
+                    .column("k").to_pylist())
+        for probe in all_keys[::97]:
+            got = (session.read.parquet(root)
+                   .filter(col("k") == probe).select("k").collect())
+            assert got.num_rows >= 1, probe
+
+    def test_string_literal_probe_coerces_like_execution(
+            self, session, tmp_path):
+        """A string literal against an int ValueList column must prune the
+        way execution matches (coerced), never drop matching files."""
+        root = str(tmp_path / "data")
+        os.makedirs(root)
+        for i in range(3):
+            pq.write_table(pa.table({
+                "cat": pa.array([0, 99, i], type=pa.int64()),
+            }), os.path.join(root, f"part-{i:05d}.parquet"))
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(root),
+                        DataSkippingIndexConfig("c", ["cat"], ["ValueList"]))
+        session.enable_hyperspace()
+        ds = session.read.parquet(root).filter(col("cat") == "1").select("cat")
+        got = ds.collect()
+        session.disable_hyperspace()
+        expected = ds.collect()
+        assert got.num_rows == expected.num_rows == 1
