@@ -93,7 +93,87 @@ def write_delta(table: pa.Table, path: str, mode: str = "append") -> int:
                                    "operation": "WRITE",
                                    "operationParameters": {"mode": mode}}})
     log.write_commit(version, actions)
+    _maybe_checkpoint(log, version)
     return version
+
+
+CHECKPOINT_INTERVAL = 10  # delta-core's default checkpoint cadence
+
+
+_CHECKPOINT_SCHEMA = pa.schema([
+    ("protocol", pa.struct([("minReaderVersion", pa.int32()),
+                            ("minWriterVersion", pa.int32())])),
+    ("metaData", pa.struct([
+        ("id", pa.string()),
+        ("format", pa.struct([("provider", pa.string())])),
+        ("schemaString", pa.string()),
+        ("partitionColumns", pa.list_(pa.string())),
+        ("configuration", pa.map_(pa.string(), pa.string())),
+        ("createdTime", pa.int64()),
+    ])),
+    ("add", pa.struct([
+        ("path", pa.string()),
+        ("partitionValues", pa.map_(pa.string(), pa.string())),
+        ("size", pa.int64()),
+        ("modificationTime", pa.int64()),
+        ("dataChange", pa.bool_()),
+    ])),
+])
+
+
+def _maybe_checkpoint(log: DeltaLog, version: int) -> None:
+    """Write ``version.checkpoint.parquet`` + ``_last_checkpoint`` every
+    CHECKPOINT_INTERVAL commits (the delta protocol's log-compaction
+    mechanism; our reader already replays from checkpoints, and writing
+    them keeps snapshot() O(interval) instead of O(commits)).
+
+    The table uses the protocol's EXPLICIT action schema (protocol row,
+    metaData with format + map-typed configuration, add rows with
+    partitionValues/dataChange) so standard Delta readers can consume it;
+    both files land via temp + atomic rename, and any failure is swallowed
+    — the commit already succeeded and a checkpoint is only an
+    optimization."""
+    if version == 0 or version % CHECKPOINT_INTERVAL != 0:
+        return
+    import os
+
+    try:
+        snap = log.snapshot(version)
+        rows = [
+            {"protocol": {"minReaderVersion": 1, "minWriterVersion": 2},
+             "metaData": None, "add": None},
+            {"protocol": None, "add": None, "metaData": {
+                "id": snap.metadata.id,
+                "format": {"provider": "parquet"},
+                "schemaString": snap.metadata.schema_string,
+                "partitionColumns": snap.metadata.partition_columns,
+                "configuration": list(snap.metadata.configuration.items()),
+                "createdTime": None,
+            }},
+        ]
+        for f in snap.files:
+            rows.append({"protocol": None, "metaData": None, "add": {
+                "path": _relativize(f.path, log.table_path),
+                "partitionValues": [],
+                "size": f.size,
+                "modificationTime": f.modification_time,
+                "dataChange": True,
+            }})
+        cp_path = os.path.join(log.log_path,
+                               f"{version:020d}.checkpoint.parquet")
+        tmp = cp_path + f".tmp{os.getpid()}"
+        pq.write_table(pa.Table.from_pylist(rows, schema=_CHECKPOINT_SCHEMA),
+                       tmp)
+        os.replace(tmp, cp_path)
+        last = os.path.join(log.log_path, "_last_checkpoint")
+        tmp2 = last + f".tmp{os.getpid()}"
+        with open(tmp2, "w", encoding="utf-8") as f:
+            json.dump({"version": version, "size": len(rows)}, f)
+        os.replace(tmp2, last)
+    except Exception:
+        # Best-effort: a failed checkpoint must not fail the (already
+        # durable) commit; the JSON log remains fully replayable.
+        pass
 
 
 def delete_where_file(path: str, file_path: str) -> int:
@@ -108,6 +188,7 @@ def delete_where_file(path: str, file_path: str) -> int:
                     "dataChange": True}},
         {"commitInfo": {"timestamp": now_ms, "operation": "DELETE"}},
     ])
+    _maybe_checkpoint(log, version)
     return version
 
 

@@ -315,3 +315,24 @@ class TestDeltaSchemaEdges:
         out = session.read.delta(path).select("k", "v").collect()
         assert out.sort_by("k").to_pydict() == {"k": [1, 2, 3],
                                                 "v": [None, None, 9]}
+
+    def test_writer_emits_checkpoints(self, session, tmp_path):
+        """Every 10th commit writes N.checkpoint.parquet + _last_checkpoint
+        (the protocol's log compaction; our reader replays from it)."""
+        path = str(tmp_path / "t")
+        for i in range(12):
+            write_delta(_table([i]), path, mode="append")
+        log_dir = os.path.join(path, "_delta_log")
+        assert os.path.isfile(os.path.join(
+            log_dir, f"{10:020d}.checkpoint.parquet"))
+        last = json.load(open(os.path.join(log_dir, "_last_checkpoint")))
+        assert last["version"] == 10
+        # Snapshot replay through the checkpoint stays correct even after
+        # the superseded JSON commits disappear.
+        for v in range(10):
+            os.remove(os.path.join(log_dir, f"{v:020d}.json"))
+        snap = DeltaLog(path).snapshot()
+        assert snap.version == 11
+        assert len(snap.files) == 12
+        out = session.read.delta(path).select("id").collect()
+        assert out.num_rows == 12
