@@ -148,42 +148,11 @@ class DeltaLakeRelation(FileBasedRelation):
                       key=lambda t: t[1])
 
     def closest_index(self, entry: IndexLogEntry) -> IndexLogEntry:
-        versions = self._version_history(entry)
-        if not versions or self._session is None:
-            return entry
-
-        def load(log_version: int) -> Optional[IndexLogEntry]:
-            return self._session.index_collection_manager.get_index(
-                entry.name, log_version)
-
-        table_version = self.table_version
-        floor_i = -1
-        for i, (_, delta_v) in enumerate(versions):
-            if delta_v <= table_version:
-                floor_i = i
-        if floor_i == len(versions) - 1:
-            return entry  # at or past the latest indexed version
-        if floor_i == -1:
-            return load(versions[0][0]) or entry  # before the first
-        if versions[floor_i][1] == table_version:
-            return load(versions[floor_i][0]) or entry  # exact
-        # Between two indexed versions: prefer the one with fewer diff bytes
-        # so Hybrid Scan has less to patch (DeltaLakeRelation.scala:228-241).
-        current = {(f.name, f.size, f.mtime): f.size for f in self.all_files()}
-        total = sum(current.values())
-
-        def diff_bytes(candidate: IndexLogEntry) -> int:
-            candidate_keys = {(f.name, f.size, f.mtime)
-                              for f in candidate.source_file_infos()}
-            common = sum(size for key, size in current.items()
-                         if key in candidate_keys)
-            return (total - common) + (candidate.source_files_size() - common)
-
-        prev_log = load(versions[floor_i][0])
-        next_log = load(versions[floor_i + 1][0])
-        if prev_log is None or next_log is None:
-            return next_log or prev_log or entry
-        return prev_log if diff_bytes(prev_log) < diff_bytes(next_log) else next_log
+        """DeltaLakeRelation.scala:186-243; the algorithm lives in the
+        shared FileBasedRelation helper (positions = delta versions)."""
+        return self._select_closest_version(
+            entry, self._session, self._version_history(entry),
+            self.table_version)
 
 
 class DeltaLakeSource(FileBasedSourceProvider):

@@ -10,8 +10,10 @@ sources/iceberg/IcebergRelation.scala:44-243 —
     of the current snapshot (:85-113);
   - ``refresh_relation_metadata`` drops both pins so refresh sees the latest
     snapshot (IcebergFileBasedSource.scala:45-52);
-  - ``enrich_index_properties`` passes properties through unchanged (:99-107)
-    — unlike Delta there is no multi-version index history;
+  - ``enrich_index_properties`` appends "indexLogVersion:snapshotId" pairs
+    to the ``icebergSnapshots`` history (the reference passes through here,
+    :99-107 — the history powers the beyond-parity multi-version selection
+    below);
   - data files are always Parquet (:118-121).
 """
 
@@ -37,12 +39,15 @@ from hyperspace_tpu.sources.iceberg.metadata import (
 from hyperspace_tpu.sources.interfaces import FileBasedRelation, FileBasedSourceProvider
 
 ICEBERG_FORMAT = "iceberg"
+ICEBERG_VERSION_HISTORY_PROPERTY = "icebergSnapshots"
+INDEX_LOG_VERSION_PROPERTY = "indexLogVersion"
 
 
 class IcebergRelation(FileBasedRelation):
-    def __init__(self, scan: Scan, conf: HyperspaceConf) -> None:
+    def __init__(self, scan: Scan, conf: HyperspaceConf, session=None) -> None:
         super().__init__(scan)
         self._conf = conf
+        self._session = session
         if len(self.root_paths) != 1:
             raise ValueError("An Iceberg relation has exactly one table path")
         self._table = IcebergTable(self.root_paths[0])
@@ -131,12 +136,53 @@ class IcebergRelation(FileBasedRelation):
             options=opts,
         )
 
+    # -- multi-version index selection (beyond reference: the Delta-only
+    # closestIndex, DeltaLakeRelation.scala:186-243, extended to Iceberg's
+    # snapshot timeline) -----------------------------------------------------
+    def _snapshot_order(self):
+        """snapshot_id -> position on the timestamp-ordered timeline."""
+        return {s.snapshot_id: i for i, s in enumerate(
+            sorted(self._metadata().snapshots,
+                   key=lambda s: s.timestamp_ms))}
+
+    def _version_history(self, entry, order):
+        """[(index log version, snapshot position)] ascending; when several
+        index versions map to one snapshot (optimize), keep the highest."""
+        raw = entry.properties.get(ICEBERG_VERSION_HISTORY_PROPERTY, "")
+        if not raw:
+            return []
+        by_pos = {}
+        for pair in raw.split(","):
+            index_v, snap_id = (int(x) for x in pair.split(":"))
+            pos = order.get(snap_id)
+            if pos is None:
+                continue  # expired snapshot: its index version can't anchor
+            by_pos[pos] = max(index_v, by_pos.get(pos, -1))
+        return sorted(((iv, pos) for pos, iv in by_pos.items()),
+                      key=lambda t: t[1])
+
+    def closest_index(self, entry):
+        """The Delta closestIndex algorithm over Iceberg's snapshot
+        timeline (shared FileBasedRelation helper)."""
+        snap = self._snapshot()
+        if snap is None:
+            return entry
+        order = self._snapshot_order()
+        return self._select_closest_version(
+            entry, self._session, self._version_history(entry, order),
+            order.get(snap.snapshot_id))
+
 
 class IcebergSource(FileBasedSourceProvider):
     name = "iceberg"
 
     def __init__(self, conf: HyperspaceConf) -> None:
         self._conf = conf
+        self._session = None
+
+    def bind_session(self, session) -> None:
+        """Index-manager access for closest_index (as DeltaLakeSource)."""
+        self._session = session
 
     def is_supported_relation(self, scan: Scan) -> Optional[bool]:
         return True if scan.relation.file_format.lower() == ICEBERG_FORMAT \
@@ -145,7 +191,7 @@ class IcebergSource(FileBasedSourceProvider):
     def get_relation(self, scan: Scan) -> Optional[FileBasedRelation]:
         if not self.is_supported_relation(scan):
             return None
-        return IcebergRelation(scan, self._conf)
+        return IcebergRelation(scan, self._conf, self._session)
 
     def internal_file_format_name(self, relation: Relation) -> Optional[str]:
         return "parquet" if relation.file_format == ICEBERG_FORMAT else None
@@ -163,7 +209,18 @@ class IcebergSource(FileBasedSourceProvider):
 
     def enrich_index_properties(self, relation: Relation,
                                 properties: Dict[str, str]) -> Optional[Dict[str, str]]:
-        """Pass-through (IcebergFileBasedSource.scala:99-107)."""
+        """Append "indexLogVersion:snapshotId" to the snapshot history so
+        time-traveled reads can pick the closest index version (the
+        reference passes through here, IcebergFileBasedSource.scala:99-107
+        — this history is the beyond-parity Delta symmetry)."""
         if relation.file_format != ICEBERG_FORMAT:
             return None
-        return dict(properties)
+        out = dict(properties)
+        index_version = properties.get(INDEX_LOG_VERSION_PROPERTY)
+        snap_id = relation.options.get("snapshot-id")
+        if index_version is not None and snap_id is not None:
+            pair = f"{index_version}:{snap_id}"
+            history = properties.get(ICEBERG_VERSION_HISTORY_PROPERTY)
+            out[ICEBERG_VERSION_HISTORY_PROPERTY] = \
+                f"{history},{pair}" if history else pair
+        return out

@@ -82,6 +82,49 @@ class FileBasedRelation:
         interfaces.scala:138-146); default: the entry itself."""
         return entry
 
+    def _select_closest_version(self, entry: IndexLogEntry, session,
+                                versions, current_pos) -> IndexLogEntry:
+        """Shared floor/exact/diff-bytes selection over a recorded version
+        history (DeltaLakeRelation.scala:186-243's algorithm, reused by
+        every versioned source).  ``versions`` is [(index log version,
+        position)] ascending by position; ``current_pos`` is the read
+        snapshot's position in the same ordering."""
+        if not versions or session is None or current_pos is None:
+            return entry
+
+        def load(log_version: int) -> Optional[IndexLogEntry]:
+            return session.index_collection_manager.get_index(
+                entry.name, log_version)
+
+        floor_i = -1
+        for i, (_, pos) in enumerate(versions):
+            if pos <= current_pos:
+                floor_i = i
+        if floor_i == len(versions) - 1:
+            return entry  # at or past the latest indexed version
+        if floor_i == -1:
+            return load(versions[0][0]) or entry  # before the first
+        if versions[floor_i][1] == current_pos:
+            return load(versions[floor_i][0]) or entry  # exact
+        # Between two indexed versions: fewer diff bytes wins so Hybrid
+        # Scan has less to patch.
+        current = {(f.name, f.size, f.mtime): f.size
+                   for f in self.all_files()}
+        total = sum(current.values())
+
+        def diff_bytes(candidate: IndexLogEntry) -> int:
+            keys = {(f.name, f.size, f.mtime)
+                    for f in candidate.source_file_infos()}
+            common = sum(size for key, size in current.items() if key in keys)
+            return (total - common) + (candidate.source_files_size() - common)
+
+        prev_log = load(versions[floor_i][0])
+        next_log = load(versions[floor_i + 1][0])
+        if prev_log is None or next_log is None:
+            return next_log or prev_log or entry
+        return prev_log if diff_bytes(prev_log) < diff_bytes(next_log) \
+            else next_log
+
 
 class FileBasedSourceProvider:
     """Format plug-in (interfaces.scala:184-234)."""

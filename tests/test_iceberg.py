@@ -338,3 +338,40 @@ class TestIcebergSchemaEdges:
         md = IcebergTable(path).load_metadata()
         assert md.schema["fields"][0]["id"] == 3
         assert md.last_column_id == 3
+
+
+class TestIcebergClosestIndex:
+    def test_snapshot_history_recorded(self, session, tmp_path):
+        path = str(tmp_path / "t")
+        s0 = write_iceberg(_table([1, 2]), path)
+        hs = Hyperspace(session)
+        hs.create_index(session.read.iceberg(path),
+                        IndexConfig("ci", ["id"], ["name"]))
+        entry = session.index_collection_manager.get_index("ci")
+        assert entry.properties["icebergSnapshots"] == f"2:{s0}"
+        write_iceberg(_table([3]), path)
+        hs.refresh_index("ci", "incremental")
+        entry = session.index_collection_manager.get_index("ci")
+        assert entry.properties["icebergSnapshots"].startswith(f"2:{s0},4:")
+
+    def test_time_travel_uses_closest_index_version(self, session, tmp_path):
+        """Reading snapshot s0 must use the index version built at s0
+        (exact-match branch), excluding later appended rows."""
+        from hyperspace_tpu import IndexConfig as IC
+
+        path = str(tmp_path / "t")
+        s0 = write_iceberg(_table(list(range(20))), path)
+        hs = Hyperspace(session)
+        hs.create_index(session.read.iceberg(path),
+                        IC("ci", ["id"], ["name"]))
+        write_iceberg(_table([100, 101]), path)
+        hs.refresh_index("ci", "incremental")
+        session.conf.hybrid_scan_enabled = True
+        session.enable_hyperspace()
+        ds = (session.read.iceberg(path, snapshot_id=str(s0))
+              .filter(col("id") >= 0).select("id", "name"))
+        plan = ds.optimized_plan()
+        assert [s for s in plan.leaf_relations()
+                if s.relation.index_scan_of], plan.tree_string()
+        got = ds.collect()
+        assert got.num_rows == 20  # no 100/101
