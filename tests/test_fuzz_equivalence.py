@@ -131,3 +131,51 @@ def test_join_then_filter_equivalence(catalog, pred):
     session.disable_hyperspace()
     expected = ds.collect()
     assert _canon(got) == _canon(expected), f"pred={pred!r}"
+
+
+@pytest.fixture(scope="module")
+def delta_catalog(tmp_path_factory):
+    """A Delta table with a covering index, post-index appends AND a file
+    delete, hybrid scan on — the adversarial mutable-data configuration."""
+    from hyperspace_tpu.sources.delta import DeltaLog, write_delta
+    from hyperspace_tpu.sources.delta.writer import delete_where_file
+
+    root = str(tmp_path_factory.mktemp("fuzz_delta"))
+    table_path = os.path.join(root, "t")
+    rng = np.random.default_rng(11)
+
+    def chunk(n, start):
+        return pa.table({
+            "a": pa.array(rng.integers(0, 100, n), type=pa.int64()),
+            "b": pa.array(rng.integers(-50, 50, n), type=pa.int64()),
+            "f": pa.array(np.round(rng.uniform(-10, 10, n), 3)),
+        })
+
+    for i in range(3):
+        write_delta(chunk(150, i * 150), table_path, mode="append")
+    session = HyperspaceSession(system_path=os.path.join(root, "ix"))
+    session.conf.num_buckets = 4
+    session.conf.lineage_enabled = True
+    session.conf.hybrid_scan_enabled = True
+    session.conf.hybrid_scan_max_appended_ratio = 1.0
+    session.conf.hybrid_scan_max_deleted_ratio = 1.0
+    hs = Hyperspace(session)
+    hs.create_index(session.read.delta(table_path),
+                    IndexConfig("da", ["a"], ["b", "f"]))
+    # Mutate AFTER indexing: hybrid scan must patch both directions.
+    write_delta(chunk(100, 450), table_path, mode="append")
+    delete_where_file(table_path, DeltaLog(table_path).snapshot().files[0].path)
+    return session, table_path
+
+
+@settings(max_examples=max(30, _EXAMPLES // 2), deadline=None,
+          suppress_health_check=[HealthCheck.function_scoped_fixture])
+@given(pred=predicates())
+def test_delta_hybrid_answer_equivalence(delta_catalog, pred):
+    session, table_path = delta_catalog
+    ds = session.read.delta(table_path).filter(pred).select("a", "b", "f")
+    session.enable_hyperspace()
+    got = ds.collect()
+    session.disable_hyperspace()
+    expected = ds.collect()
+    assert _canon(got) == _canon(expected), f"pred={pred!r}"
