@@ -372,6 +372,8 @@ class _BucketSpill:
         max_rows = action.conf.index_max_rows_per_file
 
         def finish_bucket(bname: str) -> None:
+            from hyperspace_tpu.io.parquet import write_bucket_run
+
             bdir = os.path.join(self._dir, bname)
             bucket = int(bname.split("=")[1])
             runs = sorted(os.listdir(bdir))  # chunk order = stable ties
@@ -380,12 +382,7 @@ class _BucketSpill:
                 promote_options="default")
             perm = self._sort_permutation(btable)
             btable = btable.take(pa.array(perm))
-            n = btable.num_rows
-            chunk = max_rows if max_rows > 0 else n
-            for off in range(0, n, chunk):
-                pq.write_table(
-                    btable.slice(off, min(chunk, n - off)),
-                    os.path.join(out_dir, bucket_file_name(bucket)))
+            write_bucket_run(btable, bucket, out_dir, max_rows)
 
         from hyperspace_tpu.utils.parallel_map import parallel_map_ordered
 
@@ -402,22 +399,13 @@ class _BucketSpill:
                                 zip(self._schema.names, self._schema.types)}
 
     def _sort_permutation(self, btable: pa.Table) -> np.ndarray:
-        if self.resolved.layout == "zorder":
-            from hyperspace_tpu.ops.zorder import zorder_order_words_np
+        # Ranks are per bucket for zorder (global ranks would need another
+        # pass); clustering quality within each bucket is what the
+        # per-file sketches consume, so pruning power is preserved.
+        from hyperspace_tpu.io.parquet import sort_permutation_host
 
-            # Ranks are per bucket here (global ranks would need another
-            # pass); clustering quality within each bucket is what the
-            # per-file sketches consume, so pruning power is preserved.
-            z = zorder_order_words_np([
-                np.asarray(columnar.to_order_words(btable.column(c)))
-                for c in self.resolved.indexed_columns])
-            return np.lexsort((z[:, 1], z[:, 0]))
-        keys: List[np.ndarray] = []
-        for c in reversed(self.resolved.indexed_columns):
-            w = np.asarray(columnar.to_order_words(btable.column(c)))
-            keys.append(w[:, 1])
-            keys.append(w[:, 0])
-        return np.lexsort(tuple(keys))
+        return sort_permutation_host(btable, self.resolved.indexed_columns,
+                                     self.resolved.layout)
 
 
 class CreateAction(CreateActionBase):

@@ -36,7 +36,12 @@ from hyperspace_tpu.index.log_entry import (
 )
 from hyperspace_tpu.index.log_manager import IndexLogManager
 from hyperspace_tpu.io import columnar
-from hyperspace_tpu.io.parquet import bucket_file_name, bucket_id_of_file
+from hyperspace_tpu.io.parquet import (
+    bucket_file_name,
+    bucket_id_of_file,
+    sort_permutation_host,
+    write_bucket_run,
+)
 from hyperspace_tpu.telemetry.events import OptimizeActionEvent
 
 
@@ -66,9 +71,20 @@ class OptimizeAction(Action):
                 retained.append(f)
             else:
                 by_bucket[bucket].append(f)
-        mergeable = {b: fs for b, fs in by_bucket.items() if len(fs) > 1}
+        max_rows = self.session.conf.index_max_rows_per_file
+        mergeable: Dict[int, List[FileInfo]] = {}
         for b, fs in by_bucket.items():
-            if len(fs) <= 1:
+            worth_merging = len(fs) > 1
+            if worth_merging and max_rows > 0:
+                # Convergence with the file-size knob: a bucket already at
+                # its minimal ceil(rows/max_rows) file count is optimal —
+                # re-merging it forever would churn a version per run.
+                rows = sum(pq.ParquetFile(f.name).metadata.num_rows
+                           for f in fs)
+                worth_merging = len(fs) > -(-rows // max_rows)
+            if worth_merging:
+                mergeable[b] = fs
+            else:
                 retained.extend(fs)
         self._retained = retained
         return mergeable
@@ -95,15 +111,22 @@ class OptimizeAction(Action):
         out_dir = self.data_manager.version_path(version)
         os.makedirs(out_dir, exist_ok=True)
         sort_cols = entry.indexed_columns
+        max_rows = self.session.conf.index_max_rows_per_file
+        layout = entry.derived_dataset.properties.get("layout",
+                                                      "lexicographic")
         for bucket, files in sorted(mergeable.items()):
             merged = pa.concat_tables(
                 [pq.read_table(f.name) for f in files], promote_options="default")
-            keys = [columnar.to_order_key(merged.column(c)) for c in sort_cols]
-            perm = np.lexsort(tuple(reversed(keys)))
+            # Layout-aware: a Z-ordered index must stay Z-ordered through
+            # compaction or its per-file sketches go wide on every
+            # non-primary dimension.
+            perm = sort_permutation_host(merged, sort_cols, layout)
             merged = merged.take(pa.array(perm))
-            path = os.path.join(out_dir, bucket_file_name(bucket))
-            pq.write_table(merged, path)
-            self._new_files.append(path)
+            # Honor the file-size knob: collapsing a bucket to ONE file
+            # would destroy the per-file sketch pruning granularity the
+            # split exists for.
+            self._new_files.extend(
+                write_bucket_run(merged, bucket, out_dir, max_rows))
         # Per-file min/max sketch for the compacted version, like every
         # build writes — keeps FilterIndexRule's file pruning effective on
         # optimized indexes.

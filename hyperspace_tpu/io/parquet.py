@@ -162,6 +162,46 @@ def _dtype_from_string(t: str) -> pa.DataType:
         return pa.string()
 
 
+def write_bucket_run(sorted_bucket_table: pa.Table, bucket: int,
+                     out_dir: str, max_rows_per_file: int = 0) -> List[str]:
+    """Write ONE bucket's already-sorted rows, split at
+    ``max_rows_per_file`` (0 = single file) — the one home for the chunking
+    rule shared by the monolithic build, the external build's phase 2, and
+    optimize's compaction."""
+    n = sorted_bucket_table.num_rows
+    chunk = max_rows_per_file if max_rows_per_file > 0 else n
+    out: List[str] = []
+    for off in range(0, n, chunk):
+        path = os.path.join(out_dir, bucket_file_name(bucket))
+        pq.write_table(sorted_bucket_table.slice(off, min(chunk, n - off)),
+                       path)
+        out.append(path)
+    return out
+
+
+def sort_permutation_host(table: pa.Table, indexed_columns, layout: str):
+    """Host-side within-bucket sort permutation honoring the index LAYOUT —
+    lexicographic over the indexed columns, or Morton order for
+    ``layout == "zorder"`` (per-batch ranks; same shape the external build
+    uses).  Shared by optimize and the external build so a compaction can
+    never silently destroy a Z-order layout."""
+    from hyperspace_tpu.io import columnar
+
+    if layout == "zorder":
+        from hyperspace_tpu.ops.zorder import zorder_order_words_np
+
+        z = zorder_order_words_np([
+            np.asarray(columnar.to_order_words(table.column(c)))
+            for c in indexed_columns])
+        return np.lexsort((z[:, 1], z[:, 0]))
+    keys: List[np.ndarray] = []
+    for c in reversed(list(indexed_columns)):
+        w = np.asarray(columnar.to_order_words(table.column(c)))
+        keys.append(w[:, 1])
+        keys.append(w[:, 0])
+    return np.lexsort(tuple(keys))
+
+
 def write_bucketed(table: pa.Table, bucket_ids: np.ndarray, sort_perm: np.ndarray,
                    num_buckets: int, out_dir: str,
                    max_rows_per_file: int = 0) -> List[str]:
@@ -182,19 +222,13 @@ def write_bucketed(table: pa.Table, bucket_ids: np.ndarray, sort_perm: np.ndarra
     # Bucket boundaries within the sorted order.
     starts = np.searchsorted(sorted_buckets, np.arange(num_buckets), side="left")
     ends = np.searchsorted(sorted_buckets, np.arange(num_buckets), side="right")
-    jobs: List = []  # (path, start, rows)
-    for b in range(num_buckets):
-        n = int(ends[b] - starts[b])
-        if n == 0:
-            continue
-        chunk = max_rows_per_file if max_rows_per_file > 0 else n
-        for off in range(0, n, chunk):
-            jobs.append((os.path.join(out_dir, bucket_file_name(b)),
-                         int(starts[b]) + off, min(chunk, n - off)))
+    buckets_with_rows = [(b, int(starts[b]), int(ends[b] - starts[b]))
+                         for b in range(num_buckets) if ends[b] > starts[b]]
 
-    def write(job) -> str:
-        path, start, rows = job
-        pq.write_table(sorted_table.slice(start, rows), path)
-        return path
+    def write(job) -> List[str]:
+        b, start, rows = job
+        return write_bucket_run(sorted_table.slice(start, rows), b, out_dir,
+                                max_rows_per_file)
 
-    return parallel_map_ordered(write, jobs)
+    return [p for paths in parallel_map_ordered(write, buckets_with_rows)
+            for p in paths]

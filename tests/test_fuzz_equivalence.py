@@ -149,6 +149,9 @@ def delta_catalog(tmp_path_factory):
             "a": pa.array(rng.integers(0, 100, n), type=pa.int64()),
             "b": pa.array(rng.integers(-50, 50, n), type=pa.int64()),
             "f": pa.array(np.round(rng.uniform(-10, 10, n), 3)),
+            # Unique per row: duplicate (a,b,f) triples can't mask a
+            # dropped/duplicated row in the canonical comparison.
+            "rid": pa.array(np.arange(start, start + n, dtype=np.int64)),
         })
 
     for i in range(3):
@@ -161,7 +164,7 @@ def delta_catalog(tmp_path_factory):
     session.conf.hybrid_scan_max_deleted_ratio = 1.0
     hs = Hyperspace(session)
     hs.create_index(session.read.delta(table_path),
-                    IndexConfig("da", ["a"], ["b", "f"]))
+                    IndexConfig("da", ["a"], ["b", "f", "rid"]))
     # Mutate AFTER indexing: hybrid scan must patch both directions.
     write_delta(chunk(100, 450), table_path, mode="append")
     delete_where_file(table_path, DeltaLog(table_path).snapshot().files[0].path)
@@ -173,7 +176,8 @@ def delta_catalog(tmp_path_factory):
 @given(pred=predicates())
 def test_delta_hybrid_answer_equivalence(delta_catalog, pred):
     session, table_path = delta_catalog
-    ds = session.read.delta(table_path).filter(pred).select("a", "b", "f")
+    ds = (session.read.delta(table_path).filter(pred)
+          .select("a", "b", "f", "rid"))
     session.enable_hyperspace()
     got = ds.collect()
     session.disable_hyperspace()

@@ -233,3 +233,84 @@ def test_explain_lists_indexes(env):
     assert "Plan with indexes" in out
     assert "Physical operator stats" in out
     assert "Hyperspace(Type: CI, Name: idx)" in out
+
+
+def test_optimize_honors_max_rows_per_file(env):
+    """Compaction must re-split bucket runs at index_max_rows_per_file —
+    collapsing to one file would destroy sketch-pruning granularity."""
+    session, hs, data_dir = env
+    session.conf.index_max_rows_per_file = 3
+    session.conf.optimize_file_size_threshold = 1 << 30
+    hs.create_index(session.read.parquet(data_dir),
+                    IndexConfig("oi", ["id"], ["name"]))
+    import os
+
+    import pyarrow.parquet as pq
+
+    from hyperspace_tpu.io.parquet import bucket_id_of_file
+
+    entry = session.index_collection_manager.get_index("oi")
+    pre = entry.content.file_infos()
+    hs.optimize_index("oi", "full")
+    entry = session.index_collection_manager.get_index("oi")
+    post = entry.content.file_infos()
+    for f in post:
+        assert pq.read_table(f.name).num_rows <= 3, f.name
+    # Bucket coverage unchanged; answers still correct.
+    assert {bucket_id_of_file(f.name) for f in post} \
+        == {bucket_id_of_file(f.name) for f in pre}
+    session.enable_hyperspace()
+    out = (session.read.parquet(data_dir)
+           .filter(col("id") == 3810076).select("id", "name").collect())
+    assert out.num_rows == 1
+    session.disable_hyperspace()
+    assert out.equals(session.read.parquet(data_dir)
+                      .filter(col("id") == 3810076)
+                      .select("id", "name").collect())
+
+
+def test_optimize_converges_with_max_rows(env):
+    """A second optimize over already-minimal split buckets must be a
+    no-op (NoChangesError swallowed), not a version-churning rewrite."""
+    session, hs, data_dir = env
+    session.conf.index_max_rows_per_file = 3
+    session.conf.optimize_file_size_threshold = 1 << 30
+    hs.create_index(session.read.parquet(data_dir),
+                    IndexConfig("oc", ["id"], ["name"]))
+    hs.optimize_index("oc", "full")
+    v1 = session.index_collection_manager.get_index("oc").id
+    hs.optimize_index("oc", "full")  # must not rewrite again
+    v2 = session.index_collection_manager.get_index("oc").id
+    assert v1 == v2
+
+
+def test_optimize_keeps_zorder_layout_order(env, tmp_path):
+    """Compacting a Z-ordered index must preserve Z-order clustering —
+    second-dimension pruning still works afterward."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    session, hs, _ = env
+    root = tmp_path / "grid"
+    root.mkdir()
+    rng = np.random.default_rng(0)
+    n = 4096
+    pq.write_table(pa.table({
+        "x": pa.array(rng.integers(0, 1 << 16, n), type=pa.int64()),
+        "y": pa.array(rng.integers(0, 1 << 16, n), type=pa.int64()),
+    }), str(root / "p.parquet"))
+    session.conf.num_buckets = 1
+    session.conf.index_max_rows_per_file = 256
+    session.conf.optimize_file_size_threshold = 1 << 30
+    hs.create_index(session.read.parquet(str(root)),
+                    IndexConfig("zo", ["x", "y"], layout="zorder"))
+    hs.optimize_index("zo", "full")
+    session.enable_hyperspace()
+    plan = (session.read.parquet(str(root))
+            .filter((col("y") >= 1000) & (col("y") < 9000))
+            .select("x", "y").optimized_plan())
+    scans = [s for s in plan.leaf_relations() if s.relation.index_scan_of]
+    assert scans, plan.tree_string()
+    kept, total = scans[0].relation.data_skipping_stats
+    assert kept <= total // 2, (kept, total)  # y-pruning survives compaction
