@@ -60,7 +60,11 @@ class OptimizeAction(Action):
         self._retained: List[FileInfo] = []
 
     def _candidates(self) -> Dict[int, List[FileInfo]]:
-        """Bucket → files worth merging (OptimizeAction.scala:115-133)."""
+        """Bucket → files worth merging (OptimizeAction.scala:115-133).
+        Memoized: validate() and op() both need it, and the convergence
+        check reads Parquet footers."""
+        if getattr(self, "_candidates_cache", None) is not None:
+            return self._candidates_cache
         entry = self.previous_log_entry
         threshold = self.session.conf.optimize_file_size_threshold
         by_bucket: Dict[int, List[FileInfo]] = defaultdict(list)
@@ -74,19 +78,25 @@ class OptimizeAction(Action):
         max_rows = self.session.conf.index_max_rows_per_file
         mergeable: Dict[int, List[FileInfo]] = {}
         for b, fs in by_bucket.items():
-            worth_merging = len(fs) > 1
-            if worth_merging and max_rows > 0:
-                # Convergence with the file-size knob: a bucket already at
-                # its minimal ceil(rows/max_rows) file count is optimal —
-                # re-merging it forever would churn a version per run.
-                rows = sum(pq.ParquetFile(f.name).metadata.num_rows
-                           for f in fs)
-                worth_merging = len(fs) > -(-rows // max_rows)
+            if max_rows > 0:
+                # With the file-size knob: rewrite when the bucket has more
+                # files than its minimal ceil(rows/max_rows) count OR any
+                # file exceeds the (possibly lowered) knob — and converge
+                # once both hold (re-merging an optimal bucket forever
+                # would churn a version per run).
+                per_file = [pq.ParquetFile(f.name).metadata.num_rows
+                            for f in fs]
+                minimal = -(-sum(per_file) // max_rows)
+                worth_merging = (len(fs) > minimal
+                                 or any(r > max_rows for r in per_file))
+            else:
+                worth_merging = len(fs) > 1
             if worth_merging:
                 mergeable[b] = fs
             else:
                 retained.extend(fs)
         self._retained = retained
+        self._candidates_cache = mergeable
         return mergeable
 
     def validate(self) -> None:

@@ -162,19 +162,25 @@ def _dtype_from_string(t: str) -> pa.DataType:
         return pa.string()
 
 
+def bucket_chunks(n_rows: int, max_rows_per_file: int) -> List:
+    """[(offset, rows)] splitting a bucket run at ``max_rows_per_file``
+    (0 = single chunk) — the one home for the chunking rule."""
+    chunk = max_rows_per_file if max_rows_per_file > 0 else max(n_rows, 1)
+    return [(off, min(chunk, n_rows - off))
+            for off in range(0, n_rows, chunk)]
+
+
 def write_bucket_run(sorted_bucket_table: pa.Table, bucket: int,
                      out_dir: str, max_rows_per_file: int = 0) -> List[str]:
     """Write ONE bucket's already-sorted rows, split at
-    ``max_rows_per_file`` (0 = single file) — the one home for the chunking
-    rule shared by the monolithic build, the external build's phase 2, and
-    optimize's compaction."""
-    n = sorted_bucket_table.num_rows
-    chunk = max_rows_per_file if max_rows_per_file > 0 else n
+    ``max_rows_per_file`` — shared by the external build's phase 2 and
+    optimize's compaction (both already parallelize per bucket; the
+    monolithic writer parallelizes per chunk via ``bucket_chunks``)."""
     out: List[str] = []
-    for off in range(0, n, chunk):
+    for off, rows in bucket_chunks(sorted_bucket_table.num_rows,
+                                   max_rows_per_file):
         path = os.path.join(out_dir, bucket_file_name(bucket))
-        pq.write_table(sorted_bucket_table.slice(off, min(chunk, n - off)),
-                       path)
+        pq.write_table(sorted_bucket_table.slice(off, rows), path)
         out.append(path)
     return out
 
@@ -222,13 +228,19 @@ def write_bucketed(table: pa.Table, bucket_ids: np.ndarray, sort_perm: np.ndarra
     # Bucket boundaries within the sorted order.
     starts = np.searchsorted(sorted_buckets, np.arange(num_buckets), side="left")
     ends = np.searchsorted(sorted_buckets, np.arange(num_buckets), side="right")
-    buckets_with_rows = [(b, int(starts[b]), int(ends[b] - starts[b]))
-                         for b in range(num_buckets) if ends[b] > starts[b]]
+    jobs: List = []  # one PER CHUNK: skewed/low-bucket builds still
+    # parallelize their writes
+    for b in range(num_buckets):
+        n = int(ends[b] - starts[b])
+        if n == 0:
+            continue
+        for off, rows in bucket_chunks(n, max_rows_per_file):
+            jobs.append((b, int(starts[b]) + off, rows))
 
-    def write(job) -> List[str]:
+    def write(job) -> str:
         b, start, rows = job
-        return write_bucket_run(sorted_table.slice(start, rows), b, out_dir,
-                                max_rows_per_file)
+        path = os.path.join(out_dir, bucket_file_name(b))
+        pq.write_table(sorted_table.slice(start, rows), path)
+        return path
 
-    return [p for paths in parallel_map_ordered(write, buckets_with_rows)
-            for p in paths]
+    return parallel_map_ordered(write, jobs)

@@ -32,7 +32,7 @@ from hyperspace_tpu.actions.data_skipping import (
     read_sketch,
 )
 from hyperspace_tpu.index.log_entry import IndexLogEntry, States
-from hyperspace_tpu.plan.expr import BinOp, Col, Expr, IsIn, Lit, split_conjuncts
+from hyperspace_tpu.plan.expr import And, BinOp, Col, Expr, IsIn, Lit, Or
 from hyperspace_tpu.plan.nodes import Filter, LogicalPlan, Project, Scan, ScanRelation
 from hyperspace_tpu.rules import rule_utils
 from hyperspace_tpu.rules.filter_rule import _extract_filter_nodes
@@ -93,38 +93,138 @@ class _Constraint:
         return True
 
 
+_MIRROR = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "==": "=="}
+
+
+def _copy(c: _Constraint) -> _Constraint:
+    out = _Constraint()
+    out.lo, out.lo_open = c.lo, c.lo_open
+    out.hi, out.hi_open = c.hi, c.hi_open
+    out.values = None if c.values is None else set(c.values)
+    return out
+
+
+def _is_false(c: _Constraint) -> bool:
+    """An intersected-to-empty value set: the branch matches no row."""
+    return c.values is not None and len(c.values) == 0
+
+
+def _union(a: _Constraint, b: _Constraint) -> Optional[_Constraint]:
+    """Sound OR of two single-column constraints: pure value sets union
+    exactly; anything involving ranges widens to the covering interval
+    (values collapse to [min, max]); unbounded sides make the union
+    unconstrained (None).  An unsatisfiable branch (empty value set, e.g.
+    from ``a==0 AND a==1``) is the union identity."""
+    if _is_false(a):
+        return _copy(b)
+    if _is_false(b):
+        return _copy(a)
+    out = _Constraint()
+    if a.values is not None and b.values is not None \
+            and a.lo is None and a.hi is None and b.lo is None and b.hi is None:
+        out.values = a.values | b.values
+        return out
+
+    def bounds(c: _Constraint):
+        lo, lo_open, hi, hi_open = c.lo, c.lo_open, c.hi, c.hi_open
+        if c.values is not None:
+            try:
+                vmin, vmax = min(c.values), max(c.values)
+            except TypeError:
+                return None
+            lo = vmin if lo is None else min(lo, vmin)
+            hi = vmax if hi is None else max(hi, vmax)
+            lo_open = hi_open = False
+        return lo, lo_open, hi, hi_open
+
+    ba, bb = bounds(a), bounds(b)
+    if ba is None or bb is None:
+        return None
+    try:
+        if ba[0] is None or bb[0] is None:
+            out.lo = None
+        else:
+            out.lo, out.lo_open = min((ba[0], ba[1]), (bb[0], bb[1]),
+                                      key=lambda t: (t[0], t[1]))
+        if ba[2] is None or bb[2] is None:
+            out.hi = None
+        else:
+            out.hi, out.hi_open = max((ba[2], not ba[3]), (bb[2], not bb[3]),
+                                      key=lambda t: (t[0], t[1]))
+            out.hi_open = not out.hi_open
+    except TypeError:
+        return None
+    if out.lo is None and out.hi is None:
+        return None
+    return out
+
+
+def _intersect_into(target: _Constraint, c: _Constraint) -> None:
+    """AND ``c`` into ``target`` (both constrain the same column)."""
+    if c.values is not None:
+        target.values = set(c.values) if target.values is None \
+            else target.values & c.values
+    if c.lo is not None:
+        target.add_cmp(">" if c.lo_open else ">=", c.lo)
+    if c.hi is not None:
+        target.add_cmp("<" if c.hi_open else "<=", c.hi)
+
+
+def _analyze(expr: Expr) -> Optional[Dict[str, _Constraint]]:
+    """Per-column constraints implied by ``expr`` (names lowercased).
+    {} = no usable constraint; never over-constrains (pruning stays
+    conservative): an AND merges by intersection, an OR keeps only columns
+    constrained on BOTH branches, merged by sound union."""
+    if isinstance(expr, BinOp) and expr.op in _MIRROR:
+        c = _Constraint()
+        if isinstance(expr.left, Col) and isinstance(expr.right, Lit):
+            c.add_cmp(expr.op, expr.right.value)
+            return {expr.left.name.lower(): c}
+        if isinstance(expr.right, Col) and isinstance(expr.left, Lit):
+            c.add_cmp(_MIRROR[expr.op], expr.left.value)
+            return {expr.right.name.lower(): c}
+        return {}
+    if isinstance(expr, IsIn) and isinstance(expr.child, Col):
+        c = _Constraint()
+        c.add_values(expr.values)
+        return {expr.child.name.lower(): c}
+    if isinstance(expr, And):
+        left = _analyze(expr.left) or {}
+        right = _analyze(expr.right) or {}
+        out = dict(left)
+        for name, c in right.items():
+            if name in out:
+                _intersect_into(out[name], c)
+            else:
+                out[name] = c
+        return out
+    if isinstance(expr, Or):
+        left = _analyze(expr.left)
+        right = _analyze(expr.right)
+        if not left or not right:
+            return {}  # an unconstrained branch admits anything
+        out: Dict[str, _Constraint] = {}
+        for name in left.keys() & right.keys():
+            u = _union(left[name], right[name])
+            if u is not None:
+                out[name] = u
+        return out
+    return {}
+
+
 def extract_constraints(condition: Expr,
                         sketched: List[str]) -> Dict[str, _Constraint]:
-    """Per-column constraints from top-level conjuncts (OR branches and
-    other shapes contribute nothing — pruning stays conservative)."""
+    """Per-column constraints over the sketched columns.  Conjunctions
+    intersect; disjunctions union soundly (pure value sets exactly, ranges
+    as covering intervals) — so ``a == 1 OR a == 5`` prunes by the value
+    pair and ``(a BETWEEN 1 AND 5) OR (a BETWEEN 90 AND 95)`` by the
+    covering interval [1, 95]; opposite-unbounded sides (``a<3 OR a>90``)
+    correctly yield no constraint.  NOT and other shapes contribute
+    nothing (always conservative)."""
+    analyzed = _analyze(condition) or {}
     lowered = {c.lower(): c for c in sketched}
-    out: Dict[str, _Constraint] = {}
-
-    def constraint_for(name: str) -> Optional[_Constraint]:
-        canonical = lowered.get(name.lower())
-        if canonical is None:
-            return None
-        return out.setdefault(canonical, _Constraint())
-
-    _MIRROR = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "==": "=="}
-    for conj in split_conjuncts(condition):
-        # The op check comes BEFORE constraint_for: an unsupported operator
-        # must not setdefault an empty constraint (it would defeat callers'
-        # "no constraints -> skip all sketch IO" fast path).
-        if isinstance(conj, BinOp) and conj.op in _MIRROR:
-            if isinstance(conj.left, Col) and isinstance(conj.right, Lit):
-                c = constraint_for(conj.left.name)
-                if c is not None:
-                    c.add_cmp(conj.op, conj.right.value)
-            elif isinstance(conj.right, Col) and isinstance(conj.left, Lit):
-                c = constraint_for(conj.right.name)
-                if c is not None:
-                    c.add_cmp(_MIRROR[conj.op], conj.left.value)
-        elif isinstance(conj, IsIn) and isinstance(conj.child, Col):
-            c = constraint_for(conj.child.name)
-            if c is not None:
-                c.add_values(conj.values)
-    return out
+    return {lowered[name]: c for name, c in analyzed.items()
+            if name in lowered}
 
 
 class _TypedProbe:

@@ -169,13 +169,43 @@ class TestRule:
         assert not _ds_scans(plan)
         assert ds.collect().num_rows == 1
 
-    def test_or_predicate_is_conservative(self, session, tmp_path):
+    def test_or_of_equalities_prunes_by_value_union(self, session, tmp_path):
         hs, root = self._setup(session, tmp_path)
         ds = (session.read.parquet(root)
               .filter((col("id") == 1) | (col("id") == 499)).select("id"))
-        # OR contributes no constraint: no pruning, but answers stay right.
+        plan = ds.optimized_plan()
+        scans = _ds_scans(plan)
+        # {1, 499} live in the first and last of the 5 disjoint files.
+        assert scans and scans[0].relation.data_skipping_stats == (2, 5), \
+            plan.tree_string()
         got = ds.collect()
+        session.disable_hyperspace()
+        assert got.sort_by("id").equals(ds.collect().sort_by("id"))
         assert got.num_rows == 2
+
+    def test_or_of_ranges_prunes_by_covering_interval(self, session, tmp_path):
+        hs, root = self._setup(session, tmp_path)
+        ds = (session.read.parquet(root)
+              .filter(((col("id") >= 10) & (col("id") < 20))
+                      | ((col("id") >= 110) & (col("id") < 120)))
+              .select("id"))
+        plan = ds.optimized_plan()
+        scans = _ds_scans(plan)
+        # Covering interval [10, 120) spans files 0 and 1 of 5.
+        assert scans and scans[0].relation.data_skipping_stats == (2, 5), \
+            plan.tree_string()
+        assert ds.collect().num_rows == 20
+
+    def test_opposite_unbounded_or_is_conservative(self, session, tmp_path):
+        hs, root = self._setup(session, tmp_path)
+        ds = (session.read.parquet(root)
+              .filter((col("id") < 3) | (col("id") > 490)).select("id"))
+        # (-inf,3) ∪ (490,inf) has no covering bound: no pruning, answers
+        # stay right.
+        got = ds.collect()
+        session.disable_hyperspace()
+        assert got.sort_by("id").equals(ds.collect().sort_by("id"))
+        assert got.num_rows == 3 + 9
 
     def test_covering_index_wins_over_ds(self, session, tmp_path):
         root = str(tmp_path / "data")

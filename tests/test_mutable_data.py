@@ -235,30 +235,29 @@ def test_explain_lists_indexes(env):
     assert "Hyperspace(Type: CI, Name: idx)" in out
 
 
-def test_optimize_honors_max_rows_per_file(env):
-    """Compaction must re-split bucket runs at index_max_rows_per_file —
-    collapsing to one file would destroy sketch-pruning granularity."""
+def test_optimize_resplits_oversized_files(env):
+    """Lowering index_max_rows_per_file then optimizing must RE-SPLIT
+    oversized files — collapsing the knob's granularity would blunt
+    per-file sketch pruning."""
     session, hs, data_dir = env
-    session.conf.index_max_rows_per_file = 3
     session.conf.optimize_file_size_threshold = 1 << 30
     hs.create_index(session.read.parquet(data_dir),
-                    IndexConfig("oi", ["id"], ["name"]))
-    import os
-
+                    IndexConfig("oi", ["id"], ["name"]))  # knob off: big files
     import pyarrow.parquet as pq
 
     from hyperspace_tpu.io.parquet import bucket_id_of_file
 
-    entry = session.index_collection_manager.get_index("oi")
-    pre = entry.content.file_infos()
+    pre = session.index_collection_manager.get_index("oi")
+    assert any(pq.read_table(f.name).num_rows > 3
+               for f in pre.content.file_infos())
+    session.conf.index_max_rows_per_file = 3
     hs.optimize_index("oi", "full")
-    entry = session.index_collection_manager.get_index("oi")
-    post = entry.content.file_infos()
-    for f in post:
+    post = session.index_collection_manager.get_index("oi")
+    assert post.id != pre.id  # optimize genuinely ran
+    for f in post.content.file_infos():
         assert pq.read_table(f.name).num_rows <= 3, f.name
-    # Bucket coverage unchanged; answers still correct.
-    assert {bucket_id_of_file(f.name) for f in post} \
-        == {bucket_id_of_file(f.name) for f in pre}
+    assert {bucket_id_of_file(f.name) for f in post.content.file_infos()} \
+        == {bucket_id_of_file(f.name) for f in pre.content.file_infos()}
     session.enable_hyperspace()
     out = (session.read.parquet(data_dir)
            .filter(col("id") == 3810076).select("id", "name").collect())
@@ -270,14 +269,15 @@ def test_optimize_honors_max_rows_per_file(env):
 
 
 def test_optimize_converges_with_max_rows(env):
-    """A second optimize over already-minimal split buckets must be a
-    no-op (NoChangesError swallowed), not a version-churning rewrite."""
+    """After one real compaction, a second optimize over already-minimal
+    split buckets is a no-op (NoChangesError swallowed) — not a
+    version-churning rewrite."""
     session, hs, data_dir = env
-    session.conf.index_max_rows_per_file = 3
     session.conf.optimize_file_size_threshold = 1 << 30
     hs.create_index(session.read.parquet(data_dir),
                     IndexConfig("oc", ["id"], ["name"]))
-    hs.optimize_index("oc", "full")
+    session.conf.index_max_rows_per_file = 3
+    hs.optimize_index("oc", "full")  # real resplit
     v1 = session.index_collection_manager.get_index("oc").id
     hs.optimize_index("oc", "full")  # must not rewrite again
     v2 = session.index_collection_manager.get_index("oc").id
@@ -301,11 +301,17 @@ def test_optimize_keeps_zorder_layout_order(env, tmp_path):
         "y": pa.array(rng.integers(0, 1 << 16, n), type=pa.int64()),
     }), str(root / "p.parquet"))
     session.conf.num_buckets = 1
-    session.conf.index_max_rows_per_file = 256
     session.conf.optimize_file_size_threshold = 1 << 30
     hs.create_index(session.read.parquet(str(root)),
                     IndexConfig("zo", ["x", "y"], layout="zorder"))
+    pre_id = session.index_collection_manager.get_index("zo").id
+    # Lower the knob so optimize genuinely re-splits (and must re-sort in
+    # Z order while doing it).
+    session.conf.index_max_rows_per_file = 256
     hs.optimize_index("zo", "full")
+    post = session.index_collection_manager.get_index("zo")
+    assert post.id != pre_id  # compaction genuinely ran
+    assert len(post.content.file_infos()) >= 16
     session.enable_hyperspace()
     plan = (session.read.parquet(str(root))
             .filter((col("y") >= 1000) & (col("y") < 9000))
