@@ -1,0 +1,52 @@
+"""Driver-contract tests for __graft_entry__.
+
+The driver imports the module in a fresh process and calls
+``dryrun_multichip(n)`` with NO multi-chip hardware present; the entry
+must self-provision the virtual CPU mesh (round-1 failure mode:
+MULTICHIP_r01 rc=1 because it raised instead of provisioning).  These
+tests spawn real subprocesses so the conftest's own mesh provisioning
+cannot mask a regression.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(code: str) -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    # Simulate the driver: no pytest conftest, no pre-set virtual mesh.
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("HS_DEVICE_BATCH_ROWS", None)
+    return subprocess.run(
+        [sys.executable, "-c", code], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=600)
+
+
+def test_dryrun_multichip_fresh_process():
+    r = _run("import __graft_entry__ as g; g.dryrun_multichip(8)")
+    assert r.returncode == 0, r.stderr[-2000:]
+
+
+def test_dryrun_multichip_after_backend_init():
+    # entry() may have initialized the default backend first; the dryrun
+    # must still provision the 8-device CPU mesh.
+    r = _run(
+        "import jax\n"
+        "import __graft_entry__ as g\n"
+        "jax.devices()\n"
+        "g.dryrun_multichip(8)\n")
+    assert r.returncode == 0, r.stderr[-2000:]
+
+
+def test_entry_is_jittable():
+    r = _run(
+        "import jax\n"
+        "import __graft_entry__ as g\n"
+        "fn, args = g.entry()\n"
+        "out = jax.jit(fn)(*args)\n"
+        "jax.block_until_ready(out)\n")
+    assert r.returncode == 0, r.stderr[-2000:]
