@@ -37,7 +37,12 @@ class Dataset:
     def collect(self) -> pa.Table:
         from hyperspace_tpu.execution.executor import Executor
 
-        return Executor(self.session).execute(self.optimized_plan())
+        executor = Executor(self.session)
+        out = executor.execute(self.optimized_plan())
+        # Physical stats of the most recent execution (join strategies,
+        # scan file counts) — read by verbose explain and tests.
+        self.session.last_execution_stats = executor.stats
+        return out
 
     def to_pandas(self):
         return self.collect().to_pandas()

@@ -31,6 +31,7 @@ from hyperspace_tpu.plan.expr import And, BinOp, Col, Expr, IsIn, Lit, Not, Or
 from hyperspace_tpu.plan.nodes import (
     BucketUnion,
     Filter,
+    InMemory,
     Join,
     LogicalPlan,
     Project,
@@ -45,8 +46,14 @@ from hyperspace_tpu.sources.interfaces import LAKE_DATA_FORMATS, physical_read_f
 class Executor:
     def __init__(self, session) -> None:
         self.session = session
+        # Physical execution stats (PhysicalOperatorAnalyzer.scala:30-58
+        # intent): per-join strategy, per-scan file counts.  Read back via
+        # session.last_execution_stats after Dataset.collect().
+        self.stats: Dict[str, list] = {"joins": [], "scans": []}
 
     def execute(self, plan: LogicalPlan) -> pa.Table:
+        if isinstance(plan, InMemory):
+            return plan.table
         if isinstance(plan, Scan):
             return self._scan(plan)
         if isinstance(plan, Filter):
@@ -85,6 +92,12 @@ class Executor:
             wanted = set(rel.prune_to_buckets)
             paths = [p for p in paths
                      if (b := bucket_id_of_file(p)) is None or b in wanted]
+        self.stats["scans"].append({
+            "relation": rel.index_scan_of or ",".join(rel.root_paths),
+            "is_index": bool(rel.index_scan_of),
+            "files_read": len(paths),
+            "files_listed": len(all_paths),
+        })
         if not paths:
             # Bucket pruning removed every file (key hashes to an empty
             # bucket): the result is empty but MUST keep the scan schema so
@@ -208,12 +221,14 @@ class Executor:
         return mask
 
     # -- join ---------------------------------------------------------------
-    def _join(self, plan: Join) -> pa.Table:
+    def _join(self, plan: Join, _record: bool = True) -> pa.Table:
         from hyperspace_tpu.plan.expr import as_equi_join_pairs
 
         bucketed = self._try_bucketed_join(plan)
         if bucketed is not None:
             return bucketed
+        if _record:
+            self.stats["joins"].append({"strategy": "plain"})
         left = self.execute(plan.left)
         right = self.execute(plan.right)
         pairs = as_equi_join_pairs(plan.condition)
@@ -279,16 +294,24 @@ class Executor:
         constructs), execute and join bucket by bucket: equal keys can only
         meet inside one bucket, so each per-bucket merge works on 1/B of the
         data — the single-chip analog of Spark's exchange-free SMJ over
-        matching bucketSpecs (JoinIndexRule.scala:36-50)."""
+        matching bucketSpecs (JoinIndexRule.scala:36-50).
+
+        A side may also be a hybrid-scan ``BucketUnion(index, appended)``:
+        the appended rows are routed through the build hash kernel into the
+        index's bucket space and joined per bucket alongside the index
+        files — the executed form of the reference's on-the-fly shuffle
+        (RuleUtils.scala:511-570), keeping the index side exchange-free
+        instead of degrading to a full-table merge."""
         from hyperspace_tpu.plan.expr import as_equi_join_pairs
 
         pairs = as_equi_join_pairs(plan.condition)
         if pairs is None or len(pairs) != 1:
             return None
-        aligned = [_bucketed_chain(side) for side in (plan.left, plan.right)]
+        aligned = [_bucketed_side(side) for side in (plan.left, plan.right)]
         if any(a is None for a in aligned):
             return None
-        (l_scan, l_wrap), (r_scan, r_wrap) = aligned
+        left_side, right_side = aligned
+        l_scan, r_scan = left_side.scan, right_side.scan
         l_spec, r_spec = l_scan.relation.bucket_spec, r_scan.relation.bucket_spec
         if l_spec[0] != r_spec[0]:
             return None
@@ -308,22 +331,38 @@ class Executor:
         r_type = self.session.schema_map_of(r_scan).get(r_spec[1][0])
         if l_type is None or r_type is None or l_type != r_type:
             return None
-        l_by_bucket = _files_by_bucket(l_scan)
-        r_by_bucket = _files_by_bucket(r_scan)
-        if l_by_bucket is None or r_by_bucket is None:
+        # Cheap structural checks for BOTH sides before executing any
+        # appended subtree (a late failure would re-execute it on the plain
+        # path); if a rare post-execution failure (appended key cast) still
+        # falls back, roll the stats back so one collect() doesn't report
+        # the appended scan twice.
+        l_files = _files_by_bucket(left_side.scan)
+        r_files = _files_by_bucket(right_side.scan)
+        if l_files is None or r_files is None:
             return None
-        shared = sorted(set(l_by_bucket) & set(r_by_bucket))
+        scans_mark = len(self.stats["scans"])
+        l_parts = self._side_bucket_parts(left_side, l_files)
+        r_parts = None if l_parts is None \
+            else self._side_bucket_parts(right_side, r_files)
+        if l_parts is None or r_parts is None:
+            del self.stats["scans"][scans_mark:]
+            return None
+        shared = sorted(set(l_parts) & set(r_parts))
         if not shared:
             return None  # rare: plain path produces the empty result with
             # the correct joined schema
+        self.stats["joins"].append({
+            "strategy": "bucketed",
+            "buckets": len(shared),
+            "hybrid": bool(left_side.appended or right_side.appended),
+        })
+
         def join_bucket(bucket: int) -> pa.Table:
-            sub = Join(
-                _rewrap(l_scan, l_wrap, l_by_bucket[bucket]),
-                _rewrap(r_scan, r_wrap, r_by_bucket[bucket]),
-                plan.condition, plan.how)
-            # _rewrap strips bucket_spec, so this recursion takes the plain
-            # per-bucket join path — no re-entry.
-            return self._join(sub)
+            sub = Join(l_parts[bucket](), r_parts[bucket](),
+                       plan.condition, plan.how)
+            # Per-bucket plans carry no bucket_spec, so this recursion takes
+            # the plain per-bucket join path — no re-entry.
+            return self._join(sub, _record=False)
 
         from hyperspace_tpu.utils.parallel_map import parallel_map_ordered
 
@@ -332,18 +371,114 @@ class Executor:
         parts = parallel_map_ordered(join_bucket, shared, max_workers=4)
         return pa.concat_tables(parts, promote_options="default")
 
+    def _side_bucket_parts(self, side: "_BucketedSide", by_bucket):
+        """bucket id -> zero-arg builder of that bucket's sub-plan for one
+        join side, or None when the side can't be decomposed.  Index files
+        group by the bucket id in their name (``by_bucket``, precomputed by
+        the caller); appended rows (hybrid scan) are routed with the build
+        hash kernel."""
+        appended_by_bucket: Dict[int, pa.Table] = {}
+        if side.appended is not None:
+            table = self.execute(side.appended)
+            num_buckets, cols, _sort = side.scan.relation.bucket_spec
+            routed = self._route_to_buckets(table, cols, num_buckets, side.scan)
+            if routed is None:
+                return None
+            appended_by_bucket = routed
 
-def _bucketed_chain(node: LogicalPlan):
-    """(scan, wrappers) when ``node`` is a (Project|Filter)* chain over a
-    bucketed index scan with explicit file paths; None otherwise."""
+        def make(bucket: int) -> LogicalPlan:
+            parts: List[LogicalPlan] = []
+            if bucket in by_bucket:
+                parts.append(_rewrap(side.scan, side.inner, by_bucket[bucket]))
+            if bucket in appended_by_bucket:
+                parts.append(InMemory(appended_by_bucket[bucket]))
+            node = parts[0] if len(parts) == 1 else Union(parts)
+            for w in reversed(side.outer):
+                node = w.with_children((node,))
+            return node
+
+        return {b: (lambda b=b: make(b))
+                for b in set(by_bucket) | set(appended_by_bucket)}
+
+    def _route_to_buckets(self, table: pa.Table, cols, num_buckets: int,
+                          index_scan: Scan) -> Optional[Dict[int, pa.Table]]:
+        """Partition ``table`` by the index's bucket assignment.  Uses the
+        host mirror of the build kernel (bit-identical, parity-tested):
+        hybrid-scan thresholds cap appended bytes at a fraction of the
+        index, so these batches are small and a device round trip would be
+        pure latency.  Key columns are cast to the index's STORED type
+        first — the kernel hashes raw bits, so an int64 row hashed as
+        float64 would land in the wrong bucket."""
+        from hyperspace_tpu.io.columnar import to_hash_words
+        from hyperspace_tpu.io.parquet import schema_to_arrow
+        from hyperspace_tpu.ops.hash import bucket_ids_np
+
+        if table.num_rows == 0:
+            return {}
+        by_lower = {c.lower(): c for c in table.column_names}
+        stored = {k.lower(): v
+                  for k, v in self.session.schema_map_of(index_scan).items()}
+        word_cols = []
+        for c in cols:
+            name = by_lower.get(c.lower())
+            if name is None:
+                return None
+            col = table.column(name)
+            stored_type = stored.get(c.lower())
+            if stored_type is not None and str(col.type) != stored_type:
+                target = schema_to_arrow({"c": stored_type}).field(0).type
+                try:
+                    col = pc.cast(col, target)
+                except (pa.ArrowInvalid, pa.ArrowNotImplementedError,
+                        pa.ArrowTypeError):
+                    return None
+            word_cols.append(np.asarray(to_hash_words(col)))
+        bucket_ids = bucket_ids_np(word_cols, num_buckets)
+        return {int(b): table.filter(pa.array(bucket_ids == b))
+                for b in np.unique(bucket_ids)}
+
+
+class _BucketedSide:
+    """One join side decomposed for bucket-aligned execution: the bucketed
+    index ``scan``, ``inner`` wrappers between the hybrid BucketUnion and
+    the scan (empty when there is no union), ``outer`` wrappers above, and
+    the ``appended`` subtree (None when the side is a pure index chain)."""
+
+    def __init__(self, scan: Scan, inner, outer, appended) -> None:
+        self.scan = scan
+        self.inner = inner
+        self.outer = outer
+        self.appended = appended
+
+
+def _is_bucketed_index_scan(node: LogicalPlan) -> bool:
+    return (isinstance(node, Scan) and bool(node.relation.bucket_spec)
+            and node.relation.file_paths is not None
+            and bool(node.relation.index_scan_of))
+
+
+def _unwrap_chain(node: LogicalPlan):
     wrappers: List[LogicalPlan] = []
     while isinstance(node, (Project, Filter)):
         wrappers.append(node)
         node = node.children[0]
-    if isinstance(node, Scan) and node.relation.bucket_spec \
-            and node.relation.file_paths is not None \
-            and node.relation.index_scan_of:
-        return node, wrappers
+    return wrappers, node
+
+
+def _bucketed_side(node: LogicalPlan) -> Optional[_BucketedSide]:
+    """Match ``(Project|Filter)*`` over either a bucketed index scan or a
+    hybrid-scan ``BucketUnion(index chain, appended subtree)``."""
+    outer, node = _unwrap_chain(node)
+    if _is_bucketed_index_scan(node):
+        return _BucketedSide(node, [], outer, None)
+    if isinstance(node, BucketUnion) and len(node.children) == 2:
+        # The rule constructs [index_side, appended_side]; identify the
+        # index chain structurally rather than by position.
+        for index_child, appended_child in (node.children,
+                                            node.children[::-1]):
+            inner, leaf = _unwrap_chain(index_child)
+            if _is_bucketed_index_scan(leaf):
+                return _BucketedSide(leaf, inner, outer, appended_child)
     return None
 
 

@@ -212,6 +212,28 @@ class BucketUnion(LogicalPlan):
         return f"BucketUnion (buckets={self.bucket_spec[0]})"
 
 
+class InMemory(LogicalPlan):
+    """A materialized table literal.  Execution-internal: the bucket-aligned
+    hybrid join routes appended rows through the build hash kernel into
+    per-bucket batches and re-injects each batch via this node (the analog
+    of the reference's on-the-fly RepartitionByExpression output,
+    RuleUtils.scala:511-570).  Never produced by the rewrite rules."""
+
+    def __init__(self, table) -> None:
+        self.table = table
+        self.children = ()
+
+    def output_columns(self, schema_of) -> List[str]:
+        return list(self.table.column_names)
+
+    def with_children(self, children) -> "InMemory":
+        assert not children
+        return self
+
+    def simple_string(self) -> str:
+        return f"InMemory [{self.table.num_rows} rows]"
+
+
 class Union(LogicalPlan):
     """Plain union (the non-bucketed hybrid-scan merge, RuleUtils.scala:422-439)."""
 

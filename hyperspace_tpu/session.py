@@ -85,6 +85,9 @@ class HyperspaceSession:
         # sees one snapshot, so memoizing there is safe; across queries it
         # would go stale (overwrites can change the schema mid-session).
         self._lake_schema_memo: Optional[Dict[object, Dict[str, str]]] = None
+        # Physical stats of the most recent Dataset.collect() on this
+        # session (join strategies, scan file counts) — see Executor.stats.
+        self.last_execution_stats: Optional[Dict[str, list]] = None
 
     # -- plumbing -----------------------------------------------------------
     @property
