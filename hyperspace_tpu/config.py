@@ -72,7 +72,8 @@ class HyperspaceConf:
     source_providers: str = "default,delta,iceberg"
     signature_provider: str = "IndexSignatureProvider"
     event_logger: str = ""
-    supported_file_formats: str = "parquet,csv,json,orc"
+    # Reference default allow-list (HyperspaceConf.scala:97).
+    supported_file_formats: str = "avro,csv,json,orc,parquet,text"
     # TPU data-plane tunable: kernel row dimensions are padded up to the
     # next multiple of this, so builds of different datasets share one
     # compiled program per capacity instead of paying a fresh XLA compile

@@ -344,13 +344,14 @@ class Executor:
         l_parts = self._side_bucket_parts(left_side, l_files)
         r_parts = None if l_parts is None \
             else self._side_bucket_parts(right_side, r_files)
-        if l_parts is None or r_parts is None:
+        shared = [] if l_parts is None or r_parts is None \
+            else sorted(set(l_parts) & set(r_parts))
+        if not shared:
+            # Decomposition failed (or zero overlapping buckets — the plain
+            # path produces the empty result with the correct joined
+            # schema): roll back anything recorded while probing.
             del self.stats["scans"][scans_mark:]
             return None
-        shared = sorted(set(l_parts) & set(r_parts))
-        if not shared:
-            return None  # rare: plain path produces the empty result with
-            # the correct joined schema
         self.stats["joins"].append({
             "strategy": "bucketed",
             "buckets": len(shared),

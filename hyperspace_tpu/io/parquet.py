@@ -121,6 +121,19 @@ def _read_one(path: str, file_format: str, columns, options: Dict[str, str]) -> 
             return paorc.read_table(
                 path, columns=[c for c in columns if c in present])
         return paorc.read_table(path)
+    elif file_format == "avro":
+        from hyperspace_tpu.io import avro as hsavro
+
+        return hsavro.to_arrow_table(path, columns)
+    elif file_format == "text":
+        # Spark's text source shape: one string column "value", one row per
+        # line (DefaultFileBasedSource.scala:37-43's allow-listed format).
+        with open(path, "rb") as f:
+            lines = f.read().decode("utf-8").splitlines()
+        table = pa.table({"value": pa.array(lines, type=pa.string())})
+        if columns is not None:
+            return table.select([c for c in columns if c in table.column_names])
+        return table
     else:
         raise ValueError(f"Unsupported file format: {file_format!r}")
     if columns:
@@ -139,6 +152,14 @@ def read_schema(path: str, file_format: str = "parquet",
 
         # ORC footers carry the schema — no data read needed.
         return {f.name: str(f.type) for f in paorc.ORCFile(path).schema}
+    if file_format == "avro":
+        from hyperspace_tpu.io import avro as hsavro
+
+        # Container headers carry the writer schema — no record decode.
+        return {f.name: str(f.type) for f in hsavro.avro_schema_to_arrow(
+            hsavro.read_schema_only(path))}
+    if file_format == "text":
+        return {"value": "string"}
     table = _read_one(path, file_format, None, options or {})
     return {f.name: str(f.type) for f in table.schema}
 

@@ -42,6 +42,12 @@ class DataReader:
     def json(self, *paths: str, **options: str):
         return self._make("json", *paths, **options)
 
+    def avro(self, *paths: str, **options: str):
+        return self._make("avro", *paths, **options)
+
+    def text(self, *paths: str, **options: str):
+        return self._make("text", *paths, **options)
+
     def orc(self, *paths: str, **options: str):
         return self._make("orc", *paths, **options)
 

@@ -47,9 +47,21 @@ def _write_json(root, n=50):
             f.write(json.dumps({"id": i, "name": f"n{i}"}) + "\n")
 
 
+def _write_avro(root, n=50):
+    from hyperspace_tpu.io.avro import write_container
+
+    os.makedirs(root)
+    schema = {"type": "record", "name": "row", "fields": [
+        {"name": "id", "type": "long"},
+        {"name": "name", "type": "string"}]}
+    write_container(os.path.join(root, "part-0.avro"), schema,
+                    [{"id": i, "name": f"n{i}"} for i in range(n)])
+
+
 @pytest.mark.parametrize("fmt,writer", [("csv", _write_csv),
                                         ("json", _write_json),
-                                        ("orc", _write_orc)])
+                                        ("orc", _write_orc),
+                                        ("avro", _write_avro)])
 def test_index_lifecycle_over_format(session, tmp_path, fmt, writer):
     root = str(tmp_path / "data")
     writer(root)
@@ -73,6 +85,51 @@ def test_index_lifecycle_over_format(session, tmp_path, fmt, writer):
     assert got.num_rows == 1
     hs.delete_index("fi")
     hs.vacuum_index("fi")
+
+
+def test_index_lifecycle_over_text(session, tmp_path):
+    """Text source: one string column "value", one row per line (the last
+    format on the reference's default allow-list, HyperspaceConf.scala:97)."""
+    root = str(tmp_path / "data")
+    os.makedirs(root)
+    with open(os.path.join(root, "part-0.txt"), "w") as f:
+        for i in range(50):
+            f.write(f"line-{i}\n")
+    hs = Hyperspace(session)
+    df = session.read.text(root)
+    hs.create_index(df, IndexConfig("ti", ["value"]))
+    session.enable_hyperspace()
+    ds = df.filter(col("value") == "line-7")
+    plan = ds.optimized_plan()
+    assert [s for s in plan.leaf_relations() if s.relation.index_scan_of], \
+        plan.tree_string()
+    got = ds.collect()
+    session.disable_hyperspace()
+    assert got.equals(ds.collect())
+    assert got.column("value").to_pylist() == ["line-7"]
+
+
+def test_avro_incremental_refresh(session, tmp_path):
+    """Appending an avro file and refreshing incrementally reindexes only
+    the new file (RefreshIncrementalAction semantics over the avro reader)."""
+    from hyperspace_tpu.io.avro import write_container
+
+    root = str(tmp_path / "data")
+    _write_avro(root)
+    hs = Hyperspace(session)
+    df = session.read.avro(root)
+    hs.create_index(df, IndexConfig("ai", ["id"], ["name"]))
+    schema = {"type": "record", "name": "row", "fields": [
+        {"name": "id", "type": "long"},
+        {"name": "name", "type": "string"}]}
+    write_container(os.path.join(root, "part-1.avro"), schema,
+                    [{"id": 999, "name": "appended"}])
+    hs.refresh_index("ai", "incremental")
+    session.enable_hyperspace()
+    ds = session.read.avro(root).filter(col("id") == 999).select("id", "name")
+    assert [s for s in ds.optimized_plan().leaf_relations()
+            if s.relation.index_scan_of]
+    assert ds.collect().column("name").to_pylist() == ["appended"]
 
 
 def test_unsupported_format_rejected(session, tmp_path):
