@@ -524,6 +524,24 @@ def _concat_horizontal(left: pa.Table, right: pa.Table) -> pa.Table:
     return pa.table(dict(zip(names, cols)))
 
 
+def _parse_numeric(column, target_type) -> pa.Array:
+    """Parse a string column as ``target_type``, null on failure — the
+    Spark coercion for string-column vs numeric-literal comparisons
+    ('05' == 5 and '5.0' == 5 match via the double promotion; 'abc'
+    becomes null and the row drops)."""
+    try:
+        return pc.cast(column, target_type)
+    except (pa.ArrowInvalid, pa.ArrowTypeError):
+        py = float if pa.types.is_floating(target_type) else int
+        values = []
+        for v in column.to_pylist():
+            try:
+                values.append(py(v) if v is not None else None)
+            except (ValueError, TypeError):
+                values.append(None)
+        return pa.array(values, type=target_type)
+
+
 def _arrow_eval(expr: Expr, table: pa.Table):
     if isinstance(expr, Col):
         return table.column(expr.name)
@@ -537,18 +555,30 @@ def _arrow_eval(expr: Expr, table: pa.Table):
         try:
             return ops[expr.op](left, right)
         except pa.ArrowNotImplementedError:
-            # Spark-style literal coercion: a scalar of a different type is
-            # cast to the column's type (e.g. "2024" vs an int64 partition
-            # column).  Uncastable literals re-raise the original error.
-            def cast_scalar(scalar, target):
+            # Spark-style coercion.  String column vs numeric literal: Spark
+            # casts the STRING side to the numeric type ('05' == 5 matches),
+            # so cast the column, not the literal.  Otherwise a scalar of a
+            # different type is cast to the column's type (e.g. "2024" vs an
+            # int64 partition column).  Uncastable values re-raise.
+            def coerced(scalar, column):
+                if (pa.types.is_string(column.type)
+                        and (pa.types.is_integer(scalar.type)
+                             or pa.types.is_floating(scalar.type))):
+                    # Spark promotes string-vs-numeric to DOUBLE, so
+                    # '5.0' == 5 and '5e0' == 5 both match.
+                    target = pa.float64()
+                    return pc.cast(scalar, target), \
+                        _parse_numeric(column, target)
                 # pc.cast parses, e.g. string "2024" -> int64 2024.
-                return pc.cast(scalar, target.type)
+                return pc.cast(scalar, column.type), column
 
             try:
                 if isinstance(left, pa.Scalar) and not isinstance(right, pa.Scalar):
-                    return ops[expr.op](cast_scalar(left, right), right)
+                    lhs, rhs = coerced(left, right)
+                    return ops[expr.op](lhs, rhs)
                 if isinstance(right, pa.Scalar) and not isinstance(left, pa.Scalar):
-                    return ops[expr.op](left, cast_scalar(right, left))
+                    rhs, lhs = coerced(right, left)
+                    return ops[expr.op](lhs, rhs)
             except (pa.ArrowInvalid, pa.ArrowTypeError, ValueError, TypeError):
                 pass
             raise

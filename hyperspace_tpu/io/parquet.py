@@ -64,18 +64,29 @@ def read_table(paths: Sequence[str], file_format: str = "parquet",
         # hybrid subsets) or concatenation breaks.
         spec = partition_spec if partition_spec is not None \
             else partition_spec_for_roots(partition_roots)
-        if spec and paths and file_format == "parquet":
-            # A column present in the data files wins over the path value —
-            # consistently, whether or not a projection is pushed down.
-            in_file = set(pq.read_schema(paths[0]).names)
-            spec = {k: t for k, t in spec.items() if k not in in_file}
-        if spec and columns:
+        if spec and columns and file_format != "parquet":
             # Partition columns come from paths, not file data.
             file_columns = [c for c in columns if c not in spec]
+
     def load(path: str) -> pa.Table:
-        t = _read_one(path, file_format, file_columns, options or {})
-        if spec:
-            t = attach_partition_columns(t, path, partition_roots, spec,
+        file_spec, cols = spec, file_columns
+        if spec and file_format == "parquet":
+            # A column present in THIS data file wins over the path value;
+            # in a mixed-schema file set the decision must be per file, or
+            # files lacking the column get nulls instead of the path value.
+            # One ParquetFile serves both the schema decision and the read —
+            # pq.read_table after pq.read_schema would parse the footer twice.
+            pf = pq.ParquetFile(path)
+            present = set(pf.schema_arrow.names)
+            file_spec = {k: t for k, t in spec.items() if k not in present}
+            if columns is not None:
+                cols = [c for c in columns if c not in file_spec]
+            t = pf.read(columns=None if cols is None
+                        else [c for c in cols if c in present])
+        else:
+            t = _read_one(path, file_format, cols, options or {})
+        if file_spec:
+            t = attach_partition_columns(t, path, partition_roots, file_spec,
                                          columns)
         return t
 
@@ -128,8 +139,13 @@ def _read_one(path: str, file_format: str, columns, options: Dict[str, str]) -> 
     elif file_format == "text":
         # Spark's text source shape: one string column "value", one row per
         # line (DefaultFileBasedSource.scala:37-43's allow-listed format).
+        # Split on \n / \r / \r\n ONLY — str.splitlines would also split on
+        # \x0b, \x85, U+2028 etc., diverging from Hadoop's LineRecordReader.
         with open(path, "rb") as f:
-            lines = f.read().decode("utf-8").splitlines()
+            text = f.read().decode("utf-8")
+        lines = text.replace("\r\n", "\n").replace("\r", "\n").split("\n")
+        if lines and lines[-1] == "":
+            lines.pop()  # trailing newline does not make an empty last row
         table = pa.table({"value": pa.array(lines, type=pa.string())})
         if columns is not None:
             return table.select([c for c in columns if c in table.column_names])

@@ -37,6 +37,17 @@ class AddFile:
     modification_time: int  # milliseconds, from the log — not the filesystem
 
 
+@dataclasses.dataclass(frozen=True)
+class RemoveFile:
+    """Tombstone for a removed data file (absolute path).  Carried in
+    snapshots and checkpoints until the retention window expires so
+    concurrent readers of an older version can still resolve the file —
+    the protocol's VACUUM-safety mechanism."""
+
+    path: str
+    deletion_timestamp: int  # milliseconds
+
+
 @dataclasses.dataclass
 class DeltaMetadata:
     schema_string: str = ""
@@ -50,6 +61,7 @@ class Snapshot:
     version: int
     files: List[AddFile]
     metadata: DeltaMetadata
+    tombstones: List[RemoveFile] = dataclasses.field(default_factory=list)
 
 
 class DeltaLog:
@@ -131,6 +143,7 @@ class DeltaLog:
                 f"Version {version} does not exist in {self.table_path} "
                 f"(latest is {latest})")
         active: Dict[str, AddFile] = {}
+        tombstones: Dict[str, RemoveFile] = {}
         metadata = DeltaMetadata()
 
         # Start from the newest checkpoint at or below the target version.
@@ -138,7 +151,7 @@ class DeltaLog:
         usable = [c for c in self.checkpoint_versions() if c <= version]
         if usable:
             cp = usable[-1]
-            metadata, active = self._read_checkpoint(cp)
+            metadata, active, tombstones = self._read_checkpoint(cp)
             start = cp + 1
 
         commits = [v for v in self.commit_versions() if start <= v <= version]
@@ -150,20 +163,28 @@ class DeltaLog:
                 f"{version} of {self.table_path}")
         for v in commits:
             for action in self._commit_actions(v):
-                self._apply(action, active, metadata)
+                self._apply(action, active, metadata, tombstones)
         return Snapshot(version, sorted(active.values(), key=lambda f: f.path),
-                        metadata)
+                        metadata,
+                        sorted(tombstones.values(), key=lambda f: f.path))
 
     def _apply(self, action: Dict[str, Any], active: Dict[str, AddFile],
-               metadata: DeltaMetadata) -> None:
+               metadata: DeltaMetadata,
+               tombstones: Optional[Dict[str, RemoveFile]] = None) -> None:
         if "add" in action and action["add"]:
             a = action["add"]
             path = self._absolute(a["path"])
             active[path] = AddFile(path, int(a["size"]),
                                    int(a.get("modificationTime", 0)))
+            if tombstones is not None:
+                tombstones.pop(path, None)
         elif "remove" in action and action["remove"]:
-            path = self._absolute(action["remove"]["path"])
+            r = action["remove"]
+            path = self._absolute(r["path"])
             active.pop(path, None)
+            if tombstones is not None:
+                tombstones[path] = RemoveFile(
+                    path, int(r.get("deletionTimestamp") or 0))
         elif "metaData" in action and action["metaData"]:
             m = action["metaData"]
             metadata.schema_string = m.get("schemaString", "")
@@ -197,10 +218,11 @@ class DeltaLog:
         table = pq.read_table(path)
         metadata = DeltaMetadata()
         active: Dict[str, AddFile] = {}
+        tombstones: Dict[str, RemoveFile] = {}
         for row in table.to_pylist():
             self._apply({k: v for k, v in row.items() if v is not None},
-                        active, metadata)
-        return metadata, active
+                        active, metadata, tombstones)
+        return metadata, active, tombstones
 
     # -- writing ------------------------------------------------------------
     def write_commit(self, version: int, actions: List[Dict[str, Any]]) -> str:

@@ -118,7 +118,17 @@ _CHECKPOINT_SCHEMA = pa.schema([
         ("modificationTime", pa.int64()),
         ("dataChange", pa.bool_()),
     ])),
+    ("remove", pa.struct([
+        ("path", pa.string()),
+        ("deletionTimestamp", pa.int64()),
+        ("dataChange", pa.bool_()),
+    ])),
 ])
+
+# delta-core's delta.deletedFileRetentionDuration default ("interval 1 week"):
+# remove tombstones younger than this must survive checkpointing so readers
+# of older versions can still resolve the files (VACUUM safety).
+TOMBSTONE_RETENTION_MS = 7 * 24 * 3600 * 1000
 
 
 def _maybe_checkpoint(log: DeltaLog, version: int) -> None:
@@ -141,8 +151,8 @@ def _maybe_checkpoint(log: DeltaLog, version: int) -> None:
         snap = log.snapshot(version)
         rows = [
             {"protocol": {"minReaderVersion": 1, "minWriterVersion": 2},
-             "metaData": None, "add": None},
-            {"protocol": None, "add": None, "metaData": {
+             "metaData": None, "add": None, "remove": None},
+            {"protocol": None, "add": None, "remove": None, "metaData": {
                 "id": snap.metadata.id,
                 "format": {"provider": "parquet"},
                 "schemaString": snap.metadata.schema_string,
@@ -151,14 +161,30 @@ def _maybe_checkpoint(log: DeltaLog, version: int) -> None:
                 "createdTime": None,
             }},
         ]
+        # Checkpoint actions carry dataChange=false: they restate existing
+        # state, and a streaming reader bootstrapping from the checkpoint
+        # must not re-process them as new changes.
         for f in snap.files:
-            rows.append({"protocol": None, "metaData": None, "add": {
-                "path": _relativize(f.path, log.table_path),
-                "partitionValues": [],
-                "size": f.size,
-                "modificationTime": f.modification_time,
-                "dataChange": True,
-            }})
+            rows.append({"protocol": None, "metaData": None, "remove": None,
+                         "add": {
+                             "path": _relativize(f.path, log.table_path),
+                             "partitionValues": [],
+                             "size": f.size,
+                             "modificationTime": f.modification_time,
+                             "dataChange": False,
+                         }})
+        # Unexpired remove tombstones ride along (delta-core checkpoint
+        # schema): external readers pinned to an older version rely on them
+        # within the retention window.
+        horizon = int(time.time() * 1000) - TOMBSTONE_RETENTION_MS
+        for t in snap.tombstones:
+            if t.deletion_timestamp >= horizon:
+                rows.append({"protocol": None, "metaData": None, "add": None,
+                             "remove": {
+                                 "path": _relativize(t.path, log.table_path),
+                                 "deletionTimestamp": t.deletion_timestamp,
+                                 "dataChange": False,
+                             }})
         cp_path = os.path.join(log.log_path,
                                f"{version:020d}.checkpoint.parquet")
         tmp = cp_path + f".tmp{os.getpid()}"

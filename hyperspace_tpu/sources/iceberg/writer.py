@@ -60,6 +60,21 @@ def _evolve_schema(metadata: TableMetadata, arrow_schema: pa.Schema) -> Dict:
     return {"type": "struct", "schema-id": 0, "fields": fields}
 
 
+def _check_append_schema(metadata: TableMetadata, arrow_schema: pa.Schema,
+                         path: str) -> None:
+    """Appends pin the table schema, so a mismatched table would commit
+    silently and only surface later as null columns at read time; fail the
+    commit instead (Iceberg writers validate the same way)."""
+    fresh = {f["name"]: f["type"] for f in iceberg_schema(arrow_schema)["fields"]}
+    existing = {f["name"]: f["type"]
+                for f in metadata.schema.get("fields", [])}
+    if fresh != existing:
+        raise ValueError(
+            f"Appended data schema {sorted(fresh.items())} does not match "
+            f"table schema {sorted(existing.items())} of Iceberg table "
+            f"{path}; use mode='overwrite' to change the schema")
+
+
 def _write_manifest(table_path: str, entries: List[Dict],
                     snapshot_id: int) -> Dict:
     name = f"{uuid.uuid4().hex}-m0.avro"
@@ -155,6 +170,7 @@ def write_iceberg(data: pa.Table, path: str, mode: str = "append") -> int:
     # Overwrite may change the schema (appends must conform to the table's);
     # stale schema metadata would make readers resolve the wrong column set.
     if metadata and mode == "append":
+        _check_append_schema(metadata, data.schema, path)
         schema = metadata.schema
     elif metadata:
         schema = _evolve_schema(metadata, data.schema)

@@ -336,3 +336,29 @@ class TestDeltaSchemaEdges:
         assert len(snap.files) == 12
         out = session.read.delta(path).select("id").collect()
         assert out.num_rows == 12
+
+    def test_checkpoint_carries_remove_tombstones(self, tmp_path):
+        """Unexpired remove actions survive checkpointing (delta-core's
+        checkpoint schema): external readers pinned to an older version
+        rely on tombstones within the retention window."""
+        path = str(tmp_path / "t")
+        for i in range(9):
+            write_delta(_table([i]), path, mode="append")
+        victim = DeltaLog(path).snapshot().files[0].path
+        delete_where_file(path, victim)  # v9: remove
+        write_delta(_table([99]), path, mode="append")  # v10: checkpoint
+        log_dir = os.path.join(path, "_delta_log")
+        cp = os.path.join(log_dir, f"{10:020d}.checkpoint.parquet")
+        assert os.path.isfile(cp)
+        removes = [r["remove"] for r in pq.read_table(cp).to_pylist()
+                   if r.get("remove")]
+        assert [os.path.basename(victim)] == \
+            [os.path.basename(r["path"]) for r in removes]
+        assert removes[0]["deletionTimestamp"] > 0
+        # Replay through the checkpoint keeps the tombstone AND the file out
+        # of the active set.
+        for v in range(10):
+            os.remove(os.path.join(log_dir, f"{v:020d}.json"))
+        snap = DeltaLog(path).snapshot()
+        assert victim not in {f.path for f in snap.files}
+        assert victim in {t.path for t in snap.tombstones}

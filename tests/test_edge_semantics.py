@@ -124,6 +124,27 @@ def test_date_column_with_nulls_indexes_cleanly(tmp_path):
     assert got.column("v").to_pylist() == [3]
 
 
+def test_string_column_vs_numeric_literal_coerces_numerically(tmp_path):
+    """Spark promotes string-vs-numeric comparisons to DOUBLE, so
+    '05' == 5, '5.0' == 5 and '5e0' == 5 all match and '12' < 7 is
+    numeric (not lexicographic); unparseable strings become null and
+    drop."""
+    data = str(tmp_path / "s")
+    os.makedirs(data)
+    pq.write_table(pa.table({
+        "code": ["05", "5", "12", "abc", None, "5.0", "5e0"],
+        "name": ["a", "b", "c", "d", "e", "f", "g"],
+    }), os.path.join(data, "f.parquet"))
+    session = HyperspaceSession(system_path=str(tmp_path / "idx"))
+    ds = session.read.parquet(data)
+    eq = ds.filter(col("code") == 5).select("name").collect()
+    assert sorted(eq.column("name").to_pylist()) == ["a", "b", "f", "g"]
+    lt = ds.filter(col("code") < 7).select("name").collect()
+    assert sorted(lt.column("name").to_pylist()) == ["a", "b", "f", "g"]
+    fl = ds.filter(col("code") >= 5.0).select("name").collect()
+    assert sorted(fl.column("name").to_pylist()) == ["a", "b", "c", "f", "g"]
+
+
 def test_constant_predicate_routes_to_host(tmp_path):
     from hyperspace_tpu import lit
 

@@ -109,6 +109,19 @@ def test_index_lifecycle_over_text(session, tmp_path):
     assert got.column("value").to_pylist() == ["line-7"]
 
 
+def test_text_splits_newlines_only(session, tmp_path):
+    """Hadoop's LineRecordReader splits on \\n / \\r / \\r\\n only: an
+    embedded U+2028 or vertical tab stays inside its line (str.splitlines
+    would split there), and a trailing newline adds no empty row."""
+    root = str(tmp_path / "data")
+    os.makedirs(root)
+    with open(os.path.join(root, "part-0.txt"), "wb") as f:
+        f.write("a b\nc\x0bd\r\ne\rlast\n".encode("utf-8"))
+    out = session.read.text(root).collect()
+    assert out.column("value").to_pylist() == ["a b", "c\x0bd", "e",
+                                               "last"]
+
+
 def test_avro_incremental_refresh(session, tmp_path):
     """Appending an avro file and refreshing incrementally reindexes only
     the new file (RefreshIncrementalAction semantics over the avro reader)."""

@@ -131,6 +131,25 @@ class TestIcebergTable:
         assert len(left) == 1
         assert left[0].path != files[0].path
 
+    def test_append_schema_mismatch_raises(self, tmp_path):
+        """Appends pin the table schema; a mismatched table must fail the
+        commit instead of surfacing later as null columns at read time."""
+        path = str(tmp_path / "t")
+        write_iceberg(_table([1, 2]), path)
+        bad = pa.table({"id": pa.array([3], type=pa.int64()),
+                        "extra": pa.array(["x"])})
+        with pytest.raises(ValueError, match="does not match"):
+            write_iceberg(bad, path, mode="append")
+        # Same columns, different type: also rejected.
+        retyped = pa.table({"id": pa.array([3.0], type=pa.float64()),
+                            "name": pa.array(["n"]),
+                            "other": pa.array([30], type=pa.int64())})
+        with pytest.raises(ValueError, match="does not match"):
+            write_iceberg(retyped, path, mode="append")
+        # Overwrite is the sanctioned schema-change path.
+        write_iceberg(bad, path, mode="overwrite")
+        assert len(IcebergTable(path).plan_files()) == 1
+
     def test_snapshot_for_timestamp(self, tmp_path):
         path = str(tmp_path / "t")
         s0 = write_iceberg(_table([1]), path)

@@ -207,3 +207,27 @@ class TestSpecConsistency:
         projected = session.read.parquet(root).select("id", "date").collect()
         assert full.column("date").to_pylist() == [1999]
         assert projected.column("date").to_pylist() == [1999]
+
+    def test_mixed_schema_file_vs_path_conflict_is_per_file(self, session,
+                                                            tmp_path):
+        """In a mixed-schema set the file-wins rule applies PER FILE: a file
+        lacking the column takes the path value, not null — whichever file
+        the reader happens to list first."""
+        root = str(tmp_path / "data")
+        d = os.path.join(root, "date=2024")
+        os.makedirs(d)
+        # part-0 physically stores date, part-1 does not.
+        pq.write_table(pa.table({
+            "id": pa.array([1], type=pa.int64()),
+            "date": pa.array([1999], type=pa.int64()),
+        }), os.path.join(d, "part-0.parquet"))
+        pq.write_table(pa.table({"id": pa.array([2], type=pa.int64())}),
+                       os.path.join(d, "part-1.parquet"))
+        for sel in (None, ("id", "date")):
+            df = session.read.parquet(root)
+            if sel:
+                df = df.select(*sel)
+            out = df.collect()
+            by_id = dict(zip(out.column("id").to_pylist(),
+                             out.column("date").to_pylist()))
+            assert by_id == {1: 1999, 2: 2024}
