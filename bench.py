@@ -147,9 +147,10 @@ def main() -> None:
                         DataSkippingIndexConfig("li_ds", ["l_shipdate"]))
         # Z-order over (shipdate, extendedprice): range queries on the
         # second dimension prune files (BASELINE config 5's shape).  One
-        # bucket + 32 files along the Z-curve: the file split must cut BOTH
-        # dimensions' top bits for second-dimension pruning to bite.
-        session.conf.index_max_rows_per_file = N_LINEITEM // 32
+        # bucket, ~64-file target along the Z-curve; the writer aligns file
+        # cuts to Z-cell boundaries (io/parquet.zorder_split_chunks) so each
+        # file stays narrow on BOTH dimensions.
+        session.conf.index_max_rows_per_file = N_LINEITEM // 64
         session.conf.num_buckets = 1
         hs.create_index(session.read.parquet(lineitem_dir),
                         IndexConfig("li_z", ["l_shipdate", "l_extendedprice"],

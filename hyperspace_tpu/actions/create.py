@@ -74,6 +74,13 @@ class CreateActionBase(Action):
 
     @property
     def num_buckets(self) -> int:
+        # Z-order clusters via Morton-ordered file cuts, and hash
+        # bucketing would scatter that clustering across buckets (a file
+        # per bucket sees near-uniform value ranges on every dimension —
+        # no pruning).  One bucket makes the whole index a single Z-curve
+        # run; file granularity comes from index_max_rows_per_file.
+        if getattr(self.config, "layout", None) == "zorder":
+            return 1
         return self.conf.num_buckets
 
     @property
@@ -201,7 +208,24 @@ class CreateActionBase(Action):
 
     def _write_table_bucketed(self, table: pa.Table, resolved: IndexConfig,
                               version: Optional[int] = None) -> None:
-        if self._use_distributed_build():
+        # Z-order: Morton codes are computed ONCE on host (global dense
+        # ranks need a global pass, and the codes double as the writer's
+        # split keys — files cut at Z-cell boundaries,
+        # io/parquet.zorder_split_chunks, so every file's per-dimension
+        # min/max stays narrow).  The permutation is simply their argsort:
+        # there is no device shuffle to do for a one-bucket index, and a
+        # hash shuffle would fragment the curve into per-partition samples,
+        # gutting the pruning — so every build mode takes this path and
+        # produces the identical, environment-independent layout.
+        split_keys, split_bits = (None, 0)
+        if resolved.layout == "zorder":
+            from hyperspace_tpu.io.parquet import zorder_codes_host
+
+            split_keys, split_bits = zorder_codes_host(
+                table, resolved.indexed_columns)
+            perm = np.argsort(split_keys, kind="stable")
+            buckets = np.zeros(table.num_rows, dtype=np.int32)
+        elif self._use_distributed_build():
             from hyperspace_tpu.parallel import (
                 build_mesh,
                 distributed_bucket_sort_permutation,
@@ -210,26 +234,26 @@ class CreateActionBase(Action):
             buckets, perm = distributed_bucket_sort_permutation(
                 table, resolved.indexed_columns, self.num_buckets,
                 build_mesh(), slack=self.conf.shuffle_capacity_slack,
-                pad_to=self.conf.device_batch_rows,
-                zorder=resolved.layout == "zorder")
+                pad_to=self.conf.device_batch_rows)
         else:
             from hyperspace_tpu.ops.sort import bucket_sort_permutation
 
             word_cols = [columnar.to_hash_words(table.column(c))
                          for c in resolved.indexed_columns]
-            order_words = [columnar.to_order_words(table.column(c))
-                           for c in resolved.indexed_columns]
+            order_words = [
+                np.asarray(columnar.to_order_words(table.column(c)))
+                for c in resolved.indexed_columns]
             buckets, perm = bucket_sort_permutation(
                 [np.asarray(w) for w in word_cols],
-                [np.asarray(k) for k in order_words],
+                order_words,
                 self.num_buckets,
-                pad_to=self.conf.device_batch_rows,
-                zorder=resolved.layout == "zorder")
+                pad_to=self.conf.device_batch_rows)
         version = self.data_manager.get_next_version() if version is None else version
         out_dir = self.data_manager.version_path(version)
         write_bucketed(table, np.asarray(buckets), np.asarray(perm),
                        self.num_buckets, out_dir,
-                       max_rows_per_file=self.conf.index_max_rows_per_file)
+                       max_rows_per_file=self.conf.index_max_rows_per_file,
+                       split_keys=split_keys, split_key_bits=split_bits)
         self._write_index_file_sketch(out_dir, resolved)
         self._written_version = version
         self._index_schema = {name: str(t) for name, t in
@@ -319,6 +343,17 @@ class _BucketSpill:
             shutil.rmtree(self._dir, ignore_errors=True)
             self._dir = None
 
+    # Spill partition count for the zorder layout (the logical index has
+    # ONE bucket, so without this the final merge would hold the whole
+    # dataset).  Partitions are HASH groups — a pure function of row
+    # values, chunk-order independent, and ~uniform for ANY key
+    # distribution, so phase 2's memory is bounded by ~dataset/16.  Each
+    # partition re-covers the whole key space, so sketch-pruning
+    # granularity through the spill is files-per-PARTITION (a 16x
+    # granularity cost vs the monolithic build at equal file counts) —
+    # the price of bounded memory; keep the count low.
+    ZORDER_SPILL_PARTITIONS = 16
+
     def add_chunk(self, table: pa.Table) -> None:
         import pyarrow.parquet as pq
 
@@ -333,6 +368,8 @@ class _BucketSpill:
         if self._schema is None:
             self._schema = table.schema
         n = table.num_rows
+        num_buckets = self.ZORDER_SPILL_PARTITIONS \
+            if self.resolved.layout == "zorder" else self.action.num_buckets
         capacity = max(1, int(self.action.conf.device_batch_rows))
         capacity = -(-max(n, 1) // capacity) * capacity
         word_cols = [
@@ -340,7 +377,6 @@ class _BucketSpill:
                       capacity)
             for c in self.resolved.indexed_columns
         ]
-        num_buckets = self.action.num_buckets
         buckets = np.asarray(bucket_ids(word_cols, num_buckets))[:n]
         order = np.argsort(buckets, kind="stable")
         sorted_buckets = buckets[order]
@@ -372,7 +408,10 @@ class _BucketSpill:
         max_rows = action.conf.index_max_rows_per_file
 
         def finish_bucket(bname: str) -> None:
-            from hyperspace_tpu.io.parquet import write_bucket_run
+            from hyperspace_tpu.io.parquet import (
+                write_bucket_run,
+                write_zorder_run,
+            )
 
             bdir = os.path.join(self._dir, bname)
             bucket = int(bname.split("=")[1])
@@ -380,6 +419,15 @@ class _BucketSpill:
             btable = pa.concat_tables(
                 [pq.read_table(os.path.join(bdir, r)) for r in runs],
                 promote_options="default")
+            if resolved.layout == "zorder":
+                # The dir name is a SPILL partition (value-space Morton
+                # cell), not an index bucket: the index has one bucket, so
+                # every file is written as bucket 0.  Codes (and therefore
+                # the cell-aligned cuts) are partition-local ranks — see
+                # _sort_permutation's note.
+                write_zorder_run(btable, 0, out_dir, max_rows,
+                                 resolved.indexed_columns)
+                return
             perm = self._sort_permutation(btable)
             btable = btable.take(pa.array(perm))
             write_bucket_run(btable, bucket, out_dir, max_rows)

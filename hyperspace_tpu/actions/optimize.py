@@ -128,8 +128,15 @@ class OptimizeAction(Action):
             merged = pa.concat_tables(
                 [pq.read_table(f.name) for f in files], promote_options="default")
             # Layout-aware: a Z-ordered index must stay Z-ordered through
-            # compaction or its per-file sketches go wide on every
-            # non-primary dimension.
+            # compaction — Morton sort AND Z-cell-aligned file cuts — or its
+            # per-file sketches go wide on every non-primary dimension.
+            if layout == "zorder":
+                from hyperspace_tpu.io.parquet import write_zorder_run
+
+                self._new_files.extend(
+                    write_zorder_run(merged, bucket, out_dir, max_rows,
+                                     sort_cols))
+                continue
             perm = sort_permutation_host(merged, sort_cols, layout)
             merged = merged.take(pa.array(perm))
             # Honor the file-size knob: collapsing a bucket to ONE file

@@ -17,7 +17,7 @@ from __future__ import annotations
 import os
 import re
 import uuid
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 import pyarrow as pa
@@ -207,15 +207,66 @@ def bucket_chunks(n_rows: int, max_rows_per_file: int) -> List:
             for off in range(0, n_rows, chunk)]
 
 
+def zorder_codes_host(table: pa.Table, indexed_columns) -> Tuple[np.ndarray, int]:
+    """(uint64 Morton code per row, total code bits) for a Z-order layout —
+    the writer's file-split key.  Host mirror of the build kernel's codes
+    (ops/zorder.py): dense ranks per column scaled to 16 bits, interleaved."""
+    from hyperspace_tpu.io import columnar
+    from hyperspace_tpu.ops.zorder import zorder_order_words_np
+
+    z = zorder_order_words_np([
+        np.asarray(columnar.to_order_words(table.column(c)))
+        for c in indexed_columns])
+    codes = (z[:, 0].astype(np.uint64) << np.uint64(32)) \
+        | z[:, 1].astype(np.uint64)
+    return codes, 16 * len(list(indexed_columns))
+
+
+def zorder_split_chunks(z_sorted: np.ndarray, key_bits: int,
+                        max_rows_per_file: int) -> List:
+    """[(offset, rows)] for one bucket run ALIGNED to Morton cell
+    boundaries.  Equal-row splits smear a file across two Z-curve cells and
+    widen its per-dimension min/max (the sketch-pruning lever); cutting
+    where the top ``level`` code bits change keeps every file inside one
+    cell, so range predicates on ANY indexed dimension prune sharply.
+    ``max_rows_per_file`` still caps a skewed cell's file size."""
+    n = int(len(z_sorted))
+    if n == 0:
+        return []
+    if max_rows_per_file <= 0 or n <= max_rows_per_file:
+        return [(0, n)]
+    target_files = -(-n // max_rows_per_file)
+    level = max(1, min(key_bits, int(np.ceil(np.log2(target_files)))))
+    cells = z_sorted >> np.uint64(key_bits - level)
+    cuts = (np.flatnonzero(np.diff(cells)) + 1).tolist()
+    bounds = [0, *cuts, n]
+    out: List = []
+    for i in range(len(bounds) - 1):
+        off = bounds[i]
+        for o, r in bucket_chunks(bounds[i + 1] - off, max_rows_per_file):
+            out.append((off + o, r))
+    return out
+
+
 def write_bucket_run(sorted_bucket_table: pa.Table, bucket: int,
-                     out_dir: str, max_rows_per_file: int = 0) -> List[str]:
+                     out_dir: str, max_rows_per_file: int = 0,
+                     split_keys: Optional[np.ndarray] = None,
+                     split_key_bits: int = 0) -> List[str]:
     """Write ONE bucket's already-sorted rows, split at
     ``max_rows_per_file`` — shared by the external build's phase 2 and
     optimize's compaction (both already parallelize per bucket; the
-    monolithic writer parallelizes per chunk via ``bucket_chunks``)."""
+    monolithic writer parallelizes per chunk via ``bucket_chunks``).
+    ``split_keys``: sorted Morton codes for a Z-order layout — files then
+    cut at cell boundaries (``zorder_split_chunks``) instead of row
+    counts."""
+    if split_keys is not None:
+        chunks = zorder_split_chunks(split_keys, split_key_bits,
+                                     max_rows_per_file)
+    else:
+        chunks = bucket_chunks(sorted_bucket_table.num_rows,
+                               max_rows_per_file)
     out: List[str] = []
-    for off, rows in bucket_chunks(sorted_bucket_table.num_rows,
-                                   max_rows_per_file):
+    for off, rows in chunks:
         path = os.path.join(out_dir, bucket_file_name(bucket))
         pq.write_table(sorted_bucket_table.slice(off, rows), path)
         out.append(path)
@@ -225,18 +276,14 @@ def write_bucket_run(sorted_bucket_table: pa.Table, bucket: int,
 def sort_permutation_host(table: pa.Table, indexed_columns, layout: str):
     """Host-side within-bucket sort permutation honoring the index LAYOUT —
     lexicographic over the indexed columns, or Morton order for
-    ``layout == "zorder"`` (per-batch ranks; same shape the external build
-    uses).  Shared by optimize and the external build so a compaction can
-    never silently destroy a Z-order layout."""
+    ``layout == "zorder"`` (per-batch ranks, via the one zorder_codes_host
+    code path).  Z-order callers that also need cell-aligned file cuts use
+    ``write_zorder_run`` instead."""
     from hyperspace_tpu.io import columnar
 
     if layout == "zorder":
-        from hyperspace_tpu.ops.zorder import zorder_order_words_np
-
-        z = zorder_order_words_np([
-            np.asarray(columnar.to_order_words(table.column(c)))
-            for c in indexed_columns])
-        return np.lexsort((z[:, 1], z[:, 0]))
+        codes, _ = zorder_codes_host(table, indexed_columns)
+        return np.argsort(codes, kind="stable")
     keys: List[np.ndarray] = []
     for c in reversed(list(indexed_columns)):
         w = np.asarray(columnar.to_order_words(table.column(c)))
@@ -245,9 +292,24 @@ def sort_permutation_host(table: pa.Table, indexed_columns, layout: str):
     return np.lexsort(tuple(keys))
 
 
+def write_zorder_run(btable: pa.Table, bucket: int, out_dir: str,
+                     max_rows_per_file: int, indexed_columns) -> List[str]:
+    """Morton-sort one bucket run and write it with Z-cell-aligned file
+    cuts — the ONE home for the zorder sort+split contract, shared by the
+    external build's phase 2 and optimize's compaction (a divergence
+    between the two would silently destroy the layout on compaction)."""
+    codes, bits = zorder_codes_host(btable, indexed_columns)
+    perm = np.argsort(codes, kind="stable")
+    return write_bucket_run(btable.take(pa.array(perm)), bucket, out_dir,
+                            max_rows_per_file,
+                            split_keys=codes[perm], split_key_bits=bits)
+
+
 def write_bucketed(table: pa.Table, bucket_ids: np.ndarray, sort_perm: np.ndarray,
                    num_buckets: int, out_dir: str,
-                   max_rows_per_file: int = 0) -> List[str]:
+                   max_rows_per_file: int = 0,
+                   split_keys: Optional[np.ndarray] = None,
+                   split_key_bits: int = 0) -> List[str]:
     """Write ``table`` as sorted Parquet files, one or more per non-empty
     bucket.
 
@@ -257,11 +319,15 @@ def write_bucketed(table: pa.Table, bucket_ids: np.ndarray, sort_perm: np.ndarra
     Spark's bucketed write behavior.  ``max_rows_per_file`` > 0 splits each
     bucket's sorted run into chunks — consecutive key (or Z-code) ranges per
     file, which is what gives the per-file min/max sketch its pruning
-    granularity within a bucket.
+    granularity within a bucket.  ``split_keys`` (per-row PRE-permutation
+    Morton codes, Z-order layout) aligns those cuts to Z-curve cell
+    boundaries via ``zorder_split_chunks``.
     """
     os.makedirs(out_dir, exist_ok=True)
     sorted_buckets = np.asarray(bucket_ids)[sort_perm]
     sorted_table = table.take(pa.array(sort_perm))
+    sorted_keys = None if split_keys is None \
+        else np.asarray(split_keys)[sort_perm]
     # Bucket boundaries within the sorted order.
     starts = np.searchsorted(sorted_buckets, np.arange(num_buckets), side="left")
     ends = np.searchsorted(sorted_buckets, np.arange(num_buckets), side="right")
@@ -271,7 +337,13 @@ def write_bucketed(table: pa.Table, bucket_ids: np.ndarray, sort_perm: np.ndarra
         n = int(ends[b] - starts[b])
         if n == 0:
             continue
-        for off, rows in bucket_chunks(n, max_rows_per_file):
+        if sorted_keys is not None:
+            chunks = zorder_split_chunks(
+                sorted_keys[int(starts[b]):int(ends[b])], split_key_bits,
+                max_rows_per_file)
+        else:
+            chunks = bucket_chunks(n, max_rows_per_file)
+        for off, rows in chunks:
             jobs.append((b, int(starts[b]) + off, rows))
 
     def write(job) -> str:

@@ -25,14 +25,13 @@ import jax.numpy as jnp
 from hyperspace_tpu.ops.hash import _bucket_ids_impl, use_pallas
 
 
-@partial(jax.jit, static_argnames=("num_buckets", "pallas", "zorder"))
+@partial(jax.jit, static_argnames=("num_buckets", "pallas"))
 def _bucket_sort_impl(
     word_cols,
     order_words,
     n_valid,
     num_buckets: int,
     pallas: bool,
-    zorder: bool = False,
 ) -> jnp.ndarray:  # (2, n) stacked [buckets, perm] — one host transfer
     # One bucket-assignment implementation for build and query paths —
     # duplicating it risks the two silently diverging, which corrupts the
@@ -46,19 +45,14 @@ def _bucket_sort_impl(
     buckets = jnp.where(jnp.arange(n) < n_valid, buckets,
                         jnp.int32(num_buckets))
     # jnp.lexsort: LAST key is the primary.  Order: bucket first, then key
-    # columns in config order, each (hi, lo) word pair hi-major — or the
-    # Morton code when the layout is Z-order (ops/zorder.py).
+    # columns in config order, each (hi, lo) word pair hi-major.  A Z-order
+    # build passes ONE precomputed Morton-word column here (the host ranks
+    # in io/parquet.zorder_codes_host define the layout AND the file-split
+    # keys, so the device never re-ranks).
     keys = []
-    if zorder:
-        from hyperspace_tpu.ops.zorder import zorder_words
-
-        z_hi, z_lo = zorder_words(order_words, n_valid)
-        keys.append(z_lo)
-        keys.append(z_hi)
-    else:
-        for w in reversed(order_words):
-            keys.append(w[:, 1])
-            keys.append(w[:, 0])
+    for w in reversed(order_words):
+        keys.append(w[:, 1])
+        keys.append(w[:, 0])
     keys.append(buckets)
     perm = jnp.lexsort(tuple(keys)).astype(jnp.int32)
     # One stacked output = ONE device->host transfer for both arrays (the
@@ -81,7 +75,6 @@ def bucket_sort_permutation(
     order_words: Sequence[jnp.ndarray],
     num_buckets: int,
     pad_to: int = 0,
-    zorder: bool = False,
 ) -> "Tuple[np.ndarray, np.ndarray]":
     """Fused hash + sort kernel.
 
@@ -114,8 +107,7 @@ def bucket_sort_permutation(
     import numpy as np
 
     stacked = np.asarray(_bucket_sort_impl(
-        tuple(word_cols), tuple(order_words), n, num_buckets, use_pallas(),
-        zorder))
+        tuple(word_cols), tuple(order_words), n, num_buckets, use_pallas()))
     return stacked[0, :n], stacked[1, :n]
 
 

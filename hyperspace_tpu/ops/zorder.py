@@ -1,4 +1,4 @@
-"""Z-order (Morton) clustering kernel.
+"""Z-order (Morton) code computation.
 
 Multi-column covering indexes sorted lexicographically only cluster the
 FIRST indexed column; range predicates on the others touch every file.
@@ -7,71 +7,27 @@ value-ranges stay narrow on EVERY dimension — per-file min/max sketches
 then prune files for range queries on any indexed column
 (BASELINE.json's Z-order config; capability beyond the reference snapshot).
 
-Pipeline (all on device, fused into the build program by XLA):
-  1. per column: dense rank via double argsort of the 64-bit monotone order
-     words (hyperspace_tpu.io.columnar.to_order_words) — padded rows are
-     forced to sort last so real ranks stay dense in [0, n_valid);
+Pipeline (host-side: global dense ranks need a global pass, and the codes
+double as the writer's Z-cell-aligned file-split keys —
+io/parquet.zorder_codes_host):
+  1. per column: dense rank via stable argsort of the 64-bit monotone order
+     words (hyperspace_tpu.io.columnar.to_order_words);
   2. ranks are scaled to 16 bits (quantile-uniform by construction: ranks
-     are dense), float32-exact up to 2^24 rows per batch;
-  3. bit interleave of K x 16-bit codes into a (hi, lo) uint32 pair — pure
-     VPU shift/or work, the kind of elementwise uint32 math TPU eats.
+     are dense), float32-exact up to 2^24 rows;
+  3. bit interleave of K x 16-bit codes into a (hi, lo) uint32 pair.
 
-Everything is 32-bit; no x64 emulation anywhere.
+The resulting (n, 2) words feed the device build kernel as ONE precomputed
+order column (ops/sort.bucket_sort_permutation) — the device sorts by the
+code but never re-ranks, so layout and split keys can never diverge.
 """
 
 from __future__ import annotations
 
 from typing import List, Sequence, Tuple
 
-import jax.numpy as jnp
 import numpy as np
 
 MAX_ZORDER_COLUMNS = 4  # 4 x 16 bits = the 64-bit (hi, lo) code
-
-
-def _ranks(order_words: jnp.ndarray, n_valid) -> jnp.ndarray:
-    """Dense rank of each row's 64-bit key, padded rows ranked last."""
-    n = order_words.shape[0]
-    pad = jnp.arange(n) >= n_valid
-    hi = jnp.where(pad, jnp.uint32(0xFFFFFFFF), order_words[:, 0])
-    lo = jnp.where(pad, jnp.uint32(0xFFFFFFFF), order_words[:, 1])
-    perm = jnp.lexsort((lo, hi))  # stable: ties broken by position, so
-    # equal-key real rows (earlier positions) rank before padding.
-    return jnp.zeros(n, jnp.int32).at[perm].set(
-        jnp.arange(n, dtype=jnp.int32))
-
-
-def _rank16(rank: jnp.ndarray, n_valid) -> jnp.ndarray:
-    """Scale dense ranks to [0, 65535].  float32 is exact for ranks < 2^24
-    (device_batch_rows is far below that)."""
-    denom = jnp.maximum(n_valid - 1, 1).astype(jnp.float32)
-    return jnp.clip((rank.astype(jnp.float32) * (65535.0 / denom)),
-                    0, 65535).astype(jnp.uint32)
-
-
-def zorder_words(order_words: Sequence[jnp.ndarray],
-                 n_valid) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """(hi, lo) uint32 Morton words for rows whose per-column 64-bit keys
-    are ``order_words`` (each (n, 2) uint32).  Bit j of column k lands at
-    interleaved position j*K + (K-1-k), so earlier config columns take the
-    more significant bits within each level."""
-    k_cols = len(order_words)
-    if not 1 <= k_cols <= MAX_ZORDER_COLUMNS:
-        raise ValueError(
-            f"Z-order supports 1..{MAX_ZORDER_COLUMNS} columns, got {k_cols}")
-    codes = [_rank16(_ranks(w, n_valid), n_valid) for w in order_words]
-    n = order_words[0].shape[0]
-    hi = jnp.zeros(n, jnp.uint32)
-    lo = jnp.zeros(n, jnp.uint32)
-    for j in range(16):
-        for k, code in enumerate(codes):
-            bit = (code >> jnp.uint32(j)) & jnp.uint32(1)
-            pos = j * k_cols + (k_cols - 1 - k)
-            if pos < 32:
-                lo = lo | (bit << jnp.uint32(pos))
-            else:
-                hi = hi | (bit << jnp.uint32(pos - 32))
-    return hi, lo
 
 
 def zorder_order_words_np(order_words: Sequence[np.ndarray]) -> np.ndarray:

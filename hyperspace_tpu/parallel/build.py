@@ -29,26 +29,22 @@ def distributed_bucket_sort_permutation(
     mesh,
     slack: float = 1.5,
     pad_to: int = 0,
-    zorder: bool = False,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """(bucket_ids, perm) for ``table`` computed over ``mesh``.
 
     Equivalent ordering contract to ``ops.sort.bucket_sort_permutation``:
-    ``perm`` orders rows by (bucket, indexed columns) — or the Morton code
-    when ``zorder`` — and ``bucket_ids`` are per-row (pre-permutation)
-    bucket assignments.  ``pad_to`` quantizes the per-device shard length so
-    different dataset sizes share one compiled program (same knob as the
-    single-chip kernel).
+    ``perm`` orders rows by (bucket, indexed columns) and ``bucket_ids``
+    are per-row (pre-permutation) bucket assignments.  ``pad_to`` quantizes
+    the per-device shard length so different dataset sizes share one
+    compiled program (same knob as the single-chip kernel).
+
+    Z-order builds never come here: their permutation is the host argsort
+    of the precomputed Morton codes (actions/create._write_table_bucketed)
+    — a hash shuffle would fragment the curve into per-partition samples.
     """
     hash_words = [columnar.to_hash_words(table.column(c)) for c in indexed_columns]
-    order_words = [columnar.to_order_words(table.column(c)) for c in indexed_columns]
-    if zorder:
-        # One synthetic order column = the Morton words; the shuffle's
-        # per-device lexsort over it yields Z-order within buckets
-        # (ops/zorder.py; ranks are global, so computed pre-shard).
-        from hyperspace_tpu.ops.zorder import zorder_order_words_np
-
-        order_words = [zorder_order_words_np([np.asarray(w) for w in order_words])]
+    order_words = [columnar.to_order_words(table.column(c))
+                   for c in indexed_columns]
     result, _ = bucket_shuffle(hash_words, order_words, num_buckets, mesh,
                                slack=slack, pad_local_to=pad_to)
     n = table.num_rows

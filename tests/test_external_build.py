@@ -113,3 +113,51 @@ def test_chunked_zorder_build(roots):
     got = ds.collect()
     s.disable_hyperspace()
     assert canonical_rows(got) == canonical_rows(ds.collect())
+
+
+def test_chunked_zorder_spills_per_partition(tmp_path):
+    """The zorder external build routes chunks to HASH partitions
+    (bounding phase 2's memory to ~dataset/16 for any key distribution),
+    but writes every file as bucket 0 — the index logically has one bucket
+    — and each partition's rank-Morton sort still clusters the key space,
+    so the per-file sketches prune on the second dimension."""
+    import pyarrow.parquet as pq
+
+    data = str(tmp_path / "data")
+    os.makedirs(data)
+    rng = np.random.default_rng(9)
+    n = 8000
+    t = pa.table({
+        "x": pa.array(rng.integers(0, 1 << 16, n), type=pa.int64()),
+        "y": pa.array(rng.random(n) * 1000),
+    })
+    for i in range(4):
+        pq.write_table(t.slice(i * n // 4, n // 4),
+                       os.path.join(data, f"part-{i:05d}.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    s.conf.num_buckets = 4          # overridden to 1 by the zorder layout
+    s.conf.parallel_build = "off"
+    s.conf.device_batch_rows = 512  # forces ~16 spill chunks
+    # Pruning granularity through the spill = files per PARTITION (each
+    # hash partition re-covers the key space), so files must outnumber
+    # partitions for the sketches to bite.
+    s.conf.index_max_rows_per_file = n // 64
+    hs = Hyperspace(s)
+    hs.create_index(s.read.parquet(data),
+                    IndexConfig("zs", ["x", "y"], layout="zorder"))
+    entry = s.index_collection_manager.get_index("zs")
+    assert entry.num_buckets == 1
+    files = [f.name for f in entry.content.file_infos()]
+    assert len(files) >= 8  # partitions wrote independently
+    assert all(bucket_id_of_file(f) == 0 for f in files)
+    s.enable_hyperspace()
+    ds = (s.read.parquet(data)
+          .filter((col("y") >= 100.0) & (col("y") < 150.0)).select("x", "y"))
+    plan = ds.optimized_plan()
+    scans = [x for x in plan.leaf_relations() if x.relation.index_scan_of]
+    assert scans, plan.tree_string()
+    kept, total = scans[0].relation.data_skipping_stats
+    assert kept < total  # second-dimension pruning bites through the spill
+    got = ds.collect()
+    s.disable_hyperspace()
+    assert canonical_rows(got) == canonical_rows(ds.collect())

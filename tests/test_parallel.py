@@ -236,3 +236,46 @@ class TestDistributedCreate:
         session.disable_hyperspace()
         without = q.collect().to_pandas().sort_values("name").reset_index(drop=True)
         assert with_index.equals(without)
+
+    def test_zorder_build_under_mesh_keeps_global_layout(self, tmp_path):
+        """With parallel_build=on, a zorder build must NOT take the hash
+        shuffle (it would fragment the curve into per-partition samples and
+        gut pruning, or with one logical bucket send every row to one
+        device): the layout is the host argsort of the global Morton codes
+        — identical on 1 chip or N — written as bucket 0, and
+        second-dimension sketch pruning keeps its power."""
+        import pyarrow.parquet as pq
+
+        from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
+        from hyperspace_tpu.io.parquet import bucket_id_of_file
+
+        rng = np.random.default_rng(8)
+        src = tmp_path / "src"
+        src.mkdir()
+        n = 8_000
+        pq.write_table(pa.table({
+            "x": pa.array(rng.integers(0, 1 << 16, n), type=pa.int64()),
+            "y": pa.array(rng.random(n) * 1000),
+        }), str(src / "part-0.parquet"))
+        session = HyperspaceSession(system_path=str(tmp_path / "indexes"))
+        session.conf.parallel_build = "on"
+        session.conf.index_max_rows_per_file = n // 64
+        hs = Hyperspace(session)
+        df = session.read.parquet(str(src))
+        hs.create_index(df, IndexConfig("zd", ["x", "y"], layout="zorder"))
+        entry = session.index_collection_manager.get_index("zd")
+        assert entry.num_buckets == 1
+        files = [f.name for f in entry.content.file_infos()]
+        assert all(bucket_id_of_file(f) == 0 for f in files)
+        session.enable_hyperspace()
+        q = (df.filter((col("y") >= 100.0) & (col("y") < 150.0))
+             .select("x", "y"))
+        plan = q.optimized_plan()
+        scans = [s for s in plan.leaf_relations() if s.relation.index_scan_of]
+        assert scans, plan.tree_string()
+        kept, total = scans[0].relation.data_skipping_stats
+        assert kept < total
+        got = q.collect()
+        session.disable_hyperspace()
+        keys = [("x", "ascending"), ("y", "ascending")]
+        assert got.sort_by(keys).equals(q.collect().sort_by(keys))

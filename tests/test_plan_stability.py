@@ -115,9 +115,13 @@ def catalog(tmp_path_factory):
 
     hs.create_index(read.parquet(paths["lineitem"]),
                     DataSkippingIndexConfig("ds_line_ship", ["l_shipdate"]))
+    # ~16 Z-cell-aligned files (400 rows / 25): level-4 cells give each
+    # dimension 4 bands, so q14's top-band range prunes deterministically.
+    session.conf.index_max_rows_per_file = 25
     hs.create_index(read.parquet(paths["orders"]),
                     IndexConfig("idx_orders_z", ["o_custkey", "o_totalprice"],
                                 ["o_orderkey"], layout="zorder"))
+    session.conf.index_max_rows_per_file = 0
     session.enable_hyperspace()
     return session, paths
 

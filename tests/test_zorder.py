@@ -39,29 +39,57 @@ def _grid_data(tmp_path, n=4096):
 
 
 class TestKernel:
-    def test_interleave_parity_with_host_reference(self):
-        import jax.numpy as jnp
-
-        from hyperspace_tpu.ops.zorder import interleave16_np, zorder_words
+    def test_codes_interleave_ranks(self):
+        from hyperspace_tpu.ops.zorder import (
+            interleave16_np,
+            zorder_order_words_np,
+        )
 
         rng = np.random.default_rng(1)
         n = 512
         # Monotone words whose hi word IS the value (lo zero): ranks follow
-        # the values, so we can compute expected codes host-side.
+        # the values, so expected codes are computable directly.
         cols = []
         for _ in range(3):
             v = rng.permutation(n).astype(np.uint32)
             w = np.zeros((n, 2), np.uint32)
             w[:, 0] = v
             cols.append(w)
-        hi, lo = zorder_words([jnp.asarray(c) for c in cols], n)
-        # Expected: rank of each value is the value itself (a permutation of
-        # 0..n-1), scaled to 16 bits, then interleaved.
+        z = zorder_order_words_np(cols)
         codes = [np.clip(c[:, 0].astype(np.float32) * (65535.0 / (n - 1)),
                          0, 65535).astype(np.uint32) for c in cols]
         ehi, elo = interleave16_np(codes)
-        assert np.array_equal(np.asarray(hi), ehi)
-        assert np.array_equal(np.asarray(lo), elo)
+        assert np.array_equal(z[:, 0], ehi)
+        assert np.array_equal(z[:, 1], elo)
+
+    def test_split_chunks_align_to_cell_boundaries(self):
+        from hyperspace_tpu.io.parquet import zorder_split_chunks
+
+        # Target 2 files -> level 1: cells are code halves [0..7] (8 rows,
+        # capped into 6+2) and [8..15] (4 rows) — the cut lands exactly at
+        # the cell boundary, never mid-cell.
+        codes = np.array([0, 1, 2, 3, 3, 5, 6, 7, 12, 13, 14, 15],
+                         dtype=np.uint64)
+        chunks = zorder_split_chunks(codes, 4, max_rows_per_file=6)
+        assert chunks == [(0, 6), (6, 2), (8, 4)]
+        # Oversized cell: capped at max_rows inside the cell.
+        big = np.array([0] * 7 + [9] * 2, dtype=np.uint64)
+        assert zorder_split_chunks(big, 4, 4) == [(0, 4), (4, 3), (7, 2)]
+        # No split knob = one file; empty = none.
+        assert zorder_split_chunks(codes, 4, 0) == [(0, 12)]
+        assert zorder_split_chunks(np.array([], dtype=np.uint64), 4, 4) == []
+
+    def test_zorder_forces_single_bucket(self, session, tmp_path):
+        """Hash bucketing scatters Morton clustering (a per-bucket file
+        sees near-uniform ranges on every dimension); the build pins
+        num_buckets=1 for the zorder layout regardless of the conf."""
+        root = _grid_data(tmp_path)
+        session.conf.num_buckets = 16
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(root),
+                        IndexConfig("zi", ["x", "y"], layout="zorder"))
+        entry = session.index_collection_manager.get_index("zi")
+        assert entry.num_buckets == 1
 
     def test_too_many_columns_rejected(self):
         with pytest.raises(HyperspaceError, match="at most 4"):
