@@ -274,20 +274,38 @@ class Executor:
             lt = left.take(pa.array(li))
             rt = right.take(pa.array(ri))
         else:
-            # Host fallback: pandas hash join for multi-column/string keys.
-            import pandas as pd
+            # Composite/string keys: digest join on device (or its host
+            # mirror below the size threshold) with exact verification —
+            # pandas only for key pairs with no exact common domain.
+            from hyperspace_tpu.ops.join import (
+                UnsupportedJoinKeys,
+                hashed_equi_join,
+            )
 
-            ldf = left.to_pandas()
-            rdf = right.to_pandas()
-            ldf["__li"] = np.arange(len(ldf))
-            rdf["__ri"] = np.arange(len(rdf))
-            merged = ldf.merge(rdf, left_on=l_keys, right_on=r_keys,
-                               how="inner", suffixes=("", "__r"))
-            lt = left.take(pa.array(merged["__li"].to_numpy()))
-            rt = right.take(pa.array(merged["__ri"].to_numpy()))
+            try:
+                use_device = (max(left.num_rows, right.num_rows)
+                              >= self.session.conf.device_join_min_rows)
+                li, ri = hashed_equi_join(left, right, l_keys, r_keys,
+                                          device=use_device)
+                lt = left.take(pa.array(li))
+                rt = right.take(pa.array(ri))
+            except UnsupportedJoinKeys:
+                import pandas as pd  # noqa: F401
+
+                ldf = left.to_pandas()
+                rdf = right.to_pandas()
+                ldf["__li"] = np.arange(len(ldf))
+                rdf["__ri"] = np.arange(len(rdf))
+                merged = ldf.merge(rdf, left_on=l_keys, right_on=r_keys,
+                                   how="inner", suffixes=("", "__r"))
+                lt = left.take(pa.array(merged["__li"].to_numpy()))
+                rt = right.take(pa.array(merged["__ri"].to_numpy()))
         return _concat_horizontal(lt, rt)
 
     # -- bucket-aligned join (the shuffle-free SMJ payoff on one chip) ------
+    # Structural applicability lives in ``bucketed_join_precheck`` (module
+    # level) so the explain physical analyzer predicts the same strategy
+    # the executor takes, from one set of checks.
     def _try_bucketed_join(self, plan: Join) -> Optional[pa.Table]:
         """When both sides are (Project|Filter)* chains over index scans
         with MATCHING bucket specs on the join keys (what JoinIndexRule
@@ -302,44 +320,10 @@ class Executor:
         files — the executed form of the reference's on-the-fly shuffle
         (RuleUtils.scala:511-570), keeping the index side exchange-free
         instead of degrading to a full-table merge."""
-        from hyperspace_tpu.plan.expr import as_equi_join_pairs
-
-        pairs = as_equi_join_pairs(plan.condition)
-        if pairs is None or len(pairs) != 1:
+        precheck = bucketed_join_precheck(self.session, plan)
+        if precheck is None:
             return None
-        aligned = [_bucketed_side(side) for side in (plan.left, plan.right)]
-        if any(a is None for a in aligned):
-            return None
-        left_side, right_side = aligned
-        l_scan, r_scan = left_side.scan, right_side.scan
-        l_spec, r_spec = l_scan.relation.bucket_spec, r_scan.relation.bucket_spec
-        if l_spec[0] != r_spec[0]:
-            return None
-        a, b = pairs[0]
-        l_cols = tuple(c.lower() for c in l_spec[1])
-        r_cols = tuple(c.lower() for c in r_spec[1])
-        la, rb = a.lower(), b.lower()
-        if not ((l_cols == (la,) and r_cols == (rb,))
-                or (l_cols == (rb,) and r_cols == (la,))):
-            return None
-        # Bucket ids only align when both sides hashed the SAME bit
-        # patterns: an int64 key on one side and float64 on the other put
-        # equal VALUES in different buckets (to_hash_words hashes raw
-        # bits), while the plain join path matches them by value — so a
-        # type mismatch must fall back, or results silently change.
-        l_type = self.session.schema_map_of(l_scan).get(l_spec[1][0])
-        r_type = self.session.schema_map_of(r_scan).get(r_spec[1][0])
-        if l_type is None or r_type is None or l_type != r_type:
-            return None
-        # Cheap structural checks for BOTH sides before executing any
-        # appended subtree (a late failure would re-execute it on the plain
-        # path); if a rare post-execution failure (appended key cast) still
-        # falls back, roll the stats back so one collect() doesn't report
-        # the appended scan twice.
-        l_files = _files_by_bucket(left_side.scan)
-        r_files = _files_by_bucket(right_side.scan)
-        if l_files is None or r_files is None:
-            return None
+        left_side, right_side, l_files, r_files = precheck
         scans_mark = len(self.stats["scans"])
         l_parts = self._side_bucket_parts(left_side, l_files)
         r_parts = None if l_parts is None \
@@ -483,6 +467,51 @@ def _bucketed_side(node: LogicalPlan) -> Optional[_BucketedSide]:
     return None
 
 
+def bucketed_join_precheck(session, plan: Join):
+    """Structural applicability of the bucket-aligned join — side-effect
+    free, shared by the executor and the explain physical analyzer so the
+    predicted strategy can never diverge from the executed one.  Returns
+    (left_side, right_side, left_files_by_bucket, right_files_by_bucket)
+    or None when the plain join path applies."""
+    from hyperspace_tpu.plan.expr import as_equi_join_pairs
+
+    pairs = as_equi_join_pairs(plan.condition)
+    if pairs is None or len(pairs) != 1:
+        return None
+    aligned = [_bucketed_side(side) for side in (plan.left, plan.right)]
+    if any(a is None for a in aligned):
+        return None
+    left_side, right_side = aligned
+    l_scan, r_scan = left_side.scan, right_side.scan
+    l_spec, r_spec = l_scan.relation.bucket_spec, r_scan.relation.bucket_spec
+    if l_spec[0] != r_spec[0]:
+        return None
+    a, b = pairs[0]
+    l_cols = tuple(c.lower() for c in l_spec[1])
+    r_cols = tuple(c.lower() for c in r_spec[1])
+    la, rb = a.lower(), b.lower()
+    if not ((l_cols == (la,) and r_cols == (rb,))
+            or (l_cols == (rb,) and r_cols == (la,))):
+        return None
+    # Bucket ids only align when both sides hashed the SAME bit patterns:
+    # an int64 key on one side and float64 on the other put equal VALUES in
+    # different buckets (to_hash_words hashes raw bits), while the plain
+    # join path matches them by value — so a type mismatch must fall back,
+    # or results silently change.
+    l_type = session.schema_map_of(l_scan).get(l_spec[1][0])
+    r_type = session.schema_map_of(r_scan).get(r_spec[1][0])
+    if l_type is None or r_type is None or l_type != r_type:
+        return None
+    # Cheap structural checks for BOTH sides before the executor runs any
+    # appended subtree (a late failure would re-execute it on the plain
+    # path).
+    l_files = _files_by_bucket(left_side.scan)
+    r_files = _files_by_bucket(right_side.scan)
+    if l_files is None or r_files is None:
+        return None
+    return left_side, right_side, l_files, r_files
+
+
 def _files_by_bucket(scan: Scan):
     """Bucket id -> files, honoring the scan's own bucket pruning (a
     filter under the join may have restricted the buckets already)."""
@@ -532,14 +561,14 @@ def _parse_numeric(column, target_type) -> pa.Array:
     try:
         return pc.cast(column, target_type)
     except (pa.ArrowInvalid, pa.ArrowTypeError):
-        py = float if pa.types.is_floating(target_type) else int
-        values = []
-        for v in column.to_pylist():
-            try:
-                values.append(py(v) if v is not None else None)
-            except (ValueError, TypeError):
-                values.append(None)
-        return pa.array(values, type=target_type)
+        import pandas as pd
+
+        # Vectorized null-on-failure parse ('abc' -> NaN, which no
+        # comparison matches — same row-drop effect as Spark's null).
+        arr = column.to_numpy(zero_copy_only=False)
+        vals = pd.to_numeric(pd.Series(arr), errors="coerce") \
+            .to_numpy(dtype=np.float64)
+        return pa.array(vals, type=target_type)
 
 
 def _arrow_eval(expr: Expr, table: pa.Table):

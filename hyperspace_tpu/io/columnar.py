@@ -84,6 +84,10 @@ def to_hash_words(column: "pa.ChunkedArray | pa.Array") -> np.ndarray:
             column = pc.fill_null(column, 0.0)
         arr = column.to_numpy(zero_copy_only=False).astype(np.float64)
         arr = np.where(arr == 0.0, 0.0, arr)  # -0.0 == 0.0 must hash equal
+        # All NaN bit patterns hash alike (Spark normalizes NaN for
+        # joins/grouping; a negative NaN written by another engine must
+        # land with the canonical one).
+        arr = np.where(np.isnan(arr), np.float64("nan"), arr)
         bits = arr.view(np.uint64)
     elif is_numeric_type(t):
         bits = _numeric_int64(column, fill_null_zero=True).view(np.uint64)

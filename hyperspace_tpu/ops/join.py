@@ -77,6 +77,98 @@ def sorted_equi_join_np(left_keys: np.ndarray, right_keys: np.ndarray
     return left_idx.astype(np.int64), right_idx.astype(np.int64)
 
 
+_FNV_OFFSET = np.uint64(0xcbf29ce484222325)
+_FNV_PRIME = np.uint64(0x100000001b3)
+
+
+def key_digests(table, key_columns, null_salt: int = 1) -> np.ndarray:
+    """(n,) uint64 digest per row over ``key_columns`` — FNV-1a over each
+    column's 64-bit hash words (io/columnar.to_hash_words: equal values,
+    including -0.0/0.0 and equal strings, always produce equal words).
+    Equal key tuples get equal digests; collisions are possible and are
+    removed by ``hashed_equi_join``'s verification pass.
+
+    Rows with a null in ANY key column get a digest unique to (row,
+    ``null_salt``): inner-join semantics can never match them, and letting
+    them share to_hash_words' null sentinel would make the digest join
+    emit an n_left_nulls x n_right_nulls candidate cross product just for
+    verification to discard."""
+    import pyarrow.compute as pc
+
+    from hyperspace_tpu.io import columnar
+
+    n = table.num_rows
+    acc = np.full(n, _FNV_OFFSET, dtype=np.uint64)
+    nulls = np.zeros(n, dtype=bool)
+    with np.errstate(over="ignore"):
+        for c in key_columns:
+            col = table.column(c)
+            if col.null_count > 0:
+                nulls |= np.asarray(pc.is_null(col))
+            words = np.asarray(columnar.to_hash_words(col))
+            w64 = (words[:, 0].astype(np.uint64) << np.uint64(32)) \
+                | words[:, 1].astype(np.uint64)
+            acc = (acc ^ w64) * _FNV_PRIME
+        if nulls.any():
+            acc[nulls] = (np.flatnonzero(nulls).astype(np.uint64)
+                          * _FNV_PRIME) ^ (np.uint64(null_salt) << np.uint64(62))
+    return acc
+
+
+class UnsupportedJoinKeys(Exception):
+    """Key pair the hashed join cannot handle exactly (e.g. string vs int)."""
+
+
+def hashed_equi_join(left, right, l_keys, r_keys,
+                     device: bool) -> Tuple[np.ndarray, np.ndarray]:
+    """Inner equi-join for COMPOSITE and STRING keys: 64-bit digests joined
+    with the sorted kernel (device or host mirror), then candidate pairs
+    verified column-by-column against the actual values — hash collisions
+    can only ADD candidates, never hide a match, so the verified result is
+    exact.  Mixed numeric/numeric key pairs are compared as float64 (the
+    Spark cast); NaN keys match NaN (Spark normalizes NaN for joins).
+
+    Raises UnsupportedJoinKeys for key pairs with no exact common domain
+    (caller falls back to the host hash join)."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    lcols, rcols = [], []
+    for lc, rc in zip(l_keys, r_keys):
+        la, ra = left.column(lc), right.column(rc)
+        if la.type != ra.type:
+            if (pa.types.is_floating(la.type) or pa.types.is_integer(la.type)) \
+                    and (pa.types.is_floating(ra.type)
+                         or pa.types.is_integer(ra.type)):
+                la = pc.cast(la, pa.float64())
+                ra = pc.cast(ra, pa.float64())
+            else:
+                raise UnsupportedJoinKeys(f"{la.type} vs {ra.type}")
+        lcols.append(la)
+        rcols.append(ra)
+    ltab = pa.table({f"k{i}": c for i, c in enumerate(lcols)})
+    rtab = pa.table({f"k{i}": c for i, c in enumerate(rcols)})
+    join = sorted_equi_join if device else sorted_equi_join_np
+    li, ri = join(
+        key_digests(ltab, ltab.column_names, null_salt=1).view(np.int64),
+        key_digests(rtab, rtab.column_names, null_salt=2).view(np.int64))
+    if li.size == 0:
+        return li, ri
+    keep = np.ones(li.size, dtype=bool)
+    for lc, rc in zip(ltab.columns, rtab.columns):
+        la = lc.take(pa.array(li))
+        ra = rc.take(pa.array(ri))
+        eq = pc.fill_null(pc.equal(la, ra), False)
+        mask = np.asarray(eq.to_numpy(zero_copy_only=False), dtype=bool)
+        if pa.types.is_floating(la.type):
+            both_nan = (
+                np.asarray(pc.fill_null(pc.is_nan(la), False))
+                & np.asarray(pc.fill_null(pc.is_nan(ra), False)))
+            mask |= both_nan
+        keep &= mask
+    return li[keep], ri[keep]
+
+
 def sorted_equi_join(left_keys: np.ndarray, right_keys: np.ndarray
                      ) -> Tuple[np.ndarray, np.ndarray]:
     """Inner equi-join on single numeric keys.

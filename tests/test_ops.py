@@ -106,6 +106,135 @@ def test_sorted_equi_join_no_matches():
     assert len(li) == 0 and len(ri) == 0
 
 
+def _naive_pairs(ltab, rtab, l_keys, r_keys):
+    lrows = list(zip(*[ltab.column(c).to_pylist() for c in l_keys]))
+    rrows = list(zip(*[rtab.column(c).to_pylist() for c in r_keys]))
+    return sorted((i, j) for i, lv in enumerate(lrows)
+                  for j, rv in enumerate(rrows) if lv == rv)
+
+
+class TestHashedEquiJoin:
+    """Composite/string device join: digest join + exact verification
+    (ops/join.hashed_equi_join)."""
+
+    def test_composite_int_string_matches_naive(self):
+        import pyarrow as pa
+
+        from hyperspace_tpu.ops.join import hashed_equi_join
+
+        rng = np.random.default_rng(2)
+        left = pa.table({
+            "a": pa.array(rng.integers(0, 20, 300), type=pa.int64()),
+            "b": pa.array([("x", "y", "z")[i % 3] for i in range(300)]),
+        })
+        right = pa.table({
+            "a2": pa.array(rng.integers(0, 20, 200), type=pa.int64()),
+            "b2": pa.array([("x", "y", "w")[i % 3] for i in range(200)]),
+        })
+        for device in (False, True):
+            li, ri = hashed_equi_join(left, right, ["a", "b"], ["a2", "b2"],
+                                      device=device)
+            assert sorted(zip(li.tolist(), ri.tolist())) == \
+                _naive_pairs(left, right, ["a", "b"], ["a2", "b2"])
+
+    def test_string_keys_match_naive(self):
+        import pyarrow as pa
+
+        from hyperspace_tpu.ops.join import hashed_equi_join
+
+        left = pa.table({"s": pa.array(["ab", "cd", "ef", "ab", "zz"])})
+        right = pa.table({"s2": pa.array(["cd", "ab", "qq"])})
+        li, ri = hashed_equi_join(left, right, ["s"], ["s2"], device=False)
+        assert sorted(zip(li.tolist(), ri.tolist())) == \
+            _naive_pairs(left, right, ["s"], ["s2"])
+
+    def test_mixed_numeric_types_coerce(self):
+        import pyarrow as pa
+
+        from hyperspace_tpu.ops.join import hashed_equi_join
+
+        left = pa.table({"k": pa.array([1, 2, 3], type=pa.int64())})
+        right = pa.table({"k2": pa.array([2.0, 3.0, 4.5], type=pa.float64())})
+        li, ri = hashed_equi_join(left, right, ["k"], ["k2"], device=False)
+        assert sorted(zip(li.tolist(), ri.tolist())) == [(1, 0), (2, 1)]
+
+    def test_nan_keys_match_like_spark(self):
+        import pyarrow as pa
+
+        from hyperspace_tpu.ops.join import hashed_equi_join
+
+        left = pa.table({"k": pa.array([float("nan"), 1.0])})
+        right = pa.table({"k2": pa.array([float("nan"), 2.0])})
+        li, ri = hashed_equi_join(left, right, ["k"], ["k2"], device=False)
+        assert list(zip(li.tolist(), ri.tolist())) == [(0, 0)]
+
+    def test_noncanonical_nan_still_matches(self):
+        """NaN bit patterns differ across producers (negative/quiet NaN
+        from other engines); all of them must digest alike or the
+        verification rescue never sees the pair."""
+        import pyarrow as pa
+
+        from hyperspace_tpu.ops.join import hashed_equi_join
+
+        weird_nan = np.frombuffer(
+            np.uint64(0xFFF8000000000000).tobytes(), dtype=np.float64)[0]
+        assert np.isnan(weird_nan)
+        left = pa.table({"k": pa.array([weird_nan, 1.0])})
+        right = pa.table({"k2": pa.array([float("nan"), 2.0])})
+        li, ri = hashed_equi_join(left, right, ["k"], ["k2"], device=False)
+        assert list(zip(li.tolist(), ri.tolist())) == [(0, 0)]
+
+    def test_collisions_removed_by_verification(self, monkeypatch):
+        """Even a degenerate digest (everything collides) must produce the
+        exact result — the verify pass is the correctness backstop."""
+        import pyarrow as pa
+
+        from hyperspace_tpu.ops import join as join_mod
+
+        monkeypatch.setattr(
+            join_mod, "key_digests",
+            lambda table, cols, null_salt=0:
+                np.zeros(table.num_rows, dtype=np.uint64))
+        left = pa.table({"s": pa.array(["a", "b", "c"])})
+        right = pa.table({"s2": pa.array(["b", "c", "d"])})
+        li, ri = join_mod.hashed_equi_join(left, right, ["s"], ["s2"],
+                                           device=False)
+        assert sorted(zip(li.tolist(), ri.tolist())) == [(1, 0), (2, 1)]
+
+    def test_null_keys_never_match_and_never_blow_up(self):
+        """Null keys share to_hash_words' sentinel, so without per-row
+        null digests the candidate set would be n_l_nulls x n_r_nulls;
+        they must instead produce ZERO candidates and zero matches."""
+        import pyarrow as pa
+
+        from hyperspace_tpu.ops.join import hashed_equi_join, key_digests
+
+        left = pa.table({"s": pa.array(["a", None, None, "b"])})
+        right = pa.table({"s2": pa.array([None, "b", None])})
+        ld = key_digests(left, ["s"], null_salt=1)
+        rd = key_digests(right, ["s2"], null_salt=2)
+        # Every null row's digest is unique across BOTH sides.
+        all_null_digests = [ld[1], ld[2], rd[0], rd[2]]
+        assert len(set(int(d) for d in all_null_digests)) == 4
+        li, ri = hashed_equi_join(left, right, ["s"], ["s2"], device=False)
+        assert list(zip(li.tolist(), ri.tolist())) == [(3, 1)]
+
+    def test_incompatible_types_raise(self):
+        import pyarrow as pa
+
+        from hyperspace_tpu.ops.join import (
+            UnsupportedJoinKeys,
+            hashed_equi_join,
+        )
+
+        left = pa.table({"s": pa.array(["1", "2"])})
+        right = pa.table({"k": pa.array([1, 2], type=pa.int64())})
+        import pytest as _pytest
+
+        with _pytest.raises(UnsupportedJoinKeys):
+            hashed_equi_join(left, right, ["s"], ["k"], device=False)
+
+
 def test_compile_predicate_reuses_literals():
     import jax.numpy as jnp
 
