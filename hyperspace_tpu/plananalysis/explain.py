@@ -12,7 +12,6 @@ display modes (plananalysis/display.py).
 
 from __future__ import annotations
 
-from collections import Counter
 from typing import List, Optional, Tuple
 
 from hyperspace_tpu.plan.nodes import LogicalPlan, Scan
@@ -28,18 +27,6 @@ def _used_indexes(plan: LogicalPlan) -> List[str]:
     used |= {s.relation.data_skipping_of for s in plan.leaf_relations()
              if s.relation.data_skipping_of}
     return sorted(used)
-
-
-def _operator_counts(plan: LogicalPlan) -> Counter:
-    counts: Counter = Counter()
-
-    def walk(node: LogicalPlan) -> None:
-        counts[type(node).__name__] += 1
-        for c in node.children:
-            walk(c)
-
-    walk(plan)
-    return counts
 
 
 def _subtree_lines(node: LogicalPlan, indent: int,
@@ -131,9 +118,12 @@ def explain_string(dataset, session, verbose: bool = False) -> str:
     stream.write_line()
 
     if verbose:
+        from hyperspace_tpu.plananalysis.physical import physical_operators
+
         _build_header(stream, "Physical operator stats:")
-        with_counts = _operator_counts(plan_with)
-        without_counts = _operator_counts(plan_without)
+        with_counts, with_details = physical_operators(session, plan_with)
+        without_counts, without_details = physical_operators(
+            session, plan_without)
         ops = sorted(set(with_counts) | set(without_counts))
         stream.write_line(
             f"{'Physical Operator':<24}{'Hyperspace Disabled':>22}"
@@ -141,5 +131,14 @@ def explain_string(dataset, session, verbose: bool = False) -> str:
         for op in ops:
             a, b = without_counts.get(op, 0), with_counts.get(op, 0)
             stream.write_line(f"{op:<24}{a:>22}{b:>10}{b - a:>+8}")
+        stream.write_line()
+        # The numbers a pruning engine's users actually want: what will
+        # each scan read (after bucket + sketch pruning)?
+        _build_header(stream, "Scan IO (with indexes):")
+        for line in with_details:
+            stream.write_line(line)
+        _build_header(stream, "Scan IO (without indexes):")
+        for line in without_details:
+            stream.write_line(line)
         stream.write_line()
     return stream.with_tag()

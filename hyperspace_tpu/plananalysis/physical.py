@@ -1,0 +1,143 @@
+"""Physical-operator analysis for explain.
+
+Reference contract: PhysicalOperatorAnalyzer.scala:30-58 counts PHYSICAL
+operators of both compiled plans and spells out the expensive ones
+(Shuffle/BroadcastExchange) so users see WHY the indexed plan wins.  Our
+engine makes its physical choices in the executor at run time; this module
+predicts them statically from the optimized plan using the executor's own
+applicability checks (execution/executor.bucketed_join_precheck), so the
+predicted operator can never diverge from the executed one — plus per-scan
+file and byte counts, the numbers a pruning engine's users actually want.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import Counter
+from typing import List, Optional, Tuple
+
+from hyperspace_tpu.io import columnar
+from hyperspace_tpu.io.parquet import bucket_id_of_file, schema_to_arrow
+from hyperspace_tpu.plan.nodes import (
+    BucketUnion,
+    Filter,
+    InMemory,
+    Join,
+    LogicalPlan,
+    Project,
+    Scan,
+    Union,
+)
+
+
+def _scan_detail(session, scan: Scan) -> Tuple[str, str]:
+    """(operator name, detail) for a scan: files read / listed and bytes,
+    honoring bucket pruning and sketch pruning annotations."""
+    rel = scan.relation
+    name = "IndexScanExec" if rel.index_scan_of else "FileScanExec"
+    target = rel.index_scan_of or ",".join(rel.root_paths)
+    if rel.file_paths is not None:
+        paths = list(rel.file_paths)
+    else:
+        try:
+            from hyperspace_tpu.io.files import list_data_files
+
+            paths = [f.name for f in list_data_files(rel.root_paths)]
+        except OSError:
+            return name, target
+    total = len(paths)
+    if rel.prune_to_buckets is not None:
+        wanted = set(rel.prune_to_buckets)
+        paths = [p for p in paths
+                 if (b := bucket_id_of_file(p)) is None or b in wanted]
+    read_bytes = 0
+    for p in paths:
+        try:
+            read_bytes += os.path.getsize(p)
+        except OSError:
+            pass
+    mb = read_bytes / (1024 * 1024)
+    stats = rel.data_skipping_stats
+    if stats is not None:
+        total = max(total, stats[1])
+    return name, f"{target}: files {len(paths)}/{total}, {mb:.2f} MB"
+
+
+def _join_key_types(session, plan: Join):
+    """Arrow types of the (single-pair) join keys, resolved against the
+    leaf scans' schemas; (None, None) when unresolvable."""
+    from hyperspace_tpu.plan.expr import as_equi_join_pairs
+
+    pairs = as_equi_join_pairs(plan.condition)
+    if pairs is None or len(pairs) != 1:
+        return None, None
+    by_name = {}
+    for leaf in plan.leaf_relations():
+        try:
+            for col, t in session.schema_map_of(leaf).items():
+                by_name.setdefault(col.lower(), t)
+        except Exception:
+            continue
+    a, b = pairs[0]
+    return by_name.get(a.lower()), by_name.get(b.lower())
+
+
+def _join_operator(session, plan: Join) -> str:
+    """The strategy the executor will take, named like Spark's physical
+    operators — decided by the executor's OWN precheck."""
+    from hyperspace_tpu.execution.executor import bucketed_join_precheck
+    from hyperspace_tpu.plan.expr import as_equi_join_pairs
+
+    try:
+        if bucketed_join_precheck(session, plan) is not None:
+            return "PerBucketMergeJoinExec"  # shuffle-free, bucket-aligned
+    except Exception:
+        pass
+    pairs = as_equi_join_pairs(plan.condition)
+    if pairs is not None and len(pairs) == 1:
+        lt, rt = _join_key_types(session, plan)
+        if lt is not None and rt is not None:
+            try:
+                is_num = (columnar.is_numeric_type(
+                    schema_to_arrow({"c": lt}).field(0).type)
+                    and columnar.is_numeric_type(
+                        schema_to_arrow({"c": rt}).field(0).type))
+            except Exception:
+                is_num = False
+            if is_num:
+                return "SortMergeJoinExec"
+    return "DigestHashJoinExec"  # composite/string keys (exact, verified)
+
+
+def physical_operators(session, plan: Optional[LogicalPlan]
+                       ) -> Tuple[Counter, List[str]]:
+    """(operator counts, per-scan detail lines) for one optimized plan."""
+    counts: Counter = Counter()
+    details: List[str] = []
+    if plan is None:
+        return counts, details
+
+    def walk(node: LogicalPlan) -> None:
+        if isinstance(node, Scan):
+            name, detail = _scan_detail(session, node)
+            counts[name] += 1
+            details.append(detail)
+        elif isinstance(node, Join):
+            counts[_join_operator(session, node)] += 1
+        elif isinstance(node, Filter):
+            counts["FilterExec"] += 1
+        elif isinstance(node, Project):
+            counts["ProjectExec"] += 1
+        elif isinstance(node, BucketUnion):
+            counts["BucketUnionExec"] += 1
+        elif isinstance(node, Union):
+            counts["UnionExec"] += 1
+        elif isinstance(node, InMemory):
+            counts["InMemoryExec"] += 1
+        else:
+            counts[type(node).__name__] += 1
+        for c in node.children:
+            walk(c)
+
+    walk(plan)
+    return counts, details

@@ -130,7 +130,39 @@ class TestExplain:
               .filter(col("id") == 1).select("id", "name"))
         out = hs.explain(ds, verbose=True)
         assert "Physical operator stats:" in out
-        assert "Scan" in out
+        # PHYSICAL operators, spelled out (PhysicalOperatorAnalyzer intent):
+        # the indexed plan scans the index, the baseline scans files.
+        assert "IndexScanExec" in out
+        assert "FileScanExec" in out
+        # Per-scan IO detail: files read / listed and bytes.
+        assert "Scan IO (with indexes):" in out
+        import re
+
+        assert re.search(r"files \d+/\d+, \d+\.\d\d MB", out), out
+
+    def test_explain_verbose_join_strategy(self, session, tmp_path):
+        """The predicted join operator comes from the executor's own
+        precheck: a numeric-key join without matching bucketed index scans
+        on both sides reports a plain sort-merge."""
+        hs = self._indexed_session(session)
+        other_dir = tmp_path / "other"
+        other_dir.mkdir()
+        pq.write_table(pa.table({
+            "rid": np.arange(50, dtype=np.int64),
+            "w": np.arange(50, dtype=np.int64) * 3,
+        }), str(other_dir / "p.parquet"))
+        ds = (session.read.parquet(session.data_path)
+              .join(session.read.parquet(str(other_dir)),
+                    col("id") == col("rid"))
+              .select("id", "name", "w"))
+        out = hs.explain(ds, verbose=True)
+        assert "SortMergeJoinExec" in out
+        # Index the right side too: the rewrite bucketes both sides and the
+        # prediction flips to the shuffle-free per-bucket merge.
+        hs.create_index(session.read.parquet(str(other_dir)),
+                        IndexConfig("ridx", ["rid"], ["w"]))
+        out2 = hs.explain(ds, verbose=True)
+        assert "PerBucketMergeJoinExec" in out2
 
     def test_explain_html_mode(self, session):
         hs = self._indexed_session(session)
