@@ -176,9 +176,12 @@ def _maybe_checkpoint(log: DeltaLog, version: int) -> None:
         # Unexpired remove tombstones ride along (delta-core checkpoint
         # schema): external readers pinned to an older version rely on them
         # within the retention window.
+        # deletionTimestamp is optional in the protocol: an unknown age
+        # (0) must be kept — dropping a possibly-fresh tombstone is the
+        # unsafe direction.
         horizon = int(time.time() * 1000) - TOMBSTONE_RETENTION_MS
         for t in snap.tombstones:
-            if t.deletion_timestamp >= horizon:
+            if t.deletion_timestamp >= horizon or t.deletion_timestamp == 0:
                 rows.append({"protocol": None, "metaData": None, "add": None,
                              "remove": {
                                  "path": _relativize(t.path, log.table_path),

@@ -64,15 +64,22 @@ def _check_append_schema(metadata: TableMetadata, arrow_schema: pa.Schema,
                          path: str) -> None:
     """Appends pin the table schema, so a mismatched table would commit
     silently and only surface later as null columns at read time; fail the
-    commit instead (Iceberg writers validate the same way)."""
+    commit instead (Iceberg writers validate the same way).  Omitting
+    optional table columns is legal (all our fields are optional; readers
+    null-fill), but unknown columns or changed types are not."""
     fresh = {f["name"]: f["type"] for f in iceberg_schema(arrow_schema)["fields"]}
     existing = {f["name"]: f["type"]
                 for f in metadata.schema.get("fields", [])}
-    if fresh != existing:
+    problems = [f"unknown column {n!r} ({t})" for n, t in sorted(fresh.items())
+                if n not in existing]
+    problems += [f"column {n!r} is {t}, table has {existing[n]}"
+                 for n, t in sorted(fresh.items())
+                 if n in existing and t != existing[n]]
+    if problems:
         raise ValueError(
-            f"Appended data schema {sorted(fresh.items())} does not match "
-            f"table schema {sorted(existing.items())} of Iceberg table "
-            f"{path}; use mode='overwrite' to change the schema")
+            f"Appended data schema does not match Iceberg table {path}: "
+            f"{'; '.join(problems)}; use mode='overwrite' to change the "
+            f"schema")
 
 
 def _write_manifest(table_path: str, entries: List[Dict],

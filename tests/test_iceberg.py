@@ -146,6 +146,9 @@ class TestIcebergTable:
                             "other": pa.array([30], type=pa.int64())})
         with pytest.raises(ValueError, match="does not match"):
             write_iceberg(retyped, path, mode="append")
+        # Omitting an optional column is legal: readers null-fill.
+        subset = pa.table({"id": pa.array([9], type=pa.int64())})
+        write_iceberg(subset, path, mode="append")
         # Overwrite is the sanctioned schema-change path.
         write_iceberg(bad, path, mode="overwrite")
         assert len(IcebergTable(path).plan_files()) == 1
