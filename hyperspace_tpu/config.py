@@ -32,6 +32,7 @@ EVENT_LOGGER = "hyperspace.eventLoggerClass"
 SUPPORTED_FILE_FORMATS = "hyperspace.index.supportedFileFormats"
 DEVICE_BATCH_ROWS = "hyperspace.tpu.deviceBatchRows"
 DEVICE_FILTER_MIN_ROWS = "hyperspace.tpu.deviceFilterMinRows"
+MESH_FILTER_MIN_ROWS = "hyperspace.tpu.meshFilterMinRows"
 DEVICE_JOIN_MIN_ROWS = "hyperspace.tpu.deviceJoinMinRows"
 PARALLEL_BUILD = "hyperspace.tpu.parallelBuild"
 SHUFFLE_CAPACITY_SLACK = "hyperspace.tpu.shuffleCapacitySlack"
@@ -87,6 +88,11 @@ class HyperspaceConf:
     # tunnel) that a vectorized host pass over a small batch never repays.
     # Raise toward 0 on locally attached chips with resident data.
     device_filter_min_rows: int = 1 << 22
+    # At or above this row count a device-eligible filter shards its
+    # columns over ALL visible devices (1-D mesh) instead of evaluating on
+    # one chip: the predicate is elementwise, so XLA partitions it with
+    # zero collectives and each device scans 1/N of the rows.
+    mesh_filter_min_rows: int = 1 << 24
     # Same cost model for joins: below this (max-side) row count the
     # sorted-merge join runs in numpy on host.
     device_join_min_rows: int = 1 << 22
@@ -129,6 +135,7 @@ class HyperspaceConf:
         SUPPORTED_FILE_FORMATS: "supported_file_formats",
         DEVICE_BATCH_ROWS: "device_batch_rows",
         DEVICE_FILTER_MIN_ROWS: "device_filter_min_rows",
+        MESH_FILTER_MIN_ROWS: "mesh_filter_min_rows",
         DEVICE_JOIN_MIN_ROWS: "device_join_min_rows",
         PARALLEL_BUILD: "parallel_build",
         SHUFFLE_CAPACITY_SLACK: "shuffle_capacity_slack",

@@ -7,6 +7,7 @@ This package replaces the reference's Spark-cluster distribution substrate
 """
 
 from hyperspace_tpu.parallel.build import distributed_bucket_sort_permutation
+from hyperspace_tpu.parallel.filter import eval_predicate_on_mesh
 from hyperspace_tpu.parallel.join import (
     copartitioned_join,
     copartitioned_join_ragged,
@@ -20,6 +21,7 @@ __all__ = [
     "bucket_shuffle",
     "ShuffleResult",
     "distributed_bucket_sort_permutation",
+    "eval_predicate_on_mesh",
     "copartitioned_join",
     "copartitioned_join_ragged",
 ]
