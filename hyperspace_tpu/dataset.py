@@ -12,7 +12,31 @@ from typing import List, Sequence
 import pyarrow as pa
 
 from hyperspace_tpu.plan.expr import Expr
-from hyperspace_tpu.plan.nodes import Filter, Join, LogicalPlan, Project
+from hyperspace_tpu.plan.nodes import Aggregate, Filter, Join, LogicalPlan, Project
+
+
+class GroupedDataset:
+    """``df.group_by(...)`` intermediate; ``agg`` specs are pandas-style
+    keyword pairs: ``agg(total=("l_quantity", "sum"))``."""
+
+    def __init__(self, dataset: "Dataset", group_by: Sequence[str]) -> None:
+        self._dataset = dataset
+        self._group_by = list(group_by)
+
+    def agg(self, **named_specs) -> "Dataset":
+        aggs = [(func, col, out)
+                for out, (col, func) in named_specs.items()]
+        return Dataset(Aggregate(self._group_by, aggs, self._dataset.plan),
+                       self._dataset.session)
+
+    def count(self, name: str = "count") -> "Dataset":
+        """ROW count per group (count(*): null group keys count too)."""
+        if not self._group_by:
+            raise ValueError(
+                "group_by().count() needs group columns; use "
+                "Dataset.count() for the total row count")
+        return Dataset(Aggregate(self._group_by, [("count_all", "", name)],
+                                 self._dataset.plan), self._dataset.session)
 
 
 class Dataset:
@@ -29,6 +53,13 @@ class Dataset:
 
     def join(self, other: "Dataset", condition: Expr, how: str = "inner") -> "Dataset":
         return Dataset(Join(self.plan, other.plan, condition, how), self.session)
+
+    def group_by(self, *columns: str) -> "GroupedDataset":
+        return GroupedDataset(self, columns)
+
+    def agg(self, **named_specs) -> "Dataset":
+        """Global aggregation (no grouping): ``df.agg(n=("k", "count"))``."""
+        return GroupedDataset(self, ()).agg(**named_specs)
 
     # -- execution ----------------------------------------------------------
     def optimized_plan(self) -> LogicalPlan:

@@ -29,6 +29,7 @@ from hyperspace_tpu.io.files import list_data_files
 from hyperspace_tpu.io.parquet import bucket_id_of_file, read_table
 from hyperspace_tpu.plan.expr import And, BinOp, Col, Expr, IsIn, Lit, Not, Or
 from hyperspace_tpu.plan.nodes import (
+    Aggregate,
     BucketUnion,
     Filter,
     InMemory,
@@ -67,10 +68,49 @@ class Executor:
             return table.select(plan.columns)
         if isinstance(plan, Join):
             return self._join(plan)
+        if isinstance(plan, Aggregate):
+            return self._aggregate(plan)
         if isinstance(plan, (BucketUnion, Union)):
             tables = [self.execute(c) for c in plan.children]
             return pa.concat_tables(tables, promote_options="default")
         raise ValueError(f"Unknown plan node: {type(plan).__name__}")
+
+    # -- aggregate ----------------------------------------------------------
+    def _aggregate(self, plan: Aggregate) -> pa.Table:
+        table = self.execute(plan.child)
+        specs = [([] if func == "count_all" else col, func)
+                 for func, col, _out in plan.aggs]
+        if plan.group_by:
+            keys = list(plan.group_by)
+            out = table.group_by(keys).aggregate(specs)
+            # Map output columns POSITIONALLY: key columns are located by
+            # name (unique); the remaining positions, in order, are the agg
+            # results in spec order — name-based mapping would collide for
+            # duplicate (column, func) specs.
+            key_pos = {}
+            remaining = []
+            for i, name in enumerate(out.column_names):
+                if name in plan.group_by and name not in key_pos:
+                    key_pos[name] = i
+                else:
+                    remaining.append(i)
+            assert len(remaining) == len(plan.aggs)
+            data = {k: out.column(key_pos[k]) for k in keys}
+            for (_f, _c, out_name), i in zip(plan.aggs, remaining):
+                data[out_name] = out.column(i)
+            return pa.table(data)
+        # Global aggregation: one row, computed per spec.
+        cols, vals = [], []
+        for func, col, out_name in plan.aggs:
+            if func == "count_all":
+                value = table.num_rows
+            elif func == "count":
+                value = table.num_rows - table.column(col).null_count
+            else:
+                value = getattr(pc, func)(table.column(col)).as_py()
+            cols.append(out_name)
+            vals.append(value)
+        return pa.table({n: [v] for n, v in zip(cols, vals)})
 
     # -- scan ---------------------------------------------------------------
     def _scan(self, plan: Scan, columns: Optional[List[str]] = None) -> pa.Table:
@@ -180,6 +220,16 @@ class Executor:
         device_cols = [columnar.to_device_numeric(table.column(c)) for c in order]
         # Scoped x64 so int64 columns keep full width on device (global x64
         # would leak dtype defaults into the embedding application's JAX).
+        if (len(jax.local_devices()) > 1 and table.num_rows
+                >= self.session.conf.mesh_filter_min_rows):
+            # Large scan + a mesh: shard the columns row-wise over every
+            # LOCAL device (the batch is host-resident; other hosts'
+            # devices are not addressable from here); the elementwise
+            # program partitions with zero collectives (parallel/filter.py,
+            # which scopes x64 itself).
+            from hyperspace_tpu.parallel.filter import eval_predicate_on_mesh
+
+            return eval_predicate_on_mesh(fn, device_cols, literals)
         with jax.enable_x64():
             mask = fn(device_cols, literals)
         return np.asarray(mask)

@@ -191,6 +191,48 @@ class Join(LogicalPlan):
         return f"Join {self.how} on {self.condition!r}"
 
 
+class Aggregate(LogicalPlan):
+    """Group-by + aggregations: ``aggs`` is a tuple of (function, column,
+    output_name), functions from arrow's hash-aggregate set (sum, min,
+    max, mean, count; count_all counts ROWS — its column is ignored).
+    Empty ``group_by`` = global aggregation.  The rewrite rules never
+    match an Aggregate itself — they rewrite the Filter/Scan/Join patterns
+    BELOW it (Catalyst's rules behave the same way: the reference's
+    TPC-DS q1 plans keep their Aggregates while the scans underneath swap
+    to indexes)."""
+
+    FUNCTIONS = ("sum", "min", "max", "mean", "count", "count_all")
+
+    def __init__(self, group_by: Sequence[str],
+                 aggs: Sequence[Tuple[str, str, str]],
+                 child: LogicalPlan) -> None:
+        for func, _col, _out in aggs:
+            if func not in self.FUNCTIONS:
+                raise ValueError(
+                    f"Unsupported aggregate function {func!r}; "
+                    f"expected one of {self.FUNCTIONS}")
+        self.group_by = tuple(group_by)
+        self.aggs = tuple(aggs)
+        self.children = (child,)
+
+    @property
+    def child(self) -> LogicalPlan:
+        return self.children[0]
+
+    def output_columns(self, schema_of) -> List[str]:
+        return list(self.group_by) + [out for _f, _c, out in self.aggs]
+
+    def with_children(self, children) -> "Aggregate":
+        (child,) = children
+        return Aggregate(self.group_by, self.aggs, child)
+
+    def simple_string(self) -> str:
+        aggs = ", ".join(
+            f"{f}({'*' if f == 'count_all' else c}) AS {out}"
+            for f, c, out in self.aggs)
+        return f"Aggregate [{', '.join(self.group_by)}] [{aggs}]"
+
+
 class BucketUnion(LogicalPlan):
     """Partition-preserving union of identically bucketed children
     (index/plans/logical/BucketUnion.scala:31-68).  In this engine a bucketed

@@ -19,6 +19,7 @@ from __future__ import annotations
 from typing import List, Optional, Set
 
 from hyperspace_tpu.plan.nodes import (
+    Aggregate,
     BucketUnion,
     Filter,
     Join,
@@ -51,6 +52,16 @@ def _prune(plan: LogicalPlan, required: Optional[Set[str]],
             new_child = new_child.child
         if new_child is not plan.child:
             return Project(plan.columns, new_child)
+        return plan
+    if isinstance(plan, Aggregate):
+        # Like Project, an Aggregate defines exactly what its subtree must
+        # produce: the grouping keys plus the aggregated inputs
+        # (count_all's column placeholder is empty — not a real column).
+        child_required = set(plan.group_by) | {c for _f, c, _o in plan.aggs
+                                               if c}
+        new_child = _prune(plan.child, child_required, schema_of)
+        if new_child is not plan.child:
+            return Aggregate(plan.group_by, plan.aggs, new_child)
         return plan
     if isinstance(plan, Filter):
         child_required = None if required is None else (

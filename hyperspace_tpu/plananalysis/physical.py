@@ -19,6 +19,7 @@ from typing import List, Optional, Tuple
 from hyperspace_tpu.io import columnar
 from hyperspace_tpu.io.parquet import bucket_id_of_file, schema_to_arrow
 from hyperspace_tpu.plan.nodes import (
+    Aggregate,
     BucketUnion,
     Filter,
     InMemory,
@@ -124,6 +125,8 @@ def physical_operators(session, plan: Optional[LogicalPlan]
             details.append(detail)
         elif isinstance(node, Join):
             counts[_join_operator(session, node)] += 1
+        elif isinstance(node, Aggregate):
+            counts["HashAggregateExec"] += 1
         elif isinstance(node, Filter):
             counts["FilterExec"] += 1
         elif isinstance(node, Project):

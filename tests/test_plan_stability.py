@@ -122,6 +122,22 @@ def catalog(tmp_path_factory):
                     IndexConfig("idx_orders_z", ["o_custkey", "o_totalprice"],
                                 ["o_orderkey"], layout="zorder"))
     session.conf.index_max_rows_per_file = 0
+    # events: indexed, then a file APPENDED after the build — the Hybrid
+    # Scan shapes (q21-q23).  Hybrid scan is enabled session-wide: tables
+    # with no appended/deleted files behave identically (zero ratios).
+    events = pa.table({
+        "e_id": np.arange(N_ROWS, dtype=np.int64),
+        "e_val": pa.array(rng.uniform(0, 10, N_ROWS), type=pa.float64()),
+    })
+    paths["events"] = os.path.join(root, "events")
+    _write(paths["events"], events, n_files=2)
+    hs.create_index(read.parquet(paths["events"]),
+                    IndexConfig("idx_events", ["e_id"], ["e_val"]))
+    pq.write_table(pa.table({
+        "e_id": np.arange(N_ROWS, N_ROWS + 20, dtype=np.int64),
+        "e_val": pa.array(rng.uniform(0, 10, 20), type=pa.float64()),
+    }), os.path.join(paths["events"], "part-appended.parquet"))
+    session.conf.hybrid_scan_enabled = True
     session.enable_hyperspace()
     return session, paths
 
@@ -136,6 +152,7 @@ def _queries(session, paths):
     lineitem = lambda: read.parquet(paths["lineitem"])  # noqa: E731
     customer = lambda: read.parquet(paths["customer"])  # noqa: E731
     part = lambda: read.parquet(paths["part"])  # noqa: E731
+    events = lambda: read.parquet(paths["events"])  # noqa: E731
     return {
         # FilterIndexRule family
         "q01_point_filter": orders()
@@ -192,6 +209,51 @@ def _queries(session, paths):
         "q14_zorder_second_dim_range": orders()
             .filter(col("o_totalprice") >= 990.0)
             .select("o_custkey", "o_totalprice"),
+        # -- TPC-H-shaped additions (aggregate / multi-join / hybrid) -----
+        # aggregate over an index-rewritten join (TPC-H Q12 shape)
+        "q15_agg_over_join": orders().join(
+            lineitem(), col("o_orderkey") == col("l_orderkey"))
+            .group_by("o_orderkey").agg(qty=("l_quantity", "sum")),
+        # three-way join: customer ⋈ orders ⋈ lineitem (TPC-H Q3 shape)
+        "q16_three_way_join": customer().join(
+            orders(), col("c_custkey") == col("o_custkey")).join(
+            lineitem(), col("o_orderkey") == col("l_orderkey"))
+            .select("c_name", "o_orderkey", "l_quantity"),
+        # join whose lineitem side needs a column no covering index has
+        # (l_shipdate) — the DS sketch prunes its files instead
+        "q17_join_with_ds_filter": part().join(
+            lineitem().filter((col("l_shipdate") >= 100)
+                              & (col("l_shipdate") < 300)),
+            col("p_partkey") == col("l_partkey"))
+            .select("p_name", "l_shipdate", "l_quantity"),
+        # aggregate directly over an index-rewritten filter
+        "q18_agg_over_indexed_filter": lineitem()
+            .filter(col("l_orderkey") >= 300)
+            .group_by("l_orderkey").agg(total=("l_extendedprice", "sum")),
+        # global (ungrouped) aggregate over an indexed point filter
+        "q19_global_agg": orders()
+            .filter(col("o_orderkey") == 42)
+            .agg(n=("o_orderkey", "count"), mx=("o_totalprice", "max")),
+        # aggregate over the three-way join (TPC-H Q3's full shape)
+        "q20_agg_over_three_way": customer().join(
+            orders(), col("c_custkey") == col("o_custkey")).join(
+            lineitem(), col("o_orderkey") == col("l_orderkey"))
+            .group_by("c_name").agg(revenue=("l_extendedprice", "sum")),
+        # hybrid scan: point filter over a table with appended files
+        "q21_hybrid_point_filter": events()
+            .filter(col("e_id") == 7).select("e_id", "e_val"),
+        # hybrid join: appended side routed into the index's bucket space
+        "q22_hybrid_join": events().join(
+            orders(), col("e_id") == col("o_orderkey"))
+            .select("e_id", "e_val", "o_totalprice"),
+        # aggregate over the hybrid join
+        "q23_agg_over_hybrid_join": events().join(
+            orders(), col("e_id") == col("o_orderkey"))
+            .group_by("o_orderkey").agg(v=("e_val", "sum")),
+        # count-group-by over the DS-pruned range scan
+        "q24_count_over_ds_range": lineitem()
+            .filter((col("l_shipdate") >= 100) & (col("l_shipdate") < 500))
+            .group_by("l_shipdate").count(),
     }
 
 
@@ -207,7 +269,7 @@ def _simplify(plan_string: str, paths) -> str:
     return out + "\n"
 
 
-QUERY_NAMES = [f"q{i:02d}" for i in range(1, 15)]
+QUERY_NAMES = [f"q{i:02d}" for i in range(1, 25)]
 
 
 def _query_by_prefix(queries, prefix):
