@@ -253,7 +253,8 @@ class CreateActionBase(Action):
         write_bucketed(table, np.asarray(buckets), np.asarray(perm),
                        self.num_buckets, out_dir,
                        max_rows_per_file=self.conf.index_max_rows_per_file,
-                       split_keys=split_keys, split_key_bits=split_bits)
+                       split_keys=split_keys, split_key_bits=split_bits,
+                       compression=self.conf.index_file_compression)
         self._write_index_file_sketch(out_dir, resolved)
         self._written_version = version
         self._index_schema = {name: str(t) for name, t in
@@ -420,17 +421,19 @@ class _BucketSpill:
                 [pq.read_table(os.path.join(bdir, r)) for r in runs],
                 promote_options="default")
             if resolved.layout == "zorder":
-                # The dir name is a SPILL partition (value-space Morton
-                # cell), not an index bucket: the index has one bucket, so
-                # every file is written as bucket 0.  Codes (and therefore
-                # the cell-aligned cuts) are partition-local ranks — see
+                # The dir name is a SPILL partition (hash group), not an
+                # index bucket: the index has one bucket, so every file is
+                # written as bucket 0.  Codes (and therefore the
+                # cell-aligned cuts) are partition-local ranks — see
                 # _sort_permutation's note.
                 write_zorder_run(btable, 0, out_dir, max_rows,
-                                 resolved.indexed_columns)
+                                 resolved.indexed_columns,
+                                 compression=action.conf.index_file_compression)
                 return
             perm = self._sort_permutation(btable)
             btable = btable.take(pa.array(perm))
-            write_bucket_run(btable, bucket, out_dir, max_rows)
+            write_bucket_run(btable, bucket, out_dir, max_rows,
+                             compression=action.conf.index_file_compression)
 
         from hyperspace_tpu.utils.parallel_map import parallel_map_ordered
 

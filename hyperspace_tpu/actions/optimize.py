@@ -135,7 +135,9 @@ class OptimizeAction(Action):
 
                 self._new_files.extend(
                     write_zorder_run(merged, bucket, out_dir, max_rows,
-                                     sort_cols))
+                                     sort_cols,
+                                     compression=self.session.conf
+                                     .index_file_compression))
                 continue
             perm = sort_permutation_host(merged, sort_cols, layout)
             merged = merged.take(pa.array(perm))
@@ -143,7 +145,9 @@ class OptimizeAction(Action):
             # would destroy the per-file sketch pruning granularity the
             # split exists for.
             self._new_files.extend(
-                write_bucket_run(merged, bucket, out_dir, max_rows))
+                write_bucket_run(merged, bucket, out_dir, max_rows,
+                                 compression=self.session.conf
+                                 .index_file_compression))
         # Per-file min/max sketch for the compacted version, like every
         # build writes — keeps FilterIndexRule's file pruning effective on
         # optimized indexes.

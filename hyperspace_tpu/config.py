@@ -33,6 +33,7 @@ SUPPORTED_FILE_FORMATS = "hyperspace.index.supportedFileFormats"
 DEVICE_BATCH_ROWS = "hyperspace.tpu.deviceBatchRows"
 DEVICE_FILTER_MIN_ROWS = "hyperspace.tpu.deviceFilterMinRows"
 MESH_FILTER_MIN_ROWS = "hyperspace.tpu.meshFilterMinRows"
+INDEX_FILE_COMPRESSION = "hyperspace.tpu.indexFileCompression"
 DEVICE_JOIN_MIN_ROWS = "hyperspace.tpu.deviceJoinMinRows"
 PARALLEL_BUILD = "hyperspace.tpu.parallelBuild"
 SHUFFLE_CAPACITY_SLACK = "hyperspace.tpu.shuffleCapacitySlack"
@@ -42,6 +43,12 @@ HIGHLIGHT_BEGIN_TAG = "hyperspace.explain.displayMode.highlight.beginTag"
 HIGHLIGHT_END_TAG = "hyperspace.explain.displayMode.highlight.endTag"
 
 _DEFAULT_NUM_BUCKETS = 200  # IndexConstants.scala:31-32 (spark.sql.shuffle.partitions default)
+
+
+def _index_compression_default() -> str:
+    from hyperspace_tpu.io.parquet import INDEX_COMPRESSION_DEFAULT
+
+    return INDEX_COMPRESSION_DEFAULT
 
 
 @dataclasses.dataclass
@@ -93,6 +100,14 @@ class HyperspaceConf:
     # one chip: the predicate is elementwise, so XLA partitions it with
     # zero collectives and each device scans 1/N of the rows.
     mesh_filter_min_rows: int = 1 << 24
+    # Parquet codec for INDEX data files.  An index is a derived,
+    # query-latency-oriented copy: lz4 decodes ~25% faster than snappy at
+    # the same size on typical numeric index columns ("none" is fastest
+    # still, +16% size).  Source data is never rewritten.  The default
+    # literal lives with the writers (io/parquet.INDEX_COMPRESSION_DEFAULT)
+    # so a compression-kwarg-less writer call can never drift from it.
+    index_file_compression: str = dataclasses.field(
+        default_factory=lambda: _index_compression_default())
     # Same cost model for joins: below this (max-side) row count the
     # sorted-merge join runs in numpy on host.
     device_join_min_rows: int = 1 << 22
@@ -136,6 +151,7 @@ class HyperspaceConf:
         DEVICE_BATCH_ROWS: "device_batch_rows",
         DEVICE_FILTER_MIN_ROWS: "device_filter_min_rows",
         MESH_FILTER_MIN_ROWS: "mesh_filter_min_rows",
+        INDEX_FILE_COMPRESSION: "index_file_compression",
         DEVICE_JOIN_MIN_ROWS: "device_join_min_rows",
         PARALLEL_BUILD: "parallel_build",
         SHUFFLE_CAPACITY_SLACK: "shuffle_capacity_slack",

@@ -402,8 +402,11 @@ class Executor:
         from hyperspace_tpu.utils.parallel_map import parallel_map_ordered
 
         # Buckets are independent; parquet decode + numpy merge release the
-        # GIL.  Low cap: each in-flight bucket holds both sides + output.
-        parts = parallel_map_ordered(join_bucket, shared, max_workers=4)
+        # GIL.  Each in-flight bucket holds both sides + output (~2/B of
+        # the joined data), so 8 concurrent buckets stay memory-modest
+        # while keeping every core decoding (nested per-file reads run
+        # inline in the shared pool, so this cap IS the read concurrency).
+        parts = parallel_map_ordered(join_bucket, shared, max_workers=8)
         return pa.concat_tables(parts, promote_options="default")
 
     def _side_bucket_parts(self, side: "_BucketedSide", by_bucket):

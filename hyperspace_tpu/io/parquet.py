@@ -248,10 +248,22 @@ def zorder_split_chunks(z_sorted: np.ndarray, key_bits: int,
     return out
 
 
+# Parquet codec for index data; "none" means uncompressed.  Conf
+# hyperspace.tpu.indexFileCompression overrides per session (actions pass
+# it through); the default favors decode speed (see config.py).
+INDEX_COMPRESSION_DEFAULT = "lz4"
+
+
+def _codec(compression: Optional[str]):
+    c = (compression or INDEX_COMPRESSION_DEFAULT).lower()
+    return None if c == "none" else c
+
+
 def write_bucket_run(sorted_bucket_table: pa.Table, bucket: int,
                      out_dir: str, max_rows_per_file: int = 0,
                      split_keys: Optional[np.ndarray] = None,
-                     split_key_bits: int = 0) -> List[str]:
+                     split_key_bits: int = 0,
+                     compression: Optional[str] = None) -> List[str]:
     """Write ONE bucket's already-sorted rows, split at
     ``max_rows_per_file`` — shared by the external build's phase 2 and
     optimize's compaction (both already parallelize per bucket; the
@@ -268,7 +280,8 @@ def write_bucket_run(sorted_bucket_table: pa.Table, bucket: int,
     out: List[str] = []
     for off, rows in chunks:
         path = os.path.join(out_dir, bucket_file_name(bucket))
-        pq.write_table(sorted_bucket_table.slice(off, rows), path)
+        pq.write_table(sorted_bucket_table.slice(off, rows), path,
+                       compression=_codec(compression))
         out.append(path)
     return out
 
@@ -293,7 +306,8 @@ def sort_permutation_host(table: pa.Table, indexed_columns, layout: str):
 
 
 def write_zorder_run(btable: pa.Table, bucket: int, out_dir: str,
-                     max_rows_per_file: int, indexed_columns) -> List[str]:
+                     max_rows_per_file: int, indexed_columns,
+                     compression: Optional[str] = None) -> List[str]:
     """Morton-sort one bucket run and write it with Z-cell-aligned file
     cuts — the ONE home for the zorder sort+split contract, shared by the
     external build's phase 2 and optimize's compaction (a divergence
@@ -302,14 +316,16 @@ def write_zorder_run(btable: pa.Table, bucket: int, out_dir: str,
     perm = np.argsort(codes, kind="stable")
     return write_bucket_run(btable.take(pa.array(perm)), bucket, out_dir,
                             max_rows_per_file,
-                            split_keys=codes[perm], split_key_bits=bits)
+                            split_keys=codes[perm], split_key_bits=bits,
+                            compression=compression)
 
 
 def write_bucketed(table: pa.Table, bucket_ids: np.ndarray, sort_perm: np.ndarray,
                    num_buckets: int, out_dir: str,
                    max_rows_per_file: int = 0,
                    split_keys: Optional[np.ndarray] = None,
-                   split_key_bits: int = 0) -> List[str]:
+                   split_key_bits: int = 0,
+                   compression: Optional[str] = None) -> List[str]:
     """Write ``table`` as sorted Parquet files, one or more per non-empty
     bucket.
 
@@ -349,7 +365,8 @@ def write_bucketed(table: pa.Table, bucket_ids: np.ndarray, sort_perm: np.ndarra
     def write(job) -> str:
         b, start, rows = job
         path = os.path.join(out_dir, bucket_file_name(b))
-        pq.write_table(sorted_table.slice(start, rows), path)
+        pq.write_table(sorted_table.slice(start, rows), path,
+                       compression=_codec(compression))
         return path
 
     return parallel_map_ordered(write, jobs)

@@ -4,30 +4,88 @@ pyarrow's readers and writers release the GIL, so scans/writes of many
 files overlap decode and filesystem latency instead of serializing on one
 core.  Fail-fast: the first exception cancels not-yet-started work and
 propagates immediately.
+
+One SHARED pool serves every call: a query plan calls this dozens of times
+(per scan, per join bucket), and per-call ThreadPoolExecutor creation /
+teardown costs milliseconds of thread churn per query.  Reentrancy is
+handled by running NESTED calls inline in the calling worker (the outer
+level already provides the parallelism; a bounded shared pool with nested
+submission could deadlock).  ``max_workers`` caps a call's in-flight tasks
+by THROTTLED SUBMISSION — a call never occupies more pool threads than its
+cap, so concurrent callers share the pool instead of queueing behind one
+call's backlog — and a failing call stops submitting, joins its in-flight
+tasks, then raises: the caller's cleanup (e.g. removing a spill dir) can
+never race still-running tasks.
 """
 
 from __future__ import annotations
 
 import os
-from typing import Callable, List, Sequence, TypeVar
+import threading
+from typing import Callable, List, Optional, Sequence, TypeVar
 
 T = TypeVar("T")
 R = TypeVar("R")
 
+_POOL = None
+_POOL_PID: Optional[int] = None
+_POOL_LOCK = threading.Lock()
+_IN_WORKER = threading.local()
+
+
+def _pool():
+    global _POOL, _POOL_PID
+    with _POOL_LOCK:
+        # Fork guard: a child inherits the pool OBJECT but not its threads;
+        # submitting to it would hang forever.
+        if _POOL is None or _POOL_PID != os.getpid():
+            from concurrent.futures import ThreadPoolExecutor
+
+            _POOL = ThreadPoolExecutor(
+                max_workers=min(32, (os.cpu_count() or 4) * 2),
+                thread_name_prefix="hs-io")
+            _POOL_PID = os.getpid()
+        return _POOL
+
 
 def parallel_map_ordered(fn: Callable[[T], R], items: Sequence[T],
                          max_workers: int = 16) -> List[R]:
-    if len(items) <= 1:
+    n = len(items)
+    if n <= 1 or getattr(_IN_WORKER, "active", False):
         return [fn(x) for x in items]
-    from concurrent.futures import FIRST_EXCEPTION, ThreadPoolExecutor, wait
+    workers = min(n, os.cpu_count() or 4, max_workers)
+    pool = _pool()
+    results: List = [None] * n
+    cond = threading.Condition()
+    state = {"next": 0, "outstanding": 0, "error": None}
 
-    workers = min(len(items), os.cpu_count() or 4, max_workers)
-    with ThreadPoolExecutor(max_workers=workers) as pool:
-        futures = [pool.submit(fn, x) for x in items]
-        done, not_done = wait(futures, return_when=FIRST_EXCEPTION)
-        failed = next((f for f in done if f.exception() is not None), None)
-        if failed is not None:
-            for f in not_done:
-                f.cancel()
-            raise failed.exception()
-        return [f.result() for f in futures]
+    def run(i: int) -> None:
+        _IN_WORKER.active = True
+        err = None
+        try:
+            results[i] = fn(items[i])
+        except BaseException as e:  # noqa: BLE001 — re-raised in the caller
+            err = e
+        finally:
+            _IN_WORKER.active = False
+        with cond:
+            state["outstanding"] -= 1
+            if err is not None and state["error"] is None:
+                state["error"] = err
+            cond.notify_all()
+
+    with cond:
+        while True:
+            while (state["error"] is None and state["next"] < n
+                   and state["outstanding"] < workers):
+                i = state["next"]
+                state["next"] += 1
+                state["outstanding"] += 1
+                pool.submit(run, i)
+            if state["outstanding"] == 0 and (
+                    state["error"] is not None or state["next"] >= n):
+                break
+            cond.wait()
+    if state["error"] is not None:
+        raise state["error"]
+    return results
