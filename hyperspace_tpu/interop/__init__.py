@@ -1,0 +1,16 @@
+"""Language-neutral interop surface.
+
+The reference exposes its API to non-JVM hosts via py4j bindings
+(python/hyperspace/hyperspace.py:9) and ships a .NET sample
+(examples/csharp/HyperspaceApp/Program.cs).  This package is the
+equivalent for a Python-native engine: queries arrive as a JSON spec
+(interop/query.py) over a socket and results return as an Arrow IPC
+stream (interop/server.py) — consumable from Java/C#/Go/Rust/JS through
+any Arrow implementation, no Python required on the client.
+"""
+
+from hyperspace_tpu.interop.query import dataset_from_spec, expr_from_json
+from hyperspace_tpu.interop.server import QueryServer, request_query
+
+__all__ = ["dataset_from_spec", "expr_from_json", "QueryServer",
+           "request_query"]

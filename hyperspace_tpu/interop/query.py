@@ -1,0 +1,79 @@
+"""JSON query spec -> Dataset: the wire form of the engine's plan verbs.
+
+A spec is one JSON object:
+
+    {"source": {"format": "parquet", "path": "/data/lineitem"},
+     "filter": {"op": ">=", "col": "l_orderkey", "value": 100},
+     "select": ["l_orderkey", "l_quantity"],
+     "join":   {"source": {...}, "on": {"op": "==", "col": "a",
+                                        "right_col": "b"}},
+     "group_by": ["l_orderkey"],
+     "aggs":   {"total": ["l_quantity", "sum"]}}
+
+Verbs compose in the engine's canonical order: source -> filter -> join
+-> group_by/aggs -> select (a select before grouping is expressed by the
+pruning pass anyway).  Expressions use the same operator names as the
+plan IR (==, <, <=, >, >=, and, or, not, in).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from hyperspace_tpu.plan.expr import And, BinOp, Col, Expr, IsIn, Lit, Not, Or
+
+_CMP_OPS = ("==", "<", "<=", ">", ">=")
+
+
+def expr_from_json(obj: Dict[str, Any]) -> Expr:
+    op = obj.get("op")
+    if op in _CMP_OPS:
+        left = Col(obj["col"])
+        if "right_col" in obj:
+            return BinOp(op, left, Col(obj["right_col"]))
+        return BinOp(op, left, Lit(obj["value"]))
+    if op == "and":
+        return And(expr_from_json(obj["left"]), expr_from_json(obj["right"]))
+    if op == "or":
+        return Or(expr_from_json(obj["left"]), expr_from_json(obj["right"]))
+    if op == "not":
+        return Not(expr_from_json(obj["child"]))
+    if op == "in":
+        return IsIn(Col(obj["col"]), list(obj["values"]))
+    raise ValueError(f"Unknown expression op: {op!r}")
+
+
+# Wire input never reaches arbitrary attributes: explicit reader allowlist.
+_SOURCE_FORMATS = ("parquet", "csv", "json", "orc", "avro", "text",
+                   "delta", "iceberg")
+
+
+def _read_source(session, source: Dict[str, Any]):
+    fmt = source.get("format", "parquet")
+    if fmt not in _SOURCE_FORMATS:
+        raise ValueError(f"Unknown source format: {fmt!r}")
+    path = source["path"]
+    options = source.get("options", {})
+    reader = getattr(session.read, fmt)
+    return reader(path, **options) if options else reader(path)
+
+
+def dataset_from_spec(session, spec: Dict[str, Any]):
+    """Build a Dataset from ``spec`` against ``session`` (whose hyperspace
+    enablement and indexes govern rewrites, exactly as for local use)."""
+    ds = _read_source(session, spec["source"])
+    if "filter" in spec:
+        ds = ds.filter(expr_from_json(spec["filter"]))
+    if "join" in spec:
+        j = spec["join"]
+        other = _read_source(session, j["source"])
+        if "filter" in j:
+            other = other.filter(expr_from_json(j["filter"]))
+        ds = ds.join(other, expr_from_json(j["on"]), j.get("how", "inner"))
+    if "aggs" in spec or "group_by" in spec:
+        grouped = ds.group_by(*spec.get("group_by", []))
+        aggs = spec.get("aggs", {})  # {out: [col, func]} unpacks in agg()
+        ds = grouped.agg(**aggs) if aggs else grouped.count()
+    if "select" in spec:
+        ds = ds.select(*spec["select"])
+    return ds

@@ -1,0 +1,107 @@
+"""Arrow-IPC query server: one JSON request line in, one IPC stream out.
+
+Wire protocol (deliberately minimal so any language can speak it with a
+socket plus an Arrow library — no HTTP/gRPC dependency):
+
+  client -> server   one JSON object (the interop/query.py spec),
+                     UTF-8, terminated by a newline
+  server -> client   the status line ``OK\\n`` followed by an Arrow IPC
+                     STREAM of the result (self-delimiting), or
+                     ``ERR <message>\\n`` and the connection closes
+
+One request per connection.  The server executes against ONE session, so
+enabled indexes and conf govern rewrites exactly as for local use — this
+is the parity surface for the reference's py4j bindings / .NET sample
+(python/hyperspace/hyperspace.py:9, examples/csharp/Program.cs): a JVM or
+.NET client sends the JSON spec and reads the stream with its own Arrow
+implementation.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import pyarrow as pa
+
+MAX_REQUEST_BYTES = 1 << 20  # a query spec, not a data upload
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self) -> None:
+        line = self.rfile.readline(MAX_REQUEST_BYTES)
+        try:
+            spec = json.loads(line.decode("utf-8"))
+            from hyperspace_tpu.interop.query import dataset_from_spec
+
+            # One query at a time: collect() mutates session-level state
+            # (last_execution_stats), so concurrent handler threads must
+            # not interleave executions against the shared session.
+            with self.server.exec_lock:
+                table = dataset_from_spec(self.server.session, spec).collect()
+        except Exception as exc:  # -> wire error, connection stays sane
+            msg = str(exc).replace("\n", " ")[:500]
+            try:
+                self.wfile.write(f"ERR {msg}\n".encode("utf-8"))
+            except OSError:
+                pass
+            return
+        self.wfile.write(b"OK\n")
+        with pa.ipc.new_stream(self.wfile, table.schema) as writer:
+            writer.write_table(table)
+
+
+class QueryServer:
+    """Threaded TCP server bound to ``session``.  ``port=0`` picks an
+    ephemeral port (read it back from ``.address``)."""
+
+    def __init__(self, session, host: str = "127.0.0.1",
+                 port: int = 0) -> None:
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((host, port), _Handler)
+        self._server.session = session
+        self._server.exec_lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._server.server_address
+
+    def start(self) -> "QueryServer":
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="hs-query-server", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def __enter__(self) -> "QueryServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def request_query(address: Tuple[str, int],
+                  spec: Dict[str, Any]) -> pa.Table:
+    """Reference client (tests / Python callers): send ``spec``, return the
+    result table.  Non-Python clients reimplement these ~10 lines with
+    their socket + Arrow APIs."""
+    with socket.create_connection(address) as sock:
+        sock.sendall(json.dumps(spec).encode("utf-8") + b"\n")
+        f = sock.makefile("rb")
+        status = f.readline().decode("utf-8").rstrip("\n")
+        if not status.startswith("OK"):
+            raise RuntimeError(f"Query failed: {status}")
+        with pa.ipc.open_stream(f) as reader:
+            return reader.read_all()
