@@ -525,11 +525,16 @@ def bucketed_join_precheck(session, plan: Join):
     free, shared by the executor and the explain physical analyzer so the
     predicted strategy can never diverge from the executed one.  Returns
     (left_side, right_side, left_files_by_bucket, right_files_by_bucket)
-    or None when the plain join path applies."""
+    or None when the plain join path applies.
+
+    Multi-column keys qualify when the join pairs map the two sides'
+    bucket columns POSITION BY POSITION (the reference's compatible-order
+    requirement, JoinIndexRule.scala:483-530) — same hash inputs in the
+    same order means equal key tuples share a bucket id."""
     from hyperspace_tpu.plan.expr import as_equi_join_pairs
 
     pairs = as_equi_join_pairs(plan.condition)
-    if pairs is None or len(pairs) != 1:
+    if not pairs:
         return None
     aligned = [_bucketed_side(side) for side in (plan.left, plan.right)]
     if any(a is None for a in aligned):
@@ -539,22 +544,41 @@ def bucketed_join_precheck(session, plan: Join):
     l_spec, r_spec = l_scan.relation.bucket_spec, r_scan.relation.bucket_spec
     if l_spec[0] != r_spec[0]:
         return None
-    a, b = pairs[0]
     l_cols = tuple(c.lower() for c in l_spec[1])
     r_cols = tuple(c.lower() for c in r_spec[1])
-    la, rb = a.lower(), b.lower()
-    if not ((l_cols == (la,) and r_cols == (rb,))
-            or (l_cols == (rb,) and r_cols == (la,))):
+    if len(pairs) != len(l_cols) or len(l_cols) != len(r_cols):
+        return None
+    # Orient each pair to (left-side column, right-side column); a pair
+    # whose columns don't belong to the two bucket specs disqualifies.
+    l_to_r = {}
+    for a, b in pairs:
+        la, rb = a.lower(), b.lower()
+        fwd = la in l_cols and rb in r_cols
+        rev = rb in l_cols and la in r_cols
+        if fwd and rev and la != rb:
+            # Ambiguous orientation (both names exist on both specs): the
+            # per-bucket sub-join resolves sides by TABLE columns and could
+            # pick the other pairing — partitioning on one orientation and
+            # joining on the other silently drops matches.  Plain path.
+            return None
+        if fwd:
+            l_to_r[la] = rb
+        elif rev:
+            l_to_r[rb] = la
+        else:
+            return None
+    if [l_to_r.get(c) for c in l_cols] != list(r_cols):
         return None
     # Bucket ids only align when both sides hashed the SAME bit patterns:
     # an int64 key on one side and float64 on the other put equal VALUES in
     # different buckets (to_hash_words hashes raw bits), while the plain
     # join path matches them by value — so a type mismatch must fall back,
     # or results silently change.
-    l_type = session.schema_map_of(l_scan).get(l_spec[1][0])
-    r_type = session.schema_map_of(r_scan).get(r_spec[1][0])
-    if l_type is None or r_type is None or l_type != r_type:
-        return None
+    for lc, rc in zip(l_spec[1], r_spec[1]):
+        l_type = session.schema_map_of(l_scan).get(lc)
+        r_type = session.schema_map_of(r_scan).get(rc)
+        if l_type is None or r_type is None or l_type != r_type:
+            return None
     # Cheap structural checks for BOTH sides before the executor runs any
     # appended subtree (a late failure would re-execute it on the plain
     # path).

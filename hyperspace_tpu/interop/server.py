@@ -30,10 +30,22 @@ import pyarrow as pa
 MAX_REQUEST_BYTES = 1 << 20  # a query spec, not a data upload
 
 
+REQUEST_TIMEOUT_S = 30.0  # an idle connection must not pin a thread + fd
+
+
 class _Handler(socketserver.StreamRequestHandler):
+    timeout = REQUEST_TIMEOUT_S  # StreamRequestHandler applies it pre-read
+
     def handle(self) -> None:
-        line = self.rfile.readline(MAX_REQUEST_BYTES)
         try:
+            line = self.rfile.readline(MAX_REQUEST_BYTES + 1)
+        except (TimeoutError, OSError):
+            return
+        try:
+            if len(line) > MAX_REQUEST_BYTES or (line and not line.endswith(b"\n")):
+                raise ValueError(
+                    f"request exceeds {MAX_REQUEST_BYTES} bytes or is not "
+                    f"newline-terminated")
             spec = json.loads(line.decode("utf-8"))
             from hyperspace_tpu.interop.query import dataset_from_spec
 
@@ -49,9 +61,12 @@ class _Handler(socketserver.StreamRequestHandler):
             except OSError:
                 pass
             return
-        self.wfile.write(b"OK\n")
-        with pa.ipc.new_stream(self.wfile, table.schema) as writer:
-            writer.write_table(table)
+        try:
+            self.wfile.write(b"OK\n")
+            with pa.ipc.new_stream(self.wfile, table.schema) as writer:
+                writer.write_table(table)
+        except OSError:
+            pass  # client hung up mid-response; nothing to clean up
 
 
 class QueryServer:

@@ -154,6 +154,48 @@ def test_multi_column_and_string_joins_execute_exactly(tmp_path):
         ("x", 10, 200), ("x", 30, 200), ("y", 20, 100)]
 
 
+def test_multi_column_join_executes_bucket_aligned(tmp_path):
+    """Both sides indexed on the SAME two columns in the same order: the
+    join runs per bucket (shuffle-free), matching the reference's
+    compatible-order multi-column rule (JoinIndexRule.scala:483-530)."""
+    import numpy as np
+
+    ldir = str(tmp_path / "L")
+    rdir = str(tmp_path / "R")
+    os.makedirs(ldir)
+    os.makedirs(rdir)
+    rng = np.random.default_rng(12)
+    n = 3000
+    pq.write_table(pa.table({
+        "a": pa.array(rng.integers(0, 40, n), type=pa.int64()),
+        "b": pa.array(rng.integers(0, 5, n), type=pa.int64()),
+        "lv": pa.array(rng.random(n)),
+    }), os.path.join(ldir, "f.parquet"))
+    pq.write_table(pa.table({
+        "a2": pa.array(rng.integers(0, 40, n // 3), type=pa.int64()),
+        "b2": pa.array(rng.integers(0, 5, n // 3), type=pa.int64()),
+        "rv": pa.array(rng.random(n // 3)),
+    }), os.path.join(rdir, "f.parquet"))
+    session = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    session.conf.num_buckets = 4
+    hs = Hyperspace(session)
+    hs.create_index(session.read.parquet(ldir),
+                    IndexConfig("li2", ["a", "b"], ["lv"]))
+    hs.create_index(session.read.parquet(rdir),
+                    IndexConfig("ri2", ["a2", "b2"], ["rv"]))
+    session.enable_hyperspace()
+    ds = (session.read.parquet(ldir)
+          .join(session.read.parquet(rdir),
+                (col("a") == col("a2")) & (col("b") == col("b2")))
+          .select("a", "b", "lv", "rv"))
+    got = ds.collect()
+    assert session.last_execution_stats["joins"][0]["strategy"] == "bucketed"
+    session.disable_hyperspace()
+    want = ds.collect()
+    keys = [(c, "ascending") for c in ("a", "b", "lv", "rv")]
+    assert got.sort_by(keys).equals(want.sort_by(keys))
+
+
 def test_string_column_vs_numeric_literal_coerces_numerically(tmp_path):
     """Spark promotes string-vs-numeric comparisons to DOUBLE, so
     '05' == 5, '5.0' == 5 and '5e0' == 5 all match and '12' < 7 is

@@ -112,6 +112,15 @@ class TestServer:
                 request_query(server.address, {"source": {
                     "format": "nope", "path": "/nowhere"}})
 
+    def test_oversize_request_gets_clear_error(self, env):
+        s, data = env
+        huge = {"source": {"format": "parquet", "path": data},
+                "filter": {"op": "in", "col": "k",
+                           "values": list(range(300_000))}}
+        with QueryServer(s) as server:
+            with pytest.raises(RuntimeError, match="exceeds"):
+                request_query(server.address, huge)
+
     def test_raw_socket_protocol(self, env):
         """The wire format a non-Python client implements: JSON line out,
         'OK' line + IPC stream back."""

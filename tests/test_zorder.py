@@ -8,6 +8,8 @@ the reference snapshot (BASELINE.json's Z-order config)."""
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as pq
@@ -206,6 +208,39 @@ class TestIndexFileSketchPruning:
 
 
 class TestZorderRefresh:
+    def test_incremental_refresh_appends_zorder_version(self, session,
+                                                        tmp_path):
+        """Incremental refresh builds the appended files' version with the
+        zorder write path (layout pinned): bucket-0 files, aligned cuts,
+        answers exact across both versions."""
+        from hyperspace_tpu.io.parquet import bucket_id_of_file
+
+        root = _grid_data(tmp_path)
+        session.conf.index_max_rows_per_file = 256
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(root),
+                        IndexConfig("zi", ["x", "y"], ["payload"],
+                                    layout="zorder"))
+        rng = np.random.default_rng(3)
+        pq.write_table(pa.table({
+            "x": pa.array(rng.integers(0, 1 << 16, 512), type=pa.int64()),
+            "y": pa.array(rng.integers(0, 1 << 16, 512), type=pa.int64()),
+            "payload": pa.array(rng.random(512)),
+        }), root + "/part-append.parquet")
+        hs.refresh_index("zi", "incremental")
+        entry = session.index_collection_manager.get_index("zi")
+        assert entry.num_buckets == 1
+        files = [f.name for f in entry.content.file_infos()]
+        assert all(bucket_id_of_file(f) == 0 for f in files)
+        assert len({os.path.dirname(f) for f in files}) == 2  # two versions
+        session.enable_hyperspace()
+        ds = (session.read.parquet(root)
+              .filter(col("y") >= (1 << 15)).select("x", "y", "payload"))
+        got = ds.collect()
+        session.disable_hyperspace()
+        keys = [(c, "ascending") for c in ("x", "y", "payload")]
+        assert got.sort_by(keys).equals(ds.collect().sort_by(keys))
+
     def test_refresh_keeps_zorder_layout(self, session, tmp_path):
         """Refresh must not silently rebuild a Z-ordered index
         lexicographic (layout pinned like numBuckets/lineage)."""
