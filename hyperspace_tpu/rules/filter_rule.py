@@ -176,6 +176,27 @@ def _find_covering_indexes(candidates: Sequence[IndexLogEntry],
     return out
 
 
+def _pinned_values(e: Expr) -> Optional[Tuple[str, set]]:
+    """(column, finite value set) when ``e`` pins one column: an equality,
+    an IN list, or a DISJUNCTION of those over the same column
+    (``a == 1 OR a IN (2, 3)`` pins a to {1, 2, 3} — same normalization
+    the sketch pruning applies)."""
+    if isinstance(e, BinOp) and e.op == "==":
+        if isinstance(e.left, Col) and isinstance(e.right, Lit):
+            return e.left.name.lower(), {e.right.value}
+        if isinstance(e.right, Col) and isinstance(e.left, Lit):
+            return e.right.name.lower(), {e.left.value}
+        return None
+    if isinstance(e, IsIn) and isinstance(e.child, Col):
+        return e.child.name.lower(), set(e.values)
+    if isinstance(e, Or):
+        left = _pinned_values(e.left)
+        right = _pinned_values(e.right)
+        if left is not None and right is not None and left[0] == right[0]:
+            return left[0], left[1] | right[1]
+    return None
+
+
 def _bucket_pruning(condition: Expr, entry: IndexLogEntry
                     ) -> Optional[Tuple[int, ...]]:
     """Buckets that can possibly hold matching rows, or None if not prunable.
@@ -187,13 +208,10 @@ def _bucket_pruning(condition: Expr, entry: IndexLogEntry
     """
     pinned: dict = {}
     for conj in split_conjuncts(condition):
-        if isinstance(conj, BinOp) and conj.op == "==":
-            if isinstance(conj.left, Col) and isinstance(conj.right, Lit):
-                pinned.setdefault(conj.left.name.lower(), set()).add(conj.right.value)
-            elif isinstance(conj.right, Col) and isinstance(conj.left, Lit):
-                pinned.setdefault(conj.right.name.lower(), set()).add(conj.left.value)
-        elif isinstance(conj, IsIn) and isinstance(conj.child, Col):
-            pinned.setdefault(conj.child.name.lower(), set()).update(conj.values)
+        hit = _pinned_values(conj)
+        if hit is not None:
+            name, values = hit
+            pinned.setdefault(name, set()).update(values)
     indexed = [c.lower() for c in entry.indexed_columns]
     if not all(c in pinned for c in indexed):
         return None

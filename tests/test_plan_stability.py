@@ -137,6 +137,31 @@ def catalog(tmp_path_factory):
         "e_id": np.arange(N_ROWS, N_ROWS + 20, dtype=np.int64),
         "e_val": pa.array(rng.uniform(0, 10, 20), type=pa.float64()),
     }), os.path.join(paths["events"], "part-appended.parquet"))
+    # A Delta table (lake-source shapes) and a lineage-enabled table with a
+    # post-index DELETED file (the Filter(Not(In(lineage))) hybrid shape).
+    from hyperspace_tpu.sources.delta import write_delta
+
+    paths["dorders"] = os.path.join(root, "dorders")
+    write_delta(pa.table({
+        "d_key": np.arange(N_ROWS, dtype=np.int64),
+        "d_price": pa.array(rng.uniform(1, 1000, N_ROWS),
+                            type=pa.float64()),
+    }), paths["dorders"])
+    hs.create_index(read.delta(paths["dorders"]),
+                    IndexConfig("idx_dorders", ["d_key"], ["d_price"]))
+    logs = pa.table({
+        "g_id": np.arange(N_ROWS, dtype=np.int64),
+        "g_val": pa.array(rng.uniform(0, 10, N_ROWS), type=pa.float64()),
+    })
+    paths["logs"] = os.path.join(root, "logs")
+    # 1 of 8 files deleted post-build: 12.5% deleted bytes, inside the
+    # hybrid-scan deleted-ratio bound (0.2).
+    _write(paths["logs"], logs, n_files=8)
+    session.conf.lineage_enabled = True
+    hs.create_index(read.parquet(paths["logs"]),
+                    IndexConfig("idx_logs", ["g_id"], ["g_val"]))
+    session.conf.lineage_enabled = False
+    os.remove(os.path.join(paths["logs"], "part-00007.parquet"))
     session.conf.hybrid_scan_enabled = True
     session.enable_hyperspace()
     return session, paths
@@ -254,6 +279,43 @@ def _queries(session, paths):
         "q24_count_over_ds_range": lineitem()
             .filter((col("l_shipdate") >= 100) & (col("l_shipdate") < 500))
             .group_by("l_shipdate").count(),
+        # OR of point predicates on one column: rewrite + bucket pruning
+        # over the union of the pinned values
+        "q25_or_filter": orders()
+            .filter((col("o_orderkey") == 5) | (col("o_orderkey") == 300))
+            .select("o_orderkey", "o_totalprice"),
+        # IN-list filter: bucket pruning over the probe set
+        "q26_in_filter": lineitem()
+            .filter(col("l_partkey").isin([3, 33, 77]))
+            .select("l_partkey", "l_quantity"),
+        # negative: l_quantity is only an INCLUDED column and carries no
+        # sketch — neither rule may fire
+        "q27_no_rewrite_included_only": lineitem()
+            .filter(col("l_quantity") >= 25)
+            .select("l_quantity"),
+        # Delta source behind the same rules
+        "q28_delta_point_filter": read.delta(paths["dorders"])
+            .filter(col("d_key") == 123).select("d_key", "d_price"),
+        # zorder: both dimensions pinned -> sharp sketch pruning
+        "q29_zorder_point_both_dims": orders()
+            .filter((col("o_custkey") == 7) & (col("o_totalprice") < 250.0))
+            .select("o_custkey", "o_totalprice"),
+        # point filter under a join side: BOTH sides still rewrite (the
+        # filter stays above the index scan; no bucket pruning there —
+        # FilterIndexRule skips already-rewritten scans)
+        "q30_join_with_filtered_side": orders()
+            .filter(col("o_orderkey") == 42).join(
+            lineitem(), col("o_orderkey") == col("l_orderkey"))
+            .select("o_orderkey", "l_quantity"),
+        # hybrid with DELETED source file: lineage Not-In filter shape
+        "q31_hybrid_deleted_rows": read.parquet(paths["logs"])
+            .filter(col("g_id") >= 0).select("g_id", "g_val"),
+        # the full combination: filter + 3-way join + aggregate
+        "q32_filter_three_way_agg": customer()
+            .filter(col("c_custkey") < 25).join(
+            orders(), col("c_custkey") == col("o_custkey")).join(
+            lineitem(), col("o_orderkey") == col("l_orderkey"))
+            .group_by("c_name").agg(qty=("l_quantity", "sum")),
     }
 
 
@@ -269,7 +331,7 @@ def _simplify(plan_string: str, paths) -> str:
     return out + "\n"
 
 
-QUERY_NAMES = [f"q{i:02d}" for i in range(1, 25)]
+QUERY_NAMES = [f"q{i:02d}" for i in range(1, 33)]
 
 
 def _query_by_prefix(queries, prefix):
