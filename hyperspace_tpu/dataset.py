@@ -12,7 +12,15 @@ from typing import List, Sequence
 import pyarrow as pa
 
 from hyperspace_tpu.plan.expr import Expr
-from hyperspace_tpu.plan.nodes import Aggregate, Filter, Join, LogicalPlan, Project
+from hyperspace_tpu.plan.nodes import (
+    Aggregate,
+    Filter,
+    Join,
+    Limit,
+    LogicalPlan,
+    Project,
+    Sort,
+)
 
 
 class GroupedDataset:
@@ -53,6 +61,24 @@ class Dataset:
 
     def join(self, other: "Dataset", condition: Expr, how: str = "inner") -> "Dataset":
         return Dataset(Join(self.plan, other.plan, condition, how), self.session)
+
+    def sort(self, *keys, ascending: bool = True) -> "Dataset":
+        """Order by ``keys`` — column names, or (column, ascending)
+        pairs; a bare name takes the ``ascending`` default."""
+        normalized = []
+        for k in keys:
+            if isinstance(k, str):
+                normalized.append((k, ascending))
+            elif len(tuple(k)) == 2:
+                normalized.append(tuple(k))
+            else:
+                raise ValueError(
+                    f"Sort key must be a column name or a "
+                    f"(column, ascending) pair, got {k!r}")
+        return Dataset(Sort(normalized, self.plan), self.session)
+
+    def limit(self, n: int) -> "Dataset":
+        return Dataset(Limit(n, self.plan), self.session)
 
     def group_by(self, *columns: str) -> "GroupedDataset":
         return GroupedDataset(self, columns)

@@ -34,9 +34,11 @@ from hyperspace_tpu.plan.nodes import (
     Filter,
     InMemory,
     Join,
+    Limit,
     LogicalPlan,
     Project,
     Scan,
+    Sort,
     Union,
 )
 
@@ -70,6 +72,13 @@ class Executor:
             return self._join(plan)
         if isinstance(plan, Aggregate):
             return self._aggregate(plan)
+        if isinstance(plan, Sort):
+            table = self.execute(plan.child)
+            return table.sort_by([(c, "ascending" if asc else "descending")
+                                  for c, asc in plan.keys])
+        if isinstance(plan, Limit):
+            table = self.execute(plan.child)
+            return table.slice(0, plan.n)
         if isinstance(plan, (BucketUnion, Union)):
             tables = [self.execute(c) for c in plan.children]
             return pa.concat_tables(tables, promote_options="default")

@@ -11,9 +11,9 @@ A spec is one JSON object:
      "aggs":   {"total": ["l_quantity", "sum"]}}
 
 Verbs compose in the engine's canonical order: source -> filter -> join
--> group_by/aggs -> select (a select before grouping is expressed by the
-pruning pass anyway).  Expressions use the same operator names as the
-plan IR (==, <, <=, >, >=, and, or, not, in).
+-> group_by/aggs -> sort -> limit -> select (a select before grouping is
+expressed by the pruning pass anyway).  Expressions use the same operator
+names as the plan IR (==, <, <=, >, >=, and, or, not, in).
 """
 
 from __future__ import annotations
@@ -74,6 +74,13 @@ def dataset_from_spec(session, spec: Dict[str, Any]):
         grouped = ds.group_by(*spec.get("group_by", []))
         aggs = spec.get("aggs", {})  # {out: [col, func]} unpacks in agg()
         ds = grouped.agg(**aggs) if aggs else grouped.count()
+    if "sort" in spec:
+        # ["col", ...] or [["col", false], ...] for descending; malformed
+        # entries fail Dataset.sort's validation with a clear message.
+        keys = [k if isinstance(k, str) else tuple(k) for k in spec["sort"]]
+        ds = ds.sort(*keys)
+    if "limit" in spec:
+        ds = ds.limit(int(spec["limit"]))
     if "select" in spec:
         ds = ds.select(*spec["select"])
     return ds

@@ -191,6 +191,59 @@ class Join(LogicalPlan):
         return f"Join {self.how} on {self.condition!r}"
 
 
+class Sort(LogicalPlan):
+    """Total order by ``keys`` — (column, ascending) pairs.  Like
+    Aggregate, the rewrite rules pass through it and rewrite the patterns
+    below."""
+
+    def __init__(self, keys: Sequence[Tuple[str, bool]],
+                 child: LogicalPlan) -> None:
+        if not keys:
+            raise ValueError("Sort needs at least one key")
+        self.keys = tuple((c, bool(asc)) for c, asc in keys)
+        self.children = (child,)
+
+    @property
+    def child(self) -> LogicalPlan:
+        return self.children[0]
+
+    def output_columns(self, schema_of) -> List[str]:
+        return self.child.output_columns(schema_of)
+
+    def with_children(self, children) -> "Sort":
+        (child,) = children
+        return Sort(self.keys, child)
+
+    def simple_string(self) -> str:
+        keys = ", ".join(f"{c} {'ASC' if asc else 'DESC'}"
+                         for c, asc in self.keys)
+        return f"Sort [{keys}]"
+
+
+class Limit(LogicalPlan):
+    """First ``n`` rows of the child's order."""
+
+    def __init__(self, n: int, child: LogicalPlan) -> None:
+        if n < 0:
+            raise ValueError(f"Limit must be non-negative, got {n}")
+        self.n = int(n)
+        self.children = (child,)
+
+    @property
+    def child(self) -> LogicalPlan:
+        return self.children[0]
+
+    def output_columns(self, schema_of) -> List[str]:
+        return self.child.output_columns(schema_of)
+
+    def with_children(self, children) -> "Limit":
+        (child,) = children
+        return Limit(self.n, child)
+
+    def simple_string(self) -> str:
+        return f"Limit {self.n}"
+
+
 class Aggregate(LogicalPlan):
     """Group-by + aggregations: ``aggs`` is a tuple of (function, column,
     output_name), functions from arrow's hash-aggregate set (sum, min,

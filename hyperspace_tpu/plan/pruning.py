@@ -23,9 +23,11 @@ from hyperspace_tpu.plan.nodes import (
     BucketUnion,
     Filter,
     Join,
+    Limit,
     LogicalPlan,
     Project,
     Scan,
+    Sort,
     Union,
 )
 from hyperspace_tpu.utils.resolver import resolve
@@ -69,6 +71,18 @@ def _prune(plan: LogicalPlan, required: Optional[Set[str]],
         new_child = _prune(plan.child, child_required, schema_of)
         if new_child is not plan.child:
             return Filter(plan.condition, new_child)
+        return plan
+    if isinstance(plan, Sort):
+        child_required = None if required is None else (
+            required | {c for c, _asc in plan.keys})
+        new_child = _prune(plan.child, child_required, schema_of)
+        if new_child is not plan.child:
+            return Sort(plan.keys, new_child)
+        return plan
+    if isinstance(plan, Limit):
+        new_child = _prune(plan.child, required, schema_of)
+        if new_child is not plan.child:
+            return Limit(plan.n, new_child)
         return plan
     if isinstance(plan, Join):
         cond_cols = set(plan.condition.referenced_columns())

@@ -24,9 +24,11 @@ from hyperspace_tpu.plan.nodes import (
     Filter,
     InMemory,
     Join,
+    Limit,
     LogicalPlan,
     Project,
     Scan,
+    Sort,
     Union,
 )
 
@@ -127,6 +129,10 @@ def physical_operators(session, plan: Optional[LogicalPlan]
             counts[_join_operator(session, node)] += 1
         elif isinstance(node, Aggregate):
             counts["HashAggregateExec"] += 1
+        elif isinstance(node, Sort):
+            counts["SortExec"] += 1
+        elif isinstance(node, Limit):
+            counts["LimitExec"] += 1
         elif isinstance(node, Filter):
             counts["FilterExec"] += 1
         elif isinstance(node, Project):

@@ -1,0 +1,69 @@
+"""BucketPruneRule: push bucket pruning into ALREADY-REWRITTEN index scans.
+
+FilterIndexRule computes bucket pruning while rewriting a Filter-over-Scan
+itself, but a filter above a scan that JoinIndexRule rewrote (a
+point-filtered join side) is skipped by that rule (is_index_applied), so
+its selective predicate never pruned buckets.  This pass runs after the
+rewrite rules and annotates any ``Filter -> [Project] -> index Scan``
+chain whose predicate pins every indexed column (the same
+FilterIndexRule._bucket_pruning math — one implementation, one hash
+mirror) with ``prune_to_buckets``.
+
+Spark gets this effect for free from bucketed FileSourceScan pruning
+inside the scan operator; our executor prunes by file name, so the plan
+must carry the bucket set.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from hyperspace_tpu.index.log_entry import IndexLogEntry
+from hyperspace_tpu.plan.nodes import Filter, LogicalPlan, Project, Scan
+
+
+class BucketPruneRule:
+    def __init__(self, session, entries: List[IndexLogEntry]) -> None:
+        self.session = session
+        self._by_name = {e.name.lower(): e for e in entries}
+
+    def apply(self, plan: LogicalPlan) -> LogicalPlan:
+        from hyperspace_tpu.rules.filter_rule import _bucket_pruning
+
+        def visit(node: LogicalPlan) -> LogicalPlan:
+            if not isinstance(node, Filter):
+                return node
+            scan, wrap = _index_scan_below(node.children[0])
+            if scan is None:
+                return node
+            rel = scan.relation
+            if rel.prune_to_buckets is not None:
+                # FilterIndexRule already pruned this scan from the SAME
+                # condition chain; recomputing the hash probes here would
+                # be duplicate work for an identical (or looser) set.
+                return node
+            entry = self._by_name.get((rel.index_scan_of or "").lower())
+            if entry is None:
+                return node
+            prune = _bucket_pruning(node.condition, entry)
+            if prune is None:
+                return node
+            new_scan = Scan(dataclasses.replace(rel, prune_to_buckets=prune))
+            child = new_scan if wrap is None \
+                else wrap.with_children((new_scan,))
+            return Filter(node.condition, child)
+
+        return plan.transform_up(visit)
+
+
+def _index_scan_below(node: LogicalPlan):
+    """(scan, wrapping Project or None) when ``node`` is an index scan with
+    a bucket spec, optionally under one pruning Project."""
+    wrap: Optional[Project] = None
+    if isinstance(node, Project):
+        wrap, node = node, node.children[0]
+    if (isinstance(node, Scan) and node.relation.index_scan_of
+            and node.relation.bucket_spec):
+        return node, wrap
+    return None, None

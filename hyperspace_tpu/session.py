@@ -208,6 +208,11 @@ class HyperspaceSession:
                 e._tags.clear()
             plan = JoinIndexRule(self, entries).apply(plan)
             plan = FilterIndexRule(self, entries).apply(plan)
+            # Filters above join-rewritten index scans still prune buckets
+            # (rules/bucket_prune.py).
+            from hyperspace_tpu.rules.bucket_prune import BucketPruneRule
+
+            plan = BucketPruneRule(self, entries).apply(plan)
             # Data skipping last: a covering rewrite beats file pruning, and
             # the rule skips scans the other rules already rewrote.
             from hyperspace_tpu.rules.data_skipping import DataSkippingFilterRule

@@ -154,6 +154,51 @@ def test_multi_column_and_string_joins_execute_exactly(tmp_path):
         ("x", 10, 200), ("x", 30, 200), ("y", 20, 100)]
 
 
+def test_filtered_join_side_prunes_buckets(tmp_path):
+    """A point filter under a join side: JoinIndexRule rewrites both
+    sides, and BucketPruneRule then prunes the filtered side's buckets —
+    the executor reads fewer index files than it lists."""
+    import numpy as np
+
+    ldir = str(tmp_path / "L")
+    rdir = str(tmp_path / "R")
+    os.makedirs(ldir)
+    os.makedirs(rdir)
+    rng = np.random.default_rng(13)
+    pq.write_table(pa.table({
+        "k": pa.array(np.arange(2000, dtype=np.int64)),
+        "lv": pa.array(rng.random(2000)),
+    }), os.path.join(ldir, "f.parquet"))
+    pq.write_table(pa.table({
+        "k2": pa.array(rng.integers(0, 2000, 4000), type=pa.int64()),
+        "rv": pa.array(rng.random(4000)),
+    }), os.path.join(rdir, "f.parquet"))
+    session = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    session.conf.num_buckets = 8
+    hs = Hyperspace(session)
+    hs.create_index(session.read.parquet(ldir), IndexConfig("lk", ["k"], ["lv"]))
+    hs.create_index(session.read.parquet(rdir), IndexConfig("rk", ["k2"], ["rv"]))
+    session.enable_hyperspace()
+    ds = (session.read.parquet(ldir).filter(col("k") == 77)
+          .join(session.read.parquet(rdir), col("k") == col("k2"))
+          .select("k", "lv", "rv"))
+    plan = ds.optimized_plan()
+    pruned = [s for s in plan.leaf_relations()
+              if s.relation.prune_to_buckets is not None]
+    assert pruned, plan.tree_string()
+    assert len(pruned[0].relation.prune_to_buckets) == 1
+    got = ds.collect()
+    stats = session.last_execution_stats
+    # The pruned bucket set intersects into the bucket-aligned join: only
+    # ONE of the 8 buckets executes at all.
+    assert stats["joins"][0] == {"strategy": "bucketed", "buckets": 1,
+                                 "hybrid": False}
+    session.disable_hyperspace()
+    want = ds.collect()
+    keys = [(c, "ascending") for c in ("k", "lv", "rv")]
+    assert got.sort_by(keys).equals(want.sort_by(keys))
+
+
 def test_multi_column_join_executes_bucket_aligned(tmp_path):
     """Both sides indexed on the SAME two columns in the same order: the
     join runs per bucket (shuffle-free), matching the reference's

@@ -300,9 +300,9 @@ def _queries(session, paths):
         "q29_zorder_point_both_dims": orders()
             .filter((col("o_custkey") == 7) & (col("o_totalprice") < 250.0))
             .select("o_custkey", "o_totalprice"),
-        # point filter under a join side: BOTH sides still rewrite (the
-        # filter stays above the index scan; no bucket pruning there —
-        # FilterIndexRule skips already-rewritten scans)
+        # point filter under a join side: both sides rewrite AND the
+        # filtered side bucket-prunes (BucketPruneRule annotates filters
+        # above join-rewritten scans)
         "q30_join_with_filtered_side": orders()
             .filter(col("o_orderkey") == 42).join(
             lineitem(), col("o_orderkey") == col("l_orderkey"))
@@ -310,6 +310,11 @@ def _queries(session, paths):
         # hybrid with DELETED source file: lineage Not-In filter shape
         "q31_hybrid_deleted_rows": read.parquet(paths["logs"])
             .filter(col("g_id") >= 0).select("g_id", "g_val"),
+        # top-N: Sort/Limit above an index-rewritten point filter
+        "q33_topn_over_indexed_filter": orders()
+            .filter(col("o_custkey") == 3)
+            .sort(("o_totalprice", False)).limit(5)
+            .select("o_orderkey", "o_totalprice"),
         # the full combination: filter + 3-way join + aggregate
         "q32_filter_three_way_agg": customer()
             .filter(col("c_custkey") < 25).join(
@@ -331,7 +336,7 @@ def _simplify(plan_string: str, paths) -> str:
     return out + "\n"
 
 
-QUERY_NAMES = [f"q{i:02d}" for i in range(1, 33)]
+QUERY_NAMES = [f"q{i:02d}" for i in range(1, 34)]
 
 
 def _query_by_prefix(queries, prefix):

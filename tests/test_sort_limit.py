@@ -1,0 +1,98 @@
+"""Sort and Limit: the top-N verbs of the query layer.
+
+The reference leans on Spark for ORDER BY / LIMIT; this engine owns its
+executor, so they are plan nodes — rules pass through them, pruning keeps
+sort keys alive, and answers match pandas exactly."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
+
+
+@pytest.fixture()
+def env(tmp_path):
+    data = str(tmp_path / "data")
+    os.makedirs(data)
+    rng = np.random.default_rng(5)
+    n = 1000
+    pq.write_table(pa.table({
+        "k": pa.array(rng.permutation(n).astype(np.int64)),
+        "v": pa.array(rng.integers(0, 50, n), type=pa.int64()),
+        "pad": pa.array(rng.random(n)),
+    }), os.path.join(data, "f.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    s.conf.num_buckets = 4
+    return s, data
+
+
+def test_sort_matches_pandas(env):
+    s, data = env
+    out = (s.read.parquet(data).sort(("v", False), "k")
+           .select("k", "v").collect().to_pandas())
+    df = pq.read_table(os.path.join(data, "f.parquet")).to_pandas()
+    want = (df.sort_values(["v", "k"], ascending=[False, True])
+            [["k", "v"]].reset_index(drop=True))
+    assert out.equals(want)
+
+
+def test_limit_takes_prefix_of_sorted_order(env):
+    s, data = env
+    out = (s.read.parquet(data).sort("k").limit(5)
+           .select("k").collect().column("k").to_pylist())
+    assert out == [0, 1, 2, 3, 4]
+    assert s.read.parquet(data).limit(0).collect().num_rows == 0
+    with pytest.raises(ValueError, match="non-negative"):
+        s.read.parquet(data).limit(-1)
+    with pytest.raises(ValueError, match="at least one key"):
+        s.read.parquet(data).sort()
+    with pytest.raises(ValueError, match="Sort key"):
+        s.read.parquet(data).sort(("k",))
+
+
+def test_topn_over_indexed_filter(env):
+    """The TPC-H top-N shape: the filter below the Sort/Limit still
+    rewrites to the index, and pruning keeps only the needed columns."""
+    s, data = env
+    hs = Hyperspace(s)
+    hs.create_index(s.read.parquet(data), IndexConfig("ki", ["v"], ["k"]))
+    s.enable_hyperspace()
+    ds = (s.read.parquet(data).filter(col("v") == 7)
+          .sort(("k", False)).limit(3).select("k", "v"))
+    plan = ds.optimized_plan()
+    assert [x for x in plan.leaf_relations() if x.relation.index_scan_of], \
+        plan.tree_string()
+    got = ds.collect()
+    s.disable_hyperspace()
+    assert got.equals(ds.collect())
+    ks = got.column("k").to_pylist()
+    assert ks == sorted(ks, reverse=True) and got.num_rows == 3
+
+
+def test_sort_key_survives_pruning_when_not_selected(env):
+    """select() after sort drops the key from the OUTPUT, but the scan
+    must still read it for the ordering."""
+    s, data = env
+    out = (s.read.parquet(data).sort(("v", False)).limit(10)
+           .select("k").collect())
+    assert out.column_names == ["k"]
+    assert out.num_rows == 10
+
+
+def test_interop_spec_sort_limit(env):
+    from hyperspace_tpu.interop import dataset_from_spec
+
+    s, data = env
+    out = dataset_from_spec(s, {
+        "source": {"format": "parquet", "path": data},
+        "sort": [["k", True]],
+        "limit": 4,
+        "select": ["k"],
+    }).collect()
+    assert out.column("k").to_pylist() == [0, 1, 2, 3]
