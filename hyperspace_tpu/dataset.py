@@ -69,8 +69,9 @@ class Dataset:
         for k in keys:
             if isinstance(k, str):
                 normalized.append((k, ascending))
-            elif len(tuple(k)) == 2:
-                normalized.append(tuple(k))
+            elif (isinstance(k, (tuple, list)) and len(k) == 2
+                    and isinstance(k[0], str) and isinstance(k[1], bool)):
+                normalized.append((k[0], k[1]))
             else:
                 raise ValueError(
                     f"Sort key must be a column name or a "

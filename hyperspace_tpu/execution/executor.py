@@ -77,6 +77,19 @@ class Executor:
             return table.sort_by([(c, "ascending" if asc else "descending")
                                   for c, asc in plan.keys])
         if isinstance(plan, Limit):
+            if isinstance(plan.child, Sort) and plan.n > 0:
+                # Top-N fusion: O(n log k) partial selection instead of a
+                # full sort + slice.  "Unstable" only affects tie order,
+                # which LIMIT over ORDER BY leaves unspecified anyway.
+                sort = plan.child
+                table = self.execute(sort.child)
+                if table.num_rows == 0:
+                    return table  # select_k rejects zero-row input
+                idx = pc.select_k_unstable(
+                    table, k=min(plan.n, table.num_rows),
+                    sort_keys=[(c, "ascending" if asc else "descending")
+                               for c, asc in sort.keys])
+                return table.take(idx)
             table = self.execute(plan.child)
             return table.slice(0, plan.n)
         if isinstance(plan, (BucketUnion, Union)):

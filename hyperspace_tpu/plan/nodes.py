@@ -247,14 +247,15 @@ class Limit(LogicalPlan):
 class Aggregate(LogicalPlan):
     """Group-by + aggregations: ``aggs`` is a tuple of (function, column,
     output_name), functions from arrow's hash-aggregate set (sum, min,
-    max, mean, count; count_all counts ROWS — its column is ignored).
-    Empty ``group_by`` = global aggregation.  The rewrite rules never
-    match an Aggregate itself — they rewrite the Filter/Scan/Join patterns
-    BELOW it (Catalyst's rules behave the same way: the reference's
-    TPC-DS q1 plans keep their Aggregates while the scans underneath swap
-    to indexes)."""
+    max, mean, count, count_distinct, stddev, variance; count_all counts
+    ROWS — its column is ignored).  Empty ``group_by`` = global
+    aggregation.  The rewrite rules never match an Aggregate itself —
+    they rewrite the Filter/Scan/Join patterns BELOW it (Catalyst's rules
+    behave the same way: the reference's TPC-DS q1 plans keep their
+    Aggregates while the scans underneath swap to indexes)."""
 
-    FUNCTIONS = ("sum", "min", "max", "mean", "count", "count_all")
+    FUNCTIONS = ("sum", "min", "max", "mean", "count", "count_all",
+                 "count_distinct", "stddev", "variance")
 
     def __init__(self, group_by: Sequence[str],
                  aggs: Sequence[Tuple[str, str, str]],

@@ -120,6 +120,20 @@ def test_agg_over_join_answer_parity(env, tmp_path):
     np.testing.assert_allclose(got["total"], want["total"])
 
 
+def test_statistical_functions_match_pandas(env):
+    s, data = env
+    out = (s.read.parquet(data).group_by("k")
+           .agg(nd=("w", "count_distinct"), sd=("v", "stddev"),
+                var=("v", "variance"))
+           .collect().to_pandas().set_index("k").sort_index())
+    df = pq.read_table(os.path.join(data, "f.parquet")).to_pandas()
+    g = df.groupby("k")
+    np.testing.assert_array_equal(out["nd"], g["w"].nunique().sort_index())
+    # Arrow stddev/variance are POPULATION (ddof=0).
+    np.testing.assert_allclose(out["sd"], g["v"].std(ddof=0).sort_index())
+    np.testing.assert_allclose(out["var"], g["v"].var(ddof=0).sort_index())
+
+
 def test_bad_function_rejected(env):
     s, data = env
     with pytest.raises(ValueError, match="Unsupported aggregate"):

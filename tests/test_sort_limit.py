@@ -54,6 +54,14 @@ def test_limit_takes_prefix_of_sorted_order(env):
         s.read.parquet(data).sort()
     with pytest.raises(ValueError, match="Sort key"):
         s.read.parquet(data).sort(("k",))
+    with pytest.raises(ValueError, match="Sort key"):
+        s.read.parquet(data).sort(5)
+    with pytest.raises(ValueError, match="Sort key"):
+        s.read.parquet(data).sort(("k", "not-a-bool"))
+    # Fusion over an empty input: no rows, no crash.
+    empty = (s.read.parquet(data).filter(col("k") == 10**9)
+             .sort("k").limit(5).collect())
+    assert empty.num_rows == 0
 
 
 def test_topn_over_indexed_filter(env):
@@ -73,6 +81,22 @@ def test_topn_over_indexed_filter(env):
     assert got.equals(ds.collect())
     ks = got.column("k").to_pylist()
     assert ks == sorted(ks, reverse=True) and got.num_rows == 3
+
+
+def test_topn_fusion_matches_full_sort(env):
+    """Limit(Sort(x)) takes the select_k path; the selected rows must
+    equal the full sort's prefix (keys here are unique, so tie order
+    cannot differ)."""
+    s, data = env
+    top = (s.read.parquet(data).sort(("k", False)).limit(7)
+           .select("k").collect().column("k").to_pylist())
+    full = (s.read.parquet(data).sort(("k", False))
+            .select("k").collect().column("k").to_pylist())
+    assert top == full[:7]
+    # Limit larger than the table: everything, still sorted.
+    n_all = (s.read.parquet(data).sort("k").limit(10**6)
+             .collect().num_rows)
+    assert n_all == 1000
 
 
 def test_sort_key_survives_pruning_when_not_selected(env):
