@@ -70,8 +70,11 @@ class Dataset:
             if isinstance(k, str):
                 normalized.append((k, ascending))
             elif (isinstance(k, (tuple, list)) and len(k) == 2
-                    and isinstance(k[0], str) and isinstance(k[1], bool)):
-                normalized.append((k[0], k[1]))
+                    and isinstance(k[0], str)
+                    and not isinstance(k[1], str)):
+                # Any truthy/falsy flag works (ints, numpy bools); a STRING
+                # flag is the ('a', 'b') two-column confusion — reject it.
+                normalized.append((k[0], bool(k[1])))
             else:
                 raise ValueError(
                     f"Sort key must be a column name or a "

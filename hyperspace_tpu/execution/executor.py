@@ -27,7 +27,17 @@ import pyarrow.compute as pc
 from hyperspace_tpu.io import columnar
 from hyperspace_tpu.io.files import list_data_files
 from hyperspace_tpu.io.parquet import bucket_id_of_file, read_table
-from hyperspace_tpu.plan.expr import And, BinOp, Col, Expr, IsIn, Lit, Not, Or
+from hyperspace_tpu.plan.expr import (
+    And,
+    BinOp,
+    Col,
+    Expr,
+    IsIn,
+    IsNull,
+    Lit,
+    Not,
+    Or,
+)
 from hyperspace_tpu.plan.nodes import (
     Aggregate,
     BucketUnion,
@@ -719,4 +729,6 @@ def _arrow_eval(expr: Expr, table: pa.Table):
     if isinstance(expr, IsIn):
         return pc.is_in(_arrow_eval(expr.child, table),
                         value_set=pa.array(expr.values))
+    if isinstance(expr, IsNull):
+        return pc.is_null(_arrow_eval(expr.child, table))
     raise ValueError(f"Unsupported expression: {expr!r}")

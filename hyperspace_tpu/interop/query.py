@@ -20,7 +20,17 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
-from hyperspace_tpu.plan.expr import And, BinOp, Col, Expr, IsIn, Lit, Not, Or
+from hyperspace_tpu.plan.expr import (
+    And,
+    BinOp,
+    Col,
+    Expr,
+    IsIn,
+    IsNull,
+    Lit,
+    Not,
+    Or,
+)
 
 _CMP_OPS = ("==", "<", "<=", ">", ">=")
 
@@ -40,6 +50,8 @@ def expr_from_json(obj: Dict[str, Any]) -> Expr:
         return Not(expr_from_json(obj["child"]))
     if op == "in":
         return IsIn(Col(obj["col"]), list(obj["values"]))
+    if op == "is_null":
+        return IsNull(Col(obj["col"]))
     raise ValueError(f"Unknown expression op: {op!r}")
 
 

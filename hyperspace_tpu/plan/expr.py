@@ -47,6 +47,12 @@ class Expr:
     def isin(self, values: Iterable[Any]) -> "Expr":
         return IsIn(self, list(values))
 
+    def is_null(self) -> "Expr":
+        return IsNull(self)
+
+    def is_not_null(self) -> "Expr":
+        return Not(IsNull(self))
+
     def __hash__(self) -> int:
         return hash(repr(self))
 
@@ -124,6 +130,19 @@ class IsIn(Expr):
         return f"{self.child!r}.isin({self.values!r})"
 
 
+class IsNull(Expr):
+    """SQL IS NULL — unlike comparisons (null => unknown => row drops),
+    this yields TRUE for null values.  The device filter path and every
+    pruning analysis treat it as an opaque shape (always conservative);
+    evaluation happens on the arrow host path."""
+
+    def __init__(self, child: Expr) -> None:
+        self.child = child
+
+    def __repr__(self) -> str:
+        return f"{self.child!r}.is_null()"
+
+
 def col(name: str) -> Col:
     return Col(name)
 
@@ -148,6 +167,8 @@ def _collect_columns(e: Expr, out: Set[str]) -> None:
     elif isinstance(e, Not):
         _collect_columns(e.child, out)
     elif isinstance(e, IsIn):
+        _collect_columns(e.child, out)
+    elif isinstance(e, IsNull):
         _collect_columns(e.child, out)
 
 

@@ -262,6 +262,32 @@ def test_string_column_vs_numeric_literal_coerces_numerically(tmp_path):
     assert sorted(fl.column("name").to_pylist()) == ["a", "b", "c", "f", "g"]
 
 
+def test_is_null_predicates(tmp_path):
+    """IS NULL matches null rows (unlike comparisons); IS NOT NULL is its
+    complement; both compose with other predicates and stay conservative
+    for every pruning analysis."""
+    data = str(tmp_path / "n")
+    os.makedirs(data)
+    pq.write_table(pa.table({
+        "x": pa.array([1, None, 3, None], type=pa.int64()),
+        "name": ["a", "b", "c", "d"],
+    }), os.path.join(data, "f.parquet"))
+    session = HyperspaceSession(system_path=str(tmp_path / "idx"))
+    ds = session.read.parquet(data)
+    nulls = ds.filter(col("x").is_null()).select("name").collect()
+    assert sorted(nulls.column("name").to_pylist()) == ["b", "d"]
+    vals = ds.filter(col("x").is_not_null()).select("name").collect()
+    assert sorted(vals.column("name").to_pylist()) == ["a", "c"]
+    both = ds.filter(col("x").is_null() | (col("x") == 3)).select("name").collect()
+    assert sorted(both.column("name").to_pylist()) == ["b", "c", "d"]
+    # Indexed path: the rewrite still applies; answers stay exact.
+    hs = Hyperspace(session)
+    hs.create_index(ds, IndexConfig("xi", ["x"], ["name"]))
+    session.enable_hyperspace()
+    got = ds.filter(col("x").is_null()).select("name").collect()
+    assert sorted(got.column("name").to_pylist()) == ["b", "d"]
+
+
 def test_constant_predicate_routes_to_host(tmp_path):
     from hyperspace_tpu import lit
 
