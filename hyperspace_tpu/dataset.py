@@ -9,6 +9,7 @@ from __future__ import annotations
 
 from typing import List, Sequence
 
+import numpy as np
 import pyarrow as pa
 
 from hyperspace_tpu.plan.expr import Expr
@@ -71,9 +72,11 @@ class Dataset:
                 normalized.append((k, ascending))
             elif (isinstance(k, (tuple, list)) and len(k) == 2
                     and isinstance(k[0], str)
-                    and not isinstance(k[1], str)):
-                # Any truthy/falsy flag works (ints, numpy bools); a STRING
-                # flag is the ('a', 'b') two-column confusion — reject it.
+                    and isinstance(k[1], (bool, int, np.bool_,
+                                          np.integer))):
+                # Bool-like flags only (incl. ints / numpy bools); a string
+                # is the ('a', 'b') two-column confusion and None/nested
+                # junk means the caller didn't intend a direction — reject.
                 normalized.append((k[0], bool(k[1])))
             else:
                 raise ValueError(

@@ -13,7 +13,7 @@ A spec is one JSON object:
 Verbs compose in the engine's canonical order: source -> filter -> join
 -> group_by/aggs -> sort -> limit -> select (a select before grouping is
 expressed by the pruning pass anyway).  Expressions use the same operator
-names as the plan IR (==, <, <=, >, >=, and, or, not, in).
+names as the plan IR (==, <, <=, >, >=, and, or, not, in, is_null).
 """
 
 from __future__ import annotations
