@@ -15,6 +15,7 @@ import pyarrow as pa
 from hyperspace_tpu.plan.expr import Expr
 from hyperspace_tpu.plan.nodes import (
     Aggregate,
+    Distinct,
     Filter,
     Join,
     Limit,
@@ -86,6 +87,10 @@ class Dataset:
 
     def limit(self, n: int) -> "Dataset":
         return Dataset(Limit(n, self.plan), self.session)
+
+    def distinct(self) -> "Dataset":
+        """Unique rows over the full output (SQL DISTINCT)."""
+        return Dataset(Distinct(self.plan), self.session)
 
     def group_by(self, *columns: str) -> "GroupedDataset":
         return GroupedDataset(self, columns)

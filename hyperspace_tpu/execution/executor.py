@@ -41,6 +41,7 @@ from hyperspace_tpu.plan.expr import (
 from hyperspace_tpu.plan.nodes import (
     Aggregate,
     BucketUnion,
+    Distinct,
     Filter,
     InMemory,
     Join,
@@ -82,6 +83,16 @@ class Executor:
             return self._join(plan)
         if isinstance(plan, Aggregate):
             return self._aggregate(plan)
+        if isinstance(plan, Distinct):
+            table = self.execute(plan.child)
+            names = table.column_names
+            if len(set(names)) != len(names):
+                raise ValueError(
+                    f"distinct() needs unique column names, got {names}; "
+                    f"project/rename the duplicates first")
+            if table.num_rows == 0:
+                return table
+            return table.group_by(names).aggregate([]).select(names)
         if isinstance(plan, Sort):
             table = self.execute(plan.child)
             return table.sort_by([(c, "ascending" if asc else "descending")

@@ -191,6 +191,29 @@ class Join(LogicalPlan):
         return f"Join {self.how} on {self.condition!r}"
 
 
+class Distinct(LogicalPlan):
+    """Unique rows over the child's FULL output (SQL DISTINCT).  Lazy: the
+    column set resolves at execution, not plan construction, like every
+    other node (the IR stays IO-free)."""
+
+    def __init__(self, child: LogicalPlan) -> None:
+        self.children = (child,)
+
+    @property
+    def child(self) -> LogicalPlan:
+        return self.children[0]
+
+    def output_columns(self, schema_of) -> List[str]:
+        return self.child.output_columns(schema_of)
+
+    def with_children(self, children) -> "Distinct":
+        (child,) = children
+        return Distinct(child)
+
+    def simple_string(self) -> str:
+        return "Distinct"
+
+
 class Sort(LogicalPlan):
     """Total order by ``keys`` — (column, ascending) pairs.  Like
     Aggregate, the rewrite rules pass through it and rewrite the patterns

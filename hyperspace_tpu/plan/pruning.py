@@ -21,6 +21,7 @@ from typing import List, Optional, Set
 from hyperspace_tpu.plan.nodes import (
     Aggregate,
     BucketUnion,
+    Distinct,
     Filter,
     Join,
     Limit,
@@ -83,6 +84,14 @@ def _prune(plan: LogicalPlan, required: Optional[Set[str]],
         new_child = _prune(plan.child, required, schema_of)
         if new_child is not plan.child:
             return Limit(plan.n, new_child)
+        return plan
+    if isinstance(plan, Distinct):
+        # DISTINCT dedups over its FULL input — narrowing to the parent's
+        # columns would change row multiplicity; the child's own Projects
+        # still prune below.
+        new_child = _prune(plan.child, None, schema_of)
+        if new_child is not plan.child:
+            return Distinct(new_child)
         return plan
     if isinstance(plan, Join):
         cond_cols = set(plan.condition.referenced_columns())

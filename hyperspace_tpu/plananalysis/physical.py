@@ -21,6 +21,7 @@ from hyperspace_tpu.io.parquet import bucket_id_of_file, schema_to_arrow
 from hyperspace_tpu.plan.nodes import (
     Aggregate,
     BucketUnion,
+    Distinct,
     Filter,
     InMemory,
     Join,
@@ -129,6 +130,8 @@ def physical_operators(session, plan: Optional[LogicalPlan]
             counts[_join_operator(session, node)] += 1
         elif isinstance(node, Aggregate):
             counts["HashAggregateExec"] += 1
+        elif isinstance(node, Distinct):
+            counts["DistinctExec"] += 1
         elif isinstance(node, Sort):
             counts["SortExec"] += 1
         elif isinstance(node, Limit):

@@ -120,6 +120,43 @@ def test_agg_over_join_answer_parity(env, tmp_path):
     np.testing.assert_allclose(got["total"], want["total"])
 
 
+def test_distinct_matches_pandas(env, tmp_path):
+    s, _ = env
+    d = str(tmp_path / "dup")
+    os.makedirs(d)
+    pq.write_table(pa.table({
+        "a": [1, 1, 2, 2, 2, None],
+        "b": ["x", "x", "y", "y", "z", "x"],
+    }), os.path.join(d, "f.parquet"))
+    out = (s.read.parquet(d).distinct().collect().to_pylist())
+    assert sorted(map(repr, out)) == sorted(map(repr, [
+        {"a": 1, "b": "x"}, {"a": 2, "b": "y"}, {"a": 2, "b": "z"},
+        {"a": None, "b": "x"}]))
+    # distinct after a projection dedups the projected columns only.
+    one = s.read.parquet(d).select("b").distinct().collect()
+    assert sorted(one.column("b").to_pylist()) == ["x", "y", "z"]
+    # Duplicate projected names fail before distinct (scan concat);
+    # self-join duplicates are renamed by the executor — the executor's
+    # own unique-name guard in Distinct is defense in depth.
+    with pytest.raises(Exception, match="duplicate field names"):
+        s.read.parquet(d).select("a", "a").distinct().collect()
+
+
+def test_having_filter_above_aggregate(env):
+    """SQL HAVING is just Filter above Aggregate in this IR; pruning and
+    execution compose without special casing."""
+    s, data = env
+    ds = (s.read.parquet(data).group_by("k").agg(total=("v", "sum"))
+          .filter(col("total") > 20.0).sort("k"))
+    out = ds.collect().to_pandas()
+    df = pq.read_table(os.path.join(data, "f.parquet")).to_pandas()
+    want = df.groupby("k")["v"].sum()
+    want = want[want > 20.0]
+    np.testing.assert_array_equal(out["k"], want.index.sort_values())
+    np.testing.assert_allclose(out.set_index("k")["total"],
+                               want.sort_index())
+
+
 def test_statistical_functions_match_pandas(env):
     s, data = env
     out = (s.read.parquet(data).group_by("k")

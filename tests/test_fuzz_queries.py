@@ -121,11 +121,15 @@ def _random_query(session, paths, seed: int):
         keys = ["f_tag"] if not joined or r.random() < 0.5 else ["d_name"]
         ds = ds.group_by(*keys).agg(total=("f_price", "sum"),
                                     n=("f_key", "count"))
+        if r.random() < 0.4:  # HAVING
+            ds = ds.filter(col("total") > r.uniform(0, 500))
     else:
         cols = ["f_key", "f_num", "f_price", "f_tag"]
         if joined and r.random() < 0.5:
             cols += ["d_name"]
         ds = ds.select(*r.sample(cols, k=r.randrange(1, len(cols) + 1)))
+        if r.random() < 0.2:
+            ds = ds.distinct()
     return ds
 
 
