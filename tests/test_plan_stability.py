@@ -315,6 +315,15 @@ def _queries(session, paths):
             .filter(col("o_custkey") == 3)
             .sort(("o_totalprice", False)).limit(5)
             .select("o_orderkey", "o_totalprice"),
+        # HAVING: Filter above the Aggregate, scans still rewritten below
+        "q34_having_over_agg": lineitem()
+            .filter(col("l_orderkey") >= 200)
+            .group_by("l_orderkey").agg(qty=("l_quantity", "sum"))
+            .filter(col("qty") > 100),
+        # DISTINCT above an indexed point filter
+        "q35_distinct_over_indexed_filter": orders()
+            .filter(col("o_custkey") == 3)
+            .select("o_orderstatus").distinct(),
         # the full combination: filter + 3-way join + aggregate
         "q32_filter_three_way_agg": customer()
             .filter(col("c_custkey") < 25).join(
@@ -336,7 +345,7 @@ def _simplify(plan_string: str, paths) -> str:
     return out + "\n"
 
 
-QUERY_NAMES = [f"q{i:02d}" for i in range(1, 34)]
+QUERY_NAMES = [f"q{i:02d}" for i in range(1, 36)]
 
 
 def _query_by_prefix(queries, prefix):
