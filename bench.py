@@ -352,6 +352,10 @@ def main() -> None:
                 "hybrid_join_scan_s": round(results["hybrid_join"][0], 4),
                 "hybrid_join_indexed_s": round(results["hybrid_join"][1], 4),
                 "index_build_s": round(build_s, 3),
+                # Per-index, per-phase build attribution (read / kernel /
+                # write / sketch seconds) — session.build_stats_log is
+                # appended by every CreateActionBase build.
+                "index_build_phases": getattr(session, "build_stats_log", []),
                 "platform": _platform(),
             },
         }

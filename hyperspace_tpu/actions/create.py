@@ -63,6 +63,22 @@ class CreateActionBase(Action):
         self._written_version: Optional[int] = None
         self._file_id_tracker = FileIdTracker()
         self._relation_cache = None
+        # Per-phase wall-clock of this build (read / kernel / write /
+        # sketch, seconds) — appended to session.build_stats_log on
+        # completion so bench.py can attribute build time (the round-2
+        # regression was unattributable without this).
+        self.build_phases: Dict[str, float] = {}
+
+    def _phase(self, name: str, seconds: float) -> None:
+        self.build_phases[name] = self.build_phases.get(name, 0.0) + seconds
+
+    def _publish_build_stats(self) -> None:
+        log = getattr(self.session, "build_stats_log", None)
+        if log is None:
+            log = []
+            self.session.build_stats_log = log
+        log.append({"index": self.index_name,
+                    **{k: round(v, 4) for k, v in self.build_phases.items()}})
 
     @property
     def conf(self) -> HyperspaceConf:
@@ -142,18 +158,23 @@ class CreateActionBase(Action):
         try:
             self._stream_build(files, columns, relation, lineage, resolved,
                                batch_rows, streaming, spill)
+            self._publish_build_stats()
         except BaseException:
             spill.cleanup()
             raise
 
     def _stream_build(self, files, columns, relation, lineage, resolved,
                       batch_rows, streaming, spill) -> None:
+        import time as _time
+
         buffer: List[pa.Table] = []
         buffered = 0
         for f in files:
+            t0 = _time.perf_counter()
             t = read_table([f.name], relation.read_format, columns,
                            relation.options,
                            partition_roots=relation.root_paths)
+            self._phase("read_s", _time.perf_counter() - t0)
             # Schema evolution: a file predating an added column yields a
             # table without it; the monolithic concat used to null-promote,
             # so the streaming path must normalize per file the same way.
@@ -217,6 +238,9 @@ class CreateActionBase(Action):
         # hash shuffle would fragment the curve into per-partition samples,
         # gutting the pruning — so every build mode takes this path and
         # produces the identical, environment-independent layout.
+        import time as _time
+
+        t0 = _time.perf_counter()
         split_keys, split_bits = (None, 0)
         if resolved.layout == "zorder":
             from hyperspace_tpu.io.parquet import zorder_codes_host
@@ -236,26 +260,41 @@ class CreateActionBase(Action):
                 build_mesh(), slack=self.conf.shuffle_capacity_slack,
                 pad_to=self.conf.device_batch_rows)
         else:
-            from hyperspace_tpu.ops.sort import bucket_sort_permutation
+            from hyperspace_tpu.ops.sort import (
+                bucket_sort_permutation,
+                bucket_sort_permutation_np,
+            )
 
             word_cols = [columnar.to_hash_words(table.column(c))
                          for c in resolved.indexed_columns]
             order_words = [
                 np.asarray(columnar.to_order_words(table.column(c)))
                 for c in resolved.indexed_columns]
-            buckets, perm = bucket_sort_permutation(
-                [np.asarray(w) for w in word_cols],
-                order_words,
-                self.num_buckets,
-                pad_to=self.conf.device_batch_rows)
+            if table.num_rows < self.conf.device_build_min_rows:
+                # Host mirror below the threshold — identical layout, no
+                # device transfer/compile latency (see config).
+                buckets, perm = bucket_sort_permutation_np(
+                    [np.asarray(w) for w in word_cols], order_words,
+                    self.num_buckets)
+            else:
+                buckets, perm = bucket_sort_permutation(
+                    [np.asarray(w) for w in word_cols],
+                    order_words,
+                    self.num_buckets,
+                    pad_to=self.conf.device_batch_rows)
+        self._phase("kernel_s", _time.perf_counter() - t0)
         version = self.data_manager.get_next_version() if version is None else version
         out_dir = self.data_manager.version_path(version)
+        t0 = _time.perf_counter()
         write_bucketed(table, np.asarray(buckets), np.asarray(perm),
                        self.num_buckets, out_dir,
                        max_rows_per_file=self.conf.index_max_rows_per_file,
                        split_keys=split_keys, split_key_bits=split_bits,
                        compression=self.conf.index_file_compression)
+        self._phase("write_s", _time.perf_counter() - t0)
+        t0 = _time.perf_counter()
         self._write_index_file_sketch(out_dir, resolved)
+        self._phase("sketch_s", _time.perf_counter() - t0)
         self._written_version = version
         self._index_schema = {name: str(t) for name, t in
                               zip(table.column_names, table.schema.types)}
@@ -356,10 +395,14 @@ class _BucketSpill:
     ZORDER_SPILL_PARTITIONS = 16
 
     def add_chunk(self, table: pa.Table) -> None:
+        import time as _time
+
         import pyarrow.parquet as pq
 
         from hyperspace_tpu.ops.hash import bucket_ids
         from hyperspace_tpu.ops.sort import _pad_rows
+
+        _t0 = _time.perf_counter()
 
         if self._dir is None:
             import tempfile
@@ -393,6 +436,7 @@ class _BucketSpill:
             pq.write_table(routed.slice(int(starts[b]), rows),
                            os.path.join(bdir, f"run-{self._chunk_no:05d}.parquet"))
         self._chunk_no += 1
+        self.action._phase("spill_route_s", _time.perf_counter() - _t0)
 
     def finish(self) -> None:
         import shutil

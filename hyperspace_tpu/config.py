@@ -35,6 +35,8 @@ DEVICE_FILTER_MIN_ROWS = "hyperspace.tpu.deviceFilterMinRows"
 MESH_FILTER_MIN_ROWS = "hyperspace.tpu.meshFilterMinRows"
 INDEX_FILE_COMPRESSION = "hyperspace.tpu.indexFileCompression"
 DEVICE_JOIN_MIN_ROWS = "hyperspace.tpu.deviceJoinMinRows"
+DEVICE_BUILD_MIN_ROWS = "hyperspace.tpu.deviceBuildMinRows"
+MESH_JOIN_MIN_ROWS = "hyperspace.tpu.meshJoinMinRows"
 PARALLEL_BUILD = "hyperspace.tpu.parallelBuild"
 SHUFFLE_CAPACITY_SLACK = "hyperspace.tpu.shuffleCapacitySlack"
 GLOBBING_PATTERN = "hyperspace.source.globbingPattern"
@@ -111,6 +113,19 @@ class HyperspaceConf:
     # Same cost model for joins: below this (max-side) row count the
     # sorted-merge join runs in numpy on host.
     device_join_min_rows: int = 1 << 22
+    # Same cost model for the BUILD's fused hash+lexsort kernel: below
+    # this row count the bit-identical host mirror runs instead (the
+    # round-2 bench regression was this kernel's transfer + compile
+    # latency over the tunnel dominating an 800k-row build).  The layouts
+    # are identical either way — only where the permutation is computed
+    # changes.  Raise toward 0 on locally attached chips.
+    device_build_min_rows: int = 1 << 22
+    # With >1 visible device, a bucket-aligned INNER join at or above this
+    # total row count dispatches its per-bucket joins over the mesh
+    # (parallel/join.copartitioned_join_ragged: buckets range-partitioned
+    # over the shard axis, zero-collective by co-partitioning); below it,
+    # the host thread pool runs the buckets (the single-chip path).
+    mesh_join_min_rows: int = 1 << 24
     # Distributed build over the device mesh: "auto" uses it when more than
     # one accelerator is visible; "on"/"off" force it.  The shuffle uses
     # capacity-padded all_to_all; slack is the initial headroom factor over
@@ -153,6 +168,8 @@ class HyperspaceConf:
         MESH_FILTER_MIN_ROWS: "mesh_filter_min_rows",
         INDEX_FILE_COMPRESSION: "index_file_compression",
         DEVICE_JOIN_MIN_ROWS: "device_join_min_rows",
+        DEVICE_BUILD_MIN_ROWS: "device_build_min_rows",
+        MESH_JOIN_MIN_ROWS: "mesh_join_min_rows",
         PARALLEL_BUILD: "parallel_build",
         SHUFFLE_CAPACITY_SLACK: "shuffle_capacity_slack",
         DISPLAY_MODE: "display_mode",

@@ -12,9 +12,10 @@ from typing import List, Sequence
 import numpy as np
 import pyarrow as pa
 
-from hyperspace_tpu.plan.expr import Expr
+from hyperspace_tpu.plan.expr import Col, Expr, Lit
 from hyperspace_tpu.plan.nodes import (
     Aggregate,
+    Compute,
     Distinct,
     Filter,
     Join,
@@ -22,6 +23,7 @@ from hyperspace_tpu.plan.nodes import (
     LogicalPlan,
     Project,
     Sort,
+    WithColumns,
 )
 
 
@@ -34,8 +36,11 @@ class GroupedDataset:
         self._group_by = list(group_by)
 
     def agg(self, **named_specs) -> "Dataset":
-        aggs = [(func, col, out)
-                for out, (col, func) in named_specs.items()]
+        """Specs are ``out=(input, func)`` where ``input`` is a column name
+        or an expression: ``agg(revenue=(col("p") * (1 - col("d")), "sum"))``
+        — the TPC-H Q3/Q10 shape."""
+        aggs = [(func, agg_in, out)
+                for out, (agg_in, func) in named_specs.items()]
         return Dataset(Aggregate(self._group_by, aggs, self._dataset.plan),
                        self._dataset.session)
 
@@ -58,8 +63,33 @@ class Dataset:
     def filter(self, condition: Expr) -> "Dataset":
         return Dataset(Filter(condition, self.plan), self.session)
 
-    def select(self, *columns: str) -> "Dataset":
-        return Dataset(Project(list(columns), self.plan), self.session)
+    def select(self, *columns: str, **computed: Expr) -> "Dataset":
+        """Project columns, optionally with computed expressions:
+        ``select("o_orderkey", revenue=col("p") * (1 - col("d")))``.
+        Plain-string-only selects stay a Project (the shape the rewrite
+        rules pattern-match); any computed output builds a Compute node."""
+        bad = [c for c in columns if not isinstance(c, str)]
+        if bad:
+            raise ValueError(
+                f"select() positional arguments are column names; pass "
+                f"expressions as keywords (alias=expr), got {bad[0]!r}")
+        if not computed:
+            return Dataset(Project(list(columns), self.plan), self.session)
+        exprs = [(c, Col(c)) for c in columns]
+        for name, e in computed.items():
+            if isinstance(e, str):
+                # Ambiguous: a rename (col) or a constant (lit)?  Make the
+                # caller say which.
+                raise ValueError(
+                    f"select({name}={e!r}): pass col({e!r}) to project a "
+                    f"column under a new name, or lit({e!r}) for a string "
+                    f"constant")
+            exprs.append((name, e if isinstance(e, Expr) else Lit(e)))
+        return Dataset(Compute(exprs, self.plan), self.session)
+
+    def with_column(self, name: str, expr: Expr) -> "Dataset":
+        """Append (or replace) one computed column, keeping all others."""
+        return Dataset(WithColumns([(name, expr)], self.plan), self.session)
 
     def join(self, other: "Dataset", condition: Expr, how: str = "inner") -> "Dataset":
         return Dataset(Join(self.plan, other.plan, condition, how), self.session)

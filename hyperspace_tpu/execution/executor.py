@@ -18,7 +18,7 @@ Scan semantics:
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 import pyarrow as pa
@@ -29,18 +29,21 @@ from hyperspace_tpu.io.files import list_data_files
 from hyperspace_tpu.io.parquet import bucket_id_of_file, read_table
 from hyperspace_tpu.plan.expr import (
     And,
+    Arith,
     BinOp,
     Col,
     Expr,
     IsIn,
     IsNull,
     Lit,
+    Neg,
     Not,
     Or,
 )
 from hyperspace_tpu.plan.nodes import (
     Aggregate,
     BucketUnion,
+    Compute,
     Distinct,
     Filter,
     InMemory,
@@ -51,6 +54,7 @@ from hyperspace_tpu.plan.nodes import (
     Scan,
     Sort,
     Union,
+    WithColumns,
 )
 
 
@@ -79,6 +83,20 @@ class Executor:
                 return self._scan(plan.child, columns=plan.columns)
             table = self.execute(plan.child)
             return table.select(plan.columns)
+        if isinstance(plan, Compute):
+            table = self.execute(plan.child)
+            data = {name: _eval_column(e, table) for name, e in plan.exprs}
+            return pa.table(data)
+        if isinstance(plan, WithColumns):
+            table = self.execute(plan.child)
+            for name, e in plan.exprs:
+                arr = _eval_column(e, table)
+                if name in table.column_names:
+                    table = table.set_column(
+                        table.column_names.index(name), name, arr)
+                else:
+                    table = table.append_column(name, arr)
+            return table
         if isinstance(plan, Join):
             return self._join(plan)
         if isinstance(plan, Aggregate):
@@ -95,17 +113,20 @@ class Executor:
             return table.group_by(names).aggregate([]).select(names)
         if isinstance(plan, Sort):
             table = self.execute(plan.child)
-            return table.sort_by([(c, "ascending" if asc else "descending")
-                                  for c, asc in plan.keys])
+            return _sorted_table(table, plan.keys)
         if isinstance(plan, Limit):
             if isinstance(plan.child, Sort) and plan.n > 0:
                 # Top-N fusion: O(n log k) partial selection instead of a
                 # full sort + slice.  "Unstable" only affects tie order,
                 # which LIMIT over ORDER BY leaves unspecified anyway.
+                # select_k has no null-placement control, so null-bearing
+                # keys take the full sort (Spark null order preserved).
                 sort = plan.child
                 table = self.execute(sort.child)
                 if table.num_rows == 0:
                     return table  # select_k rejects zero-row input
+                if any(table.column(c).null_count > 0 for c, _ in sort.keys):
+                    return _sorted_table(table, sort.keys).slice(0, plan.n)
                 idx = pc.select_k_unstable(
                     table, k=min(plan.n, table.num_rows),
                     sort_keys=[(c, "ascending" if asc else "descending")
@@ -121,36 +142,57 @@ class Executor:
     # -- aggregate ----------------------------------------------------------
     def _aggregate(self, plan: Aggregate) -> pa.Table:
         table = self.execute(plan.child)
-        specs = [([] if func == "count_all" else col, func)
-                 for func, col, _out in plan.aggs]
+        # Expression inputs (sum(price * (1 - discount))) materialize as
+        # hidden columns first; the reduction then sees plain columns.
+        agg_inputs: List = []
+        for i, (func, agg_in, _out) in enumerate(plan.aggs):
+            if isinstance(agg_in, Expr) and not isinstance(agg_in, Col):
+                name = f"__agg_in_{i}"
+                while name in table.column_names:
+                    name += "_"
+                table = table.append_column(name, _eval_column(agg_in, table))
+                agg_inputs.append(name)
+            elif isinstance(agg_in, Col):
+                agg_inputs.append(agg_in.name)
+            else:
+                agg_inputs.append(agg_in)
+        specs = [([] if func == "count_all" else agg_inputs[i], func)
+                 for i, (func, _in, _out) in enumerate(plan.aggs)]
         if plan.group_by:
             keys = list(plan.group_by)
             out = table.group_by(keys).aggregate(specs)
-            # Map output columns POSITIONALLY: key columns are located by
-            # name (unique); the remaining positions, in order, are the agg
-            # results in spec order — name-based mapping would collide for
-            # duplicate (column, func) specs.
-            key_pos = {}
-            remaining = []
-            for i, name in enumerate(out.column_names):
-                if name in plan.group_by and name not in key_pos:
-                    key_pos[name] = i
-                else:
-                    remaining.append(i)
-            assert len(remaining) == len(plan.aggs)
-            data = {k: out.column(key_pos[k]) for k in keys}
-            for (_f, _c, out_name), i in zip(plan.aggs, remaining):
+            # Map output columns POSITIONALLY from arrow's documented
+            # layout: the key block is contiguous at the front (pyarrow
+            # >= 8) or the back (older), in group_by order; the other
+            # positions are the agg results in spec order.  First-name
+            # matching would silently swap a key with an auto-named agg
+            # column (e.g. key 'v_sum' vs agg output 'v_sum').
+            names = out.column_names
+            nk = len(keys)
+            if names[:nk] == keys:
+                key_idx, agg_idx = list(range(nk)), list(range(nk, len(names)))
+            elif names[-nk:] == keys:
+                key_idx = list(range(len(names) - nk, len(names)))
+                agg_idx = list(range(len(names) - nk))
+            else:
+                raise AssertionError(
+                    f"Unrecognized group-by output layout {names} for keys "
+                    f"{keys}")
+            assert len(agg_idx) == len(plan.aggs)
+            data = {k: out.column(i) for k, i in zip(keys, key_idx)}
+            for (_f, _c, out_name), i in zip(plan.aggs, agg_idx):
                 data[out_name] = out.column(i)
             return pa.table(data)
         # Global aggregation: one row, computed per spec.
         cols, vals = [], []
-        for func, col, out_name in plan.aggs:
+        for i, (func, _in, out_name) in enumerate(plan.aggs):
             if func == "count_all":
                 value = table.num_rows
             elif func == "count":
-                value = table.num_rows - table.column(col).null_count
+                column = table.column(agg_inputs[i])
+                value = table.num_rows - column.null_count
             else:
-                value = getattr(pc, func)(table.column(col)).as_py()
+                value = getattr(pc, func)(table.column(agg_inputs[i])).as_py()
             cols.append(out_name)
             vals.append(value)
         return pa.table({n: [v] for n, v in zip(cols, vals)})
@@ -235,7 +277,13 @@ class Executor:
 
     def _device_compatible(self, expr: Expr, table: pa.Table) -> bool:
         if isinstance(expr, BinOp):
-            for side in (expr.left, expr.right):
+            sides = (expr.left, expr.right)
+            if any(isinstance(s, (Arith, Neg)) for s in sides):
+                # Arithmetic comparisons: every leaf must be a column or a
+                # plainly numeric literal (no temporal normalization inside
+                # arithmetic); division is host-only (x/0 -> null 3VL).
+                return all(_arith_device_ok(s) for s in sides)
+            for side in sides:
                 if isinstance(side, Lit) and not isinstance(side.value, (int, float, bool)):
                     # Temporal/string literals: host path normalizes them.
                     t = table.schema.field(
@@ -315,18 +363,28 @@ class Executor:
 
     # -- join ---------------------------------------------------------------
     def _join(self, plan: Join, _record: bool = True) -> pa.Table:
-        from hyperspace_tpu.plan.expr import as_equi_join_pairs
-
         bucketed = self._try_bucketed_join(plan)
         if bucketed is not None:
             return bucketed
         if _record:
-            self.stats["joins"].append({"strategy": "plain"})
+            self.stats["joins"].append({"strategy": "plain",
+                                        "how": plan.how})
         left = self.execute(plan.left)
         right = self.execute(plan.right)
-        pairs = as_equi_join_pairs(plan.condition)
+        return self._host_join_tables(left, right, plan.condition, plan.how)
+
+    def _host_join_tables(self, left: pa.Table, right: pa.Table,
+                          condition: Expr, how: str) -> pa.Table:
+        """Join two materialized tables.  Match pairs come from the inner
+        equi-join kernels over the VALID-key rows (SQL: null keys never
+        match); the join type then shapes the output from those pairs —
+        null-extension via arrow's null-index take, existence joins by
+        membership over the matched left rows."""
+        from hyperspace_tpu.plan.expr import as_equi_join_pairs
+
+        pairs = as_equi_join_pairs(condition)
         if pairs is None:
-            raise ValueError(f"Non-equi join condition: {plan.condition!r}")
+            raise ValueError(f"Non-equi join condition: {condition!r}")
         # Resolve which side each column belongs to.
         l_keys, r_keys = [], []
         for a, b in pairs:
@@ -338,15 +396,51 @@ class Executor:
                 r_keys.append(a)
             else:
                 raise ValueError(f"Join columns {a!r}/{b!r} not found")
-        # SQL inner-join semantics: null keys never match — drop them up
-        # front so neither the device kernel nor pandas (which matches
-        # NaN==NaN) ever sees a null key.
-        for k in l_keys:
-            if left.column(k).null_count > 0:
-                left = left.filter(pc.is_valid(left.column(k)))
-        for k in r_keys:
-            if right.column(k).null_count > 0:
-                right = right.filter(pc.is_valid(right.column(k)))
+        # Null keys never match, but outer/anti joins still EMIT those rows
+        # — so track original positions instead of dropping rows outright.
+        l_map = _valid_key_positions(left, l_keys)
+        r_map = _valid_key_positions(right, r_keys)
+        lv = left if len(l_map) == left.num_rows else left.take(pa.array(l_map))
+        rv = right if len(r_map) == right.num_rows else right.take(pa.array(r_map))
+        li, ri = self._inner_match_pairs(lv, rv, l_keys, r_keys)
+        li = l_map[li] if len(l_map) != left.num_rows else li
+        ri = r_map[ri] if len(r_map) != right.num_rows else ri
+
+        if how == "inner":
+            return _concat_horizontal(left.take(pa.array(li)),
+                                      right.take(pa.array(ri)))
+        if how == "semi":
+            return left.take(pa.array(np.unique(li)))
+        if how == "anti":
+            mask = np.ones(left.num_rows, dtype=bool)
+            mask[li] = False
+            return left.filter(pa.array(mask))
+        # Outer joins: matched pairs first, then each side's unmatched rows
+        # null-extended (take with a null index yields a null row).
+        l_parts = [li]
+        r_parts = [ri]
+        l_masks = [np.zeros(len(li), dtype=bool)]
+        r_masks = [np.zeros(len(ri), dtype=bool)]
+        if how in ("left", "full"):
+            unmatched = np.setdiff1d(np.arange(left.num_rows), li)
+            l_parts.append(unmatched)
+            r_parts.append(np.zeros(len(unmatched), dtype=ri.dtype))
+            l_masks.append(np.zeros(len(unmatched), dtype=bool))
+            r_masks.append(np.ones(len(unmatched), dtype=bool))
+        if how in ("right", "full"):
+            unmatched = np.setdiff1d(np.arange(right.num_rows), ri)
+            l_parts.append(np.zeros(len(unmatched), dtype=li.dtype))
+            r_parts.append(unmatched)
+            l_masks.append(np.ones(len(unmatched), dtype=bool))
+            r_masks.append(np.zeros(len(unmatched), dtype=bool))
+        l_idx = pa.array(np.concatenate(l_parts), mask=np.concatenate(l_masks))
+        r_idx = pa.array(np.concatenate(r_parts), mask=np.concatenate(r_masks))
+        return _concat_horizontal(left.take(l_idx), right.take(r_idx))
+
+    def _inner_match_pairs(self, left: pa.Table, right: pa.Table,
+                           l_keys: List[str], r_keys: List[str]):
+        """(left_indices, right_indices) of the INNER matches between two
+        null-free-key tables, as int64 numpy arrays."""
         single_numeric = (
             len(l_keys) == 1
             and columnar.is_numeric_type(left.schema.field(l_keys[0]).type)
@@ -364,36 +458,32 @@ class Executor:
                 li, ri = sorted_equi_join(lk, rk)
             else:
                 li, ri = sorted_equi_join_np(lk, rk)
-            lt = left.take(pa.array(li))
-            rt = right.take(pa.array(ri))
-        else:
-            # Composite/string keys: digest join on device (or its host
-            # mirror below the size threshold) with exact verification —
-            # pandas only for key pairs with no exact common domain.
-            from hyperspace_tpu.ops.join import (
-                UnsupportedJoinKeys,
-                hashed_equi_join,
-            )
+            return np.asarray(li, dtype=np.int64), np.asarray(ri, dtype=np.int64)
+        # Composite/string keys: digest join on device (or its host
+        # mirror below the size threshold) with exact verification —
+        # pandas only for key pairs with no exact common domain.
+        from hyperspace_tpu.ops.join import (
+            UnsupportedJoinKeys,
+            hashed_equi_join,
+        )
 
-            try:
-                use_device = (max(left.num_rows, right.num_rows)
-                              >= self.session.conf.device_join_min_rows)
-                li, ri = hashed_equi_join(left, right, l_keys, r_keys,
-                                          device=use_device)
-                lt = left.take(pa.array(li))
-                rt = right.take(pa.array(ri))
-            except UnsupportedJoinKeys:
-                import pandas as pd  # noqa: F401
+        try:
+            use_device = (max(left.num_rows, right.num_rows)
+                          >= self.session.conf.device_join_min_rows)
+            li, ri = hashed_equi_join(left, right, l_keys, r_keys,
+                                      device=use_device)
+            return np.asarray(li, dtype=np.int64), np.asarray(ri, dtype=np.int64)
+        except UnsupportedJoinKeys:
+            import pandas as pd  # noqa: F401
 
-                ldf = left.to_pandas()
-                rdf = right.to_pandas()
-                ldf["__li"] = np.arange(len(ldf))
-                rdf["__ri"] = np.arange(len(rdf))
-                merged = ldf.merge(rdf, left_on=l_keys, right_on=r_keys,
-                                   how="inner", suffixes=("", "__r"))
-                lt = left.take(pa.array(merged["__li"].to_numpy()))
-                rt = right.take(pa.array(merged["__ri"].to_numpy()))
-        return _concat_horizontal(lt, rt)
+            ldf = left.to_pandas()
+            rdf = right.to_pandas()
+            ldf["__li"] = np.arange(len(ldf))
+            rdf["__ri"] = np.arange(len(rdf))
+            merged = ldf.merge(rdf, left_on=l_keys, right_on=r_keys,
+                               how="inner", suffixes=("", "__r"))
+            return (merged["__li"].to_numpy(dtype=np.int64),
+                    merged["__ri"].to_numpy(dtype=np.int64))
 
     # -- bucket-aligned join (the shuffle-free SMJ payoff on one chip) ------
     # Structural applicability lives in ``bucketed_join_precheck`` (module
@@ -425,19 +515,59 @@ class Executor:
             else sorted(set(l_parts) & set(r_parts))
         if not shared:
             # Decomposition failed (or zero overlapping buckets — the plain
-            # path produces the empty result with the correct joined
-            # schema): roll back anything recorded while probing.
+            # path produces the correct result, including outer
+            # null-extension, with the full joined schema): roll back
+            # anything recorded while probing.
             del self.stats["scans"][scans_mark:]
             return None
+        # One-sided buckets: for inner (and semi on the right / anti on the
+        # right) they contribute nothing, but an outer/anti join must still
+        # emit the unmatched rows of its preserved side.  Join those buckets
+        # against a ZERO-ROW donor of the other side (schema from a shared
+        # bucket) — the per-bucket join then null-extends/passes them
+        # exactly like the plain path would.
+        extra_left = sorted(set(l_parts) - set(r_parts)) \
+            if plan.how in ("left", "full", "anti") else []
+        extra_right = sorted(set(r_parts) - set(l_parts)) \
+            if plan.how in ("right", "full") else []
+        hybrid = bool(left_side.appended or right_side.appended)
+        mesh_result = self._try_mesh_bucketed_join(
+            plan, left_side, right_side, l_parts, r_parts, shared,
+            extra_left, extra_right, hybrid, l_files, r_files)
+        if mesh_result is not None:
+            return mesh_result
         self.stats["joins"].append({
             "strategy": "bucketed",
-            "buckets": len(shared),
-            "hybrid": bool(left_side.appended or right_side.appended),
+            "how": plan.how,
+            "buckets": len(shared) + len(extra_left) + len(extra_right),
+            "hybrid": hybrid,
         })
+        # Zero-row schema donors for one-sided buckets: executed ONCE —
+        # the donor bucket's table is reused for its own join too, so its
+        # files are not decoded (nor its scans recorded) twice.
+        pre: Dict[int, Tuple[pa.Table, pa.Table]] = {}
+        l_donor = r_donor = None
+        if extra_left or extra_right:
+            donor = shared[0]
+            lt0 = self.execute(l_parts[donor]())
+            rt0 = self.execute(r_parts[donor]())
+            pre[donor] = (lt0, rt0)
+            l_donor, r_donor = lt0.slice(0, 0), rt0.slice(0, 0)
 
         def join_bucket(bucket: int) -> pa.Table:
-            sub = Join(l_parts[bucket](), r_parts[bucket](),
-                       plan.condition, plan.how)
+            if bucket in extra_left:
+                sub = Join(l_parts[bucket](), InMemory(r_donor),
+                           plan.condition, plan.how)
+            elif bucket in extra_right:
+                sub = Join(InMemory(l_donor), r_parts[bucket](),
+                           plan.condition, plan.how)
+            elif bucket in pre:
+                lt, rt = pre[bucket]
+                sub = Join(InMemory(lt), InMemory(rt),
+                           plan.condition, plan.how)
+            else:
+                sub = Join(l_parts[bucket](), r_parts[bucket](),
+                           plan.condition, plan.how)
             # Per-bucket plans carry no bucket_spec, so this recursion takes
             # the plain per-bucket join path — no re-entry.
             return self._join(sub, _record=False)
@@ -449,7 +579,144 @@ class Executor:
         # the joined data), so 8 concurrent buckets stay memory-modest
         # while keeping every core decoding (nested per-file reads run
         # inline in the shared pool, so this cap IS the read concurrency).
-        parts = parallel_map_ordered(join_bucket, shared, max_workers=8)
+        parts = parallel_map_ordered(join_bucket,
+                                     sorted(shared + extra_left + extra_right),
+                                     max_workers=8)
+        return pa.concat_tables(parts, promote_options="default")
+
+    # -- mesh dispatch of the bucket-aligned join ---------------------------
+    def _try_mesh_bucketed_join(self, plan: Join, left_side, right_side,
+                                l_parts, r_parts, shared,
+                                extra_left, extra_right,
+                                hybrid: bool, l_files, r_files):
+        """Run the per-bucket joins over the device mesh instead of the
+        host thread pool: buckets are range-partitioned over the shard
+        axis and ``copartitioned_join_ragged`` joins every device's
+        buckets with ZERO collectives (equal keys share a bucket, and a
+        bucket lives on exactly one device) — the executed form of the
+        reference's distributed exchange-free SMJ
+        (BucketUnionExec.scala:52-81 + Spark SMJ over executors).
+
+        Applies to INNER joins with a single numeric key when >1 device is
+        visible and the data is large enough to amortize the transfer
+        (conf mesh_join_min_rows — estimated from parquet FOOTERS before
+        anything is materialized, so a below-threshold join never loses the
+        host pool's 8-concurrent-bucket memory bound); everything else
+        keeps the host pool.  The mesh path itself holds all buckets
+        resident by construction — that is what the threshold gates."""
+        import jax
+
+        if plan.how != "inner" or extra_left or extra_right:
+            return None
+        devices = jax.devices()
+        if len(devices) < 2:
+            return None
+        from hyperspace_tpu.plan.expr import as_equi_join_pairs
+
+        pairs = as_equi_join_pairs(plan.condition)
+        if pairs is None or len(pairs) != 1:
+            return None
+        # Key columns are the (single) bucket columns — precheck guaranteed
+        # the pairs map them — so eligibility is decidable from STORED
+        # schemas before executing anything.
+        lk_name = left_side.scan.relation.bucket_spec[1][0]
+        rk_name = right_side.scan.relation.bucket_spec[1][0]
+        try:
+            from hyperspace_tpu.io.parquet import schema_to_arrow
+
+            l_map = {k.lower(): v for k, v in
+                     self.session.schema_map_of(left_side.scan).items()}
+            r_map = {k.lower(): v for k, v in
+                     self.session.schema_map_of(right_side.scan).items()}
+            l_type = l_map[lk_name.lower()]
+            r_type = r_map[rk_name.lower()]
+            if not (columnar.is_numeric_type(
+                        schema_to_arrow({"c": l_type}).field(0).type)
+                    and columnar.is_numeric_type(
+                        schema_to_arrow({"c": r_type}).field(0).type)):
+                return None
+        except Exception:
+            return None
+        # Row estimate from footers only (no decode): filters above the
+        # scans can shrink actual rows, so this is an upper bound — the
+        # threshold is a routing heuristic, not a correctness gate.
+        est = _footer_row_estimate(l_files, shared) \
+            + _footer_row_estimate(r_files, shared)
+        if est < self.session.conf.mesh_join_min_rows:
+            return None
+
+        from hyperspace_tpu.utils.parallel_map import parallel_map_ordered
+
+        l_tabs = parallel_map_ordered(
+            lambda b: self.execute(l_parts[b]()), shared, max_workers=8)
+        r_tabs = parallel_map_ordered(
+            lambda b: self.execute(r_parts[b]()), shared, max_workers=8)
+        # Resolve the executed tables' key column spellings (projections
+        # preserve source case; the spec columns are case-insensitive).
+        lk_name = _find_column(l_tabs[0], lk_name)
+        rk_name = _find_column(r_tabs[0], rk_name)
+        if lk_name is None or rk_name is None:
+            # Shouldn't happen (the join condition references them), but a
+            # wrong guess must degrade to an error-free fallback: run the
+            # buckets through the host join path on the materialized pairs.
+            return pa.concat_tables(
+                [self._join(Join(InMemory(lt), InMemory(rt),
+                                 plan.condition, plan.how), _record=True)
+                 for lt, rt in zip(l_tabs, r_tabs)],
+                promote_options="default")
+        # Null keys never match an inner join: drop per bucket up front.
+
+        def drop_nulls(tabs, key):
+            out = []
+            for t in tabs:
+                if t.column(key).null_count > 0:
+                    t = t.filter(pc.is_valid(t.column(key)))
+                out.append(t)
+            return out
+
+        l_tabs = drop_nulls(l_tabs, lk_name)
+        r_tabs = drop_nulls(r_tabs, rk_name)
+        # Contiguous bucket ranges per device (range partitioning over the
+        # shard axis, matching parallel/shuffle.py's ownership layout);
+        # one concatenated table + key shard per device.
+        from hyperspace_tpu.parallel.join import copartitioned_join_ragged
+        from hyperspace_tpu.parallel.mesh import build_mesh
+
+        D = len(devices)
+        groups = np.array_split(np.arange(len(shared)), D)
+        l_dev_tabs, r_dev_tabs, l_shards, r_shards = [], [], [], []
+        for g in groups:
+            lt = pa.concat_tables([l_tabs[i] for i in g]) if len(g) \
+                else l_tabs[0].slice(0, 0)
+            rt = pa.concat_tables([r_tabs[i] for i in g]) if len(g) \
+                else r_tabs[0].slice(0, 0)
+            l_dev_tabs.append(lt)
+            r_dev_tabs.append(rt)
+            l_shards.append(np.asarray(
+                columnar.to_device_numeric(lt.column(lk_name))))
+            r_shards.append(np.asarray(
+                columnar.to_device_numeric(rt.column(rk_name))))
+        dev_ids, l_local, r_local = copartitioned_join_ragged(
+            l_shards, r_shards, build_mesh())
+        self.stats["joins"].append({
+            "strategy": "bucketed-mesh",
+            "how": plan.how,
+            "buckets": len(shared),
+            "devices": D,
+            "hybrid": hybrid,
+        })
+        parts = []
+        for d in range(D):
+            sel = dev_ids == d
+            if not sel.any():
+                continue
+            parts.append(_concat_horizontal(
+                l_dev_tabs[d].take(pa.array(l_local[sel])),
+                r_dev_tabs[d].take(pa.array(r_local[sel]))))
+        if not parts:
+            empty_l = l_dev_tabs[0].slice(0, 0)
+            empty_r = r_dev_tabs[0].slice(0, 0)
+            return _concat_horizontal(empty_l, empty_r)
         return pa.concat_tables(parts, promote_options="default")
 
     def _side_bucket_parts(self, side: "_BucketedSide", by_bucket):
@@ -517,6 +784,61 @@ class Executor:
         bucket_ids = bucket_ids_np(word_cols, num_buckets)
         return {int(b): table.filter(pa.array(bucket_ids == b))
                 for b in np.unique(bucket_ids)}
+
+
+def _footer_row_estimate(files_by_bucket, buckets) -> int:
+    """Sum of parquet footer row counts for the given buckets' files —
+    O(footer) per file, no column decode.  Non-parquet/unreadable files
+    contribute 0 (the estimate is a routing heuristic only)."""
+    import pyarrow.parquet as pq
+
+    total = 0
+    for b in buckets:
+        for path in files_by_bucket.get(b, ()):
+            try:
+                total += pq.read_metadata(path).num_rows
+            except Exception:
+                pass
+    return total
+
+
+def _find_column(table: pa.Table, name: str) -> Optional[str]:
+    """Case-insensitive column lookup, exact spelling preferred."""
+    if name in table.column_names:
+        return name
+    lower = name.lower()
+    for c in table.column_names:
+        if c.lower() == lower:
+            return c
+    return None
+
+
+def _valid_key_positions(table: pa.Table, keys: List[str]) -> np.ndarray:
+    """Row positions whose join keys are ALL non-null (the rows that can
+    participate in matching)."""
+    valid = np.ones(table.num_rows, dtype=bool)
+    for k in keys:
+        col = table.column(k)
+        if col.null_count > 0:
+            valid &= np.asarray(
+                pc.is_valid(col).to_numpy(zero_copy_only=False))
+    return np.nonzero(valid)[0] if not valid.all() \
+        else np.arange(table.num_rows)
+
+
+def _arith_device_ok(e: Expr) -> bool:
+    """Device-evaluable value expression: columns, numeric literals, and
+    + - * arithmetic over them (division is host-only: x/0 must null)."""
+    if isinstance(e, Col):
+        return True
+    if isinstance(e, Lit):
+        return isinstance(e.value, (int, float, bool))
+    if isinstance(e, Arith):
+        return (e.op != "/" and _arith_device_ok(e.left)
+                and _arith_device_ok(e.right))
+    if isinstance(e, Neg):
+        return _arith_device_ok(e.child)
+    return False
 
 
 class _BucketedSide:
@@ -659,6 +981,37 @@ def _rewrap(scan: Scan, wrappers, files) -> LogicalPlan:
     return node
 
 
+def _sorted_table(table: pa.Table, keys) -> pa.Table:
+    """ORDER BY with Spark's null order: nulls sort as SMALLEST — first
+    ascending, last descending (the reference's executor for ORDER BY is
+    Spark SQL).  Arrow's null_placement is positional (one setting for all
+    keys regardless of direction), so each null-bearing key gets a validity
+    flag key in front: false < true puts nulls first under the key's own
+    direction when ascending and last when descending, and within each flag
+    group the real key orders rows."""
+    if table.num_rows == 0:
+        return table
+    sort_keys = []
+    has_aux = False
+    work = table
+    for c, asc in keys:
+        direction = "ascending" if asc else "descending"
+        if table.column(c).null_count > 0:
+            flag = f"__valid__{c}"
+            n = 1
+            while flag in work.column_names:
+                flag = f"__valid__{c}__{n}"
+                n += 1
+            work = work.append_column(flag, pc.is_valid(table.column(c)))
+            has_aux = True
+            sort_keys.append((flag, direction))
+        sort_keys.append((c, direction))
+    if not has_aux:
+        return table.sort_by(sort_keys)
+    indices = pc.sort_indices(work, sort_keys=sort_keys)
+    return table.take(indices)
+
+
 def _concat_horizontal(left: pa.Table, right: pa.Table) -> pa.Table:
     names = list(left.column_names)
     cols = list(left.columns)
@@ -671,6 +1024,17 @@ def _concat_horizontal(left: pa.Table, right: pa.Table) -> pa.Table:
         names.append(out_name)
         cols.append(col)
     return pa.table(dict(zip(names, cols)))
+
+
+def _eval_column(expr: Expr, table: pa.Table):
+    """Evaluate ``expr`` as an output COLUMN (Compute/WithColumns/agg
+    inputs): array results pass through, scalar results broadcast to the
+    table's length (``lit(1)`` as a column)."""
+    result = _arrow_eval(expr, table)
+    if isinstance(result, pa.Scalar):
+        return pa.array([result.as_py()] * table.num_rows,
+                        type=result.type if result.is_valid else None)
+    return result
 
 
 def _parse_numeric(column, target_type) -> pa.Array:
@@ -731,6 +1095,22 @@ def _arrow_eval(expr: Expr, table: pa.Table):
             except (pa.ArrowInvalid, pa.ArrowTypeError, ValueError, TypeError):
                 pass
             raise
+    if isinstance(expr, Arith):
+        left = _arrow_eval(expr.left, table)
+        right = _arrow_eval(expr.right, table)
+        if expr.op == "/":
+            # Spark non-ANSI division: result is DOUBLE; x / 0 is NULL
+            # (arrow would give inf for floats and raise for ints).
+            left = pc.cast(left, pa.float64())
+            right = pc.cast(right, pa.float64())
+            zero = pc.equal(right, pa.scalar(0.0))
+            safe = pc.if_else(zero, pa.scalar(1.0), right)
+            return pc.if_else(zero, pa.scalar(None, type=pa.float64()),
+                              pc.divide(left, safe))
+        fn = {"+": pc.add, "-": pc.subtract, "*": pc.multiply}[expr.op]
+        return fn(left, right)
+    if isinstance(expr, Neg):
+        return pc.negate(_arrow_eval(expr.child, table))
     if isinstance(expr, And):
         return pc.and_kleene(_arrow_eval(expr.left, table), _arrow_eval(expr.right, table))
     if isinstance(expr, Or):

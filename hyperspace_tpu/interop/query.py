@@ -22,22 +22,49 @@ from typing import Any, Dict
 
 from hyperspace_tpu.plan.expr import (
     And,
+    Arith,
     BinOp,
     Col,
     Expr,
     IsIn,
     IsNull,
     Lit,
+    Neg,
     Not,
     Or,
 )
 
 _CMP_OPS = ("==", "<", "<=", ">", ">=")
+_ARITH_OPS = ("+", "-", "*", "/")
+
+
+def value_expr_from_json(obj: Any) -> Expr:
+    """A VALUE expression: bare JSON literal, {"col": name},
+    {"value": v}, arithmetic {"op": "+", "left": ..., "right": ...},
+    or {"op": "neg", "child": ...}."""
+    if not isinstance(obj, dict):
+        return Lit(obj)
+    op = obj.get("op")
+    if op in _ARITH_OPS:
+        return Arith(op, value_expr_from_json(obj["left"]),
+                     value_expr_from_json(obj["right"]))
+    if op == "neg":
+        return Neg(value_expr_from_json(obj["child"]))
+    if op is None and "col" in obj:
+        return Col(obj["col"])
+    if op is None and "value" in obj:
+        return Lit(obj["value"])
+    raise ValueError(f"Unknown value expression: {obj!r}")
 
 
 def expr_from_json(obj: Dict[str, Any]) -> Expr:
     op = obj.get("op")
     if op in _CMP_OPS:
+        if "left" in obj:
+            # Structured form: both sides are value expressions
+            # (arithmetic comparisons like l_ep * l_d > 100).
+            return BinOp(op, value_expr_from_json(obj["left"]),
+                         value_expr_from_json(obj["right"]))
         left = Col(obj["col"])
         if "right_col" in obj:
             return BinOp(op, left, Col(obj["right_col"]))
@@ -84,7 +111,11 @@ def dataset_from_spec(session, spec: Dict[str, Any]):
         ds = ds.join(other, expr_from_json(j["on"]), j.get("how", "inner"))
     if "aggs" in spec or "group_by" in spec:
         grouped = ds.group_by(*spec.get("group_by", []))
-        aggs = spec.get("aggs", {})  # {out: [col, func]} unpacks in agg()
+        # {out: [col_or_value_expr, func]}; expression inputs arrive as
+        # structured objects (value_expr_from_json).
+        aggs = {out: (value_expr_from_json(src) if isinstance(src, dict)
+                      else src, func)
+                for out, (src, func) in spec.get("aggs", {}).items()}
         ds = grouped.agg(**aggs) if aggs else grouped.count()
     if "sort" in spec:
         # ["col", ...] or [["col", false], ...] for descending; malformed
@@ -94,5 +125,10 @@ def dataset_from_spec(session, spec: Dict[str, Any]):
     if "limit" in spec:
         ds = ds.limit(int(spec["limit"]))
     if "select" in spec:
-        ds = ds.select(*spec["select"])
+        # Entries are column names, or {"name": out, "expr": value-expr}
+        # for computed projections.
+        names = [c for c in spec["select"] if isinstance(c, str)]
+        computed = {c["name"]: value_expr_from_json(c["expr"])
+                    for c in spec["select"] if isinstance(c, dict)}
+        ds = ds.select(*names, **computed)
     return ds

@@ -69,12 +69,35 @@ class _Handler(socketserver.StreamRequestHandler):
             pass  # client hung up mid-response; nothing to clean up
 
 
+def _is_loopback(host: str) -> bool:
+    if host == "localhost":
+        return True
+    if host == "":
+        return False  # "" binds INADDR_ANY — every interface, most exposed
+    import ipaddress
+
+    try:
+        return ipaddress.ip_address(host).is_loopback
+    except ValueError:
+        return False  # a hostname we can't classify: treat as remote
+
+
 class QueryServer:
     """Threaded TCP server bound to ``session``.  ``port=0`` picks an
     ephemeral port (read it back from ``.address``)."""
 
     def __init__(self, session, host: str = "127.0.0.1",
-                 port: int = 0) -> None:
+                 port: int = 0, allow_remote: bool = False) -> None:
+        # The server is UNAUTHENTICATED and reads any path the process can
+        # access; binding a non-loopback interface exposes that to the
+        # network.  Require the caller to say so explicitly.
+        if not _is_loopback(host) and not allow_remote:
+            raise ValueError(
+                f"QueryServer binds {host!r}, a non-loopback interface, but "
+                f"the protocol has no authentication: any peer that can "
+                f"reach the port can read any file this process can.  Pass "
+                f"allow_remote=True only behind a trusted network boundary.")
+
         class _Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
             daemon_threads = True
@@ -95,7 +118,12 @@ class QueryServer:
         return self
 
     def stop(self) -> None:
-        self._server.shutdown()
+        # shutdown() blocks on serve_forever's exit handshake — calling it
+        # on a never-started server would hang forever, so only do the
+        # handshake when start() actually ran; server_close() alone
+        # releases the socket either way.
+        if self._thread is not None:
+            self._server.shutdown()
         self._server.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5)

@@ -76,13 +76,16 @@ def read_table(paths: Sequence[str], file_format: str = "parquet",
             # files lacking the column get nulls instead of the path value.
             # One ParquetFile serves both the schema decision and the read —
             # pq.read_table after pq.read_schema would parse the footer twice.
-            pf = pq.ParquetFile(path)
-            present = set(pf.schema_arrow.names)
-            file_spec = {k: t for k, t in spec.items() if k not in present}
-            if columns is not None:
-                cols = [c for c in columns if c not in file_spec]
-            t = pf.read(columns=None if cols is None
-                        else [c for c in cols if c in present])
+            # Context-managed so the fd closes deterministically — a wide
+            # scan through the shared pool must not hold descriptors until
+            # GC runs.
+            with pq.ParquetFile(path) as pf:
+                present = set(pf.schema_arrow.names)
+                file_spec = {k: t for k, t in spec.items() if k not in present}
+                if columns is not None:
+                    cols = [c for c in columns if c not in file_spec]
+                t = pf.read(columns=None if cols is None
+                            else [c for c in cols if c in present])
         else:
             t = _read_one(path, file_format, cols, options or {})
         if file_spec:

@@ -19,7 +19,18 @@ from typing import Callable, Dict, List, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-from hyperspace_tpu.plan.expr import And, BinOp, Col, Expr, IsIn, Lit, Not, Or
+from hyperspace_tpu.plan.expr import (
+    And,
+    Arith,
+    BinOp,
+    Col,
+    Expr,
+    IsIn,
+    Lit,
+    Neg,
+    Not,
+    Or,
+)
 
 _CMP = {
     "==": lambda a, b: a == b,
@@ -39,20 +50,40 @@ _PREDICATE_CACHE: Dict[Tuple, Callable] = {}
 _PREDICATE_CACHE_MAX = 512  # queries have few distinct shapes; safety bound
 
 
+def _structure_value_key(e: Expr, parts: List, literals: List[float]) -> None:
+    """Pre-order fingerprint of a VALUE expression (column, literal, or
+    arithmetic over those); collects literals in the SAME traversal order
+    ``build`` appends them."""
+    if isinstance(e, Col):
+        parts += ("c", e.name)
+        return
+    if isinstance(e, Lit):
+        parts.append("L")
+        literals.append(e.value)
+        return
+    if isinstance(e, Arith):
+        if e.op == "/":
+            # Division is host-only: x/0 must become null (drops the row
+            # in a comparison), and the device path has no validity plane.
+            raise ValueError(f"Division is not device-evaluable: {e!r}")
+        parts += ("a", e.op)
+        _structure_value_key(e.left, parts, literals)
+        _structure_value_key(e.right, parts, literals)
+        return
+    if isinstance(e, Neg):
+        parts.append("neg")
+        _structure_value_key(e.child, parts, literals)
+        return
+    raise ValueError(f"Unsupported value expression: {e!r}")
+
+
 def _structure_key(e: Expr, parts: List, literals: List[float]) -> None:
     """Pre-order structural fingerprint of ``e``; collects literals in the
     SAME traversal order ``_build`` appends them."""
     if isinstance(e, BinOp):
-        if isinstance(e.left, Col) and isinstance(e.right, Lit):
-            parts += ("b", e.op, "c", e.left.name, "L")
-            literals.append(e.right.value)
-        elif isinstance(e.left, Lit) and isinstance(e.right, Col):
-            parts += ("b", e.op, "L", "c", e.right.name)
-            literals.append(e.left.value)
-        elif isinstance(e.left, Col) and isinstance(e.right, Col):
-            parts += ("b", e.op, "c", e.left.name, "c", e.right.name)
-        else:
-            raise ValueError(f"Unsupported comparison operands: {e!r}")
+        parts += ("b", e.op)
+        _structure_value_key(e.left, parts, literals)
+        _structure_value_key(e.right, parts, literals)
         return
     if isinstance(e, (And, Or)):
         parts.append("&" if isinstance(e, And) else "|")
@@ -95,23 +126,32 @@ def compile_predicate(expr: Expr, column_order: Sequence[str]
     col_ix = {name: i for i, name in enumerate(column_order)}
     literals: List[float] = []
 
+    def build_value(e: Expr) -> Callable:
+        if isinstance(e, Col):
+            i = col_ix[e.name]
+            return lambda cols, lits: cols[i]
+        if isinstance(e, Lit):
+            j = len(literals)
+            literals.append(e.value)
+            return lambda cols, lits: lits[j]
+        if isinstance(e, Arith):
+            if e.op == "/":
+                raise ValueError(f"Division is not device-evaluable: {e!r}")
+            fl, fr = build_value(e.left), build_value(e.right)
+            fn = {"+": lambda a, b: a + b,
+                  "-": lambda a, b: a - b,
+                  "*": lambda a, b: a * b}[e.op]
+            return lambda cols, lits: fn(fl(cols, lits), fr(cols, lits))
+        if isinstance(e, Neg):
+            f = build_value(e.child)
+            return lambda cols, lits: -f(cols, lits)
+        raise ValueError(f"Unsupported value expression: {e!r}")
+
     def build(e: Expr) -> Callable:
         if isinstance(e, BinOp):
             op = _CMP[e.op]
-            if isinstance(e.left, Col) and isinstance(e.right, Lit):
-                i = col_ix[e.left.name]
-                j = len(literals)
-                literals.append(e.right.value)
-                return lambda cols, lits: op(cols[i], lits[j])
-            if isinstance(e.left, Lit) and isinstance(e.right, Col):
-                i = col_ix[e.right.name]
-                j = len(literals)
-                literals.append(e.left.value)
-                return lambda cols, lits: op(lits[j], cols[i])
-            if isinstance(e.left, Col) and isinstance(e.right, Col):
-                i, k = col_ix[e.left.name], col_ix[e.right.name]
-                return lambda cols, lits: op(cols[i], cols[k])
-            raise ValueError(f"Unsupported comparison operands: {e!r}")
+            fl, fr = build_value(e.left), build_value(e.right)
+            return lambda cols, lits: op(fl(cols, lits), fr(cols, lits))
         if isinstance(e, And):
             fl, fr = build(e.left), build(e.right)
             return lambda cols, lits: fl(cols, lits) & fr(cols, lits)

@@ -111,6 +111,34 @@ def bucket_sort_permutation(
     return stacked[0, :n], stacked[1, :n]
 
 
+def bucket_sort_permutation_np(
+    word_cols,
+    order_words,
+    num_buckets: int,
+) -> "Tuple[np.ndarray, np.ndarray]":
+    """Bit-identical HOST mirror of ``bucket_sort_permutation`` (same cost
+    model as the filter/join host mirrors: below
+    ``device_build_min_rows`` the device round trip's transfer + compile
+    latency over a remote tunnel dwarfs a numpy lexsort).  Identity holds
+    because bucket assignment shares ``bucket_ids_np`` (parity-tested
+    against the device kernel) and both sorts are stable lexsorts over the
+    SAME (bucket, order-word) key sequence — padding in the device path
+    parks only pad rows at the tail, never reordering real ties."""
+    import numpy as np
+
+    from hyperspace_tpu.ops.hash import bucket_ids_np
+
+    buckets = bucket_ids_np([np.asarray(w) for w in word_cols], num_buckets)
+    keys = []
+    for w in reversed(order_words):
+        w = np.asarray(w)
+        keys.append(w[:, 1])
+        keys.append(w[:, 0])
+    keys.append(buckets)
+    perm = np.lexsort(tuple(keys)).astype(np.int32)
+    return buckets.astype(np.int32), perm
+
+
 @partial(jax.jit, static_argnames=("num_buckets",))
 def _bucket_counts_xla(buckets: jnp.ndarray, num_buckets: int) -> jnp.ndarray:
     return jax.ops.segment_sum(

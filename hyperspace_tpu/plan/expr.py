@@ -44,6 +44,35 @@ class Expr:
     def __ge__(self, other: Any) -> "Expr":
         return BinOp(">=", self, _lift(other))
 
+    # -- arithmetic (Spark's numeric expression surface; every TPC query
+    # uses these freely, e.g. sum(l_extendedprice * (1 - l_discount))) ----
+    def __add__(self, other: Any) -> "Expr":
+        return Arith("+", self, _lift(other))
+
+    def __radd__(self, other: Any) -> "Expr":
+        return Arith("+", _lift(other), self)
+
+    def __sub__(self, other: Any) -> "Expr":
+        return Arith("-", self, _lift(other))
+
+    def __rsub__(self, other: Any) -> "Expr":
+        return Arith("-", _lift(other), self)
+
+    def __mul__(self, other: Any) -> "Expr":
+        return Arith("*", self, _lift(other))
+
+    def __rmul__(self, other: Any) -> "Expr":
+        return Arith("*", _lift(other), self)
+
+    def __truediv__(self, other: Any) -> "Expr":
+        return Arith("/", self, _lift(other))
+
+    def __rtruediv__(self, other: Any) -> "Expr":
+        return Arith("/", _lift(other), self)
+
+    def __neg__(self) -> "Expr":
+        return Neg(self)
+
     def isin(self, values: Iterable[Any]) -> "Expr":
         return IsIn(self, list(values))
 
@@ -93,6 +122,33 @@ class BinOp(Expr):
 
     def __repr__(self) -> str:
         return f"({self.left!r} {self.op} {self.right!r})"
+
+
+class Arith(Expr):
+    """Numeric arithmetic: + - * /.  Division follows Spark's non-ANSI
+    semantics — the result is DOUBLE and a zero denominator yields null
+    (which drops the row in any comparison); + - * keep arrow/numpy's
+    type promotion and wrap on int64 overflow like Spark with ANSI off."""
+
+    OPS = ("+", "-", "*", "/")
+
+    def __init__(self, op: str, left: Expr, right: Expr) -> None:
+        if op not in self.OPS:
+            raise ValueError(f"Unsupported arithmetic op {op!r}")
+        self.op = op
+        self.left = left
+        self.right = right
+
+    def __repr__(self) -> str:
+        return f"({self.left!r} {self.op} {self.right!r})"
+
+
+class Neg(Expr):
+    def __init__(self, child: Expr) -> None:
+        self.child = child
+
+    def __repr__(self) -> str:
+        return f"(-{self.child!r})"
 
 
 class And(Expr):
@@ -158,9 +214,11 @@ def _lift(v: Any) -> Expr:
 def _collect_columns(e: Expr, out: Set[str]) -> None:
     if isinstance(e, Col):
         out.add(e.name)
-    elif isinstance(e, BinOp):
+    elif isinstance(e, (BinOp, Arith)):
         _collect_columns(e.left, out)
         _collect_columns(e.right, out)
+    elif isinstance(e, Neg):
+        _collect_columns(e.child, out)
     elif isinstance(e, (And, Or)):
         _collect_columns(e.left, out)
         _collect_columns(e.right, out)

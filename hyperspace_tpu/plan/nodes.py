@@ -13,7 +13,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-from hyperspace_tpu.plan.expr import Expr
+from hyperspace_tpu.plan.expr import Col as ColRef, Expr
 
 
 @dataclasses.dataclass(frozen=True)
@@ -162,11 +162,101 @@ class Project(LogicalPlan):
         return f"Project [{', '.join(self.columns)}]"
 
 
+class Compute(LogicalPlan):
+    """Expression-valued projection: output is exactly ``exprs`` — (name,
+    Expr) pairs, where a bare column passthrough is ``(name, Col(name))``.
+    The computed analog of Catalyst's Project-with-expressions (the
+    reference rides Catalyst for ``1 - l_discount`` arithmetic; this engine
+    owns it).  The rewrite rules never match a Compute itself — pruning
+    derives its input needs from the expressions' referenced columns, so
+    a plain Project lands over the scan below and the rules match THAT."""
+
+    def __init__(self, exprs: Sequence[Tuple[str, Expr]],
+                 child: LogicalPlan) -> None:
+        if not exprs:
+            raise ValueError("Compute needs at least one output expression")
+        names = [n for n, _ in exprs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"Duplicate output names in select: {names}")
+        self.exprs = tuple((n, e) for n, e in exprs)
+        self.children = (child,)
+
+    @property
+    def child(self) -> LogicalPlan:
+        return self.children[0]
+
+    def input_columns(self) -> List[str]:
+        out: set = set()
+        for _n, e in self.exprs:
+            out |= e.referenced_columns()
+        return sorted(out)
+
+    def output_columns(self, schema_of) -> List[str]:
+        return [n for n, _e in self.exprs]
+
+    def with_children(self, children) -> "Compute":
+        (child,) = children
+        return Compute(self.exprs, child)
+
+    def simple_string(self) -> str:
+        parts = []
+        for n, e in self.exprs:
+            if isinstance(e, ColRef) and e.name == n:
+                parts.append(n)
+            else:
+                parts.append(f"{e!r} AS {n}")
+        return f"Compute [{', '.join(parts)}]"
+
+
+class WithColumns(LogicalPlan):
+    """Append (or replace, by name) computed columns while keeping the
+    child's full output — ``df.with_column('rev', ...)``.  Lazy like every
+    node: the child's column set resolves at execution."""
+
+    def __init__(self, exprs: Sequence[Tuple[str, Expr]],
+                 child: LogicalPlan) -> None:
+        if not exprs:
+            raise ValueError("with_column needs at least one expression")
+        names = [n for n, _ in exprs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"Duplicate with_column names: {names}")
+        self.exprs = tuple((n, e) for n, e in exprs)
+        self.children = (child,)
+
+    @property
+    def child(self) -> LogicalPlan:
+        return self.children[0]
+
+    def output_columns(self, schema_of) -> List[str]:
+        base = self.child.output_columns(schema_of)
+        new = [n for n, _e in self.exprs if n not in base]
+        return list(base) + new
+
+    def with_children(self, children) -> "WithColumns":
+        (child,) = children
+        return WithColumns(self.exprs, child)
+
+    def simple_string(self) -> str:
+        parts = ", ".join(f"{n} := {e!r}" for n, e in self.exprs)
+        return f"WithColumns [{parts}]"
+
+
 class Join(LogicalPlan):
+    """Equi-join with SQL join types.  The engine executes every type (the
+    reference's engine, Spark, does too); the JoinIndexRule REWRITE stays
+    scoped to inner equi-joins exactly like JoinIndexRule.scala:134-140 —
+    but index scans introduced by other rules still execute bucket-aligned
+    under any type, since per-bucket null-extension composes (non-matching
+    rows can only be certified unmatched within their own bucket, and
+    co-partitioning guarantees their matches couldn't live elsewhere)."""
+
+    HOW = ("inner", "left", "right", "full", "semi", "anti")
+
     def __init__(self, left: LogicalPlan, right: LogicalPlan,
                  condition: Expr, how: str = "inner") -> None:
-        if how != "inner":
-            raise ValueError("Only inner joins are supported (JoinIndexRule scope)")
+        if how not in self.HOW:
+            raise ValueError(f"Unsupported join type {how!r}; "
+                             f"expected one of {self.HOW}")
         self.condition = condition
         self.how = how
         self.children = (left, right)
@@ -180,6 +270,9 @@ class Join(LogicalPlan):
         return self.children[1]
 
     def output_columns(self, schema_of) -> List[str]:
+        if self.how in ("semi", "anti"):
+            # Existence joins produce the LEFT side only (Spark semantics).
+            return self.left.output_columns(schema_of)
         return (self.left.output_columns(schema_of)
                 + self.right.output_columns(schema_of))
 
@@ -268,10 +361,12 @@ class Limit(LogicalPlan):
 
 
 class Aggregate(LogicalPlan):
-    """Group-by + aggregations: ``aggs`` is a tuple of (function, column,
+    """Group-by + aggregations: ``aggs`` is a tuple of (function, input,
     output_name), functions from arrow's hash-aggregate set (sum, min,
     max, mean, count, count_distinct, stddev, variance; count_all counts
-    ROWS — its column is ignored).  Empty ``group_by`` = global
+    ROWS — its input is ignored).  ``input`` is a column name OR an Expr —
+    ``sum(l_extendedprice * (1 - l_discount))`` is an Expr input; the
+    executor materializes it before reducing.  Empty ``group_by`` = global
     aggregation.  The rewrite rules never match an Aggregate itself —
     they rewrite the Filter/Scan/Join patterns BELOW it (Catalyst's rules
     behave the same way: the reference's TPC-DS q1 plans keep their
@@ -281,16 +376,30 @@ class Aggregate(LogicalPlan):
                  "count_distinct", "stddev", "variance")
 
     def __init__(self, group_by: Sequence[str],
-                 aggs: Sequence[Tuple[str, str, str]],
+                 aggs: Sequence[Tuple[str, Any, str]],
                  child: LogicalPlan) -> None:
-        for func, _col, _out in aggs:
+        for func, agg_in, _out in aggs:
             if func not in self.FUNCTIONS:
                 raise ValueError(
                     f"Unsupported aggregate function {func!r}; "
                     f"expected one of {self.FUNCTIONS}")
+            if not isinstance(agg_in, (str, Expr)):
+                raise ValueError(
+                    f"Aggregate input must be a column name or expression, "
+                    f"got {agg_in!r}")
         self.group_by = tuple(group_by)
         self.aggs = tuple(aggs)
         self.children = (child,)
+
+    def input_columns(self) -> List[str]:
+        """Source columns the aggregations read (group keys excluded)."""
+        out: set = set()
+        for _f, agg_in, _o in self.aggs:
+            if isinstance(agg_in, Expr):
+                out |= agg_in.referenced_columns()
+            elif agg_in:
+                out.add(agg_in)
+        return sorted(out)
 
     @property
     def child(self) -> LogicalPlan:
@@ -304,9 +413,13 @@ class Aggregate(LogicalPlan):
         return Aggregate(self.group_by, self.aggs, child)
 
     def simple_string(self) -> str:
-        aggs = ", ".join(
-            f"{f}({'*' if f == 'count_all' else c}) AS {out}"
-            for f, c, out in self.aggs)
+        def render(f, agg_in):
+            if f == "count_all":
+                return "*"
+            return repr(agg_in) if isinstance(agg_in, Expr) else str(agg_in)
+
+        aggs = ", ".join(f"{f}({render(f, c)}) AS {out}"
+                         for f, c, out in self.aggs)
         return f"Aggregate [{', '.join(self.group_by)}] [{aggs}]"
 
 

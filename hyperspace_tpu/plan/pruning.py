@@ -21,6 +21,7 @@ from typing import List, Optional, Set
 from hyperspace_tpu.plan.nodes import (
     Aggregate,
     BucketUnion,
+    Compute,
     Distinct,
     Filter,
     Join,
@@ -30,6 +31,7 @@ from hyperspace_tpu.plan.nodes import (
     Scan,
     Sort,
     Union,
+    WithColumns,
 )
 from hyperspace_tpu.utils.resolver import resolve
 
@@ -56,12 +58,45 @@ def _prune(plan: LogicalPlan, required: Optional[Set[str]],
         if new_child is not plan.child:
             return Project(plan.columns, new_child)
         return plan
+    if isinstance(plan, Compute):
+        # Like Project, a Compute defines exactly what its subtree must
+        # produce: the union of its expressions' referenced columns.
+        child_required = set(plan.input_columns())
+        new_child = _prune(plan.child, child_required, schema_of)
+        if new_child is not plan.child:
+            return Compute(plan.exprs, new_child)
+        return plan
+    if isinstance(plan, WithColumns):
+        # Passes the child's full output through.  A computed column the
+        # parent never requires is DROPPED here (evaluating it would force
+        # its inputs to survive pruning for a value that is discarded
+        # above); the survivors' inputs plus the parent's remaining needs
+        # flow down.
+        if required is None:
+            keep = plan.exprs
+        else:
+            keep = tuple((n, e) for n, e in plan.exprs if n in required)
+        computed_names = {n for n, _e in keep}
+        expr_refs: Set[str] = set()
+        for _n, e in keep:
+            expr_refs |= e.referenced_columns()
+        child_required = None if required is None else (
+            (required - computed_names) | expr_refs)
+        new_child = _prune(plan.child, child_required, schema_of)
+        if not keep:
+            return new_child
+        # Length check, not tuple equality: Expr.__eq__ builds a BinOp (the
+        # DSL), so == on expr tuples is meaningless; keep is a filtered
+        # subsequence, so equal length means nothing was dropped.
+        if new_child is not plan.child or len(keep) != len(plan.exprs):
+            return WithColumns(keep, new_child)
+        return plan
     if isinstance(plan, Aggregate):
         # Like Project, an Aggregate defines exactly what its subtree must
         # produce: the grouping keys plus the aggregated inputs
-        # (count_all's column placeholder is empty — not a real column).
-        child_required = set(plan.group_by) | {c for _f, c, _o in plan.aggs
-                                               if c}
+        # (count_all's column placeholder is empty — not a real column;
+        # expression inputs contribute their referenced columns).
+        child_required = set(plan.group_by) | set(plan.input_columns())
         new_child = _prune(plan.child, child_required, schema_of)
         if new_child is not plan.child:
             return Aggregate(plan.group_by, plan.aggs, new_child)
@@ -99,6 +134,12 @@ def _prune(plan: LogicalPlan, required: Optional[Set[str]],
         right_schema = plan.right.output_columns(schema_of)
         if required is None:
             side_requireds = [None, None]  # root output must keep every column
+            if plan.how in ("semi", "anti"):
+                # Existence joins never emit right-side columns — the right
+                # side only needs the join keys, even at the root.
+                side_requireds[1] = {
+                    c for c in cond_cols
+                    if resolve([c], right_schema) is not None}
         else:
             side_requireds = [set(), set()]
             for c in required | cond_cols:
@@ -134,6 +175,10 @@ def _prune(plan: LogicalPlan, required: Optional[Set[str]],
         if required is None:
             return plan
         schema = plan.output_columns(schema_of)
+        if not required and schema:
+            # A literal-only parent (select(x=lit(1))) needs no columns but
+            # still needs the ROW COUNT; keep one column to carry it.
+            required = {schema[0]}
         resolved = resolve(sorted(required), schema)
         if resolved is None:
             # Unresolvable columns — leave the scan alone; execution will

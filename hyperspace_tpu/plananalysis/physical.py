@@ -21,6 +21,7 @@ from hyperspace_tpu.io.parquet import bucket_id_of_file, schema_to_arrow
 from hyperspace_tpu.plan.nodes import (
     Aggregate,
     BucketUnion,
+    Compute,
     Distinct,
     Filter,
     InMemory,
@@ -31,6 +32,7 @@ from hyperspace_tpu.plan.nodes import (
     Scan,
     Sort,
     Union,
+    WithColumns,
 )
 
 
@@ -140,6 +142,8 @@ def physical_operators(session, plan: Optional[LogicalPlan]
             counts["FilterExec"] += 1
         elif isinstance(node, Project):
             counts["ProjectExec"] += 1
+        elif isinstance(node, (Compute, WithColumns)):
+            counts["ProjectExec"] += 1  # computed projection, same phys op
         elif isinstance(node, BucketUnion):
             counts["BucketUnionExec"] += 1
         elif isinstance(node, Union):

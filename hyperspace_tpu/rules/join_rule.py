@@ -43,6 +43,11 @@ class JoinIndexRule:
 
     def _try_rewrite(self, join: Join) -> Optional[LogicalPlan]:
         spm = self.session.source_provider_manager
+        if join.how != "inner":
+            # Reference scope: the rewrite applies to inner equi-joins only
+            # (JoinIndexRule.scala:134-140).  Other join types still execute
+            # — and FilterIndexRule may still index their sides.
+            return None
         pairs = as_equi_join_pairs(join.condition)
         if not pairs:
             return None
@@ -126,15 +131,26 @@ class JoinIndexRule:
         return new_plan
 
     def _required_columns(self, side_plan: LogicalPlan, schema: List[str]) -> List[str]:
-        """All columns this side must provide: its output plus any columns
-        referenced by intermediate filters (JoinIndexRule.scala:371-383)."""
-        from hyperspace_tpu.plan.nodes import Filter
+        """All SOURCE columns this side must provide: its output plus any
+        columns referenced by intermediate filters
+        (JoinIndexRule.scala:371-383).  Computed outputs (Compute /
+        WithColumns) resolve to their expressions' referenced columns —
+        the index need only cover the inputs, since the arithmetic runs
+        above the scan."""
+        from hyperspace_tpu.plan.nodes import Compute, Filter, WithColumns
 
         needed: Set[str] = set(side_plan.output_columns(self.session.schema_of))
 
         def walk(node: LogicalPlan) -> None:
             if isinstance(node, Filter):
                 needed.update(node.condition.referenced_columns())
+            elif isinstance(node, (Compute, WithColumns)):
+                # Top-down: a computed name needed above is replaced by the
+                # source columns its expression reads.
+                for name, e in node.exprs:
+                    if name in needed:
+                        needed.discard(name)
+                        needed.update(e.referenced_columns())
             for c in node.children:
                 walk(c)
 

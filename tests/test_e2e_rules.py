@@ -429,7 +429,7 @@ class TestHybridJoinExecution:
         got = ds.collect()
         stats = session.last_execution_stats
         assert stats["joins"] == [
-            {"strategy": "bucketed",
+            {"strategy": "bucketed", "how": "inner",
              "buckets": stats["joins"][0]["buckets"], "hybrid": True}]
         assert stats["joins"][0]["buckets"] >= 1
         session.disable_hyperspace()
@@ -496,3 +496,29 @@ def _walk(plan):
     yield plan
     for c in plan.children:
         yield from _walk(c)
+
+
+def test_build_layout_identical_across_kernel_routing(env, tmp_path):
+    """device_build_min_rows routes the build's hash+sort to the device
+    kernel or its host mirror; the on-disk index layout must be identical
+    either way (same files, same row order)."""
+    import pyarrow.parquet as pq
+
+    session, hs, data_dir = env
+    outs = {}
+    for mode, threshold in (("device", 0), ("host", 1 << 60)):
+        session.conf.device_build_min_rows = threshold
+        name = f"route_{mode}"
+        hs.create_index(session.read.parquet(data_dir),
+                        IndexConfig(name, ["id"], ["name"]))
+        idx_dir = os.path.join(session.conf.system_path, name, "v__=0")
+        files = sorted(f for f in os.listdir(idx_dir)
+                       if not f.startswith("_"))
+        # File names carry a random suffix; identity is per-bucket content
+        # (and the per-bucket row ORDER — both paths must sort identically).
+        tables = {bucket_id_of_file(f):
+                  pq.read_table(os.path.join(idx_dir, f)).to_pydict()
+                  for f in files}
+        outs[mode] = tables
+    assert sorted(outs["device"]) == sorted(outs["host"])
+    assert outs["device"] == outs["host"]

@@ -191,8 +191,8 @@ def test_filtered_join_side_prunes_buckets(tmp_path):
     stats = session.last_execution_stats
     # The pruned bucket set intersects into the bucket-aligned join: only
     # ONE of the 8 buckets executes at all.
-    assert stats["joins"][0] == {"strategy": "bucketed", "buckets": 1,
-                                 "hybrid": False}
+    assert stats["joins"][0] == {"strategy": "bucketed", "how": "inner",
+                                 "buckets": 1, "hybrid": False}
     session.disable_hyperspace()
     want = ds.collect()
     keys = [(c, "ascending") for c in ("k", "lv", "rv")]

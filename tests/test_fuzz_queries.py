@@ -99,6 +99,12 @@ def _random_predicate(r: random.Random):
         lambda: col("f_num").is_not_null(),
         lambda: (col("f_key") == r.randrange(0, 250))
         | (col("f_key") == r.randrange(0, 250)),
+        # Arithmetic predicates (nullable operand -> Kleene nulls drop;
+        # division -> null-on-zero host path).
+        lambda: col("f_price") * 2 + col("f_key") > r.uniform(0, 400),
+        lambda: col("f_price") * (1 - col("f_price") / 200)
+        < r.uniform(0, 100),
+        lambda: -col("f_num") + 1000 >= r.randrange(0, 1000),
     ]
     e = r.choice(pool)()
     if r.random() < 0.5:
@@ -113,21 +119,39 @@ def _random_query(session, paths, seed: int):
     ds = session.read.parquet(paths["facts"])
     if r.random() < 0.8:
         ds = ds.filter(_random_predicate(r))
-    joined = r.random() < 0.4
+    joined = r.random() < 0.5
+    how = "inner"
     if joined:
+        # Every SQL join type; inner weighted since it is the only one the
+        # JOIN rewrite targets (the others exercise executor parity).
+        how = r.choice(("inner", "inner", "left", "right", "full",
+                        "semi", "anti"))
         ds = ds.join(session.read.parquet(paths["dims"]),
-                     col("f_key") == col("d_key"))
+                     col("f_key") == col("d_key"), how=how)
+    right_cols = joined and how not in ("semi", "anti")
     if r.random() < 0.35:
-        keys = ["f_tag"] if not joined or r.random() < 0.5 else ["d_name"]
-        ds = ds.group_by(*keys).agg(total=("f_price", "sum"),
-                                    n=("f_key", "count"))
+        keys = ["f_tag"] if not right_cols or r.random() < 0.5 else ["d_name"]
+        if r.random() < 0.5:
+            ds = ds.group_by(*keys).agg(total=("f_price", "sum"),
+                                        n=("f_key", "count"))
+        else:
+            # Expression aggregate (the TPC-H revenue shape).
+            ds = ds.group_by(*keys).agg(
+                total=(col("f_price") * (1 - col("f_price") / 300), "sum"),
+                n=("f_key", "count"))
         if r.random() < 0.4:  # HAVING
             ds = ds.filter(col("total") > r.uniform(0, 500))
     else:
         cols = ["f_key", "f_num", "f_price", "f_tag"]
-        if joined and r.random() < 0.5:
+        if right_cols and r.random() < 0.5:
             cols += ["d_name"]
-        ds = ds.select(*r.sample(cols, k=r.randrange(1, len(cols) + 1)))
+        picked = r.sample(cols, k=r.randrange(1, len(cols) + 1))
+        if r.random() < 0.3:
+            # Computed projection alongside plain columns.
+            ds = ds.select(*picked,
+                           rev=col("f_price") * (1 - col("f_price") / 500))
+        else:
+            ds = ds.select(*picked)
         if r.random() < 0.2:
             ds = ds.distinct()
     return ds

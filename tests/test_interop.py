@@ -135,3 +135,19 @@ class TestServer:
                 assert f.readline() == b"OK\n"
                 table = pa.ipc.open_stream(f).read_all()
         assert table.num_rows == 1000
+
+
+def test_non_loopback_bind_requires_allow_remote(env):
+    s, _data = env
+    with pytest.raises(ValueError, match="no authentication"):
+        QueryServer(s, host="0.0.0.0")
+    # Loopback spellings stay frictionless.
+    QueryServer(s, host="localhost").stop()
+    # An explicit opt-in lifts the guard.
+    QueryServer(s, host="0.0.0.0", allow_remote=True).stop()
+
+
+def test_empty_host_binds_all_interfaces_requires_opt_in(env):
+    s, _data = env
+    with pytest.raises(ValueError, match="no authentication"):
+        QueryServer(s, host="")

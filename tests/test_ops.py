@@ -320,3 +320,32 @@ class TestHostHashMirror:
             assert np.array_equal(np.asarray(b0), np.asarray(b1)), n
             assert np.array_equal(np.asarray(p0), np.asarray(p1)), n
             assert np.asarray(p1).max() < n  # no padded index leaks
+
+
+def test_bucket_sort_permutation_host_mirror_parity():
+    """bucket_sort_permutation_np (the build's host mirror below
+    device_build_min_rows) must reproduce the device kernel's buckets AND
+    permutation exactly — the on-disk layout must not depend on where the
+    permutation was computed."""
+    import numpy as np
+
+    from hyperspace_tpu.io import columnar
+    from hyperspace_tpu.ops.sort import (
+        bucket_sort_permutation,
+        bucket_sort_permutation_np,
+    )
+
+    rng = np.random.default_rng(9)
+    n = 1000
+    import pyarrow as pa
+
+    cols = [pa.array(rng.integers(-500, 500, n), type=pa.int64()),
+            pa.array(rng.random(n))]
+    word_cols = [np.asarray(columnar.to_hash_words(c)) for c in cols]
+    order_words = [np.asarray(columnar.to_order_words(c)) for c in cols]
+    for nb in (1, 4, 16):
+        db, dp = bucket_sort_permutation(word_cols, order_words, nb,
+                                         pad_to=256)
+        hb, hp = bucket_sort_permutation_np(word_cols, order_words, nb)
+        np.testing.assert_array_equal(np.asarray(db), hb)
+        np.testing.assert_array_equal(np.asarray(dp), hp)

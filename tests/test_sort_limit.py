@@ -120,3 +120,52 @@ def test_interop_spec_sort_limit(env):
         "select": ["k"],
     }).collect()
     assert out.column("k").to_pylist() == [0, 1, 2, 3]
+
+def test_sort_null_order_matches_spark(tmp_path):
+    """Spark ORDER BY null order: nulls FIRST ascending, LAST descending —
+    on every key independently, including mixed-direction sorts."""
+    data = str(tmp_path / "nulldata")
+    os.makedirs(data)
+    pq.write_table(pa.table({
+        "a": pa.array([3, None, 1, None, 2], type=pa.int64()),
+        "b": pa.array([None, 5, None, 4, 6], type=pa.int64()),
+    }), os.path.join(data, "f.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+
+    asc = s.read.parquet(data).sort("a").collect().column("a").to_pylist()
+    assert asc == [None, None, 1, 2, 3]
+
+    desc = (s.read.parquet(data).sort(("a", False))
+            .collect().column("a").to_pylist())
+    assert desc == [3, 2, 1, None, None]
+
+    # Mixed directions: a DESC (nulls last), b ASC (nulls first) within ties.
+    mixed = (s.read.parquet(data).sort(("a", False), "b")
+             .collect().to_pydict())
+    assert mixed["a"] == [3, 2, 1, None, None]
+    assert mixed["b"] == [None, 6, None, 4, 5]
+
+    # Top-N fusion path with null keys falls back to the full sort and
+    # keeps the same null order.
+    top = (s.read.parquet(data).sort("a").limit(3)
+           .collect().column("a").to_pylist())
+    assert top == [None, None, 1]
+    bottom = (s.read.parquet(data).sort(("a", False)).limit(4)
+              .collect().column("a").to_pylist())
+    assert bottom == [3, 2, 1, None]
+
+
+def test_group_key_colliding_with_agg_output_name(tmp_path):
+    """A group key named like an arrow auto-generated agg column (v_sum)
+    must not swap with the agg output (advisor round-2 finding)."""
+    data = str(tmp_path / "colldata")
+    os.makedirs(data)
+    pq.write_table(pa.table({
+        "v_sum": pa.array([10, 10, 20], type=pa.int64()),
+        "v": pa.array([1, 2, 3], type=pa.int64()),
+    }), os.path.join(data, "f.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    out = (s.read.parquet(data).group_by("v_sum")
+           .agg(total=("v", "sum")).sort("v_sum").collect().to_pydict())
+    assert out["v_sum"] == [10, 20]
+    assert out["total"] == [3, 3]
