@@ -37,6 +37,7 @@ INDEX_FILE_COMPRESSION = "hyperspace.tpu.indexFileCompression"
 DEVICE_JOIN_MIN_ROWS = "hyperspace.tpu.deviceJoinMinRows"
 DEVICE_BUILD_MIN_ROWS = "hyperspace.tpu.deviceBuildMinRows"
 MESH_JOIN_MIN_ROWS = "hyperspace.tpu.meshJoinMinRows"
+DEVICE_AGG_MIN_ROWS = "hyperspace.tpu.deviceAggMinRows"
 PARALLEL_BUILD = "hyperspace.tpu.parallelBuild"
 SHUFFLE_CAPACITY_SLACK = "hyperspace.tpu.shuffleCapacitySlack"
 GLOBBING_PATTERN = "hyperspace.source.globbingPattern"
@@ -126,6 +127,15 @@ class HyperspaceConf:
     # over the shard axis, zero-collective by co-partitioning); below it,
     # the host thread pool runs the buckets (the single-chip path).
     mesh_join_min_rows: int = 1 << 24
+    # Same cost model for GROUP BY: at or above this row count an eligible
+    # aggregation (integer/bool keys, null-free numeric inputs,
+    # sum/min/max/mean/count) runs as the device segment-reduction kernel
+    # (ops/aggregate.py); below it, host arrow hash aggregation.  The
+    # default is high: aggregation ships EVERY input column to the device
+    # (measured ~20 MB -> ~5 s over the remote tunnel vs ~26 ms host arrow
+    # at 400k rows), so only resident-data / locally-attached deployments
+    # should lower it.
+    device_agg_min_rows: int = 1 << 26
     # Distributed build over the device mesh: "auto" uses it when more than
     # one accelerator is visible; "on"/"off" force it.  The shuffle uses
     # capacity-padded all_to_all; slack is the initial headroom factor over
@@ -170,6 +180,7 @@ class HyperspaceConf:
         DEVICE_JOIN_MIN_ROWS: "device_join_min_rows",
         DEVICE_BUILD_MIN_ROWS: "device_build_min_rows",
         MESH_JOIN_MIN_ROWS: "mesh_join_min_rows",
+        DEVICE_AGG_MIN_ROWS: "device_agg_min_rows",
         PARALLEL_BUILD: "parallel_build",
         SHUFFLE_CAPACITY_SLACK: "shuffle_capacity_slack",
         DISPLAY_MODE: "display_mode",

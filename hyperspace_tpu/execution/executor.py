@@ -159,6 +159,9 @@ class Executor:
         specs = [([] if func == "count_all" else agg_inputs[i], func)
                  for i, (func, _in, _out) in enumerate(plan.aggs)]
         if plan.group_by:
+            device = self._try_device_aggregate(table, plan, agg_inputs)
+            if device is not None:
+                return device
             keys = list(plan.group_by)
             out = table.group_by(keys).aggregate(specs)
             # Map output columns POSITIONALLY from arrow's documented
@@ -196,6 +199,82 @@ class Executor:
             cols.append(out_name)
             vals.append(value)
         return pa.table({n: [v] for n, v in zip(cols, vals)})
+
+    def _try_device_aggregate(self, table: pa.Table, plan: Aggregate,
+                              agg_inputs: List[str]) -> Optional[pa.Table]:
+        """Route an eligible GROUP BY through the device segment-reduction
+        kernel (ops/aggregate.py).  Eligible: enough rows (conf
+        device_agg_min_rows), integer/bool group keys (float keys would
+        split arrow's single NaN group by bit pattern), null-free numeric
+        inputs, and only sum/min/max/mean/count/count_all.  Output rows
+        come back in ascending key order — GROUP BY output order is
+        unspecified, as on the host path."""
+        from hyperspace_tpu.ops.aggregate import AGG_OPS
+
+        conf = self.session.conf
+        if table.num_rows < conf.device_agg_min_rows or table.num_rows == 0:
+            return None
+        if any(func not in AGG_OPS for func, _i, _o in plan.aggs):
+            return None
+        for k in plan.group_by:
+            t = table.schema.field(k).type
+            if not (pa.types.is_integer(t) or pa.types.is_boolean(t)):
+                return None
+            if table.column(k).null_count > 0:
+                return None
+        for i, (func, _in, _out) in enumerate(plan.aggs):
+            if func == "count_all":
+                continue
+            if func == "count":
+                # count == group row count only when the input has no
+                # nulls; any TYPE is fine since no value is reduced.
+                if table.column(agg_inputs[i]).null_count > 0:
+                    return None
+                continue
+            t = table.schema.field(agg_inputs[i]).type
+            # Strictly int/float: temporal columns would crash min/max at
+            # the cast back (and "sum" over dates must raise, as the host
+            # path does); bool sums promote to uint64 on host but int64 on
+            # device — excluded rather than special-cased.
+            if not (pa.types.is_integer(t) or pa.types.is_floating(t)) \
+                    or table.column(agg_inputs[i]).null_count > 0:
+                return None
+
+        from hyperspace_tpu.ops.aggregate import grouped_aggregate
+
+        key_words = [np.asarray(columnar.to_order_words(table.column(k)))
+                     for k in plan.group_by]
+        # One array per NON-count aggregate; counts ship nothing (a dummy
+        # column would be ~8 B/row of pointless tunnel transfer).
+        value_cols = [np.asarray(
+            columnar.to_device_numeric(table.column(agg_inputs[i])))
+            for i, (func, _in, _out) in enumerate(plan.aggs)
+            if func not in ("count", "count_all")]
+        first_rows, counts, results = grouped_aggregate(
+            key_words, value_cols, [f for f, _i, _o in plan.aggs],
+            pad_to=conf.device_batch_rows)
+        self.stats.setdefault("aggregates", []).append({
+            "strategy": "device-segment",
+            "groups": int(len(first_rows)),
+            "rows": table.num_rows,
+        })
+        taken = table.take(pa.array(first_rows))
+        data = {k: taken.column(k) for k in plan.group_by}
+        for (func, _in, out_name), res, i in zip(
+                plan.aggs, results, range(len(results))):
+            if func in ("count", "count_all"):
+                data[out_name] = pa.array(counts.astype(np.int64))
+            elif func in ("min", "max"):
+                # Reductions return existing values: restore the input type
+                # (the device ran float64/int64).
+                src_type = table.schema.field(agg_inputs[i]).type
+                data[out_name] = pc.cast(pa.array(res), src_type)
+            elif func == "mean":
+                data[out_name] = pa.array(res.astype(np.float64))
+            else:  # sum: int stays int64, float stays float64 — arrow's
+                # own promotion for sums.
+                data[out_name] = pa.array(res)
+        return pa.table(data)
 
     # -- scan ---------------------------------------------------------------
     def _scan(self, plan: Scan, columns: Optional[List[str]] = None) -> pa.Table:

@@ -1,0 +1,155 @@
+"""Device-side grouped aggregation kernel: sort by key words, segment-reduce.
+
+Reference contract: Spark's HashAggregateExec is what executes the
+reference's GROUP BY plans (the reference itself ships no aggregation code —
+SURVEY.md §2.4's "components Spark provides" note); this engine previously
+ran every aggregation on host arrow.  The device path reuses the bucket
+machinery's normalization: group keys become monotone uint32 order words
+(io/columnar.to_order_words), rows lexsort by them, group boundaries fall
+out of adjacent-word comparison, and every aggregate is one XLA
+``segment_sum``/``segment_min``/``segment_max`` over the sorted rows.
+
+Two static-shape programs, like the join kernels:
+  1. sort + boundary detection; only the GROUP COUNT crosses to host
+     (perm/boundaries stay device-resident),
+  2. capacity-padded segment reduction (capacity = next pow2 of the group
+     count, so repeated queries share compiled programs).
+
+Supported: non-empty integer/bool group keys, null-free numeric inputs,
+sum/min/max/mean/count/count_all.  Everything else stays on the arrow host
+path (the executor gates, execution/executor.py).  Floating-point KEYS are
+excluded: NaN bit patterns would split arrow's single NaN group.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hyperspace_tpu.utils.shapes import round_up_pow2
+
+AGG_OPS = ("sum", "min", "max", "mean", "count", "count_all")
+
+
+@jax.jit
+def _group_sort(key_words, n_valid):
+    """(perm, boundaries, n_groups): rows lexsorted by key words with
+    padding parked last (validity is the PRIMARY sort key, as in the join
+    kernel); boundaries mark the first row of each group among the valid
+    prefix."""
+    n = key_words[0].shape[0]
+    positions = jnp.arange(n, dtype=jnp.int32)
+    invalid = (positions >= n_valid).astype(jnp.uint32)
+    keys = []
+    for w in reversed(key_words):
+        keys.append(w[:, 1])
+        keys.append(w[:, 0])
+    keys.append(invalid)  # LAST key = primary: valid rows first
+    perm = jnp.lexsort(tuple(keys)).astype(jnp.int32)
+    is_valid = positions < n_valid
+    diff = jnp.zeros(n, dtype=bool)
+    for w in key_words:
+        sorted_w = w[perm]
+        d = (sorted_w[1:] != sorted_w[:-1]).any(axis=-1)
+        diff = diff.at[1:].set(diff[1:] | d)
+    boundaries = (diff | (positions == 0)) & is_valid
+    return perm, boundaries, jnp.sum(boundaries, dtype=jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("ops", "capacity"))
+def _segment_reduce(perm, boundaries, n_valid, value_cols, *, ops, capacity):
+    """Per-group reductions over the sorted rows.  Returns
+    (first_positions, counts, per-op arrays), each (capacity,); slots past
+    the real group count are zeros/identities and sliced off on host."""
+    n = perm.shape[0]
+    positions = jnp.arange(n, dtype=jnp.int32)
+    is_valid = positions < n_valid
+    seg_ids = jnp.cumsum(boundaries.astype(jnp.int32)) - 1
+    # Padded rows (sorted past the valid prefix) get segment `capacity` —
+    # out of every real segment's range.
+    seg_ids = jnp.where(is_valid, seg_ids, capacity)
+    first_pos = jnp.nonzero(boundaries, size=capacity, fill_value=n - 1)[0]
+    first_rows = perm[first_pos].astype(jnp.int32)
+    counts = jax.ops.segment_sum(is_valid.astype(jnp.int32), seg_ids,
+                                 num_segments=capacity + 1)[:capacity]
+    outs = []
+    vi = 0
+    for op in ops:
+        if op in ("count", "count_all"):
+            # No value column — counts need nothing shipped or gathered.
+            outs.append(counts)
+            continue
+        col = value_cols[vi]
+        vi += 1
+        vals = col[perm]
+        if op in ("sum", "mean"):
+            r = jax.ops.segment_sum(
+                jnp.where(is_valid, vals, jnp.zeros_like(vals)), seg_ids,
+                num_segments=capacity + 1)[:capacity]
+            if op == "mean":
+                r = r.astype(jnp.float64) / jnp.maximum(counts, 1)
+        elif op == "min":
+            r = jax.ops.segment_min(vals, seg_ids,
+                                    num_segments=capacity + 1)[:capacity]
+        elif op == "max":
+            r = jax.ops.segment_max(vals, seg_ids,
+                                    num_segments=capacity + 1)[:capacity]
+        else:  # unreachable: AGG_OPS is validated by the caller
+            raise AssertionError(op)
+        outs.append(r)
+    return (first_rows, counts) + tuple(outs)
+
+
+def grouped_aggregate(
+    key_words: Sequence[np.ndarray],
+    value_cols: Sequence[np.ndarray],
+    ops: Sequence[str],
+    pad_to: int = 0,
+) -> Tuple[np.ndarray, np.ndarray, List[np.ndarray]]:
+    """Device grouped aggregation.
+
+    Args:
+      key_words: per group-key column, (n, 2) uint32 monotone order words.
+      value_cols: one length-n numeric array per NON-count aggregate, in
+        ops order (count/count_all ship no data — nothing to reduce).
+      ops: per aggregate, one of AGG_OPS.
+      pad_to: round the row dimension up to a multiple (compile-cache
+        sharing across row counts, conf device_batch_rows).
+
+    Returns:
+      (first_row_indices, counts, results): for each of G groups, the index
+      of its first row in the ORIGINAL order (host gathers the key values
+      from the arrow table — no dtype round trip), the row count, and one
+      result array per aggregate.  Groups are emitted in ascending key
+      order.
+    """
+    from hyperspace_tpu.ops.sort import _pad_rows
+    from hyperspace_tpu.utils.xla_cache import ensure_persistent_xla_cache
+
+    for op in ops:
+        if op not in AGG_OPS:
+            raise ValueError(f"Unsupported device aggregate {op!r}")
+    ensure_persistent_xla_cache()
+    n = int(key_words[0].shape[0])
+    capacity_rows = n
+    if pad_to and pad_to > 0:
+        capacity_rows = -(-max(n, 1) // pad_to) * pad_to
+    kw = tuple(_pad_rows(np.asarray(w), capacity_rows) for w in key_words)
+    vc = tuple(_pad_rows(np.asarray(v), capacity_rows) for v in value_cols)
+    with jax.enable_x64():
+        perm, boundaries, n_groups = _group_sort(kw, n)
+        g = int(n_groups)
+        if g == 0:
+            return (np.empty(0, np.int32), np.empty(0, np.int32),
+                    [np.empty(0) for _ in ops])
+        capacity = round_up_pow2(g)
+        out = _segment_reduce(perm, boundaries, n, vc,
+                              ops=tuple(ops), capacity=capacity)
+    first_rows = np.asarray(out[0])[:g]
+    counts = np.asarray(out[1])[:g]
+    results = [np.asarray(r)[:g] for r in out[2:]]
+    return first_rows, counts, results

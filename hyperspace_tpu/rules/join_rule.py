@@ -134,10 +134,16 @@ class JoinIndexRule:
         """All SOURCE columns this side must provide: its output plus any
         columns referenced by intermediate filters
         (JoinIndexRule.scala:371-383).  Computed outputs (Compute /
-        WithColumns) resolve to their expressions' referenced columns —
-        the index need only cover the inputs, since the arithmetic runs
-        above the scan."""
-        from hyperspace_tpu.plan.nodes import Compute, Filter, WithColumns
+        WithColumns / Aggregate results) resolve to their expressions'
+        referenced source columns — the index need only cover the inputs,
+        since the computation runs above the scan."""
+        from hyperspace_tpu.plan.expr import Expr as _Expr
+        from hyperspace_tpu.plan.nodes import (
+            Aggregate,
+            Compute,
+            Filter,
+            WithColumns,
+        )
 
         needed: Set[str] = set(side_plan.output_columns(self.session.schema_of))
 
@@ -151,6 +157,17 @@ class JoinIndexRule:
                     if name in needed:
                         needed.discard(name)
                         needed.update(e.referenced_columns())
+            elif isinstance(node, Aggregate):
+                # An aggregate output needed above is replaced by its input
+                # column(s); group keys pass through as themselves.
+                for func, agg_in, out in node.aggs:
+                    if out in needed:
+                        needed.discard(out)
+                        if isinstance(agg_in, _Expr):
+                            needed.update(agg_in.referenced_columns())
+                        elif agg_in:
+                            needed.add(agg_in)
+                needed.update(node.group_by)
             for c in node.children:
                 walk(c)
 

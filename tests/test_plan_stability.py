@@ -26,7 +26,7 @@ import pyarrow as pa
 import pyarrow.parquet as pq
 import pytest
 
-from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
+from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col, lit
 
 APPROVED_DIR = os.path.join(os.path.dirname(__file__), "resources",
                             "approved-plans-v1")
@@ -330,6 +330,40 @@ def _queries(session, paths):
             orders(), col("c_custkey") == col("o_custkey")).join(
             lineitem(), col("o_orderkey") == col("l_orderkey"))
             .group_by("c_name").agg(qty=("l_quantity", "sum")),
+        # LEFT OUTER join: no JOIN rewrite (inner-only scope,
+        # JoinIndexRule.scala:134-140) but the filtered side still
+        # bucket-prunes via FilterIndexRule
+        "q36_left_outer_join": orders()
+            .filter(col("o_orderkey") == 42).join(
+            lineitem(), col("o_orderkey") == col("l_orderkey"), how="left")
+            .select("o_orderkey", "o_totalprice", "l_quantity"),
+        # SEMI join (EXISTS shape): left side's filter rewrite still fires
+        "q37_semi_join": orders()
+            .filter(col("o_custkey") == 3).join(
+            lineitem(), col("o_orderkey") == col("l_orderkey"), how="semi")
+            .select("o_orderkey", "o_orderstatus"),
+        # ANTI join (NOT EXISTS shape)
+        "q38_anti_join": orders()
+            .filter(col("o_custkey") == 3).join(
+            lineitem(), col("o_orderkey") == col("l_orderkey"), how="anti")
+            .select("o_orderkey", "o_orderstatus"),
+        # computed projection over an indexed filter: pruning reduces the
+        # Compute's needs to source columns, the index covers them
+        "q39_computed_select_over_index": lineitem()
+            .filter(col("l_orderkey") == 100)
+            .select("l_orderkey",
+                    revenue=col("l_extendedprice") * (1 - lit(0.04))),
+        # expression aggregate over an index-rewritten join (TPC-H revenue)
+        "q40_expression_agg_over_join": orders().join(
+            lineitem(), col("o_orderkey") == col("l_orderkey"))
+            .group_by("o_orderkey")
+            .agg(revenue=(col("l_extendedprice") * (1 - lit(0.04)), "sum")),
+        # with_column kept by the parent: WithColumns node survives with
+        # its inputs pruned to the minimum
+        "q41_with_column_over_index": orders()
+            .filter(col("o_orderkey") == 42)
+            .with_column("double_price", col("o_totalprice") * 2)
+            .select("o_orderkey", "double_price"),
     }
 
 
@@ -345,7 +379,7 @@ def _simplify(plan_string: str, paths) -> str:
     return out + "\n"
 
 
-QUERY_NAMES = [f"q{i:02d}" for i in range(1, 36)]
+QUERY_NAMES = [f"q{i:02d}" for i in range(1, 42)]
 
 
 def _query_by_prefix(queries, prefix):

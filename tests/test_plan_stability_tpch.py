@@ -1,0 +1,515 @@
+"""Adapted TPC-H plan-stability + answer-equivalence corpus.
+
+The reference pins 103 TPC-DS plans over a 24-table DDL harness
+(goldstandard/TPCDSBase.scala:35+, PlanStabilitySuite.scala:81-283); this
+module is the same idiom over the full 8-table TPC-H schema with ~19
+queries adapted to the engine's surface:
+
+  - expression aggregates (sum(l_extendedprice * (1 - l_discount))),
+  - semi/anti/left joins standing in for EXISTS / NOT EXISTS / outer SQL,
+  - computed projections over aggregate outputs for ratio queries,
+  - constants in place of scalar subqueries, equality/IN in place of LIKE
+    (no string functions yet — each adaptation is noted inline).
+
+Golden plans live under resources/approved-plans-tpch/; regenerate with
+HS_GENERATE_GOLDEN_FILES=1.  Beneath the plan goldens an answer-equivalence
+net runs every query with rules on vs off (checkAnswer's role) so a golden
+regenerated from a broken optimizer cannot freeze the breakage in.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import (
+    DataSkippingIndexConfig,
+    Hyperspace,
+    HyperspaceSession,
+    IndexConfig,
+    col,
+)
+from tests.test_plan_stability import _simplify, _write
+
+APPROVED_DIR = os.path.join(os.path.dirname(__file__), "resources",
+                            "approved-plans-tpch")
+GENERATE = os.environ.get("HS_GENERATE_GOLDEN_FILES") == "1"
+
+N_ORDERS = 600
+N_LINEITEM = 2400
+N_CUSTOMER = 90
+N_SUPPLIER = 40
+N_PART = 80
+N_PARTSUPP = 160
+N_NATION = 25
+N_REGION = 5
+
+
+@pytest.fixture(scope="module")
+def catalog(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("tpch_full"))
+    rng = np.random.default_rng(7)
+
+    region = pa.table({
+        "r_regionkey": np.arange(N_REGION, dtype=np.int64),
+        "r_name": pa.array(["AFRICA", "AMERICA", "ASIA", "EUROPE",
+                            "MIDDLE EAST"]),
+    })
+    nation = pa.table({
+        "n_nationkey": np.arange(N_NATION, dtype=np.int64),
+        "n_name": pa.array([f"NATION{i:02d}" if i != 7 else "GERMANY"
+                            for i in range(N_NATION)]),
+        "n_regionkey": pa.array(
+            rng.integers(0, N_REGION, N_NATION), type=pa.int64()),
+    })
+    supplier = pa.table({
+        "s_suppkey": np.arange(N_SUPPLIER, dtype=np.int64),
+        "s_name": pa.array([f"Supplier#{i:05d}" for i in range(N_SUPPLIER)]),
+        "s_nationkey": pa.array(
+            rng.integers(0, N_NATION, N_SUPPLIER), type=pa.int64()),
+        "s_acctbal": pa.array(rng.uniform(-500, 5000, N_SUPPLIER)),
+    })
+    customer = pa.table({
+        "c_custkey": np.arange(N_CUSTOMER, dtype=np.int64),
+        "c_name": pa.array([f"Customer#{i:06d}" for i in range(N_CUSTOMER)]),
+        "c_nationkey": pa.array(
+            rng.integers(0, N_NATION, N_CUSTOMER), type=pa.int64()),
+        "c_mktsegment": pa.array(
+            [("BUILDING", "MACHINERY", "AUTOMOBILE", "FURNITURE",
+              "HOUSEHOLD")[i % 5] for i in range(N_CUSTOMER)]),
+        "c_acctbal": pa.array(rng.uniform(-500, 5000, N_CUSTOMER)),
+        # Int country prefix standing in for substring(c_phone, 1, 2).
+        "c_phonecode": pa.array(
+            rng.integers(10, 35, N_CUSTOMER), type=pa.int64()),
+    })
+    part = pa.table({
+        "p_partkey": np.arange(N_PART, dtype=np.int64),
+        "p_name": pa.array([f"part {('green', 'red', 'blue')[i % 3]} {i}"
+                            for i in range(N_PART)]),
+        "p_brand": pa.array([f"Brand#{i % 5}{i % 3}" for i in range(N_PART)]),
+        "p_type": pa.array([("PROMO BRUSHED", "STANDARD POLISHED",
+                             "MEDIUM PLATED")[i % 3]
+                            for i in range(N_PART)]),
+        "p_size": pa.array(rng.integers(1, 50, N_PART), type=pa.int64()),
+        "p_container": pa.array([("SM CASE", "MED BOX", "LG JAR")[i % 3]
+                                 for i in range(N_PART)]),
+    })
+    partsupp = pa.table({
+        "ps_partkey": pa.array(np.repeat(np.arange(N_PART), 2),
+                               type=pa.int64()),
+        "ps_suppkey": pa.array(
+            rng.integers(0, N_SUPPLIER, N_PARTSUPP), type=pa.int64()),
+        "ps_availqty": pa.array(
+            rng.integers(1, 1000, N_PARTSUPP), type=pa.int64()),
+        "ps_supplycost": pa.array(rng.uniform(1, 100, N_PARTSUPP)),
+    })
+    orders = pa.table({
+        "o_orderkey": np.arange(N_ORDERS, dtype=np.int64),
+        "o_custkey": pa.array(
+            rng.integers(0, N_CUSTOMER, N_ORDERS), type=pa.int64()),
+        "o_orderstatus": pa.array(
+            [("O", "F", "P")[i % 3] for i in range(N_ORDERS)]),
+        "o_totalprice": pa.array(rng.uniform(1, 1000, N_ORDERS)),
+        # Dates are day numbers (no date functions yet), time-correlated
+        # with the key (append order) so per-file sketch ranges are narrow
+        # — the layout data skipping exploits in any real ingest.
+        "o_orderdate": pa.array(
+            np.sort(rng.integers(0, 2400, N_ORDERS)), type=pa.int64()),
+        "o_orderpriority": pa.array(
+            [("1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED",
+              "5-LOW")[i % 5] for i in range(N_ORDERS)]),
+        "o_shippriority": pa.array(
+            np.zeros(N_ORDERS, dtype=np.int64)),
+    })
+    l_ship = np.sort(rng.integers(0, 2400, N_LINEITEM))  # time-correlated
+    lineitem = pa.table({
+        "l_orderkey": pa.array(
+            rng.integers(0, N_ORDERS, N_LINEITEM), type=pa.int64()),
+        "l_partkey": pa.array(
+            rng.integers(0, N_PART, N_LINEITEM), type=pa.int64()),
+        "l_suppkey": pa.array(
+            rng.integers(0, N_SUPPLIER, N_LINEITEM), type=pa.int64()),
+        "l_quantity": pa.array(
+            rng.integers(1, 50, N_LINEITEM), type=pa.int64()),
+        "l_extendedprice": pa.array(rng.uniform(1, 1000, N_LINEITEM)),
+        "l_discount": pa.array(rng.uniform(0.0, 0.1, N_LINEITEM)),
+        "l_tax": pa.array(rng.uniform(0.0, 0.08, N_LINEITEM)),
+        "l_returnflag": pa.array(
+            [("R", "A", "N")[i % 3] for i in range(N_LINEITEM)]),
+        "l_linestatus": pa.array(
+            [("O", "F")[i % 2] for i in range(N_LINEITEM)]),
+        "l_shipdate": pa.array(l_ship, type=pa.int64()),
+        "l_commitdate": pa.array(l_ship + rng.integers(-30, 60, N_LINEITEM),
+                                 type=pa.int64()),
+        "l_receiptdate": pa.array(l_ship + rng.integers(1, 30, N_LINEITEM),
+                                  type=pa.int64()),
+        "l_shipmode": pa.array(
+            [("MAIL", "SHIP", "AIR", "TRUCK", "RAIL")[i % 5]
+             for i in range(N_LINEITEM)]),
+    })
+
+    tables = {"region": region, "nation": nation, "supplier": supplier,
+              "customer": customer, "part": part, "partsupp": partsupp,
+              "orders": orders, "lineitem": lineitem}
+    paths = {name: os.path.join(root, name) for name in tables}
+    for name, t in tables.items():
+        _write(paths[name], t,
+               n_files=4 if name in ("orders", "lineitem") else 1)
+
+    session = HyperspaceSession(system_path=os.path.join(root, "indexes"))
+    session.conf.num_buckets = 4
+    hs = Hyperspace(session)
+    read = session.read
+    # The index set a TPC-H accelerator deployment would build: covering
+    # indexes on each hot join key with the columns the query mix reads,
+    # plus date sketches for the range scans.
+    hs.create_index(read.parquet(paths["lineitem"]),
+                    IndexConfig("t_l_ok", ["l_orderkey"],
+                                ["l_quantity", "l_extendedprice",
+                                 "l_discount", "l_shipdate", "l_suppkey",
+                                 "l_returnflag", "l_shipmode",
+                                 "l_commitdate", "l_receiptdate"]))
+    hs.create_index(read.parquet(paths["lineitem"]),
+                    IndexConfig("t_l_pk", ["l_partkey"],
+                                ["l_suppkey", "l_quantity",
+                                 "l_extendedprice", "l_discount"]))
+    hs.create_index(read.parquet(paths["orders"]),
+                    IndexConfig("t_o_ok", ["o_orderkey"],
+                                ["o_custkey", "o_orderdate",
+                                 "o_shippriority", "o_totalprice"]))
+    hs.create_index(read.parquet(paths["orders"]),
+                    IndexConfig("t_o_ck", ["o_custkey"],
+                                ["o_orderkey", "o_orderdate",
+                                 "o_shippriority", "o_totalprice"]))
+    hs.create_index(read.parquet(paths["customer"]),
+                    IndexConfig("t_c_ck", ["c_custkey"],
+                                ["c_name", "c_nationkey", "c_acctbal",
+                                 "c_mktsegment"]))
+    hs.create_index(read.parquet(paths["part"]),
+                    IndexConfig("t_p_pk", ["p_partkey"],
+                                ["p_name", "p_brand", "p_type", "p_size",
+                                 "p_container"]))
+    hs.create_index(read.parquet(paths["partsupp"]),
+                    IndexConfig("t_ps_pk", ["ps_partkey"],
+                                ["ps_suppkey", "ps_availqty",
+                                 "ps_supplycost"]))
+    hs.create_index(read.parquet(paths["partsupp"]),
+                    IndexConfig("t_ps_sk", ["ps_suppkey"],
+                                ["ps_partkey", "ps_availqty",
+                                 "ps_supplycost"]))
+    hs.create_index(read.parquet(paths["supplier"]),
+                    IndexConfig("t_s_sk", ["s_suppkey"],
+                                ["s_name", "s_nationkey"]))
+    hs.create_index(read.parquet(paths["lineitem"]),
+                    DataSkippingIndexConfig("t_ds_ship", ["l_shipdate"]))
+    hs.create_index(read.parquet(paths["orders"]),
+                    DataSkippingIndexConfig("t_ds_odate", ["o_orderdate"]))
+    session.enable_hyperspace()
+    return session, paths
+
+
+def _queries(session, paths):
+    read = session.read
+
+    def t(name):
+        return read.parquet(paths[name])
+
+    rev = col("l_extendedprice") * (1 - col("l_discount"))
+    return {
+        # Q1: pricing summary report (dates are day numbers).
+        "t01_pricing_summary": t("lineitem")
+            .filter(col("l_shipdate") <= 2300)
+            .group_by("l_returnflag", "l_linestatus")
+            .agg(sum_qty=("l_quantity", "sum"),
+                 sum_base_price=("l_extendedprice", "sum"),
+                 sum_disc_price=(rev, "sum"),
+                 sum_charge=(rev * (1 + col("l_tax")), "sum"),
+                 avg_qty=("l_quantity", "mean"),
+                 avg_price=("l_extendedprice", "mean"),
+                 count_order=("", "count_all"))
+            .sort("l_returnflag", "l_linestatus"),
+        # Q2 (adapted: min-cost scalar subquery dropped): suppliers for
+        # size-15 parts in EUROPE, cheapest first.
+        "t02_min_cost_supplier": t("part")
+            .filter(col("p_size") == 15)
+            .join(t("partsupp"), col("p_partkey") == col("ps_partkey"))
+            .join(t("supplier"), col("ps_suppkey") == col("s_suppkey"))
+            .join(t("nation"), col("s_nationkey") == col("n_nationkey"))
+            .join(t("region"), col("n_regionkey") == col("r_regionkey"))
+            .filter(col("r_name") == "EUROPE")
+            .select("s_name", "p_partkey", "ps_supplycost")
+            .sort("ps_supplycost").limit(10),
+        # Q3: shipping priority.
+        "t03_shipping_priority": t("customer")
+            .filter(col("c_mktsegment") == "BUILDING")
+            .join(t("orders"), col("c_custkey") == col("o_custkey"))
+            .filter(col("o_orderdate") < 1200)
+            .join(t("lineitem"), col("o_orderkey") == col("l_orderkey"))
+            .filter(col("l_shipdate") > 1200)
+            .group_by("o_orderkey", "o_orderdate", "o_shippriority")
+            .agg(revenue=(rev, "sum"))
+            .sort(("revenue", False), "o_orderdate").limit(10),
+        # Q4: order priority checking — EXISTS as a SEMI join; the
+        # commit<receipt comparison is a column-column filter.
+        "t04_order_priority": t("orders")
+            .filter((col("o_orderdate") >= 800) & (col("o_orderdate") < 1100))
+            .join(t("lineitem")
+                  .filter(col("l_commitdate") < col("l_receiptdate")),
+                  col("o_orderkey") == col("l_orderkey"), how="semi")
+            .group_by("o_orderpriority").count("order_count")
+            .sort("o_orderpriority"),
+        # Q5: local supplier volume — the c_nationkey == s_nationkey leg
+        # rides the same CNF join condition.
+        "t05_local_supplier_volume": t("customer")
+            .join(t("orders"), col("c_custkey") == col("o_custkey"))
+            .filter((col("o_orderdate") >= 400) & (col("o_orderdate") < 1200))
+            .join(t("lineitem"), col("o_orderkey") == col("l_orderkey"))
+            .join(t("supplier"),
+                  (col("l_suppkey") == col("s_suppkey"))
+                  & (col("c_nationkey") == col("s_nationkey")))
+            .join(t("nation"), col("s_nationkey") == col("n_nationkey"))
+            .join(t("region"), col("n_regionkey") == col("r_regionkey"))
+            .filter(col("r_name") == "ASIA")
+            .group_by("n_name").agg(revenue=(rev, "sum"))
+            .sort(("revenue", False)),
+        # Q6: forecasting revenue change.
+        "t06_forecast_revenue": t("lineitem")
+            .filter((col("l_shipdate") >= 400) & (col("l_shipdate") < 800)
+                    & (col("l_discount") >= 0.03)
+                    & (col("l_discount") <= 0.07)
+                    & (col("l_quantity") < 24))
+            .agg(revenue=(col("l_extendedprice") * col("l_discount"), "sum")),
+        # Q9 (adapted: LIKE '%green%' -> p_name prefix set): product-type
+        # profit, partsupp joined on the composite (partkey, suppkey).
+        "t09_product_profit": t("part")
+            .filter(col("p_name").isin(
+                [f"part green {i}" for i in range(0, N_PART, 3)]))
+            .join(t("lineitem"), col("p_partkey") == col("l_partkey"))
+            .join(t("partsupp"),
+                  (col("l_partkey") == col("ps_partkey"))
+                  & (col("l_suppkey") == col("ps_suppkey")))
+            .join(t("supplier"), col("l_suppkey") == col("s_suppkey"))
+            .group_by("s_nationkey")
+            .agg(profit=(rev - col("ps_supplycost") * col("l_quantity"),
+                         "sum"))
+            .sort("s_nationkey"),
+        # Q10: returned-item reporting.
+        "t10_returned_items": t("customer")
+            .join(t("orders"), col("c_custkey") == col("o_custkey"))
+            .filter((col("o_orderdate") >= 600) & (col("o_orderdate") < 900))
+            .join(t("lineitem").filter(col("l_returnflag") == "R"),
+                  col("o_orderkey") == col("l_orderkey"))
+            .join(t("nation"), col("c_nationkey") == col("n_nationkey"))
+            .group_by("c_custkey", "c_name", "c_acctbal", "n_name")
+            .agg(revenue=(rev, "sum"))
+            .sort(("revenue", False)).limit(20),
+        # Q11 (adapted: the group-value threshold is a constant, not a
+        # scalar subquery): important stock identification.
+        "t11_important_stock": t("partsupp")
+            .join(t("supplier"), col("ps_suppkey") == col("s_suppkey"))
+            .join(t("nation").filter(col("n_name") == "GERMANY"),
+                  col("s_nationkey") == col("n_nationkey"))
+            .group_by("ps_partkey")
+            .agg(value=(col("ps_supplycost") * col("ps_availqty"), "sum"))
+            .filter(col("value") > 2000.0)
+            .sort(("value", False)),
+        # Q12 (adapted: the CASE priority split becomes a plain count).
+        "t12_shipping_modes": t("orders")
+            .join(t("lineitem")
+                  .filter(col("l_shipmode").isin(["MAIL", "SHIP"])
+                          & (col("l_commitdate") < col("l_receiptdate"))
+                          & (col("l_shipdate") < col("l_commitdate"))
+                          & (col("l_receiptdate") >= 400)
+                          & (col("l_receiptdate") < 1200)),
+                  col("o_orderkey") == col("l_orderkey"))
+            .group_by("l_shipmode").count("line_count").sort("l_shipmode"),
+        # Q13: customer order-count distribution — LEFT OUTER join, then a
+        # second aggregation over the first's output.
+        "t13_customer_distribution": t("customer")
+            .join(t("orders"), col("c_custkey") == col("o_custkey"),
+                  how="left")
+            .group_by("c_custkey").agg(c_count=("o_orderkey", "count"))
+            .group_by("c_count").count("custdist")
+            .sort(("custdist", False), ("c_count", False)),
+        # Q14 (adapted: the CASE promo split becomes a ratio of aggregate
+        # outputs via a computed projection over the Aggregate).
+        "t14_promo_effect": t("lineitem")
+            .filter((col("l_shipdate") >= 1000) & (col("l_shipdate") < 1100))
+            .join(t("part"), col("l_partkey") == col("p_partkey"))
+            .group_by("p_type").agg(revenue=(rev, "sum"),
+                                    n=("", "count_all"))
+            .select("p_type", avg_item_revenue=col("revenue") / col("n"))
+            .sort("p_type"),
+        # Q15 (adapted: max-revenue scalar subquery -> top-1 by sort): the
+        # top supplier by shipped revenue, joined back to supplier.
+        "t15_top_supplier": t("lineitem")
+            .filter((col("l_shipdate") >= 1200) & (col("l_shipdate") < 1500))
+            .group_by("l_suppkey").agg(total_revenue=(rev, "sum"))
+            .sort(("total_revenue", False)).limit(1)
+            .join(t("supplier"), col("l_suppkey") == col("s_suppkey"))
+            .select("s_suppkey", "s_name", "total_revenue"),
+        # Q16 (adapted: LIKE excluded-type -> brand inequality; the
+        # complaints NOT EXISTS is an ANTI join against negative-balance
+        # suppliers).
+        "t16_parts_supplier_counts": t("partsupp")
+            .join(t("part")
+                  .filter(~(col("p_brand") == "Brand#00")
+                          & col("p_size").isin([5, 15, 25, 35, 45])),
+                  col("ps_partkey") == col("p_partkey"))
+            .join(t("supplier").filter(col("s_acctbal") < 0.0),
+                  col("ps_suppkey") == col("s_suppkey"), how="anti")
+            .group_by("p_brand", "p_type", "p_size")
+            .agg(supplier_cnt=("ps_suppkey", "count_distinct"))
+            .sort(("supplier_cnt", False), "p_brand", "p_type", "p_size"),
+        # Q17 (adapted: the avg-quantity scalar subquery is a constant;
+        # yearly average via a computed projection).
+        "t17_small_quantity_revenue": t("lineitem")
+            .join(t("part").filter((col("p_brand") == "Brand#11")
+                                   & (col("p_container") == "SM CASE")),
+                  col("l_partkey") == col("p_partkey"))
+            .filter(col("l_quantity") < 10)
+            .agg(total=("l_extendedprice", "sum"))
+            .select(avg_yearly=col("total") / 7.0),
+        # Q18: large-volume customers — HAVING sum(qty) > K feeds the join.
+        "t18_large_orders": t("lineitem")
+            .group_by("l_orderkey").agg(qty=("l_quantity", "sum"))
+            .filter(col("qty") > 120)
+            .join(t("orders"), col("l_orderkey") == col("o_orderkey"))
+            .join(t("customer"), col("o_custkey") == col("c_custkey"))
+            .select("c_name", "c_custkey", "o_orderkey", "o_orderdate",
+                    "o_totalprice", "qty")
+            .sort(("o_totalprice", False), "o_orderkey").limit(100),
+        # Q19: discounted revenue over OR-of-conjunct groups.
+        "t19_discounted_revenue": t("lineitem")
+            .join(t("part"), col("l_partkey") == col("p_partkey"))
+            .filter(((col("p_container") == "SM CASE")
+                     & (col("l_quantity") >= 1) & (col("l_quantity") <= 11)
+                     & (col("p_size") <= 5))
+                    | ((col("p_container") == "MED BOX")
+                       & (col("l_quantity") >= 10)
+                       & (col("l_quantity") <= 20)
+                       & (col("p_size") <= 10))
+                    | ((col("p_container") == "LG JAR")
+                       & (col("l_quantity") >= 20)
+                       & (col("l_quantity") <= 30)
+                       & (col("p_size") <= 15)))
+            .agg(revenue=(rev, "sum")),
+        # Q20 (adapted: the availability scalar subquery is dropped):
+        # suppliers with green parts on offer, as a SEMI-join chain.
+        "t20_potential_promotions": t("supplier")
+            .join(t("partsupp")
+                  .join(t("part").filter(col("p_name").isin(
+                      [f"part green {i}" for i in range(0, N_PART, 3)])),
+                      col("ps_partkey") == col("p_partkey"), how="semi"),
+                  col("s_suppkey") == col("ps_suppkey"), how="semi")
+            .select("s_suppkey", "s_name").sort("s_suppkey"),
+        # Q22 (adapted: substring(c_phone) -> c_phonecode): customers with
+        # a positive balance and NO orders — ANTI join.
+        "t22_global_sales_opportunity": t("customer")
+            .filter(col("c_phonecode").isin([13, 31, 23, 29, 30, 18, 17])
+                    & (col("c_acctbal") > 0.0))
+            .join(t("orders"), col("c_custkey") == col("o_custkey"),
+                  how="anti")
+            .group_by("c_phonecode")
+            .agg(numcust=("", "count_all"), totacctbal=("c_acctbal", "sum"))
+            .sort("c_phonecode"),
+    }
+
+
+TPCH_NAMES = sorted(
+    ["t01", "t02", "t03", "t04", "t05", "t06", "t09", "t10", "t11", "t12",
+     "t13", "t14", "t15", "t16", "t17", "t18", "t19", "t20", "t22"])
+
+
+def _query_by_prefix(queries, prefix):
+    matches = [k for k in queries if k.startswith(prefix)]
+    assert len(matches) == 1, f"{prefix}: {matches}"
+    return matches[0]
+
+
+@pytest.mark.parametrize("prefix", TPCH_NAMES)
+def test_tpch_plan_stability(catalog, prefix):
+    session, paths = catalog
+    queries = _queries(session, paths)
+    name = _query_by_prefix(queries, prefix)
+    plan = queries[name].optimized_plan()
+    simplified = _simplify(plan.tree_string(), paths)
+
+    approved_path = os.path.join(APPROVED_DIR, name, "simplified.txt")
+    if GENERATE:
+        os.makedirs(os.path.dirname(approved_path), exist_ok=True)
+        with open(approved_path, "w", encoding="utf-8") as f:
+            f.write(simplified)
+        return
+    assert os.path.isfile(approved_path), (
+        f"No approved plan for {name}; run with HS_GENERATE_GOLDEN_FILES=1")
+    with open(approved_path, "r", encoding="utf-8") as f:
+        approved = f.read()
+    assert simplified == approved, (
+        f"Plan for {name} changed.\n--- approved ---\n{approved}\n"
+        f"--- current ---\n{simplified}\n"
+        f"If intentional, regenerate with HS_GENERATE_GOLDEN_FILES=1")
+
+
+def _canonical(table: pa.Table):
+    cols = sorted(table.column_names)
+
+    def norm(v):
+        if isinstance(v, float):
+            return "nan" if math.isnan(v) else float(f"{v:.9g}")
+        return v
+
+    rows = sorted((tuple(norm(v) for v in r.values())
+                   for r in table.select(cols).to_pylist()), key=repr)
+    return cols, rows
+
+
+@pytest.mark.parametrize("prefix", TPCH_NAMES)
+def test_tpch_answers_match_unindexed(catalog, prefix):
+    """checkAnswer's role: rules on vs off must agree for every query.
+    Top-N queries are compared AFTER canonicalization of the limited
+    result only when the sort key has no ties at the cut (the corpus
+    sorts are tie-free by construction: float revenue keys)."""
+    session, paths = catalog
+    queries = _queries(session, paths)
+    name = _query_by_prefix(queries, prefix)
+    got = _canonical(queries[name].collect())
+    session.disable_hyperspace()
+    try:
+        want = _canonical(queries[name].collect())
+    finally:
+        session.enable_hyperspace()
+    assert got == want, f"{name}: indexed answer diverged"
+
+
+def test_tpch_rewrites_fire_where_expected(catalog):
+    """The headline queries must actually use indexes (not just produce
+    stable plans): every query touching an indexed join key or the
+    DS-sketched l_shipdate should have at least one rewritten scan."""
+    session, paths = catalog
+    queries = _queries(session, paths)
+    # t01 keeps its full scan by design (the <= 2300 range touches every
+    # file and l_shipdate is not any covering index's first column) — the
+    # reference's FAQ documents exactly this "no improvement" case.
+    # t13/t20/t22 are outer/semi/anti-rooted: the JOIN rewrite is scoped to
+    # inner joins (JoinIndexRule.scala:134-140) and no eligible filter
+    # pattern remains.
+    expect_rewrite = {
+        "t02_min_cost_supplier", "t03_shipping_priority",
+        "t04_order_priority", "t05_local_supplier_volume",
+        "t06_forecast_revenue", "t09_product_profit",
+        "t10_returned_items", "t11_important_stock",
+        "t12_shipping_modes", "t14_promo_effect", "t15_top_supplier",
+        "t16_parts_supplier_counts", "t17_small_quantity_revenue",
+        "t18_large_orders", "t19_discounted_revenue",
+    }
+    for name in expect_rewrite:
+        plan = queries[name].optimized_plan()
+        used = [s for s in plan.leaf_relations()
+                if s.relation.index_scan_of or s.relation.data_skipping_of]
+        assert used, f"{name}: expected an index rewrite\n{plan.tree_string()}"
