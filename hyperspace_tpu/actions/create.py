@@ -399,7 +399,7 @@ class _BucketSpill:
 
         import pyarrow.parquet as pq
 
-        from hyperspace_tpu.ops.hash import bucket_ids
+        from hyperspace_tpu.ops.hash import bucket_ids, bucket_ids_np
         from hyperspace_tpu.ops.sort import _pad_rows
 
         _t0 = _time.perf_counter()
@@ -414,14 +414,23 @@ class _BucketSpill:
         n = table.num_rows
         num_buckets = self.ZORDER_SPILL_PARTITIONS \
             if self.resolved.layout == "zorder" else self.action.num_buckets
-        capacity = max(1, int(self.action.conf.device_batch_rows))
-        capacity = -(-max(n, 1) // capacity) * capacity
-        word_cols = [
-            _pad_rows(np.asarray(columnar.to_hash_words(table.column(c))),
-                      capacity)
-            for c in self.resolved.indexed_columns
-        ]
-        buckets = np.asarray(bucket_ids(word_cols, num_buckets))[:n]
+        if n < self.action.conf.device_build_min_rows:
+            # Same routing as the monolithic build: the per-chunk device
+            # round trip (transfer + possible compile, per chunk!) over a
+            # remote tunnel dwarfs a host hash pass; bucket_ids_np is the
+            # bit-identical mirror, so layout cannot depend on the route.
+            word_cols = [np.asarray(columnar.to_hash_words(table.column(c)))
+                         for c in self.resolved.indexed_columns]
+            buckets = bucket_ids_np(word_cols, num_buckets)
+        else:
+            capacity = max(1, int(self.action.conf.device_batch_rows))
+            capacity = -(-max(n, 1) // capacity) * capacity
+            word_cols = [
+                _pad_rows(np.asarray(columnar.to_hash_words(table.column(c))),
+                          capacity)
+                for c in self.resolved.indexed_columns
+            ]
+            buckets = np.asarray(bucket_ids(word_cols, num_buckets))[:n]
         order = np.argsort(buckets, kind="stable")
         sorted_buckets = buckets[order]
         routed = table.take(pa.array(order))
@@ -440,11 +449,13 @@ class _BucketSpill:
 
     def finish(self) -> None:
         import shutil
+        import time as _time
 
         import pyarrow.parquet as pq
 
         from hyperspace_tpu.io.parquet import bucket_file_name
 
+        _t0 = _time.perf_counter()
         action = self.action
         resolved = self.resolved
         version = action.data_manager.get_next_version()
@@ -488,7 +499,10 @@ class _BucketSpill:
         finally:
             shutil.rmtree(self._dir, ignore_errors=True)
             self._dir = None
+        action._phase("spill_finish_s", _time.perf_counter() - _t0)
+        _t0 = _time.perf_counter()
         action._write_index_file_sketch(out_dir, resolved)
+        action._phase("sketch_s", _time.perf_counter() - _t0)
         action._written_version = version
         action._index_schema = {name: str(t) for name, t in
                                 zip(self._schema.names, self._schema.types)}

@@ -95,9 +95,11 @@ class HyperspaceConf:
             os.environ.get("HS_DEVICE_BATCH_ROWS", 1 << 20)))
     # Below this row count a filter evaluates host-side (arrow compute): a
     # device round trip costs fixed transfer latency (~100 ms over a remote
-    # tunnel) that a vectorized host pass over a small batch never repays.
-    # Raise toward 0 on locally attached chips with resident data.
-    device_filter_min_rows: int = 1 << 22
+    # tunnel) plus ~8 B/row/column of upload at the tunnel's few-MB/s
+    # throughput, which a vectorized host pass never repays — measured at
+    # 6M rows the tunnel upload alone exceeds the whole host pass by >100x.
+    # Lower toward 0 on locally attached chips with resident data.
+    device_filter_min_rows: int = 1 << 26
     # At or above this row count a device-eligible filter shards its
     # columns over ALL visible devices (1-D mesh) instead of evaluating on
     # one chip: the predicate is elementwise, so XLA partitions it with
@@ -112,8 +114,11 @@ class HyperspaceConf:
     index_file_compression: str = dataclasses.field(
         default_factory=lambda: _index_compression_default())
     # Same cost model for joins: below this (max-side) row count the
-    # sorted-merge join runs in numpy on host.
-    device_join_min_rows: int = 1 << 22
+    # sorted-merge join runs in numpy on host.  Measured on the remote
+    # tunnel at 6M x 1.5M int64 keys: host 7.5 s, device 14.9 s warm
+    # (99 s cold) — the transfer dominates, so the tunnel default keeps
+    # joins host-side; lower on locally attached chips.
+    device_join_min_rows: int = 1 << 26
     # Same cost model for the BUILD's fused hash+lexsort kernel: below
     # this row count the bit-identical host mirror runs instead (the
     # round-2 bench regression was this kernel's transfer + compile

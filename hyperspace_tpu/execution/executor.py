@@ -218,7 +218,11 @@ class Executor:
             return None
         for k in plan.group_by:
             t = table.schema.field(k).type
-            if not (pa.types.is_integer(t) or pa.types.is_boolean(t)):
+            # uint64 excluded: the device domain is int64 (a >= 2^63 value
+            # would fail the safe cast; smaller ones would flip the output
+            # type with row count).  Narrower unsigned types fit int64.
+            if not (pa.types.is_integer(t) or pa.types.is_boolean(t)) \
+                    or pa.types.is_uint64(t):
                 return None
             if table.column(k).null_count > 0:
                 return None
@@ -232,11 +236,13 @@ class Executor:
                     return None
                 continue
             t = table.schema.field(agg_inputs[i]).type
-            # Strictly int/float: temporal columns would crash min/max at
-            # the cast back (and "sum" over dates must raise, as the host
-            # path does); bool sums promote to uint64 on host but int64 on
+            # Strictly int/float (uint64 excluded — device domain is
+            # int64): temporal columns would crash min/max at the cast
+            # back (and "sum" over dates must raise, as the host path
+            # does); bool sums promote to uint64 on host but int64 on
             # device — excluded rather than special-cased.
             if not (pa.types.is_integer(t) or pa.types.is_floating(t)) \
+                    or pa.types.is_uint64(t) \
                     or table.column(agg_inputs[i]).null_count > 0:
                 return None
 
@@ -258,7 +264,9 @@ class Executor:
             "groups": int(len(first_rows)),
             "rows": table.num_rows,
         })
-        taken = table.take(pa.array(first_rows))
+        # Gather only the key columns (the full-width table would random-
+        # gather every unused value column too).
+        taken = table.select(list(plan.group_by)).take(pa.array(first_rows))
         data = {k: taken.column(k) for k in plan.group_by}
         for (func, _in, out_name), res, i in zip(
                 plan.aggs, results, range(len(results))):
@@ -343,8 +351,16 @@ class Executor:
         # three-valued-logic semantics.
         # Small batches stay on host: the device round trip's fixed latency
         # dwarfs a vectorized arrow pass (conf device_filter_min_rows).
+        # With >1 device the MESH threshold also opens the device path —
+        # otherwise raising device_filter_min_rows above mesh_filter_min_rows
+        # would make the sharded path unreachable in between.
+        import jax
+
+        min_rows = self.session.conf.device_filter_min_rows
+        if len(jax.local_devices()) > 1:
+            min_rows = min(min_rows, self.session.conf.mesh_filter_min_rows)
         numeric = bool(cols) \
-            and table.num_rows >= self.session.conf.device_filter_min_rows \
+            and table.num_rows >= min_rows \
             and all(
                 columnar.is_numeric_type(table.schema.field(c).type)
                 and table.column(c).null_count == 0
