@@ -10,7 +10,11 @@ any Arrow implementation, no Python required on the client.
 """
 
 from hyperspace_tpu.interop.query import dataset_from_spec, expr_from_json
-from hyperspace_tpu.interop.server import QueryServer, request_query
+from hyperspace_tpu.interop.server import (
+    QueryClient,
+    QueryServer,
+    request_query,
+)
 
-__all__ = ["dataset_from_spec", "expr_from_json", "QueryServer",
-           "request_query"]
+__all__ = ["dataset_from_spec", "expr_from_json", "QueryClient",
+           "QueryServer", "request_query"]

@@ -9,9 +9,13 @@ socket plus an Arrow library — no HTTP/gRPC dependency):
                      STREAM of the result (self-delimiting), or
                      ``ERR <message>\\n`` and the connection closes
 
-One request per connection.  The server executes against ONE session, so
-enabled indexes and conf govern rewrites exactly as for local use — this
-is the parity surface for the reference's py4j bindings / .NET sample
+Connections are PIPELINED: after a successful response the client may send
+the next request on the same connection (an error closes it, keeping
+framing unambiguous).  Clients execute CONCURRENTLY — only the optimizer
+step serializes (session-level state); a slow query does not stall other
+connections.  The server executes against ONE session, so enabled indexes
+and conf govern rewrites exactly as for local use — this is the parity
+surface for the reference's py4j bindings / .NET sample
 (python/hyperspace/hyperspace.py:9, examples/csharp/Program.cs): a JVM or
 .NET client sends the JSON spec and reads the stream with its own Arrow
 implementation.
@@ -37,36 +41,46 @@ class _Handler(socketserver.StreamRequestHandler):
     timeout = REQUEST_TIMEOUT_S  # StreamRequestHandler applies it pre-read
 
     def handle(self) -> None:
+        # Pipelined: serve requests until EOF, idle timeout, or an error
+        # response (errors close the connection so framing stays
+        # unambiguous for simple clients).
+        while self._serve_one():
+            pass
+
+    def _serve_one(self) -> bool:
         try:
             line = self.rfile.readline(MAX_REQUEST_BYTES + 1)
         except (TimeoutError, OSError):
-            return
+            return False
+        if not line:
+            return False  # clean EOF between requests
         try:
-            if len(line) > MAX_REQUEST_BYTES or (line and not line.endswith(b"\n")):
+            if len(line) > MAX_REQUEST_BYTES or not line.endswith(b"\n"):
                 raise ValueError(
                     f"request exceeds {MAX_REQUEST_BYTES} bytes or is not "
                     f"newline-terminated")
             spec = json.loads(line.decode("utf-8"))
             from hyperspace_tpu.interop.query import dataset_from_spec
 
-            # One query at a time: collect() mutates session-level state
-            # (last_execution_stats), so concurrent handler threads must
-            # not interleave executions against the shared session.
-            with self.server.exec_lock:
-                table = dataset_from_spec(self.server.session, spec).collect()
-        except Exception as exc:  # -> wire error, connection stays sane
+            # Concurrent execution is safe: the session serializes its
+            # OPTIMIZE step internally (shared entry tags / schema memo);
+            # the executor itself only reads shared state.
+            table = dataset_from_spec(self.server.session, spec).collect()
+        except Exception as exc:  # -> wire error, connection closes
             msg = str(exc).replace("\n", " ")[:500]
             try:
                 self.wfile.write(f"ERR {msg}\n".encode("utf-8"))
             except OSError:
                 pass
-            return
+            return False
         try:
             self.wfile.write(b"OK\n")
             with pa.ipc.new_stream(self.wfile, table.schema) as writer:
                 writer.write_table(table)
+            self.wfile.flush()
+            return True
         except OSError:
-            pass  # client hung up mid-response; nothing to clean up
+            return False  # client hung up mid-response
 
 
 def _is_loopback(host: str) -> bool:
@@ -104,7 +118,6 @@ class QueryServer:
 
         self._server = _Server((host, port), _Handler)
         self._server.session = session
-        self._server.exec_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -140,11 +153,52 @@ def request_query(address: Tuple[str, int],
     """Reference client (tests / Python callers): send ``spec``, return the
     result table.  Non-Python clients reimplement these ~10 lines with
     their socket + Arrow APIs."""
-    with socket.create_connection(address) as sock:
-        sock.sendall(json.dumps(spec).encode("utf-8") + b"\n")
-        f = sock.makefile("rb")
-        status = f.readline().decode("utf-8").rstrip("\n")
+    with QueryClient(address) as client:
+        return client.query(spec)
+
+
+class QueryClient:
+    """Persistent pipelined connection: successful ``query()`` calls ride
+    one socket (the server answers each in order).  After an error
+    response, a transport failure, or the server's idle timeout
+    (REQUEST_TIMEOUT_S between requests) the server closes the connection
+    — the client marks itself broken and subsequent calls raise
+    ``ConnectionError`` asking for a fresh client, rather than failing
+    with a confusing empty-status error on the dead socket."""
+
+    def __init__(self, address: Tuple[str, int]) -> None:
+        self._sock = socket.create_connection(address)
+        self._f = self._sock.makefile("rb")
+        self._broken = False
+
+    def query(self, spec: Dict[str, Any]) -> pa.Table:
+        if self._broken:
+            raise ConnectionError(
+                "connection closed by an earlier error or timeout; open a "
+                "new QueryClient")
+        try:
+            self._sock.sendall(json.dumps(spec).encode("utf-8") + b"\n")
+            status = self._f.readline().decode("utf-8").rstrip("\n")
+        except OSError as exc:
+            self._broken = True
+            raise ConnectionError(f"connection lost: {exc}") from exc
         if not status.startswith("OK"):
+            # ERR (server closes) or EOF (idle timeout / server gone).
+            self._broken = True
+            if not status:
+                raise ConnectionError(
+                    "server closed the connection (idle timeout or "
+                    "shutdown); open a new QueryClient")
             raise RuntimeError(f"Query failed: {status}")
-        with pa.ipc.open_stream(f) as reader:
+        with pa.ipc.open_stream(self._f) as reader:
             return reader.read_all()
+
+    def close(self) -> None:
+        self._f.close()
+        self._sock.close()
+
+    def __enter__(self) -> "QueryClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

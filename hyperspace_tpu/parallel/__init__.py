@@ -13,12 +13,24 @@ from hyperspace_tpu.parallel.join import (
     copartitioned_join_ragged,
 )
 from hyperspace_tpu.parallel.mesh import SHARD_AXIS, build_mesh
+from hyperspace_tpu.parallel.multihost import (
+    DCN_AXIS,
+    ICI_AXIS,
+    build_mesh_2d,
+    hierarchical_bucket_shuffle,
+    initialize_distributed,
+)
 from hyperspace_tpu.parallel.shuffle import ShuffleResult, bucket_shuffle
 
 __all__ = [
     "SHARD_AXIS",
+    "DCN_AXIS",
+    "ICI_AXIS",
     "build_mesh",
+    "build_mesh_2d",
     "bucket_shuffle",
+    "hierarchical_bucket_shuffle",
+    "initialize_distributed",
     "ShuffleResult",
     "distributed_bucket_sort_permutation",
     "eval_predicate_on_mesh",

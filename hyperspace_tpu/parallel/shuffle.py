@@ -61,56 +61,77 @@ class ShuffleResult(NamedTuple):
     capacity: int
 
 
-def _route_kernel(num_buckets: int, num_devices: int, capacity: int,
-                  n_key_cols: int, pallas: bool,
-                  hash_words, order_words, row_words, payload, valid):
-    """Per-device body run under shard_map.  All inputs are the LOCAL shard:
-    hash_words (L, 2K), order_words (L, 2K), row_words (L, 2), payload
-    (L, E), valid (L,) int32."""
-    L = hash_words.shape[0]
-    word_cols = tuple(hash_words[:, 2 * k:2 * k + 2] for k in range(n_key_cols))
-    bucket = _bucket_ids_impl(word_cols, num_buckets, pallas)
-    buckets_per_device = -(-num_buckets // num_devices)  # ceil
-    dest = bucket // buckets_per_device
-    dest = jnp.where(valid.astype(bool), dest, num_devices)  # sentinel: drop
-
-    # Stable order by destination; rank within each destination group.
+def scatter_to_buffer(record, dest, n_dest: int, capacity: int):
+    """Pack ``record`` rows into an ``(n_dest * capacity)`` send buffer by
+    destination (the MoE-dispatch pattern: static shapes, overflow COUNTED
+    rather than sent).  ``dest == n_dest`` drops the row (padding).
+    Shared by the flat and hierarchical shuffle kernels — both must pack
+    identically for their outputs to be bit-identical."""
+    n = record.shape[0]
     order = jnp.argsort(dest, stable=True)
     sorted_dest = dest[order]
-    rank = jnp.arange(L, dtype=jnp.int32) - jnp.searchsorted(
+    rank = jnp.arange(n, dtype=jnp.int32) - jnp.searchsorted(
         sorted_dest, sorted_dest, side="left").astype(jnp.int32)
-    in_window = (rank < capacity) & (sorted_dest < num_devices)
-    overflow = jnp.sum((rank >= capacity) & (sorted_dest < num_devices),
+    in_window = (rank < capacity) & (sorted_dest < n_dest)
+    overflow = jnp.sum((rank >= capacity) & (sorted_dest < n_dest),
                        dtype=jnp.int32)
+    slot = jnp.where(in_window, sorted_dest * capacity + rank,
+                     n_dest * capacity)
+    send = jnp.zeros((n_dest * capacity, record.shape[1]), jnp.uint32)
+    send = send.at[slot].set(record[order], mode="drop")
+    return send, overflow
 
-    # Row record: [flag, bucket, row_hi, row_lo, order words..., payload...].
-    record = jnp.concatenate([
+
+def make_row_records(hash_words, order_words, row_words, payload, bucket):
+    """The on-wire row record both kernels route:
+    [flag, bucket, row_hi, row_lo, order words..., payload...]."""
+    L = hash_words.shape[0]
+    return jnp.concatenate([
         jnp.ones((L, 1), jnp.uint32),
         bucket.astype(jnp.uint32)[:, None],
         row_words,
         order_words,
         payload,
-    ], axis=1)[order]
-    slot = jnp.where(in_window, sorted_dest * capacity + rank,
-                     num_devices * capacity)
-    send = jnp.zeros((num_devices * capacity, record.shape[1]), jnp.uint32)
-    send = send.at[slot].set(record, mode="drop")
+    ], axis=1)
 
-    recv = jax.lax.all_to_all(send, SHARD_AXIS, split_axis=0, concat_axis=0,
-                              tiled=True)
 
-    # Sort received rows: valid first, then (bucket, order words).
+def sort_received(recv, n_key_cols: int):
+    """Per-device final order: valid first, then (bucket, order words),
+    with the GLOBAL ROW ID as the final tiebreak — arrival order in the
+    receive buffer depends on the traffic pattern, so without it equal
+    keys would order differently across topologies (flat vs hierarchical
+    shuffle); with it, ties come out in original row order, matching the
+    single-chip kernel's stable sort exactly.  Returns (sorted rows,
+    valid count)."""
     flag = recv[:, 0]
     rbucket = recv[:, 1]
-    keys: List[jnp.ndarray] = []
+    keys: List[jnp.ndarray] = [recv[:, 3], recv[:, 2]]  # row lo, hi
     for k in reversed(range(n_key_cols)):
         keys.append(recv[:, 4 + 2 * k + 1])  # lo
         keys.append(recv[:, 4 + 2 * k])      # hi
     keys.append(rbucket)
     keys.append(jnp.uint32(1) - flag)        # primary: invalid rows last
     perm = jnp.lexsort(tuple(keys))
-    out = recv[perm]
-    count = jnp.sum(flag, dtype=jnp.int32)
+    return recv[perm], jnp.sum(flag, dtype=jnp.int32)
+
+
+def _route_kernel(num_buckets: int, num_devices: int, capacity: int,
+                  n_key_cols: int, pallas: bool,
+                  hash_words, order_words, row_words, payload, valid):
+    """Per-device body run under shard_map.  All inputs are the LOCAL shard:
+    hash_words (L, 2K), order_words (L, 2K), row_words (L, 2), payload
+    (L, E), valid (L,) int32."""
+    word_cols = tuple(hash_words[:, 2 * k:2 * k + 2] for k in range(n_key_cols))
+    bucket = _bucket_ids_impl(word_cols, num_buckets, pallas)
+    buckets_per_device = -(-num_buckets // num_devices)  # ceil
+    dest = bucket // buckets_per_device
+    dest = jnp.where(valid.astype(bool), dest, num_devices)  # sentinel: drop
+    record = make_row_records(hash_words, order_words, row_words, payload,
+                              bucket)
+    send, overflow = scatter_to_buffer(record, dest, num_devices, capacity)
+    recv = jax.lax.all_to_all(send, SHARD_AXIS, split_axis=0, concat_axis=0,
+                              tiled=True)
+    out, count = sort_received(recv, n_key_cols)
     return out, count[None], overflow[None]
 
 
@@ -170,33 +191,10 @@ def bucket_shuffle(
     n_devices = mesh.devices.size
     if n == 0:
         # Zero-row build (empty source): nothing to route.
-        return ShuffleResult(
-            perm=np.empty(0, np.int64),
-            buckets_sorted=np.empty(0, np.int32),
-            device_row_counts=np.zeros(n_devices, np.int32),
-            capacity=0,
-        ), (np.empty((0, payload_words.shape[1]), np.uint32)
-            if payload_words is not None else None)
+        return empty_shuffle_result(n_devices, payload_words)
     n_key_cols = len(hash_words)
-    local = -(-n // n_devices)  # rows per device, ceil
-    if pad_local_to and pad_local_to > 0:
-        quantum = max(1, -(-pad_local_to // n_devices))
-        local = -(-local // quantum) * quantum
-    padded = local * n_devices
-
-    def pad(a: np.ndarray) -> np.ndarray:
-        if a.shape[0] == padded:
-            return a
-        width = ((0, padded - a.shape[0]),) + ((0, 0),) * (a.ndim - 1)
-        return np.pad(a, width)
-
-    hw = pad(np.concatenate([np.asarray(w, np.uint32) for w in hash_words], axis=1))
-    ow = pad(np.concatenate([np.asarray(w, np.uint32) for w in order_words], axis=1))
-    row_ids = np.arange(padded, dtype=np.uint64)
-    rw = split_words64(row_ids)
-    pl = pad(np.asarray(payload_words, np.uint32)) if payload_words is not None \
-        else np.zeros((padded, 0), np.uint32)
-    valid = pad(np.ones(n, dtype=np.int32))
+    hw, ow, rw, pl, valid, local = marshal_shuffle_inputs(
+        hash_words, order_words, payload_words, n_devices, pad_local_to)
 
     if capacity is None:
         capacity = max(16, int(-(-local * slack // n_devices)))
@@ -214,22 +212,69 @@ def bucket_shuffle(
             raise RuntimeError("bucket_shuffle: capacity overflow at maximum")
         capacity = min(local, capacity * 2)
 
-    out = np.asarray(out)          # (D * D*C, record)
     counts = np.asarray(counts).reshape(-1)
-    per_dev = out.reshape(n_devices, n_devices * capacity, -1)
+    perm, buckets_sorted, routed_payload = unpack_shuffle_output(
+        np.asarray(out), counts, n_devices, n_devices * capacity,
+        n_key_cols, payload_words is not None)
+    result = ShuffleResult(perm=perm, buckets_sorted=buckets_sorted,
+                           device_row_counts=counts, capacity=capacity)
+    return result, routed_payload
+
+
+def empty_shuffle_result(n_devices: int, payload_words):
+    return ShuffleResult(
+        perm=np.empty(0, np.int64),
+        buckets_sorted=np.empty(0, np.int32),
+        device_row_counts=np.zeros(n_devices, np.int32),
+        capacity=0,
+    ), (np.empty((0, payload_words.shape[1]), np.uint32)
+        if payload_words is not None else None)
+
+
+def marshal_shuffle_inputs(hash_words, order_words, payload_words,
+                           n_devices: int, pad_local_to: int):
+    """Host-side input marshalling shared by the flat and hierarchical
+    shuffles: concatenated uint32 word planes, global row-id words, the
+    padded validity mask, and the per-device shard length."""
+    n = hash_words[0].shape[0]
+    local = -(-n // n_devices)  # rows per device, ceil
+    if pad_local_to and pad_local_to > 0:
+        quantum = max(1, -(-pad_local_to // n_devices))
+        local = -(-local // quantum) * quantum
+    padded = local * n_devices
+
+    def pad(a: np.ndarray) -> np.ndarray:
+        if a.shape[0] == padded:
+            return a
+        width = ((0, padded - a.shape[0]),) + ((0, 0),) * (a.ndim - 1)
+        return np.pad(a, width)
+
+    hw = pad(np.concatenate([np.asarray(w, np.uint32)
+                             for w in hash_words], axis=1))
+    ow = pad(np.concatenate([np.asarray(w, np.uint32)
+                             for w in order_words], axis=1))
+    rw = split_words64(np.arange(padded, dtype=np.uint64))
+    pl = pad(np.asarray(payload_words, np.uint32)) \
+        if payload_words is not None else np.zeros((padded, 0), np.uint32)
+    valid = pad(np.ones(n, dtype=np.int32))
+    return hw, ow, rw, pl, valid, local
+
+
+def unpack_shuffle_output(out, counts, n_devices: int, rows_per_device: int,
+                          n_key_cols: int, has_payload: bool):
+    """Host-side output unpacking shared by both shuffles: per-device
+    valid prefixes concatenate into (perm, buckets_sorted, payload)."""
+    per_dev = out.reshape(n_devices, rows_per_device, -1)
     perm_parts, bucket_parts, payload_parts = [], [], []
     for d in range(n_devices):
         c = int(counts[d])
         rows = per_dev[d, :c]
         perm_parts.append(join_words64(rows[:, 2], rows[:, 3]).astype(np.int64))
         bucket_parts.append(rows[:, 1].astype(np.int32))
-        if payload_words is not None:
+        if has_payload:
             payload_parts.append(rows[:, 4 + 2 * n_key_cols:])
     perm = np.concatenate(perm_parts) if perm_parts else np.empty(0, np.int64)
     buckets_sorted = np.concatenate(bucket_parts) if bucket_parts else \
         np.empty(0, np.int32)
-    routed_payload = (np.concatenate(payload_parts)
-                      if payload_words is not None else None)
-    result = ShuffleResult(perm=perm, buckets_sorted=buckets_sorted,
-                           device_row_counts=counts, capacity=capacity)
-    return result, routed_payload
+    payload = np.concatenate(payload_parts) if has_payload else None
+    return perm, buckets_sorted, payload

@@ -91,9 +91,27 @@ class HyperspaceSession:
         # sees one snapshot, so memoizing there is safe; across queries it
         # would go stale (overwrites can change the schema mid-session).
         self._lake_schema_memo: Optional[Dict[object, Dict[str, str]]] = None
-        # Physical stats of the most recent Dataset.collect() on this
-        # session (join strategies, scan file counts) — see Executor.stats.
-        self.last_execution_stats: Optional[Dict[str, list]] = None
+        # optimize() mutates shared state (the lake-schema memo and the
+        # cached IndexLogEntry tags it clears per pass), so concurrent
+        # queries — e.g. interop server threads — serialize the OPTIMIZE
+        # step only; execution runs outside the lock.
+        import threading
+
+        self._optimize_lock = threading.RLock()
+        # Physical stats of the most recent Dataset.collect() — THREAD
+        # LOCAL so a server thread's query can never overwrite the stats a
+        # local caller reads right after its own collect()
+        # (see Executor.stats; the property pair below).
+        self._exec_stats = threading.local()
+        self.last_execution_stats = None
+
+    @property
+    def last_execution_stats(self) -> Optional[Dict[str, list]]:
+        return getattr(self._exec_stats, "value", None)
+
+    @last_execution_stats.setter
+    def last_execution_stats(self, value: Optional[Dict[str, list]]) -> None:
+        self._exec_stats.value = value
 
     # -- plumbing -----------------------------------------------------------
     @property
@@ -183,6 +201,10 @@ class HyperspaceSession:
         Catalyst's ColumnPruning, so minimal per-side column requirements are
         a precondition the engine must establish itself (plan/pruning.py); it
         also enables scan-level column pushdown for the non-indexed path."""
+        with self._optimize_lock:
+            return self._optimize_locked(plan)
+
+    def _optimize_locked(self, plan: LogicalPlan) -> LogicalPlan:
         from hyperspace_tpu.plan.pruning import prune_columns
 
         self._lake_schema_memo = {}

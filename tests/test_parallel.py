@@ -409,3 +409,73 @@ class TestMeshBucketedJoin:
         # 8 buckets per side; each executed once (no duplicate scans).
         index_scans = [sc for sc in stats["scans"] if sc["is_index"]]
         assert len(index_scans) == 16, stats["scans"]
+
+
+class TestHierarchicalShuffle:
+    """Two-stage (DCN then ICI) shuffle over a 2-axis mesh — must be
+    bit-identical to the flat 1-axis shuffle on the same devices."""
+
+    @pytest.mark.parametrize("shape", [(2, 4), (4, 2), (8, 1), (1, 8)])
+    def test_matches_flat_shuffle(self, mesh, shape):
+        from hyperspace_tpu.parallel import (
+            build_mesh_2d,
+            hierarchical_bucket_shuffle,
+        )
+
+        rng = np.random.default_rng(5)
+        n = 512
+        keys = pa.array(rng.integers(-1000, 1000, n), type=pa.int64())
+        hw = [np.asarray(columnar.to_hash_words(keys))]
+        ow = [np.asarray(columnar.to_order_words(keys))]
+        payload = rng.integers(0, 2**32, (n, 3), dtype=np.uint32)
+        flat, flat_pl = bucket_shuffle(hw, ow, 16, mesh,
+                                       payload_words=payload)
+        mesh2d = build_mesh_2d(shape[0], shape[1])
+        hier, hier_pl = hierarchical_bucket_shuffle(hw, ow, 16, mesh2d,
+                                                    payload_words=payload)
+        np.testing.assert_array_equal(flat.perm, hier.perm)
+        np.testing.assert_array_equal(flat.buckets_sorted,
+                                      hier.buckets_sorted)
+        np.testing.assert_array_equal(flat.device_row_counts,
+                                      hier.device_row_counts)
+        np.testing.assert_array_equal(flat_pl, hier_pl)
+
+    def test_overflow_retry_with_skew(self):
+        """Every row hashes to ONE bucket: both stage buffers overflow at
+        the balanced estimate and must retry to completion."""
+        from hyperspace_tpu.parallel import (
+            build_mesh_2d,
+            hierarchical_bucket_shuffle,
+        )
+
+        n = 256
+        keys = pa.array(np.full(n, 42), type=pa.int64())
+        hw = [np.asarray(columnar.to_hash_words(keys))]
+        ow = [np.asarray(columnar.to_order_words(keys))]
+        mesh2d = build_mesh_2d(2, 4)
+        result, _ = hierarchical_bucket_shuffle(hw, ow, 16, mesh2d)
+        assert result.perm.shape[0] == n
+        assert np.array_equal(np.sort(result.perm), np.arange(n))
+        # One bucket -> one owning device holds every row.
+        assert sorted(result.device_row_counts, reverse=True)[0] == n
+
+    def test_zero_rows(self):
+        from hyperspace_tpu.parallel import (
+            build_mesh_2d,
+            hierarchical_bucket_shuffle,
+        )
+
+        hw = [np.zeros((0, 2), np.uint32)]
+        ow = [np.zeros((0, 2), np.uint32)]
+        result, _ = hierarchical_bucket_shuffle(hw, ow, 8,
+                                                build_mesh_2d(2, 4))
+        assert result.perm.shape[0] == 0
+        assert result.device_row_counts.sum() == 0
+
+    def test_rejects_wrong_mesh(self, mesh):
+        from hyperspace_tpu.parallel import hierarchical_bucket_shuffle
+
+        with pytest.raises(ValueError, match="dcn"):
+            hierarchical_bucket_shuffle(
+                [np.zeros((4, 2), np.uint32)],
+                [np.zeros((4, 2), np.uint32)], 8, mesh)
