@@ -31,6 +31,7 @@ from hyperspace_tpu.plan.expr import (
     And,
     Arith,
     BinOp,
+    Case,
     Col,
     Expr,
     IsIn,
@@ -39,6 +40,7 @@ from hyperspace_tpu.plan.expr import (
     Neg,
     Not,
     Or,
+    StringMatch,
 )
 from hyperspace_tpu.plan.nodes import (
     Aggregate,
@@ -373,10 +375,12 @@ class Executor:
     def _device_compatible(self, expr: Expr, table: pa.Table) -> bool:
         if isinstance(expr, BinOp):
             sides = (expr.left, expr.right)
-            if any(isinstance(s, (Arith, Neg)) for s in sides):
-                # Arithmetic comparisons: every leaf must be a column or a
-                # plainly numeric literal (no temporal normalization inside
-                # arithmetic); division is host-only (x/0 -> null 3VL).
+            if not all(isinstance(s, (Col, Lit)) for s in sides):
+                # Compound operands: every leaf must be a column or a
+                # plainly numeric literal under + - * / neg arithmetic (no
+                # temporal normalization inside arithmetic; division is
+                # host-only for x/0 -> null 3VL; CASE/string nodes are
+                # host-only entirely).
                 return all(_arith_device_ok(s) for s in sides)
             for side in sides:
                 if isinstance(side, Lit) and not isinstance(side.value, (int, float, bool)):
@@ -1213,8 +1217,49 @@ def _arrow_eval(expr: Expr, table: pa.Table):
     if isinstance(expr, Not):
         return pc.invert(_arrow_eval(expr.child, table))
     if isinstance(expr, IsIn):
-        return pc.is_in(_arrow_eval(expr.child, table),
-                        value_set=pa.array(expr.values))
+        child = _arrow_eval(expr.child, table)
+        result = pc.is_in(child, value_set=pa.array(expr.values))
+        # Spark 3VL: NULL IN (...) is NULL (drops the row under both isin
+        # and ~isin); arrow's is_in returns false, which would flip to
+        # TRUE under NOT — restore the null.
+        if not isinstance(child, pa.Scalar):
+            null_bool = pa.scalar(None, type=pa.bool_())
+            return pc.if_else(pc.is_valid(child), result, null_bool)
+        if not child.is_valid:
+            return pa.scalar(None, type=pa.bool_())
+        return result
     if isinstance(expr, IsNull):
         return pc.is_null(_arrow_eval(expr.child, table))
+    if isinstance(expr, StringMatch):
+        child = _arrow_eval(expr.child, table)
+        if expr.kind == "like":
+            return pc.match_like(child, expr.pattern)
+        if expr.kind == "startswith":
+            return pc.starts_with(child, expr.pattern)
+        if expr.kind == "endswith":
+            return pc.ends_with(child, expr.pattern)
+        return pc.match_substring(child, expr.pattern)
+    if isinstance(expr, Case):
+        # Spark CASE: branches in order, null condition = branch NOT taken
+        # (arrow's if_else would propagate the null instead), no ELSE =
+        # null.  Built right-to-left so earlier branches win.
+        result = _arrow_eval(expr.otherwise, table)
+        if isinstance(result, pa.Scalar) and not result.is_valid \
+                and result.type == pa.null():
+            # Untyped null ELSE: let if_else infer the branch type.
+            result = None
+        for cond, value in reversed(expr.branches):
+            mask = _arrow_eval(cond, table)
+            if isinstance(mask, pa.Scalar):
+                mask = pa.scalar(bool(mask.as_py())
+                                 if mask.is_valid else False)
+            else:
+                mask = pc.fill_null(mask, False)
+            val = _arrow_eval(value, table)
+            if result is None:
+                # First (innermost) branch with a null ELSE: null of the
+                # branch value's type.
+                result = pa.scalar(None, type=val.type)
+            result = pc.if_else(mask, val, result)
+        return result
     raise ValueError(f"Unsupported expression: {expr!r}")

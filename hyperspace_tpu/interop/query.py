@@ -24,6 +24,7 @@ from hyperspace_tpu.plan.expr import (
     And,
     Arith,
     BinOp,
+    Case,
     Col,
     Expr,
     IsIn,
@@ -32,6 +33,7 @@ from hyperspace_tpu.plan.expr import (
     Neg,
     Not,
     Or,
+    StringMatch,
 )
 
 _CMP_OPS = ("==", "<", "<=", ">", ">=")
@@ -50,6 +52,14 @@ def value_expr_from_json(obj: Any) -> Expr:
                      value_expr_from_json(obj["right"]))
     if op == "neg":
         return Neg(value_expr_from_json(obj["child"]))
+    if op == "case":
+        # {"op": "case", "branches": [[cond, value], ...],
+        #  "otherwise": value?}  Conditions are BOOLEAN expressions.
+        branches = [(expr_from_json(c), value_expr_from_json(v))
+                    for c, v in obj["branches"]]
+        otherwise = value_expr_from_json(obj["otherwise"]) \
+            if "otherwise" in obj else Lit(None)
+        return Case(branches, otherwise)
     if op is None and "col" in obj:
         return Col(obj["col"])
     if op is None and "value" in obj:
@@ -79,6 +89,8 @@ def expr_from_json(obj: Dict[str, Any]) -> Expr:
         return IsIn(Col(obj["col"]), list(obj["values"]))
     if op == "is_null":
         return IsNull(Col(obj["col"]))
+    if op in StringMatch.KINDS:
+        return StringMatch(op, Col(obj["col"]), obj["pattern"])
     raise ValueError(f"Unknown expression op: {op!r}")
 
 

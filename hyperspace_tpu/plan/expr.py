@@ -82,6 +82,22 @@ class Expr:
     def is_not_null(self) -> "Expr":
         return Not(IsNull(self))
 
+    # -- string predicates (SQL LIKE and friends; every TPC query uses
+    # LIKE '%green%'-style matching).  Host-evaluated: strings never take
+    # the device path.
+    def like(self, pattern: str) -> "Expr":
+        """SQL LIKE: ``%`` any run, ``_`` one char (case sensitive)."""
+        return StringMatch("like", self, pattern)
+
+    def startswith(self, prefix: str) -> "Expr":
+        return StringMatch("startswith", self, prefix)
+
+    def endswith(self, suffix: str) -> "Expr":
+        return StringMatch("endswith", self, suffix)
+
+    def contains(self, needle: str) -> "Expr":
+        return StringMatch("contains", self, needle)
+
     def __hash__(self) -> int:
         return hash(repr(self))
 
@@ -186,6 +202,68 @@ class IsIn(Expr):
         return f"{self.child!r}.isin({self.values!r})"
 
 
+class StringMatch(Expr):
+    """SQL string predicate: like / startswith / endswith / contains.
+    Null input yields null (the row drops), matching SQL LIKE."""
+
+    KINDS = ("like", "startswith", "endswith", "contains")
+
+    def __init__(self, kind: str, child: Expr, pattern: str) -> None:
+        if kind not in self.KINDS:
+            raise ValueError(f"Unsupported string match {kind!r}")
+        if not isinstance(pattern, str):
+            raise ValueError(
+                f"{kind} pattern must be a string, got {pattern!r}")
+        self.kind = kind
+        self.child = child
+        self.pattern = pattern
+
+    def __repr__(self) -> str:
+        return f"{self.child!r}.{self.kind}({self.pattern!r})"
+
+
+class Case(Expr):
+    """CASE WHEN ... THEN ... [ELSE ...] END.  Spark semantics: branches
+    evaluate in order; a null condition is FALSE (the branch is not
+    taken); with no ELSE the result is null.  Build with ``when()``:
+
+        when(col("p") > 5, 1).when(col("p") > 2, 2).otherwise(0)
+    """
+
+    def __init__(self, branches, otherwise: "Expr") -> None:
+        if not branches:
+            raise ValueError("CASE needs at least one WHEN branch")
+        self.branches = tuple((c, v) for c, v in branches)
+        self.otherwise = otherwise
+
+    def __repr__(self) -> str:
+        parts = " ".join(f"WHEN {c!r} THEN {v!r}" for c, v in self.branches)
+        return f"CASE {parts} ELSE {self.otherwise!r} END"
+
+
+class CaseBuilder:
+    """Chainable WHEN accumulator — finish with ``.otherwise(value)`` or
+    ``.end()`` (no ELSE = null when no branch matches)."""
+
+    def __init__(self, branches) -> None:
+        self._branches = branches
+
+    def when(self, condition: "Expr", value: Any) -> "CaseBuilder":
+        return CaseBuilder(self._branches + [(condition, _lift(value))])
+
+    def otherwise(self, value: Any) -> Case:
+        return Case(self._branches, _lift(value))
+
+    def end(self) -> Case:
+        """Terminate with no ELSE (null when no branch matches)."""
+        return Case(self._branches, Lit(None))
+
+
+def when(condition: Expr, value: Any) -> CaseBuilder:
+    """Start a CASE expression: ``when(cond, value).otherwise(default)``."""
+    return CaseBuilder([(condition, _lift(value))])
+
+
 class IsNull(Expr):
     """SQL IS NULL — unlike comparisons (null => unknown => row drops),
     this yields TRUE for null values.  The device filter path and every
@@ -228,6 +306,13 @@ def _collect_columns(e: Expr, out: Set[str]) -> None:
         _collect_columns(e.child, out)
     elif isinstance(e, IsNull):
         _collect_columns(e.child, out)
+    elif isinstance(e, StringMatch):
+        _collect_columns(e.child, out)
+    elif isinstance(e, Case):
+        for c, v in e.branches:
+            _collect_columns(c, out)
+            _collect_columns(v, out)
+        _collect_columns(e.otherwise, out)
 
 
 def split_conjuncts(e: Expr) -> List[Expr]:

@@ -256,3 +256,134 @@ def test_with_column_unused_is_pruned_away(env):
     assert "WithColumns" not in text, text
     out = ds.collect()
     assert out.column_names == ["k"]
+
+
+def test_string_predicates_match_sql_like(env):
+    from hyperspace_tpu import when  # noqa: F401  (import surface)
+
+    s, data, df = env
+    ds = s.read.parquet(data)
+    # tag in {a, b, c}; like with % and _ wildcards.
+    assert ds.filter(col("tag").like("a")).count() == int((df["tag"] == "a").sum())
+    assert ds.filter(col("tag").like("%a%")).count() == int(df["tag"].str.contains("a").sum())
+    assert ds.filter(col("tag").like("_")).count() == len(df)  # all 1-char
+    assert ds.filter(col("tag").startswith("b")).count() == int(df["tag"].str.startswith("b").sum())
+    assert ds.filter(col("tag").endswith("c")).count() == int(df["tag"].str.endswith("c").sum())
+    assert ds.filter(col("tag").contains("b")).count() == int(df["tag"].str.contains("b").sum())
+
+
+def test_string_predicate_null_drops_row(tmp_path):
+    d = str(tmp_path / "sn")
+    os.makedirs(d)
+    pq.write_table(pa.table({"t": pa.array(["abc", None, "abd"]) }),
+                   os.path.join(d, "p.parquet"))
+    from hyperspace_tpu import HyperspaceSession
+
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    ds = s.read.parquet(d)
+    assert ds.filter(col("t").like("ab%")).count() == 2
+    # NOT LIKE: null is still unknown -> row drops (Spark 3VL).
+    assert ds.filter(~col("t").like("ab_")).count() == 0
+    assert ds.filter(~col("t").like("abc")).count() == 1
+
+
+def test_case_when_matches_spark_semantics(env):
+    from hyperspace_tpu import when
+
+    s, data, df = env
+    out = (s.read.parquet(data)
+           .select("k", bucket=when(col("qty") >= 40, "high")
+                   .when(col("qty") >= 20, "mid").otherwise("low"))
+           .collect().to_pandas().sort_values("k"))
+    want = np.where(df["qty"] >= 40, "high",
+                    np.where(df["qty"] >= 20, "mid", "low"))
+    # df is already in k order, so positions line up directly.
+    np.testing.assert_array_equal(out["bucket"].to_numpy(), want)
+    # No ELSE: unmatched rows are null.
+    ends = (s.read.parquet(data)
+            .select("k", flag=when(col("qty") >= 40, 1).end())
+            .collect())
+    assert ends.column("flag").null_count == int((df["qty"] < 40).sum())
+
+
+def test_case_null_condition_is_false(tmp_path):
+    """A null WHEN condition skips the branch (Spark), rather than
+    propagating null (raw arrow if_else)."""
+    from hyperspace_tpu import HyperspaceSession, when
+
+    d = str(tmp_path / "cn")
+    os.makedirs(d)
+    pq.write_table(pa.table({
+        "x": pa.array([1, None, 3], type=pa.int64()),
+    }), os.path.join(d, "p.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    out = (s.read.parquet(d)
+           .select(y=when(col("x") > 2, "big").otherwise("small"))
+           .collect())
+    # Row with null x: condition null -> FALSE -> "small", not null.
+    assert out.column("y").to_pylist() == ["small", "small", "big"]
+
+
+def test_case_in_aggregate_q12_shape(env):
+    """The TPC-H Q12 CASE-inside-sum shape: conditional counting."""
+    from hyperspace_tpu import when
+
+    s, data, df = env
+    out = (s.read.parquet(data).group_by("tag")
+           .agg(high=(when((col("qty") >= 25), 1).otherwise(0), "sum"),
+                low=(when(col("qty") < 25, 1).otherwise(0), "sum"))
+           .sort("tag").collect().to_pandas())
+    want = (df.assign(high=(df["qty"] >= 25).astype(int),
+                      low=(df["qty"] < 25).astype(int))
+            .groupby("tag").agg(high=("high", "sum"), low=("low", "sum"))
+            .reset_index())
+    np.testing.assert_array_equal(out["high"].to_numpy(), want["high"].to_numpy())
+    np.testing.assert_array_equal(out["low"].to_numpy(), want["low"].to_numpy())
+
+
+def test_string_and_case_never_take_device_path(env):
+    """Predicates containing CASE/LIKE are host-only — the device gate
+    must reject them instead of crashing the compiler."""
+    from hyperspace_tpu import when
+
+    s, data, df = env
+    s.conf.device_filter_min_rows = 1
+    n1 = (s.read.parquet(data)
+          .filter(when(col("qty") > 25, 1).otherwise(0) == 1).count())
+    assert n1 == int((df["qty"] > 25).sum())
+    n2 = s.read.parquet(data).filter(col("tag").like("a%")).count()
+    assert n2 == int(df["tag"].str.startswith("a").sum())
+
+
+def test_interop_codec_case_and_like(env):
+    from hyperspace_tpu.interop.query import dataset_from_spec
+
+    s, data, df = env
+    out = dataset_from_spec(s, {
+        "source": {"format": "parquet", "path": data},
+        "filter": {"op": "like", "col": "tag", "pattern": "%a%"},
+        "group_by": ["tag"],
+        "aggs": {"n_high": [{"op": "case",
+                             "branches": [[{"op": ">=", "col": "qty",
+                                            "value": 25}, 1]],
+                             "otherwise": 0}, "sum"]},
+    }).collect()
+    sub = df[df["tag"].str.contains("a")]
+    assert out.column("n_high").to_pylist() == \
+        [int((sub["qty"] >= 25).sum())]
+
+
+def test_not_isin_null_drops_row_like_spark(tmp_path):
+    """NULL IN (...) is NULL in SQL: the row drops under both isin and
+    ~isin (arrow's raw is_in would give false -> true under NOT)."""
+    from hyperspace_tpu import HyperspaceSession
+
+    d = str(tmp_path / "nin")
+    os.makedirs(d)
+    pq.write_table(pa.table({
+        "x": pa.array([1, None, 3], type=pa.int64()),
+    }), os.path.join(d, "p.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    ds = s.read.parquet(d)
+    assert ds.filter(col("x").isin([1, 2])).count() == 1
+    assert ds.filter(~col("x").isin([1, 2])).count() == 1  # only x=3

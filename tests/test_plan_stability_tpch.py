@@ -6,10 +6,11 @@ module is the same idiom over the full 8-table TPC-H schema with ~19
 queries adapted to the engine's surface:
 
   - expression aggregates (sum(l_extendedprice * (1 - l_discount))),
+  - CASE WHEN inside aggregates (Q12's priority split, Q14's promo ratio)
+    and SQL LIKE predicates (Q9/Q14/Q20's p_name/p_type matches) — native,
   - semi/anti/left joins standing in for EXISTS / NOT EXISTS / outer SQL,
   - computed projections over aggregate outputs for ratio queries,
-  - constants in place of scalar subqueries, equality/IN in place of LIKE
-    (no string functions yet — each adaptation is noted inline).
+  - constants in place of scalar subqueries (each adaptation noted inline).
 
 Golden plans live under resources/approved-plans-tpch/; regenerate with
 HS_GENERATE_GOLDEN_FILES=1.  Beneath the plan goldens an answer-equivalence
@@ -33,6 +34,7 @@ from hyperspace_tpu import (
     HyperspaceSession,
     IndexConfig,
     col,
+    when,
 )
 from tests.test_plan_stability import _simplify, _write
 
@@ -181,7 +183,8 @@ def catalog(tmp_path_factory):
     hs.create_index(read.parquet(paths["orders"]),
                     IndexConfig("t_o_ok", ["o_orderkey"],
                                 ["o_custkey", "o_orderdate",
-                                 "o_shippriority", "o_totalprice"]))
+                                 "o_shippriority", "o_totalprice",
+                                 "o_orderpriority"]))
     hs.create_index(read.parquet(paths["orders"]),
                     IndexConfig("t_o_ck", ["o_custkey"],
                                 ["o_orderkey", "o_orderdate",
@@ -284,11 +287,10 @@ def _queries(session, paths):
                     & (col("l_discount") <= 0.07)
                     & (col("l_quantity") < 24))
             .agg(revenue=(col("l_extendedprice") * col("l_discount"), "sum")),
-        # Q9 (adapted: LIKE '%green%' -> p_name prefix set): product-type
-        # profit, partsupp joined on the composite (partkey, suppkey).
+        # Q9: product-type profit (the real LIKE '%green%' predicate),
+        # partsupp joined on the composite (partkey, suppkey).
         "t09_product_profit": t("part")
-            .filter(col("p_name").isin(
-                [f"part green {i}" for i in range(0, N_PART, 3)]))
+            .filter(col("p_name").like("%green%"))
             .join(t("lineitem"), col("p_partkey") == col("l_partkey"))
             .join(t("partsupp"),
                   (col("l_partkey") == col("ps_partkey"))
@@ -318,7 +320,8 @@ def _queries(session, paths):
             .agg(value=(col("ps_supplycost") * col("ps_availqty"), "sum"))
             .filter(col("value") > 2000.0)
             .sort(("value", False)),
-        # Q12 (adapted: the CASE priority split becomes a plain count).
+        # Q12: the REAL shape — CASE WHEN inside both sums splits lines by
+        # order priority.
         "t12_shipping_modes": t("orders")
             .join(t("lineitem")
                   .filter(col("l_shipmode").isin(["MAIL", "SHIP"])
@@ -327,7 +330,14 @@ def _queries(session, paths):
                           & (col("l_receiptdate") >= 400)
                           & (col("l_receiptdate") < 1200)),
                   col("o_orderkey") == col("l_orderkey"))
-            .group_by("l_shipmode").count("line_count").sort("l_shipmode"),
+            .group_by("l_shipmode")
+            .agg(high_line_count=(
+                     when(col("o_orderpriority").isin(
+                         ["1-URGENT", "2-HIGH"]), 1).otherwise(0), "sum"),
+                 low_line_count=(
+                     when(~col("o_orderpriority").isin(
+                         ["1-URGENT", "2-HIGH"]), 1).otherwise(0), "sum"))
+            .sort("l_shipmode"),
         # Q13: customer order-count distribution — LEFT OUTER join, then a
         # second aggregation over the first's output.
         "t13_customer_distribution": t("customer")
@@ -336,15 +346,16 @@ def _queries(session, paths):
             .group_by("c_custkey").agg(c_count=("o_orderkey", "count"))
             .group_by("c_count").count("custdist")
             .sort(("custdist", False), ("c_count", False)),
-        # Q14 (adapted: the CASE promo split becomes a ratio of aggregate
-        # outputs via a computed projection over the Aggregate).
+        # Q14: the REAL shape — promo revenue ratio via CASE WHEN p_type
+        # LIKE 'PROMO%' inside the sum, divided in a computed projection
+        # over the aggregate outputs.
         "t14_promo_effect": t("lineitem")
             .filter((col("l_shipdate") >= 1000) & (col("l_shipdate") < 1100))
             .join(t("part"), col("l_partkey") == col("p_partkey"))
-            .group_by("p_type").agg(revenue=(rev, "sum"),
-                                    n=("", "count_all"))
-            .select("p_type", avg_item_revenue=col("revenue") / col("n"))
-            .sort("p_type"),
+            .agg(promo=(when(col("p_type").like("PROMO%"), rev)
+                        .otherwise(0.0), "sum"),
+                 total=(rev, "sum"))
+            .select(promo_revenue=100.0 * col("promo") / col("total")),
         # Q15 (adapted: max-revenue scalar subquery -> top-1 by sort): the
         # top supplier by shipped revenue, joined back to supplier.
         "t15_top_supplier": t("lineitem")
@@ -400,12 +411,12 @@ def _queries(session, paths):
                        & (col("p_size") <= 15)))
             .agg(revenue=(rev, "sum")),
         # Q20 (adapted: the availability scalar subquery is dropped):
-        # suppliers with green parts on offer, as a SEMI-join chain.
+        # suppliers with green parts on offer (the real LIKE 'part green%'
+        # prefix match), as a SEMI-join chain.
         "t20_potential_promotions": t("supplier")
             .join(t("partsupp")
-                  .join(t("part").filter(col("p_name").isin(
-                      [f"part green {i}" for i in range(0, N_PART, 3)])),
-                      col("ps_partkey") == col("p_partkey"), how="semi"),
+                  .join(t("part").filter(col("p_name").like("part green%")),
+                        col("ps_partkey") == col("p_partkey"), how="semi"),
                   col("s_suppkey") == col("ps_suppkey"), how="semi")
             .select("s_suppkey", "s_name").sort("s_suppkey"),
         # Q22 (adapted: substring(c_phone) -> c_phonecode): customers with
