@@ -1218,16 +1218,23 @@ def _arrow_eval(expr: Expr, table: pa.Table):
         return pc.invert(_arrow_eval(expr.child, table))
     if isinstance(expr, IsIn):
         child = _arrow_eval(expr.child, table)
-        result = pc.is_in(child, value_set=pa.array(expr.values))
-        # Spark 3VL: NULL IN (...) is NULL (drops the row under both isin
-        # and ~isin); arrow's is_in returns false, which would flip to
-        # TRUE under NOT — restore the null.
-        if not isinstance(child, pa.Scalar):
-            null_bool = pa.scalar(None, type=pa.bool_())
-            return pc.if_else(pc.is_valid(child), result, null_bool)
-        if not child.is_valid:
-            return pa.scalar(None, type=pa.bool_())
-        return result
+        # Spark 3VL, which arrow's is_in does not implement:
+        #   NULL IN (...)          -> NULL  (arrow: false)
+        #   x IN (..no match.., NULL) -> NULL  (arrow: false)
+        # Both matter under NOT — false would flip to TRUE and keep rows
+        # SQL drops.
+        values = [v for v in expr.values if v is not None]
+        null_in_list = len(values) != len(expr.values)
+        null_bool = pa.scalar(None, type=pa.bool_())
+        result = pc.is_in(child, value_set=pa.array(values)) if values \
+            else pa.scalar(False)
+        if null_in_list:
+            result = pc.if_else(result, pa.scalar(True), null_bool)
+        if isinstance(child, pa.Scalar):
+            if not child.is_valid:
+                return null_bool
+            return result
+        return pc.if_else(pc.is_valid(child), result, null_bool)
     if isinstance(expr, IsNull):
         return pc.is_null(_arrow_eval(expr.child, table))
     if isinstance(expr, StringMatch):

@@ -210,19 +210,29 @@ def bucket_chunks(n_rows: int, max_rows_per_file: int) -> List:
             for off in range(0, n_rows, chunk)]
 
 
+def zorder_codes_from_order_words(word_cols: List[np.ndarray]
+                                  ) -> Tuple[np.ndarray, int]:
+    """(uint64 Morton code per row, total code bits) from per-column
+    (n, 2) uint32 monotone order words — the streaming build accumulates
+    words per chunk (8 B/row/column) instead of raw key columns, so this
+    entry point keeps its peak memory independent of key width."""
+    from hyperspace_tpu.ops.zorder import zorder_order_words_np
+
+    z = zorder_order_words_np([np.asarray(w) for w in word_cols])
+    codes = (z[:, 0].astype(np.uint64) << np.uint64(32)) \
+        | z[:, 1].astype(np.uint64)
+    return codes, 16 * len(word_cols)
+
+
 def zorder_codes_host(table: pa.Table, indexed_columns) -> Tuple[np.ndarray, int]:
     """(uint64 Morton code per row, total code bits) for a Z-order layout —
     the writer's file-split key.  Host mirror of the build kernel's codes
     (ops/zorder.py): dense ranks per column scaled to 16 bits, interleaved."""
     from hyperspace_tpu.io import columnar
-    from hyperspace_tpu.ops.zorder import zorder_order_words_np
 
-    z = zorder_order_words_np([
+    return zorder_codes_from_order_words([
         np.asarray(columnar.to_order_words(table.column(c)))
         for c in indexed_columns])
-    codes = (z[:, 0].astype(np.uint64) << np.uint64(32)) \
-        | z[:, 1].astype(np.uint64)
-    return codes, 16 * len(list(indexed_columns))
 
 
 def zorder_split_chunks(z_sorted: np.ndarray, key_bits: int,
@@ -311,10 +321,14 @@ def sort_permutation_host(table: pa.Table, indexed_columns, layout: str):
 def write_zorder_run(btable: pa.Table, bucket: int, out_dir: str,
                      max_rows_per_file: int, indexed_columns,
                      compression: Optional[str] = None) -> List[str]:
-    """Morton-sort one bucket run and write it with Z-cell-aligned file
-    cuts — the ONE home for the zorder sort+split contract, shared by the
-    external build's phase 2 and optimize's compaction (a divergence
-    between the two would silently destroy the layout on compaction)."""
+    """Morton-sort one run by BATCH-LOCAL ranks and write it with
+    Z-cell-aligned file cuts.  Used by optimize's compaction, which merges
+    a SUBSET of an index's files: local ranks keep the merged subset
+    clustered (per-file min/max stays narrow, which is all the sketches
+    consume) without a global pass.  The BUILD no longer goes through
+    here — it computes GLOBAL ranks in the two-pass streaming path
+    (actions/create._zorder_streaming_build) or the monolithic writer, so
+    fresh indexes carry the exact global curve."""
     codes, bits = zorder_codes_host(btable, indexed_columns)
     perm = np.argsort(codes, kind="stable")
     return write_bucket_run(btable.take(pa.array(perm)), bucket, out_dir,

@@ -387,3 +387,21 @@ def test_not_isin_null_drops_row_like_spark(tmp_path):
     ds = s.read.parquet(d)
     assert ds.filter(col("x").isin([1, 2])).count() == 1
     assert ds.filter(~col("x").isin([1, 2])).count() == 1  # only x=3
+
+
+def test_isin_with_null_in_value_list(tmp_path):
+    """x IN (1, NULL): true on match, NULL otherwise (never false) — so
+    ~isin drops non-matching rows instead of keeping them."""
+    from hyperspace_tpu import HyperspaceSession
+
+    d = str(tmp_path / "ninv")
+    os.makedirs(d)
+    pq.write_table(pa.table({
+        "x": pa.array([1, 2, None], type=pa.int64()),
+    }), os.path.join(d, "p.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    ds = s.read.parquet(d)
+    assert ds.filter(col("x").isin([1, None])).count() == 1
+    assert ds.filter(~col("x").isin([1, None])).count() == 0
+    assert ds.filter(col("x").isin([None])).count() == 0
+    assert ds.filter(~col("x").isin([None])).count() == 0

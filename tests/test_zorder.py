@@ -267,3 +267,38 @@ class TestZorderRefresh:
                 .filter(col("y") >= 0).select("x", "y").optimized_plan())
         assert [s for s in plan.leaf_relations() if s.relation.index_scan_of], \
             plan.tree_string()
+
+
+def test_zorder_build_with_reserved_column_name(tmp_path):
+    """A source column literally named __z must not collide with the
+    streaming build's routing column."""
+    import os
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
+
+    d = str(tmp_path / "zz")
+    os.makedirs(d)
+    rng = np.random.default_rng(0)
+    n = 4000
+    pq.write_table(pa.table({
+        "a": pa.array(np.arange(n, dtype=np.int64)),
+        "b": pa.array(rng.random(n)),
+        "__z": pa.array(rng.integers(0, 9, n), type=pa.int64()),
+    }), os.path.join(d, "p.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    s.conf.num_buckets = 1
+    s.conf.device_batch_rows = 512  # force the streaming two-pass path
+    s.conf.index_max_rows_per_file = 500
+    hs = Hyperspace(s)
+    hs.create_index(s.read.parquet(d),
+                    IndexConfig("zres", ["a", "b"], ["__z"],
+                                layout="zorder"))
+    s.enable_hyperspace()
+    ds = (s.read.parquet(d).filter(col("a") == 7).select("a", "__z"))
+    got = ds.collect()
+    s.disable_hyperspace()
+    assert got.to_pydict() == ds.collect().to_pydict()
