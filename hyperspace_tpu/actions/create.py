@@ -231,13 +231,15 @@ class CreateActionBase(Action):
         """Two-pass Z-order build for datasets beyond one device batch,
         producing EXACTLY the monolithic layout:
 
-          A. stream only the INDEXED columns (column-pruned reads),
-             converting each chunk to fixed-width order words immediately
-             (8 B/row/column — raw keys are never accumulated, so string
-             keys cost the same as ints), then compute global dense-rank
-             Morton codes, argsort, and the Z-cell-aligned output-file
-             assignment per row — words fit in host RAM long after
-             payloads don't;
+          A. stream only the INDEXED columns (column-pruned reads).
+             Value-mapped types (numeric/temporal — their order words are
+             chunk-independent) convert to fixed-width words immediately
+             (8 B/row/column); rank-mapped types (strings, bool) must keep
+             the raw column until one GLOBAL rank pass — a chunk-local
+             dense rank would not be comparable across chunks and the
+             curve would silently interleave.  Then compute global Morton
+             codes, argsort, and the Z-cell-aligned output-file
+             assignment per row;
           B. stream the full rows again, routing each chunk's rows to
              per-output-file run files (codes ride along as a temp
              column); then per output file: concat runs in chunk order,
@@ -263,38 +265,54 @@ class CreateActionBase(Action):
         )
 
         key_cols = list(resolved.indexed_columns)
+
+        def build_monolithic() -> None:
+            table = pa.concat_tables(
+                [self._read_chunk(f, columns, relation, lineage)
+                 for f in files], promote_options="default")
+            self._write_table_bucketed(table, resolved)
+
         # Small datasets skip the two-pass machinery entirely when footers
         # can prove the total fits one batch (parquet only; other formats
         # fall through and pay one extra key-column read).
         footer_n = _footer_row_count(files, relation)
         if footer_n is not None and footer_n <= batch_rows:
-            table = pa.concat_tables(
-                [self._read_chunk(f, columns, relation, lineage)
-                 for f in files], promote_options="default")
-            self._write_table_bucketed(table, resolved)
+            build_monolithic()
             return
-        # -- pass A: global codes from the indexed columns only, converted
-        # to fixed-width order words chunk by chunk ------------------------
-        word_parts: List[List[np.ndarray]] = [[] for _ in key_cols]
+        # -- pass A: global codes from the indexed columns only ------------
+        word_parts: List[List] = [[] for _ in key_cols]
+        value_mapped: List[Optional[bool]] = [None] * len(key_cols)
         n = 0
         for f in files:
             kt = self._read_chunk(f, key_cols, relation, lineage=False)
             n += kt.num_rows
             for i, c in enumerate(key_cols):
-                word_parts[i].append(
-                    np.asarray(_columnar.to_order_words(kt.column(c))))
+                arr = kt.column(c)
+                if value_mapped[i] is None:
+                    value_mapped[i] = columnar.is_numeric_type(
+                        kt.schema.field(c).type)
+                if value_mapped[i]:
+                    word_parts[i].append(
+                        np.asarray(_columnar.to_order_words(arr)))
+                else:
+                    # Rank-mapped type: keep the raw chunks for ONE global
+                    # rank pass below.
+                    word_parts[i].extend(arr.chunks)
         if n <= batch_rows:
             # Non-parquet source that turned out small: monolithic writer
             # (identical layout, no run files).
-            table = pa.concat_tables(
-                [self._read_chunk(f, columns, relation, lineage)
-                 for f in files], promote_options="default")
-            self._write_table_bucketed(table, resolved)
+            build_monolithic()
             return
         t0 = _time.perf_counter()
-        codes, bits = zorder_codes_from_order_words(
-            [np.concatenate(parts, axis=0) for parts in word_parts])
-        del word_parts
+        per_col_words = []
+        for i in range(len(key_cols)):
+            if value_mapped[i]:
+                per_col_words.append(np.concatenate(word_parts[i], axis=0))
+            else:
+                per_col_words.append(np.asarray(_columnar.to_order_words(
+                    pa.chunked_array(word_parts[i]))))
+        codes, bits = zorder_codes_from_order_words(per_col_words)
+        del word_parts, per_col_words
         order = np.argsort(codes, kind="stable")
         chunks = zorder_split_chunks(codes[order], bits,
                                      self.conf.index_max_rows_per_file)

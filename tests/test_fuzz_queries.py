@@ -24,6 +24,7 @@ from hyperspace_tpu import (
     HyperspaceSession,
     IndexConfig,
     col,
+    when,
 )
 
 N_SEEDS = 25
@@ -105,6 +106,11 @@ def _random_predicate(r: random.Random):
         lambda: col("f_price") * (1 - col("f_price") / 200)
         < r.uniform(0, 100),
         lambda: -col("f_num") + 1000 >= r.randrange(0, 1000),
+        # String predicates (SQL LIKE family) and CASE comparisons.
+        lambda: col("f_tag").like(r.choice(["%e%", "b%", "_ed", "te__"])),
+        lambda: col("f_tag").contains(r.choice(["e", "l", "zz"])),
+        lambda: when(col("f_price") > r.uniform(0, 100), 1)
+        .otherwise(0) == 1,
     ]
     e = r.choice(pool)()
     if r.random() < 0.5:
@@ -147,9 +153,16 @@ def _random_query(session, paths, seed: int):
             cols += ["d_name"]
         picked = r.sample(cols, k=r.randrange(1, len(cols) + 1))
         if r.random() < 0.3:
-            # Computed projection alongside plain columns.
-            ds = ds.select(*picked,
-                           rev=col("f_price") * (1 - col("f_price") / 500))
+            # Computed projection alongside plain columns — arithmetic or
+            # a CASE bucket.
+            if r.random() < 0.5:
+                ds = ds.select(*picked,
+                               rev=col("f_price") * (1 - col("f_price") / 500))
+            else:
+                ds = ds.select(*picked,
+                               band=when(col("f_price") > 66.0, "hi")
+                               .when(col("f_price") > 33.0, "mid")
+                               .otherwise("lo"))
         else:
             ds = ds.select(*picked)
         if r.random() < 0.2:

@@ -178,7 +178,7 @@ def catalog(tmp_path_factory):
                                  "l_commitdate", "l_receiptdate"]))
     hs.create_index(read.parquet(paths["lineitem"]),
                     IndexConfig("t_l_pk", ["l_partkey"],
-                                ["l_suppkey", "l_quantity",
+                                ["l_suppkey", "l_orderkey", "l_quantity",
                                  "l_extendedprice", "l_discount"]))
     hs.create_index(read.parquet(paths["orders"]),
                     IndexConfig("t_o_ok", ["o_orderkey"],
@@ -287,6 +287,26 @@ def _queries(session, paths):
                     & (col("l_discount") <= 0.07)
                     & (col("l_quantity") < 24))
             .agg(revenue=(col("l_extendedprice") * col("l_discount"), "sum")),
+        # Q8 (adapted: the per-year grouping is dropped — dates are plain
+        # ints — and the "nation" share is the supplier's nation KEY):
+        # national market share via CASE inside both sums, over a 6-way
+        # join.
+        "t08_market_share": t("part")
+            .filter(col("p_type") == "STANDARD POLISHED")
+            .join(t("lineitem"), col("p_partkey") == col("l_partkey"))
+            .join(t("supplier"), col("l_suppkey") == col("s_suppkey"))
+            .join(t("orders")
+                  .filter((col("o_orderdate") >= 600)
+                          & (col("o_orderdate") < 1800)),
+                  col("l_orderkey") == col("o_orderkey"))
+            .join(t("customer"), col("o_custkey") == col("c_custkey"))
+            .join(t("nation"), col("c_nationkey") == col("n_nationkey"))
+            .join(t("region").filter(col("r_name") == "AMERICA"),
+                  col("n_regionkey") == col("r_regionkey"))
+            .agg(nation_volume=(when(col("s_nationkey") == 7, rev)
+                                .otherwise(0.0), "sum"),
+                 total_volume=(rev, "sum"))
+            .select(mkt_share=col("nation_volume") / col("total_volume")),
         # Q9: product-type profit (the real LIKE '%green%' predicate),
         # partsupp joined on the composite (partkey, suppkey).
         "t09_product_profit": t("part")
@@ -433,8 +453,8 @@ def _queries(session, paths):
 
 
 TPCH_NAMES = sorted(
-    ["t01", "t02", "t03", "t04", "t05", "t06", "t09", "t10", "t11", "t12",
-     "t13", "t14", "t15", "t16", "t17", "t18", "t19", "t20", "t22"])
+    ["t01", "t02", "t03", "t04", "t05", "t06", "t08", "t09", "t10", "t11",
+     "t12", "t13", "t14", "t15", "t16", "t17", "t18", "t19", "t20", "t22"])
 
 
 def _query_by_prefix(queries, prefix):
@@ -512,6 +532,7 @@ def test_tpch_rewrites_fire_where_expected(catalog):
     # pattern remains.
     expect_rewrite = {
         "t02_min_cost_supplier", "t03_shipping_priority",
+        "t08_market_share",
         "t04_order_priority", "t05_local_supplier_volume",
         "t06_forecast_revenue", "t09_product_profit",
         "t10_returned_items", "t11_important_stock",

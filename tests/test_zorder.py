@@ -302,3 +302,56 @@ def test_zorder_build_with_reserved_column_name(tmp_path):
     got = ds.collect()
     s.disable_hyperspace()
     assert got.to_pydict() == ds.collect().to_pydict()
+
+
+def test_string_key_streaming_build_matches_monolithic_layout(tmp_path):
+    """String keys are RANK-mapped (chunk-local dense ranks are not
+    comparable across chunks), so the streaming two-pass build must rank
+    them globally — the on-disk layout must equal the monolithic build's
+    exactly."""
+    import os
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig
+    from hyperspace_tpu.io.parquet import bucket_id_of_file
+
+    rng = np.random.default_rng(4)
+    n = 3000
+    # Deliberately anti-sorted across files: later files hold
+    # lexicographically EARLIER strings, so chunk-local ranks would
+    # interleave the curve.
+    tags = sorted(f"s{i:05d}" for i in rng.integers(0, 800, n))[::-1]
+    d = str(tmp_path / "sk")
+    os.makedirs(d)
+    t = pa.table({
+        "name": pa.array(tags),
+        "y": pa.array(rng.random(n) * 100),
+        "v": pa.array(np.arange(n, dtype=np.int64)),
+    })
+    for i in range(4):
+        pq.write_table(t.slice(i * n // 4, n // 4),
+                       os.path.join(d, f"part-{i:05d}.parquet"))
+
+    outs = {}
+    for mode, batch in (("streaming", 512), ("monolithic", 1 << 30)):
+        s = HyperspaceSession(system_path=str(tmp_path / f"ix_{mode}"))
+        s.conf.num_buckets = 1
+        s.conf.device_batch_rows = batch
+        s.conf.index_max_rows_per_file = 300
+        hs = Hyperspace(s)
+        hs.create_index(s.read.parquet(d),
+                        IndexConfig("z", ["name", "y"], ["v"],
+                                    layout="zorder"))
+        vdir = os.path.join(str(tmp_path / f"ix_{mode}"), "z", "v__=0")
+        files = sorted(f for f in os.listdir(vdir) if not f.startswith("_"))
+        # Content per file, in file order sorted by first row's v (file
+        # names are random): canonical comparison of the whole layout.
+        tables = sorted(
+            (pq.read_table(os.path.join(vdir, f)).to_pydict()
+             for f in files),
+            key=lambda td: (len(td["v"]), td["v"]))
+        outs[mode] = tables
+    assert outs["streaming"] == outs["monolithic"]
