@@ -232,12 +232,13 @@ class CreateActionBase(Action):
         producing EXACTLY the monolithic layout:
 
           A. stream only the INDEXED columns (column-pruned reads).
-             Value-mapped types (numeric/temporal — their order words are
-             chunk-independent) convert to fixed-width words immediately
-             (8 B/row/column); rank-mapped types (strings, bool) must keep
-             the raw column until one GLOBAL rank pass — a chunk-local
-             dense rank would not be comparable across chunks and the
-             curve would silently interleave.  Then compute global Morton
+             Value-mapped types (numeric/temporal/bool — their order words
+             are chunk-independent) convert to fixed-width words
+             immediately (8 B/row/column); rank-mapped types
+             (strings/binary/decimal) must keep the raw column until one
+             GLOBAL rank pass — a chunk-local dense rank would not be
+             comparable across chunks and the curve would silently
+             interleave.  Then compute global Morton
              codes, argsort, and the Z-cell-aligned output-file
              assignment per row;
           B. stream the full rows again, routing each chunk's rows to

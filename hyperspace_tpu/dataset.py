@@ -23,6 +23,7 @@ from hyperspace_tpu.plan.nodes import (
     LogicalPlan,
     Project,
     Sort,
+    Union,
     WithColumns,
 )
 
@@ -121,6 +122,11 @@ class Dataset:
     def distinct(self) -> "Dataset":
         """Unique rows over the full output (SQL DISTINCT)."""
         return Dataset(Distinct(self.plan), self.session)
+
+    def union(self, other: "Dataset") -> "Dataset":
+        """UNION ALL (Spark's union: bag semantics, schemas merged by
+        name with null promotion).  Chain ``.distinct()`` for SQL UNION."""
+        return Dataset(Union([self.plan, other.plan]), self.session)
 
     def group_by(self, *columns: str) -> "GroupedDataset":
         return GroupedDataset(self, columns)

@@ -32,6 +32,7 @@ from hyperspace_tpu.plan.expr import (
     Arith,
     BinOp,
     Case,
+    Cast,
     Col,
     Expr,
     IsIn,
@@ -1237,6 +1238,47 @@ def _arrow_eval(expr: Expr, table: pa.Table):
         return pc.if_else(pc.is_valid(child), result, null_bool)
     if isinstance(expr, IsNull):
         return pc.is_null(_arrow_eval(expr.child, table))
+    if isinstance(expr, Cast):
+        from hyperspace_tpu.io.parquet import _dtype_from_string
+
+        child = _arrow_eval(expr.child, table)
+        target = _dtype_from_string(expr.type_name)
+        try:
+            return pc.cast(child, target)
+        except (pa.ArrowInvalid, pa.ArrowNotImplementedError,
+                pa.ArrowTypeError):
+            pass
+        # Spark non-ANSI CAST: unconvertible values become null and
+        # float->int truncates toward zero (out-of-range -> null), never
+        # an error.  Vectorized try isn't available in arrow, so retry
+        # element-wise only when the bulk safe cast fails.
+        import math
+
+        def int_bounds(t):
+            bits = t.bit_width
+            if pa.types.is_signed_integer(t):
+                return -(1 << (bits - 1)), (1 << (bits - 1)) - 1
+            return 0, (1 << bits) - 1
+
+        def scalar_cast(v):
+            if v is None:
+                return None
+            if isinstance(v, float) and pa.types.is_integer(target):
+                if math.isnan(v) or math.isinf(v):
+                    return None
+                iv = int(v)  # truncation toward zero, like Spark
+                lo, hi = int_bounds(target)
+                return iv if lo <= iv <= hi else None
+            try:
+                return pc.cast(pa.array([v]), target)[0].as_py()
+            except (pa.ArrowInvalid, pa.ArrowNotImplementedError,
+                    pa.ArrowTypeError, ValueError, OverflowError):
+                return None
+
+        if isinstance(child, pa.Scalar):
+            return pa.scalar(scalar_cast(child.as_py()), type=target)
+        return pa.array([scalar_cast(v) for v in child.to_pylist()],
+                        type=target)
     if isinstance(expr, StringMatch):
         child = _arrow_eval(expr.child, table)
         if expr.kind == "like":

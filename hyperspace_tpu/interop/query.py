@@ -25,6 +25,7 @@ from hyperspace_tpu.plan.expr import (
     Arith,
     BinOp,
     Case,
+    Cast,
     Col,
     Expr,
     IsIn,
@@ -52,6 +53,8 @@ def value_expr_from_json(obj: Any) -> Expr:
                      value_expr_from_json(obj["right"]))
     if op == "neg":
         return Neg(value_expr_from_json(obj["child"]))
+    if op == "cast":
+        return Cast(value_expr_from_json(obj["child"]), obj["type"])
     if op == "case":
         # {"op": "case", "branches": [[cond, value], ...],
         #  "otherwise": value?}  Conditions are BOOLEAN expressions.
@@ -121,6 +124,9 @@ def dataset_from_spec(session, spec: Dict[str, Any]):
         if "filter" in j:
             other = other.filter(expr_from_json(j["filter"]))
         ds = ds.join(other, expr_from_json(j["on"]), j.get("how", "inner"))
+    if "union" in spec:
+        # UNION ALL with another full spec (query.py composes recursively).
+        ds = ds.union(dataset_from_spec(session, spec["union"]))
     if "aggs" in spec or "group_by" in spec:
         grouped = ds.group_by(*spec.get("group_by", []))
         # {out: [col_or_value_expr, func]}; expression inputs arrive as

@@ -82,6 +82,14 @@ class Expr:
     def is_not_null(self) -> "Expr":
         return Not(IsNull(self))
 
+    def cast(self, type_name: str) -> "Expr":
+        """Spark-style CAST with non-ANSI semantics: an unconvertible
+        value (e.g. 'abc' AS INT, or an overflow) becomes null instead of
+        raising.  ``type_name`` is an arrow type string: int8..int64,
+        float32/float64 (or double), string, bool, date32, timestamp[us],
+        ...  Host-evaluated."""
+        return Cast(self, type_name)
+
     # -- string predicates (SQL LIKE and friends; every TPC query uses
     # LIKE '%green%'-style matching).  Host-evaluated: strings never take
     # the device path.
@@ -222,6 +230,47 @@ class StringMatch(Expr):
         return f"{self.child!r}.{self.kind}({self.pattern!r})"
 
 
+# Spark SQL type-name spellings mapped to arrow aliases, so cast("long")
+# does what a Spark user expects instead of silently becoming a string.
+_CAST_ALIASES = {
+    "long": "int64", "bigint": "int64",
+    "integer": "int32", "int": "int32",
+    "short": "int16", "smallint": "int16",
+    "byte": "int8", "tinyint": "int8",
+    "double": "float64", "float": "float32",
+    "boolean": "bool", "str": "string",
+}
+
+
+class Cast(Expr):
+    """CAST(child AS type) with Spark's non-ANSI null-on-failure.  The
+    type name is validated EAGERLY: an unknown name raises here instead of
+    inheriting the schema reader's lenient fall-back-to-string (which
+    would silently produce a string column and wrong comparisons)."""
+
+    def __init__(self, child: Expr, type_name: str) -> None:
+        if not isinstance(type_name, str) or not type_name:
+            raise ValueError(f"cast type must be a type name, got "
+                             f"{type_name!r}")
+        name = _CAST_ALIASES.get(type_name.lower(), type_name)
+        from hyperspace_tpu.io.parquet import _dtype_from_string
+
+        import pyarrow as pa
+
+        resolved = _dtype_from_string(name)
+        if resolved == pa.string() and name not in ("string", "str", "utf8"):
+            raise ValueError(
+                f"Unknown cast type {type_name!r}; use an arrow type name "
+                f"(int8..int64, float32/float64, string, bool, date32, "
+                f"timestamp[us], ...) or a Spark spelling "
+                f"({', '.join(sorted(_CAST_ALIASES))})")
+        self.child = child
+        self.type_name = name
+
+    def __repr__(self) -> str:
+        return f"{self.child!r}.cast({self.type_name!r})"
+
+
 class Case(Expr):
     """CASE WHEN ... THEN ... [ELSE ...] END.  Spark semantics: branches
     evaluate in order; a null condition is FALSE (the branch is not
@@ -307,6 +356,8 @@ def _collect_columns(e: Expr, out: Set[str]) -> None:
     elif isinstance(e, IsNull):
         _collect_columns(e.child, out)
     elif isinstance(e, StringMatch):
+        _collect_columns(e.child, out)
+    elif isinstance(e, Cast):
         _collect_columns(e.child, out)
     elif isinstance(e, Case):
         for c, v in e.branches:

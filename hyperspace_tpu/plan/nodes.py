@@ -467,13 +467,24 @@ class InMemory(LogicalPlan):
 
 
 class Union(LogicalPlan):
-    """Plain union (the non-bucketed hybrid-scan merge, RuleUtils.scala:422-439)."""
+    """Plain union — the non-bucketed hybrid-scan merge
+    (RuleUtils.scala:422-439) and the public ``Dataset.union``.  Schemas
+    merge BY NAME with null promotion (the executor's concat does the
+    same), so the output is the first child's columns followed by any
+    names only later children produce."""
 
     def __init__(self, children: Sequence[LogicalPlan]) -> None:
         self.children = tuple(children)
 
     def output_columns(self, schema_of) -> List[str]:
-        return self.children[0].output_columns(schema_of)
+        out = list(self.children[0].output_columns(schema_of))
+        seen = set(out)
+        for c in self.children[1:]:
+            for name in c.output_columns(schema_of):
+                if name not in seen:
+                    seen.add(name)
+                    out.append(name)
+        return out
 
     def with_children(self, children) -> "Union":
         return Union(children)

@@ -256,3 +256,22 @@ class TestConcurrentClients:
                 client.query({"source": {"format": "parquet", "path": data},
                               "select": ["k"]})
             client.close()
+
+
+def test_spec_union_and_cast(env, tmp_path):
+    s, data = env
+    d2 = str(tmp_path / "u2")
+    os.makedirs(d2)
+    pq.write_table(pa.table({"k": pa.array([10_000, 10_001],
+                                           type=pa.int64())}),
+                   os.path.join(d2, "p.parquet"))
+    out = dataset_from_spec(s, {
+        "source": {"format": "parquet", "path": data},
+        "filter": {"op": "<", "left": {"op": "cast", "child": {"col": "k"},
+                                       "type": "float64"},
+                   "right": {"value": 2.0}},
+        "select": ["k"],
+        "union": {"source": {"format": "parquet", "path": d2},
+                  "select": ["k"]},
+    }).collect()
+    assert sorted(out.column("k").to_pylist()) == [0, 1, 10_000, 10_001]
