@@ -28,6 +28,7 @@ FILTER_RULE_USE_BUCKET_SPEC = "hyperspace.index.filterRule.useBucketSpec"
 CACHE_EXPIRY_SECONDS = "hyperspace.index.cache.expiryDurationInSeconds"
 SOURCE_PROVIDERS = "hyperspace.index.sources.fileBasedBuilders"
 SIGNATURE_PROVIDER = "hyperspace.index.signatureProvider"
+LOG_MANAGER_CLASS = "hyperspace.index.logManagerClass"
 EVENT_LOGGER = "hyperspace.eventLoggerClass"
 SUPPORTED_FILE_FORMATS = "hyperspace.index.supportedFileFormats"
 DEVICE_BATCH_ROWS = "hyperspace.tpu.deviceBatchRows"
@@ -82,6 +83,13 @@ class HyperspaceConf:
     cache_expiry_seconds: int = 300
     source_providers: str = "default,delta,iceberg"
     signature_provider: str = "IndexSignatureProvider"
+    # Operation-log backend, a dotted class path.  The default uses POSIX
+    # create-if-absent + atomic rename; object stores without atomic
+    # rename (e.g. GCS/S3 generation-/etag-conditional puts) plug in a
+    # subclass of IndexLogManager here — the seam SURVEY.md §7 flags as a
+    # hard part of the reference's HDFS-rename assumption.
+    log_manager_class: str = (
+        "hyperspace_tpu.index.log_manager.IndexLogManager")
     event_logger: str = ""
     # Reference default allow-list (HyperspaceConf.scala:97).
     supported_file_formats: str = "avro,csv,json,orc,parquet,text"
@@ -176,6 +184,7 @@ class HyperspaceConf:
         CACHE_EXPIRY_SECONDS: "cache_expiry_seconds",
         SOURCE_PROVIDERS: "source_providers",
         SIGNATURE_PROVIDER: "signature_provider",
+        LOG_MANAGER_CLASS: "log_manager_class",
         EVENT_LOGGER: "event_logger",
         SUPPORTED_FILE_FORMATS: "supported_file_formats",
         DEVICE_BATCH_ROWS: "device_batch_rows",

@@ -124,8 +124,12 @@ class Dataset:
         return Dataset(Distinct(self.plan), self.session)
 
     def union(self, other: "Dataset") -> "Dataset":
-        """UNION ALL (Spark's union: bag semantics, schemas merged by
-        name with null promotion).  Chain ``.distinct()`` for SQL UNION."""
+        """UNION ALL with bag semantics, columns resolved BY NAME and
+        missing columns null-filled — Spark's
+        ``unionByName(allowMissingColumns=True)``, not positional
+        ``union``.  Numeric widths widen (int32 ∪ int64 → int64); truly
+        incompatible same-named types fail at execution.  Chain
+        ``.distinct()`` for SQL UNION."""
         return Dataset(Union([self.plan, other.plan]), self.session)
 
     def group_by(self, *columns: str) -> "GroupedDataset":

@@ -139,7 +139,10 @@ class Executor:
             return table.slice(0, plan.n)
         if isinstance(plan, (BucketUnion, Union)):
             tables = [self.execute(c) for c in plan.children]
-            return pa.concat_tables(tables, promote_options="default")
+            # "permissive" widens same-named numeric columns of different
+            # widths (int32 ∪ int64 -> int64, int ∪ float -> double) like
+            # Spark's unionByName; incompatible types still error.
+            return pa.concat_tables(tables, promote_options="permissive")
         raise ValueError(f"Unknown plan node: {type(plan).__name__}")
 
     # -- aggregate ----------------------------------------------------------

@@ -19,6 +19,34 @@ from hyperspace_tpu.index.log_manager import IndexLogManager
 from hyperspace_tpu.index.path_resolver import PathResolver
 
 
+def _resolve_log_manager_class(name: str) -> type:
+    """Dotted-path class loader for the operation-log backend (the
+    object-store seam: stores without atomic rename plug a conditional-put
+    IndexLogManager subclass into ``hyperspace.index.logManagerClass``).
+    Memoized: one import per class name."""
+    cls = _LOG_MANAGER_CACHE.get(name)
+    if cls is not None:
+        return cls
+    import importlib
+
+    module_name, _, cls_name = name.replace(":", ".").rpartition(".")
+    if not module_name:
+        raise HyperspaceError(f"Invalid log manager class: {name!r}")
+    try:
+        cls = getattr(importlib.import_module(module_name), cls_name)
+    except (ImportError, AttributeError) as e:
+        raise HyperspaceError(
+            f"Cannot load log manager class {name!r} ({e})") from e
+    if not (isinstance(cls, type) and issubclass(cls, IndexLogManager)):
+        raise HyperspaceError(
+            f"{name!r} is not an IndexLogManager subclass")
+    _LOG_MANAGER_CACHE[name] = cls
+    return cls
+
+
+_LOG_MANAGER_CACHE: dict = {}
+
+
 class IndexCollectionManager:
     def __init__(self, session) -> None:
         self.session = session
@@ -26,7 +54,8 @@ class IndexCollectionManager:
 
     # -- manager plumbing (index/factories.scala:24-54) ---------------------
     def _log_manager(self, name: str) -> IndexLogManager:
-        return IndexLogManager(self.path_resolver.get_index_path(name))
+        cls = _resolve_log_manager_class(self.session.conf.log_manager_class)
+        return cls(self.path_resolver.get_index_path(name))
 
     def _data_manager(self, name: str) -> IndexDataManager:
         return IndexDataManager(self.path_resolver.get_index_path(name))

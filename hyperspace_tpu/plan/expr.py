@@ -252,7 +252,10 @@ class Cast(Expr):
         if not isinstance(type_name, str) or not type_name:
             raise ValueError(f"cast type must be a type name, got "
                              f"{type_name!r}")
-        name = _CAST_ALIASES.get(type_name.lower(), type_name)
+        # Spark type names are case-insensitive; arrow aliases are
+        # lowercase — normalize once so CAST(x AS STRING) works too.
+        lowered = type_name.lower()
+        name = _CAST_ALIASES.get(lowered, lowered)
         from hyperspace_tpu.io.parquet import _dtype_from_string
 
         import pyarrow as pa

@@ -483,3 +483,29 @@ def test_union_schema_merge_by_name(tmp_path):
     assert u.columns == ["k", "extra"]
     out = u.sort("k").collect()
     assert out.column("extra").to_pylist() == [None, 9]
+
+
+def test_cast_is_case_insensitive(env):
+    s, data, _df = env
+    out = (s.read.parquet(data)
+           .select(a=col("k").cast("STRING"), b=col("k").cast("Long"))
+           .limit(1).collect())
+    assert pa.types.is_string(out.schema.field("a").type)
+    assert pa.types.is_int64(out.schema.field("b").type)
+
+
+def test_union_widens_numeric_types(tmp_path):
+    from hyperspace_tpu import HyperspaceSession
+
+    d1, d2 = str(tmp_path / "w1"), str(tmp_path / "w2")
+    os.makedirs(d1)
+    os.makedirs(d2)
+    pq.write_table(pa.table({"k": pa.array([1], type=pa.int32())}),
+                   os.path.join(d1, "p.parquet"))
+    pq.write_table(pa.table({"k": pa.array([2], type=pa.int64())}),
+                   os.path.join(d2, "p.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    out = (s.read.parquet(d1).union(s.read.parquet(d2))
+           .sort("k").collect())
+    assert pa.types.is_int64(out.schema.field("k").type)
+    assert out.column("k").to_pylist() == [1, 2]
