@@ -139,10 +139,16 @@ class Executor:
             return table.slice(0, plan.n)
         if isinstance(plan, (BucketUnion, Union)):
             tables = [self.execute(c) for c in plan.children]
-            # "permissive" widens same-named numeric columns of different
-            # widths (int32 ∪ int64 -> int64, int ∪ float -> double) like
-            # Spark's unionByName; incompatible types still error.
-            return pa.concat_tables(tables, promote_options="permissive")
+            # Public Union: "permissive" widens same-named numeric columns
+            # of different widths (int32 ∪ int64 -> int64, int ∪ float ->
+            # double) like Spark's unionByName.  BucketUnion merges an
+            # INDEX with its own source's appended rows — a width mismatch
+            # there is index/source schema drift that must stay LOUD (a
+            # silent int64 ∪ float64 -> double promotion would corrupt
+            # >2^53 keys), so it keeps strict-by-name promotion.
+            promote = "permissive" if isinstance(plan, Union) \
+                and not plan.strict else "default"
+            return pa.concat_tables(tables, promote_options=promote)
         raise ValueError(f"Unknown plan node: {type(plan).__name__}")
 
     # -- aggregate ----------------------------------------------------------
@@ -843,7 +849,8 @@ class Executor:
                 parts.append(_rewrap(side.scan, side.inner, by_bucket[bucket]))
             if bucket in appended_by_bucket:
                 parts.append(InMemory(appended_by_bucket[bucket]))
-            node = parts[0] if len(parts) == 1 else Union(parts)
+            # strict: index ∪ its own appended rows (see Union docstring).
+            node = parts[0] if len(parts) == 1 else Union(parts, strict=True)
             for w in reversed(side.outer):
                 node = w.with_children((node,))
             return node

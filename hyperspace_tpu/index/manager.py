@@ -20,31 +20,12 @@ from hyperspace_tpu.index.path_resolver import PathResolver
 
 
 def _resolve_log_manager_class(name: str) -> type:
-    """Dotted-path class loader for the operation-log backend (the
-    object-store seam: stores without atomic rename plug a conditional-put
-    IndexLogManager subclass into ``hyperspace.index.logManagerClass``).
-    Memoized: one import per class name."""
-    cls = _LOG_MANAGER_CACHE.get(name)
-    if cls is not None:
-        return cls
-    import importlib
+    """Conf-pluggable operation-log backend (the object-store seam:
+    stores without atomic rename plug a conditional-put IndexLogManager
+    subclass into ``hyperspace.index.logManagerClass``)."""
+    from hyperspace_tpu.utils.reflection import load_class
 
-    module_name, _, cls_name = name.replace(":", ".").rpartition(".")
-    if not module_name:
-        raise HyperspaceError(f"Invalid log manager class: {name!r}")
-    try:
-        cls = getattr(importlib.import_module(module_name), cls_name)
-    except (ImportError, AttributeError) as e:
-        raise HyperspaceError(
-            f"Cannot load log manager class {name!r} ({e})") from e
-    if not (isinstance(cls, type) and issubclass(cls, IndexLogManager)):
-        raise HyperspaceError(
-            f"{name!r} is not an IndexLogManager subclass")
-    _LOG_MANAGER_CACHE[name] = cls
-    return cls
-
-
-_LOG_MANAGER_CACHE: dict = {}
+    return load_class(name, IndexLogManager, HyperspaceError)
 
 
 class IndexCollectionManager:

@@ -253,9 +253,14 @@ class Cast(Expr):
             raise ValueError(f"cast type must be a type name, got "
                              f"{type_name!r}")
         # Spark type names are case-insensitive; arrow aliases are
-        # lowercase — normalize once so CAST(x AS STRING) works too.
-        lowered = type_name.lower()
-        name = _CAST_ALIASES.get(lowered, lowered)
+        # lowercase.  Lowercase only the type HEAD — a parametrized
+        # payload like timestamp[us, tz=America/New_York] carries a
+        # case-sensitive IANA zone name that must pass through untouched.
+        import re as _re
+
+        m = _re.match(r"([^\[\(]*)(.*)", type_name, _re.DOTALL)
+        head, payload = m.group(1).strip().lower(), m.group(2)
+        name = _CAST_ALIASES.get(head, head) + payload
         from hyperspace_tpu.io.parquet import _dtype_from_string
 
         import pyarrow as pa

@@ -471,10 +471,18 @@ class Union(LogicalPlan):
     (RuleUtils.scala:422-439) and the public ``Dataset.union``.  Schemas
     merge BY NAME with null promotion (the executor's concat does the
     same), so the output is the first child's columns followed by any
-    names only later children produce."""
+    names only later children produce.
 
-    def __init__(self, children: Sequence[LogicalPlan]) -> None:
+    ``strict`` controls type promotion at execution: the public verb
+    widens numeric widths like Spark's unionByName; engine-internal
+    merges (hybrid scan: index ∪ appended source rows) stay strict so
+    index/source schema drift fails loudly instead of silently widening
+    (int64 ∪ float64 -> double would corrupt >2^53 keys)."""
+
+    def __init__(self, children: Sequence[LogicalPlan],
+                 strict: bool = False) -> None:
         self.children = tuple(children)
+        self.strict = bool(strict)
 
     def output_columns(self, schema_of) -> List[str]:
         out = list(self.children[0].output_columns(schema_of))
@@ -487,7 +495,7 @@ class Union(LogicalPlan):
         return out
 
     def with_children(self, children) -> "Union":
-        return Union(children)
+        return Union(children, strict=self.strict)
 
     def simple_string(self) -> str:
         return "Union"

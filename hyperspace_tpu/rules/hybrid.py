@@ -139,7 +139,9 @@ def transform_plan_to_use_hybrid_scan(session, plan: LogicalPlan, target: Scan,
                 [index_side, appended_side],
                 (entry.num_buckets, cols, cols))
         else:
-            merged = Union([index_side, appended_side])
+            # strict: the index ∪ its own source must not silently widen
+            # on schema drift (see Union's docstring).
+            merged = Union([index_side, appended_side], strict=True)
     else:
         merged = index_side
 

@@ -141,18 +141,12 @@ def resolve_event_logger(name: str) -> EventLogger:
     anything that does not resolve to an EventLogger subclass."""
     cls = LOGGER_REGISTRY.get(name)
     if cls is None:
-        import importlib
+        from hyperspace_tpu.utils.reflection import load_class
 
-        module_name, _, cls_name = name.replace(":", ".").rpartition(".")
-        if not module_name:
-            raise ValueError(f"Unknown event logger: {name!r}")
         try:
-            cls = getattr(importlib.import_module(module_name), cls_name)
-        except (ImportError, AttributeError) as e:
+            cls = load_class(name, EventLogger, ValueError)
+        except ValueError as e:
             raise ValueError(f"Unknown event logger: {name!r} ({e})") from e
-        if not (isinstance(cls, type) and issubclass(cls, EventLogger)):
-            raise ValueError(
-                f"{name!r} is not an EventLogger subclass")
     return cls()
 
 

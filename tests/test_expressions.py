@@ -509,3 +509,12 @@ def test_union_widens_numeric_types(tmp_path):
            .sort("k").collect())
     assert pa.types.is_int64(out.schema.field("k").type)
     assert out.column("k").to_pylist() == [1, 2]
+
+
+def test_cast_preserves_timezone_case(env):
+    s, data, _df = env
+    out = (s.read.parquet(data)
+           .select(t=col("k").cast("TIMESTAMP[us, tz=America/New_York]"))
+           .limit(1).collect())
+    assert str(out.schema.field("t").type) == \
+        "timestamp[us, tz=America/New_York]"

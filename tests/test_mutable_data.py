@@ -320,3 +320,39 @@ def test_optimize_keeps_zorder_layout_order(env, tmp_path):
     assert scans, plan.tree_string()
     kept, total = scans[0].relation.data_skipping_stats
     assert kept <= total // 2, (kept, total)  # y-pruning survives compaction
+
+
+def test_hybrid_scan_schema_drift_fails_loudly(tmp_path):
+    """An appended source file whose column type DRIFTED from the indexed
+    type must error at the hybrid merge, not silently widen (int64 keys
+    above 2^53 would corrupt under a double promotion)."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
+
+    d = str(tmp_path / "drift")
+    os.makedirs(d)
+    pq.write_table(pa.table({
+        "k": pa.array(np.arange(400, dtype=np.int64)),
+        "v": pa.array(np.arange(400, dtype=np.int64)),
+    }), os.path.join(d, "p.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    s.conf.num_buckets = 2
+    hs = Hyperspace(s)
+    hs.create_index(s.read.parquet(d), IndexConfig("dr", ["k"], ["v"]))
+    # Drifted append: v became float64.
+    pq.write_table(pa.table({
+        "k": pa.array([1000], type=pa.int64()),
+        "v": pa.array([0.5], type=pa.float64()),
+    }), os.path.join(d, "p2.parquet"))
+    s.conf.hybrid_scan_enabled = True
+    s.enable_hyperspace()
+    ds = s.read.parquet(d).filter(col("k") >= 0).select("k", "v")
+    plan = ds.optimized_plan()
+    used = [sc for sc in plan.leaf_relations() if sc.relation.index_scan_of]
+    if not used:
+        pytest.skip("hybrid rewrite did not fire for this shape")
+    with pytest.raises(pa.ArrowTypeError):
+        ds.collect()
