@@ -94,3 +94,51 @@ def test_create_index_race_one_winner(tmp_path):
     out = (s.read.parquet(data).filter(col("id") == 5)
            .select("id", "name").collect())
     assert out.num_rows == 1
+
+
+def test_concurrent_optimize_and_collect_threads(tmp_path):
+    """The session serializes its OPTIMIZE step (shared entry tags +
+    schema memo) while executions overlap — N threads querying one
+    session with rewrites enabled must all get exact answers."""
+    import threading
+
+    from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
+
+    d = str(tmp_path / "cc")
+    os.makedirs(d)
+    pq.write_table(pa.table({
+        "k": pa.array(np.arange(5000, dtype=np.int64)),
+        "v": pa.array(np.arange(5000) * 2.0),
+    }), os.path.join(d, "p.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    s.conf.num_buckets = 4
+    hs = Hyperspace(s)
+    hs.create_index(s.read.parquet(d), IndexConfig("cc", ["k"], ["v"]))
+    s.enable_hyperspace()
+    errors = []
+    results = {}
+
+    def worker(k):
+        try:
+            for _ in range(5):
+                out = (s.read.parquet(d).filter(col("k") == k)
+                       .select("k", "v").collect())
+                assert out.column("v").to_pylist() == [k * 2.0]
+                # Thread-local stats: this thread's own query only.
+                stats = s.last_execution_stats
+                assert any(x["is_index"] for x in stats["scans"])
+            results[k] = True
+        except Exception as e:  # noqa: BLE001
+            errors.append((k, repr(e)))
+
+    # daemon: a regression that deadlocks a worker (the exact hazard this
+    # test guards) must become a bounded failure, not a hung interpreter.
+    threads = [threading.Thread(target=worker, args=(k,), daemon=True)
+               for k in range(12)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not any(t.is_alive() for t in threads), "worker deadlocked"
+    assert not errors, errors
+    assert len(results) == 12
