@@ -390,14 +390,53 @@ class Executor:
                 # plainly numeric literal under + - * / neg arithmetic (no
                 # temporal normalization inside arithmetic; division is
                 # host-only for x/0 -> null 3VL; CASE/string nodes are
-                # host-only entirely).
-                return all(_arith_device_ok(s) for s in sides)
+                # host-only entirely).  Column leaves must be strictly
+                # int/float: temporal/bool pass the outer is_numeric_type
+                # gate but arithmetic over their int64 normalization is
+                # unit-dependent (and the host mirror raises), so routing
+                # must not depend on row count.
+                return all(_arith_device_ok(s, table) for s in sides)
+            cols_in_cmp = [s for s in sides if isinstance(s, Col)]
+            if not cols_in_cmp:
+                # Lit-vs-Lit: constant predicates are host-only (the arrow
+                # path owns their 3VL), and there is no column type to
+                # normalize a temporal literal against.
+                return False
+            col_types = [table.schema.field(c.name).type for c in cols_in_cmp]
+            if any(pa.types.is_temporal(t) for t in col_types):
+                # Temporal columns compare on device only against a
+                # temporal-typed literal (normalized below) or a column of
+                # the SAME temporal type (same epoch unit).  A raw numeric
+                # literal or a mixed-type column pair must route to host —
+                # comparing epoch int64s against plain numbers would give a
+                # silently different answer above the row threshold than
+                # the host path's loud error below it.
+                if len(col_types) == 2 and (
+                        not all(pa.types.is_temporal(t) for t in col_types)
+                        or col_types[0] != col_types[1]):
+                    return False
+                if any(isinstance(s, Lit)
+                       and isinstance(s.value, (int, float, bool,
+                                                np.integer, np.floating,
+                                                np.bool_))
+                       for s in sides):
+                    return False
             for side in sides:
-                if isinstance(side, Lit) and not isinstance(side.value, (int, float, bool)):
+                if not isinstance(side, Lit):
+                    continue
+                v = side.value
+                bool_lit = isinstance(v, (bool, np.bool_))
+                if bool_lit != pa.types.is_boolean(col_types[0]) and (
+                        bool_lit or isinstance(v, (int, float, np.integer,
+                                                   np.floating))):
+                    # bool-vs-numeric in either direction: arrow has no
+                    # mixed (int64, bool) comparison kernel, so the host
+                    # path raises — the device path must not silently
+                    # answer instead.
+                    return False
+                if not isinstance(v, (int, float, bool)):
                     # Temporal/string literals: host path normalizes them.
-                    t = table.schema.field(
-                        (expr.left if isinstance(expr.left, Col) else expr.right).name).type
-                    if columnar.literal_to_numeric(side.value, t) is None:
+                    if columnar.literal_to_numeric(v, col_types[0]) is None:
                         return False
             return True
         if isinstance(expr, (And, Or)):
@@ -406,7 +445,12 @@ class Executor:
         if isinstance(expr, Not):
             return self._device_compatible(expr.child, table)
         if isinstance(expr, IsIn):
-            return all(isinstance(v, (int, float, bool)) for v in expr.values)
+            # The child must be strictly-numeric (temporal/bool columns
+            # would be compared as raw epoch int64s against the plain
+            # numeric value set — the host path raises instead).
+            return (_arith_device_ok(expr.child, table)
+                    and all(isinstance(v, (int, float, bool))
+                            for v in expr.values))
         return False
 
     def _eval_device(self, expr: Expr, table: pa.Table) -> np.ndarray:
@@ -936,18 +980,28 @@ def _valid_key_positions(table: pa.Table, keys: List[str]) -> np.ndarray:
         else np.arange(table.num_rows)
 
 
-def _arith_device_ok(e: Expr) -> bool:
-    """Device-evaluable value expression: columns, numeric literals, and
-    + - * arithmetic over them (division is host-only: x/0 must null)."""
+def _arith_device_ok(e: Expr, table: pa.Table) -> bool:
+    """Device-evaluable value expression: strictly-numeric columns, numeric
+    literals, and + - * arithmetic over them (division is host-only: x/0
+    must null; temporal/bool columns are host-only: their arithmetic over
+    raw int64 normalization would be unit-dependent)."""
     if isinstance(e, Col):
-        return True
+        try:
+            t = table.schema.field(e.name).type
+        except KeyError:
+            return False
+        return pa.types.is_integer(t) or pa.types.is_floating(t)
     if isinstance(e, Lit):
-        return isinstance(e.value, (int, float, bool))
+        # bool is excluded despite being an int subclass: the host mirror
+        # has no (int64, bool) arithmetic kernel, so admitting it would
+        # key the outcome on row count.
+        return (isinstance(e.value, (int, float))
+                and not isinstance(e.value, bool))
     if isinstance(e, Arith):
-        return (e.op != "/" and _arith_device_ok(e.left)
-                and _arith_device_ok(e.right))
+        return (e.op != "/" and _arith_device_ok(e.left, table)
+                and _arith_device_ok(e.right, table))
     if isinstance(e, Neg):
-        return _arith_device_ok(e.child)
+        return _arith_device_ok(e.child, table)
     return False
 
 
@@ -1147,6 +1201,17 @@ def _eval_column(expr: Expr, table: pa.Table):
     return result
 
 
+def _coerce_numeric_strings(column) -> np.ndarray:
+    """Vectorized null-on-failure numeric parse of a string column:
+    float64 values with NaN where the string (or a null) didn't parse —
+    the one shared home for the pd.to_numeric coerce idiom."""
+    import pandas as pd
+
+    arr = column.to_numpy(zero_copy_only=False)
+    return pd.to_numeric(pd.Series(arr), errors="coerce") \
+        .to_numpy(dtype=np.float64, na_value=np.nan)
+
+
 def _parse_numeric(column, target_type) -> pa.Array:
     """Parse a string column as ``target_type``, null on failure — the
     Spark coercion for string-column vs numeric-literal comparisons
@@ -1155,14 +1220,9 @@ def _parse_numeric(column, target_type) -> pa.Array:
     try:
         return pc.cast(column, target_type)
     except (pa.ArrowInvalid, pa.ArrowTypeError):
-        import pandas as pd
-
-        # Vectorized null-on-failure parse ('abc' -> NaN, which no
-        # comparison matches — same row-drop effect as Spark's null).
-        arr = column.to_numpy(zero_copy_only=False)
-        vals = pd.to_numeric(pd.Series(arr), errors="coerce") \
-            .to_numpy(dtype=np.float64)
-        return pa.array(vals, type=target_type)
+        # 'abc' -> NaN, which no comparison matches — same row-drop
+        # effect as Spark's null.
+        return pa.array(_coerce_numeric_strings(column), type=target_type)
 
 
 def _arrow_eval(expr: Expr, table: pa.Table):
@@ -1273,12 +1333,30 @@ def _arrow_eval(expr: Expr, table: pa.Table):
         def scalar_cast(v):
             if v is None:
                 return None
-            if isinstance(v, float) and pa.types.is_integer(target):
-                if math.isnan(v) or math.isinf(v):
-                    return None
-                iv = int(v)  # truncation toward zero, like Spark
+            if isinstance(v, (float, str)) and pa.types.is_integer(target):
+                # Spark parses numeric strings as decimal and truncates:
+                # '3.5' AS INT is 3, not null.  Integer strings parse
+                # EXACTLY (int64 strings must not round-trip via float64)
+                # but only ASCII-digit forms — int()'s Python-only syntax
+                # ('1_000', unicode digits) must null exactly like the
+                # vectorized pd.to_numeric column path does.
+                if isinstance(v, str):
+                    import re
+
+                    sv = v.strip()
+                    if re.fullmatch(r"[+-]?[0-9]+", sv):
+                        v = int(sv)
+                    else:
+                        f = _coerce_numeric_strings(pa.array([v]))[0]
+                        if math.isnan(f):
+                            return None
+                        v = float(f)
+                if isinstance(v, float):
+                    if math.isnan(v) or math.isinf(v):
+                        return None
+                    v = int(v)  # truncation toward zero, like Spark
                 lo, hi = int_bounds(target)
-                return iv if lo <= iv <= hi else None
+                return v if lo <= v <= hi else None
             try:
                 return pc.cast(pa.array([v]), target)[0].as_py()
             except (pa.ArrowInvalid, pa.ArrowNotImplementedError,
@@ -1287,6 +1365,48 @@ def _arrow_eval(expr: Expr, table: pa.Table):
 
         if isinstance(child, pa.Scalar):
             return pa.scalar(scalar_cast(child.as_py()), type=target)
+        ctype = child.type
+        if (pa.types.is_integer(target) and target.bit_width <= 64
+                and not (pa.types.is_unsigned_integer(target)
+                         and target.bit_width == 64)
+                and (pa.types.is_floating(ctype)
+                     or pa.types.is_string(ctype)
+                     or pa.types.is_large_string(ctype))):
+            # The common fallback cases — float->int with fractional values,
+            # string->int with any bad value — stay vectorized instead of
+            # an O(n) Python loop.  Numeric strings parse as decimal first
+            # ('3.5' AS INT is 3, like Spark), bad values null.
+            valid = np.asarray(
+                pc.is_valid(child).to_numpy(zero_copy_only=False))
+            if pa.types.is_floating(ctype):
+                arr = np.asarray(pc.fill_null(child, 0.0)
+                                 .to_numpy(zero_copy_only=False),
+                                 dtype=np.float64)
+            else:
+                arr = _coerce_numeric_strings(child)
+                valid &= ~np.isnan(arr)
+                arr = np.where(np.isnan(arr), 0.0, arr)
+            finite = np.isfinite(arr)
+            trunc = np.trunc(np.where(finite, arr, 0.0))
+            lo, hi = int_bounds(target)
+            hi_f = float(hi)
+            ok = valid & finite & (trunc >= float(lo)) & (
+                trunc <= hi_f if int(hi_f) == hi else trunc < hi_f)
+            vals = np.where(ok, trunc, 0.0).astype(np.int64)
+            if not pa.types.is_floating(ctype):
+                # float64 is exact only below 2**53: integer strings in the
+                # tail (int64-range ids) re-parse exactly, element-wise
+                # over just those rows.
+                big = np.nonzero(valid & (np.abs(trunc) >= 2.0**53))[0]
+                for i in big.tolist():
+                    exact = scalar_cast(child[i].as_py())
+                    if exact is None:
+                        ok[i] = False
+                    else:
+                        vals[i] = exact
+                        ok[i] = True
+            out = pa.array(vals, mask=~ok)
+            return pc.cast(out, target)
         return pa.array([scalar_cast(v) for v in child.to_pylist()],
                         type=target)
     if isinstance(expr, StringMatch):

@@ -144,9 +144,19 @@ def dataset_from_spec(session, spec: Dict[str, Any]):
         ds = ds.limit(int(spec["limit"]))
     if "select" in spec:
         # Entries are column names, or {"name": out, "expr": value-expr}
-        # for computed projections.
-        names = [c for c in spec["select"] if isinstance(c, str)]
-        computed = {c["name"]: value_expr_from_json(c["expr"])
-                    for c in spec["select"] if isinstance(c, dict)}
-        ds = ds.select(*names, **computed)
+        # for computed projections.  When any computed entry is present the
+        # Compute node is built directly in spec order — Dataset.select's
+        # names-then-keywords signature would move computed columns after
+        # all plain names, losing the caller's interleaving.
+        entries = spec["select"]
+        if any(isinstance(c, dict) for c in entries):
+            from hyperspace_tpu.dataset import Dataset
+            from hyperspace_tpu.plan.nodes import Compute
+
+            exprs = [(c, Col(c)) if isinstance(c, str)
+                     else (c["name"], value_expr_from_json(c["expr"]))
+                     for c in entries]
+            ds = Dataset(Compute(exprs, ds.plan), ds.session)
+        else:
+            ds = ds.select(*entries)
     return ds

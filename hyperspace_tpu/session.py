@@ -87,23 +87,36 @@ class HyperspaceSession:
 
             apply_conf_event_logger(self.conf.event_logger)
         self._schema_cache: Dict[object, Dict[str, str]] = {}
-        # Lake-schema memo, live only inside one optimize() pass: a query
-        # sees one snapshot, so memoizing there is safe; across queries it
-        # would go stale (overwrites can change the schema mid-session).
-        self._lake_schema_memo: Optional[Dict[object, Dict[str, str]]] = None
-        # optimize() mutates shared state (the lake-schema memo and the
-        # cached IndexLogEntry tags it clears per pass), so concurrent
-        # queries — e.g. interop server threads — serialize the OPTIMIZE
-        # step only; execution runs outside the lock.
+        # optimize() mutates shared state (the cached IndexLogEntry tags it
+        # clears per pass), so concurrent queries — e.g. interop server
+        # threads — serialize the OPTIMIZE step only; execution runs
+        # outside the lock.
         import threading
 
         self._optimize_lock = threading.RLock()
+        # Lake-schema memo, live only inside one optimize() pass: a query
+        # sees one snapshot, so memoizing there is safe; across queries it
+        # would go stale (overwrites can change the schema mid-session).
+        # THREAD LOCAL: schema_map_of also runs outside the optimize lock
+        # (executor mesh-join gates, hybrid-scan checks), so another
+        # thread's in-flight pass must never see — or populate — this
+        # thread's snapshot memo.
+        self._lake_memo_tls = threading.local()
         # Physical stats of the most recent Dataset.collect() — THREAD
         # LOCAL so a server thread's query can never overwrite the stats a
         # local caller reads right after its own collect()
         # (see Executor.stats; the property pair below).
         self._exec_stats = threading.local()
         self.last_execution_stats = None
+
+    @property
+    def _lake_schema_memo(self) -> Optional[Dict[object, Dict[str, str]]]:
+        return getattr(self._lake_memo_tls, "memo", None)
+
+    @_lake_schema_memo.setter
+    def _lake_schema_memo(
+            self, value: Optional[Dict[object, Dict[str, str]]]) -> None:
+        self._lake_memo_tls.memo = value
 
     @property
     def last_execution_stats(self) -> Optional[Dict[str, list]]:

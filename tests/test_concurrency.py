@@ -142,3 +142,25 @@ def test_concurrent_optimize_and_collect_threads(tmp_path):
     assert not any(t.is_alive() for t in threads), "worker deadlocked"
     assert not errors, errors
     assert len(results) == 12
+
+
+def test_lake_schema_memo_is_thread_local(tmp_path):
+    """One thread's in-flight optimize memo must be invisible to another
+    thread's schema_map_of (the cross-query snapshot-leak guard)."""
+    import threading
+
+    from hyperspace_tpu import HyperspaceSession
+
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    s._lake_schema_memo = {"mine": {"a": "int64"}}
+    seen = {}
+
+    def other():
+        seen["before"] = s._lake_schema_memo
+        s._lake_schema_memo = {"theirs": {}}
+
+    t = threading.Thread(target=other)
+    t.start()
+    t.join()
+    assert seen["before"] is None
+    assert s._lake_schema_memo == {"mine": {"a": "int64"}}

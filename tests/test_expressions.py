@@ -518,3 +518,201 @@ def test_cast_preserves_timezone_case(env):
            .limit(1).collect())
     assert str(out.schema.field("t").type) == \
         "timestamp[us, tz=America/New_York]"
+
+
+def test_cast_decimal_string_truncates_like_spark(tmp_path):
+    """'3.5' AS INT is 3 (Spark parses numeric strings as decimal and
+    truncates), and the fallback stays vectorized for large columns."""
+    from hyperspace_tpu import HyperspaceSession
+
+    d = str(tmp_path / "cast")
+    os.makedirs(d)
+    pq.write_table(pa.table({
+        "s": pa.array(["3.5", "-2.9", "1e2", "abc", None, " 7 ", "inf"]),
+    }), os.path.join(d, "p.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    out = s.read.parquet(d).select(i=col("s").cast("int")).collect()
+    assert out.column("i").to_pylist() == [3, -2, 100, None, None, 7, None]
+
+
+def test_temporal_arithmetic_routing_does_not_depend_on_row_count(tmp_path):
+    """(date1 - date2) > k must behave identically whether the batch is
+    above or below deviceFilterMinRows — temporal columns inside compound
+    arithmetic never take the device int64-normalized path."""
+    from hyperspace_tpu import HyperspaceSession
+
+    d = str(tmp_path / "tmp_arith")
+    os.makedirs(d)
+    base = np.datetime64("2024-01-01")
+    pq.write_table(pa.table({
+        "d1": pa.array(base + np.arange(200, dtype="timedelta64[D]")),
+        "d2": pa.array(np.repeat(base, 200)),
+        "k": pa.array(np.arange(200, dtype=np.int64)),
+    }), os.path.join(d, "p.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    pred = (col("d1") - col("d2")) > 30
+
+    def outcome():
+        try:
+            return ("ok", s.read.parquet(d).filter(pred).count())
+        except Exception as e:
+            return ("err", type(e).__name__)
+
+    s.conf.device_filter_min_rows = 10**9
+    host = outcome()
+    s.conf.device_filter_min_rows = 1
+    dev = outcome()
+    assert host == dev, f"routing changed semantics: {host} vs {dev}"
+
+
+def test_cast_int64_strings_parse_exactly(tmp_path):
+    """Integer strings in the float64-inexact tail keep full precision
+    (ids near 2**63 must not round-trip through double)."""
+    from hyperspace_tpu import HyperspaceSession
+
+    d = str(tmp_path / "cast_big")
+    os.makedirs(d)
+    pq.write_table(pa.table({
+        "s": pa.array(["9223372036854775807", "1234567890123456789",
+                       "bad", "9223372036854775808", "-9223372036854775808",
+                       "3.5"]),
+    }), os.path.join(d, "p.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    out = s.read.parquet(d).select(i=col("s").cast("bigint")).collect()
+    assert out.column("i").to_pylist() == [
+        9223372036854775807, 1234567890123456789, None, None,
+        -9223372036854775808, 3]
+
+
+def test_constant_predicate_routing_does_not_depend_on_row_count(tmp_path):
+    """(col > 0) AND ('a' == 'b'): a Lit-vs-Lit conjunct must not crash
+    the device-compat gate above deviceFilterMinRows."""
+    from hyperspace_tpu import HyperspaceSession
+
+    d = str(tmp_path / "constpred")
+    os.makedirs(d)
+    pq.write_table(pa.table({"k": pa.array(np.arange(100, dtype=np.int64))}),
+                   os.path.join(d, "p.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    pred = (col("k") > 0) & (lit("a") == lit("b"))
+    s.conf.device_filter_min_rows = 10**9
+    host = s.read.parquet(d).filter(pred).count()
+    s.conf.device_filter_min_rows = 1
+    dev = s.read.parquet(d).filter(pred).count()
+    assert host == dev == 0
+
+
+def test_temporal_simple_comparison_routing_parity(tmp_path):
+    """A temporal column vs a raw numeric literal (or a non-temporal
+    column) must behave identically on both sides of deviceFilterMinRows —
+    the device path must not silently compare epoch int64s."""
+    from hyperspace_tpu import HyperspaceSession
+
+    d = str(tmp_path / "tmp_simple")
+    os.makedirs(d)
+    base = np.datetime64("2024-01-01")
+    pq.write_table(pa.table({
+        "d1": pa.array(base + np.arange(100, dtype="timedelta64[D]")),
+        "k": pa.array(np.arange(100, dtype=np.int64)),
+    }), os.path.join(d, "p.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+
+    def outcome(pred):
+        try:
+            return ("ok", s.read.parquet(d).filter(pred).count())
+        except Exception as e:
+            return ("err", type(e).__name__)
+
+    for pred in (col("d1") > 30, col("d1") > col("k")):
+        s.conf.device_filter_min_rows = 10**9
+        host = outcome(pred)
+        s.conf.device_filter_min_rows = 1
+        dev = outcome(pred)
+        assert host == dev, f"{pred!r}: {host} vs {dev}"
+    # Temporal-vs-temporal (same type) stays device-eligible and correct.
+    s.conf.device_filter_min_rows = 1
+    import datetime
+
+    n = s.read.parquet(d).filter(
+        col("d1") >= datetime.date(2024, 2, 1)).count()
+    assert n == 100 - 31
+
+
+def test_cast_scalar_and_column_paths_agree_on_python_only_syntax(tmp_path):
+    """'1_000' AS INT nulls on BOTH the literal-scalar path and the column
+    path (Spark rejects Python-only integer syntax)."""
+    from hyperspace_tpu import HyperspaceSession
+
+    d = str(tmp_path / "cast_sep")
+    os.makedirs(d)
+    pq.write_table(pa.table({"s": pa.array(["1_000", "25"])}),
+                   os.path.join(d, "p.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    out = (s.read.parquet(d)
+           .select(i=col("s").cast("int"), j=lit("1_000").cast("int"))
+           .collect())
+    assert out.column("i").to_pylist() == [None, 25]
+    assert out.column("j").to_pylist() == [None, None]
+
+
+def test_temporal_isin_and_numpy_literal_routing_parity(tmp_path):
+    """isin over a temporal column and numpy-scalar literals must not
+    change outcome across the deviceFilterMinRows threshold."""
+    from hyperspace_tpu import HyperspaceSession
+
+    d = str(tmp_path / "tmp_isin")
+    os.makedirs(d)
+    base = np.datetime64("2024-01-01")
+    pq.write_table(pa.table({
+        "d1": pa.array(base + np.arange(100, dtype="timedelta64[D]")),
+        "k": pa.array(np.arange(100, dtype=np.int64)),
+    }), os.path.join(d, "p.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+
+    def outcome(pred):
+        try:
+            return ("ok", s.read.parquet(d).filter(pred).count())
+        except Exception as e:
+            return ("err", type(e).__name__)
+
+    for pred in (col("d1").isin([30, 40]), col("d1") > np.int64(30)):
+        s.conf.device_filter_min_rows = 10**9
+        host = outcome(pred)
+        s.conf.device_filter_min_rows = 1
+        dev = outcome(pred)
+        assert host == dev, f"{pred!r}: {host} vs {dev}"
+    # Plain numeric isin stays device-eligible and correct.
+    s.conf.device_filter_min_rows = 1
+    assert s.read.parquet(d).filter(col("k").isin([3, 5])).count() == 2
+
+
+def test_bool_literal_routing_parity(tmp_path):
+    """bool literals against numeric columns (bare or inside arithmetic)
+    must not change outcome across the deviceFilterMinRows threshold —
+    arrow has no mixed (int64, bool) kernels."""
+    from hyperspace_tpu import HyperspaceSession
+
+    d = str(tmp_path / "boollit")
+    os.makedirs(d)
+    pq.write_table(pa.table({
+        "k": pa.array(np.arange(100, dtype=np.int64)),
+        "b": pa.array([i % 2 == 0 for i in range(100)]),
+    }), os.path.join(d, "p.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+
+    def outcome(pred):
+        try:
+            return ("ok", s.read.parquet(d).filter(pred).count())
+        except Exception as e:
+            return ("err", type(e).__name__)
+
+    for pred in ((col("k") + lit(True)) > 50, col("k") == lit(True),
+                 col("b") > 0):
+        s.conf.device_filter_min_rows = 10**9
+        host = outcome(pred)
+        s.conf.device_filter_min_rows = 1
+        dev = outcome(pred)
+        assert host == dev, f"{pred!r}: {host} vs {dev}"
+    # bool-vs-bool stays device-eligible and correct.
+    s.conf.device_filter_min_rows = 1
+    assert s.read.parquet(d).filter(col("b") == lit(True)).count() == 50

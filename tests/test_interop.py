@@ -275,3 +275,18 @@ def test_spec_union_and_cast(env, tmp_path):
                   "select": ["k"]},
     }).collect()
     assert sorted(out.column("k").to_pylist()) == [0, 1, 10_000, 10_001]
+
+
+def test_spec_select_preserves_interleaved_order(env):
+    """["a", {computed}, "b"] keeps the caller's column order — computed
+    entries must not be shoved after all plain names."""
+    s, data = env
+    out = dataset_from_spec(s, {
+        "source": {"format": "parquet", "path": data},
+        "limit": 3,
+        "select": ["k",
+                   {"name": "v2", "expr": {"op": "*", "left": {"col": "v"},
+                                           "right": 2}},
+                   "name"],
+    }).collect()
+    assert out.column_names == ["k", "v2", "name"]
