@@ -106,6 +106,119 @@ def _time(fn, repeats: int = REPEATS) -> dict:
             "max": max(times), "reps": repeats}
 
 
+def _kernel_microbench() -> dict:
+    """Device-RESIDENT kernel throughput vs the host mirrors, transfer
+    excluded: inputs are placed once with ``jax.device_put`` and timings
+    cover kernel execution only (``block_until_ready``; outputs stay on
+    device).  The two-phase kernels' intrinsic scalar sync (match count /
+    group count) is included — it is part of the kernel design, not of
+    column shipping.  This is the round-3 verdict's ask: show what the
+    chip does once data is resident, independent of the attachment."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import pyarrow as pa
+
+    from hyperspace_tpu.io import columnar
+    from hyperspace_tpu.ops.aggregate import _group_sort, _segment_reduce
+    from hyperspace_tpu.ops.hash import use_pallas
+    from hyperspace_tpu.ops.join import (
+        _expand,
+        _match_ranges,
+        sorted_equi_join_np,
+    )
+    from hyperspace_tpu.ops.sort import (
+        _bucket_sort_impl,
+        bucket_sort_permutation_np,
+    )
+    from hyperspace_tpu.utils.shapes import round_up_pow2
+
+    n = 1 << 20
+    rng = np.random.default_rng(5)
+    keys = rng.integers(0, n, n).astype(np.int64)
+    arr = pa.array(keys)
+    words = np.asarray(columnar.to_hash_words(arr))
+    order = np.asarray(columnar.to_order_words(arr))
+    dev = jax.devices()[0]
+    out = {"rows": n,
+           "note": "device timings are warm, inputs device-resident, "
+                   "outputs left on device (block_until_ready); host "
+                   "mirrors run the same formulation in numpy/arrow"}
+
+    def rate(stats):
+        return round(n / max(stats["median"], 1e-9) / 1e6, 2)
+
+    def stat(d):
+        return {k: (round(v, 5) if isinstance(v, float) else v)
+                for k, v in d.items()}
+
+    # -- build hash+lexsort (ops/sort.py) --------------------------------
+    w_d = jax.device_put(words, dev)
+    o_d = jax.device_put(order, dev)
+    pallas = use_pallas()
+
+    def dev_build():
+        _bucket_sort_impl((w_d,), (o_d,), n, 16, pallas).block_until_ready()
+
+    dev_build()  # compile + warm
+    b_dev = _time(dev_build, repeats=3)
+    b_host = _time(lambda: bucket_sort_permutation_np([words], [order], 16),
+                   repeats=3)
+    out["build_hash_sort"] = {
+        "device_s": stat(b_dev), "host_s": stat(b_host),
+        "device_mrows_per_s": rate(b_dev), "host_mrows_per_s": rate(b_host),
+        "resident_speedup": round(b_host["median"] / b_dev["median"], 3)}
+
+    # -- sorted equi-join (ops/join.py) ----------------------------------
+    lk = keys.astype(np.int32)
+    rk = rng.permutation(keys).astype(np.int32)
+    lk_d = jax.device_put(lk, dev)
+    rk_d = jax.device_put(rk, dev)
+
+    def dev_join():
+        r_perm = jnp.argsort(rk_d)
+        rk_sorted = rk_d[r_perm]
+        lo, hi = _match_ranges(lk_d, rk_sorted)
+        total = int(jnp.sum(hi - lo))  # intrinsic scalar sync
+        li, rp = _expand(lo, hi, round_up_pow2(total))
+        ri = r_perm[jnp.clip(rp, 0, n - 1)]
+        jax.block_until_ready((li, ri))
+
+    dev_join()
+    j_dev = _time(dev_join, repeats=3)
+    j_host = _time(lambda: sorted_equi_join_np(lk, rk), repeats=3)
+    out["sorted_join"] = {
+        "device_s": stat(j_dev), "host_s": stat(j_host),
+        "device_mrows_per_s": rate(j_dev), "host_mrows_per_s": rate(j_host),
+        "resident_speedup": round(j_host["median"] / j_dev["median"], 3)}
+
+    # -- segment aggregate (ops/aggregate.py) ----------------------------
+    gk = (keys % 4096).astype(np.int64)
+    kw = np.asarray(columnar.to_order_words(pa.array(gk)))
+    vals = rng.random(n)
+    tbl = pa.table({"k": gk, "v": vals})
+    kw_d = jax.device_put(kw, dev)
+    with jax.enable_x64():
+        v_d = jax.device_put(vals, dev)
+
+        def dev_agg():
+            perm, boundaries, n_groups = _group_sort((kw_d,), n)
+            g = int(n_groups)  # intrinsic scalar sync
+            res = _segment_reduce(perm, boundaries, n, (v_d,),
+                                  ops=("sum",), capacity=round_up_pow2(g))
+            jax.block_until_ready(res)
+
+        dev_agg()
+        a_dev = _time(dev_agg, repeats=3)
+    a_host = _time(
+        lambda: tbl.group_by("k").aggregate([("v", "sum")]), repeats=3)
+    out["segment_aggregate"] = {
+        "device_s": stat(a_dev), "host_s": stat(a_host),
+        "device_mrows_per_s": rate(a_dev), "host_mrows_per_s": rate(a_host),
+        "resident_speedup": round(a_host["median"] / a_dev["median"], 3)}
+    return out
+
+
 def _pin_backend() -> None:
     """Use the default backend (real TPU when attached); fall back to CPU if
     the accelerator is unreachable so the bench always produces its line.
@@ -466,6 +579,16 @@ def main() -> None:
                     "slice, outside the geomean; the cost model routes "
                     "tunnel-attached aggs to host",
         }
+
+        # Transfer-excluded kernel throughput (round-3 verdict item 1):
+        # what the chip does on RESIDENT data, vs the host mirrors.
+        detail["kernel_bench"] = _kernel_microbench()
+        # Measured attachment physics + the thresholds the session derived
+        # from them (utils/calibrate.py) — on a fast-attached device these
+        # route bench-scale work to the chip with no code changes.
+        from hyperspace_tpu.utils.calibrate import profile_summary
+
+        detail["calibration"] = profile_summary()
 
         detail["index_build_s"] = round(build_s, 3)
         # Per-index, per-phase build attribution (read / kernel / write /

@@ -458,7 +458,7 @@ class CreateActionBase(Action):
             order_words = [
                 np.asarray(columnar.to_order_words(table.column(c)))
                 for c in resolved.indexed_columns]
-            if table.num_rows < self.conf.device_build_min_rows:
+            if table.num_rows < self.conf.device_min_rows("build"):
                 # Host mirror below the threshold — identical layout, no
                 # device transfer/compile latency (see config).
                 buckets, perm = bucket_sort_permutation_np(
@@ -609,7 +609,7 @@ class _BucketSpill:
         # two-pass path that preserves the global curve), so partitions
         # are always real index buckets.
         num_buckets = self.action.num_buckets
-        if n < self.action.conf.device_build_min_rows:
+        if n < self.action.conf.device_min_rows("build"):
             # Same routing as the monolithic build: the per-chunk device
             # round trip (transfer + possible compile, per chunk!) over a
             # remote tunnel dwarfs a host hash pass; bucket_ids_np is the

@@ -102,12 +102,14 @@ class HyperspaceConf:
         default_factory=lambda: int(
             os.environ.get("HS_DEVICE_BATCH_ROWS", 1 << 20)))
     # Below this row count a filter evaluates host-side (arrow compute): a
-    # device round trip costs fixed transfer latency (~100 ms over a remote
-    # tunnel) plus ~8 B/row/column of upload at the tunnel's few-MB/s
-    # throughput, which a vectorized host pass never repays — measured at
-    # 6M rows the tunnel upload alone exceeds the whole host pass by >100x.
-    # Lower toward 0 on locally attached chips with resident data.
-    device_filter_min_rows: int = 1 << 26
+    # device round trip costs fixed transfer latency plus per-row upload,
+    # which a vectorized host pass may never repay (measured at 6M rows
+    # over a remote tunnel, the upload alone exceeds the whole host pass
+    # by >100x).  None (the default) derives the threshold from MEASURED
+    # attachment physics at first use (utils/calibrate.py): a remote
+    # tunnel calibrates to "never organically", a locally attached chip
+    # to a realistic batch size.  Set an int to pin it (always wins).
+    device_filter_min_rows: Optional[int] = None
     # At or above this row count a device-eligible filter shards its
     # columns over ALL visible devices (1-D mesh) instead of evaluating on
     # one chip: the predicate is elementwise, so XLA partitions it with
@@ -122,18 +124,17 @@ class HyperspaceConf:
     index_file_compression: str = dataclasses.field(
         default_factory=lambda: _index_compression_default())
     # Same cost model for joins: below this (max-side) row count the
-    # sorted-merge join runs in numpy on host.  Measured on the remote
-    # tunnel at 6M x 1.5M int64 keys: host 7.5 s, device 14.9 s warm
-    # (99 s cold) — the transfer dominates, so the tunnel default keeps
-    # joins host-side; lower on locally attached chips.
-    device_join_min_rows: int = 1 << 26
+    # sorted-merge join runs in numpy on host.  (Round-3 tunnel
+    # measurement, 6M x 1.5M int64 keys: host 7.5 s, device 14.9 s warm —
+    # transfer dominates.)  None = calibrate from measured physics.
+    device_join_min_rows: Optional[int] = None
     # Same cost model for the BUILD's fused hash+lexsort kernel: below
     # this row count the bit-identical host mirror runs instead (the
     # round-2 bench regression was this kernel's transfer + compile
     # latency over the tunnel dominating an 800k-row build).  The layouts
     # are identical either way — only where the permutation is computed
-    # changes.  Raise toward 0 on locally attached chips.
-    device_build_min_rows: int = 1 << 22
+    # changes.  None = calibrate from measured physics.
+    device_build_min_rows: Optional[int] = None
     # With >1 visible device, a bucket-aligned INNER join at or above this
     # total row count dispatches its per-bucket joins over the mesh
     # (parallel/join.copartitioned_join_ragged: buckets range-partitioned
@@ -143,12 +144,12 @@ class HyperspaceConf:
     # Same cost model for GROUP BY: at or above this row count an eligible
     # aggregation (integer/bool keys, null-free numeric inputs,
     # sum/min/max/mean/count) runs as the device segment-reduction kernel
-    # (ops/aggregate.py); below it, host arrow hash aggregation.  The
-    # default is high: aggregation ships EVERY input column to the device
-    # (measured ~20 MB -> ~5 s over the remote tunnel vs ~26 ms host arrow
-    # at 400k rows), so only resident-data / locally-attached deployments
-    # should lower it.
-    device_agg_min_rows: int = 1 << 26
+    # (ops/aggregate.py); below it, host arrow hash aggregation.
+    # Aggregation ships EVERY input column to the device (measured ~20 MB
+    # -> ~5 s over the remote tunnel vs ~26 ms host arrow at 400k rows),
+    # so only resident-data / locally-attached deployments route here
+    # organically.  None = calibrate from measured physics.
+    device_agg_min_rows: Optional[int] = None
     # Distributed build over the device mesh: "auto" uses it when more than
     # one accelerator is visible; "on"/"off" force it.  The shuffle uses
     # capacity-padded all_to_all; slack is the initial headroom factor over
@@ -202,6 +203,22 @@ class HyperspaceConf:
         HIGHLIGHT_END_TAG: "highlight_end_tag",
     }
 
+    # Auto-calibrated routing thresholds: None = derive from measured
+    # attachment physics (utils/calibrate.py).
+    _AUTO_INT_FIELDS = ("device_filter_min_rows", "device_join_min_rows",
+                        "device_agg_min_rows", "device_build_min_rows")
+
+    def device_min_rows(self, kind: str) -> int:
+        """Effective host-vs-device threshold for ``kind`` (one of
+        filter/join/agg/build): an explicitly set conf value wins;
+        otherwise the calibrated (or conservative-fallback) value."""
+        explicit = getattr(self, f"device_{kind}_min_rows")
+        if explicit is not None:
+            return int(explicit)
+        from hyperspace_tpu.utils.calibrate import calibrated_min_rows
+
+        return calibrated_min_rows(kind)
+
     def set(self, key: str, value: Any) -> None:
         field = self._FIELD_BY_KEY.get(key)
         if field is None:
@@ -213,7 +230,10 @@ class HyperspaceConf:
             return
         self._set_keys.add(key)
         current = getattr(self, field)
-        if isinstance(current, bool):
+        if field in self._AUTO_INT_FIELDS:
+            value = None if value is None or str(value).lower() in (
+                "none", "auto") else int(value)
+        elif isinstance(current, bool):
             value = value if isinstance(value, bool) else str(value).lower() == "true"
         elif isinstance(current, int):
             value = int(value)

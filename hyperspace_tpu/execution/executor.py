@@ -224,7 +224,7 @@ class Executor:
         from hyperspace_tpu.ops.aggregate import AGG_OPS
 
         conf = self.session.conf
-        if table.num_rows < conf.device_agg_min_rows or table.num_rows == 0:
+        if table.num_rows < conf.device_min_rows("agg") or table.num_rows == 0:
             return None
         if any(func not in AGG_OPS for func, _i, _o in plan.aggs):
             return None
@@ -368,7 +368,7 @@ class Executor:
         # would make the sharded path unreachable in between.
         import jax
 
-        min_rows = self.session.conf.device_filter_min_rows
+        min_rows = self.session.conf.device_min_rows("filter")
         if len(jax.local_devices()) > 1:
             min_rows = min(min_rows, self.session.conf.mesh_filter_min_rows)
         numeric = bool(cols) \
@@ -403,6 +403,12 @@ class Executor:
                 # normalize a temporal literal against.
                 return False
             col_types = [table.schema.field(c.name).type for c in cols_in_cmp]
+            if len(col_types) == 2 and (pa.types.is_boolean(col_types[0])
+                                        != pa.types.is_boolean(col_types[1])):
+                # bool-vs-numeric column pair: arrow has no mixed kernel,
+                # so the host path raises — the device 0/1 view must not
+                # silently answer instead.
+                return False
             if any(pa.types.is_temporal(t) for t in col_types):
                 # Temporal columns compare on device only against a
                 # temporal-typed literal (normalized below) or a column of
@@ -607,7 +613,7 @@ class Executor:
             # device kernel's two transfers + one sync are pure latency
             # until the batch is large (conf device_join_min_rows).
             if max(left.num_rows, right.num_rows) \
-                    >= self.session.conf.device_join_min_rows:
+                    >= self.session.conf.device_min_rows("join"):
                 li, ri = sorted_equi_join(lk, rk)
             else:
                 li, ri = sorted_equi_join_np(lk, rk)
@@ -622,7 +628,7 @@ class Executor:
 
         try:
             use_device = (max(left.num_rows, right.num_rows)
-                          >= self.session.conf.device_join_min_rows)
+                          >= self.session.conf.device_min_rows("join"))
             li, ri = hashed_equi_join(left, right, l_keys, r_keys,
                                       device=use_device)
             return np.asarray(li, dtype=np.int64), np.asarray(ri, dtype=np.int64)
@@ -1396,8 +1402,16 @@ def _arrow_eval(expr: Expr, table: pa.Table):
             if not pa.types.is_floating(ctype):
                 # float64 is exact only below 2**53: integer strings in the
                 # tail (int64-range ids) re-parse exactly, element-wise
-                # over just those rows.
+                # over just those rows.  Only ASCII-integer forms can gain
+                # precision — the vectorized regex keeps the loop empty for
+                # float-form tails ('1e300' columns stay O(1) Python).
                 big = np.nonzero(valid & (np.abs(trunc) >= 2.0**53))[0]
+                if big.size:
+                    intlike = np.asarray(pc.fill_null(
+                        pc.match_substring_regex(
+                            child, r"^\s*[+-]?[0-9]+\s*$"), False)
+                        .to_numpy(zero_copy_only=False), dtype=bool)
+                    big = big[intlike[big]]
                 for i in big.tolist():
                     exact = scalar_cast(child[i].as_py())
                     if exact is None:

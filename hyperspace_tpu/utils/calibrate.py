@@ -1,0 +1,224 @@
+"""Measured device-routing thresholds: transfer physics, not constants.
+
+The host-vs-device cost model (SURVEY.md §2.4 "per-core XLA data parallelism
+over HBM-resident columnar batches") needs a row threshold per op kind:
+below it, shipping columns to the accelerator costs more than a vectorized
+host pass.  Rounds 2-3 hardwired thresholds measured over ONE remote-tunnel
+environment (~4 MB/s, ~100 ms RTT); on a locally attached TPU (GB/s PCIe,
+sub-ms latency) those constants would misroute genuinely device-sized work
+to the host.  This module measures the attachment at first use and derives
+the thresholds from the observed physics:
+
+    device_time(R) ~ latency + R * bytes_per_row / bandwidth
+    host_time(R)   ~ R / host_rows_per_s          (measured per op kind)
+    threshold      = smallest R where device_time < host_time
+                     (infinite when per-row transfer alone exceeds the
+                     host's per-row cost -> capped sentinel)
+
+Device COMPUTE rate is deliberately not probed at session start: the first
+invocation of each kernel would pay a 20-40 s XLA compile over a tunnel,
+which is not a calibration a session can afford.  The model instead assumes
+device compute is never the bottleneck (true on the MXU/VPU for these
+elementwise/sort/segment kernels) — so the threshold is purely the
+transfer-amortization point, which is exactly what the hardwired constants
+were approximating.
+
+Explicit conf values always win (``HyperspaceConf.device_min_rows``); env
+``HS_CALIBRATE=0`` disables probing and falls back to the conservative
+remote-tunnel constants (the test suite pins this for determinism).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Dict, Optional
+
+# Conservative fallbacks: the round-2/3 measured remote-tunnel constants.
+# Used when calibration is disabled (HS_CALIBRATE=0) or the probe fails.
+STATIC_MIN_ROWS: Dict[str, int] = {
+    "filter": 1 << 26,
+    "join": 1 << 26,
+    "agg": 1 << 26,
+    "build": 1 << 22,
+}
+
+# "Device never organically wins" sentinel — finite so conf arithmetic and
+# JSON round-trips stay safe, far above any realizable batch.
+NEVER_MIN_ROWS = 1 << 40
+
+# Bytes shipped to the device per row, per op kind (the dominant transfer):
+#   filter: two 8-B columns up, 1-B mask down
+#   join:   8-B keys both sides up, two 8-B index vectors down
+#   agg:    (n,2)-u32 key words + one f64 value column up, results down
+#   build:  (n,2)-u32 hash words + (n,2)-u32 order words up, 2x i32 down
+_BYTES_PER_ROW: Dict[str, float] = {
+    "filter": 17.0,
+    "join": 32.0,
+    "agg": 24.0,
+    "build": 24.0,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceProfile:
+    """Measured attachment physics + host baseline rates."""
+
+    platform: str
+    latency_s: float           # fixed host->device->host round-trip
+    h2d_bytes_per_s: float     # host->device bandwidth
+    d2h_bytes_per_s: float     # device->host bandwidth
+    host_rows_per_s: Dict[str, float]  # per op kind
+
+    def min_rows(self, kind: str) -> int:
+        """Break-even row count for ``kind`` under this profile."""
+        host_s_per_row = 1.0 / self.host_rows_per_s[kind]
+        transfer_s_per_row = _BYTES_PER_ROW[kind] / self.h2d_bytes_per_s
+        margin = host_s_per_row - transfer_s_per_row
+        if margin <= 0:
+            # Per-row transfer alone already exceeds the host's per-row
+            # cost: the device can never repay the shipping (round-3's
+            # measured tunnel regime).
+            return NEVER_MIN_ROWS
+        rows = self.latency_s / margin
+        # Round up to a power of two: thresholds are routing knobs, not
+        # precision instruments, and pow2 values keep logs legible.
+        threshold = 1 << max(0, (int(rows) - 1).bit_length())
+        return min(threshold, NEVER_MIN_ROWS)
+
+
+_PROFILE: Optional[DeviceProfile] = None
+_PROFILE_FAILED = False
+# One probe per process: concurrent first queries (interop server threads)
+# must not each run the probe — timings measured under mutual load would be
+# cached as the permanent routing physics.
+import threading
+
+_PROBE_LOCK = threading.Lock()
+
+
+def calibration_enabled() -> bool:
+    return os.environ.get("HS_CALIBRATE", "1").lower() not in ("0", "false")
+
+
+def _median_time(fn, reps: int = 3) -> float:
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2]
+
+
+def _probe_host_rates(n: int = 1 << 20) -> Dict[str, float]:
+    """Host per-row rates for each op kind's dominant host-mirror cost:
+    arrow elementwise compare (filter), numpy argsort (join: the mirror is
+    sort+searchsorted), arrow hash aggregation (agg), numpy 3-key lexsort
+    (build)."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    rng = np.random.default_rng(0)
+    ints = rng.integers(0, n, n)
+    arr = pa.array(ints)
+    tbl = pa.table({"k": ints % 1024, "v": rng.random(n)})
+
+    t_filter = _median_time(lambda: pc.greater(arr, n // 2))
+    t_join = _median_time(lambda: np.argsort(ints, kind="stable"))
+    t_agg = _median_time(
+        lambda: tbl.group_by("k").aggregate([("v", "sum")]))
+    u32 = (ints % (1 << 31)).astype(np.uint32)
+    t_build = _median_time(lambda: np.lexsort((u32, u32, u32 % 16)))
+    return {
+        "filter": n / max(t_filter, 1e-9),
+        "join": n / max(t_join, 1e-9),
+        "agg": n / max(t_agg, 1e-9),
+        "build": n / max(t_build, 1e-9),
+    }
+
+
+def _probe_transfer() -> "tuple[str, float, float, float]":
+    """(platform, latency_s, h2d_Bps, d2h_Bps) via jit-free transfers
+    (device_put / np.asarray compile nothing, so the probe never pays an
+    XLA compile)."""
+    import jax
+    import numpy as np
+
+    dev = jax.devices()[0]
+    small = np.zeros(8, dtype=np.float32)
+    # Warm the dispatch path once before timing.
+    np.asarray(jax.device_put(small, dev))
+    latency = _median_time(lambda: np.asarray(jax.device_put(small, dev)))
+
+    big = np.zeros(1 << 16, dtype=np.float32)  # 256 KiB
+    nbytes = big.nbytes
+
+    def h2d():
+        jax.device_put(big, dev).block_until_ready()
+
+    h2d()  # warm
+    t_h2d = max(_median_time(h2d) - latency / 2, 1e-9)
+    resident = jax.device_put(big, dev)
+    resident.block_until_ready()
+    t_d2h = max(_median_time(lambda: np.asarray(resident)) - latency / 2,
+                1e-9)
+    return dev.platform, latency, nbytes / t_h2d, nbytes / t_d2h
+
+
+def device_profile(refresh: bool = False) -> Optional[DeviceProfile]:
+    """The process-wide measured profile (physics don't change mid-process),
+    or None when probing is disabled or the accelerator is unreachable."""
+    global _PROFILE, _PROFILE_FAILED
+    if not calibration_enabled():
+        return None
+    with _PROBE_LOCK:
+        if _PROFILE is not None and not refresh:
+            return _PROFILE
+        if _PROFILE_FAILED and not refresh:
+            return None
+        try:
+            platform, latency, h2d, d2h = _probe_transfer()
+            _PROFILE = DeviceProfile(
+                platform=platform,
+                latency_s=latency,
+                h2d_bytes_per_s=h2d,
+                d2h_bytes_per_s=d2h,
+                host_rows_per_s=_probe_host_rates(),
+            )
+            _PROFILE_FAILED = False
+            return _PROFILE
+        except Exception:
+            _PROFILE_FAILED = True
+            return None
+
+
+def calibrated_min_rows(kind: str) -> int:
+    """The derived threshold for ``kind`` — measured when possible, the
+    conservative tunnel constants otherwise."""
+    if kind not in STATIC_MIN_ROWS:
+        raise KeyError(f"Unknown device op kind: {kind!r}")
+    profile = device_profile()
+    if profile is None:
+        return STATIC_MIN_ROWS[kind]
+    return profile.min_rows(kind)
+
+
+def profile_summary() -> Dict[str, object]:
+    """JSON-ready view for bench/telemetry output."""
+    profile = device_profile()
+    if profile is None:
+        return {"calibrated": False,
+                "thresholds": dict(STATIC_MIN_ROWS)}
+    return {
+        "calibrated": True,
+        "platform": profile.platform,
+        "latency_ms": round(profile.latency_s * 1e3, 3),
+        "h2d_mb_per_s": round(profile.h2d_bytes_per_s / 1e6, 2),
+        "d2h_mb_per_s": round(profile.d2h_bytes_per_s / 1e6, 2),
+        "host_mrows_per_s": {k: round(v / 1e6, 2)
+                             for k, v in profile.host_rows_per_s.items()},
+        "thresholds": {k: profile.min_rows(k) for k in STATIC_MIN_ROWS},
+    }

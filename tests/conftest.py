@@ -19,6 +19,10 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("HS_DEVICE_BATCH_ROWS", "4096")
 # Keep the persistent XLA cache out of the developer cache dir during tests.
 os.environ.setdefault("HS_XLA_CACHE", "0")
+# Deterministic routing thresholds: auto-calibration would derive them from
+# this machine's measured physics, flipping host/device routing run to run.
+# Calibration itself is tested explicitly in test_calibrate.py.
+os.environ.setdefault("HS_CALIBRATE", "0")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

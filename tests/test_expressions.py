@@ -716,3 +716,30 @@ def test_bool_literal_routing_parity(tmp_path):
     # bool-vs-bool stays device-eligible and correct.
     s.conf.device_filter_min_rows = 1
     assert s.read.parquet(d).filter(col("b") == lit(True)).count() == 50
+
+
+def test_bool_vs_numeric_column_routing_parity(tmp_path):
+    """A bool column compared to a numeric column must behave identically
+    on both sides of deviceFilterMinRows."""
+    from hyperspace_tpu import HyperspaceSession
+
+    d = str(tmp_path / "boolcol")
+    os.makedirs(d)
+    pq.write_table(pa.table({
+        "k": pa.array(np.arange(100, dtype=np.int64)),
+        "b": pa.array([i % 2 == 0 for i in range(100)]),
+    }), os.path.join(d, "p.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+
+    def outcome(pred):
+        try:
+            return ("ok", s.read.parquet(d).filter(pred).count())
+        except Exception as e:
+            return ("err", type(e).__name__)
+
+    pred = col("b") > col("k")
+    s.conf.device_filter_min_rows = 10**9
+    host = outcome(pred)
+    s.conf.device_filter_min_rows = 1
+    dev = outcome(pred)
+    assert host == dev, f"{host} vs {dev}"
