@@ -39,6 +39,9 @@ DEVICE_JOIN_MIN_ROWS = "hyperspace.tpu.deviceJoinMinRows"
 DEVICE_BUILD_MIN_ROWS = "hyperspace.tpu.deviceBuildMinRows"
 MESH_JOIN_MIN_ROWS = "hyperspace.tpu.meshJoinMinRows"
 DEVICE_AGG_MIN_ROWS = "hyperspace.tpu.deviceAggMinRows"
+DEVICE_RESIDENT_MIN_ROWS = "hyperspace.tpu.deviceResidentMinRows"
+DEVICE_CACHE_BYTES = "hyperspace.tpu.deviceCacheBytes"
+DEVICE_CACHE_POLICY = "hyperspace.tpu.deviceCachePolicy"
 PARALLEL_BUILD = "hyperspace.tpu.parallelBuild"
 SHUFFLE_CAPACITY_SLACK = "hyperspace.tpu.shuffleCapacitySlack"
 GLOBBING_PATTERN = "hyperspace.source.globbingPattern"
@@ -150,6 +153,17 @@ class HyperspaceConf:
     # so only resident-data / locally-attached deployments route here
     # organically.  None = calibrate from measured physics.
     device_agg_min_rows: Optional[int] = None
+    # HBM-resident index-column cache (execution/device_cache.py): byte
+    # budget for post-decode device arrays kept across queries, keyed by
+    # file identity.  0 disables.
+    device_cache_bytes: int = 1 << 30
+    # "auto": populate when the device path runs anyway; "eager": ship
+    # eligible scan columns on first use (pay a slow attachment once,
+    # serve repeats from HBM); "off": never cache.
+    device_cache_policy: str = "auto"
+    # Row threshold when inputs are ALREADY resident (latency-only
+    # break-even); applies to every op kind.  None = calibrate.
+    device_resident_min_rows: Optional[int] = None
     # Distributed build over the device mesh: "auto" uses it when more than
     # one accelerator is visible; "on"/"off" force it.  The shuffle uses
     # capacity-padded all_to_all; slack is the initial headroom factor over
@@ -196,6 +210,9 @@ class HyperspaceConf:
         DEVICE_BUILD_MIN_ROWS: "device_build_min_rows",
         MESH_JOIN_MIN_ROWS: "mesh_join_min_rows",
         DEVICE_AGG_MIN_ROWS: "device_agg_min_rows",
+        DEVICE_RESIDENT_MIN_ROWS: "device_resident_min_rows",
+        DEVICE_CACHE_BYTES: "device_cache_bytes",
+        DEVICE_CACHE_POLICY: "device_cache_policy",
         PARALLEL_BUILD: "parallel_build",
         SHUFFLE_CAPACITY_SLACK: "shuffle_capacity_slack",
         DISPLAY_MODE: "display_mode",
@@ -206,7 +223,8 @@ class HyperspaceConf:
     # Auto-calibrated routing thresholds: None = derive from measured
     # attachment physics (utils/calibrate.py).
     _AUTO_INT_FIELDS = ("device_filter_min_rows", "device_join_min_rows",
-                        "device_agg_min_rows", "device_build_min_rows")
+                        "device_agg_min_rows", "device_build_min_rows",
+                        "device_resident_min_rows")
 
     def device_min_rows(self, kind: str) -> int:
         """Effective host-vs-device threshold for ``kind`` (one of
@@ -218,6 +236,17 @@ class HyperspaceConf:
         from hyperspace_tpu.utils.calibrate import calibrated_min_rows
 
         return calibrated_min_rows(kind)
+
+    def resident_min_rows(self, kind: str) -> int:
+        """Threshold when the op's inputs are already device-resident
+        (only round-trip latency must be repaid)."""
+        if self.device_resident_min_rows is not None:
+            return int(self.device_resident_min_rows)
+        from hyperspace_tpu.utils.calibrate import (
+            calibrated_resident_min_rows,
+        )
+
+        return calibrated_resident_min_rows(kind)
 
     def set(self, key: str, value: Any) -> None:
         field = self._FIELD_BY_KEY.get(key)

@@ -1,0 +1,123 @@
+"""HBM-resident index-column cache: pay the transfer once, compute many.
+
+Round-3 verdict item 2: every query re-read parquet into host arrow and
+re-shipped columns to the accelerator, so the architecture's payoff —
+SURVEY §2.4's "per-core XLA data parallelism over HBM-resident columnar
+batches" — was structurally unreachable.  Spark gives the reference this
+for free through the block manager's RDD caching; here it is explicit: a
+process-wide, byte-budgeted LRU of POST-DECODE device arrays keyed by
+file identity.
+
+Keys are ``(files_fingerprint, column, kind)`` where the fingerprint
+hashes the scan's resolved file list with each file's (size, mtime):
+an overwritten or compacted index version can never serve stale arrays —
+its fingerprint differs, and the dead entries age out of the LRU.
+
+Residency changes ROUTING, not just speed: once a scan's referenced
+columns are resident, the device path's cost is kernel time plus
+round-trip latency (no per-row shipping), so the executor compares row
+counts against the much smaller ``resident_min_rows`` derived from the
+measured profile (utils/calibrate.py) instead of the cold-transfer
+threshold.  Population policy (conf ``deviceCachePolicy``):
+
+  - ``auto`` (default): populate whenever the device path runs anyway —
+    free on locally attached chips where the calibrated cold threshold
+    routes large scans to the device organically.
+  - ``eager``: ship eligible scan columns on FIRST use even when the
+    cold cost model would stay on host — an explicit opt-in for
+    repeat-heavy workloads behind a slow attachment (pay the tunnel
+    once, serve every later query from HBM).
+  - ``off``: never cache.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from collections import OrderedDict
+from typing import Dict, Iterable, Optional, Tuple
+
+Key = Tuple[str, str, str]  # (files fingerprint, column name, kind)
+
+
+def files_fingerprint(paths: Iterable[str]) -> Optional[str]:
+    """Content-identity hash of a resolved scan file list: path order plus
+    each file's size and mtime_ns.  None when any file is unstat-able
+    (races with vacuum — safer to skip caching than to key on guesses)."""
+    h = hashlib.md5()
+    try:
+        for p in paths:
+            st = os.stat(p)
+            h.update(p.encode())
+            h.update(f":{st.st_size}:{st.st_mtime_ns};".encode())
+    except OSError:
+        return None
+    return h.hexdigest()
+
+
+class DeviceColumnCache:
+    """Byte-budgeted LRU of device arrays (thread-safe)."""
+
+    def __init__(self) -> None:
+        self._entries: "OrderedDict[Key, object]" = OrderedDict()
+        self._nbytes: Dict[Key, int] = {}
+        self._lock = threading.Lock()
+        self.bytes_cached = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, key: Key):
+        with self._lock:
+            arr = self._entries.get(key)
+            if arr is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return arr
+
+    def contains(self, key: Key) -> bool:
+        """Presence probe for ROUTING decisions — no hit/miss accounting
+        (the actual fetch follows if the device path is chosen)."""
+        with self._lock:
+            return key in self._entries
+
+    def put(self, key: Key, arr, budget_bytes: int) -> None:
+        nbytes = int(getattr(arr, "nbytes", 0) or 0)
+        if nbytes <= 0 or nbytes > budget_bytes:
+            return
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                return
+            while self.bytes_cached + nbytes > budget_bytes and self._entries:
+                old_key, _old = self._entries.popitem(last=False)
+                self.bytes_cached -= self._nbytes.pop(old_key)
+                self.evictions += 1
+            self._entries[key] = arr
+            self._nbytes[key] = nbytes
+            self.bytes_cached += nbytes
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._nbytes.clear()
+            self.bytes_cached = 0
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions,
+                    "entries": len(self._entries),
+                    "bytes": self.bytes_cached}
+
+
+# One cache per process: device memory is a process-level resource, and
+# fingerprint keys are content-based so sessions can safely share entries.
+_CACHE = DeviceColumnCache()
+
+
+def global_cache() -> DeviceColumnCache:
+    return _CACHE

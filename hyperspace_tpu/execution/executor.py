@@ -71,6 +71,91 @@ class Executor:
         # intent): per-join strategy, per-scan file counts.  Read back via
         # session.last_execution_stats after Dataset.collect().
         self.stats: Dict[str, list] = {"joins": [], "scans": []}
+        # File-identity provenance of scan outputs within THIS query, for
+        # the HBM-resident column cache (execution/device_cache.py):
+        # id(table) -> (fingerprint, cacheable column names, table ref —
+        # kept so the id can't be recycled mid-query).
+        self._scan_fp: Dict[int, Tuple[str, frozenset, pa.Table]] = {}
+
+    # -- HBM-resident column cache ------------------------------------------
+    def _register_scan_identity(self, table: pa.Table, paths) -> None:
+        conf = self.session.conf
+        if conf.device_cache_policy == "off" or conf.device_cache_bytes <= 0:
+            return
+        from hyperspace_tpu.execution.device_cache import files_fingerprint
+
+        fp = files_fingerprint(paths)
+        if fp:
+            self._scan_fp[id(table)] = (
+                fp, frozenset(table.column_names), table)
+
+    def _scan_identity(self, table: pa.Table) -> Optional[Tuple[str, frozenset]]:
+        entry = self._scan_fp.get(id(table))
+        return (entry[0], entry[1]) if entry is not None else None
+
+    def _cache_key(self, identity, column: str, kind: str):
+        if identity is None:
+            return None
+        fp, cacheable = identity
+        return (fp, column, kind) if column in cacheable else None
+
+    def _all_resident(self, identity, pairs) -> bool:
+        """True when every (column, kind) pair is already cached for this
+        scan identity."""
+        from hyperspace_tpu.execution.device_cache import global_cache
+
+        cache = global_cache()
+        keys = [self._cache_key(identity, c, k) for c, k in pairs]
+        return bool(keys) and all(k is not None and cache.contains(k)
+                                  for k in keys)
+
+    def _device_column(self, table: pa.Table, column: str, identity,
+                       kind: str):
+        """The column in its device domain — from the resident cache when
+        this scan's file identity is known (hit: zero transfer; miss:
+        convert, place on device, and cache), host numpy otherwise."""
+        key = self._cache_key(identity, column, kind)
+        convert = (columnar.to_order_words if kind == "order"
+                   else columnar.to_device_numeric)
+        if key is None:
+            return convert(table.column(column))
+        from hyperspace_tpu.execution.device_cache import global_cache
+
+        cache = global_cache()
+        counters = self.stats.setdefault(
+            "device_cache", {"hits": 0, "misses": 0})
+        arr = cache.get(key)
+        if arr is not None:
+            counters["hits"] += 1
+            return arr
+        import jax
+
+        host = convert(table.column(column))
+        with jax.enable_x64():  # int64 columns must keep full width
+            dev = jax.device_put(np.asarray(host))
+        cache.put(key, dev, self.session.conf.device_cache_bytes)
+        counters["misses"] += 1
+        return dev
+
+    def _cache_aware_min_rows(self, identity, pairs, kind: str) -> int:
+        """The effective routing threshold: the cold-transfer break-even
+        normally, the latency-only resident break-even when every input
+        (column, kind) pair is already cached for this scan (or will be
+        under the 'eager' populate policy)."""
+        conf = self.session.conf
+        min_rows = conf.device_min_rows(kind)
+        if identity is None:
+            return min_rows
+        # Eager lowers the threshold only when every input is CACHEABLE
+        # (computed hidden columns never are — re-shipping them per query
+        # would pay the transfer forever, not once).
+        eager_all_cacheable = (
+            conf.device_cache_policy == "eager"
+            and all(self._cache_key(identity, c, k) is not None
+                    for c, k in pairs))
+        if eager_all_cacheable or self._all_resident(identity, pairs):
+            return min(min_rows, conf.resident_min_rows(kind))
+        return min_rows
 
     def execute(self, plan: LogicalPlan) -> pa.Table:
         if isinstance(plan, InMemory):
@@ -154,6 +239,10 @@ class Executor:
     # -- aggregate ----------------------------------------------------------
     def _aggregate(self, plan: Aggregate) -> pa.Table:
         table = self.execute(plan.child)
+        # Scan provenance survives the hidden-column appends below (the
+        # appended table is a new object); only the ORIGINAL columns stay
+        # cacheable — computed inputs are query-specific.
+        identity = self._scan_identity(table)
         # Expression inputs (sum(price * (1 - discount))) materialize as
         # hidden columns first; the reduction then sees plain columns.
         agg_inputs: List = []
@@ -171,7 +260,8 @@ class Executor:
         specs = [([] if func == "count_all" else agg_inputs[i], func)
                  for i, (func, _in, _out) in enumerate(plan.aggs)]
         if plan.group_by:
-            device = self._try_device_aggregate(table, plan, agg_inputs)
+            device = self._try_device_aggregate(table, plan, agg_inputs,
+                                                identity)
             if device is not None:
                 return device
             keys = list(plan.group_by)
@@ -213,18 +303,27 @@ class Executor:
         return pa.table({n: [v] for n, v in zip(cols, vals)})
 
     def _try_device_aggregate(self, table: pa.Table, plan: Aggregate,
-                              agg_inputs: List[str]) -> Optional[pa.Table]:
+                              agg_inputs: List[str],
+                              identity=None) -> Optional[pa.Table]:
         """Route an eligible GROUP BY through the device segment-reduction
         kernel (ops/aggregate.py).  Eligible: enough rows (conf
-        device_agg_min_rows), integer/bool group keys (float keys would
-        split arrow's single NaN group by bit pattern), null-free numeric
+        device_agg_min_rows, or the resident threshold when the inputs are
+        HBM-cached), integer/bool group keys (float keys would split
+        arrow's single NaN group by bit pattern), null-free numeric
         inputs, and only sum/min/max/mean/count/count_all.  Output rows
         come back in ascending key order — GROUP BY output order is
         unspecified, as on the host path."""
         from hyperspace_tpu.ops.aggregate import AGG_OPS
 
         conf = self.session.conf
-        if table.num_rows < conf.device_min_rows("agg") or table.num_rows == 0:
+        if table.num_rows == 0:
+            return None
+        pairs = [(k, "order") for k in plan.group_by] + [
+            (agg_inputs[i], "num")
+            for i, (func, _in, _out) in enumerate(plan.aggs)
+            if func not in ("count", "count_all")]
+        if table.num_rows < self._cache_aware_min_rows(identity, pairs,
+                                                       "agg"):
             return None
         if any(func not in AGG_OPS for func, _i, _o in plan.aggs):
             return None
@@ -260,12 +359,13 @@ class Executor:
 
         from hyperspace_tpu.ops.aggregate import grouped_aggregate
 
-        key_words = [np.asarray(columnar.to_order_words(table.column(k)))
+        resident = self._all_resident(identity, pairs)
+        key_words = [self._device_column(table, k, identity, "order")
                      for k in plan.group_by]
         # One array per NON-count aggregate; counts ship nothing (a dummy
         # column would be ~8 B/row of pointless tunnel transfer).
-        value_cols = [np.asarray(
-            columnar.to_device_numeric(table.column(agg_inputs[i])))
+        value_cols = [
+            self._device_column(table, agg_inputs[i], identity, "num")
             for i, (func, _in, _out) in enumerate(plan.aggs)
             if func not in ("count", "count_all")]
         first_rows, counts, results = grouped_aggregate(
@@ -275,6 +375,7 @@ class Executor:
             "strategy": "device-segment",
             "groups": int(len(first_rows)),
             "rows": table.num_rows,
+            "resident": resident,
         })
         # Gather only the key columns (the full-width table would random-
         # gather every unused value column too).
@@ -345,7 +446,10 @@ class Executor:
         roots = rel.root_paths if rel.index_scan_of is None else None
         out = read_table(paths, read_format, columns, rel.options_dict,
                          partition_roots=roots)
-        return out.select(columns) if columns else out
+        if columns:
+            out = out.select(columns)
+        self._register_scan_identity(out, paths)
+        return out
 
     # -- filter -------------------------------------------------------------
     def _filter(self, plan: Filter) -> pa.Table:
@@ -368,7 +472,9 @@ class Executor:
         # would make the sharded path unreachable in between.
         import jax
 
-        min_rows = self.session.conf.device_min_rows("filter")
+        identity = self._scan_identity(table)
+        pairs = [(c, "num") for c in cols]
+        min_rows = self._cache_aware_min_rows(identity, pairs, "filter")
         if len(jax.local_devices()) > 1:
             min_rows = min(min_rows, self.session.conf.mesh_filter_min_rows)
         numeric = bool(cols) \
@@ -379,7 +485,19 @@ class Executor:
                 for c in cols
             ) and self._device_compatible(expr, table)
         if numeric:
-            return self._eval_device(expr, table)
+            # The mesh branch bypasses the single-device resident cache
+            # (sharded placement is its own layout) — its stats must not
+            # claim a zero-transfer resident run.
+            use_mesh = (len(jax.local_devices()) > 1 and table.num_rows
+                        >= self.session.conf.mesh_filter_min_rows)
+            resident = not use_mesh and self._all_resident(identity, pairs)
+            mask = self._eval_device(expr, table, identity)
+            self.stats.setdefault("filters", []).append({
+                "strategy": "device-mesh" if use_mesh else "device",
+                "rows": table.num_rows, "resident": resident})
+            return mask
+        self.stats.setdefault("filters", []).append({
+            "strategy": "host", "rows": table.num_rows})
         return self._eval_arrow(expr, table)
 
     def _device_compatible(self, expr: Expr, table: pa.Table) -> bool:
@@ -459,7 +577,8 @@ class Executor:
                             for v in expr.values))
         return False
 
-    def _eval_device(self, expr: Expr, table: pa.Table) -> np.ndarray:
+    def _eval_device(self, expr: Expr, table: pa.Table,
+                     identity=None) -> np.ndarray:
         import jax
 
         from hyperspace_tpu.ops.filter import compile_predicate
@@ -467,7 +586,6 @@ class Executor:
         order = sorted(expr.referenced_columns())
         norm = self._normalize_literals(expr, table)
         fn, literals = compile_predicate(norm, order)
-        device_cols = [columnar.to_device_numeric(table.column(c)) for c in order]
         # Scoped x64 so int64 columns keep full width on device (global x64
         # would leak dtype defaults into the embedding application's JAX).
         if (len(jax.local_devices()) > 1 and table.num_rows
@@ -476,10 +594,15 @@ class Executor:
             # LOCAL device (the batch is host-resident; other hosts'
             # devices are not addressable from here); the elementwise
             # program partitions with zero collectives (parallel/filter.py,
-            # which scopes x64 itself).
+            # which scopes x64 itself).  The single-device resident cache
+            # is bypassed — sharded placement is its own layout.
             from hyperspace_tpu.parallel.filter import eval_predicate_on_mesh
 
+            device_cols = [columnar.to_device_numeric(table.column(c))
+                           for c in order]
             return eval_predicate_on_mesh(fn, device_cols, literals)
+        device_cols = [self._device_column(table, c, identity, "num")
+                       for c in order]
         with jax.enable_x64():
             mask = fn(device_cols, literals)
         return np.asarray(mask)

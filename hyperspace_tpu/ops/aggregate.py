@@ -138,8 +138,10 @@ def grouped_aggregate(
     capacity_rows = n
     if pad_to and pad_to > 0:
         capacity_rows = -(-max(n, 1) // pad_to) * pad_to
-    kw = tuple(_pad_rows(np.asarray(w), capacity_rows) for w in key_words)
-    vc = tuple(_pad_rows(np.asarray(v), capacity_rows) for v in value_cols)
+    # Device-resident inputs (jax arrays from the HBM cache) pass through
+    # _pad_rows untouched — it pads them on device instead of pulling.
+    kw = tuple(_pad_rows(w, capacity_rows) for w in key_words)
+    vc = tuple(_pad_rows(v, capacity_rows) for v in value_cols)
     with jax.enable_x64():
         perm, boundaries, n_groups = _group_sort(kw, n)
         g = int(n_groups)

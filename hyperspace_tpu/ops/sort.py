@@ -63,6 +63,13 @@ def _bucket_sort_impl(
 def _pad_rows(arr, capacity: int):
     import numpy as np
 
+    if isinstance(arr, jax.Array):
+        # HBM-resident input (execution/device_cache.py): pad on device —
+        # np.asarray would pull the whole array back to host.
+        if arr.shape[0] == capacity:
+            return arr
+        widths = [(0, capacity - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
+        return jnp.pad(arr, widths)
     arr = np.asarray(arr)
     if arr.shape[0] == capacity:
         return arr

@@ -48,6 +48,16 @@ STATIC_MIN_ROWS: Dict[str, int] = {
 # JSON round-trips stay safe, far above any realizable batch.
 NEVER_MIN_ROWS = 1 << 40
 
+# Conservative fallbacks for the RESIDENT-data thresholds (inputs already
+# in HBM via execution/device_cache.py; only round-trip latency must be
+# repaid).  Used when calibration is disabled.
+STATIC_RESIDENT_MIN_ROWS: Dict[str, int] = {
+    "filter": 1 << 24,
+    "join": 1 << 22,
+    "agg": 1 << 22,
+    "build": 1 << 22,
+}
+
 # Bytes shipped to the device per row, per op kind (the dominant transfer):
 #   filter: two 8-B columns up, 1-B mask down
 #   join:   8-B keys both sides up, two 8-B index vectors down
@@ -85,6 +95,16 @@ class DeviceProfile:
         # Round up to a power of two: thresholds are routing knobs, not
         # precision instruments, and pow2 values keep logs legible.
         threshold = 1 << max(0, (int(rows) - 1).bit_length())
+        return min(threshold, NEVER_MIN_ROWS)
+
+    def resident_min_rows(self, kind: str) -> int:
+        """Break-even row count when the inputs are ALREADY device-resident
+        (execution/device_cache.py): no per-row shipping — the kernel only
+        has to repay its round-trip latency (x2 margin: the two-phase
+        kernels sync a scalar mid-flight), assuming device compute beats
+        the host mirror at any size that clears this."""
+        rows = 2.0 * self.latency_s * self.host_rows_per_s[kind]
+        threshold = 1 << max(12, (max(1, int(rows)) - 1).bit_length())
         return min(threshold, NEVER_MIN_ROWS)
 
 
@@ -197,13 +217,27 @@ def device_profile(refresh: bool = False) -> Optional[DeviceProfile]:
 
 def calibrated_min_rows(kind: str) -> int:
     """The derived threshold for ``kind`` — measured when possible, the
-    conservative tunnel constants otherwise."""
+    conservative tunnel constants otherwise.  A CPU-fallback backend keeps
+    the conservative constants too: the model's "device compute is never
+    the bottleneck" premise holds for the MXU/VPU, not for XLA-CPU
+    re-running the very kernels the numpy/arrow mirrors beat."""
     if kind not in STATIC_MIN_ROWS:
         raise KeyError(f"Unknown device op kind: {kind!r}")
     profile = device_profile()
-    if profile is None:
+    if profile is None or profile.platform == "cpu":
         return STATIC_MIN_ROWS[kind]
     return profile.min_rows(kind)
+
+
+def calibrated_resident_min_rows(kind: str) -> int:
+    """Threshold for device-RESIDENT inputs — latency-only break-even
+    (conservative constants on a CPU-fallback backend, as above)."""
+    if kind not in STATIC_RESIDENT_MIN_ROWS:
+        raise KeyError(f"Unknown device op kind: {kind!r}")
+    profile = device_profile()
+    if profile is None or profile.platform == "cpu":
+        return STATIC_RESIDENT_MIN_ROWS[kind]
+    return profile.resident_min_rows(kind)
 
 
 def profile_summary() -> Dict[str, object]:
@@ -211,7 +245,8 @@ def profile_summary() -> Dict[str, object]:
     profile = device_profile()
     if profile is None:
         return {"calibrated": False,
-                "thresholds": dict(STATIC_MIN_ROWS)}
+                "thresholds": dict(STATIC_MIN_ROWS),
+                "resident_thresholds": dict(STATIC_RESIDENT_MIN_ROWS)}
     return {
         "calibrated": True,
         "platform": profile.platform,
@@ -220,5 +255,9 @@ def profile_summary() -> Dict[str, object]:
         "d2h_mb_per_s": round(profile.d2h_bytes_per_s / 1e6, 2),
         "host_mrows_per_s": {k: round(v / 1e6, 2)
                              for k, v in profile.host_rows_per_s.items()},
-        "thresholds": {k: profile.min_rows(k) for k in STATIC_MIN_ROWS},
+        # Via the calibrated_* gates, so a CPU-fallback backend reports
+        # the conservative constants actually in effect.
+        "thresholds": {k: calibrated_min_rows(k) for k in STATIC_MIN_ROWS},
+        "resident_thresholds": {k: calibrated_resident_min_rows(k)
+                                for k in STATIC_RESIDENT_MIN_ROWS},
     }

@@ -105,10 +105,27 @@ def test_real_probe_smoke(monkeypatch):
 def test_profile_summary_uncalibrated(monkeypatch):
     monkeypatch.setenv("HS_CALIBRATE", "0")
     summary = calibrate.profile_summary()
-    assert summary == {"calibrated": False,
-                       "thresholds": dict(STATIC_MIN_ROWS)}
+    assert summary == {
+        "calibrated": False,
+        "thresholds": dict(STATIC_MIN_ROWS),
+        "resident_thresholds": dict(calibrate.STATIC_RESIDENT_MIN_ROWS)}
 
 
 def test_unknown_kind_rejected():
     with pytest.raises(KeyError):
         calibrate.calibrated_min_rows("scan")
+
+
+def test_cpu_fallback_backend_keeps_conservative_constants(monkeypatch):
+    """XLA-CPU 'device' kernels lose to the numpy/arrow mirrors — a
+    CPU-platform profile must not route work to them."""
+    monkeypatch.setenv("HS_CALIBRATE", "1")
+    cpu_fast = DeviceProfile(platform="cpu", latency_s=1e-4,
+                             h2d_bytes_per_s=1e10, d2h_bytes_per_s=1e10,
+                             host_rows_per_s=HOST_RATES)
+    monkeypatch.setattr(calibrate, "device_profile",
+                        lambda refresh=False: cpu_fast)
+    for kind, want in STATIC_MIN_ROWS.items():
+        assert calibrate.calibrated_min_rows(kind) == want
+    for kind, want in calibrate.STATIC_RESIDENT_MIN_ROWS.items():
+        assert calibrate.calibrated_resident_min_rows(kind) == want
