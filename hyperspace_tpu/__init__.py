@@ -15,7 +15,15 @@ from hyperspace_tpu.dataset import Dataset
 from hyperspace_tpu.exceptions import HyperspaceError
 from hyperspace_tpu.hyperspace import Hyperspace
 from hyperspace_tpu.index.index_config import DataSkippingIndexConfig, IndexConfig
-from hyperspace_tpu.plan.expr import col, lit, when
+from hyperspace_tpu.plan.expr import (
+    col,
+    dayofmonth,
+    lit,
+    month,
+    quarter,
+    when,
+    year,
+)
 from hyperspace_tpu.session import HyperspaceSession
 
 __version__ = "0.1.0"
@@ -31,4 +39,8 @@ __all__ = [
     "col",
     "lit",
     "when",
+    "year",
+    "month",
+    "dayofmonth",
+    "quarter",
 ]

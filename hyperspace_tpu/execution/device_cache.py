@@ -59,9 +59,15 @@ def files_fingerprint(paths: Iterable[str]) -> Optional[str]:
 class DeviceColumnCache:
     """Byte-budgeted LRU of device arrays (thread-safe)."""
 
+    _REJECTED_MAX = 4096  # bound the tombstone set; clear-all on overflow
+
     def __init__(self) -> None:
         self._entries: "OrderedDict[Key, object]" = OrderedDict()
         self._nbytes: Dict[Key, int] = {}
+        # Keys whose arrays did not fit the byte budget: the eager policy
+        # must stop lowering the routing threshold for them, or every
+        # repeat re-ships the column ("pay the transfer forever").
+        self._rejected: set = set()
         self._lock = threading.Lock()
         self.bytes_cached = 0
         self.hits = 0
@@ -84,9 +90,17 @@ class DeviceColumnCache:
         with self._lock:
             return key in self._entries
 
+    def was_rejected(self, key: Key) -> bool:
+        with self._lock:
+            return key in self._rejected
+
     def put(self, key: Key, arr, budget_bytes: int) -> None:
         nbytes = int(getattr(arr, "nbytes", 0) or 0)
         if nbytes <= 0 or nbytes > budget_bytes:
+            with self._lock:
+                if len(self._rejected) >= self._REJECTED_MAX:
+                    self._rejected.clear()
+                self._rejected.add(key)
             return
         with self._lock:
             if key in self._entries:
@@ -104,6 +118,7 @@ class DeviceColumnCache:
         with self._lock:
             self._entries.clear()
             self._nbytes.clear()
+            self._rejected.clear()
             self.bytes_cached = 0
 
     def stats(self) -> Dict[str, int]:

@@ -35,6 +35,7 @@ from hyperspace_tpu.plan.expr import (
     Cast,
     Col,
     Expr,
+    Extract,
     IsIn,
     IsNull,
     Lit,
@@ -147,12 +148,18 @@ class Executor:
         if identity is None:
             return min_rows
         # Eager lowers the threshold only when every input is CACHEABLE
-        # (computed hidden columns never are — re-shipping them per query
-        # would pay the transfer forever, not once).
+        # (computed hidden columns never are, and neither are columns the
+        # cache already rejected for exceeding the byte budget —
+        # re-shipping them per query would pay the transfer forever, not
+        # once).
+        from hyperspace_tpu.execution.device_cache import global_cache
+
+        cache = global_cache()
+        keys = [self._cache_key(identity, c, k) for c, k in pairs]
         eager_all_cacheable = (
             conf.device_cache_policy == "eager"
-            and all(self._cache_key(identity, c, k) is not None
-                    for c, k in pairs))
+            and all(k is not None and not cache.was_rejected(k)
+                    for k in keys))
         if eager_all_cacheable or self._all_resident(identity, pairs):
             return min(min_rows, conf.resident_min_rows(kind))
         return min_rows
@@ -1546,6 +1553,14 @@ def _arrow_eval(expr: Expr, table: pa.Table):
             return pc.cast(out, target)
         return pa.array([scalar_cast(v) for v in child.to_pylist()],
                         type=target)
+    if isinstance(expr, Extract):
+        child = _arrow_eval(expr.child, table)
+        fns = {"year": pc.year, "month": pc.month, "day": pc.day,
+               "quarter": pc.quarter}
+        out = fns[expr.field](child)
+        # Spark's year()/month()/... return INT (32-bit); arrow yields
+        # int64 — match Spark so downstream casts/joins see the same type.
+        return pc.cast(out, pa.int32())
     if isinstance(expr, StringMatch):
         child = _arrow_eval(expr.child, table)
         if expr.kind == "like":

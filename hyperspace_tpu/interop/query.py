@@ -28,6 +28,7 @@ from hyperspace_tpu.plan.expr import (
     Cast,
     Col,
     Expr,
+    Extract,
     IsIn,
     IsNull,
     Lit,
@@ -55,6 +56,9 @@ def value_expr_from_json(obj: Any) -> Expr:
         return Neg(value_expr_from_json(obj["child"]))
     if op == "cast":
         return Cast(value_expr_from_json(obj["child"]), obj["type"])
+    if op == "extract":
+        # {"op": "extract", "field": "year", "child": {"col": "d"}}
+        return Extract(obj["field"], value_expr_from_json(obj["child"]))
     if op == "case":
         # {"op": "case", "branches": [[cond, value], ...],
         #  "otherwise": value?}  Conditions are BOOLEAN expressions.

@@ -239,6 +239,8 @@ _CAST_ALIASES = {
     "byte": "int8", "tinyint": "int8",
     "double": "float64", "float": "float32",
     "boolean": "bool", "str": "string",
+    # Spark DATE is day-resolution; TIMESTAMP is microsecond.
+    "date": "date32", "timestamp": "timestamp[us]",
 }
 
 
@@ -260,7 +262,9 @@ class Cast(Expr):
 
         m = _re.match(r"([^\[\(]*)(.*)", type_name, _re.DOTALL)
         head, payload = m.group(1).strip().lower(), m.group(2)
-        name = _CAST_ALIASES.get(head, head) + payload
+        # Aliases apply only to bare names: "timestamp[ns]" keeps its own
+        # payload instead of inheriting the bare-"timestamp" default.
+        name = (head if payload else _CAST_ALIASES.get(head, head)) + payload
         from hyperspace_tpu.io.parquet import _dtype_from_string
 
         import pyarrow as pa
@@ -321,6 +325,47 @@ def when(condition: Expr, value: Any) -> CaseBuilder:
     return CaseBuilder([(condition, _lift(value))])
 
 
+class Extract(Expr):
+    """Calendar field extraction from a date/timestamp expression —
+    Spark's ``year(d_date)`` / ``month(...)`` / ``dayofmonth(...)`` /
+    ``quarter(...)`` surface (the reference gets these from Spark for
+    free; TPC-DS uses ``d_year = 2000``-style predicates from q1 on,
+    `/root/reference/src/test/resources/tpcds/queries/q1.sql:7`).
+
+    Host-evaluated (arrow pc.year & friends); ``year(col) CMP literal``
+    predicates over a temporal scan column are canonicalized to raw
+    column ranges at optimize time (plan/temporal.py), which restores
+    data-skipping pruning and device eligibility."""
+
+    FIELDS = ("year", "month", "day", "quarter")
+
+    def __init__(self, field: str, child: Expr) -> None:
+        if field not in self.FIELDS:
+            raise ValueError(f"Unsupported extract field {field!r}; "
+                             f"one of {self.FIELDS}")
+        self.field = field
+        self.child = child
+
+    def __repr__(self) -> str:
+        return f"{self.field}({self.child!r})"
+
+
+def year(e: "Expr | str") -> Extract:
+    return Extract("year", Col(e) if isinstance(e, str) else e)
+
+
+def month(e: "Expr | str") -> Extract:
+    return Extract("month", Col(e) if isinstance(e, str) else e)
+
+
+def dayofmonth(e: "Expr | str") -> Extract:
+    return Extract("day", Col(e) if isinstance(e, str) else e)
+
+
+def quarter(e: "Expr | str") -> Extract:
+    return Extract("quarter", Col(e) if isinstance(e, str) else e)
+
+
 class IsNull(Expr):
     """SQL IS NULL — unlike comparisons (null => unknown => row drops),
     this yields TRUE for null values.  The device filter path and every
@@ -366,6 +411,8 @@ def _collect_columns(e: Expr, out: Set[str]) -> None:
     elif isinstance(e, StringMatch):
         _collect_columns(e.child, out)
     elif isinstance(e, Cast):
+        _collect_columns(e.child, out)
+    elif isinstance(e, Extract):
         _collect_columns(e.child, out)
     elif isinstance(e, Case):
         for c, v in e.branches:

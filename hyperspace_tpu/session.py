@@ -228,6 +228,12 @@ class HyperspaceSession:
             # pruning into its siblings — so first rebuild the plan as a
             # tree with a distinct node object per occurrence.
             plan = _uniquify(plan)
+            # year(col)-style predicates over temporal scan columns become
+            # raw ranges FIRST (plan/temporal.py): the rules' pruning
+            # analyses and the device kernel only understand ranges.
+            from hyperspace_tpu.plan.temporal import canonicalize_temporal
+
+            plan = canonicalize_temporal(plan, self.schema_map_of)
             plan = prune_columns(plan, self.schema_of)
             if not self._hyperspace_enabled:
                 return plan

@@ -1,0 +1,252 @@
+"""Date/time types and functions (round-3 verdict item 4).
+
+The reference gets d_year-style predicates, date literals, and casts from
+Spark (e.g. /root/reference/src/test/resources/tpcds/queries/q1.sql:7);
+this engine owns the surface: Extract (year/month/day/quarter), date
+literals and string coercion, the year-range canonicalization that keeps
+pruning + device routing alive, and date32 keys through every index kind.
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import (
+    DataSkippingIndexConfig,
+    Hyperspace,
+    HyperspaceSession,
+    IndexConfig,
+    col,
+    dayofmonth,
+    month,
+    quarter,
+    year,
+)
+
+BASE = datetime.date(1992, 1, 1)
+
+
+@pytest.fixture()
+def env(tmp_path):
+    data = str(tmp_path / "data")
+    os.makedirs(data)
+    rng = np.random.default_rng(21)
+    n = 40_000
+    # ~7 years of dates, monotone so each file covers a disjoint range
+    # (the layout data skipping exploits, like l_shipdate).
+    days = (np.arange(n) * 2556 // n).astype("timedelta64[D]")
+    dates = np.datetime64(BASE) + days
+    t = pa.table({
+        "k": pa.array(np.arange(n, dtype=np.int64)),
+        "d": pa.array(dates),
+        "v": pa.array(rng.random(n)),
+    })
+    for i in range(8):
+        pq.write_table(t.slice(i * n // 8, n // 8),
+                       os.path.join(data, f"part-{i:05d}.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    s.conf.num_buckets = 4
+    return s, data, t.to_pandas()
+
+
+def test_extract_fields_match_pandas(env):
+    s, data, df = env
+    out = (s.read.parquet(data)
+           .select("k", y=year("d"), m=month("d"), dom=dayofmonth("d"),
+                   q=quarter("d"))
+           .collect().to_pandas().sort_values("k"))
+    dd = pd.to_datetime(df.sort_values("k")["d"])
+    np.testing.assert_array_equal(out["y"], dd.dt.year)
+    np.testing.assert_array_equal(out["m"], dd.dt.month)
+    np.testing.assert_array_equal(out["dom"], dd.dt.day)
+    np.testing.assert_array_equal(out["q"], dd.dt.quarter)
+    assert out["y"].dtype == np.int32  # Spark's INT, not arrow's int64
+
+
+def test_extract_null_propagates(tmp_path):
+    d = str(tmp_path / "nulls")
+    os.makedirs(d)
+    pq.write_table(pa.table({
+        "d": pa.array([datetime.date(2000, 5, 5), None]),
+    }), os.path.join(d, "p.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    out = s.read.parquet(d).select(y=year("d")).collect()
+    assert out.column("y").to_pylist() == [2000, None]
+    # In a predicate, the null row drops (SQL 3VL).
+    assert s.read.parquet(d).filter(year("d") == 2000).count() == 1
+
+
+def test_year_predicate_canonicalizes_to_range(env):
+    s, data, df = env
+    want = int((pd.to_datetime(df["d"]).dt.year == 1994).sum())
+    ds = s.read.parquet(data).filter(year("d") == 1994)
+    plan = ds.optimized_plan()
+    assert "Extract" not in repr(plan.tree_string()) \
+        and "year(" not in plan.tree_string()
+    assert ds.count() == want
+    # Every comparison shape.
+    yy = pd.to_datetime(df["d"]).dt.year
+    for pred, mask in ((year("d") >= 1995, yy >= 1995),
+                       (year("d") > 1995, yy > 1995),
+                       (year("d") <= 1993, yy <= 1993),
+                       (year("d") < 1993, yy < 1993),
+                       (1994 == year("d"), yy == 1994),
+                       (year("d").isin([1993, 1995]),
+                        yy.isin([1993, 1995]))):
+        assert s.read.parquet(data).filter(pred).count() == int(mask.sum())
+
+
+def test_month_extract_not_rewritten_but_correct(env):
+    s, data, df = env
+    want = int((pd.to_datetime(df["d"]).dt.month == 7).sum())
+    assert s.read.parquet(data).filter(month("d") == 7).count() == want
+
+
+def test_data_skipping_prunes_on_year_predicate(env):
+    s, data, df = env
+    hs = Hyperspace(s)
+    hs.create_index(s.read.parquet(data), DataSkippingIndexConfig(
+        "d_ds", ["d"]))
+    s.enable_hyperspace()
+    ds = s.read.parquet(data).filter(year("d") == 1993).select("k", "d")
+    plan = ds.optimized_plan()
+    pruned = [sc for sc in plan.leaf_relations()
+              if sc.relation.data_skipping_of]
+    assert pruned, plan.tree_string()
+    # 7 years over 8 monotone files: the 1993 range needs < half of them.
+    assert len(pruned[0].relation.file_paths) < 8
+    got = ds.collect()
+    want = df[pd.to_datetime(df["d"]).dt.year == 1993]
+    assert got.num_rows == len(want)
+    assert sorted(got.column("k").to_pylist()) == sorted(want["k"])
+
+
+def test_covering_index_on_date_key(env):
+    s, data, df = env
+    hs = Hyperspace(s)
+    hs.create_index(s.read.parquet(data),
+                    IndexConfig("d_idx", ["d"], ["k", "v"]))
+    s.enable_hyperspace()
+    probe = datetime.date(1994, 6, 1)
+    ds = (s.read.parquet(data).filter(col("d") == probe).select("k"))
+    plan = ds.optimized_plan()
+    used = [sc for sc in plan.leaf_relations() if sc.relation.index_scan_of]
+    assert used, plan.tree_string()
+    got = sorted(ds.collect().column("k").to_pylist())
+    want = sorted(df[df["d"] == probe]["k"])
+    assert got == want
+
+
+def test_zorder_on_date_dimension(env):
+    s, data, df = env
+    hs = Hyperspace(s)
+    s.conf.num_buckets = 1
+    s.conf.index_max_rows_per_file = 5000
+    hs.create_index(s.read.parquet(data),
+                    IndexConfig("dz", ["d", "v"], ["k"], layout="zorder"))
+    s.conf.num_buckets = 4
+    s.conf.index_max_rows_per_file = 0
+    s.enable_hyperspace()
+    lo, hi = datetime.date(1995, 1, 1), datetime.date(1995, 3, 1)
+    ds = (s.read.parquet(data)
+          .filter((col("d") >= lo) & (col("d") < hi)).select("k", "d"))
+    got = ds.collect()
+    scans = (s.last_execution_stats or {}).get("scans", [])
+    # The Z-curve index has 8 ~5000-row files; a 2-month window on the
+    # date dimension must read a strict subset of them.
+    assert scans and scans[-1]["is_index"] \
+        and scans[-1]["files_read"] < 8, scans
+    mask = (df["d"] >= lo) & (df["d"] < hi)
+    assert got.num_rows == int(mask.sum())
+
+
+def test_date_string_literal_coerces(env):
+    s, data, df = env
+    n1 = s.read.parquet(data).filter(col("d") >= "1997-01-01").count()
+    n2 = s.read.parquet(data).filter(
+        col("d") >= datetime.date(1997, 1, 1)).count()
+    assert n1 == n2 == int((df["d"] >= datetime.date(1997, 1, 1)).sum())
+
+
+def test_cast_date_and_timestamp_aliases(env):
+    s, data, _df = env
+    out = (s.read.parquet(data).limit(1)
+           .select(a=col("d").cast("DATE"),
+                   b=col("d").cast("timestamp"),
+                   c=col("d").cast("timestamp[ns]")))
+    tbl = out.collect()
+    assert str(tbl.schema.field("a").type) == "date32[day]"
+    assert str(tbl.schema.field("b").type) == "timestamp[us]"
+    assert str(tbl.schema.field("c").type) == "timestamp[ns]"
+    # String -> date cast parses; bad values null (non-ANSI).
+    t2 = (s.read.parquet(data).limit(1)
+          .select(d=col("k").cast("string"))
+          .collect())
+    assert t2.num_rows == 1
+
+
+def test_device_routing_parity_on_date_predicates(env):
+    """Date-vs-date-literal predicates are device-eligible; outcomes match
+    the host path on both sides of the threshold."""
+    s, data, df = env
+    probe = datetime.date(1996, 1, 1)
+    pred = col("d") >= probe
+    s.conf.device_filter_min_rows = 10**9
+    host = s.read.parquet(data).filter(pred).count()
+    s.conf.device_filter_min_rows = 1
+    dev = s.read.parquet(data).filter(pred).count()
+    assert host == dev == int((df["d"] >= probe).sum())
+
+
+def test_extract_over_interop_spec(env):
+    s, data, df = env
+    from hyperspace_tpu.interop.query import dataset_from_spec
+
+    out = dataset_from_spec(s, {
+        "source": {"format": "parquet", "path": data},
+        "select": ["k", {"name": "y", "expr":
+                         {"op": "extract", "field": "year",
+                          "child": {"col": "d"}}}],
+        "limit": 5,
+    }).collect()
+    assert out.column_names == ["k", "y"]
+    assert out.column("y").to_pylist() == \
+        pd.to_datetime(df["d"].iloc[:5]).dt.year.tolist()
+
+
+def test_tz_aware_timestamp_not_canonicalized(tmp_path):
+    """year() over a tz-aware timestamp extracts in LOCAL time; the
+    UTC-epoch range rewrite must not fire for it."""
+    d = str(tmp_path / "tz")
+    os.makedirs(d)
+    # 1994-01-01 01:00 UTC is 1993-12-31 20:00 in America/New_York.
+    ts = pa.array([datetime.datetime(1994, 1, 1, 1, 0),
+                   datetime.datetime(1994, 6, 1, 0, 0)],
+                  type=pa.timestamp("us", tz="America/New_York"))
+    pq.write_table(pa.table({"t": ts}), os.path.join(d, "p.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    ds = s.read.parquet(d).filter(year("t") == 1994)
+    assert "year(" in ds.optimized_plan().tree_string()
+    assert ds.count() == 1  # local-time year of the first row is 1993
+
+
+def test_out_of_range_year_literal_does_not_crash_optimize(env):
+    s, data, _df = env
+    for pred in (year("d") >= 9999, year("d") == 0, year("d") == -5,
+                 year("d") == 10_000):
+        assert s.read.parquet(data).filter(pred).count() == 0
+    # Mixed in/out-of-range IN list: host Extract evaluates it correctly.
+    import pandas as pd
+
+    df = pd.read_parquet(data)
+    want_1994 = int((pd.to_datetime(df["d"]).dt.year == 1994).sum())
+    assert s.read.parquet(data).filter(
+        year("d").isin([1994, 10_000])).count() == want_1994
+    assert s.read.parquet(data).filter(year("d") <= 9998).count() == 40_000

@@ -224,3 +224,22 @@ def test_eager_policy_ignores_uncacheable_computed_inputs(env):
      .agg(total=(col("v") * 2, "sum")).collect())
     aggs = (s.last_execution_stats or {}).get("aggregates", [])
     assert not aggs, aggs  # host hash aggregation, no device record
+
+
+def test_eager_stops_lowering_after_budget_rejection(env):
+    """A column too big for the byte budget is rejected once; eager must
+    then stop routing repeats through the device ('pay forever' guard)."""
+    s, data = env
+    s.conf.device_cache_policy = "eager"
+    s.conf.device_resident_min_rows = 1
+    s.conf.device_cache_bytes = 1024  # smaller than any 20k-row column
+
+    def q():
+        return s.read.parquet(data).filter(col("k") >= 19_000).count()
+
+    assert q() == 1000
+    st1 = s.last_execution_stats
+    assert st1["filters"][-1]["strategy"] == "device"  # first try ships
+    assert q() == 1000
+    st2 = s.last_execution_stats
+    assert st2["filters"][-1]["strategy"] == "host", st2["filters"]

@@ -44,6 +44,11 @@ def catalog(tmp_path_factory):
         "b": pa.array(rng.integers(-50, 50, N_ROWS), type=pa.int64()),
         "f": pa.array(np.round(rng.uniform(-10, 10, N_ROWS), 3)),
         "s": pa.array([f"k{i % 37:02d}" for i in range(N_ROWS)]),
+        # Dates spread over ~4 years; year() predicates canonicalize to
+        # ranges (plan/temporal.py) and must stay answer-equivalent.
+        "d": pa.array(np.datetime64("1993-01-01")
+                      + rng.integers(0, 1461, N_ROWS)
+                      .astype("timedelta64[D]")),
     })
     step = N_ROWS // N_FILES
     for i in range(N_FILES):
@@ -54,10 +59,13 @@ def catalog(tmp_path_factory):
     session.conf.index_max_rows_per_file = 64
     hs = Hyperspace(session)
     read = session.read
-    hs.create_index(read.parquet(data), IndexConfig("ia", ["a"], ["b", "f"]))
+    hs.create_index(read.parquet(data),
+                    IndexConfig("ia", ["a"], ["b", "f", "d"]))
     hs.create_index(read.parquet(data),
                     IndexConfig("iz", ["a", "b"], ["f"], layout="zorder"))
     hs.create_index(read.parquet(data), DataSkippingIndexConfig("ids", ["b"]))
+    hs.create_index(read.parquet(data),
+                    DataSkippingIndexConfig("idd", ["d"]))
     return session, data
 
 
@@ -65,8 +73,34 @@ _COLS = ["a", "b", "f"]
 
 
 def _leaf(draw):
-    c = draw(st.sampled_from(_COLS))
+    c = draw(st.sampled_from(_COLS + ["d", "year(d)"]))
     op = draw(st.sampled_from(["==", "<", "<=", ">", ">=", "isin"]))
+    if c == "d":
+        import datetime
+
+        days = draw(st.integers(min_value=-30, max_value=1500))
+        d = datetime.date(1993, 1, 1) + datetime.timedelta(days=days)
+        if op == "isin":
+            more = draw(st.lists(
+                st.integers(min_value=0, max_value=1460),
+                min_size=0, max_size=3))
+            vals = [d] + [datetime.date(1993, 1, 1)
+                          + datetime.timedelta(days=m) for m in more]
+            return col("d").isin(vals)
+        return {"==": col("d") == d, "<": col("d") < d,
+                "<=": col("d") <= d, ">": col("d") > d,
+                ">=": col("d") >= d}[op]
+    if c == "year(d)":
+        from hyperspace_tpu import year
+
+        y = draw(st.integers(min_value=1992, max_value=1998))
+        if op == "isin":
+            vals = draw(st.lists(st.integers(min_value=1992, max_value=1998),
+                                 min_size=1, max_size=3))
+            return year("d").isin(vals)
+        return {"==": year("d") == y, "<": year("d") < y,
+                "<=": year("d") <= y, ">": year("d") > y,
+                ">=": year("d") >= y}[op]
     if c == "f":
         lit = draw(st.floats(min_value=-12, max_value=12, allow_nan=False))
         lit = round(lit, 2)
@@ -102,7 +136,7 @@ _EXAMPLES = int(os.environ.get("HS_FUZZ_EXAMPLES", "60"))
 @settings(max_examples=_EXAMPLES, deadline=None,
           suppress_health_check=[HealthCheck.function_scoped_fixture])
 @given(pred=predicates(), projection=st.sampled_from(
-    [("a", "b"), ("a", "b", "f"), ("b", "f"), ("a",)]))
+    [("a", "b"), ("a", "b", "f"), ("b", "f"), ("a",), ("a", "d")]))
 def test_filter_answer_equivalence(catalog, pred, projection):
     session, data = catalog
     ds = session.read.parquet(data).filter(pred).select(*projection)
@@ -149,6 +183,9 @@ def delta_catalog(tmp_path_factory):
             "a": pa.array(rng.integers(0, 100, n), type=pa.int64()),
             "b": pa.array(rng.integers(-50, 50, n), type=pa.int64()),
             "f": pa.array(np.round(rng.uniform(-10, 10, n), 3)),
+            "d": pa.array(np.datetime64("1993-01-01")
+                          + rng.integers(0, 1461, n)
+                          .astype("timedelta64[D]")),
             # Unique per row: duplicate (a,b,f) triples can't mask a
             # dropped/duplicated row in the canonical comparison.
             "rid": pa.array(np.arange(start, start + n, dtype=np.int64)),
@@ -164,7 +201,7 @@ def delta_catalog(tmp_path_factory):
     session.conf.hybrid_scan_max_deleted_ratio = 1.0
     hs = Hyperspace(session)
     hs.create_index(session.read.delta(table_path),
-                    IndexConfig("da", ["a"], ["b", "f", "rid"]))
+                    IndexConfig("da", ["a"], ["b", "f", "d", "rid"]))
     # Mutate AFTER indexing: hybrid scan must patch both directions.
     write_delta(chunk(100, 450), table_path, mode="append")
     delete_where_file(table_path, DeltaLog(table_path).snapshot().files[0].path)
