@@ -18,9 +18,12 @@ from hyperspace_tpu.index.index_config import DataSkippingIndexConfig, IndexConf
 from hyperspace_tpu.plan.expr import (
     col,
     dayofmonth,
+    in_subquery,
     lit,
     month,
+    outer_ref,
     quarter,
+    scalar,
     when,
     year,
 )
@@ -43,4 +46,7 @@ __all__ = [
     "month",
     "dayofmonth",
     "quarter",
+    "scalar",
+    "in_subquery",
+    "outer_ref",
 ]

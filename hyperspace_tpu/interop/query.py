@@ -29,14 +29,32 @@ from hyperspace_tpu.plan.expr import (
     Col,
     Expr,
     Extract,
+    InSubquery,
     IsIn,
     IsNull,
     Lit,
     Neg,
     Not,
     Or,
+    OuterRef,
+    ScalarSubquery,
     StringMatch,
 )
+
+# Session in scope while a spec decodes — subquery specs need it to build
+# their Dataset trees (thread-local: the interop server decodes
+# concurrently on worker threads).
+import threading
+
+_SPEC_TLS = threading.local()
+
+
+def _subquery_plan(spec: Dict[str, Any]):
+    session = getattr(_SPEC_TLS, "session", None)
+    if session is None:
+        raise ValueError("Subquery specs are only valid inside a full "
+                         "query spec (dataset_from_spec)")
+    return dataset_from_spec(session, spec).plan
 
 _CMP_OPS = ("==", "<", "<=", ">", ">=")
 _ARITH_OPS = ("+", "-", "*", "/")
@@ -59,6 +77,13 @@ def value_expr_from_json(obj: Any) -> Expr:
     if op == "extract":
         # {"op": "extract", "field": "year", "child": {"col": "d"}}
         return Extract(obj["field"], value_expr_from_json(obj["child"]))
+    if op == "scalar_subquery":
+        # {"op": "scalar_subquery", "query": {full query spec}} — the
+        # session resolves via the _SPEC_TLS thread-local that
+        # dataset_from_spec sets while decoding.
+        return ScalarSubquery(_subquery_plan(obj["query"]))
+    if op == "outer_ref":
+        return OuterRef(obj["name"])
     if op == "case":
         # {"op": "case", "branches": [[cond, value], ...],
         #  "otherwise": value?}  Conditions are BOOLEAN expressions.
@@ -96,6 +121,10 @@ def expr_from_json(obj: Dict[str, Any]) -> Expr:
         return IsIn(Col(obj["col"]), list(obj["values"]))
     if op == "is_null":
         return IsNull(Col(obj["col"]))
+    if op == "in_subquery":
+        # {"op": "in_subquery", "col": "k", "query": {full query spec}};
+        # wrap in {"op": "not", ...} for SQL's null-aware NOT IN.
+        return InSubquery(Col(obj["col"]), _subquery_plan(obj["query"]))
     if op in StringMatch.KINDS:
         return StringMatch(op, Col(obj["col"]), obj["pattern"])
     raise ValueError(f"Unknown expression op: {op!r}")
@@ -119,6 +148,15 @@ def _read_source(session, source: Dict[str, Any]):
 def dataset_from_spec(session, spec: Dict[str, Any]):
     """Build a Dataset from ``spec`` against ``session`` (whose hyperspace
     enablement and indexes govern rewrites, exactly as for local use)."""
+    prev = getattr(_SPEC_TLS, "session", None)
+    _SPEC_TLS.session = session
+    try:
+        return _dataset_from_spec(session, spec)
+    finally:
+        _SPEC_TLS.session = prev
+
+
+def _dataset_from_spec(session, spec: Dict[str, Any]):
     ds = _read_source(session, spec["source"])
     if "filter" in spec:
         ds = ds.filter(expr_from_json(spec["filter"]))

@@ -379,6 +379,67 @@ class IsNull(Expr):
         return f"{self.child!r}.is_null()"
 
 
+class ScalarSubquery(Expr):
+    """A one-column subquery used as a scalar value — the reference's
+    corpus leans on these from its first query (TPC-DS q1 compares
+    against ``(SELECT avg(ctr_total_return)*1.2 ... WHERE correlated)``,
+    `/root/reference/src/test/resources/tpcds/queries/q1.sql:11-12`).
+
+    Rewritten at optimize time (plan/subquery.py): uncorrelated ones
+    evaluate once and fold into a literal (so pruning and the device
+    kernel see a plain constant); correlated ones (containing
+    ``outer_ref`` markers) become aggregate-then-join.  Never reaches
+    the executor."""
+
+    def __init__(self, plan) -> None:
+        # Accepts a Dataset or a LogicalPlan (duck-typed to avoid the
+        # circular dataset import).
+        self.plan = getattr(plan, "plan", plan)
+
+    def __repr__(self) -> str:
+        return f"scalar_subquery({type(self.plan).__name__})"
+
+
+class InSubquery(Expr):
+    """``child IN (SELECT single-column ...)`` — rewritten to a SEMI join
+    at optimize time; under NOT, to a null-aware ANTI join (SQL's NOT IN
+    returns no rows when the subquery yields any null)."""
+
+    def __init__(self, child: Expr, plan) -> None:
+        self.child = child
+        self.plan = getattr(plan, "plan", plan)
+
+    def __repr__(self) -> str:
+        return f"{self.child!r}.isin(subquery({type(self.plan).__name__}))"
+
+
+class OuterRef(Expr):
+    """Correlation marker inside a subquery: references a column of the
+    OUTER query (Spark's OuterReference).  Only meaningful inside a
+    ``scalar(...)`` subplan; the rewrite turns the enclosing equality
+    into a join key."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+
+    def __repr__(self) -> str:
+        return f"outer_ref({self.name!r})"
+
+
+def scalar(ds) -> ScalarSubquery:
+    """Scalar subquery: ``filter(col('v') > scalar(sub) * 1.2)``."""
+    return ScalarSubquery(ds)
+
+
+def in_subquery(column: "Expr | str", ds) -> InSubquery:
+    """IN-subquery predicate: ``filter(in_subquery('k', sub))``."""
+    return InSubquery(Col(column) if isinstance(column, str) else column, ds)
+
+
+def outer_ref(name: str) -> OuterRef:
+    return OuterRef(name)
+
+
 def col(name: str) -> Col:
     return Col(name)
 
@@ -414,6 +475,10 @@ def _collect_columns(e: Expr, out: Set[str]) -> None:
         _collect_columns(e.child, out)
     elif isinstance(e, Extract):
         _collect_columns(e.child, out)
+    elif isinstance(e, InSubquery):
+        _collect_columns(e.child, out)
+    # ScalarSubquery/OuterRef: no OUTER columns of their own; the
+    # subquery rewrite runs before any pass that consumes column sets.
     elif isinstance(e, Case):
         for c, v in e.branches:
             _collect_columns(c, out)

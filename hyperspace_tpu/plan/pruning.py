@@ -45,18 +45,29 @@ def prune_columns(plan: LogicalPlan, schema_of) -> LogicalPlan:
 def _prune(plan: LogicalPlan, required: Optional[Set[str]],
            schema_of) -> LogicalPlan:
     if isinstance(plan, Project):
-        # The project defines exactly what its subtree must produce.
-        child_required = set(plan.columns)
+        # The project defines what its subtree must produce — narrowed to
+        # the parent's declared needs first (a schema-preserving Project,
+        # like the one the subquery rewrite inserts to hide join-side
+        # columns, must not pin every column against a parent that reads
+        # two of them).
+        cols = plan.columns
+        if required is not None:
+            narrowed = [c for c in cols if c in required]
+            if not narrowed and cols:
+                # Literal-only parent: keep one column for the row count.
+                narrowed = [cols[0]]
+            cols = narrowed
+        child_required = set(cols)
         new_child = _prune(plan.child, child_required, schema_of)
         # Collapse Project(A, Project(B, x)) when A ⊆ B — in particular the
         # pruning Project this pass just inserted under a user Project (B=A).
         # Keeps optimize() idempotent and leaves scans one Project away for
         # the rules' pattern matching.
         if isinstance(new_child, Project) \
-                and set(plan.columns) <= set(new_child.columns):
+                and set(cols) <= set(new_child.columns):
             new_child = new_child.child
-        if new_child is not plan.child:
-            return Project(plan.columns, new_child)
+        if new_child is not plan.child or cols != plan.columns:
+            return Project(cols, new_child)
         return plan
     if isinstance(plan, Compute):
         # Like Project, a Compute defines exactly what its subtree must

@@ -1,0 +1,456 @@
+"""Subquery rewrites: scalar folding, IN -> semi, correlated -> agg-join.
+
+The reference rides Spark's subquery planning (TPC-DS q1's correlated
+scalar, `/root/reference/src/test/resources/tpcds/queries/q1.sql:11-12`;
+NOT IN / EXISTS throughout the corpus); this engine rewrites them into
+its own relational surface at optimize time, BEFORE every other pass, so
+pruning analyses and the device kernels see only plain joins, filters,
+and literals:
+
+  - UNCORRELATED ``scalar(sub)``: the subplan is optimized and executed
+    once; its single value folds into a literal (0 rows -> NULL, >1 rows
+    -> error, as in Spark).  A folded threshold is a plain constant, so
+    data-skipping and bucket pruning fire on it.
+  - ``in_subquery(col, sub)`` as a top-level conjunct: SEMI join on
+    col == sub's single output column.
+  - ``~in_subquery(col, sub)``: NULL-AWARE anti join.  SQL's NOT IN is
+    three-valued: any null in the subquery answers no rows; a null probe
+    matches nothing but only survives when the subquery is empty.  Two
+    Limit(1) probes (any-null?, any-row?) decide the shape: always-false
+    filter / plain pass-through / anti join + probe IS NOT NULL.
+  - CORRELATED ``scalar(sub)`` (subplan contains ``outer_ref`` equality
+    conjuncts under a global aggregate): rewritten to aggregate-by-the-
+    correlation-keys then INNER join — exactly the q1 shape.  Inner join
+    is correct because a missing group yields scalar NULL, which drops
+    the row from the comparison anyway; correlated scalars are therefore
+    supported in FILTER predicates only.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from hyperspace_tpu.plan.expr import (
+    And,
+    Arith,
+    BinOp,
+    Case,
+    Cast,
+    Col,
+    Expr,
+    Extract,
+    InSubquery,
+    IsIn,
+    IsNull,
+    Lit,
+    Neg,
+    Not,
+    Or,
+    OuterRef,
+    ScalarSubquery,
+    StringMatch,
+    split_conjuncts,
+)
+from hyperspace_tpu.plan.nodes import (
+    Aggregate,
+    Compute,
+    Filter,
+    Join,
+    LogicalPlan,
+    Project,
+)
+
+
+class SubqueryError(ValueError):
+    """Unsupported subquery shape — the message says what to rewrite."""
+
+
+def _walk_exprs(e: Expr, fn) -> None:
+    fn(e)
+    for attr in ("left", "right", "child", "otherwise"):
+        c = getattr(e, attr, None)
+        if isinstance(c, Expr):
+            _walk_exprs(c, fn)
+    if isinstance(e, Case):
+        for c, v in e.branches:
+            _walk_exprs(c, fn)
+            _walk_exprs(v, fn)
+
+
+def _contains(e: Expr, kinds) -> bool:
+    found = []
+    _walk_exprs(e, lambda x: found.append(x) if isinstance(x, kinds) else None)
+    return bool(found)
+
+
+def _plan_has_subqueries(plan: LogicalPlan) -> bool:
+    for e in _plan_exprs(plan):
+        if _contains(e, (ScalarSubquery, InSubquery, OuterRef)):
+            return True
+    return any(_plan_has_subqueries(c) for c in plan.children)
+
+
+def _plan_exprs(plan: LogicalPlan) -> List[Expr]:
+    out: List[Expr] = []
+    if isinstance(plan, Filter):
+        out.append(plan.condition)
+    if isinstance(plan, Join):
+        out.append(plan.condition)
+    if hasattr(plan, "exprs"):  # Compute / WithColumns
+        out += [e for _n, e in plan.exprs]
+    if isinstance(plan, Aggregate):
+        out += [a for _f, a, _o in plan.aggs if isinstance(a, Expr)]
+    return out
+
+
+def _plan_has_outer_refs(plan: LogicalPlan) -> bool:
+    for e in _plan_exprs(plan):
+        if _contains(e, OuterRef):
+            return True
+    return any(_plan_has_outer_refs(c) for c in plan.children)
+
+
+def _map_expr(e: Expr, fn) -> Expr:
+    """Rebuild ``e`` with ``fn`` applied to every node (bottom-up)."""
+    if isinstance(e, BinOp):
+        return fn(BinOp(e.op, _map_expr(e.left, fn), _map_expr(e.right, fn)))
+    if isinstance(e, Arith):
+        return fn(Arith(e.op, _map_expr(e.left, fn), _map_expr(e.right, fn)))
+    if isinstance(e, And):
+        return fn(And(_map_expr(e.left, fn), _map_expr(e.right, fn)))
+    if isinstance(e, Or):
+        return fn(Or(_map_expr(e.left, fn), _map_expr(e.right, fn)))
+    if isinstance(e, Not):
+        return fn(Not(_map_expr(e.child, fn)))
+    if isinstance(e, Neg):
+        return fn(Neg(_map_expr(e.child, fn)))
+    if isinstance(e, IsNull):
+        return fn(IsNull(_map_expr(e.child, fn)))
+    if isinstance(e, IsIn):
+        return fn(IsIn(_map_expr(e.child, fn), e.values))
+    if isinstance(e, Cast):
+        out = Cast(Lit(None), e.type_name)
+        out.child = _map_expr(e.child, fn)
+        return fn(out)
+    if isinstance(e, Extract):
+        return fn(Extract(e.field, _map_expr(e.child, fn)))
+    if isinstance(e, StringMatch):
+        return fn(StringMatch(e.kind, _map_expr(e.child, fn), e.pattern))
+    if isinstance(e, Case):
+        return fn(Case([(_map_expr(c, fn), _map_expr(v, fn))
+                        for c, v in e.branches],
+                       _map_expr(e.otherwise, fn)))
+    return fn(e)
+
+
+def _const_fold(e: Expr) -> Expr:
+    """Collapse literal-only arithmetic left behind by scalar folding
+    (``col > lit(7999) - lit(500)`` -> ``col > lit(7499)``) so pruning
+    analyses see one plain constant.  Spark semantics: null propagates,
+    division is DOUBLE with x/0 -> null."""
+
+    def fold(x: Expr) -> Expr:
+        if isinstance(x, Arith) and isinstance(x.left, Lit) \
+                and isinstance(x.right, Lit):
+            a, b = x.left.value, x.right.value
+            if a is None or b is None:
+                return Lit(None)
+            if not isinstance(a, (int, float)) \
+                    or not isinstance(b, (int, float)) \
+                    or isinstance(a, bool) or isinstance(b, bool):
+                return x
+            if x.op == "+":
+                return Lit(a + b)
+            if x.op == "-":
+                return Lit(a - b)
+            if x.op == "*":
+                return Lit(a * b)
+            return Lit(None) if b == 0 else Lit(float(a) / float(b))
+        if isinstance(x, Neg) and isinstance(x.child, Lit) \
+                and isinstance(x.child.value, (int, float)) \
+                and not isinstance(x.child.value, bool):
+            return Lit(-x.child.value)
+        return x
+
+    return _map_expr(e, fold)
+
+
+def _fold_scalar(sub: LogicalPlan, session) -> Lit:
+    """Execute an uncorrelated scalar subplan once; fold to a literal."""
+    from hyperspace_tpu.execution.executor import Executor
+
+    table = Executor(session).execute(session.optimize(sub))
+    if table.num_columns != 1:
+        raise SubqueryError(
+            f"Scalar subquery must produce exactly one column, got "
+            f"{table.column_names}")
+    if table.num_rows > 1:
+        raise SubqueryError(
+            f"Scalar subquery returned {table.num_rows} rows; at most one "
+            f"is allowed")
+    if table.num_rows == 0:
+        return Lit(None)
+    return Lit(table.column(0)[0].as_py())
+
+
+def _split_correlations(plan: LogicalPlan):
+    """Remove ``inner == outer_ref`` conjuncts from the Filters of a
+    subplan chain; returns (new_plan, [(outer_name, inner_name)])."""
+    pairs: List[Tuple[str, str]] = []
+
+    def strip(node: LogicalPlan) -> LogicalPlan:
+        children = tuple(strip(c) for c in node.children)
+        node = node.with_children(children)
+        if not isinstance(node, Filter):
+            return node
+        keep = []
+        for conj in split_conjuncts(node.condition):
+            corr = _as_correlation(conj)
+            if corr is not None:
+                pairs.append(corr)
+            else:
+                if _contains(conj, OuterRef):
+                    raise SubqueryError(
+                        f"Correlated subquery predicates must be "
+                        f"inner_col == outer_ref(...) equality conjuncts; "
+                        f"got {conj!r}")
+                keep.append(conj)
+        if not keep:
+            return node.child
+        cond = keep[0]
+        for c in keep[1:]:
+            cond = And(cond, c)
+        return Filter(cond, node.child)
+
+    return strip(plan), pairs
+
+
+def _as_correlation(conj: Expr) -> Optional[Tuple[str, str]]:
+    if isinstance(conj, BinOp) and conj.op == "==":
+        if isinstance(conj.left, Col) and isinstance(conj.right, OuterRef):
+            return (conj.right.name, conj.left.name)
+        if isinstance(conj.right, Col) and isinstance(conj.left, OuterRef):
+            return (conj.left.name, conj.right.name)
+    return None
+
+
+def _null_rejecting_path(e: Expr, target: Expr) -> bool:
+    """True when every ancestor of ``target`` inside ``e`` propagates a
+    NULL operand to a not-TRUE result (BinOp/Arith/Neg/Not/And/IsIn/
+    Cast/StringMatch all do).  Or, IsNull, and Case can turn the NULL of
+    a missing correlation group into TRUE — under those, the inner-join
+    rewrite would silently drop rows SQL keeps, so the caller must
+    reject instead."""
+    if e is target:
+        return True
+    nullable_safe = (BinOp, Arith, Neg, Not, And, IsIn, Cast, StringMatch,
+                     Extract)
+    for attr in ("left", "right", "child", "otherwise"):
+        c = getattr(e, attr, None)
+        if isinstance(c, Expr) and _subtree_has(c, target):
+            return isinstance(e, nullable_safe) \
+                and _null_rejecting_path(c, target)
+    if isinstance(e, Case):
+        for cond, v in e.branches:
+            if _subtree_has(cond, target) or _subtree_has(v, target):
+                return False
+    return False
+
+
+def _subtree_has(e: Expr, target: Expr) -> bool:
+    found = []
+    _walk_exprs(e, lambda x: found.append(x) if x is target else None)
+    return bool(found)
+
+
+def _rewrite_correlated_scalar(outer: LogicalPlan, pred: Expr,
+                               sq: ScalarSubquery,
+                               session, counter: List[int]) -> LogicalPlan:
+    """Filter(pred(sq)) over ``outer`` -> Project(outer cols)(
+    Filter(pred')(outer JOIN sub-aggregated-by-correlation-keys))."""
+    if not _null_rejecting_path(pred, sq):
+        raise SubqueryError(
+            "A correlated scalar subquery under OR / IS NULL / CASE is "
+            "unsupported: a missing correlation group yields NULL, and "
+            "those operators can turn NULL into TRUE — the inner-join "
+            "rewrite would drop rows SQL keeps.  Restructure so the "
+            "scalar comparison is its own AND conjunct")
+    sub = sq.plan
+    if not isinstance(sub, Aggregate) or sub.group_by \
+            or len(sub.aggs) != 1:
+        raise SubqueryError(
+            "A correlated scalar subquery must be a single global "
+            "aggregate (agg(out=(input, func))) over filters containing "
+            "inner_col == outer_ref(...) conjuncts — the TPC-DS q1 shape")
+    stripped, pairs = _split_correlations(sub.child)
+    if not pairs:
+        raise SubqueryError(
+            "Correlated scalar subquery has no outer_ref equality "
+            "conjunct; use an uncorrelated scalar() instead")
+    if _plan_has_outer_refs(stripped):
+        raise SubqueryError(
+            "outer_ref outside a Filter equality conjunct is unsupported")
+    k = counter[0]
+    counter[0] += 1
+    func, agg_in, out_name = sub.aggs[0]
+    inner_cols = [i for _o, i in pairs]
+    agged = Aggregate(inner_cols, [(func, agg_in, out_name)], stripped)
+    fresh_agg = f"__sq{k}_agg"
+    renames = [(f"__sq{k}_c{j}", Col(i)) for j, (_o, i) in enumerate(pairs)]
+    renamed = Compute(renames + [(fresh_agg, Col(out_name))], agged)
+    cond = None
+    for j, (o, _i) in enumerate(pairs):
+        eq = BinOp("==", Col(o), Col(f"__sq{k}_c{j}"))
+        cond = eq if cond is None else And(cond, eq)
+    joined = Join(outer, renamed, cond, "inner")
+    new_pred = _map_expr(pred, lambda e: Col(fresh_agg) if e is sq else e)
+    outer_cols = outer.output_columns(session.schema_of)
+    return Project(list(outer_cols), Filter(new_pred, joined))
+
+
+def _single_output_column(plan: LogicalPlan, session) -> str:
+    cols = plan.output_columns(session.schema_of)
+    if len(cols) != 1:
+        raise SubqueryError(
+            f"IN-subquery must produce exactly one column, got {cols}")
+    return cols[0]
+
+
+def _rewrite_filter(node: Filter, session, counter: List[int]) -> LogicalPlan:
+    """Rewrite ONE subquery construct in ``node``; caller loops."""
+    conjuncts = split_conjuncts(node.condition)
+
+    def rebuild(remaining: List[Expr], child: LogicalPlan) -> LogicalPlan:
+        if not remaining:
+            return child
+        cond = remaining[0]
+        for c in remaining[1:]:
+            cond = And(cond, c)
+        return Filter(cond, child)
+
+    for idx, conj in enumerate(conjuncts):
+        rest = conjuncts[:idx] + conjuncts[idx + 1:]
+        if isinstance(conj, InSubquery):
+            if not isinstance(conj.child, Col):
+                raise SubqueryError(
+                    f"IN-subquery left side must be a column, got "
+                    f"{conj.child!r}")
+            if _plan_has_outer_refs(conj.plan):
+                raise SubqueryError(
+                    "Correlated IN-subqueries are unsupported; use a "
+                    "semi join with the correlation as the join condition")
+            sub_col = _single_output_column(conj.plan, session)
+            # Residual conjuncts reference only the outer child's columns
+            # (they came from the same Filter), so they push BELOW the
+            # join — keeping them in the Filter-over-scan shape the index
+            # rules pattern-match.
+            return Join(rebuild(rest, node.child), conj.plan,
+                        BinOp("==", conj.child, Col(sub_col)), "semi")
+        if isinstance(conj, Not) and isinstance(conj.child, InSubquery):
+            inq = conj.child
+            if not isinstance(inq.child, Col):
+                raise SubqueryError(
+                    f"NOT IN subquery left side must be a column, got "
+                    f"{inq.child!r}")
+            if _plan_has_outer_refs(inq.plan):
+                raise SubqueryError("Correlated NOT IN is unsupported")
+            _single_output_column(inq.plan, session)
+            # Materialize the subquery ONCE (index rewrites applied by the
+            # nested optimize); the null/empty decisions and the anti join
+            # all read the same table instead of re-executing the subplan.
+            from hyperspace_tpu.execution.executor import Executor
+            from hyperspace_tpu.plan.nodes import InMemory
+
+            table = Executor(session).execute(session.optimize(inq.plan))
+            if table.column(0).null_count > 0:
+                # Any null in the subquery: NOT IN never holds (3VL).
+                return rebuild(rest + [Lit(False)], node.child)
+            if table.num_rows == 0:
+                # Empty subquery: vacuously true for EVERY probe row,
+                # null probes included — drop the conjunct.
+                return rebuild(rest, node.child)
+            # A null probe matches nothing in the anti join (kept), but
+            # SQL says null NOT IN (non-empty) is NULL -> dropped; the
+            # IS NOT NULL guard pushes below with the residuals.
+            return Join(
+                rebuild(rest + [Not(IsNull(inq.child))], node.child),
+                InMemory(table),
+                BinOp("==", inq.child, Col(table.column_names[0])), "anti")
+        # Correlated or foldable scalar subqueries inside this conjunct.
+        found: List[ScalarSubquery] = []
+        _walk_exprs(conj, lambda e: found.append(e)
+                    if isinstance(e, ScalarSubquery) else None)
+        for sq in found:
+            if _plan_has_outer_refs(sq.plan):
+                # Residual conjuncts push below the generated join.
+                return _rewrite_correlated_scalar(
+                    rebuild(rest, node.child), conj, sq, session, counter)
+            lit = _fold_scalar(sq.plan, session)
+            new_conj = _const_fold(
+                _map_expr(conj, lambda e: lit if e is sq else e))
+            return rebuild(conjuncts[:idx] + [new_conj]
+                           + conjuncts[idx + 1:], node.child)
+        if isinstance(conj, (ScalarSubquery,)) or _contains(
+                conj, (InSubquery,)):
+            raise SubqueryError(
+                f"Unsupported subquery position: {conj!r} (IN-subqueries "
+                f"must be top-level conjuncts)")
+    return node
+
+
+def rewrite_subqueries(plan: LogicalPlan, session,
+                       _counter: Optional[List[int]] = None) -> LogicalPlan:
+    """Eliminate every subquery construct from ``plan`` (bottom-up)."""
+    counter = _counter if _counter is not None else [0]
+    if _counter is None and not _plan_has_subqueries(plan):
+        return plan  # common case: zero overhead beyond one walk
+    children = tuple(rewrite_subqueries(c, session, counter)
+                     for c in plan.children)
+    plan = plan.with_children(children)
+    if isinstance(plan, Filter):
+        # Loop: each pass eliminates one construct and may leave more.
+        for _ in range(64):
+            out = _rewrite_filter(plan, session, counter)
+            if out is plan:
+                return plan
+            out = rewrite_subqueries(out, session, counter)
+            if not isinstance(out, Filter):
+                return out
+            plan = out
+        raise SubqueryError("Subquery rewrite did not converge")
+    # Everywhere else (Compute, aggregate inputs, join conditions):
+    # uncorrelated scalars fold; anything needing a join is unsupported.
+    for e in _plan_exprs(plan):
+        if _contains(e, (InSubquery, OuterRef)):
+            raise SubqueryError(
+                f"Subqueries are supported in filter() predicates only; "
+                f"found one inside {type(plan).__name__}")
+    if isinstance(plan, Compute):
+        new_exprs = []
+        changed = False
+        for name, e in plan.exprs:
+            if _contains(e, ScalarSubquery):
+                folds = {}
+
+                def fold_once(x, folds=folds):
+                    # Explicit membership check: setdefault would evaluate
+                    # (and so EXECUTE) the subquery once per occurrence of
+                    # a shared node.
+                    if isinstance(x, ScalarSubquery) and id(x) not in folds:
+                        folds[id(x)] = _fold_scalar(x.plan, session)
+
+                _walk_exprs(e, fold_once)
+                e = _map_expr(e, lambda x: folds[id(x)]
+                              if isinstance(x, ScalarSubquery) else x)
+                changed = True
+            new_exprs.append((name, e))
+        if changed:
+            return Compute(new_exprs, plan.child)
+    else:
+        for e in _plan_exprs(plan):
+            if _contains(e, ScalarSubquery):
+                raise SubqueryError(
+                    f"Scalar subqueries are supported in filter() and "
+                    f"select() expressions only; found one inside "
+                    f"{type(plan).__name__}")
+    return plan

@@ -214,20 +214,33 @@ class HyperspaceSession:
         Catalyst's ColumnPruning, so minimal per-side column requirements are
         a precondition the engine must establish itself (plan/pruning.py); it
         also enables scan-level column pushdown for the non-indexed path."""
+        # Reused Dataset objects make the user's plan a DAG (one Scan
+        # object under several branches).  Every rewrite below swaps
+        # nodes BY IDENTITY, which on a DAG would install one branch's
+        # pruning into its siblings — so first rebuild the plan as a
+        # tree with a distinct node object per occurrence.
+        plan = _uniquify(plan)
+        # Subqueries rewrite OUTSIDE the lock: scalar folding and NOT IN
+        # materialization EXECUTE whole subplans, and holding the
+        # optimize lock for that would serialize every concurrent
+        # query's optimize behind one slow subquery (the lock's contract
+        # is "serialize the OPTIMIZE step only").  Nested optimize calls
+        # for the subplans take the lock briefly themselves.
+        from hyperspace_tpu.plan.subquery import rewrite_subqueries
+
+        plan = rewrite_subqueries(plan, self)
         with self._optimize_lock:
             return self._optimize_locked(plan)
 
     def _optimize_locked(self, plan: LogicalPlan) -> LogicalPlan:
         from hyperspace_tpu.plan.pruning import prune_columns
 
+        # Save/restore instead of set/None: subquery folding re-enters
+        # optimize() from inside this pass (RLock), and the nested pass
+        # must not clear the OUTER pass's snapshot memo on its way out.
+        prev_memo = self._lake_schema_memo
         self._lake_schema_memo = {}
         try:
-            # Reused Dataset objects make the user's plan a DAG (one Scan
-            # object under several branches).  Every rewrite below swaps
-            # nodes BY IDENTITY, which on a DAG would install one branch's
-            # pruning into its siblings — so first rebuild the plan as a
-            # tree with a distinct node object per occurrence.
-            plan = _uniquify(plan)
             # year(col)-style predicates over temporal scan columns become
             # raw ranges FIRST (plan/temporal.py): the rules' pruning
             # analyses and the device kernel only understand ranges.
@@ -261,7 +274,7 @@ class HyperspaceSession:
             plan = DataSkippingFilterRule(self, entries).apply(plan)
             return plan
         finally:
-            self._lake_schema_memo = None
+            self._lake_schema_memo = prev_memo
 
 
 def _uniquify(plan: LogicalPlan) -> LogicalPlan:

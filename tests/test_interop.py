@@ -290,3 +290,30 @@ def test_spec_select_preserves_interleaved_order(env):
                    "name"],
     }).collect()
     assert out.column_names == ["k", "v2", "name"]
+
+
+def test_spec_subqueries(env, tmp_path):
+    """Scalar and IN subqueries over the wire compose with the local
+    rewrite (plan/subquery.py)."""
+    s, data = env
+    d2 = str(tmp_path / "dim2")
+    os.makedirs(d2)
+    pq.write_table(pa.table({
+        "k2": pa.array([1, 2, 3], type=pa.int64())}),
+        os.path.join(d2, "f.parquet"))
+    sub = {"source": {"format": "parquet", "path": d2}, "select": ["k2"]}
+    out = dataset_from_spec(s, {
+        "source": {"format": "parquet", "path": data},
+        "filter": {"op": "in_subquery", "col": "k", "query": sub},
+        "select": ["k"],
+    }).collect()
+    assert sorted(out.column("k").to_pylist()) == [1, 2, 3]
+    # Scalar: rows above the subquery's max key.
+    mx = {"source": {"format": "parquet", "path": d2},
+          "aggs": {"m": ["k2", "max"]}}
+    out2 = dataset_from_spec(s, {
+        "source": {"format": "parquet", "path": data},
+        "filter": {"op": ">", "left": {"col": "k"},
+                   "right": {"op": "scalar_subquery", "query": mx}},
+    }).collect()
+    assert out2.num_rows == 1000 - 4  # k in 4..999

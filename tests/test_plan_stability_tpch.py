@@ -8,9 +8,17 @@ queries adapted to the engine's surface:
   - expression aggregates (sum(l_extendedprice * (1 - l_discount))),
   - CASE WHEN inside aggregates (Q12's priority split, Q14's promo ratio)
     and SQL LIKE predicates (Q9/Q14/Q20's p_name/p_type matches) — native,
-  - semi/anti/left joins standing in for EXISTS / NOT EXISTS / outer SQL,
-  - computed projections over aggregate outputs for ratio queries,
-  - constants in place of scalar subqueries (each adaptation noted inline).
+  - REAL subquery trees (round-3 verdict item 3): correlated scalar
+    subqueries (Q2/Q17/Q20), uncorrelated scalar thresholds (Q11/Q15/Q22),
+    IN / NOT IN subqueries (Q16/Q18/Q20/Q21) — rewritten by
+    plan/subquery.py; semi/anti joins where SQL says EXISTS,
+  - REAL date32 columns with date literals and year() grouping
+    (round-3 verdict item 4) — o_orderdate/l_shipdate/l_commitdate/
+    l_receiptdate are dates over 1992-1998, and Q7/Q8 group by
+    year(...) through plan/temporal.py's canonicalization,
+  - all 22 queries present (t07/t21 joined the corpus this round; t21's
+    EXISTS-with-inequality is the per-order distinct-supplier-count
+    formulation, noted inline).
 
 Golden plans live under resources/approved-plans-tpch/; regenerate with
 HS_GENERATE_GOLDEN_FILES=1.  Beneath the plan goldens an answer-equivalence
@@ -34,13 +42,31 @@ from hyperspace_tpu import (
     HyperspaceSession,
     IndexConfig,
     col,
+    in_subquery,
+    outer_ref,
+    scalar,
     when,
+    year,
 )
 from tests.test_plan_stability import _simplify, _write
 
 APPROVED_DIR = os.path.join(os.path.dirname(__file__), "resources",
                             "approved-plans-tpch")
 GENERATE = os.environ.get("HS_GENERATE_GOLDEN_FILES") == "1"
+
+import datetime
+
+BASE_DATE = datetime.date(1992, 1, 1)
+
+
+def D(days: int) -> datetime.date:
+    """Day-number -> date over the corpus's 1992-1998 span."""
+    return BASE_DATE + datetime.timedelta(days=int(days))
+
+
+def _dates(day_numbers) -> pa.Array:
+    return pa.array(np.datetime64("1992-01-01")
+                    + np.asarray(day_numbers).astype("timedelta64[D]"))
 
 N_ORDERS = 600
 N_LINEITEM = 2400
@@ -64,7 +90,7 @@ def catalog(tmp_path_factory):
     })
     nation = pa.table({
         "n_nationkey": np.arange(N_NATION, dtype=np.int64),
-        "n_name": pa.array([f"NATION{i:02d}" if i != 7 else "GERMANY"
+        "n_name": pa.array([{6: "FRANCE", 7: "GERMANY"}.get(i, f"NATION{i:02d}")
                             for i in range(N_NATION)]),
         "n_regionkey": pa.array(
             rng.integers(0, N_REGION, N_NATION), type=pa.int64()),
@@ -117,11 +143,10 @@ def catalog(tmp_path_factory):
         "o_orderstatus": pa.array(
             [("O", "F", "P")[i % 3] for i in range(N_ORDERS)]),
         "o_totalprice": pa.array(rng.uniform(1, 1000, N_ORDERS)),
-        # Dates are day numbers (no date functions yet), time-correlated
-        # with the key (append order) so per-file sketch ranges are narrow
-        # — the layout data skipping exploits in any real ingest.
-        "o_orderdate": pa.array(
-            np.sort(rng.integers(0, 2400, N_ORDERS)), type=pa.int64()),
+        # REAL date32 columns, time-correlated with the key (append
+        # order) so per-file sketch ranges are narrow — the layout data
+        # skipping exploits in any real ingest.
+        "o_orderdate": _dates(np.sort(rng.integers(0, 2400, N_ORDERS))),
         "o_orderpriority": pa.array(
             [("1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED",
               "5-LOW")[i % 5] for i in range(N_ORDERS)]),
@@ -145,11 +170,9 @@ def catalog(tmp_path_factory):
             [("R", "A", "N")[i % 3] for i in range(N_LINEITEM)]),
         "l_linestatus": pa.array(
             [("O", "F")[i % 2] for i in range(N_LINEITEM)]),
-        "l_shipdate": pa.array(l_ship, type=pa.int64()),
-        "l_commitdate": pa.array(l_ship + rng.integers(-30, 60, N_LINEITEM),
-                                 type=pa.int64()),
-        "l_receiptdate": pa.array(l_ship + rng.integers(1, 30, N_LINEITEM),
-                                  type=pa.int64()),
+        "l_shipdate": _dates(l_ship),
+        "l_commitdate": _dates(l_ship + rng.integers(-30, 60, N_LINEITEM)),
+        "l_receiptdate": _dates(l_ship + rng.integers(1, 30, N_LINEITEM)),
         "l_shipmode": pa.array(
             [("MAIL", "SHIP", "AIR", "TRUCK", "RAIL")[i % 5]
              for i in range(N_LINEITEM)]),
@@ -226,7 +249,7 @@ def _queries(session, paths):
     return {
         # Q1: pricing summary report (dates are day numbers).
         "t01_pricing_summary": t("lineitem")
-            .filter(col("l_shipdate") <= 2300)
+            .filter(col("l_shipdate") <= D(2300))
             .group_by("l_returnflag", "l_linestatus")
             .agg(sum_qty=("l_quantity", "sum"),
                  sum_base_price=("l_extendedprice", "sum"),
@@ -236,31 +259,43 @@ def _queries(session, paths):
                  avg_price=("l_extendedprice", "mean"),
                  count_order=("", "count_all"))
             .sort("l_returnflag", "l_linestatus"),
-        # Q2 (adapted: min-cost scalar subquery dropped): suppliers for
-        # size-15 parts in EUROPE, cheapest first.
+        # Q2 — the REAL shape: ps_supplycost equals the CORRELATED
+        # minimum cost for that part among EUROPE suppliers (scalar
+        # subquery with an outer_ref, rewritten to aggregate-then-join).
         "t02_min_cost_supplier": t("part")
             .filter(col("p_size") == 15)
             .join(t("partsupp"), col("p_partkey") == col("ps_partkey"))
             .join(t("supplier"), col("ps_suppkey") == col("s_suppkey"))
             .join(t("nation"), col("s_nationkey") == col("n_nationkey"))
             .join(t("region"), col("n_regionkey") == col("r_regionkey"))
-            .filter(col("r_name") == "EUROPE")
+            .filter((col("r_name") == "EUROPE")
+                    & (col("ps_supplycost") == scalar(
+                        t("partsupp")
+                        .join(t("supplier"),
+                              col("ps_suppkey") == col("s_suppkey"))
+                        .join(t("nation"),
+                              col("s_nationkey") == col("n_nationkey"))
+                        .join(t("region")
+                              .filter(col("r_name") == "EUROPE"),
+                              col("n_regionkey") == col("r_regionkey"))
+                        .filter(col("ps_partkey") == outer_ref("p_partkey"))
+                        .agg(min_cost=("ps_supplycost", "min")))))
             .select("s_name", "p_partkey", "ps_supplycost")
-            .sort("ps_supplycost").limit(10),
+            .sort("ps_supplycost", "s_name", "p_partkey").limit(10),
         # Q3: shipping priority.
         "t03_shipping_priority": t("customer")
             .filter(col("c_mktsegment") == "BUILDING")
             .join(t("orders"), col("c_custkey") == col("o_custkey"))
-            .filter(col("o_orderdate") < 1200)
+            .filter(col("o_orderdate") < D(1200))
             .join(t("lineitem"), col("o_orderkey") == col("l_orderkey"))
-            .filter(col("l_shipdate") > 1200)
+            .filter(col("l_shipdate") > D(1200))
             .group_by("o_orderkey", "o_orderdate", "o_shippriority")
             .agg(revenue=(rev, "sum"))
             .sort(("revenue", False), "o_orderdate").limit(10),
         # Q4: order priority checking — EXISTS as a SEMI join; the
         # commit<receipt comparison is a column-column filter.
         "t04_order_priority": t("orders")
-            .filter((col("o_orderdate") >= 800) & (col("o_orderdate") < 1100))
+            .filter((col("o_orderdate") >= D(800)) & (col("o_orderdate") < D(1100)))
             .join(t("lineitem")
                   .filter(col("l_commitdate") < col("l_receiptdate")),
                   col("o_orderkey") == col("l_orderkey"), how="semi")
@@ -270,7 +305,7 @@ def _queries(session, paths):
         # rides the same CNF join condition.
         "t05_local_supplier_volume": t("customer")
             .join(t("orders"), col("c_custkey") == col("o_custkey"))
-            .filter((col("o_orderdate") >= 400) & (col("o_orderdate") < 1200))
+            .filter((col("o_orderdate") >= D(400)) & (col("o_orderdate") < D(1200)))
             .join(t("lineitem"), col("o_orderkey") == col("l_orderkey"))
             .join(t("supplier"),
                   (col("l_suppkey") == col("s_suppkey"))
@@ -282,31 +317,60 @@ def _queries(session, paths):
             .sort(("revenue", False)),
         # Q6: forecasting revenue change.
         "t06_forecast_revenue": t("lineitem")
-            .filter((col("l_shipdate") >= 400) & (col("l_shipdate") < 800)
+            .filter((col("l_shipdate") >= D(400)) & (col("l_shipdate") < D(800))
                     & (col("l_discount") >= 0.03)
                     & (col("l_discount") <= 0.07)
                     & (col("l_quantity") < 24))
             .agg(revenue=(col("l_extendedprice") * col("l_discount"), "sum")),
-        # Q8 (adapted: the per-year grouping is dropped — dates are plain
-        # ints — and the "nation" share is the supplier's nation KEY):
-        # national market share via CASE inside both sums, over a 6-way
-        # join.
+        # Q7 — volume shipping between FRANCE and GERMANY, grouped by
+        # the REAL year(l_shipdate) (plan/temporal.py surface); the two
+        # nation legs are pre-renamed computed selects, standing in for
+        # SQL's n1/n2 aliases.
+        "t07_volume_shipping": t("supplier")
+            .join(t("nation")
+                  .select(supp_nation=col("n_name"),
+                          n1_key=col("n_nationkey")),
+                  col("s_nationkey") == col("n1_key"))
+            .join(t("lineitem")
+                  .filter((col("l_shipdate") >= D(1096))
+                          & (col("l_shipdate") <= D(1826))),
+                  col("s_suppkey") == col("l_suppkey"))
+            .join(t("orders"), col("l_orderkey") == col("o_orderkey"))
+            .join(t("customer"), col("o_custkey") == col("c_custkey"))
+            .join(t("nation")
+                  .select(cust_nation=col("n_name"),
+                          n2_key=col("n_nationkey")),
+                  col("c_nationkey") == col("n2_key"))
+            .filter(((col("supp_nation") == "FRANCE")
+                     & (col("cust_nation") == "GERMANY"))
+                    | ((col("supp_nation") == "GERMANY")
+                       & (col("cust_nation") == "FRANCE")))
+            .with_column("l_year", year("l_shipdate"))
+            .group_by("supp_nation", "cust_nation", "l_year")
+            .agg(revenue=(rev, "sum"))
+            .sort("supp_nation", "cust_nation", "l_year"),
+        # Q8 — national market share per REAL year(o_orderdate), CASE
+        # inside both sums, over a 6-way join.
         "t08_market_share": t("part")
             .filter(col("p_type") == "STANDARD POLISHED")
             .join(t("lineitem"), col("p_partkey") == col("l_partkey"))
             .join(t("supplier"), col("l_suppkey") == col("s_suppkey"))
             .join(t("orders")
-                  .filter((col("o_orderdate") >= 600)
-                          & (col("o_orderdate") < 1800)),
+                  .filter((col("o_orderdate") >= D(600))
+                          & (col("o_orderdate") < D(1800))),
                   col("l_orderkey") == col("o_orderkey"))
             .join(t("customer"), col("o_custkey") == col("c_custkey"))
             .join(t("nation"), col("c_nationkey") == col("n_nationkey"))
             .join(t("region").filter(col("r_name") == "AMERICA"),
                   col("n_regionkey") == col("r_regionkey"))
+            .with_column("o_year", year("o_orderdate"))
+            .group_by("o_year")
             .agg(nation_volume=(when(col("s_nationkey") == 7, rev)
                                 .otherwise(0.0), "sum"),
                  total_volume=(rev, "sum"))
-            .select(mkt_share=col("nation_volume") / col("total_volume")),
+            .select("o_year",
+                    mkt_share=col("nation_volume") / col("total_volume"))
+            .sort("o_year"),
         # Q9: product-type profit (the real LIKE '%green%' predicate),
         # partsupp joined on the composite (partkey, suppkey).
         "t09_product_profit": t("part")
@@ -323,22 +387,29 @@ def _queries(session, paths):
         # Q10: returned-item reporting.
         "t10_returned_items": t("customer")
             .join(t("orders"), col("c_custkey") == col("o_custkey"))
-            .filter((col("o_orderdate") >= 600) & (col("o_orderdate") < 900))
+            .filter((col("o_orderdate") >= D(600)) & (col("o_orderdate") < D(900)))
             .join(t("lineitem").filter(col("l_returnflag") == "R"),
                   col("o_orderkey") == col("l_orderkey"))
             .join(t("nation"), col("c_nationkey") == col("n_nationkey"))
             .group_by("c_custkey", "c_name", "c_acctbal", "n_name")
             .agg(revenue=(rev, "sum"))
             .sort(("revenue", False)).limit(20),
-        # Q11 (adapted: the group-value threshold is a constant, not a
-        # scalar subquery): important stock identification.
+        # Q11 — the REAL shape: the group-value threshold is an
+        # UNCORRELATED scalar subquery (total GERMANY value x fraction),
+        # folded to a literal at optimize time.
         "t11_important_stock": t("partsupp")
             .join(t("supplier"), col("ps_suppkey") == col("s_suppkey"))
             .join(t("nation").filter(col("n_name") == "GERMANY"),
                   col("s_nationkey") == col("n_nationkey"))
             .group_by("ps_partkey")
             .agg(value=(col("ps_supplycost") * col("ps_availqty"), "sum"))
-            .filter(col("value") > 2000.0)
+            .filter(col("value") > scalar(
+                t("partsupp")
+                .join(t("supplier"), col("ps_suppkey") == col("s_suppkey"))
+                .join(t("nation").filter(col("n_name") == "GERMANY"),
+                      col("s_nationkey") == col("n_nationkey"))
+                .agg(total=(col("ps_supplycost") * col("ps_availqty"),
+                            "sum"))) * 0.02)
             .sort(("value", False)),
         # Q12: the REAL shape — CASE WHEN inside both sums splits lines by
         # order priority.
@@ -347,8 +418,8 @@ def _queries(session, paths):
                   .filter(col("l_shipmode").isin(["MAIL", "SHIP"])
                           & (col("l_commitdate") < col("l_receiptdate"))
                           & (col("l_shipdate") < col("l_commitdate"))
-                          & (col("l_receiptdate") >= 400)
-                          & (col("l_receiptdate") < 1200)),
+                          & (col("l_receiptdate") >= D(400))
+                          & (col("l_receiptdate") < D(1200))),
                   col("o_orderkey") == col("l_orderkey"))
             .group_by("l_shipmode")
             .agg(high_line_count=(
@@ -370,50 +441,69 @@ def _queries(session, paths):
         # LIKE 'PROMO%' inside the sum, divided in a computed projection
         # over the aggregate outputs.
         "t14_promo_effect": t("lineitem")
-            .filter((col("l_shipdate") >= 1000) & (col("l_shipdate") < 1100))
+            .filter((col("l_shipdate") >= D(1000)) & (col("l_shipdate") < D(1100)))
             .join(t("part"), col("l_partkey") == col("p_partkey"))
             .agg(promo=(when(col("p_type").like("PROMO%"), rev)
                         .otherwise(0.0), "sum"),
                  total=(rev, "sum"))
             .select(promo_revenue=100.0 * col("promo") / col("total")),
-        # Q15 (adapted: max-revenue scalar subquery -> top-1 by sort): the
-        # top supplier by shipped revenue, joined back to supplier.
+        # Q15 — the REAL shape: total_revenue equals the UNCORRELATED
+        # max over the same revenue view (scalar subquery, folded).
         "t15_top_supplier": t("lineitem")
-            .filter((col("l_shipdate") >= 1200) & (col("l_shipdate") < 1500))
+            .filter((col("l_shipdate") >= D(1200))
+                    & (col("l_shipdate") < D(1500)))
             .group_by("l_suppkey").agg(total_revenue=(rev, "sum"))
-            .sort(("total_revenue", False)).limit(1)
+            .filter(col("total_revenue") == scalar(
+                t("lineitem")
+                .filter((col("l_shipdate") >= D(1200))
+                        & (col("l_shipdate") < D(1500)))
+                .group_by("l_suppkey").agg(total_revenue=(rev, "sum"))
+                .agg(m=("total_revenue", "max"))))
             .join(t("supplier"), col("l_suppkey") == col("s_suppkey"))
-            .select("s_suppkey", "s_name", "total_revenue"),
-        # Q16 (adapted: LIKE excluded-type -> brand inequality; the
-        # complaints NOT EXISTS is an ANTI join against negative-balance
-        # suppliers).
+            .select("s_suppkey", "s_name", "total_revenue")
+            .sort("s_suppkey"),
+        # Q16 — the REAL shape: ps_suppkey NOT IN (complaint suppliers)
+        # as a null-aware NOT-IN subquery (negative balance stands in for
+        # the comment LIKE '%Customer%Complaints%').
         "t16_parts_supplier_counts": t("partsupp")
             .join(t("part")
                   .filter(~(col("p_brand") == "Brand#00")
                           & col("p_size").isin([5, 15, 25, 35, 45])),
                   col("ps_partkey") == col("p_partkey"))
-            .join(t("supplier").filter(col("s_acctbal") < 0.0),
-                  col("ps_suppkey") == col("s_suppkey"), how="anti")
+            .filter(~in_subquery(
+                "ps_suppkey",
+                t("supplier").filter(col("s_acctbal") < 0.0)
+                .select("s_suppkey")))
             .group_by("p_brand", "p_type", "p_size")
             .agg(supplier_cnt=("ps_suppkey", "count_distinct"))
             .sort(("supplier_cnt", False), "p_brand", "p_type", "p_size"),
-        # Q17 (adapted: the avg-quantity scalar subquery is a constant;
-        # yearly average via a computed projection).
+        # Q17 — the REAL shape: l_quantity below 0.4x the CORRELATED
+        # per-part average quantity (scalar subquery with outer_ref,
+        # rewritten to aggregate-then-join).
         "t17_small_quantity_revenue": t("lineitem")
             .join(t("part").filter((col("p_brand") == "Brand#11")
                                    & (col("p_container") == "SM CASE")),
                   col("l_partkey") == col("p_partkey"))
-            .filter(col("l_quantity") < 10)
+            .filter(col("l_quantity") < scalar(
+                t("lineitem")
+                .filter(col("l_partkey") == outer_ref("l_partkey"))
+                .agg(aq=("l_quantity", "mean"))) * 0.4)
             .agg(total=("l_extendedprice", "sum"))
             .select(avg_yearly=col("total") / 7.0),
-        # Q18: large-volume customers — HAVING sum(qty) > K feeds the join.
-        "t18_large_orders": t("lineitem")
-            .group_by("l_orderkey").agg(qty=("l_quantity", "sum"))
-            .filter(col("qty") > 120)
-            .join(t("orders"), col("l_orderkey") == col("o_orderkey"))
-            .join(t("customer"), col("o_custkey") == col("c_custkey"))
-            .select("c_name", "c_custkey", "o_orderkey", "o_orderdate",
-                    "o_totalprice", "qty")
+        # Q18 — the REAL shape: o_orderkey IN (SELECT l_orderkey GROUP BY
+        # HAVING sum(qty) > K), then re-join lineitem and re-aggregate.
+        "t18_large_orders": t("customer")
+            .join(t("orders")
+                  .filter(in_subquery(
+                      "o_orderkey",
+                      t("lineitem").group_by("l_orderkey")
+                      .agg(qty=("l_quantity", "sum"))
+                      .filter(col("qty") > 120).select("l_orderkey"))),
+                  col("c_custkey") == col("o_custkey"))
+            .join(t("lineitem"), col("o_orderkey") == col("l_orderkey"))
+            .group_by("c_name", "c_custkey", "o_orderkey", "o_orderdate",
+                      "o_totalprice")
+            .agg(sum_qty=("l_quantity", "sum"))
             .sort(("o_totalprice", False), "o_orderkey").limit(100),
         # Q19: discounted revenue over OR-of-conjunct groups.
         "t19_discounted_revenue": t("lineitem")
@@ -430,20 +520,62 @@ def _queries(session, paths):
                        & (col("l_quantity") <= 30)
                        & (col("p_size") <= 15)))
             .agg(revenue=(rev, "sum")),
-        # Q20 (adapted: the availability scalar subquery is dropped):
-        # suppliers with green parts on offer (the real LIKE 'part green%'
-        # prefix match), as a SEMI-join chain.
+        # Q20 — the REAL shape: nested IN-subqueries plus the CORRELATED
+        # half-of-shipped-quantity availability threshold.
         "t20_potential_promotions": t("supplier")
-            .join(t("partsupp")
-                  .join(t("part").filter(col("p_name").like("part green%")),
-                        col("ps_partkey") == col("p_partkey"), how="semi"),
-                  col("s_suppkey") == col("ps_suppkey"), how="semi")
+            .filter(in_subquery(
+                "s_suppkey",
+                t("partsupp")
+                .filter(in_subquery(
+                    "ps_partkey",
+                    t("part").filter(col("p_name").like("part green%"))
+                    .select("p_partkey"))
+                    & (col("ps_availqty") > scalar(
+                        t("lineitem")
+                        .filter((col("l_partkey") == outer_ref("ps_partkey"))
+                                & (col("l_suppkey")
+                                   == outer_ref("ps_suppkey"))
+                                & (col("l_shipdate") >= D(400))
+                                & (col("l_shipdate") < D(800)))
+                        .agg(q=("l_quantity", "sum"))) * 0.5))
+                .select("ps_suppkey")))
             .select("s_suppkey", "s_name").sort("s_suppkey"),
-        # Q22 (adapted: substring(c_phone) -> c_phonecode): customers with
-        # a positive balance and NO orders — ANTI join.
+        # Q21 — suppliers who kept F-status orders waiting.  The SQL
+        # EXISTS/NOT EXISTS pair carries an inequality correlation
+        # (l2.l_suppkey <> l1.l_suppkey) the equi-join surface cannot
+        # express directly; the equivalent per-order distinct-supplier
+        # counts formulation: the order has >1 supplier, and exactly one
+        # supplier (this one, already late by the l1 filter) was late.
+        "t21_waiting_suppliers": t("supplier")
+            .join(t("nation").filter(col("n_name") == "GERMANY"),
+                  col("s_nationkey") == col("n_nationkey"))
+            .join(t("lineitem")
+                  .filter(col("l_receiptdate") > col("l_commitdate")),
+                  col("s_suppkey") == col("l_suppkey"))
+            .join(t("orders").filter(col("o_orderstatus") == "F"),
+                  col("l_orderkey") == col("o_orderkey"))
+            .filter(in_subquery(
+                "l_orderkey",
+                t("lineitem").group_by("l_orderkey")
+                .agg(nsupp=("l_suppkey", "count_distinct"))
+                .filter(col("nsupp") > 1).select("l_orderkey"))
+                & in_subquery(
+                    "l_orderkey",
+                    t("lineitem")
+                    .filter(col("l_receiptdate") > col("l_commitdate"))
+                    .group_by("l_orderkey")
+                    .agg(nlate=("l_suppkey", "count_distinct"))
+                    .filter(col("nlate") == 1).select("l_orderkey")))
+            .group_by("s_name").count("numwait")
+            .sort(("numwait", False), "s_name").limit(100),
+        # Q22 — customers with an above-average balance (UNCORRELATED
+        # scalar subquery, folded) and NO orders (NOT EXISTS -> ANTI);
+        # substring(c_phone) -> c_phonecode.
         "t22_global_sales_opportunity": t("customer")
             .filter(col("c_phonecode").isin([13, 31, 23, 29, 30, 18, 17])
-                    & (col("c_acctbal") > 0.0))
+                    & (col("c_acctbal") > scalar(
+                        t("customer").filter(col("c_acctbal") > 0.0)
+                        .agg(a=("c_acctbal", "mean")))))
             .join(t("orders"), col("c_custkey") == col("o_custkey"),
                   how="anti")
             .group_by("c_phonecode")
@@ -453,8 +585,9 @@ def _queries(session, paths):
 
 
 TPCH_NAMES = sorted(
-    ["t01", "t02", "t03", "t04", "t05", "t06", "t08", "t09", "t10", "t11",
-     "t12", "t13", "t14", "t15", "t16", "t17", "t18", "t19", "t20", "t22"])
+    ["t01", "t02", "t03", "t04", "t05", "t06", "t07", "t08", "t09", "t10",
+     "t11", "t12", "t13", "t14", "t15", "t16", "t17", "t18", "t19", "t20",
+     "t21", "t22"])
 
 
 def _query_by_prefix(queries, prefix):
@@ -529,7 +662,10 @@ def test_tpch_rewrites_fire_where_expected(catalog):
     # reference's FAQ documents exactly this "no improvement" case.
     # t13/t20/t22 are outer/semi/anti-rooted: the JOIN rewrite is scoped to
     # inner joins (JoinIndexRule.scala:134-140) and no eligible filter
-    # pattern remains.
+    # pattern remains.  t18's real IN-subquery shape likewise roots the
+    # orders side under a semi join, so the inner-join rewrite cannot
+    # apply (the reference's rule has the same scope) and its lineitem
+    # sides carry no filter.
     expect_rewrite = {
         "t02_min_cost_supplier", "t03_shipping_priority",
         "t08_market_share",
@@ -538,7 +674,7 @@ def test_tpch_rewrites_fire_where_expected(catalog):
         "t10_returned_items", "t11_important_stock",
         "t12_shipping_modes", "t14_promo_effect", "t15_top_supplier",
         "t16_parts_supplier_counts", "t17_small_quantity_revenue",
-        "t18_large_orders", "t19_discounted_revenue",
+        "t19_discounted_revenue",
     }
     for name in expect_rewrite:
         plan = queries[name].optimized_plan()

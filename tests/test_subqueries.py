@@ -1,0 +1,307 @@
+"""Subquery rewrites (round-3 verdict item 3): scalar folding, IN-> semi,
+NOT IN null-aware anti, correlated scalar -> aggregate-then-join.
+
+Reference contract: Spark's subquery planning, exercised by the corpus
+from TPC-DS q1 on (correlated scalar, q1.sql:11-12) and by EXISTS/IN
+throughout; answers here are checked against pandas and against the
+unindexed path.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import (
+    Hyperspace,
+    HyperspaceSession,
+    IndexConfig,
+    col,
+    in_subquery,
+    lit,
+    outer_ref,
+    scalar,
+)
+from hyperspace_tpu.plan.subquery import SubqueryError
+
+
+@pytest.fixture()
+def env(tmp_path):
+    root = str(tmp_path)
+    rng = np.random.default_rng(5)
+    n = 3000
+    sales = pa.table({
+        "s_store": pa.array((np.arange(n) % 40).astype(np.int64)),
+        "s_cust": pa.array(rng.integers(0, 200, n), type=pa.int64()),
+        "s_return": pa.array(np.round(rng.uniform(0, 100, n), 3)),
+    })
+    stores = pa.table({
+        "st_key": pa.array(np.arange(40, dtype=np.int64)),
+        "st_state": pa.array([("TN", "CA", "NY", "WA")[i % 4]
+                              for i in range(40)]),
+    })
+    paths = {}
+    for name, t in (("sales", sales), ("stores", stores)):
+        d = os.path.join(root, name)
+        os.makedirs(d)
+        pq.write_table(t, os.path.join(d, "p.parquet"))
+        paths[name] = d
+    s = HyperspaceSession(system_path=os.path.join(root, "ix"))
+    s.conf.num_buckets = 4
+    return s, paths, sales.to_pandas(), stores.to_pandas()
+
+
+def test_uncorrelated_scalar_folds_to_literal(env):
+    s, paths, df, _stores = env
+    sub = s.read.parquet(paths["sales"]).agg(m=("s_return", "mean"))
+    ds = s.read.parquet(paths["sales"]).filter(
+        col("s_return") > scalar(sub) * 1.2)
+    plan = ds.optimized_plan()
+    assert "scalar_subquery" not in plan.tree_string()
+    want = int((df["s_return"] > df["s_return"].mean() * 1.2).sum())
+    assert ds.count() == want
+
+
+def test_scalar_fold_enables_pruning(tmp_path):
+    """A folded threshold is a plain constant: data skipping prunes on
+    it like on any literal."""
+    from hyperspace_tpu import DataSkippingIndexConfig
+
+    d = str(tmp_path / "mono")
+    os.makedirs(d)
+    t = pa.table({"k": pa.array(np.arange(8000, dtype=np.int64))})
+    for i in range(8):
+        pq.write_table(t.slice(i * 1000, 1000),
+                       os.path.join(d, f"part-{i:05d}.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    hs = Hyperspace(s)
+    hs.create_index(s.read.parquet(d), DataSkippingIndexConfig("kds", ["k"]))
+    s.enable_hyperspace()
+    sub = s.read.parquet(d).agg(m=("k", "max"))
+    ds = s.read.parquet(d).filter(col("k") > scalar(sub) - 500)
+    plan = ds.optimized_plan()
+    pruned = [sc for sc in plan.leaf_relations()
+              if sc.relation.data_skipping_of]
+    assert pruned and len(pruned[0].relation.file_paths) == 1, \
+        plan.tree_string()
+    assert ds.count() == 500  # k in 7500..7999
+
+
+def test_scalar_empty_is_null_and_multirow_raises(env):
+    s, paths, _df, _stores = env
+    empty = (s.read.parquet(paths["sales"])
+             .filter(col("s_return") < -1).agg(m=("s_return", "mean")))
+    # NULL threshold: comparison is never true -> 0 rows.
+    assert s.read.parquet(paths["sales"]).filter(
+        col("s_return") > scalar(empty)).count() == 0
+    multi = s.read.parquet(paths["stores"]).select("st_key")
+    with pytest.raises(SubqueryError, match="more than|rows"):
+        s.read.parquet(paths["sales"]).filter(
+            col("s_store") == scalar(multi)).count()
+    two_cols = s.read.parquet(paths["stores"])
+    with pytest.raises(SubqueryError, match="one column"):
+        s.read.parquet(paths["sales"]).filter(
+            col("s_store") == scalar(two_cols)).count()
+
+
+def test_in_subquery_semi_join(env):
+    s, paths, df, stores = env
+    tn = (s.read.parquet(paths["stores"])
+          .filter(col("st_state") == "TN").select("st_key"))
+    ds = s.read.parquet(paths["sales"]).filter(
+        in_subquery("s_store", tn))
+    plan = ds.optimized_plan()
+    assert "semi" in plan.tree_string().lower()
+    keys = set(stores[stores["st_state"] == "TN"]["st_key"])
+    assert ds.count() == int(df["s_store"].isin(keys).sum())
+
+
+def test_not_in_null_aware(tmp_path):
+    d1, d2 = str(tmp_path / "a"), str(tmp_path / "b")
+    os.makedirs(d1)
+    os.makedirs(d2)
+    pq.write_table(pa.table({
+        "x": pa.array([1, 2, None, 4], type=pa.int64())}),
+        os.path.join(d1, "p.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+
+    def sub_of(values):
+        pq.write_table(pa.table({"y": pa.array(values, type=pa.int64())}),
+                       os.path.join(d2, "p.parquet"))
+        return s.read.parquet(d2).select("y")
+
+    # Plain: x NOT IN (2, 9) -> {1, 4}; the null probe drops.
+    assert sorted(
+        s.read.parquet(d1).filter(~in_subquery("x", sub_of([2, 9])))
+        .collect().column("x").to_pylist()) == [1, 4]
+    # Null in the subquery: NO rows survive (SQL 3VL).
+    s._schema_cache.clear()
+    assert s.read.parquet(d1).filter(
+        ~in_subquery("x", sub_of([2, None]))).count() == 0
+    # Empty subquery: vacuously true for every row, null probe included.
+    s._schema_cache.clear()
+    assert s.read.parquet(d1).filter(
+        ~in_subquery("x", sub_of([]))).count() == 4
+
+
+def test_correlated_scalar_q1_shape(env):
+    """The TPC-DS q1 shape: rows whose return exceeds 1.2x the average
+    of their OWN store (aggregate-then-join rewrite)."""
+    s, paths, df, _stores = env
+    sales = s.read.parquet(paths["sales"])
+    sub = (s.read.parquet(paths["sales"])
+           .filter(col("s_store") == outer_ref("s_store"))
+           .agg(m=("s_return", "mean")))
+    ds = sales.filter(col("s_return") > scalar(sub) * 1.2) \
+        .select("s_store", "s_cust", "s_return")
+    plan = ds.optimized_plan()
+    assert "scalar_subquery" not in plan.tree_string()
+    assert "outer_ref" not in plan.tree_string()
+    got = ds.collect().to_pandas()
+    per_store = df.groupby("s_store")["s_return"].transform("mean")
+    want = df[df["s_return"] > per_store * 1.2]
+    assert len(got) == len(want)
+    np.testing.assert_allclose(
+        np.sort(got["s_return"].to_numpy()),
+        np.sort(want["s_return"].to_numpy()))
+
+
+def test_correlated_scalar_multi_key(env):
+    s, paths, df, _stores = env
+    sales = s.read.parquet(paths["sales"])
+    sub = (s.read.parquet(paths["sales"])
+           .filter((col("s_store") == outer_ref("s_store"))
+                   & (col("s_cust") == outer_ref("s_cust")))
+           .agg(mx=("s_return", "max")))
+    ds = sales.filter(col("s_return") == scalar(sub))
+    got = ds.count()
+    want = int((df["s_return"] == df.groupby(["s_store", "s_cust"])
+                ["s_return"].transform("max")).sum())
+    assert got == want
+
+
+def test_rewrite_composes_with_index_rules(env):
+    """A folded scalar + semi join still leaves the plan eligible for
+    covering-index rewrites on the outer side."""
+    s, paths, df, stores = env
+    hs = Hyperspace(s)
+    hs.create_index(s.read.parquet(paths["sales"]),
+                    IndexConfig("sq_ix", ["s_store"],
+                                ["s_cust", "s_return"]))
+    s.enable_hyperspace()
+    tn = (s.read.parquet(paths["stores"])
+          .filter(col("st_state") == "CA").select("st_key"))
+    ds = (s.read.parquet(paths["sales"])
+          .filter(in_subquery("s_store", tn) & (col("s_store") == 1)))
+    plan = ds.optimized_plan()
+    used = [sc for sc in plan.leaf_relations() if sc.relation.index_scan_of]
+    assert used, plan.tree_string()
+    keys = set(stores[stores["st_state"] == "CA"]["st_key"])
+    want = int((df["s_store"].isin(keys) & (df["s_store"] == 1)).sum())
+    assert ds.count() == want
+
+
+def test_answer_parity_rules_on_off(env):
+    s, paths, df, _stores = env
+    hs = Hyperspace(s)
+    hs.create_index(s.read.parquet(paths["sales"]),
+                    IndexConfig("sq_ix2", ["s_store"],
+                                ["s_cust", "s_return"]))
+
+    def q():
+        sub = (s.read.parquet(paths["sales"])
+               .filter(col("s_store") == outer_ref("s_store"))
+               .agg(m=("s_return", "mean")))
+        return (s.read.parquet(paths["sales"])
+                .filter((col("s_return") > scalar(sub))
+                        & (col("s_store") < 20))
+                .select("s_store", "s_return").collect())
+
+    s.enable_hyperspace()
+    on = q()
+    s.disable_hyperspace()
+    off = q()
+    assert on.num_rows == off.num_rows
+    np.testing.assert_allclose(
+        np.sort(on.column("s_return").to_numpy()),
+        np.sort(off.column("s_return").to_numpy()))
+
+
+def test_unsupported_shapes_raise_clearly(env):
+    s, paths, _df, _stores = env
+    sales = s.read.parquet(paths["sales"])
+    corr = (s.read.parquet(paths["sales"])
+            .filter(col("s_store") == outer_ref("s_store"))
+            .select("s_cust"))
+    with pytest.raises(SubqueryError, match="single global aggregate"):
+        sales.filter(col("s_cust") == scalar(corr)).count()
+    non_agg = (s.read.parquet(paths["sales"])
+               .filter(col("s_store") == outer_ref("s_store"))
+               .select("s_cust"))
+    with pytest.raises(SubqueryError):
+        sales.filter(in_subquery("s_cust", non_agg)).count()
+    # Scalar subquery in an aggregate input: filters/select only.
+    sub = s.read.parquet(paths["sales"]).agg(m=("s_return", "mean"))
+    with pytest.raises(SubqueryError, match="filter"):
+        (sales.group_by("s_store")
+         .agg(x=(col("s_return") - scalar(sub), "sum")).collect())
+
+
+def test_scalar_in_select_folds(env):
+    s, paths, df, _stores = env
+    sub = s.read.parquet(paths["sales"]).agg(m=("s_return", "mean"))
+    out = (s.read.parquet(paths["sales"]).limit(3)
+           .select("s_store", ratio=col("s_return") / scalar(sub))
+           .collect())
+    assert out.num_rows == 3
+    assert out.column("ratio").to_pylist() == pytest.approx(
+        (df["s_return"].iloc[:3] / df["s_return"].mean()).tolist())
+
+
+def test_correlated_scalar_under_or_rejected(env):
+    """A missing correlation group yields NULL; OR can turn that into
+    TRUE, which the inner-join rewrite cannot honor — must raise, never
+    silently drop rows."""
+    s, paths, _df, _stores = env
+    sub = (s.read.parquet(paths["sales"])
+           .filter(col("s_store") == outer_ref("s_store"))
+           .agg(m=("s_return", "mean")))
+    pred = (col("s_return") > scalar(sub)) | (col("s_cust") == 1)
+    with pytest.raises(SubqueryError, match="OR"):
+        s.read.parquet(paths["sales"]).filter(pred).count()
+    # NOT around the comparison is null-rejecting: still supported.
+    n = s.read.parquet(paths["sales"]).filter(
+        ~(col("s_return") > scalar(sub))).count()
+    assert n >= 0
+
+
+def test_not_in_materializes_subquery_once(env, monkeypatch):
+    """The null/empty probes and the anti join share ONE subquery
+    execution (round-4 review finding)."""
+    import hyperspace_tpu.plan.subquery as sq_mod
+
+    s, paths, df, stores = env
+    calls = []
+    orig = sq_mod._fold_scalar  # unrelated; count executor runs instead
+    from hyperspace_tpu.execution import executor as ex_mod
+
+    orig_exec = ex_mod.Executor.execute
+
+    def counting(self, plan):
+        calls.append(self)  # execute() recurses on one instance per query
+        return orig_exec(self, plan)
+
+    monkeypatch.setattr(ex_mod.Executor, "execute", counting)
+    tn = (s.read.parquet(paths["stores"])
+          .filter(col("st_state") == "TN").select("st_key"))
+    got = s.read.parquet(paths["sales"]).filter(
+        ~in_subquery("s_store", tn)).count()
+    keys = set(stores[stores["st_state"] == "TN"]["st_key"])
+    assert got == int((~df["s_store"].isin(keys)).sum())
+    # Exactly two executor instances ran: the materialized subquery and
+    # the outer query (execute() recurses within one instance).
+    assert len({id(e) for e in calls}) == 2, len({id(e) for e in calls})
