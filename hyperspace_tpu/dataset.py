@@ -7,7 +7,7 @@ package.scala:47-79) and then the executor.
 
 from __future__ import annotations
 
-from typing import List, Sequence
+from typing import List, Optional, Sequence
 
 import numpy as np
 import pyarrow as pa
@@ -24,6 +24,7 @@ from hyperspace_tpu.plan.nodes import (
     Project,
     Sort,
     Union,
+    Window,
     WithColumns,
 )
 
@@ -91,6 +92,35 @@ class Dataset:
     def with_column(self, name: str, expr: Expr) -> "Dataset":
         """Append (or replace) one computed column, keeping all others."""
         return Dataset(WithColumns([(name, expr)], self.plan), self.session)
+
+    def with_window(self, name: str, func: str,
+                    partition_by: Sequence[str] = (),
+                    order_by: Sequence = (),
+                    value: str = None) -> "Dataset":
+        """Append one analytic column: ``func(value) OVER (PARTITION BY
+        partition_by ORDER BY order_by)`` — Spark's window surface
+        (rank/row_number/dense_rank/sum/min/max/mean/count).
+
+            df.with_window("rk", "rank", partition_by=["grp"],
+                           order_by=[("revenue", False)])
+
+        ``order_by`` entries are column names or (column, ascending)
+        pairs, like ``sort``.  Aggregates with an ORDER BY are running
+        (Spark's default RANGE frame: rows tied on the order key share
+        one value); without one they reduce the whole partition."""
+        normalized = []
+        for k in order_by:
+            if isinstance(k, str):
+                normalized.append((k, True))
+            elif (isinstance(k, (tuple, list)) and len(k) == 2
+                    and isinstance(k[0], str)):
+                normalized.append((k[0], bool(k[1])))
+            else:
+                raise ValueError(
+                    f"Window order key must be a column name or a "
+                    f"(column, ascending) pair, got {k!r}")
+        return Dataset(Window(name, func, value, list(partition_by),
+                              normalized, self.plan), self.session)
 
     def join(self, other: "Dataset", condition: Expr, how: str = "inner") -> "Dataset":
         return Dataset(Join(self.plan, other.plan, condition, how), self.session)

@@ -58,6 +58,7 @@ from hyperspace_tpu.plan.nodes import (
     Scan,
     Sort,
     Union,
+    Window,
     WithColumns,
 )
 
@@ -194,6 +195,8 @@ class Executor:
             return table
         if isinstance(plan, Join):
             return self._join(plan)
+        if isinstance(plan, Window):
+            return _window(self.execute(plan.child), plan)
         if isinstance(plan, Aggregate):
             return self._aggregate(plan)
         if isinstance(plan, Distinct):
@@ -1291,9 +1294,14 @@ def _sorted_table(table: pa.Table, keys) -> pa.Table:
     group the real key orders rows."""
     if table.num_rows == 0:
         return table
-    sort_keys = []
-    has_aux = False
+    return table.take(_sort_indices(table, keys))
+
+
+def _sort_indices(table: pa.Table, keys) -> pa.Array:
+    """Sort permutation with Spark's null order (nulls first ascending,
+    last descending) — the validity-flag technique of _sorted_table."""
     work = table
+    sort_keys = []
     for c, asc in keys:
         direction = "ascending" if asc else "descending"
         if table.column(c).null_count > 0:
@@ -1303,13 +1311,160 @@ def _sorted_table(table: pa.Table, keys) -> pa.Table:
                 flag = f"__valid__{c}__{n}"
                 n += 1
             work = work.append_column(flag, pc.is_valid(table.column(c)))
-            has_aux = True
             sort_keys.append((flag, direction))
         sort_keys.append((c, direction))
-    if not has_aux:
-        return table.sort_by(sort_keys)
-    indices = pc.sort_indices(work, sort_keys=sort_keys)
-    return table.take(indices)
+    return pc.sort_indices(work, sort_keys=sort_keys)
+
+
+def _window(table: pa.Table, plan: Window) -> pa.Table:
+    """One analytic column over ``table`` (host path: sort + segmented
+    pandas scans).  Semantics in the Window node's docstring."""
+    import pandas as pd
+
+    n = table.num_rows
+    if n == 0:
+        out_type = {"row_number": pa.int32(), "rank": pa.int32(),
+                    "dense_rank": pa.int32(), "count": pa.int64(),
+                    "mean": pa.float64()}.get(plan.func)
+        if out_type is None and plan.func == "sum":
+            # Same widening as _window_cast: the schema must not depend
+            # on whether the input had rows.
+            src = table.schema.field(plan.value).type
+            out_type = pa.int64() if pa.types.is_integer(src) \
+                else pa.float64()
+        if out_type is None:  # min/max follow the input column
+            out_type = table.schema.field(plan.value).type \
+                if plan.value else pa.int64()
+        return table.append_column(plan.name,
+                                   pa.array([], type=out_type))
+
+    # Partition codes: null-safe grouping over the partition columns.
+    if plan.partition_by:
+        pdf = table.select(list(plan.partition_by)).to_pandas()
+        part_orig = pdf.groupby(list(plan.partition_by), dropna=False,
+                                sort=False).ngroup().to_numpy()
+    else:
+        part_orig = np.zeros(n, dtype=np.int64)
+    work = table.append_column("__part", pa.array(part_orig))
+    perm = _sort_indices(
+        work, [("__part", True)] + list(plan.order_by))
+    perm_np = np.asarray(perm)
+    part = part_orig[perm_np]
+    new_part = np.empty(n, dtype=bool)
+    new_part[0] = True
+    new_part[1:] = part[1:] != part[:-1]
+
+    # Tie groups: partition change OR any order-key change (null-safe,
+    # both-NaN equal — Spark normalizes NaN ordering ties).
+    new_tie = new_part.copy()
+    for c, _asc in plan.order_by:
+        col_sorted = table.column(c).take(perm)
+        valid = np.asarray(pc.is_valid(col_sorted)
+                           .to_numpy(zero_copy_only=False))
+        vals = col_sorted.to_numpy(zero_copy_only=False)
+        with np.errstate(invalid="ignore"):
+            eq = vals[1:] == vals[:-1]
+        if vals.dtype.kind == "f":
+            eq = eq | (np.isnan(vals[1:].astype(float))
+                       & np.isnan(vals[:-1].astype(float)))
+        same = (valid[1:] == valid[:-1]) & (~valid[1:] | eq)
+        new_tie[1:] |= ~same.astype(bool)
+
+    part_s = pd.Series(part)
+    tg = np.cumsum(new_tie) - 1  # tie-group id (global)
+
+    func = plan.func
+    if func == "row_number":
+        res = (part_s.groupby(part).cumcount() + 1).to_numpy()
+        out = pa.array(res.astype(np.int32))
+    elif func in ("rank", "dense_rank"):
+        dense = pd.Series(new_tie.astype(np.int64)) \
+            .groupby(part).cumsum().to_numpy()
+        if func == "dense_rank":
+            out = pa.array(dense.astype(np.int32))
+        else:
+            rn = (part_s.groupby(part).cumcount() + 1).to_numpy()
+            first_rn = pd.Series(rn).groupby(tg).transform("first") \
+                .to_numpy()
+            out = pa.array(first_rn.astype(np.int32))
+    else:
+        src_type = table.schema.field(plan.value).type if plan.value \
+            else None
+        if plan.value is not None:
+            v = table.column(plan.value).take(perm).to_pandas()
+        else:
+            v = pd.Series(np.ones(n))  # count(*): every row counts
+        valid_v = v.notna()
+        if not plan.order_by:
+            # Whole-partition aggregate.
+            if func == "count":
+                res = valid_v.groupby(part).transform("sum") \
+                    .to_numpy().astype(np.int64)
+                out = pa.array(res)
+            else:
+                r = v.groupby(part).transform(func)
+                # pandas sums an all-null group to 0; Spark keeps null.
+                any_valid = valid_v.groupby(part).transform("any")
+                r[~any_valid] = None
+                out = _window_cast(r, func, src_type)
+        else:
+            # Running aggregate over the RANGE frame: cumulative within
+            # the partition, then rows tied on the order key share the
+            # tie group's LAST value.
+            cnt = valid_v.astype(np.int64).groupby(part).cumsum()
+            if func == "count":
+                r = cnt.astype("float64")
+            elif func in ("sum", "mean"):
+                filled = v.fillna(0.0) if v.dtype.kind == "f" \
+                    else v.fillna(0)
+                r = filled.groupby(part).cumsum().astype("float64") \
+                    if func == "mean" else filled.groupby(part).cumsum()
+                if func == "mean":
+                    r = r / cnt.to_numpy()
+            else:  # min / max
+                try:
+                    r = getattr(v.groupby(part), f"cum{func}")()
+                except (TypeError, NotImplementedError) as e:
+                    raise ValueError(
+                        f"Running window {func}() over a "
+                        f"{v.dtype} column is not supported; drop the "
+                        f"ORDER BY for a whole-partition {func}, or "
+                        f"cast the column to a numeric/temporal type"
+                    ) from e
+                # NaN rows don't poison, but their position shows NaN:
+                # carry the previous extremum forward within the
+                # partition (Spark ignores nulls in the frame).
+                r = r.groupby(part).ffill()
+            r = pd.Series(np.asarray(r)).groupby(tg).transform("last")
+            r[cnt.groupby(tg).transform("last").to_numpy() == 0] = None
+            if func == "count":
+                out = pa.array(pd.Series(r).fillna(0).to_numpy()
+                               .astype(np.int64))
+            else:
+                out = _window_cast(pd.Series(r), func, src_type)
+    # Scatter back to the original row order.
+    inverse = np.empty(n, dtype=np.int64)
+    inverse[perm_np] = np.arange(n)
+    out = out.take(pa.array(inverse))
+    if plan.name in table.column_names:
+        return table.set_column(table.column_names.index(plan.name),
+                                plan.name, out)
+    return table.append_column(plan.name, out)
+
+
+def _window_cast(series, func: str, src_type) -> pa.Array:
+    """Result typing: mean -> float64; sum widens int->int64 and keeps
+    float64; min/max restore the INPUT type (dates stay dates)."""
+    arr = pa.Array.from_pandas(series)
+    if func == "mean":
+        return pc.cast(arr, pa.float64())
+    if func == "sum":
+        if src_type is not None and pa.types.is_integer(src_type):
+            return pc.cast(arr, pa.int64())
+        return pc.cast(arr, pa.float64())
+    if src_type is not None and arr.type != src_type:
+        return pc.cast(arr, src_type)
+    return arr
 
 
 def _concat_horizontal(left: pa.Table, right: pa.Table) -> pa.Table:

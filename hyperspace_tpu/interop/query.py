@@ -177,6 +177,19 @@ def _dataset_from_spec(session, spec: Dict[str, Any]):
                       else src, func)
                 for out, (src, func) in spec.get("aggs", {}).items()}
         ds = grouped.agg(**aggs) if aggs else grouped.count()
+    if "window" in spec:
+        # [{"name": out, "func": "rank", "partition_by": [...],
+        #   "order_by": ["c" | ["c", false], ...], "value": "v"?}, ...]
+        for w in spec["window"]:
+            keys = [k if isinstance(k, str) else tuple(k)
+                    for k in w.get("order_by", [])]
+            ds = ds.with_window(w["name"], w["func"],
+                                partition_by=w.get("partition_by", ()),
+                                order_by=keys, value=w.get("value"))
+    if "qualify" in spec:
+        # SQL QUALIFY: a filter over window outputs ("filter" runs
+        # before windows, like WHERE).
+        ds = ds.filter(expr_from_json(spec["qualify"]))
     if "sort" in spec:
         # ["col", ...] or [["col", false], ...] for descending; malformed
         # entries fail Dataset.sort's validation with a clear message.

@@ -208,6 +208,75 @@ class Compute(LogicalPlan):
         return f"Compute [{', '.join(parts)}]"
 
 
+class Window(LogicalPlan):
+    """One analytic column: ``func(value) OVER (PARTITION BY keys ORDER BY
+    keys)`` appended to the child's output — the reference's corpus uses
+    these throughout (rank()/row_number()/sum() OVER ... in TPC-DS q36,
+    q44, q47, q49, q57 under
+    /root/reference/src/test/resources/tpcds/queries/).
+
+    Spark semantics:
+      - row_number/rank/dense_rank need an ORDER BY; results are INT.
+      - aggregates (sum/min/max/mean/count) WITHOUT order_by reduce the
+        whole partition; WITH order_by they are running aggregates over
+        the default RANGE frame (UNBOUNDED PRECEDING..CURRENT ROW), so
+        rows tied on the order key share one value.
+      - order_by nulls sort FIRST ascending / LAST descending (Spark's
+        null order, same as Sort).
+    Host-evaluated (sort + segment scan); ties in the order key make the
+    ranking functions deterministic regardless of input order.
+    """
+
+    RANKING = ("row_number", "rank", "dense_rank")
+    AGGREGATES = ("sum", "min", "max", "mean", "count")
+
+    def __init__(self, name: str, func: str, value: Optional[str],
+                 partition_by: Sequence[str],
+                 order_by: Sequence[Tuple[str, bool]],
+                 child: LogicalPlan) -> None:
+        if func not in self.RANKING + self.AGGREGATES:
+            raise ValueError(
+                f"Unsupported window function {func!r}; one of "
+                f"{self.RANKING + self.AGGREGATES}")
+        if func in self.RANKING and not order_by:
+            raise ValueError(f"{func}() requires an ORDER BY")
+        if func in self.RANKING and value is not None:
+            raise ValueError(f"{func}() takes no value column")
+        if func in self.AGGREGATES and func != "count" and value is None:
+            raise ValueError(f"window {func}() needs a value column")
+        self.name = name
+        self.func = func
+        self.value = value
+        self.partition_by = tuple(partition_by)
+        self.order_by = tuple((c, bool(a)) for c, a in order_by)
+        self.children = (child,)
+
+    @property
+    def child(self) -> LogicalPlan:
+        return self.children[0]
+
+    def output_columns(self, schema_of) -> List[str]:
+        base = self.child.output_columns(schema_of)
+        return list(base) + ([self.name] if self.name not in base else [])
+
+    def with_children(self, children) -> "Window":
+        (child,) = children
+        return Window(self.name, self.func, self.value, self.partition_by,
+                      self.order_by, child)
+
+    def simple_string(self) -> str:
+        arg = self.value or ""
+        over = []
+        if self.partition_by:
+            over.append(f"PARTITION BY {', '.join(self.partition_by)}")
+        if self.order_by:
+            keys = ", ".join(f"{c}{'' if a else ' DESC'}"
+                             for c, a in self.order_by)
+            over.append(f"ORDER BY {keys}")
+        return (f"Window {self.name} := {self.func}({arg}) "
+                f"OVER ({' '.join(over)})")
+
+
 class WithColumns(LogicalPlan):
     """Append (or replace, by name) computed columns while keeping the
     child's full output — ``df.with_column('rev', ...)``.  Lazy like every

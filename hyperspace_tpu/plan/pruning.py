@@ -31,6 +31,7 @@ from hyperspace_tpu.plan.nodes import (
     Scan,
     Sort,
     Union,
+    Window,
     WithColumns,
 )
 from hyperspace_tpu.utils.resolver import resolve
@@ -101,6 +102,22 @@ def _prune(plan: LogicalPlan, required: Optional[Set[str]],
         # subsequence, so equal length means nothing was dropped.
         if new_child is not plan.child or len(keep) != len(plan.exprs):
             return WithColumns(keep, new_child)
+        return plan
+    if isinstance(plan, Window):
+        refs = set(plan.partition_by) | {c for c, _a in plan.order_by}
+        if plan.value:
+            refs.add(plan.value)
+        if required is not None and plan.name not in required:
+            # The analytic column is never consumed above: evaluating it
+            # would be pure cost — drop the node (same stance as unused
+            # WithColumns outputs).
+            return _prune(plan.child, required, schema_of)
+        child_required = None if required is None else (
+            (required - {plan.name}) | refs)
+        new_child = _prune(plan.child, child_required, schema_of)
+        if new_child is not plan.child:
+            return Window(plan.name, plan.func, plan.value,
+                          plan.partition_by, plan.order_by, new_child)
         return plan
     if isinstance(plan, Aggregate):
         # Like Project, an Aggregate defines exactly what its subtree must

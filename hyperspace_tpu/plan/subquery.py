@@ -15,15 +15,24 @@ and literals:
     col == sub's single output column.
   - ``~in_subquery(col, sub)``: NULL-AWARE anti join.  SQL's NOT IN is
     three-valued: any null in the subquery answers no rows; a null probe
-    matches nothing but only survives when the subquery is empty.  Two
-    Limit(1) probes (any-null?, any-row?) decide the shape: always-false
-    filter / plain pass-through / anti join + probe IS NOT NULL.
+    matches nothing but only survives when the subquery is empty.  The
+    subquery is MATERIALIZED once; its null_count/num_rows decide the
+    shape (always-false filter / plain pass-through / anti join against
+    the in-memory table + probe IS NOT NULL).
   - CORRELATED ``scalar(sub)`` (subplan contains ``outer_ref`` equality
     conjuncts under a global aggregate): rewritten to aggregate-by-the-
     correlation-keys then INNER join — exactly the q1 shape.  Inner join
     is correct because a missing group yields scalar NULL, which drops
-    the row from the comparison anyway; correlated scalars are therefore
-    supported in FILTER predicates only.
+    the row from the comparison anyway (positions where NULL could turn
+    TRUE — OR / IS NULL / CASE — are rejected); the COUNT family LEFT
+    joins and coalesces a missing group to 0 instead, since SQL's count
+    is never NULL.  Correlated scalars are supported in FILTER
+    predicates only.
+
+Each optimize() pass folds a given ScalarSubquery object once (shared
+nodes share the result), but separate optimize() calls — e.g. explain
+followed by collect — re-execute subplans: results are never cached
+across passes, where they could go stale against the underlying files.
 """
 
 from __future__ import annotations
@@ -175,6 +184,18 @@ def _const_fold(e: Expr) -> Expr:
     return _map_expr(e, fold)
 
 
+def _fold_scalar_memo(sq: "ScalarSubquery", session, state) -> Lit:
+    """Per-pass memo: one execution per ScalarSubquery OBJECT within a
+    single rewrite pass (shared nodes share the result); the object is
+    pinned in the state so its id cannot be recycled mid-pass."""
+    lit = state["folds"].get(id(sq))
+    if lit is None:
+        lit = _fold_scalar(sq.plan, session)
+        state["folds"][id(sq)] = lit
+        state["refs"].append(sq)
+    return lit
+
+
 def _fold_scalar(sub: LogicalPlan, session) -> Lit:
     """Execute an uncorrelated scalar subplan once; fold to a literal."""
     from hyperspace_tpu.execution.executor import Executor
@@ -265,17 +286,20 @@ def _subtree_has(e: Expr, target: Expr) -> bool:
 
 def _rewrite_correlated_scalar(outer: LogicalPlan, pred: Expr,
                                sq: ScalarSubquery,
-                               session, counter: List[int]) -> LogicalPlan:
+                               session, state) -> LogicalPlan:
     """Filter(pred(sq)) over ``outer`` -> Project(outer cols)(
     Filter(pred')(outer JOIN sub-aggregated-by-correlation-keys))."""
-    if not _null_rejecting_path(pred, sq):
+    sub = sq.plan
+    count_like = (isinstance(sub, Aggregate) and len(sub.aggs) == 1
+                  and sub.aggs[0][0] in ("count", "count_all",
+                                         "count_distinct"))
+    if not count_like and not _null_rejecting_path(pred, sq):
         raise SubqueryError(
             "A correlated scalar subquery under OR / IS NULL / CASE is "
             "unsupported: a missing correlation group yields NULL, and "
             "those operators can turn NULL into TRUE — the inner-join "
             "rewrite would drop rows SQL keeps.  Restructure so the "
             "scalar comparison is its own AND conjunct")
-    sub = sq.plan
     if not isinstance(sub, Aggregate) or sub.group_by \
             or len(sub.aggs) != 1:
         raise SubqueryError(
@@ -290,8 +314,8 @@ def _rewrite_correlated_scalar(outer: LogicalPlan, pred: Expr,
     if _plan_has_outer_refs(stripped):
         raise SubqueryError(
             "outer_ref outside a Filter equality conjunct is unsupported")
-    k = counter[0]
-    counter[0] += 1
+    k = state["n"]
+    state["n"] += 1
     func, agg_in, out_name = sub.aggs[0]
     inner_cols = [i for _o, i in pairs]
     agged = Aggregate(inner_cols, [(func, agg_in, out_name)], stripped)
@@ -302,8 +326,17 @@ def _rewrite_correlated_scalar(outer: LogicalPlan, pred: Expr,
     for j, (o, _i) in enumerate(pairs):
         eq = BinOp("==", Col(o), Col(f"__sq{k}_c{j}"))
         cond = eq if cond is None else And(cond, eq)
-    joined = Join(outer, renamed, cond, "inner")
-    new_pred = _map_expr(pred, lambda e: Col(fresh_agg) if e is sq else e)
+    if count_like:
+        # SQL's COUNT over an empty correlated group is 0, not NULL: an
+        # inner join would silently drop exactly those outer rows, so
+        # count-family scalars LEFT join and coalesce the miss to 0.
+        joined = Join(outer, renamed, cond, "left")
+        replacement: Expr = Case([(IsNull(Col(fresh_agg)), Lit(0))],
+                                 Col(fresh_agg))
+    else:
+        joined = Join(outer, renamed, cond, "inner")
+        replacement = Col(fresh_agg)
+    new_pred = _map_expr(pred, lambda e: replacement if e is sq else e)
     outer_cols = outer.output_columns(session.schema_of)
     return Project(list(outer_cols), Filter(new_pred, joined))
 
@@ -316,7 +349,7 @@ def _single_output_column(plan: LogicalPlan, session) -> str:
     return cols[0]
 
 
-def _rewrite_filter(node: Filter, session, counter: List[int]) -> LogicalPlan:
+def _rewrite_filter(node: Filter, session, state) -> LogicalPlan:
     """Rewrite ONE subquery construct in ``node``; caller loops."""
     conjuncts = split_conjuncts(node.condition)
 
@@ -384,8 +417,8 @@ def _rewrite_filter(node: Filter, session, counter: List[int]) -> LogicalPlan:
             if _plan_has_outer_refs(sq.plan):
                 # Residual conjuncts push below the generated join.
                 return _rewrite_correlated_scalar(
-                    rebuild(rest, node.child), conj, sq, session, counter)
-            lit = _fold_scalar(sq.plan, session)
+                    rebuild(rest, node.child), conj, sq, session, state)
+            lit = _fold_scalar_memo(sq, session, state)
             new_conj = _const_fold(
                 _map_expr(conj, lambda e: lit if e is sq else e))
             return rebuild(conjuncts[:idx] + [new_conj]
@@ -399,21 +432,22 @@ def _rewrite_filter(node: Filter, session, counter: List[int]) -> LogicalPlan:
 
 
 def rewrite_subqueries(plan: LogicalPlan, session,
-                       _counter: Optional[List[int]] = None) -> LogicalPlan:
+                       _state: Optional[dict] = None) -> LogicalPlan:
     """Eliminate every subquery construct from ``plan`` (bottom-up)."""
-    counter = _counter if _counter is not None else [0]
-    if _counter is None and not _plan_has_subqueries(plan):
+    state = _state if _state is not None else {
+        "n": 0, "folds": {}, "refs": []}
+    if _state is None and not _plan_has_subqueries(plan):
         return plan  # common case: zero overhead beyond one walk
-    children = tuple(rewrite_subqueries(c, session, counter)
+    children = tuple(rewrite_subqueries(c, session, state)
                      for c in plan.children)
     plan = plan.with_children(children)
     if isinstance(plan, Filter):
         # Loop: each pass eliminates one construct and may leave more.
         for _ in range(64):
-            out = _rewrite_filter(plan, session, counter)
+            out = _rewrite_filter(plan, session, state)
             if out is plan:
                 return plan
-            out = rewrite_subqueries(out, session, counter)
+            out = rewrite_subqueries(out, session, state)
             if not isinstance(out, Filter):
                 return out
             plan = out
@@ -437,7 +471,7 @@ def rewrite_subqueries(plan: LogicalPlan, session,
                     # (and so EXECUTE) the subquery once per occurrence of
                     # a shared node.
                     if isinstance(x, ScalarSubquery) and id(x) not in folds:
-                        folds[id(x)] = _fold_scalar(x.plan, session)
+                        folds[id(x)] = _fold_scalar_memo(x, session, state)
 
                 _walk_exprs(e, fold_once)
                 e = _map_expr(e, lambda x: folds[id(x)]

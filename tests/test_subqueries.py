@@ -305,3 +305,47 @@ def test_not_in_materializes_subquery_once(env, monkeypatch):
     # Exactly two executor instances ran: the materialized subquery and
     # the outer query (execute() recurses within one instance).
     assert len({id(e) for e in calls}) == 2, len({id(e) for e in calls})
+
+
+def test_correlated_count_empty_group_is_zero(tmp_path):
+    """SQL's COUNT over an empty correlated group is 0, not NULL — the
+    rewrite must LEFT join and keep those outer rows."""
+    d1, d2 = str(tmp_path / "o"), str(tmp_path / "i")
+    os.makedirs(d1)
+    os.makedirs(d2)
+    pq.write_table(pa.table({
+        "k": pa.array([1, 2, 3], type=pa.int64()),
+        "x": pa.array([0, 0, 5], type=pa.int64()),
+    }), os.path.join(d1, "p.parquet"))
+    pq.write_table(pa.table({
+        "ik": pa.array([1, 1, 3], type=pa.int64()),
+        "v": pa.array([10, 20, 30], type=pa.int64()),
+    }), os.path.join(d2, "p.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    sub = (s.read.parquet(d2).filter(col("ik") == outer_ref("k"))
+           .agg(cnt=("v", "count")))
+    out = (s.read.parquet(d1).filter(col("x") >= scalar(sub))
+           .sort("k").collect())
+    # k=1: cnt=2, 0>=2 false.  k=2: cnt=0, 0>=0 TRUE (kept).  k=3: 5>=1.
+    assert out.column("k").to_pylist() == [2, 3], out.column("k")
+
+
+def test_fold_memoized_within_one_pass(env, monkeypatch):
+    """One ScalarSubquery object referenced twice folds (executes) once
+    per optimize pass."""
+    import hyperspace_tpu.plan.subquery as sq_mod
+
+    s, paths, _df, _stores = env
+    calls = []
+    orig = sq_mod._fold_scalar
+
+    def counting(sub, session):
+        calls.append(1)
+        return orig(sub, session)
+
+    monkeypatch.setattr(sq_mod, "_fold_scalar", counting)
+    sub = scalar(s.read.parquet(paths["sales"]).agg(m=("s_return", "mean")))
+    ds = s.read.parquet(paths["sales"]).filter(
+        (col("s_return") > sub) & (col("s_return") < sub * 2))
+    ds.collect()
+    assert len(calls) == 1, len(calls)
