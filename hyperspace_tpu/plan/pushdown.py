@@ -1,0 +1,113 @@
+"""Filter pushdown: WHERE conjuncts sink below joins and projects.
+
+The reference rides Catalyst's PredicatePushdown — its rules see filters
+already sitting on the scan they constrain.  This engine owns its
+optimizer, and the SQL front end lowers WHERE to one Filter above the
+whole join tree, so without this pass no index rule or scan pruning
+could ever fire on a SQL query (and DSL users would keep hand-placing
+filters below joins).
+
+Rules, applied to fixpoint:
+  - Filter over Filter: merge into one conjunction (ordering preserved).
+  - Filter over Project: swap when every referenced column survives the
+    projection.
+  - Filter over Join: each conjunct moves to the side that resolves ALL
+    its columns.  LEFT-side resolution wins when a name exists on both
+    sides — matching execution, where the joined table exposes the left
+    copy under the ambiguous name.  Side eligibility by join type:
+      inner        -> either side
+      semi / anti  -> left only (output is left rows; right-side names
+                      do not survive the join anyway)
+      left         -> left only (a left-side predicate commutes; a
+                      right-side one evaluates after null-extension)
+      right        -> right only (mirror)
+    Constant conjuncts and cross-side conjuncts stay above the join.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from hyperspace_tpu.plan.expr import And, Expr, split_conjuncts
+from hyperspace_tpu.plan.nodes import (
+    Filter,
+    Join,
+    LogicalPlan,
+    Project,
+)
+from hyperspace_tpu.utils.resolver import resolve
+
+
+def _conjoin(conjuncts: List[Expr]) -> Expr:
+    cond = conjuncts[0]
+    for c in conjuncts[1:]:
+        cond = And(cond, c)
+    return cond
+
+
+def push_filters(plan: LogicalPlan, schema_of: Callable) -> LogicalPlan:
+    """Sink every Filter as far down as the rules above allow."""
+    children = tuple(push_filters(c, schema_of) for c in plan.children)
+    plan = plan.with_children(children)
+    if not isinstance(plan, Filter):
+        return plan
+    return _push_one(plan, schema_of)
+
+
+def _push_one(node: Filter, schema_of: Callable) -> LogicalPlan:
+    child = node.child
+    if isinstance(child, Filter):
+        merged = Filter(And(node.condition, child.condition), child.child)
+        return _push_one(merged, schema_of)
+    if isinstance(child, Project):
+        refs = node.condition.referenced_columns()
+        if refs and refs <= set(child.columns):
+            below = _push_one(Filter(node.condition, child.child),
+                              schema_of)
+            return Project(child.columns, below)
+        return node
+    if isinstance(child, Join):
+        sides = _pushable_sides(child.how)
+        if sides == (False, False):
+            return node
+        left_cols = child.left.output_columns(schema_of)
+        right_cols = child.right.output_columns(schema_of)
+        left_pushed: List[Expr] = []
+        right_pushed: List[Expr] = []
+        kept: List[Expr] = []
+        for conj in split_conjuncts(node.condition):
+            refs = sorted(conj.referenced_columns())
+            if not refs:
+                kept.append(conj)  # constant predicates stay put
+            elif sides[0] and resolve(refs, left_cols) is not None:
+                left_pushed.append(conj)
+            elif sides[1] and resolve(refs, right_cols) is not None:
+                right_pushed.append(conj)
+            else:
+                kept.append(conj)
+        if not left_pushed and not right_pushed:
+            return node
+        new_left = child.left
+        if left_pushed:
+            new_left = _push_one(Filter(_conjoin(left_pushed), new_left),
+                                 schema_of)
+        new_right = child.right
+        if right_pushed:
+            new_right = _push_one(Filter(_conjoin(right_pushed), new_right),
+                                  schema_of)
+        out: LogicalPlan = Join(new_left, new_right, child.condition,
+                                child.how)
+        if kept:
+            out = Filter(_conjoin(kept), out)
+        return out
+    return node
+
+
+def _pushable_sides(how: str):
+    if how == "inner":
+        return (True, True)
+    if how in ("semi", "anti", "left"):
+        return (True, False)
+    if how == "right":
+        return (False, True)
+    return (False, False)

@@ -247,6 +247,13 @@ class HyperspaceSession:
             from hyperspace_tpu.plan.temporal import canonicalize_temporal
 
             plan = canonicalize_temporal(plan, self.schema_map_of)
+            # WHERE conjuncts sink to the side/scan they constrain
+            # (Catalyst's PredicatePushdown role) — required for the SQL
+            # front end's canonical filter-above-joins form to reach the
+            # Filter-over-scan shapes every rule pattern-matches.
+            from hyperspace_tpu.plan.pushdown import push_filters
+
+            plan = push_filters(plan, self.schema_of)
             plan = prune_columns(plan, self.schema_of)
             if not self._hyperspace_enabled:
                 return plan
@@ -272,6 +279,12 @@ class HyperspaceSession:
             from hyperspace_tpu.rules.data_skipping import DataSkippingFilterRule
 
             plan = DataSkippingFilterRule(self, entries).apply(plan)
+            # The rules rebuild rewritten sides in Filter-above-Project
+            # form; one more pushdown + prune reaches the same normal
+            # form a second optimize() would — keeping optimize
+            # idempotent (the plan-stability suites diff exact trees).
+            plan = push_filters(plan, self.schema_of)
+            plan = prune_columns(plan, self.schema_of)
             return plan
         finally:
             self._lake_schema_memo = prev_memo

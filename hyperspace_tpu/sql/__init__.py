@@ -1,0 +1,13 @@
+"""SQL front end: SELECT text -> the engine's Dataset DSL.
+
+The reference's users and its golden harness feed ``.sql`` files
+(goldstandard/PlanStabilitySuite.scala:81-283); this package parses a
+practical SELECT dialect and lowers it onto the existing plan verbs, so
+corpus queries run near-verbatim.  ``plan/pushdown.py`` makes the
+canonical WHERE-above-joins lowering optimize into the same plans as
+hand-placed DSL filters.
+"""
+
+from hyperspace_tpu.sql.parser import SqlError, sql
+
+__all__ = ["sql", "SqlError"]

@@ -1,0 +1,840 @@
+"""A recursive-descent SELECT parser lowering to the Dataset DSL.
+
+Supported surface (the shapes the reference's TPC corpus uses):
+
+    SELECT [DISTINCT] items | *
+    FROM table [alias] | (subquery) [alias]
+    [ [INNER|LEFT [OUTER]|RIGHT [OUTER]|FULL [OUTER]|[LEFT] SEMI|
+       [LEFT] ANTI] JOIN source ON cond ]...
+    [WHERE cond] [GROUP BY keys] [HAVING cond]
+    [ORDER BY out [ASC|DESC], ...] [LIMIT n]
+
+Expressions: literals (numbers, 'strings', DATE 'yyyy-mm-dd', TRUE/
+FALSE/NULL), [alias.]column, + - * /, comparisons (= <> != < <= > >=),
+AND/OR/NOT, BETWEEN, [NOT] IN (list | subquery), [NOT] LIKE, IS [NOT]
+NULL, CASE WHEN, CAST(x AS type), EXTRACT(field FROM x) and
+year/month/day/quarter(x), aggregate calls (sum/min/max/avg/count/
+count(DISTINCT x)/stddev/variance), window calls ``func(...) OVER
+(PARTITION BY ... ORDER BY ...)`` as top-level select items, scalar
+subqueries ``(SELECT ...)``.  A column qualified by an alias not in the
+current scope becomes ``outer_ref`` — SQL's correlated subquery form.
+
+EXISTS is not parsed: write a SEMI JOIN (the rewrite SQL engines apply).
+"""
+
+from __future__ import annotations
+
+import datetime
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from hyperspace_tpu.plan.expr import (
+    And,
+    BinOp,
+    Case,
+    Cast,
+    Col,
+    Expr,
+    Extract,
+    InSubquery,
+    IsIn,
+    IsNull,
+    Lit,
+    Neg,
+    Not,
+    Or,
+    OuterRef,
+    ScalarSubquery,
+    StringMatch,
+)
+
+
+class SqlError(ValueError):
+    """Parse or lowering failure, with position context."""
+
+
+# ---- markers local to lowering -----------------------------------------
+
+class _AggCall(Expr):
+    def __init__(self, func: str, arg: Optional[Expr]) -> None:
+        self.func = func  # engine spelling (mean, count_all, ...)
+        # Named "child" so the shared expression walkers
+        # (plan/subquery._walk_exprs) descend into it.
+        self.child = arg
+
+    def __repr__(self) -> str:
+        return f"_agg_{self.func}({self.child!r})"
+
+
+class _WindowCall(Expr):
+    def __init__(self, func, value, partition_by, order_by) -> None:
+        self.func = func
+        self.value = value
+        self.partition_by = partition_by
+        self.order_by = order_by
+
+    def __repr__(self) -> str:
+        return f"_window_{self.func}"
+
+
+_AGG_FUNCS = {"sum": "sum", "min": "min", "max": "max", "avg": "mean",
+              "mean": "mean", "count": "count", "stddev": "stddev",
+              "variance": "variance"}
+_WINDOW_FUNCS = ("row_number", "rank", "dense_rank", "sum", "min", "max",
+                 "avg", "count")
+_EXTRACT_FUNCS = {"year": "year", "month": "month", "day": "day",
+                  "dayofmonth": "day", "quarter": "quarter"}
+
+_TOKEN_RE = re.compile(r"""
+    \s+
+  | --[^\n]*
+  | (?P<num>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+|\d+(?:[eE][+-]?\d+)?)
+  | (?P<str>'(?:[^']|'')*')
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
+  | (?P<op><>|!=|<=|>=|=|<|>|\(|\)|,|\.|\*|\+|-|/|;)
+""", re.VERBOSE)
+
+
+def _tokenize(text: str) -> List[Tuple[str, str, int]]:
+    out = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN_RE.match(text, pos)
+        if m is None:
+            raise SqlError(f"Unexpected character {text[pos]!r} at "
+                           f"position {pos}: ...{text[pos:pos+20]!r}")
+        pos = m.end()
+        if m.lastgroup == "num":
+            out.append(("num", m.group("num"), m.start()))
+        elif m.lastgroup == "str":
+            out.append(("str", m.group("str")[1:-1].replace("''", "'"),
+                        m.start()))
+        elif m.lastgroup == "ident":
+            out.append(("ident", m.group("ident"), m.start()))
+        elif m.lastgroup == "op":
+            out.append(("op", m.group("op"), m.start()))
+    out.append(("eof", "", len(text)))
+    return out
+
+
+class _Parser:
+    def __init__(self, text: str, session, tables: Dict[str, Any],
+                 outer_aliases: Tuple[str, ...] = ()) -> None:
+        self.text = text
+        self.tokens = _tokenize(text)
+        self.i = 0
+        self.session = session
+        self.tables = tables
+        self.outer_aliases = outer_aliases
+        self.aliases: List[str] = []  # this query's own scope
+        # FROM-order source registry: ({names}, [columns] or None) per
+        # source, for qualified-reference validation.
+        self.sources: List[Tuple[set, Optional[List[str]]]] = []
+        self._in_join_on = False
+
+    # -- token plumbing --------------------------------------------------
+    def peek(self, offset: int = 0):
+        return self.tokens[min(self.i + offset, len(self.tokens) - 1)]
+
+    def next(self):
+        t = self.tokens[self.i]
+        self.i = min(self.i + 1, len(self.tokens) - 1)
+        return t
+
+    def at_kw(self, *words: str) -> bool:
+        t = self.peek()
+        return t[0] == "ident" and t[1].upper() in words
+
+    def take_kw(self, *words: str) -> bool:
+        if self.at_kw(*words):
+            self.next()
+            return True
+        return False
+
+    def expect_kw(self, word: str) -> None:
+        if not self.take_kw(word):
+            self.fail(f"expected {word}")
+
+    def at_op(self, *ops: str) -> bool:
+        t = self.peek()
+        return t[0] == "op" and t[1] in ops
+
+    def take_op(self, *ops: str) -> bool:
+        if self.at_op(*ops):
+            self.next()
+            return True
+        return False
+
+    def expect_op(self, op: str) -> None:
+        if not self.take_op(op):
+            self.fail(f"expected {op!r}")
+
+    def fail(self, msg: str) -> None:
+        t = self.peek()
+        raise SqlError(f"{msg} at position {t[2]} (near {t[1]!r}): "
+                       f"...{self.text[t[2]:t[2] + 30]!r}")
+
+    # -- query -----------------------------------------------------------
+    def parse_select(self):
+        self.expect_kw("SELECT")
+        distinct = self.take_kw("DISTINCT")
+        # FROM declares the aliases the select list references, so parse
+        # it FIRST: skip ahead to the depth-0 FROM, build the sources,
+        # then come back for the items with the scope populated.
+        items_start = self.i
+        self._skip_to_from()
+        self.expect_kw("FROM")
+        ds = self.parse_from()
+        after_from = self.i
+        self.i = items_start
+        items = self.parse_select_items()
+        if not self.at_kw("FROM"):
+            self.fail("expected FROM after the select list")
+        self.i = after_from
+        where = None
+        if self.take_kw("WHERE"):
+            where = self.parse_expr()
+        group_by: List[str] = []
+        if self.take_kw("GROUP"):
+            self.expect_kw("BY")
+            group_by = self.parse_group_keys()
+        having = None
+        if self.take_kw("HAVING"):
+            having = self.parse_expr()
+        order_by: List[Tuple[str, bool]] = []
+        if self.take_kw("ORDER"):
+            self.expect_kw("BY")
+            order_by = self.parse_order_keys()
+        limit = None
+        if self.take_kw("LIMIT"):
+            t = self.next()
+            if t[0] != "num":
+                self.fail("expected a number after LIMIT")
+            limit = int(t[1])
+        return _lower(self, ds, items, distinct, where, group_by, having,
+                      order_by, limit)
+
+    def _skip_to_from(self) -> None:
+        depth = 0
+        while True:
+            t = self.peek()
+            if t[0] == "eof":
+                self.fail("expected FROM")
+            if t[0] == "op" and t[1] == "(":
+                depth += 1
+            elif t[0] == "op" and t[1] == ")":
+                depth -= 1
+            elif depth == 0 and t[0] == "ident" and t[1].upper() == "FROM":
+                return
+            self.next()
+
+    def parse_select_items(self):
+        if self.take_op("*"):
+            return [("*", None)]
+        items = []
+        while True:
+            e = self.parse_expr()
+            alias = None
+            if self.take_kw("AS"):
+                t = self.next()
+                if t[0] != "ident":
+                    self.fail("expected an alias after AS")
+                alias = t[1]
+            elif self.peek()[0] == "ident" and not self.at_kw(
+                    "FROM", "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT"):
+                alias = self.next()[1]
+            items.append((alias, e))
+            if not self.take_op(","):
+                return items
+
+    def parse_group_keys(self) -> List[str]:
+        keys = []
+        while True:
+            e = self.parse_expr()
+            keys.append(e)
+            if not self.take_op(","):
+                return keys
+
+    def parse_order_keys(self):
+        keys = []
+        while True:
+            t = self.next()
+            if t[0] != "ident":
+                self.fail("ORDER BY keys must be output column names")
+            asc = True
+            if self.take_kw("DESC"):
+                asc = False
+            else:
+                self.take_kw("ASC")
+            keys.append((t[1], asc))
+            if not self.take_op(","):
+                return keys
+
+    # -- FROM / JOIN -----------------------------------------------------
+    def parse_from(self):
+        ds = self.parse_source()
+        while True:
+            how = self.parse_join_type()
+            if how is None:
+                return ds
+            right = self.parse_source()
+            self.expect_kw("ON")
+            # Join conditions resolve each side independently (the
+            # engine's equi-join pairs), so same-named keys on both
+            # sides are fine there — skip the ambiguity check.
+            self._in_join_on = True
+            try:
+                cond = self.parse_expr()
+            finally:
+                self._in_join_on = False
+            ds = ds.join(right, cond, how=how)
+
+    def parse_join_type(self) -> Optional[str]:
+        if self.take_kw("JOIN"):
+            return "inner"
+        if self.take_kw("INNER"):
+            self.expect_kw("JOIN")
+            return "inner"
+        for kw, how in (("LEFT", "left"), ("RIGHT", "right"),
+                        ("FULL", "full"), ("SEMI", "semi"),
+                        ("ANTI", "anti")):
+            if self.at_kw(kw):
+                self.next()
+                if kw == "LEFT" and self.at_kw("SEMI", "ANTI"):
+                    how = "semi" if self.take_kw("SEMI") else "anti"
+                else:
+                    self.take_kw("OUTER")
+                self.expect_kw("JOIN")
+                return how
+        return None
+
+    def parse_source(self):
+        if self.take_op("("):
+            sub = _Parser(self.text, self.session, self.tables,
+                          self.outer_aliases)
+            sub.tokens, sub.i = self.tokens, self.i
+            ds = sub.parse_select()
+            self.i = sub.i
+            self.expect_op(")")
+            names = set()
+            if self.peek()[0] == "ident" and not self._at_clause_kw():
+                alias = self.next()[1]
+                self.aliases.append(alias)
+                names.add(alias)
+            self._register_source(names, ds)
+            return ds
+        t = self.next()
+        if t[0] != "ident":
+            self.fail("expected a table name")
+        name = t[1]
+        src = self.tables.get(name)
+        if src is None:
+            raise SqlError(
+                f"Unknown table {name!r}; pass it in sql(..., tables="
+                f"{{{name!r}: dataset_or_parquet_path}})")
+        ds = self.session.read.parquet(src) if isinstance(src, str) else src
+        names = {name}
+        self.aliases.append(name)
+        if self.peek()[0] == "ident" and not self._at_clause_kw():
+            alias = self.next()[1]
+            self.aliases.append(alias)
+            names.add(alias)
+        self._register_source(names, ds)
+        return ds
+
+    def _register_source(self, names: set, ds) -> None:
+        try:
+            cols = list(ds.columns)
+        except Exception:
+            cols = None  # unresolvable schema: skip validation
+        self.sources.append((names, cols))
+
+    def _at_clause_kw(self) -> bool:
+        return self.at_kw("WHERE", "GROUP", "HAVING", "ORDER", "LIMIT",
+                          "JOIN", "INNER", "LEFT", "RIGHT", "FULL", "SEMI",
+                          "ANTI", "ON", "AS", "UNION")
+
+    # -- expressions (precedence climbing) -------------------------------
+    def parse_expr(self) -> Expr:
+        return self.parse_or()
+
+    def parse_or(self) -> Expr:
+        e = self.parse_and()
+        while self.take_kw("OR"):
+            e = Or(e, self.parse_and())
+        return e
+
+    def parse_and(self) -> Expr:
+        e = self.parse_not()
+        while self.take_kw("AND"):
+            e = And(e, self.parse_not())
+        return e
+
+    def parse_not(self) -> Expr:
+        if self.take_kw("NOT"):
+            return Not(self.parse_not())
+        return self.parse_comparison()
+
+    def parse_comparison(self) -> Expr:
+        e = self.parse_additive()
+        if self.at_op("=", "<>", "!=", "<", "<=", ">", ">="):
+            op = self.next()[1]
+            rhs = self.parse_additive()
+            if op == "=":
+                return BinOp("==", e, rhs)
+            if op in ("<>", "!="):
+                return Not(BinOp("==", e, rhs))
+            return BinOp(op, e, rhs)
+        if self.at_kw("BETWEEN"):
+            self.next()
+            lo = self.parse_additive()
+            self.expect_kw("AND")
+            hi = self.parse_additive()
+            return And(BinOp(">=", e, lo), BinOp("<=", e, hi))
+        negated = False
+        if self.at_kw("NOT") and self.peek(1)[0] == "ident" \
+                and self.peek(1)[1].upper() in ("IN", "LIKE"):
+            self.next()
+            negated = True
+        if self.take_kw("IN"):
+            self.expect_op("(")
+            if self.at_kw("SELECT"):
+                sub = self._parse_subquery()
+                out: Expr = InSubquery(e, sub.plan)
+            else:
+                values = [self._literal_value(self.parse_additive())]
+                while self.take_op(","):
+                    values.append(self._literal_value(self.parse_additive()))
+                out = IsIn(e, values)
+            if not isinstance(out, InSubquery):
+                self.expect_op(")")
+            return Not(out) if negated else out
+        if self.take_kw("LIKE"):
+            t = self.next()
+            if t[0] != "str":
+                self.fail("LIKE needs a string pattern")
+            out = StringMatch("like", e, t[1])
+            return Not(out) if negated else out
+        if self.take_kw("IS"):
+            neg = self.take_kw("NOT")
+            self.expect_kw("NULL")
+            out = IsNull(e)
+            return Not(out) if neg else out
+        return e
+
+    def _literal_value(self, e: Expr):
+        if isinstance(e, Neg) and isinstance(e.child, Lit) \
+                and isinstance(e.child.value, (int, float)):
+            return -e.child.value
+        if not isinstance(e, Lit):
+            self.fail("IN lists take literals (use an IN subquery for "
+                      "computed sets)")
+        return e.value
+
+    def parse_additive(self) -> Expr:
+        e = self.parse_multiplicative()
+        while self.at_op("+", "-"):
+            op = self.next()[1]
+            e = (e + self.parse_multiplicative()) if op == "+" \
+                else (e - self.parse_multiplicative())
+        return e
+
+    def parse_multiplicative(self) -> Expr:
+        e = self.parse_unary()
+        while self.at_op("*", "/"):
+            op = self.next()[1]
+            e = (e * self.parse_unary()) if op == "*" \
+                else (e / self.parse_unary())
+        return e
+
+    def parse_unary(self) -> Expr:
+        if self.take_op("-"):
+            return Neg(self.parse_unary())
+        if self.take_op("+"):
+            return self.parse_unary()
+        return self.parse_primary()
+
+    def _parse_subquery(self):
+        sub = _Parser(self.text, self.session, self.tables,
+                      tuple(self.aliases) + self.outer_aliases)
+        sub.tokens, sub.i = self.tokens, self.i
+        ds = sub.parse_select()
+        self.i = sub.i
+        self.expect_op(")")
+        return ds
+
+    def parse_primary(self) -> Expr:
+        t = self.peek()
+        if self.take_op("("):
+            if self.at_kw("SELECT"):
+                return ScalarSubquery(self._parse_subquery().plan)
+            e = self.parse_expr()
+            self.expect_op(")")
+            return e
+        if t[0] == "num":
+            self.next()
+            text = t[1]
+            return Lit(float(text) if any(c in text for c in ".eE")
+                       else int(text))
+        if t[0] == "str":
+            self.next()
+            return Lit(t[1])
+        if t[0] != "ident":
+            self.fail("expected an expression")
+        word = t[1]
+        upper = word.upper()
+        if upper == "DATE":
+            self.next()
+            s = self.next()
+            if s[0] != "str":
+                self.fail("DATE needs a 'yyyy-mm-dd' string")
+            try:
+                return Lit(datetime.date.fromisoformat(s[1]))
+            except ValueError as e:
+                raise SqlError(f"Bad DATE literal {s[1]!r}: {e}") from e
+        if upper in ("TRUE", "FALSE"):
+            self.next()
+            return Lit(upper == "TRUE")
+        if upper == "NULL":
+            self.next()
+            return Lit(None)
+        if upper == "CASE":
+            return self.parse_case()
+        if upper == "CAST":
+            self.next()
+            self.expect_op("(")
+            e = self.parse_expr()
+            self.expect_kw("AS")
+            type_name = self.next()[1]
+            self.expect_op(")")
+            return Cast(e, type_name)
+        if upper == "EXTRACT":
+            self.next()
+            self.expect_op("(")
+            field = self.next()[1].lower()
+            if field not in _EXTRACT_FUNCS:
+                self.fail(f"EXTRACT field must be one of "
+                          f"{sorted(_EXTRACT_FUNCS)}")
+            self.expect_kw("FROM")
+            e = self.parse_expr()
+            self.expect_op(")")
+            return Extract(_EXTRACT_FUNCS[field], e)
+        if upper == "EXISTS":
+            self.fail("EXISTS is not supported; write a SEMI JOIN (the "
+                      "rewrite SQL engines apply)")
+        if self.peek(1)[0] == "op" and self.peek(1)[1] == "(":
+            return self.parse_call()
+        # [alias.]column
+        self.next()
+        if self.take_op("."):
+            c = self.next()
+            if c[0] != "ident":
+                self.fail("expected a column after '.'")
+            if word in self.aliases:
+                return self._qualified_col(word, c[1])
+            if word in self.outer_aliases:
+                return OuterRef(c[1])
+            raise SqlError(
+                f"Unknown table alias {word!r} (in scope: "
+                f"{self.aliases + list(self.outer_aliases)})")
+        return Col(word)
+
+    def _qualified_col(self, alias: str, column: str) -> Expr:
+        """``alias.column`` with BINDING validation: the engine's Col has
+        no qualifier, and a joined table exposes the FIRST (leftmost)
+        source's copy under an ambiguous name — so a reference that
+        would silently bind to a different table must error instead."""
+        target = next((cols for names, cols in self.sources
+                       if alias in names), None)
+        if target is not None:
+            if column not in target:
+                raise SqlError(
+                    f"Column {column!r} does not exist in table "
+                    f"{alias!r} (columns: {target})")
+            first = next((names for names, cols in self.sources
+                          if cols is not None and column in cols), None)
+            if not self._in_join_on and first is not None \
+                    and alias not in first:
+                raise SqlError(
+                    f"Ambiguous column {alias}.{column}: another table "
+                    f"earlier in FROM also has {column!r}, and the "
+                    f"joined output exposes that copy under this name — "
+                    f"rename one side via a derived table "
+                    f"(SELECT {column} AS ... FROM ...)")
+        return Col(column)
+
+    def parse_case(self) -> Expr:
+        self.expect_kw("CASE")
+        branches = []
+        while self.take_kw("WHEN"):
+            cond = self.parse_expr()
+            self.expect_kw("THEN")
+            branches.append((cond, self.parse_expr()))
+        otherwise: Expr = Lit(None)
+        if self.take_kw("ELSE"):
+            otherwise = self.parse_expr()
+        self.expect_kw("END")
+        if not branches:
+            self.fail("CASE needs at least one WHEN")
+        return Case(branches, otherwise)
+
+    def parse_call(self) -> Expr:
+        name = self.next()[1].lower()
+        self.expect_op("(")
+        distinct = False
+        star = False
+        arg: Optional[Expr] = None
+        if self.take_op("*"):
+            star = True
+        elif not self.at_op(")"):
+            if self.take_kw("DISTINCT"):
+                distinct = True
+            arg = self.parse_expr()
+        self.expect_op(")")
+        # OVER -> window call
+        if self.at_kw("OVER"):
+            self.next()
+            self.expect_op("(")
+            partition: List[str] = []
+            order: List[Tuple[str, bool]] = []
+            if self.take_kw("PARTITION"):
+                self.expect_kw("BY")
+                while True:
+                    c = self.parse_primary()
+                    if not isinstance(c, Col):
+                        self.fail("PARTITION BY keys must be columns")
+                    partition.append(c.name)
+                    if not self.take_op(","):
+                        break
+            if self.take_kw("ORDER"):
+                self.expect_kw("BY")
+                while True:
+                    c = self.parse_primary()
+                    if not isinstance(c, Col):
+                        self.fail("window ORDER BY keys must be columns")
+                    asc = True
+                    if self.take_kw("DESC"):
+                        asc = False
+                    else:
+                        self.take_kw("ASC")
+                    order.append((c.name, asc))
+                    if not self.take_op(","):
+                        break
+            self.expect_op(")")
+            if name not in _WINDOW_FUNCS:
+                self.fail(f"Unsupported window function {name}")
+            func = {"avg": "mean"}.get(name, name)
+            value = None
+            if func in ("sum", "min", "max", "mean", "count") \
+                    and arg is not None:
+                if not isinstance(arg, Col):
+                    self.fail("window aggregate arguments must be columns")
+                value = arg.name
+            return _WindowCall(func, value, partition, order)
+        if name in _AGG_FUNCS:
+            func = _AGG_FUNCS[name]
+            if name == "count":
+                if star:
+                    return _AggCall("count_all", None)
+                if distinct:
+                    return _AggCall("count_distinct", arg)
+                return _AggCall("count", arg)
+            if distinct:
+                self.fail(f"DISTINCT is only supported inside count()")
+            if arg is None:
+                self.fail(f"{name}() needs an argument")
+            return _AggCall(func, arg)
+        if name in _EXTRACT_FUNCS:
+            if arg is None:
+                self.fail(f"{name}() needs an argument")
+            return Extract(_EXTRACT_FUNCS[name], arg)
+        self.fail(f"Unknown function {name}")
+
+
+# ---- lowering ----------------------------------------------------------
+
+def _map(e: Expr, fn) -> Expr:
+    from hyperspace_tpu.plan.subquery import _map_expr
+
+    return _map_expr(e, fn)
+
+
+def _contains_agg(e: Expr) -> bool:
+    from hyperspace_tpu.plan.subquery import _contains
+
+    return _contains(e, _AggCall)
+
+
+def _lower(p: _Parser, ds, items, distinct, where, group_by, having,
+           order_by, limit):
+    if where is not None:
+        _reject_markers(where, "WHERE")
+        ds = ds.filter(where)
+
+    star = len(items) == 1 and items[0][0] == "*" and items[0][1] is None
+    has_agg = any(_contains_agg(e) for _a, e in items
+                  if e is not None and not isinstance(e, _WindowCall))
+    aggregate_query = bool(group_by) or has_agg
+
+    window_items = [(a, e) for a, e in items
+                    if isinstance(e, _WindowCall)]
+    plain_items = [(a, e) for a, e in items
+                   if not isinstance(e, _WindowCall) and e is not None]
+
+    select_names: List[str] = []
+    select_computed: List[Tuple[str, Expr]] = []
+
+    if aggregate_query:
+        # Group keys: plain columns, or references to computed select
+        # aliases (SELECT year(d) AS y ... GROUP BY y) which materialize
+        # as with_column first.
+        alias_exprs = {a: e for a, e in plain_items
+                       if a is not None and not _contains_agg(e)}
+        keys: List[str] = []
+        for k in group_by:
+            if isinstance(k, Col):
+                if k.name in alias_exprs \
+                        and not isinstance(alias_exprs[k.name], Col):
+                    ds = ds.with_column(k.name, alias_exprs[k.name])
+                keys.append(k.name)
+            else:
+                raise SqlError(
+                    f"GROUP BY keys must be column names or select "
+                    f"aliases, got {k!r}")
+        agg_specs: Dict[str, tuple] = {}
+        hidden = [0]
+
+        def agg_name(call: _AggCall, alias: Optional[str]) -> str:
+            if alias is not None:
+                name = alias
+            else:
+                name = f"__agg{hidden[0]}"
+                hidden[0] += 1
+            inp = "" if call.func == "count_all" else (
+                call.child.name if isinstance(call.child, Col) else call.child)
+            agg_specs[name] = (inp, call.func)
+            return name
+
+        for alias, e in plain_items:
+            if isinstance(e, _AggCall):
+                out = agg_name(e, alias)
+                select_names.append(out)
+                continue
+            if _contains_agg(e):
+                if alias is None:
+                    raise SqlError(
+                        f"Computed aggregate select items need AS "
+                        f"aliases: {e!r}")
+                new_e = _map(e, lambda x: Col(agg_name(x, None))
+                             if isinstance(x, _AggCall) else x)
+                _reject_markers(new_e, "SELECT expressions",
+                                (_WindowCall,))
+                select_computed.append((alias, new_e))
+                continue
+            # Non-aggregate item: must be a group key (or its alias).
+            name = alias or (e.name if isinstance(e, Col) else None)
+            if name is None or name not in keys:
+                raise SqlError(
+                    f"Select item {e!r} is neither aggregated nor a "
+                    f"GROUP BY key")
+            select_names.append(name)
+        if not keys:
+            ds = ds.agg(**agg_specs)
+        else:
+            ds = ds.group_by(*keys).agg(**agg_specs)
+        if having is not None:
+            _reject_markers(having, "HAVING", (_WindowCall,))
+
+            def map_having(x):
+                if isinstance(x, _AggCall):
+                    # Match an existing SELECT output structurally; a
+                    # HAVING-only aggregate is deliberately rejected (it
+                    # would need a hidden output threaded through the
+                    # final projection) — alias the aggregate in SELECT.
+                    for name, (inp, func) in agg_specs.items():
+                        want = "" if x.func == "count_all" else (
+                            x.child.name if isinstance(x.child, Col)
+                            else x.child)
+                        if func == x.func and repr(inp) == repr(want):
+                            return Col(name)
+                    raise SqlError(
+                        f"HAVING aggregate {x!r} must also appear in the "
+                        f"SELECT list")
+                return x
+
+            ds = ds.filter(_map(having, map_having))
+    else:
+        if having is not None:
+            raise SqlError("HAVING without GROUP BY/aggregates")
+        if not star:
+            for alias, e in plain_items:
+                if isinstance(e, Col) and alias is None:
+                    select_names.append(e.name)
+                elif alias is not None:
+                    _reject_markers(e, "SELECT expressions",
+                                    (_WindowCall,))
+                    select_computed.append((alias, e))
+                else:
+                    raise SqlError(
+                        f"Computed select items need AS aliases: {e!r}")
+
+    for alias, w in window_items:
+        if alias is None:
+            raise SqlError("Window select items need AS aliases")
+        ds = ds.with_window(alias, w.func, partition_by=w.partition_by,
+                            order_by=w.order_by, value=w.value)
+        select_names.append(alias)
+
+    if not star and (select_names or select_computed):
+        kwargs = dict(select_computed)
+        overlap = set(select_names) & set(kwargs)
+        if overlap:
+            raise SqlError(f"Duplicate select output names: {overlap}")
+        # Skip a no-op projection (SELECT exactly the current output, in
+        # order): keeps plans identical to DSL forms that never wrote a
+        # select — and leaves subquery plans as bare Aggregates, the
+        # shape the correlated-scalar rewrite requires.
+        noop = not kwargs
+        if noop:
+            try:
+                noop = ds.columns == select_names
+            except Exception:
+                noop = False
+        if not noop:
+            ds = ds.select(*select_names, **kwargs)
+    if distinct:
+        ds = ds.distinct()
+    if order_by:
+        ds = ds.sort(*[(c, asc) for c, asc in order_by])
+    if limit is not None:
+        ds = ds.limit(limit)
+    return ds
+
+
+def _reject_markers(e: Expr, where: str, kinds=None) -> None:
+    from hyperspace_tpu.plan.subquery import _walk_exprs
+
+    kinds = kinds or (_AggCall, _WindowCall)
+
+    def check(x):
+        if isinstance(x, kinds):
+            raise SqlError(f"Aggregate/window calls are not allowed in "
+                           f"{where} (window calls must be top-level "
+                           f"select items)")
+    _walk_exprs(e, check)
+
+
+def sql(session, text: str, tables: Dict[str, Any]):
+    """Parse ``text`` and lower it to a Dataset against ``session``.
+
+    ``tables`` maps SQL table names to Datasets or parquet directory
+    paths (the FROM resolution — the engine has no catalog).
+    """
+    p = _Parser(text, session, dict(tables))
+    ds = p.parse_select()
+    while p.take_op(";"):  # .sql files commonly end with a semicolon
+        pass
+    t = p.peek()
+    if t[0] != "eof":
+        p.fail("unexpected trailing input")
+    return ds

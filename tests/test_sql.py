@@ -1,0 +1,354 @@
+"""SQL front end (round-3 verdict item 7).
+
+The reference's users and its golden harness feed .sql files
+(goldstandard/PlanStabilitySuite.scala:81-283).  These tests lower
+TPC-H-shaped SQL text and require IDENTICAL optimized plans to the
+equivalent DSL forms (filter pushdown makes the canonical
+WHERE-above-joins lowering converge), plus answer parity.
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import (
+    Hyperspace,
+    HyperspaceSession,
+    IndexConfig,
+    col,
+    in_subquery,
+    outer_ref,
+    scalar,
+    when,
+    year,
+)
+from hyperspace_tpu.sql import SqlError, sql
+
+D = lambda n: datetime.date(1992, 1, 1) + datetime.timedelta(days=n)
+
+
+@pytest.fixture(scope="module")
+def env(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("sqlenv"))
+    rng = np.random.default_rng(3)
+    n_o, n_l, n_c = 500, 2000, 80
+    orders = pa.table({
+        "o_orderkey": np.arange(n_o, dtype=np.int64),
+        "o_custkey": pa.array(rng.integers(0, n_c, n_o), type=pa.int64()),
+        "o_totalprice": pa.array(np.round(rng.uniform(1, 1000, n_o), 2)),
+        "o_orderdate": pa.array(
+            np.datetime64("1992-01-01")
+            + np.sort(rng.integers(0, 2000, n_o)).astype("timedelta64[D]")),
+        "o_orderpriority": pa.array(
+            [("1-URGENT", "2-HIGH", "3-MEDIUM")[i % 3] for i in range(n_o)]),
+    })
+    lineitem = pa.table({
+        "l_orderkey": pa.array(rng.integers(0, n_o, n_l), type=pa.int64()),
+        "l_quantity": pa.array(rng.integers(1, 50, n_l), type=pa.int64()),
+        "l_extendedprice": pa.array(np.round(rng.uniform(1, 1000, n_l), 2)),
+        "l_discount": pa.array(np.round(rng.uniform(0, 0.1, n_l), 3)),
+        "l_returnflag": pa.array([("R", "A", "N")[i % 3]
+                                  for i in range(n_l)]),
+        "l_shipdate": pa.array(
+            np.datetime64("1992-01-01")
+            + np.sort(rng.integers(0, 2000, n_l)).astype("timedelta64[D]")),
+        "l_shipmode": pa.array([("MAIL", "SHIP", "AIR")[i % 3]
+                                for i in range(n_l)]),
+    })
+    customer = pa.table({
+        "c_custkey": np.arange(n_c, dtype=np.int64),
+        "c_name": pa.array([f"Customer#{i:06d}" for i in range(n_c)]),
+        "c_mktsegment": pa.array([("BUILDING", "MACHINERY")[i % 2]
+                                  for i in range(n_c)]),
+        "c_acctbal": pa.array(np.round(rng.uniform(-500, 5000, n_c), 2)),
+    })
+    paths = {}
+    for name, t in (("orders", orders), ("lineitem", lineitem),
+                    ("customer", customer)):
+        d = os.path.join(root, name)
+        os.makedirs(d)
+        for i in range(2):
+            pq.write_table(t.slice(i * t.num_rows // 2, t.num_rows // 2),
+                           os.path.join(d, f"part-{i:05d}.parquet"))
+        paths[name] = d
+    s = HyperspaceSession(system_path=os.path.join(root, "ix"))
+    s.conf.num_buckets = 4
+    hs = Hyperspace(s)
+    hs.create_index(s.read.parquet(paths["lineitem"]),
+                    IndexConfig("sq_l", ["l_orderkey"],
+                                ["l_quantity", "l_extendedprice",
+                                 "l_discount", "l_shipdate"]))
+    hs.create_index(s.read.parquet(paths["orders"]),
+                    IndexConfig("sq_o", ["o_orderkey"],
+                                ["o_custkey", "o_totalprice",
+                                 "o_orderdate"]))
+    s.enable_hyperspace()
+    return s, paths
+
+
+def _tables(s, paths):
+    return {name: s.read.parquet(p) for name, p in paths.items()}
+
+
+def _assert_same(sql_ds, dsl_ds, check_order=False):
+    assert sql_ds.optimized_plan().tree_string() \
+        == dsl_ds.optimized_plan().tree_string()
+    a = sql_ds.collect()
+    b = dsl_ds.collect()
+    assert a.num_rows == b.num_rows
+    assert set(a.column_names) == set(b.column_names)
+    if check_order:
+        for c in a.column_names:
+            assert a.column(c).to_pylist() == b.column(c).to_pylist(), c
+
+
+# One pair per corpus shape: (name, SQL text, DSL builder).
+def _corpus(s, paths):
+    t = _tables(s, paths)
+    rev = col("l_extendedprice") * (1 - col("l_discount"))
+    return [
+        ("q_point_filter",
+         "SELECT l_orderkey, l_quantity FROM lineitem "
+         "WHERE l_orderkey = 42",
+         t["lineitem"].filter(col("l_orderkey") == 42)
+         .select("l_orderkey", "l_quantity")),
+        ("q_pricing_summary",
+         "SELECT l_returnflag, sum(l_quantity) AS sum_qty, "
+         "       avg(l_extendedprice) AS avg_price, count(*) AS n "
+         "FROM lineitem WHERE l_shipdate <= DATE '1997-01-01' "
+         "GROUP BY l_returnflag ORDER BY l_returnflag",
+         t["lineitem"].filter(col("l_shipdate") <= D(1827))
+         .group_by("l_returnflag")
+         .agg(sum_qty=("l_quantity", "sum"),
+              avg_price=("l_extendedprice", "mean"), n=("", "count_all"))
+         .sort("l_returnflag")),
+        ("q_join_where",
+         "SELECT o_orderkey, o_totalprice, l_quantity FROM orders "
+         "JOIN lineitem ON o_orderkey = l_orderkey "
+         "WHERE o_totalprice < 100 AND l_quantity > 10",
+         t["orders"].filter(col("o_totalprice") < 100)
+         .join(t["lineitem"].filter(col("l_quantity") > 10),
+               col("o_orderkey") == col("l_orderkey"))
+         .select("o_orderkey", "o_totalprice", "l_quantity")),
+        ("q_revenue_q3_shape",
+         "SELECT o_orderkey, sum(l_extendedprice * (1 - l_discount)) "
+         "AS revenue FROM orders JOIN lineitem "
+         "ON o_orderkey = l_orderkey WHERE o_totalprice < 500 "
+         "GROUP BY o_orderkey ORDER BY revenue DESC LIMIT 10",
+         t["orders"].filter(col("o_totalprice") < 500)
+         .join(t["lineitem"], col("o_orderkey") == col("l_orderkey"))
+         .group_by("o_orderkey").agg(revenue=(rev, "sum"))
+         .sort(("revenue", False)).limit(10)),
+        ("q_case_when",
+         "SELECT l_shipmode, "
+         "  sum(CASE WHEN o_orderpriority IN ('1-URGENT', '2-HIGH') "
+         "      THEN 1 ELSE 0 END) AS high_line_count "
+         "FROM orders JOIN lineitem ON o_orderkey = l_orderkey "
+         "GROUP BY l_shipmode ORDER BY l_shipmode",
+         t["orders"]
+         .join(t["lineitem"], col("o_orderkey") == col("l_orderkey"))
+         .group_by("l_shipmode")
+         .agg(high_line_count=(
+             when(col("o_orderpriority").isin(["1-URGENT", "2-HIGH"]), 1)
+             .otherwise(0), "sum"))
+         .sort("l_shipmode")),
+        ("q_year_extract",
+         "SELECT l_returnflag, count(*) AS n FROM lineitem "
+         "WHERE year(l_shipdate) = 1994 GROUP BY l_returnflag "
+         "ORDER BY l_returnflag",
+         t["lineitem"].filter(year("l_shipdate") == 1994)
+         .group_by("l_returnflag").agg(n=("", "count_all"))
+         .sort("l_returnflag")),
+        ("q_between_like",
+         "SELECT l_orderkey FROM lineitem "
+         "WHERE l_quantity BETWEEN 5 AND 10 AND l_shipmode LIKE 'MA%'",
+         t["lineitem"]
+         .filter((col("l_quantity") >= 5) & (col("l_quantity") <= 10)
+                 & col("l_shipmode").like("MA%"))
+         .select("l_orderkey")),
+        ("q_semi_join",
+         "SELECT o_orderkey FROM orders SEMI JOIN lineitem "
+         "ON o_orderkey = l_orderkey ORDER BY o_orderkey",
+         t["orders"]
+         .join(t["lineitem"], col("o_orderkey") == col("l_orderkey"),
+               how="semi")
+         .select("o_orderkey").sort("o_orderkey")),
+        ("q_anti_join_agg",
+         "SELECT c_mktsegment, count(*) AS numcust FROM customer "
+         "ANTI JOIN orders ON c_custkey = o_custkey "
+         "GROUP BY c_mktsegment ORDER BY c_mktsegment",
+         t["customer"]
+         .join(t["orders"], col("c_custkey") == col("o_custkey"),
+               how="anti")
+         .group_by("c_mktsegment").agg(numcust=("", "count_all"))
+         .sort("c_mktsegment")),
+        ("q_in_subquery",
+         "SELECT c_name, c_acctbal FROM customer WHERE c_custkey IN "
+         "(SELECT o_custkey FROM orders WHERE o_totalprice > 900) "
+         "ORDER BY c_name",
+         t["customer"]
+         .filter(in_subquery(
+             "c_custkey",
+             t["orders"].filter(col("o_totalprice") > 900)
+             .select("o_custkey")))
+         .select("c_name", "c_acctbal").sort("c_name")),
+        ("q_scalar_subquery",
+         "SELECT o_orderkey, o_totalprice FROM orders "
+         "WHERE o_totalprice > (SELECT avg(o_totalprice) AS a "
+         "                      FROM orders) ORDER BY o_orderkey",
+         t["orders"]
+         .filter(col("o_totalprice")
+                 > scalar(t["orders"].agg(a=("o_totalprice", "mean"))))
+         .select("o_orderkey", "o_totalprice").sort("o_orderkey")),
+        ("q_correlated_scalar",
+         "SELECT l.l_orderkey, l.l_quantity FROM lineitem l "
+         "WHERE l.l_quantity > (SELECT avg(l2.l_quantity) AS a "
+         "    FROM lineitem l2 WHERE l2.l_orderkey = l.l_orderkey) "
+         "ORDER BY l_orderkey",
+         t["lineitem"]
+         .filter(col("l_quantity") > scalar(
+             t["lineitem"]
+             .filter(col("l_orderkey") == outer_ref("l_orderkey"))
+             .agg(a=("l_quantity", "mean"))))
+         .select("l_orderkey", "l_quantity").sort("l_orderkey")),
+        ("q_having",
+         "SELECT o_custkey, sum(o_totalprice) AS total FROM orders "
+         "GROUP BY o_custkey HAVING sum(o_totalprice) > 2000 "
+         "ORDER BY total DESC",
+         t["orders"].group_by("o_custkey")
+         .agg(total=("o_totalprice", "sum"))
+         .filter(col("total") > 2000)
+         .sort(("total", False))),
+        ("q_window_rank",
+         "SELECT * FROM ("
+         "  SELECT c_mktsegment, c_name, c_acctbal, "
+         "         rank() OVER (PARTITION BY c_mktsegment "
+         "                      ORDER BY c_acctbal DESC) AS rk "
+         "  FROM customer) ranked "
+         "WHERE rk <= 3 ORDER BY c_mktsegment, rk, c_name",
+         t["customer"]
+         .with_window("rk", "rank", partition_by=["c_mktsegment"],
+                      order_by=[("c_acctbal", False)])
+         .select("c_mktsegment", "c_name", "c_acctbal", "rk")
+         .filter(col("rk") <= 3)
+         .sort("c_mktsegment", "rk", "c_name")),
+    ]
+
+
+def test_corpus_plans_and_answers_match_dsl(env):
+    s, paths = env
+    pairs = _corpus(s, paths)
+    assert len(pairs) >= 10  # the verdict's bar
+    for name, text, dsl in pairs:
+        got = sql(s, text, tables=_tables(s, paths))
+        try:
+            _assert_same(got, dsl, check_order=("ORDER BY" in text
+                                                and "LIMIT" not in text))
+        except AssertionError as e:
+            raise AssertionError(f"{name}: {e}") from e
+
+
+def test_index_rewrites_fire_from_sql(env):
+    """SQL text reaches the same covering-index rewrites as the DSL."""
+    s, paths = env
+    ds = sql(s, "SELECT o_orderkey, o_totalprice, l_quantity FROM orders "
+                "JOIN lineitem ON o_orderkey = l_orderkey",
+             tables=_tables(s, paths))
+    plan = ds.optimized_plan()
+    used = [sc for sc in plan.leaf_relations() if sc.relation.index_scan_of]
+    assert len(used) == 2, plan.tree_string()
+
+
+def test_answers_match_pandas(env):
+    s, paths = env
+    got = sql(s, "SELECT l_returnflag, sum(l_quantity) AS q FROM lineitem "
+                 "GROUP BY l_returnflag ORDER BY l_returnflag",
+              tables=_tables(s, paths)).collect().to_pandas()
+    df = pd.read_parquet(paths["lineitem"])
+    want = df.groupby("l_returnflag")["l_quantity"].sum().reset_index()
+    np.testing.assert_array_equal(got["q"], want["l_quantity"])
+
+
+class TestErrors:
+    def test_unknown_table(self, env):
+        s, paths = env
+        with pytest.raises(SqlError, match="Unknown table"):
+            sql(s, "SELECT a FROM nope", tables={})
+
+    def test_exists_hint(self, env):
+        s, paths = env
+        with pytest.raises(SqlError, match="SEMI JOIN"):
+            sql(s, "SELECT o_orderkey FROM orders WHERE EXISTS "
+                   "(SELECT 1 FROM lineitem)", tables=_tables(s, paths))
+
+    def test_trailing_garbage(self, env):
+        s, paths = env
+        with pytest.raises(SqlError, match="trailing"):
+            sql(s, "SELECT o_orderkey FROM orders extra nonsense ; ",
+                tables=_tables(s, paths))
+
+    def test_unknown_alias(self, env):
+        s, paths = env
+        with pytest.raises(SqlError, match="Unknown table alias"):
+            sql(s, "SELECT x.o_orderkey FROM orders o",
+                tables=_tables(s, paths))
+
+    def test_nonagg_select_item_not_group_key(self, env):
+        s, paths = env
+        with pytest.raises(SqlError, match="GROUP BY key"):
+            sql(s, "SELECT o_custkey, o_totalprice FROM orders "
+                   "GROUP BY o_custkey", tables=_tables(s, paths))
+
+    def test_position_in_error(self, env):
+        s, paths = env
+        with pytest.raises(SqlError, match="position"):
+            sql(s, "SELECT FROM orders", tables=_tables(s, paths))
+
+
+class TestReviewFixes:
+    def test_ambiguous_qualified_column_rejected(self, tmp_path):
+        s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+        for name in ("a", "b"):
+            d = str(tmp_path / name)
+            os.makedirs(d)
+            pq.write_table(pa.table({
+                "k": pa.array([1, 2, 3], type=pa.int64()),
+                "x": pa.array([10, 20, 30], type=pa.int64())}),
+                os.path.join(d, "p.parquet"))
+        tabs = {"a": s.read.parquet(str(tmp_path / "a")),
+                "b": s.read.parquet(str(tmp_path / "b"))}
+        with pytest.raises(SqlError, match="Ambiguous"):
+            sql(s, "SELECT a.k FROM a JOIN b ON a.k = b.k "
+                   "WHERE b.x > 20", tables=tabs)
+        # Left-bound qualified refs still work.
+        n = sql(s, "SELECT a.k FROM a JOIN b ON a.k = b.k "
+                   "WHERE a.x > 20", tables=tabs).count()
+        assert n == 1
+        with pytest.raises(SqlError, match="does not exist"):
+            sql(s, "SELECT a.nope FROM a", tables=tabs)
+
+    def test_full_outer_join(self, env):
+        s, paths = env
+        ds = sql(s, "SELECT c_custkey, o_orderkey FROM customer "
+                    "FULL OUTER JOIN orders ON c_custkey = o_custkey",
+                 tables=_tables(s, paths))
+        assert ds.collect().num_rows > 0
+
+    def test_negative_literals_in_in_list(self, env):
+        s, paths = env
+        n = sql(s, "SELECT o_orderkey FROM orders WHERE o_orderkey "
+                   "IN (-1, 3, 5)", tables=_tables(s, paths)).count()
+        assert n == 2
+
+    def test_nested_window_call_rejected(self, env):
+        s, paths = env
+        with pytest.raises(SqlError, match="top-level"):
+            sql(s, "SELECT row_number() OVER (ORDER BY o_orderkey) + 0 "
+                   "AS r FROM orders", tables=_tables(s, paths))
