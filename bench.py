@@ -223,6 +223,143 @@ def _kernel_microbench() -> dict:
     return out
 
 
+N_ORDERS_SF10 = 15_000_000
+N_LINEITEM_SF10 = 60_000_000
+SF10_FILES = 64
+SF10_REPEATS = 2
+# The SF10 section self-skips when the SF1 portion already consumed this
+# much wall-clock (a degraded tunnel day must not kill the whole bench).
+SF10_TIME_BUDGET_S = float(os.environ.get("HS_BENCH_SF10_BUDGET", "2400"))
+
+
+def _peak_rss_mb() -> float:
+    import resource
+
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def _sf10_section(session, hs, root: str, tables_equal) -> dict:
+    """SF10-scale credibility step (round-3 verdict item 6): a 60M-row
+    lineitem through the streaming spill build, then the headline query
+    shapes with the same answer-equality gates.  Generation and reads are
+    per-file so peak memory stays bounded; the spill build's peak RSS is
+    recorded."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from hyperspace_tpu import DataSkippingIndexConfig, IndexConfig, col
+
+    out: dict = {"lineitem_rows": N_LINEITEM_SF10,
+                 "orders_rows": N_ORDERS_SF10,
+                 "files_per_table": SF10_FILES, "reps": SF10_REPEATS}
+    li_dir = os.path.join(root, "sf10_lineitem")
+    ord_dir = os.path.join(root, "sf10_orders")
+    os.makedirs(li_dir)
+    os.makedirs(ord_dir)
+
+    t0 = time.perf_counter()
+    rng = np.random.default_rng(17)
+    per_li = -(-N_LINEITEM_SF10 // SF10_FILES)
+    per_ord = -(-N_ORDERS_SF10 // SF10_FILES)
+    for f in range(SF10_FILES):
+        n = min(per_li, N_LINEITEM_SF10 - f * per_li)
+        base = f * per_li
+        pq.write_table(pa.table({
+            "l_orderkey": rng.integers(0, N_ORDERS_SF10, n),
+            "l_quantity": rng.integers(1, 50, n).astype(np.float64),
+            "l_extendedprice": rng.random(n) * 1e4,
+            "l_discount": rng.random(n) * 0.1,
+            # Monotone across the dataset: per-file sketch ranges stay
+            # narrow, like any time-correlated ingest.
+            "l_shipdate": np.arange(base, base + n, dtype=np.int64),
+            "l_pad0": rng.random(n),
+            "l_pad1": rng.random(n),
+        }), os.path.join(li_dir, f"part-{f:05d}.parquet"))
+        n_o = min(per_ord, N_ORDERS_SF10 - f * per_ord)
+        pq.write_table(pa.table({
+            "o_orderkey": np.arange(f * per_ord, f * per_ord + n_o,
+                                    dtype=np.int64),
+            "o_custkey": rng.integers(0, 200_000, n_o),
+            "o_totalprice": rng.random(n_o) * 1e5,
+        }), os.path.join(ord_dir, f"part-{f:05d}.parquet"))
+    out["datagen_s"] = round(time.perf_counter() - t0, 2)
+
+    rss_before = _peak_rss_mb()
+    t0 = time.perf_counter()
+    phases_before = len(getattr(session, "build_stats_log", []))
+    hs.create_index(session.read.parquet(li_dir),
+                    IndexConfig("sf10_li", ["l_orderkey"],
+                                ["l_quantity", "l_extendedprice",
+                                 "l_discount", "l_shipdate"]))
+    hs.create_index(session.read.parquet(ord_dir),
+                    IndexConfig("sf10_ord", ["o_orderkey"],
+                                ["o_custkey", "o_totalprice"]))
+    hs.create_index(session.read.parquet(li_dir),
+                    DataSkippingIndexConfig("sf10_ds", ["l_shipdate"]))
+    out["index_build_s"] = round(time.perf_counter() - t0, 2)
+    out["build_phases"] = getattr(session, "build_stats_log",
+                                  [])[phases_before:]
+    out["build_peak_rss_mb"] = round(_peak_rss_mb(), 1)
+    out["peak_rss_before_build_mb"] = round(rss_before, 1)
+
+    probe_key = 1_234_567
+
+    def q_filter():
+        return (session.read.parquet(li_dir)
+                .filter(col("l_orderkey") == probe_key)
+                .select("l_orderkey", "l_quantity").collect())
+
+    def q_ds_range():
+        lo, hi = 3_000_000, 3_900_000
+        return (session.read.parquet(li_dir)
+                .filter((col("l_shipdate") >= lo) & (col("l_shipdate") < hi))
+                .select("l_shipdate", "l_extendedprice").collect())
+
+    def q_q3():
+        return (session.read.parquet(ord_dir)
+                .filter(col("o_totalprice") < 2_000.0)
+                .join(session.read.parquet(li_dir),
+                      col("o_orderkey") == col("l_orderkey"))
+                .group_by("o_custkey")
+                .agg(revenue=(col("l_extendedprice")
+                              * (1 - col("l_discount")), "sum"))
+                .sort(("revenue", False)).limit(10).collect())
+
+    def q_q10():
+        return (session.read.parquet(li_dir)
+                .filter((col("l_shipdate") >= 10_000_000)
+                        & (col("l_shipdate") < 25_000_000))
+                .join(session.read.parquet(ord_dir),
+                      col("l_orderkey") == col("o_orderkey"))
+                .group_by("o_custkey")
+                .agg(revenue=(col("l_extendedprice")
+                              * (1 - col("l_discount")), "sum"))
+                .sort(("revenue", False)).limit(20).collect())
+
+    speedups = {}
+    for name, q in (("filter", q_filter), ("ds_range", q_ds_range),
+                    ("q3_shape", q_q3), ("q10_shape", q_q10)):
+        session.disable_hyperspace()
+        expected = q()
+        base = _time(q, repeats=SF10_REPEATS)
+        session.enable_hyperspace()
+        got = q()
+        if not tables_equal(got, expected):
+            raise SystemExit(f"sf10 {name}: indexed answer diverged")
+        idx = _time(q, repeats=SF10_REPEATS)
+        out[f"{name}_scan_s"] = {k: round(v, 4) if isinstance(v, float)
+                                 else v for k, v in base.items()}
+        out[f"{name}_indexed_s"] = {k: round(v, 4) if isinstance(v, float)
+                                    else v for k, v in idx.items()}
+        speedups[name] = base["median"] / idx["median"]
+        out[f"{name}_speedup"] = round(speedups[name], 3)
+    out["geomean_speedup"] = round(math.exp(
+        sum(math.log(s) for s in speedups.values()) / len(speedups)), 3)
+    out["query_peak_rss_mb"] = round(_peak_rss_mb(), 1)
+    return out
+
+
 def _pin_backend() -> None:
     """Use the default backend (real TPU when attached); fall back to CPU if
     the accelerator is unreachable so the bench always produces its line.
@@ -250,6 +387,7 @@ def _pin_backend() -> None:
 
 
 def main() -> None:
+    bench_t0 = time.perf_counter()
     _pin_backend()
     from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
 
@@ -658,6 +796,25 @@ def main() -> None:
         # sketch seconds) — session.build_stats_log is appended by every
         # CreateActionBase build.
         detail["index_build_phases"] = getattr(session, "build_stats_log", [])
+
+        # SF10 scale step (round-3 verdict item 6): runs unless the SF1
+        # portion already burned the time budget (degraded-tunnel guard)
+        # or HS_BENCH_SF10=0.
+        elapsed = time.perf_counter() - bench_t0
+        if os.environ.get("HS_BENCH_SF10", "1") == "0":
+            detail["sf10"] = {"skipped": "HS_BENCH_SF10=0"}
+        elif elapsed > SF10_TIME_BUDGET_S:
+            detail["sf10"] = {
+                "skipped": f"SF1 portion took {elapsed:.0f}s > "
+                           f"{SF10_TIME_BUDGET_S:.0f}s budget"}
+        else:
+            try:
+                detail["sf10"] = _sf10_section(session, hs, root,
+                                               _tables_equal)
+            except SystemExit:
+                raise  # correctness-gate failures must fail the bench
+            except Exception as e:  # resource exhaustion must not
+                detail["sf10"] = {"skipped": f"{type(e).__name__}: {e}"}
         detail["platform"] = _platform()
         line = {
             "metric": "tpch_sf1_indexed_query_speedup_geomean",

@@ -28,7 +28,13 @@ On real multi-host pods, call ``initialize_distributed()`` first (one
 process per host; jax.distributed wires the DCN coordinator), then
 ``build_mesh_2d(n_slices, chips_per_slice)``.  Single-host validation uses
 the same code over virtual CPU devices (tests/test_parallel.py runs 2x4
-and 4x2 meshes).
+and 4x2 meshes); tests/test_multiprocess.py additionally wires TWO OS
+processes through ``initialize_distributed`` over CPU and runs the
+two-stage all_to_all pattern across the process boundary.  Remaining
+pod-only gap: ``hierarchical_bucket_shuffle`` takes process-local numpy
+inputs, so multi-process runs must feed each host its own shard (the
+natural pod usage); the single entry point has not been driven end-to-end
+across processes in this environment.
 """
 
 from __future__ import annotations

@@ -493,6 +493,17 @@ def split_conjuncts(e: Expr) -> List[Expr]:
     return [e]
 
 
+def conjoin(conjuncts: Sequence[Expr]) -> Expr:
+    """Left-fold a non-empty conjunct list back into one And chain —
+    split_conjuncts' inverse."""
+    if not conjuncts:
+        raise ValueError("conjoin needs at least one conjunct")
+    cond = conjuncts[0]
+    for c in conjuncts[1:]:
+        cond = And(cond, c)
+    return cond
+
+
 def as_equi_join_pairs(condition: Expr) -> Union[List[tuple], None]:
     """If ``condition`` is a CNF of column==column equalities, return the
     (left_name, right_name) pairs; else None (JoinIndexRule.scala:134-166)."""

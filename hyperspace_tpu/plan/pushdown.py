@@ -28,7 +28,7 @@ from __future__ import annotations
 
 from typing import Callable, List, Optional
 
-from hyperspace_tpu.plan.expr import And, Expr, split_conjuncts
+from hyperspace_tpu.plan.expr import And, Expr, conjoin, split_conjuncts
 from hyperspace_tpu.plan.nodes import (
     Filter,
     Join,
@@ -36,13 +36,6 @@ from hyperspace_tpu.plan.nodes import (
     Project,
 )
 from hyperspace_tpu.utils.resolver import resolve
-
-
-def _conjoin(conjuncts: List[Expr]) -> Expr:
-    cond = conjuncts[0]
-    for c in conjuncts[1:]:
-        cond = And(cond, c)
-    return cond
 
 
 def push_filters(plan: LogicalPlan, schema_of: Callable) -> LogicalPlan:
@@ -81,7 +74,13 @@ def _push_one(node: Filter, schema_of: Callable) -> LogicalPlan:
                 kept.append(conj)  # constant predicates stay put
             elif sides[0] and resolve(refs, left_cols) is not None:
                 left_pushed.append(conj)
-            elif sides[1] and resolve(refs, right_cols) is not None:
+            elif sides[1] and resolve(refs, right_cols) is not None \
+                    and (sides[0]
+                         or resolve(refs, left_cols) is None):
+                # RIGHT joins can only push right; a name that ALSO
+                # resolves on the left binds to the LEFT copy in the
+                # joined output (execution renames the right duplicate),
+                # so pushing it right would filter the wrong column.
                 right_pushed.append(conj)
             else:
                 kept.append(conj)
@@ -89,16 +88,16 @@ def _push_one(node: Filter, schema_of: Callable) -> LogicalPlan:
             return node
         new_left = child.left
         if left_pushed:
-            new_left = _push_one(Filter(_conjoin(left_pushed), new_left),
+            new_left = _push_one(Filter(conjoin(left_pushed), new_left),
                                  schema_of)
         new_right = child.right
         if right_pushed:
-            new_right = _push_one(Filter(_conjoin(right_pushed), new_right),
+            new_right = _push_one(Filter(conjoin(right_pushed), new_right),
                                   schema_of)
         out: LogicalPlan = Join(new_left, new_right, child.condition,
                                 child.how)
         if kept:
-            out = Filter(_conjoin(kept), out)
+            out = Filter(conjoin(kept), out)
         return out
     return node
 

@@ -42,6 +42,7 @@ from typing import List, Optional, Tuple
 from hyperspace_tpu.plan.expr import (
     And,
     Arith,
+    conjoin,
     BinOp,
     Case,
     Cast,
@@ -238,10 +239,7 @@ def _split_correlations(plan: LogicalPlan):
                 keep.append(conj)
         if not keep:
             return node.child
-        cond = keep[0]
-        for c in keep[1:]:
-            cond = And(cond, c)
-        return Filter(cond, node.child)
+        return Filter(conjoin(keep), node.child)
 
     return strip(plan), pairs
 
@@ -356,10 +354,7 @@ def _rewrite_filter(node: Filter, session, state) -> LogicalPlan:
     def rebuild(remaining: List[Expr], child: LogicalPlan) -> LogicalPlan:
         if not remaining:
             return child
-        cond = remaining[0]
-        for c in remaining[1:]:
-            cond = And(cond, c)
-        return Filter(cond, child)
+        return Filter(conjoin(remaining), child)
 
     for idx, conj in enumerate(conjuncts):
         rest = conjuncts[:idx] + conjuncts[idx + 1:]

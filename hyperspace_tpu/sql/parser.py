@@ -623,6 +623,8 @@ class _Parser:
             self.expect_op(")")
             if name not in _WINDOW_FUNCS:
                 self.fail(f"Unsupported window function {name}")
+            if distinct:
+                self.fail("DISTINCT is not supported in window functions")
             func = {"avg": "mean"}.get(name, name)
             value = None
             if func in ("sum", "min", "max", "mean", "count") \
@@ -676,25 +678,30 @@ def _lower(p: _Parser, ds, items, distinct, where, group_by, having,
                   if e is not None and not isinstance(e, _WindowCall))
     aggregate_query = bool(group_by) or has_agg
 
-    window_items = [(a, e) for a, e in items
-                    if isinstance(e, _WindowCall)]
-    plain_items = [(a, e) for a, e in items
-                   if not isinstance(e, _WindowCall) and e is not None]
-
-    select_names: List[str] = []
-    select_computed: List[Tuple[str, Expr]] = []
+    # Output in SELECT-LIST ORDER: (name, None) for a plain column of the
+    # current dataset, (name, expr) for a computed output.
+    out_items: List[Tuple[str, Optional[Expr]]] = []
+    windows_to_apply: List[Tuple[str, _WindowCall]] = []
 
     if aggregate_query:
+        if star:
+            raise SqlError("SELECT * cannot be combined with GROUP "
+                           "BY/aggregates; list the outputs")
         # Group keys: plain columns, or references to computed select
         # aliases (SELECT year(d) AS y ... GROUP BY y) which materialize
         # as with_column first.
-        alias_exprs = {a: e for a, e in plain_items
-                       if a is not None and not _contains_agg(e)}
+        alias_exprs = {a: e for a, e in items
+                       if a is not None and e is not None
+                       and not isinstance(e, _WindowCall)
+                       and not _contains_agg(e)}
         keys: List[str] = []
         for k in group_by:
             if isinstance(k, Col):
-                if k.name in alias_exprs \
-                        and not isinstance(alias_exprs[k.name], Col):
+                if k.name in alias_exprs and not (
+                        isinstance(alias_exprs[k.name], Col)
+                        and alias_exprs[k.name].name == k.name):
+                    # Renaming aliases (x AS g) materialize too — the
+                    # group key must exist under the alias name.
                     ds = ds.with_column(k.name, alias_exprs[k.name])
                 keys.append(k.name)
             else:
@@ -715,10 +722,17 @@ def _lower(p: _Parser, ds, items, distinct, where, group_by, having,
             agg_specs[name] = (inp, call.func)
             return name
 
-        for alias, e in plain_items:
+        for alias, e in items:
+            if e is None:
+                continue
+            if isinstance(e, _WindowCall):
+                if alias is None:
+                    raise SqlError("Window select items need AS aliases")
+                windows_to_apply.append((alias, e))
+                out_items.append((alias, None))
+                continue
             if isinstance(e, _AggCall):
-                out = agg_name(e, alias)
-                select_names.append(out)
+                out_items.append((agg_name(e, alias), None))
                 continue
             if _contains_agg(e):
                 if alias is None:
@@ -729,7 +743,7 @@ def _lower(p: _Parser, ds, items, distinct, where, group_by, having,
                              if isinstance(x, _AggCall) else x)
                 _reject_markers(new_e, "SELECT expressions",
                                 (_WindowCall,))
-                select_computed.append((alias, new_e))
+                out_items.append((alias, new_e))
                 continue
             # Non-aggregate item: must be a group key (or its alias).
             name = alias or (e.name if isinstance(e, Col) else None)
@@ -737,7 +751,7 @@ def _lower(p: _Parser, ds, items, distinct, where, group_by, having,
                 raise SqlError(
                     f"Select item {e!r} is neither aggregated nor a "
                     f"GROUP BY key")
-            select_names.append(name)
+            out_items.append((name, None))
         if not keys:
             ds = ds.agg(**agg_specs)
         else:
@@ -767,41 +781,54 @@ def _lower(p: _Parser, ds, items, distinct, where, group_by, having,
         if having is not None:
             raise SqlError("HAVING without GROUP BY/aggregates")
         if not star:
-            for alias, e in plain_items:
-                if isinstance(e, Col) and alias is None:
-                    select_names.append(e.name)
+            for alias, e in items:
+                if e is None:
+                    continue
+                if isinstance(e, _WindowCall):
+                    if alias is None:
+                        raise SqlError(
+                            "Window select items need AS aliases")
+                    windows_to_apply.append((alias, e))
+                    out_items.append((alias, None))
+                elif isinstance(e, Col) and alias is None:
+                    out_items.append((e.name, None))
                 elif alias is not None:
                     _reject_markers(e, "SELECT expressions",
                                     (_WindowCall,))
-                    select_computed.append((alias, e))
+                    out_items.append((alias, e))
                 else:
                     raise SqlError(
                         f"Computed select items need AS aliases: {e!r}")
 
-    for alias, w in window_items:
-        if alias is None:
-            raise SqlError("Window select items need AS aliases")
+    for alias, w in windows_to_apply:
         ds = ds.with_window(alias, w.func, partition_by=w.partition_by,
                             order_by=w.order_by, value=w.value)
-        select_names.append(alias)
 
-    if not star and (select_names or select_computed):
-        kwargs = dict(select_computed)
-        overlap = set(select_names) & set(kwargs)
-        if overlap:
-            raise SqlError(f"Duplicate select output names: {overlap}")
-        # Skip a no-op projection (SELECT exactly the current output, in
-        # order): keeps plans identical to DSL forms that never wrote a
-        # select — and leaves subquery plans as bare Aggregates, the
-        # shape the correlated-scalar rewrite requires.
-        noop = not kwargs
-        if noop:
+    if not star and out_items:
+        names = [n for n, _e in out_items]
+        if len(set(names)) != len(names):
+            raise SqlError(f"Duplicate select output names: {names}")
+        if all(e is None for _n, e in out_items):
+            # Skip a no-op projection (SELECT exactly the current
+            # output, in order): keeps plans identical to DSL forms
+            # that never wrote a select — and leaves subquery plans as
+            # bare Aggregates, the shape the correlated-scalar rewrite
+            # requires.
             try:
-                noop = ds.columns == select_names
+                noop = ds.columns == names
             except Exception:
                 noop = False
-        if not noop:
-            ds = ds.select(*select_names, **kwargs)
+            if not noop:
+                ds = ds.select(*names)
+        else:
+            # Computed outputs interleave with plain ones: build the
+            # Compute in SELECT-LIST order (Dataset.select's
+            # names-then-keywords signature would reorder them).
+            from hyperspace_tpu.dataset import Dataset
+            from hyperspace_tpu.plan.nodes import Compute
+
+            exprs = [(n, Col(n) if e is None else e) for n, e in out_items]
+            ds = Dataset(Compute(exprs, ds.plan), ds.session)
     if distinct:
         ds = ds.distinct()
     if order_by:

