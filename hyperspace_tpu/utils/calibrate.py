@@ -181,10 +181,18 @@ def _probe_transfer() -> "tuple[str, float, float, float]":
 
     h2d()  # warm
     t_h2d = max(_median_time(h2d) - latency / 2, 1e-9)
-    resident = jax.device_put(big, dev)
-    resident.block_until_ready()
-    t_d2h = max(_median_time(lambda: np.asarray(resident)) - latency / 2,
-                1e-9)
+    # d2h: jax arrays CACHE their fetched host copy, so each timed pull
+    # must read a DISTINCT resident array or the probe measures a cache
+    # hit (observed as an absurd quarter-TB/s on a 4 MB/s tunnel).
+    residents = [jax.device_put(big + np.float32(i), dev).block_until_ready()
+                 for i in range(3)]
+    times = []
+    for r in residents:
+        t0 = time.perf_counter()
+        np.asarray(r)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    t_d2h = max(times[len(times) // 2] - latency / 2, 1e-9)
     return dev.platform, latency, nbytes / t_h2d, nbytes / t_d2h
 
 
