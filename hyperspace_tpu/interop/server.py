@@ -60,12 +60,32 @@ class _Handler(socketserver.StreamRequestHandler):
                     f"request exceeds {MAX_REQUEST_BYTES} bytes or is not "
                     f"newline-terminated")
             spec = json.loads(line.decode("utf-8"))
-            from hyperspace_tpu.interop.query import dataset_from_spec
-
+            if not isinstance(spec, dict):
+                # A bare JSON string/array is valid JSON — and `"sql" in
+                # spec` on a string would substring-match.
+                raise ValueError("request must be a JSON object")
             # Concurrent execution is safe: the session serializes its
             # OPTIMIZE step internally (shared entry tags / schema memo);
             # the executor itself only reads shared state.
-            table = dataset_from_spec(self.server.session, spec).collect()
+            if "sql" in spec:
+                # {"sql": "SELECT ...", "tables": {name: parquet_dir}} —
+                # SQL text over the wire, the reference corpus's native
+                # form (goldstandard/PlanStabilitySuite.scala:81-283).
+                from hyperspace_tpu.sql import sql as run_sql
+
+                tables = spec.get("tables", {})
+                if not isinstance(tables, dict) or not all(
+                        isinstance(v, str) for v in tables.values()):
+                    raise ValueError(
+                        '"tables" must map names to parquet directory '
+                        'paths over the wire')
+                table = run_sql(self.server.session, spec["sql"],
+                                tables=tables).collect()
+            else:
+                from hyperspace_tpu.interop.query import dataset_from_spec
+
+                table = dataset_from_spec(
+                    self.server.session, spec).collect()
         except Exception as exc:  # -> wire error, connection closes
             msg = str(exc).replace("\n", " ")[:500]
             try:

@@ -317,3 +317,44 @@ def test_spec_subqueries(env, tmp_path):
                    "right": {"op": "scalar_subquery", "query": mx}},
     }).collect()
     assert out2.num_rows == 1000 - 4  # k in 4..999
+
+
+def test_sql_over_the_wire(env):
+    """{"sql": ..., "tables": {...}} requests run the SQL front end
+    against the server's session — the reference corpus's native form."""
+    from hyperspace_tpu.interop.server import QueryServer, request_query
+
+    s, data = env
+    hs = Hyperspace(s)
+    hs.create_index(s.read.parquet(data),
+                    IndexConfig("wire_sql_ix", ["k"], ["v"]))
+    s.enable_hyperspace()
+    with QueryServer(s) as server:
+        out = request_query(server.address, {
+            "sql": "SELECT k, v FROM t WHERE k = 7",
+            "tables": {"t": data},
+        })
+        assert out.column("k").to_pylist() == [7]
+        # Aggregates + ORDER BY over the wire.
+        out2 = request_query(server.address, {
+            "sql": "SELECT name, sum(v) AS total FROM t GROUP BY name "
+                   "ORDER BY name LIMIT 3",
+            "tables": {"t": data},
+        })
+        assert out2.column_names == ["name", "total"]
+        assert out2.num_rows == 3
+        # Errors surface as wire errors, not crashes.
+        import pytest as _pytest
+
+        with _pytest.raises(RuntimeError, match="Unknown table"):
+            request_query(server.address, {"sql": "SELECT x FROM nope",
+                                           "tables": {}})
+
+
+def test_non_object_request_clear_error(env):
+    from hyperspace_tpu.interop.server import QueryServer, request_query
+
+    s, _data = env
+    with QueryServer(s) as server:
+        with pytest.raises(RuntimeError, match="JSON object"):
+            request_query(server.address, "run sql please")
