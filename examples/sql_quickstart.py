@@ -1,0 +1,78 @@
+"""SQL front-end quickstart: index once, query with SELECT text.
+
+Run: python examples/sql_quickstart.py
+(On a machine without an accelerator, JAX falls back to CPU.)
+"""
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig
+from hyperspace_tpu.sql import sql
+
+
+def main() -> None:
+    root = tempfile.mkdtemp(prefix="hs_sql_example_")
+    orders_dir = os.path.join(root, "orders")
+    lineitem_dir = os.path.join(root, "lineitem")
+    os.makedirs(orders_dir)
+    os.makedirs(lineitem_dir)
+    rng = np.random.default_rng(0)
+    n_o, n_l = 50_000, 200_000
+    pq.write_table(pa.table({
+        "o_orderkey": np.arange(n_o, dtype=np.int64),
+        "o_totalprice": np.round(rng.uniform(1, 1000, n_o), 2),
+        "o_orderdate": (np.datetime64("1995-01-01")
+                        + rng.integers(0, 1000, n_o)
+                        .astype("timedelta64[D]")),
+    }), os.path.join(orders_dir, "part-0.parquet"))
+    pq.write_table(pa.table({
+        "l_orderkey": rng.integers(0, n_o, n_l),
+        "l_quantity": rng.integers(1, 50, n_l),
+        "l_extendedprice": np.round(rng.uniform(1, 1000, n_l), 2),
+        "l_discount": np.round(rng.uniform(0, 0.1, n_l), 3),
+    }), os.path.join(lineitem_dir, "part-0.parquet"))
+
+    session = HyperspaceSession(system_path=os.path.join(root, "indexes"))
+    hs = Hyperspace(session)
+    hs.create_index(session.read.parquet(lineitem_dir),
+                    IndexConfig("li", ["l_orderkey"],
+                                ["l_quantity", "l_extendedprice",
+                                 "l_discount"]))
+    hs.create_index(session.read.parquet(orders_dir),
+                    IndexConfig("ord", ["o_orderkey"],
+                                ["o_totalprice", "o_orderdate"]))
+    session.enable_hyperspace()
+    tables = {"orders": orders_dir, "lineitem": lineitem_dir}
+
+    ds = sql(session, """
+        SELECT o_orderkey,
+               sum(l_extendedprice * (1 - l_discount)) AS revenue
+        FROM orders JOIN lineitem ON o_orderkey = l_orderkey
+        WHERE o_totalprice < 250 AND year(o_orderdate) = 1996
+        GROUP BY o_orderkey
+        ORDER BY revenue DESC
+        LIMIT 5;
+    """, tables=tables)
+    print(ds.optimized_plan().tree_string())
+    print(ds.collect().to_pandas())
+
+    top = sql(session, """
+        SELECT * FROM (
+            SELECT o_orderkey, o_totalprice,
+                   rank() OVER (ORDER BY o_totalprice DESC) AS rk
+            FROM orders) ranked
+        WHERE rk <= 3 ORDER BY rk;
+    """, tables=tables)
+    print(top.collect().to_pandas())
+
+
+if __name__ == "__main__":
+    main()

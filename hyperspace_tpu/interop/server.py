@@ -73,6 +73,8 @@ class _Handler(socketserver.StreamRequestHandler):
                 # form (goldstandard/PlanStabilitySuite.scala:81-283).
                 from hyperspace_tpu.sql import sql as run_sql
 
+                if not isinstance(spec["sql"], str):
+                    raise ValueError('"sql" must be a string')
                 tables = spec.get("tables", {})
                 if not isinstance(tables, dict) or not all(
                         isinstance(v, str) for v in tables.values()):

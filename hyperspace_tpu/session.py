@@ -241,12 +241,6 @@ class HyperspaceSession:
         prev_memo = self._lake_schema_memo
         self._lake_schema_memo = {}
         try:
-            # year(col)-style predicates over temporal scan columns become
-            # raw ranges FIRST (plan/temporal.py): the rules' pruning
-            # analyses and the device kernel only understand ranges.
-            from hyperspace_tpu.plan.temporal import canonicalize_temporal
-
-            plan = canonicalize_temporal(plan, self.schema_map_of)
             # WHERE conjuncts sink to the side/scan they constrain
             # (Catalyst's PredicatePushdown role) — required for the SQL
             # front end's canonical filter-above-joins form to reach the
@@ -254,6 +248,14 @@ class HyperspaceSession:
             from hyperspace_tpu.plan.pushdown import push_filters
 
             plan = push_filters(plan, self.schema_of)
+            # THEN year(col)-style predicates over temporal scan columns
+            # become raw ranges (plan/temporal.py): canonicalization needs
+            # the filter sitting over its scan to see the column type, so
+            # it must follow pushdown or SQL-shaped filters-above-joins
+            # would keep their opaque Extracts.
+            from hyperspace_tpu.plan.temporal import canonicalize_temporal
+
+            plan = canonicalize_temporal(plan, self.schema_map_of)
             plan = prune_columns(plan, self.schema_of)
             if not self._hyperspace_enabled:
                 return plan

@@ -344,9 +344,7 @@ def test_sql_over_the_wire(env):
         assert out2.column_names == ["name", "total"]
         assert out2.num_rows == 3
         # Errors surface as wire errors, not crashes.
-        import pytest as _pytest
-
-        with _pytest.raises(RuntimeError, match="Unknown table"):
+        with pytest.raises(RuntimeError, match="Unknown table"):
             request_query(server.address, {"sql": "SELECT x FROM nope",
                                            "tables": {}})
 

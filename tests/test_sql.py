@@ -398,3 +398,16 @@ class TestSecondReviewFixes:
         got = ds.collect()
         assert got.num_rows == 2
         assert sorted(got.column("x").to_pylist()) == [20, 30]
+
+
+def test_year_predicate_canonicalizes_through_join(env):
+    """year() in a WHERE above a join must still canonicalize to a date
+    range after pushdown (pass ordering: pushdown BEFORE temporal)."""
+    s, paths = env
+    ds = sql(s, "SELECT o_orderkey FROM orders JOIN lineitem "
+                "ON o_orderkey = l_orderkey "
+                "WHERE year(o_orderdate) = 1995",
+             tables=_tables(s, paths))
+    tree = ds.optimized_plan().tree_string()
+    assert "year(" not in tree, tree
+    assert "datetime.date(1995, 1, 1)" in tree
