@@ -18,6 +18,7 @@ from hyperspace_tpu.index.index_config import DataSkippingIndexConfig, IndexConf
 from hyperspace_tpu.plan.expr import (
     col,
     dayofmonth,
+    exists,
     in_subquery,
     lit,
     month,
@@ -49,4 +50,5 @@ __all__ = [
     "scalar",
     "in_subquery",
     "outer_ref",
+    "exists",
 ]

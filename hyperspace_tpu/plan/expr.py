@@ -426,6 +426,26 @@ class OuterRef(Expr):
         return f"outer_ref({self.name!r})"
 
 
+class Exists(Expr):
+    """``EXISTS (SELECT ... [WHERE inner == outer_ref(...)])`` — rewritten
+    at optimize time (plan/subquery.py): correlated forms become SEMI
+    joins on the correlation equalities (``~exists`` -> ANTI), matching
+    the rewrite SQL engines apply; uncorrelated forms probe once and fold
+    to TRUE/FALSE.  Only row EXISTENCE matters, so the subquery's own
+    projection is discarded (``SELECT 1`` works)."""
+
+    def __init__(self, plan) -> None:
+        self.plan = getattr(plan, "plan", plan)
+
+    def __repr__(self) -> str:
+        return f"exists({type(self.plan).__name__})"
+
+
+def exists(ds) -> Exists:
+    """EXISTS predicate: ``filter(exists(sub))`` / ``filter(~exists(sub))``."""
+    return Exists(ds)
+
+
 def scalar(ds) -> ScalarSubquery:
     """Scalar subquery: ``filter(col('v') > scalar(sub) * 1.2)``."""
     return ScalarSubquery(ds)

@@ -42,6 +42,7 @@ from typing import List, Optional, Tuple
 from hyperspace_tpu.plan.expr import (
     And,
     Arith,
+    Exists,
     conjoin,
     BinOp,
     Case,
@@ -63,11 +64,17 @@ from hyperspace_tpu.plan.expr import (
 )
 from hyperspace_tpu.plan.nodes import (
     Aggregate,
+    BucketUnion,
     Compute,
+    Distinct,
     Filter,
     Join,
+    Limit,
     LogicalPlan,
     Project,
+    Sort,
+    Union,
+    Window,
 )
 
 
@@ -95,7 +102,7 @@ def _contains(e: Expr, kinds) -> bool:
 
 def _plan_has_subqueries(plan: LogicalPlan) -> bool:
     for e in _plan_exprs(plan):
-        if _contains(e, (ScalarSubquery, InSubquery, OuterRef)):
+        if _contains(e, (ScalarSubquery, InSubquery, OuterRef, Exists)):
             return True
     return any(_plan_has_subqueries(c) for c in plan.children)
 
@@ -215,12 +222,57 @@ def _fold_scalar(sub: LogicalPlan, session) -> Lit:
     return Lit(table.column(0)[0].as_py())
 
 
+def _simplify_exists(plan: LogicalPlan):
+    """Existence-simplify an EXISTS subplan top-down.  Returns one of
+    ("always", None)  — the subplan yields >=1 row for EVERY outer row
+                        (a global aggregate always emits exactly one),
+    ("empty", None)   — it can never yield a row (LIMIT 0),
+    ("plan", p)       — check existence of ``p``.
+    Shedding rules: Project/Compute/Sort shape columns or order only;
+    DISTINCT preserves existence; LIMIT n>=1 preserves PER-OUTER-ROW
+    existence (SQL's common ``EXISTS (... LIMIT 1)`` idiom — the limit
+    applies to each outer row's subquery result, so dropping it is the
+    only sound rewrite; keeping it would cap the whole inner table);
+    a GROUPED aggregate emits >=1 group iff its input has >=1 row."""
+    while True:
+        if isinstance(plan, (Project, Compute, Sort, Distinct, Window)):
+            # A TOP-level Window only appends a column: existence-safe
+            # to shed (filters over its outputs below stay barriers).
+            plan = plan.child
+            continue
+        if isinstance(plan, Limit):
+            if plan.n <= 0:
+                return ("empty", None)
+            plan = plan.child
+            continue
+        if isinstance(plan, Aggregate):
+            if not plan.group_by:
+                return ("always", None)
+            plan = plan.child
+            continue
+        return ("plan", plan)
+
+
 def _split_correlations(plan: LogicalPlan):
     """Remove ``inner == outer_ref`` conjuncts from the Filters of a
     subplan chain; returns (new_plan, [(outer_name, inner_name)])."""
     pairs: List[Tuple[str, str]] = []
 
     def strip(node: LogicalPlan) -> LogicalPlan:
+        # HOIST BARRIERS: a correlation conjunct below a row-count-
+        # changing node (or a non-inner join's unsafe side) cannot move
+        # into the join condition — removing it there would change what
+        # the upper node sees.  Leftover outer_refs below a barrier are
+        # caught by the callers' _plan_has_outer_refs check and raise a
+        # clean SubqueryError instead of silently changing answers.
+        if isinstance(node, (Limit, Distinct, Aggregate, Union,
+                             BucketUnion, Window)):
+            # Window included: its analytic values (rank, running sums)
+            # are computed over the subquery's rows, so a correlation
+            # hoisted above it would change them.
+            return node
+        if isinstance(node, Join) and node.how != "inner":
+            return node
         children = tuple(strip(c) for c in node.children)
         node = node.with_children(children)
         if not isinstance(node, Filter):
@@ -374,6 +426,44 @@ def _rewrite_filter(node: Filter, session, state) -> LogicalPlan:
             # rules pattern-match.
             return Join(rebuild(rest, node.child), conj.plan,
                         BinOp("==", conj.child, Col(sub_col)), "semi")
+        if isinstance(conj, Exists) or (
+                isinstance(conj, Not) and isinstance(conj.child, Exists)):
+            negated = isinstance(conj, Not)
+            ex = conj.child if negated else conj
+            kind, simplified = _simplify_exists(ex.plan)
+            if kind == "always":
+                # A global aggregate yields exactly one row per outer
+                # row: EXISTS is TRUE (NOT EXISTS FALSE), correlated or
+                # not.
+                if negated:
+                    return rebuild(rest + [Lit(False)], node.child)
+                return rebuild(rest, node.child)
+            if kind == "empty":
+                if negated:
+                    return rebuild(rest, node.child)
+                return rebuild(rest + [Lit(False)], node.child)
+            stripped, pairs = _split_correlations(simplified)
+            if _plan_has_outer_refs(stripped):
+                raise SubqueryError(
+                    "EXISTS correlation must be inner_col == outer_ref() "
+                    "equality conjuncts in the subquery's filters")
+            if not pairs:
+                # Uncorrelated: existence is one probe, folded here.
+                from hyperspace_tpu.execution.executor import Executor
+
+                any_row = Executor(session).execute(
+                    session.optimize(Limit(1, stripped))).num_rows > 0
+                if any_row != negated:
+                    return rebuild(rest, node.child)  # always TRUE
+                return rebuild(rest + [Lit(False)], node.child)
+            inner_cols = [i for _o, i in pairs]
+            cond = conjoin([BinOp("==", Col(o), Col(i))
+                            for o, i in pairs])
+            # Only existence matters: project the sub to the correlation
+            # columns (its own SELECT list — often `SELECT 1` — is shed).
+            sub_side = Project(sorted(set(inner_cols)), stripped)
+            return Join(rebuild(rest, node.child), sub_side, cond,
+                        "anti" if negated else "semi")
         if isinstance(conj, Not) and isinstance(conj.child, InSubquery):
             inq = conj.child
             if not isinstance(inq.child, Col):
@@ -419,10 +509,11 @@ def _rewrite_filter(node: Filter, session, state) -> LogicalPlan:
             return rebuild(conjuncts[:idx] + [new_conj]
                            + conjuncts[idx + 1:], node.child)
         if isinstance(conj, (ScalarSubquery,)) or _contains(
-                conj, (InSubquery,)):
+                conj, (InSubquery, Exists)):
             raise SubqueryError(
-                f"Unsupported subquery position: {conj!r} (IN-subqueries "
-                f"must be top-level conjuncts)")
+                f"Unsupported subquery position: {conj!r} (IN/EXISTS "
+                f"subqueries must be top-level conjuncts, possibly under "
+                f"NOT)")
     return node
 
 
@@ -450,7 +541,7 @@ def rewrite_subqueries(plan: LogicalPlan, session,
     # Everywhere else (Compute, aggregate inputs, join conditions):
     # uncorrelated scalars fold; anything needing a join is unsupported.
     for e in _plan_exprs(plan):
-        if _contains(e, (InSubquery, OuterRef)):
+        if _contains(e, (InSubquery, OuterRef, Exists)):
             raise SubqueryError(
                 f"Subqueries are supported in filter() predicates only; "
                 f"found one inside {type(plan).__name__}")

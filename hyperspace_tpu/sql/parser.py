@@ -19,7 +19,10 @@ count(DISTINCT x)/stddev/variance), window calls ``func(...) OVER
 subqueries ``(SELECT ...)``.  A column qualified by an alias not in the
 current scope becomes ``outer_ref`` — SQL's correlated subquery form.
 
-EXISTS is not parsed: write a SEMI JOIN (the rewrite SQL engines apply).
+[NOT] EXISTS (SELECT ... WHERE inner = alias.outer) lowers to the
+SEMI/ANTI join rewrite (plan/subquery.py); the subquery's own select
+list is existence-only, so ``SELECT 1`` works.  Unaliased computed
+select items auto-name as ``_c<position>``.
 """
 
 from __future__ import annotations
@@ -34,6 +37,7 @@ from hyperspace_tpu.plan.expr import (
     Case,
     Cast,
     Col,
+    Exists,
     Expr,
     Extract,
     InSubquery,
@@ -520,8 +524,11 @@ class _Parser:
             self.expect_op(")")
             return Extract(_EXTRACT_FUNCS[field], e)
         if upper == "EXISTS":
-            self.fail("EXISTS is not supported; write a SEMI JOIN (the "
-                      "rewrite SQL engines apply)")
+            self.next()
+            self.expect_op("(")
+            if not self.at_kw("SELECT"):
+                self.fail("EXISTS needs a (SELECT ...) subquery")
+            return Exists(self._parse_subquery().plan)
         if self.peek(1)[0] == "op" and self.peek(1)[1] == "(":
             return self.parse_call()
         # [alias.]column
@@ -792,13 +799,12 @@ def _lower(p: _Parser, ds, items, distinct, where, group_by, having,
                     out_items.append((alias, None))
                 elif isinstance(e, Col) and alias is None:
                     out_items.append((e.name, None))
-                elif alias is not None:
+                else:
                     _reject_markers(e, "SELECT expressions",
                                     (_WindowCall,))
-                    out_items.append((alias, e))
-                else:
-                    raise SqlError(
-                        f"Computed select items need AS aliases: {e!r}")
+                    # Unaliased computed items auto-name (Spark names
+                    # them after the expression text; `_c<i>` is stabler).
+                    out_items.append((alias or f"_c{len(out_items)}", e))
 
     for alias, w in windows_to_apply:
         ds = ds.with_window(alias, w.func, partition_by=w.partition_by,

@@ -282,11 +282,11 @@ class TestErrors:
         with pytest.raises(SqlError, match="Unknown table"):
             sql(s, "SELECT a FROM nope", tables={})
 
-    def test_exists_hint(self, env):
+    def test_exists_needs_subquery(self, env):
         s, paths = env
-        with pytest.raises(SqlError, match="SEMI JOIN"):
-            sql(s, "SELECT o_orderkey FROM orders WHERE EXISTS "
-                   "(SELECT 1 FROM lineitem)", tables=_tables(s, paths))
+        with pytest.raises(SqlError, match="EXISTS needs"):
+            sql(s, "SELECT o_orderkey FROM orders WHERE EXISTS (42)",
+                tables=_tables(s, paths))
 
     def test_trailing_garbage(self, env):
         s, paths = env
@@ -411,3 +411,42 @@ def test_year_predicate_canonicalizes_through_join(env):
     tree = ds.optimized_plan().tree_string()
     assert "year(" not in tree, tree
     assert "datetime.date(1995, 1, 1)" in tree
+
+
+class TestExists:
+    def test_exists_from_sql_text(self, env):
+        """TPC-H Q4's EXISTS shape runs from SQL text as a semi join."""
+        s, paths = env
+        ds = sql(s, """
+            SELECT o_orderkey FROM orders o
+            WHERE o_totalprice < 500 AND EXISTS (
+                SELECT 1 FROM lineitem l
+                WHERE l.l_orderkey = o.o_orderkey AND l.l_quantity > 45)
+            ORDER BY o_orderkey
+        """, tables=_tables(s, paths))
+        assert "semi" in ds.optimized_plan().tree_string().lower()
+        odf = pd.read_parquet(paths["orders"])
+        ldf = pd.read_parquet(paths["lineitem"])
+        keys = set(ldf[ldf["l_quantity"] > 45]["l_orderkey"])
+        want = odf[(odf["o_totalprice"] < 500)
+                   & odf["o_orderkey"].isin(keys)]
+        assert ds.count() == len(want)
+
+    def test_not_exists_from_sql_text(self, env):
+        s, paths = env
+        ds = sql(s, """
+            SELECT c_custkey FROM customer c
+            WHERE NOT EXISTS (
+                SELECT 1 FROM orders o WHERE o.o_custkey = c.c_custkey)
+        """, tables=_tables(s, paths))
+        cdf = pd.read_parquet(paths["customer"])
+        odf = pd.read_parquet(paths["orders"])
+        want = cdf[~cdf["c_custkey"].isin(set(odf["o_custkey"]))]
+        assert ds.count() == len(want)
+
+    def test_select_one_auto_alias(self, env):
+        s, paths = env
+        out = sql(s, "SELECT 1, o_orderkey FROM orders LIMIT 2",
+                  tables=_tables(s, paths)).collect()
+        assert out.column_names == ["_c0", "o_orderkey"]
+        assert out.column("_c0").to_pylist() == [1, 1]

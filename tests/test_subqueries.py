@@ -349,3 +349,99 @@ def test_fold_memoized_within_one_pass(env, monkeypatch):
         (col("s_return") > sub) & (col("s_return") < sub * 2))
     ds.collect()
     assert len(calls) == 1, len(calls)
+
+
+def test_exists_correlated_semi_and_anti(env):
+    """EXISTS with outer_ref correlation -> SEMI join; NOT EXISTS ->
+    ANTI; the subquery's own projection (SELECT 1) is existence-only."""
+    from hyperspace_tpu import exists
+
+    s, paths, df, stores = env
+    has_store = (s.read.parquet(paths["stores"])
+                 .filter((col("st_key") == outer_ref("s_store"))
+                         & (col("st_state") == "TN"))
+                 .select(one=lit(1)))
+    ds = s.read.parquet(paths["sales"]).filter(exists(has_store))
+    plan = ds.optimized_plan()
+    assert "semi" in plan.tree_string().lower(), plan.tree_string()
+    tn = set(stores[stores["st_state"] == "TN"]["st_key"])
+    assert ds.count() == int(df["s_store"].isin(tn).sum())
+    anti = s.read.parquet(paths["sales"]).filter(~exists(has_store))
+    assert anti.count() == int((~df["s_store"].isin(tn)).sum())
+
+
+def test_exists_uncorrelated_folds(env):
+    from hyperspace_tpu import exists
+
+    s, paths, df, _stores = env
+    nonempty = s.read.parquet(paths["stores"]).filter(
+        col("st_state") == "TN")
+    empty = s.read.parquet(paths["stores"]).filter(
+        col("st_state") == "XX")
+    n = len(df)
+    assert s.read.parquet(paths["sales"]).filter(
+        exists(nonempty)).count() == n
+    assert s.read.parquet(paths["sales"]).filter(
+        exists(empty)).count() == 0
+    assert s.read.parquet(paths["sales"]).filter(
+        ~exists(empty)).count() == n
+
+
+def test_exists_limit_distinct_and_aggregate_shapes(env):
+    """EXISTS (... LIMIT 1) keeps per-outer-row semantics; LIMIT 0 is
+    never-true; DISTINCT 1 works; a global aggregate is always-true;
+    correlations trapped below a hoist barrier error cleanly instead of
+    silently changing answers."""
+    from hyperspace_tpu import exists
+    from hyperspace_tpu.dataset import Dataset
+    from hyperspace_tpu.plan.nodes import Filter as FilterNode, Limit
+
+    s, paths, df, stores = env
+    sales = s.read.parquet(paths["sales"])
+    corr = (s.read.parquet(paths["stores"])
+            .filter(col("st_key") == outer_ref("s_store")))
+    n_match = int(df["s_store"].isin(set(stores["st_key"])).sum())
+    # LIMIT 1 inside EXISTS: the common no-op idiom stays per-outer-row.
+    assert sales.filter(exists(corr.select(one=lit(1)).limit(1))).count() \
+        == n_match
+    # LIMIT 0: never true.
+    assert sales.filter(exists(corr.limit(0))).count() == 0
+    assert sales.filter(~exists(corr.limit(0))).count() == len(df)
+    # DISTINCT over the select-one projection.
+    assert sales.filter(
+        exists(corr.select(one=lit(1)).distinct())).count() == n_match
+    # Global aggregate: exactly one row -> always TRUE / NOT -> FALSE.
+    agg = corr.agg(m=("st_key", "max"))
+    assert sales.filter(exists(agg)).count() == len(df)
+    assert sales.filter(~exists(agg)).count() == 0
+    # Correlated filter ABOVE a Limit barrier hoists soundly (the limit
+    # caps the INNER table, then correlation selects within it).
+    stores_ds = s.read.parquet(paths["stores"])
+    capped = Dataset(FilterNode(col("st_key") == outer_ref("s_store"),
+                                Limit(5, stores_ds.plan)), s)
+    got = sales.filter(exists(capped)).count()
+    want = int(df["s_store"].isin(set(stores["st_key"].iloc[:5])).sum())
+    assert got == want, (got, want)
+    # Correlation BELOW a barrier that cannot be shed (a filter sits
+    # above the Limit): clean error, never a silent wrong answer.
+    trapped = Dataset(
+        FilterNode(col("st_state") == "TN",
+                   Limit(5, FilterNode(
+                       col("st_key") == outer_ref("s_store"),
+                       stores_ds.plan))), s)
+    with pytest.raises(SubqueryError, match="outer_ref"):
+        sales.filter(exists(trapped)).count()
+
+
+def test_exists_correlation_below_window_errors(env):
+    """Window values (rank) compute over the subquery's rows — hoisting
+    a correlation above one would change them, so it must error."""
+    from hyperspace_tpu import exists
+
+    s, paths, _df, _stores = env
+    sub = (s.read.parquet(paths["stores"])
+           .filter(col("st_key") == outer_ref("s_store"))
+           .with_window("rk", "rank", order_by=[("st_key", False)])
+           .filter(col("rk") <= 1))
+    with pytest.raises(SubqueryError, match="outer_ref"):
+        s.read.parquet(paths["sales"]).filter(exists(sub)).count()
