@@ -266,10 +266,15 @@ def _split_correlations(plan: LogicalPlan):
         # caught by the callers' _plan_has_outer_refs check and raise a
         # clean SubqueryError instead of silently changing answers.
         if isinstance(node, (Limit, Distinct, Aggregate, Union,
-                             BucketUnion, Window)):
+                             BucketUnion, Window, Compute)):
             # Window included: its analytic values (rank, running sums)
             # are computed over the subquery's rows, so a correlation
-            # hoisted above it would change them.
+            # hoisted above it would change them.  Compute included
+            # conservatively: it can REDEFINE the correlation column, so
+            # a conjunct hoisted across it would bind to recomputed
+            # values (Project only drops/keeps columns and stays
+            # transparent; dropped correlation columns are caught by the
+            # caller's output validation).
             return node
         if isinstance(node, Join) and node.how != "inner":
             return node
@@ -457,6 +462,13 @@ def _rewrite_filter(node: Filter, session, state) -> LogicalPlan:
                     return rebuild(rest, node.child)  # always TRUE
                 return rebuild(rest + [Lit(False)], node.child)
             inner_cols = [i for _o, i in pairs]
+            missing = set(inner_cols) - set(
+                stripped.output_columns(session.schema_of))
+            if missing:
+                raise SubqueryError(
+                    f"EXISTS correlation column(s) {sorted(missing)} are "
+                    f"projected away inside the subquery; keep them "
+                    f"visible (or drop the intermediate projection)")
             cond = conjoin([BinOp("==", Col(o), Col(i))
                             for o, i in pairs])
             # Only existence matters: project the sub to the correlation

@@ -21,8 +21,10 @@ current scope becomes ``outer_ref`` — SQL's correlated subquery form.
 
 [NOT] EXISTS (SELECT ... WHERE inner = alias.outer) lowers to the
 SEMI/ANTI join rewrite (plan/subquery.py); the subquery's own select
-list is existence-only, so ``SELECT 1`` works.  Unaliased computed
-select items auto-name as ``_c<position>``.
+list is existence-only, so ``SELECT 1`` works.  In NON-aggregate select
+lists, unaliased computed items auto-name as ``_c<position>``;
+aggregate select items still require AS aliases (their names become the
+aggregate outputs).
 """
 
 from __future__ import annotations

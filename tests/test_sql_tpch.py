@@ -1,0 +1,310 @@
+"""The TPC-H corpus as SQL TEXT — the reference's native form.
+
+The reference's golden harness feeds .sql files
+(goldstandard/PlanStabilitySuite.scala:81-283); here every one of the 22
+corpus queries runs from SQL text through hyperspace_tpu.sql and must
+produce the SAME canonicalized answer as its DSL twin in
+test_plan_stability_tpch (rules on), over the same catalog and indexes —
+correlated scalar subqueries, [NOT] EXISTS, IN subqueries, windows of
+clause order, CASE, LIKE, dates, and year() grouping all arrive the way
+a reference user would write them.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tests.test_plan_stability_tpch import (  # noqa: F401 (fixture)
+    D,
+    TPCH_NAMES,
+    _canonical,
+    _queries,
+    catalog,
+)
+from hyperspace_tpu.sql import sql
+
+
+def _d(n: int) -> str:
+    return f"DATE '{D(n).isoformat()}'"
+
+
+REV = "sum(l_extendedprice * (1 - l_discount))"
+
+
+def _sql_texts():
+    return {
+        "t01": f"""
+            SELECT l_returnflag, l_linestatus,
+                   sum(l_quantity) AS sum_qty,
+                   sum(l_extendedprice) AS sum_base_price,
+                   {REV} AS sum_disc_price,
+                   sum(l_extendedprice * (1 - l_discount) * (1 + l_tax))
+                       AS sum_charge,
+                   avg(l_quantity) AS avg_qty,
+                   avg(l_extendedprice) AS avg_price,
+                   count(*) AS count_order
+            FROM lineitem WHERE l_shipdate <= {_d(2300)}
+            GROUP BY l_returnflag, l_linestatus
+            ORDER BY l_returnflag, l_linestatus""",
+        "t02": """
+            SELECT s_name, p_partkey, ps_supplycost
+            FROM part JOIN partsupp ON p_partkey = ps_partkey
+                 JOIN supplier ON ps_suppkey = s_suppkey
+                 JOIN nation ON s_nationkey = n_nationkey
+                 JOIN region ON n_regionkey = r_regionkey
+            WHERE p_size = 15 AND r_name = 'EUROPE'
+              AND ps_supplycost = (
+                  SELECT min(p2.ps_supplycost) AS min_cost
+                  FROM partsupp p2
+                       JOIN supplier s2 ON p2.ps_suppkey = s2.s_suppkey
+                       JOIN nation n2 ON s2.s_nationkey = n2.n_nationkey
+                       JOIN region r2 ON n2.n_regionkey = r2.r_regionkey
+                  WHERE r2.r_name = 'EUROPE'
+                    AND p2.ps_partkey = part.p_partkey)
+            ORDER BY ps_supplycost, s_name, p_partkey LIMIT 10""",
+        "t03": f"""
+            SELECT o_orderkey, o_orderdate, o_shippriority,
+                   {REV} AS revenue
+            FROM customer JOIN orders ON c_custkey = o_custkey
+                 JOIN lineitem ON o_orderkey = l_orderkey
+            WHERE c_mktsegment = 'BUILDING'
+              AND o_orderdate < {_d(1200)} AND l_shipdate > {_d(1200)}
+            GROUP BY o_orderkey, o_orderdate, o_shippriority
+            ORDER BY revenue DESC, o_orderdate LIMIT 10""",
+        "t04": f"""
+            SELECT o_orderpriority, count(*) AS order_count
+            FROM orders
+            WHERE o_orderdate >= {_d(800)} AND o_orderdate < {_d(1100)}
+              AND EXISTS (SELECT 1 FROM lineitem l
+                          WHERE l.l_orderkey = orders.o_orderkey
+                            AND l.l_commitdate < l.l_receiptdate)
+            GROUP BY o_orderpriority ORDER BY o_orderpriority""",
+        "t05": f"""
+            SELECT n_name, {REV} AS revenue
+            FROM customer JOIN orders ON c_custkey = o_custkey
+                 JOIN lineitem ON o_orderkey = l_orderkey
+                 JOIN supplier ON l_suppkey = s_suppkey
+                                  AND c_nationkey = s_nationkey
+                 JOIN nation ON s_nationkey = n_nationkey
+                 JOIN region ON n_regionkey = r_regionkey
+            WHERE r_name = 'ASIA'
+              AND o_orderdate >= {_d(400)} AND o_orderdate < {_d(1200)}
+            GROUP BY n_name ORDER BY revenue DESC""",
+        "t06": f"""
+            SELECT sum(l_extendedprice * l_discount) AS revenue
+            FROM lineitem
+            WHERE l_shipdate >= {_d(400)} AND l_shipdate < {_d(800)}
+              AND l_discount BETWEEN 0.03 AND 0.07 AND l_quantity < 24""",
+        "t07": f"""
+            SELECT supp_nation, cust_nation,
+                   year(l_shipdate) AS l_year, {REV} AS revenue
+            FROM supplier
+                 JOIN (SELECT n_name AS supp_nation,
+                              n_nationkey AS n1_key FROM nation) n1
+                      ON s_nationkey = n1_key
+                 JOIN lineitem ON s_suppkey = l_suppkey
+                 JOIN orders ON l_orderkey = o_orderkey
+                 JOIN customer ON o_custkey = c_custkey
+                 JOIN (SELECT n_name AS cust_nation,
+                              n_nationkey AS n2_key FROM nation) n2
+                      ON c_nationkey = n2_key
+            WHERE l_shipdate >= {_d(1096)} AND l_shipdate <= {_d(1826)}
+              AND ((supp_nation = 'FRANCE' AND cust_nation = 'GERMANY')
+                   OR (supp_nation = 'GERMANY'
+                       AND cust_nation = 'FRANCE'))
+            GROUP BY supp_nation, cust_nation, l_year
+            ORDER BY supp_nation, cust_nation, l_year""",
+        "t08": f"""
+            SELECT year(o_orderdate) AS o_year,
+                   sum(CASE WHEN s_nationkey = 7
+                            THEN l_extendedprice * (1 - l_discount)
+                            ELSE 0.0 END)
+                   / {REV} AS mkt_share
+            FROM part JOIN lineitem ON p_partkey = l_partkey
+                 JOIN supplier ON l_suppkey = s_suppkey
+                 JOIN orders ON l_orderkey = o_orderkey
+                 JOIN customer ON o_custkey = c_custkey
+                 JOIN nation ON c_nationkey = n_nationkey
+                 JOIN region ON n_regionkey = r_regionkey
+            WHERE p_type = 'STANDARD POLISHED' AND r_name = 'AMERICA'
+              AND o_orderdate >= {_d(600)} AND o_orderdate < {_d(1800)}
+            GROUP BY o_year ORDER BY o_year""",
+        "t09": """
+            SELECT s_nationkey,
+                   sum(l_extendedprice * (1 - l_discount)
+                       - ps_supplycost * l_quantity) AS profit
+            FROM part JOIN lineitem ON p_partkey = l_partkey
+                 JOIN partsupp ON l_partkey = ps_partkey
+                                  AND l_suppkey = ps_suppkey
+                 JOIN supplier ON l_suppkey = s_suppkey
+            WHERE p_name LIKE '%green%'
+            GROUP BY s_nationkey ORDER BY s_nationkey""",
+        "t10": f"""
+            SELECT c_custkey, c_name, c_acctbal, n_name, {REV} AS revenue
+            FROM customer JOIN orders ON c_custkey = o_custkey
+                 JOIN lineitem ON o_orderkey = l_orderkey
+                 JOIN nation ON c_nationkey = n_nationkey
+            WHERE o_orderdate >= {_d(600)} AND o_orderdate < {_d(900)}
+              AND l_returnflag = 'R'
+            GROUP BY c_custkey, c_name, c_acctbal, n_name
+            ORDER BY revenue DESC LIMIT 20""",
+        "t11": """
+            SELECT ps_partkey,
+                   sum(ps_supplycost * ps_availqty) AS value
+            FROM partsupp JOIN supplier ON ps_suppkey = s_suppkey
+                 JOIN nation ON s_nationkey = n_nationkey
+            WHERE n_name = 'GERMANY'
+            GROUP BY ps_partkey
+            HAVING sum(ps_supplycost * ps_availqty) > (
+                SELECT sum(p2.ps_supplycost * p2.ps_availqty) * 0.02 AS v
+                FROM partsupp p2
+                     JOIN supplier s2 ON p2.ps_suppkey = s2.s_suppkey
+                     JOIN nation n2 ON s2.s_nationkey = n2.n_nationkey
+                WHERE n2.n_name = 'GERMANY')
+            ORDER BY value DESC""",
+        "t12": f"""
+            SELECT l_shipmode,
+                   sum(CASE WHEN o_orderpriority IN ('1-URGENT', '2-HIGH')
+                            THEN 1 ELSE 0 END) AS high_line_count,
+                   sum(CASE WHEN o_orderpriority NOT IN
+                                ('1-URGENT', '2-HIGH')
+                            THEN 1 ELSE 0 END) AS low_line_count
+            FROM orders JOIN lineitem ON o_orderkey = l_orderkey
+            WHERE l_shipmode IN ('MAIL', 'SHIP')
+              AND l_commitdate < l_receiptdate
+              AND l_shipdate < l_commitdate
+              AND l_receiptdate >= {_d(400)}
+              AND l_receiptdate < {_d(1200)}
+            GROUP BY l_shipmode ORDER BY l_shipmode""",
+        "t13": """
+            SELECT c_count, count(*) AS custdist
+            FROM (SELECT c_custkey, count(o_orderkey) AS c_count
+                  FROM customer LEFT JOIN orders
+                       ON c_custkey = o_custkey
+                  GROUP BY c_custkey) cc
+            GROUP BY c_count ORDER BY custdist DESC, c_count DESC""",
+        "t14": f"""
+            SELECT 100.0 * sum(CASE WHEN p_type LIKE 'PROMO%'
+                                    THEN l_extendedprice * (1 - l_discount)
+                                    ELSE 0.0 END)
+                   / {REV} AS promo_revenue
+            FROM lineitem JOIN part ON l_partkey = p_partkey
+            WHERE l_shipdate >= {_d(1000)} AND l_shipdate < {_d(1100)}""",
+        "t15": f"""
+            SELECT s_suppkey, s_name, total_revenue
+            FROM (SELECT l_suppkey, {REV} AS total_revenue
+                  FROM lineitem
+                  WHERE l_shipdate >= {_d(1200)}
+                    AND l_shipdate < {_d(1500)}
+                  GROUP BY l_suppkey) r
+                 JOIN supplier ON l_suppkey = s_suppkey
+            WHERE total_revenue = (
+                SELECT max(r2.total_revenue) AS m
+                FROM (SELECT l_suppkey, {REV} AS total_revenue
+                      FROM lineitem
+                      WHERE l_shipdate >= {_d(1200)}
+                        AND l_shipdate < {_d(1500)}
+                      GROUP BY l_suppkey) r2)
+            ORDER BY s_suppkey""",
+        "t16": """
+            SELECT p_brand, p_type, p_size,
+                   count(DISTINCT ps_suppkey) AS supplier_cnt
+            FROM partsupp JOIN part ON ps_partkey = p_partkey
+            WHERE NOT p_brand = 'Brand#00'
+              AND p_size IN (5, 15, 25, 35, 45)
+              AND ps_suppkey NOT IN (SELECT s_suppkey FROM supplier
+                                     WHERE s_acctbal < 0.0)
+            GROUP BY p_brand, p_type, p_size
+            ORDER BY supplier_cnt DESC, p_brand, p_type, p_size""",
+        "t17": """
+            SELECT sum(l_extendedprice) / 7.0 AS avg_yearly
+            FROM lineitem JOIN part ON l_partkey = p_partkey
+            WHERE p_brand = 'Brand#11' AND p_container = 'SM CASE'
+              AND l_quantity < (
+                  SELECT avg(l2.l_quantity) AS aq FROM lineitem l2
+                  WHERE l2.l_partkey = lineitem.l_partkey) * 0.4""",
+        "t18": """
+            SELECT c_name, c_custkey, o_orderkey, o_orderdate,
+                   o_totalprice, sum(l_quantity) AS sum_qty
+            FROM customer JOIN orders ON c_custkey = o_custkey
+                 JOIN lineitem ON o_orderkey = l_orderkey
+            WHERE o_orderkey IN (
+                SELECT l_orderkey FROM
+                    (SELECT l_orderkey, sum(l_quantity) AS qty
+                     FROM lineitem GROUP BY l_orderkey) t
+                WHERE qty > 120)
+            GROUP BY c_name, c_custkey, o_orderkey, o_orderdate,
+                     o_totalprice
+            ORDER BY o_totalprice DESC, o_orderkey LIMIT 100""",
+        "t19": f"""
+            SELECT {REV} AS revenue
+            FROM lineitem JOIN part ON l_partkey = p_partkey
+            WHERE (p_container = 'SM CASE' AND l_quantity >= 1
+                   AND l_quantity <= 11 AND p_size <= 5)
+               OR (p_container = 'MED BOX' AND l_quantity >= 10
+                   AND l_quantity <= 20 AND p_size <= 10)
+               OR (p_container = 'LG JAR' AND l_quantity >= 20
+                   AND l_quantity <= 30 AND p_size <= 15)""",
+        "t20": f"""
+            SELECT s_suppkey, s_name
+            FROM supplier
+            WHERE s_suppkey IN (
+                SELECT ps_suppkey FROM partsupp
+                WHERE ps_partkey IN (SELECT p_partkey FROM part
+                                     WHERE p_name LIKE 'part green%')
+                  AND ps_availqty > (
+                      SELECT sum(l.l_quantity) AS q FROM lineitem l
+                      WHERE l.l_partkey = partsupp.ps_partkey
+                        AND l.l_suppkey = partsupp.ps_suppkey
+                        AND l.l_shipdate >= {_d(400)}
+                        AND l.l_shipdate < {_d(800)}) * 0.5)
+            ORDER BY s_suppkey""",
+        "t21": """
+            SELECT s_name, count(*) AS numwait
+            FROM supplier JOIN nation ON s_nationkey = n_nationkey
+                 JOIN lineitem ON s_suppkey = l_suppkey
+                 JOIN orders ON l_orderkey = o_orderkey
+            WHERE n_name = 'GERMANY'
+              AND l_receiptdate > l_commitdate
+              AND o_orderstatus = 'F'
+              AND l_orderkey IN (
+                  SELECT l_orderkey FROM
+                      (SELECT l_orderkey,
+                              count(DISTINCT l_suppkey) AS nsupp
+                       FROM lineitem GROUP BY l_orderkey) x
+                  WHERE nsupp > 1)
+              AND l_orderkey IN (
+                  SELECT l_orderkey FROM
+                      (SELECT l_orderkey,
+                              count(DISTINCT l_suppkey) AS nlate
+                       FROM lineitem
+                       WHERE l_receiptdate > l_commitdate
+                       GROUP BY l_orderkey) y
+                  WHERE nlate = 1)
+            GROUP BY s_name ORDER BY numwait DESC, s_name LIMIT 100""",
+        "t22": """
+            SELECT c_phonecode, count(*) AS numcust,
+                   sum(c_acctbal) AS totacctbal
+            FROM customer
+            WHERE c_phonecode IN (13, 31, 23, 29, 30, 18, 17)
+              AND c_acctbal > (SELECT avg(c2.c_acctbal) AS a
+                               FROM customer c2
+                               WHERE c2.c_acctbal > 0.0)
+              AND NOT EXISTS (SELECT 1 FROM orders o
+                              WHERE o.o_custkey = customer.c_custkey)
+            GROUP BY c_phonecode ORDER BY c_phonecode""",
+    }
+
+
+@pytest.mark.parametrize("prefix", TPCH_NAMES)
+def test_sql_text_matches_dsl_corpus(catalog, prefix):
+    session, paths = catalog
+    texts = _sql_texts()
+    assert set(texts) == set(TPCH_NAMES), "every corpus query has SQL text"
+    dsl = _queries(session, paths)
+    name = [k for k in dsl if k.startswith(prefix)][0]
+    tables = {t: session.read.parquet(p) for t, p in paths.items()}
+    session.enable_hyperspace()
+    got = _canonical(sql(session, texts[prefix], tables=tables).collect())
+    want = _canonical(dsl[name].collect())
+    assert got == want, f"{name}: SQL text answer diverged from DSL"

@@ -445,3 +445,24 @@ def test_exists_correlation_below_window_errors(env):
            .filter(col("rk") <= 1))
     with pytest.raises(SubqueryError, match="outer_ref"):
         s.read.parquet(paths["sales"]).filter(exists(sub)).count()
+
+
+def test_exists_correlation_not_hoisted_across_compute(env):
+    """A Compute redefining the correlation column is a hoist barrier
+    (clean error, never a silently re-bound join); a Project dropping
+    the correlation column errors by name."""
+    from hyperspace_tpu import exists
+
+    s, paths, _df, _stores = env
+    redefined = (s.read.parquet(paths["stores"])
+                 .filter(col("st_key") == outer_ref("s_store"))
+                 .select(st_key=col("st_key") * 2)
+                 .filter(col("st_key") >= 0))
+    with pytest.raises(SubqueryError, match="outer_ref"):
+        s.read.parquet(paths["sales"]).filter(exists(redefined)).count()
+    dropped = (s.read.parquet(paths["sales"])
+               .filter(col("s_cust") == outer_ref("s_cust"))
+               .select("s_return")
+               .filter(col("s_return") >= 0))
+    with pytest.raises(SubqueryError, match="projected away"):
+        s.read.parquet(paths["sales"]).filter(exists(dropped)).count()
