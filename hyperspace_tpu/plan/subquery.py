@@ -258,37 +258,51 @@ def _split_correlations(plan: LogicalPlan):
     subplan chain; returns (new_plan, [(outer_name, inner_name)])."""
     pairs: List[Tuple[str, str]] = []
 
-    def strip(node: LogicalPlan) -> LogicalPlan:
+    def passes_computes(col_name: str, computes) -> bool:
+        """A correlation column may hoist across a Compute only when the
+        Compute passes it through UNCHANGED (an identity entry) — a
+        redefining entry would make the hoisted join condition bind to
+        recomputed values, and a dropping Compute would hide it."""
+        for comp in computes:
+            ok = any(name == col_name and isinstance(e, Col)
+                     and e.name == col_name for name, e in comp.exprs)
+            if not ok:
+                return False
+        return True
+
+    def strip(node: LogicalPlan, computes) -> LogicalPlan:
         # HOIST BARRIERS: a correlation conjunct below a row-count-
         # changing node (or a non-inner join's unsafe side) cannot move
         # into the join condition — removing it there would change what
         # the upper node sees.  Leftover outer_refs below a barrier are
         # caught by the callers' _plan_has_outer_refs check and raise a
         # clean SubqueryError instead of silently changing answers.
+        # Window included: its analytic values (rank, running sums) are
+        # computed over the subquery's rows, so a correlation hoisted
+        # above one would change them.
         if isinstance(node, (Limit, Distinct, Aggregate, Union,
-                             BucketUnion, Window, Compute)):
-            # Window included: its analytic values (rank, running sums)
-            # are computed over the subquery's rows, so a correlation
-            # hoisted above it would change them.  Compute included
-            # conservatively: it can REDEFINE the correlation column, so
-            # a conjunct hoisted across it would bind to recomputed
-            # values (Project only drops/keeps columns and stays
-            # transparent; dropped correlation columns are caught by the
-            # caller's output validation).
+                             BucketUnion, Window)):
             return node
         if isinstance(node, Join) and node.how != "inner":
             return node
-        children = tuple(strip(c) for c in node.children)
+        if isinstance(node, Compute):
+            # Transparent per-column: hoisting decisions below consult
+            # the identity check above.
+            computes = computes + [node]
+        children = tuple(strip(c, computes) for c in node.children)
         node = node.with_children(children)
         if not isinstance(node, Filter):
             return node
         keep = []
         for conj in split_conjuncts(node.condition):
             corr = _as_correlation(conj)
-            if corr is not None:
+            if corr is not None and passes_computes(corr[1], computes):
                 pairs.append(corr)
             else:
                 if _contains(conj, OuterRef):
+                    if corr is not None:
+                        keep.append(conj)  # trapped below a redefining
+                        continue           # Compute -> clean error above
                     raise SubqueryError(
                         f"Correlated subquery predicates must be "
                         f"inner_col == outer_ref(...) equality conjuncts; "
@@ -298,7 +312,7 @@ def _split_correlations(plan: LogicalPlan):
             return node.child
         return Filter(conjoin(keep), node.child)
 
-    return strip(plan), pairs
+    return strip(plan, []), pairs
 
 
 def _as_correlation(conj: Expr) -> Optional[Tuple[str, str]]:
@@ -369,6 +383,12 @@ def _rewrite_correlated_scalar(outer: LogicalPlan, pred: Expr,
     if _plan_has_outer_refs(stripped):
         raise SubqueryError(
             "outer_ref outside a Filter equality conjunct is unsupported")
+    missing = {i for _o, i in pairs} - set(
+        stripped.output_columns(session.schema_of))
+    if missing:
+        raise SubqueryError(
+            f"Correlated scalar subquery projects away its correlation "
+            f"column(s) {sorted(missing)}; keep them visible")
     k = state["n"]
     state["n"] += 1
     func, agg_in, out_name = sub.aggs[0]

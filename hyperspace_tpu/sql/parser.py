@@ -593,13 +593,34 @@ class _Parser:
         distinct = False
         star = False
         arg: Optional[Expr] = None
+        args: List[Expr] = []
         if self.take_op("*"):
             star = True
         elif not self.at_op(")"):
             if self.take_kw("DISTINCT"):
                 distinct = True
             arg = self.parse_expr()
+            args.append(arg)
+            while self.take_op(","):
+                args.append(self.parse_expr())
         self.expect_op(")")
+        if name in ("coalesce", "ifnull", "nvl", "nullif") \
+                and (distinct or star):
+            self.fail(f"{name}() takes plain expression arguments")
+        if name in ("coalesce", "ifnull", "nvl"):
+            if len(args) < 2:
+                self.fail(f"{name}() needs at least two arguments")
+            # COALESCE(a, b, c) -> CASE WHEN a IS NOT NULL THEN a
+            #                           WHEN b IS NOT NULL THEN b ELSE c
+            branches = [(Not(IsNull(a)), a) for a in args[:-1]]
+            return Case(branches, args[-1])
+        if name == "nullif":
+            if len(args) != 2:
+                self.fail("nullif() takes exactly two arguments")
+            return Case([(BinOp("==", args[0], args[1]), Lit(None))],
+                        args[0])
+        if len(args) > 1:
+            self.fail(f"{name}() takes one argument")
         # OVER -> window call
         if self.at_kw("OVER"):
             self.next()

@@ -450,3 +450,36 @@ class TestExists:
                   tables=_tables(s, paths)).collect()
         assert out.column_names == ["_c0", "o_orderkey"]
         assert out.column("_c0").to_pylist() == [1, 1]
+
+
+class TestNullFunctions:
+    def test_coalesce_and_nullif(self, tmp_path):
+        s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+        d = str(tmp_path / "t")
+        os.makedirs(d)
+        pq.write_table(pa.table({
+            "a": pa.array([1, None, None], type=pa.int64()),
+            "b": pa.array([None, 2, None], type=pa.int64()),
+        }), os.path.join(d, "p.parquet"))
+        out = sql(s, "SELECT coalesce(a, b, 0) AS c, "
+                     "nullif(a, 1) AS n FROM t",
+                  tables={"t": s.read.parquet(d)}).collect()
+        assert out.column("c").to_pylist() == [1, 2, 0]
+        assert out.column("n").to_pylist() == [None, None, None]
+        # In a predicate too.
+        n = sql(s, "SELECT a FROM t WHERE coalesce(a, b, 0) > 0",
+                tables={"t": s.read.parquet(d)}).count()
+        assert n == 2
+
+    def test_single_arg_functions_reject_lists(self, tmp_path):
+        s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+        with pytest.raises(SqlError, match="one argument"):
+            sql(s, "SELECT sum(a, b) AS x FROM t GROUP BY a",
+                tables={"t": s.read})
+
+
+def test_coalesce_rejects_distinct(tmp_path):
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    with pytest.raises(SqlError, match="plain expression"):
+        sql(s, "SELECT coalesce(DISTINCT a, b) AS c FROM t",
+            tables={"t": s.read})

@@ -466,3 +466,29 @@ def test_exists_correlation_not_hoisted_across_compute(env):
                .filter(col("s_return") >= 0))
     with pytest.raises(SubqueryError, match="projected away"):
         s.read.parquet(paths["sales"]).filter(exists(dropped)).count()
+
+
+def test_exists_hoists_through_identity_compute(env):
+    """A Compute that passes the correlation column through UNCHANGED is
+    transparent; one that redefines it is a barrier."""
+    from hyperspace_tpu import exists
+
+    s, paths, df, stores = env
+    # select('st_key', doubled=...) keeps st_key as an identity entry.
+    through = (s.read.parquet(paths["stores"])
+               .filter(col("st_key") == outer_ref("s_store"))
+               .select("st_key", doubled=col("st_key") * 2)
+               .filter(col("doubled") >= 0))
+    n = s.read.parquet(paths["sales"]).filter(exists(through)).count()
+    assert n == int(df["s_store"].isin(set(stores["st_key"])).sum())
+
+
+def test_correlated_scalar_projected_away_errors(env):
+    s, paths, _df, _stores = env
+    sub = (s.read.parquet(paths["sales"])
+           .filter(col("s_store") == outer_ref("s_store"))
+           .select("s_return")
+           .agg(m=("s_return", "mean")))
+    with pytest.raises(SubqueryError, match="projects away"):
+        s.read.parquet(paths["sales"]).filter(
+            col("s_return") > scalar(sub)).count()
