@@ -257,17 +257,25 @@ def _split_correlations(plan: LogicalPlan):
     """Remove ``inner == outer_ref`` conjuncts from the Filters of a
     subplan chain; returns (new_plan, [(outer_name, inner_name)])."""
     pairs: List[Tuple[str, str]] = []
+    trapped: List[str] = []
 
     def passes_computes(col_name: str, computes) -> bool:
-        """A correlation column may hoist across a Compute only when the
-        Compute passes it through UNCHANGED (an identity entry) — a
-        redefining entry would make the hoisted join condition bind to
-        recomputed values, and a dropping Compute would hide it."""
+        """A correlation column may hoist across a Compute/WithColumns
+        only when the node passes it through UNCHANGED — a redefining
+        entry would make the hoisted join condition bind to recomputed
+        values; a Compute (which keeps ONLY its entries) must list an
+        identity entry, while WithColumns passes unlisted columns
+        through implicitly."""
+        from hyperspace_tpu.plan.nodes import WithColumns
+
         for comp in computes:
-            ok = any(name == col_name and isinstance(e, Col)
-                     and e.name == col_name for name, e in comp.exprs)
-            if not ok:
-                return False
+            entry = next((e for name, e in comp.exprs
+                          if name == col_name), None)
+            if entry is not None:
+                if not (isinstance(entry, Col) and entry.name == col_name):
+                    return False  # redefined
+            elif not isinstance(comp, WithColumns):
+                return False  # Compute drops unlisted columns
         return True
 
     def strip(node: LogicalPlan, computes) -> LogicalPlan:
@@ -285,7 +293,9 @@ def _split_correlations(plan: LogicalPlan):
             return node
         if isinstance(node, Join) and node.how != "inner":
             return node
-        if isinstance(node, Compute):
+        from hyperspace_tpu.plan.nodes import WithColumns
+
+        if isinstance(node, (Compute, WithColumns)):
             # Transparent per-column: hoisting decisions below consult
             # the identity check above.
             computes = computes + [node]
@@ -301,8 +311,9 @@ def _split_correlations(plan: LogicalPlan):
             else:
                 if _contains(conj, OuterRef):
                     if corr is not None:
-                        keep.append(conj)  # trapped below a redefining
-                        continue           # Compute -> clean error above
+                        trapped.append(corr[1])
+                        keep.append(conj)  # redefining Compute above ->
+                        continue           # specific error at the caller
                     raise SubqueryError(
                         f"Correlated subquery predicates must be "
                         f"inner_col == outer_ref(...) equality conjuncts; "
@@ -312,7 +323,7 @@ def _split_correlations(plan: LogicalPlan):
             return node.child
         return Filter(conjoin(keep), node.child)
 
-    return strip(plan, []), pairs
+    return strip(plan, []), pairs, trapped
 
 
 def _as_correlation(conj: Expr) -> Optional[Tuple[str, str]]:
@@ -375,7 +386,12 @@ def _rewrite_correlated_scalar(outer: LogicalPlan, pred: Expr,
             "A correlated scalar subquery must be a single global "
             "aggregate (agg(out=(input, func))) over filters containing "
             "inner_col == outer_ref(...) conjuncts — the TPC-DS q1 shape")
-    stripped, pairs = _split_correlations(sub.child)
+    stripped, pairs, trapped = _split_correlations(sub.child)
+    if trapped:
+        raise SubqueryError(
+            f"Correlation column(s) {sorted(set(trapped))} are redefined "
+            f"by an intervening select()/with_column() inside the "
+            f"subquery; keep them passed through unchanged")
     if not pairs:
         raise SubqueryError(
             "Correlated scalar subquery has no outer_ref equality "
@@ -467,7 +483,13 @@ def _rewrite_filter(node: Filter, session, state) -> LogicalPlan:
                 if negated:
                     return rebuild(rest, node.child)
                 return rebuild(rest + [Lit(False)], node.child)
-            stripped, pairs = _split_correlations(simplified)
+            stripped, pairs, trapped = _split_correlations(simplified)
+            if trapped:
+                raise SubqueryError(
+                    f"Correlation column(s) {sorted(set(trapped))} are "
+                    f"redefined by an intervening select()/with_column() "
+                    f"inside the EXISTS subquery; keep them passed "
+                    f"through unchanged")
             if _plan_has_outer_refs(stripped):
                 raise SubqueryError(
                     "EXISTS correlation must be inner_col == outer_ref() "

@@ -181,7 +181,7 @@ class _Parser:
                        f"...{self.text[t[2]:t[2] + 30]!r}")
 
     # -- query -----------------------------------------------------------
-    def parse_select(self):
+    def parse_select(self, allow_tail: bool = True):
         self.expect_kw("SELECT")
         distinct = self.take_kw("DISTINCT")
         # FROM declares the aliases the select list references, so parse
@@ -208,17 +208,23 @@ class _Parser:
         if self.take_kw("HAVING"):
             having = self.parse_expr()
         order_by: List[Tuple[str, bool]] = []
-        if self.take_kw("ORDER"):
-            self.expect_kw("BY")
-            order_by = self.parse_order_keys()
         limit = None
-        if self.take_kw("LIMIT"):
-            t = self.next()
-            if t[0] != "num":
-                self.fail("expected a number after LIMIT")
-            limit = int(t[1])
+        if allow_tail:
+            # Inside a UNION chain the trailing ORDER BY/LIMIT bind the
+            # WHOLE union (SQL), so branch parses leave them untouched.
+            if self.take_kw("ORDER"):
+                self.expect_kw("BY")
+                order_by = self.parse_order_keys()
+            if self.take_kw("LIMIT"):
+                limit = self.parse_limit_count()
         return _lower(self, ds, items, distinct, where, group_by, having,
                       order_by, limit)
+
+    def parse_limit_count(self) -> int:
+        t = self.next()
+        if t[0] != "num":
+            self.fail("expected a number after LIMIT")
+        return int(t[1])
 
     def _skip_to_from(self) -> None:
         depth = 0
@@ -347,6 +353,21 @@ class _Parser:
             names.add(alias)
         self._register_source(names, ds)
         return ds
+
+    def fork(self) -> "_Parser":
+        """A fresh per-select scope sharing this parser's token stream
+        (no re-tokenization) and position."""
+        child = _Parser.__new__(_Parser)
+        child.text = self.text
+        child.tokens = self.tokens
+        child.i = self.i
+        child.session = self.session
+        child.tables = self.tables
+        child.outer_aliases = ()
+        child.aliases = []
+        child.sources = []
+        child._in_join_on = False
+        return child
 
     def _register_source(self, names: set, ds) -> None:
         try:
@@ -887,10 +908,53 @@ def sql(session, text: str, tables: Dict[str, Any]):
     paths (the FROM resolution — the engine has no catalog).
     """
     p = _Parser(text, session, dict(tables))
-    ds = p.parse_select()
+    has_union = _has_top_level_union(p)
+    ds = p.parse_select(allow_tail=not has_union)
+    while p.take_kw("UNION"):
+        # SQL set semantics: bare UNION dedups the accumulated result;
+        # UNION ALL keeps bags.  Left-associative like SQL.
+        dedup = True
+        if p.take_kw("ALL"):
+            dedup = False
+        else:
+            p.take_kw("DISTINCT")
+        branch = p.fork()
+        nxt = branch.parse_select(allow_tail=False)
+        p.i = branch.i
+        prev_cols, next_cols = None, None
+        try:
+            prev_cols, next_cols = ds.columns, nxt.columns
+        except Exception:
+            pass  # unresolvable schema: let execution surface it
+        if prev_cols is not None and set(prev_cols) != set(next_cols):
+            raise SqlError(
+                f"UNION branches must produce the same column names "
+                f"(the engine unions BY NAME): {prev_cols} vs "
+                f"{next_cols}; alias the outputs to match")
+        ds = ds.union(nxt)
+        if dedup:
+            ds = ds.distinct()
+    if has_union:
+        if p.take_kw("ORDER"):
+            p.expect_kw("BY")
+            ds = ds.sort(*p.parse_order_keys())
+        if p.take_kw("LIMIT"):
+            ds = ds.limit(p.parse_limit_count())
     while p.take_op(";"):  # .sql files commonly end with a semicolon
         pass
     t = p.peek()
     if t[0] != "eof":
         p.fail("unexpected trailing input")
     return ds
+
+
+def _has_top_level_union(p: _Parser) -> bool:
+    depth = 0
+    for kind, val, _pos in p.tokens[p.i:]:
+        if kind == "op" and val == "(":
+            depth += 1
+        elif kind == "op" and val == ")":
+            depth -= 1
+        elif depth == 0 and kind == "ident" and val.upper() == "UNION":
+            return True
+    return False

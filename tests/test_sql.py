@@ -483,3 +483,52 @@ def test_coalesce_rejects_distinct(tmp_path):
     with pytest.raises(SqlError, match="plain expression"):
         sql(s, "SELECT coalesce(DISTINCT a, b) AS c FROM t",
             tables={"t": s.read})
+
+
+class TestUnion:
+    def test_union_all_and_distinct(self, env):
+        s, paths = env
+        t = _tables(s, paths)
+        both = sql(s, "SELECT o_orderkey AS k FROM orders "
+                      "WHERE o_orderkey < 3 "
+                      "UNION ALL "
+                      "SELECT o_orderkey AS k FROM orders "
+                      "WHERE o_orderkey < 5", tables=t).collect()
+        assert sorted(both.column("k").to_pylist()) == [0, 0, 1, 1, 2, 2,
+                                                        3, 4]
+        dedup = sql(s, "SELECT o_orderkey AS k FROM orders "
+                       "WHERE o_orderkey < 3 "
+                       "UNION "
+                       "SELECT o_orderkey AS k FROM orders "
+                       "WHERE o_orderkey < 5 "
+                       "ORDER BY k", tables=t).collect()
+        assert dedup.column("k").to_pylist() == [0, 1, 2, 3, 4]
+
+    def test_union_tail_order_limit_binds_whole(self, env):
+        s, paths = env
+        out = sql(s, "SELECT o_orderkey AS k FROM orders "
+                     "WHERE o_orderkey IN (7, 3) "
+                     "UNION ALL "
+                     "SELECT o_orderkey AS k FROM orders "
+                     "WHERE o_orderkey IN (9, 1) "
+                     "ORDER BY k DESC LIMIT 3", tables=_tables(s, paths))
+        assert out.collect().column("k").to_pylist() == [9, 7, 3]
+
+    def test_union_by_name_merges(self, env):
+        s, paths = env
+        out = sql(s, "SELECT c_custkey AS id, c_acctbal AS v "
+                     "FROM customer WHERE c_custkey < 2 "
+                     "UNION ALL "
+                     "SELECT o_orderkey AS id, o_totalprice AS v "
+                     "FROM orders WHERE o_orderkey < 2",
+                  tables=_tables(s, paths)).collect()
+        assert out.num_rows == 4
+        assert set(out.column_names) == {"id", "v"}
+
+
+def test_union_mismatched_names_rejected(env):
+    s, paths = env
+    with pytest.raises(SqlError, match="same column names"):
+        sql(s, "SELECT o_orderkey FROM orders UNION ALL "
+               "SELECT c_custkey FROM customer",
+            tables=_tables(s, paths))

@@ -458,8 +458,21 @@ def test_exists_correlation_not_hoisted_across_compute(env):
                  .filter(col("st_key") == outer_ref("s_store"))
                  .select(st_key=col("st_key") * 2)
                  .filter(col("st_key") >= 0))
-    with pytest.raises(SubqueryError, match="outer_ref"):
+    with pytest.raises(SubqueryError, match="redefined"):
         s.read.parquet(paths["sales"]).filter(exists(redefined)).count()
+    # with_column redefinition is the same hazard (WithColumns node).
+    wc = (s.read.parquet(paths["stores"])
+          .filter(col("st_key") == outer_ref("s_store"))
+          .with_column("st_key", col("st_key") * 2 + 1)
+          .filter(col("st_key") >= 0))
+    with pytest.raises(SubqueryError, match="redefined"):
+        s.read.parquet(paths["sales"]).filter(exists(wc)).count()
+    # with_column ADDING a new column passes the correlation through.
+    wc_ok = (s.read.parquet(paths["stores"])
+             .filter(col("st_key") == outer_ref("s_store"))
+             .with_column("extra", col("st_key") * 2)
+             .filter(col("extra") >= 0))
+    assert s.read.parquet(paths["sales"]).filter(exists(wc_ok)).count() > 0
     dropped = (s.read.parquet(paths["sales"])
                .filter(col("s_cust") == outer_ref("s_cust"))
                .select("s_return")
