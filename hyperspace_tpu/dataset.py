@@ -96,7 +96,7 @@ class Dataset:
     def with_window(self, name: str, func: str,
                     partition_by: Sequence[str] = (),
                     order_by: Sequence = (),
-                    value: str = None) -> "Dataset":
+                    value: str = None, offset: int = 1) -> "Dataset":
         """Append one analytic column: ``func(value) OVER (PARTITION BY
         partition_by ORDER BY order_by)`` — Spark's window surface
         (rank/row_number/dense_rank/sum/min/max/mean/count).
@@ -107,7 +107,9 @@ class Dataset:
         ``order_by`` entries are column names or (column, ascending)
         pairs, like ``sort``.  Aggregates with an ORDER BY are running
         (Spark's default RANGE frame: rows tied on the order key share
-        one value); without one they reduce the whole partition."""
+        one value); without one they reduce the whole partition.
+        ``lag``/``lead`` shift ``value`` by ``offset`` rows within the
+        partition's order (out-of-partition positions yield null)."""
         normalized = []
         for k in order_by:
             if isinstance(k, str):
@@ -120,7 +122,8 @@ class Dataset:
                     f"Window order key must be a column name or a "
                     f"(column, ascending) pair, got {k!r}")
         return Dataset(Window(name, func, value, list(partition_by),
-                              normalized, self.plan), self.session)
+                              normalized, self.plan, offset=offset),
+                       self.session)
 
     def join(self, other: "Dataset", condition: Expr, how: str = "inner") -> "Dataset":
         return Dataset(Join(self.plan, other.plan, condition, how), self.session)

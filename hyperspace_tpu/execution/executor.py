@@ -1326,6 +1326,8 @@ def _window(table: pa.Table, plan: Window) -> pa.Table:
         out_type = {"row_number": pa.int32(), "rank": pa.int32(),
                     "dense_rank": pa.int32(), "count": pa.int64(),
                     "mean": pa.float64()}.get(plan.func)
+        if out_type is None and plan.func in ("lag", "lead"):
+            out_type = table.schema.field(plan.value).type
         if out_type is None and plan.func == "sum":
             # Same widening as _window_cast: the schema must not depend
             # on whether the input had rows.
@@ -1374,7 +1376,26 @@ def _window(table: pa.Table, plan: Window) -> pa.Table:
     tg = np.cumsum(new_tie) - 1  # tie-group id (global)
 
     func = plan.func
-    if func == "row_number":
+    if func in ("lag", "lead"):
+        # Exact index shift within partitions on the sorted layout — no
+        # pandas float round-trip (groupby().shift() promotes int64 to
+        # float64 and would silently round values above 2^53).  Arrow
+        # take preserves the value type bit-for-bit; out-of-partition
+        # positions null via the validity mask.
+        src_type = table.schema.field(plan.value).type
+        v_sorted = table.column(plan.value).take(perm)
+        if isinstance(v_sorted, pa.ChunkedArray):
+            v_sorted = v_sorted.combine_chunks()
+        shift = plan.offset if func == "lag" else -plan.offset
+        idx = np.arange(n) - shift
+        inb = (idx >= 0) & (idx < n)
+        rows = np.nonzero(inb)[0]
+        valid = np.zeros(n, dtype=bool)
+        valid[rows] = part[idx[rows]] == part[rows]
+        taken = v_sorted.take(pa.array(np.where(valid, idx, 0)))
+        out = pc.if_else(pa.array(valid), taken,
+                         pa.scalar(None, type=src_type))
+    elif func == "row_number":
         res = (part_s.groupby(part).cumcount() + 1).to_numpy()
         out = pa.array(res.astype(np.int32))
     elif func in ("rank", "dense_rank"):

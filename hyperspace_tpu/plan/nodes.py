@@ -229,24 +229,32 @@ class Window(LogicalPlan):
 
     RANKING = ("row_number", "rank", "dense_rank")
     AGGREGATES = ("sum", "min", "max", "mean", "count")
+    SHIFTS = ("lag", "lead")  # TPC-DS q47/q57's prev/next-period shape
 
     def __init__(self, name: str, func: str, value: Optional[str],
                  partition_by: Sequence[str],
                  order_by: Sequence[Tuple[str, bool]],
-                 child: LogicalPlan) -> None:
-        if func not in self.RANKING + self.AGGREGATES:
+                 child: LogicalPlan, offset: int = 1) -> None:
+        if func not in self.RANKING + self.AGGREGATES + self.SHIFTS:
             raise ValueError(
                 f"Unsupported window function {func!r}; one of "
-                f"{self.RANKING + self.AGGREGATES}")
-        if func in self.RANKING and not order_by:
+                f"{self.RANKING + self.AGGREGATES + self.SHIFTS}")
+        if func in self.RANKING + self.SHIFTS and not order_by:
             raise ValueError(f"{func}() requires an ORDER BY")
         if func in self.RANKING and value is not None:
             raise ValueError(f"{func}() takes no value column")
         if func in self.AGGREGATES and func != "count" and value is None:
             raise ValueError(f"window {func}() needs a value column")
+        if func in self.SHIFTS:
+            if value is None:
+                raise ValueError(f"{func}() needs a value column")
+            if not isinstance(offset, int) or offset < 0:
+                raise ValueError(f"{func}() offset must be a "
+                                 f"non-negative int, got {offset!r}")
         self.name = name
         self.func = func
         self.value = value
+        self.offset = int(offset)
         self.partition_by = tuple(partition_by)
         self.order_by = tuple((c, bool(a)) for c, a in order_by)
         self.children = (child,)
@@ -262,10 +270,12 @@ class Window(LogicalPlan):
     def with_children(self, children) -> "Window":
         (child,) = children
         return Window(self.name, self.func, self.value, self.partition_by,
-                      self.order_by, child)
+                      self.order_by, child, offset=self.offset)
 
     def simple_string(self) -> str:
         arg = self.value or ""
+        if self.func in self.SHIFTS:
+            arg = f"{arg}, {self.offset}"
         over = []
         if self.partition_by:
             over.append(f"PARTITION BY {', '.join(self.partition_by)}")

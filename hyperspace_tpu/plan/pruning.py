@@ -117,7 +117,8 @@ def _prune(plan: LogicalPlan, required: Optional[Set[str]],
         new_child = _prune(plan.child, child_required, schema_of)
         if new_child is not plan.child:
             return Window(plan.name, plan.func, plan.value,
-                          plan.partition_by, plan.order_by, new_child)
+                          plan.partition_by, plan.order_by, new_child,
+                          offset=plan.offset)
         return plan
     if isinstance(plan, Aggregate):
         # Like Project, an Aggregate defines exactly what its subtree must

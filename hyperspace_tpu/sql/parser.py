@@ -73,11 +73,13 @@ class _AggCall(Expr):
 
 
 class _WindowCall(Expr):
-    def __init__(self, func, value, partition_by, order_by) -> None:
+    def __init__(self, func, value, partition_by, order_by,
+                 offset: int = 1) -> None:
         self.func = func
         self.value = value
         self.partition_by = partition_by
         self.order_by = order_by
+        self.offset = offset
 
     def __repr__(self) -> str:
         return f"_window_{self.func}"
@@ -87,7 +89,7 @@ _AGG_FUNCS = {"sum": "sum", "min": "min", "max": "max", "avg": "mean",
               "mean": "mean", "count": "count", "stddev": "stddev",
               "variance": "variance"}
 _WINDOW_FUNCS = ("row_number", "rank", "dense_rank", "sum", "min", "max",
-                 "avg", "count")
+                 "avg", "count", "lag", "lead")
 _EXTRACT_FUNCS = {"year": "year", "month": "month", "day": "day",
                   "dayofmonth": "day", "quarter": "quarter"}
 
@@ -640,7 +642,7 @@ class _Parser:
                 self.fail("nullif() takes exactly two arguments")
             return Case([(BinOp("==", args[0], args[1]), Lit(None))],
                         args[0])
-        if len(args) > 1:
+        if len(args) > 1 and name not in ("lag", "lead"):
             self.fail(f"{name}() takes one argument")
         # OVER -> window call
         if self.at_kw("OVER"):
@@ -678,12 +680,24 @@ class _Parser:
                 self.fail("DISTINCT is not supported in window functions")
             func = {"avg": "mean"}.get(name, name)
             value = None
-            if func in ("sum", "min", "max", "mean", "count") \
-                    and arg is not None:
+            offset = 1
+            if func in ("sum", "min", "max", "mean", "count",
+                        "lag", "lead") and arg is not None:
                 if not isinstance(arg, Col):
-                    self.fail("window aggregate arguments must be columns")
+                    self.fail("window function arguments must be columns")
                 value = arg.name
-            return _WindowCall(func, value, partition, order)
+            if func in ("lag", "lead"):
+                if len(args) > 2:
+                    self.fail(f"{func}(value[, offset]) takes at most "
+                              f"two arguments")
+                if len(args) == 2:
+                    off = args[1]
+                    if not isinstance(off, Lit) \
+                            or not isinstance(off.value, int):
+                        self.fail(f"{func}() offset must be an integer "
+                                  f"literal")
+                    offset = off.value
+            return _WindowCall(func, value, partition, order, offset)
         if name in _AGG_FUNCS:
             func = _AGG_FUNCS[name]
             if name == "count":
@@ -852,7 +866,8 @@ def _lower(p: _Parser, ds, items, distinct, where, group_by, having,
 
     for alias, w in windows_to_apply:
         ds = ds.with_window(alias, w.func, partition_by=w.partition_by,
-                            order_by=w.order_by, value=w.value)
+                            order_by=w.order_by, value=w.value,
+                            offset=w.offset)
 
     if not star and out_items:
         names = [n for n, _e in out_items]

@@ -359,3 +359,79 @@ def test_window_sum_type_stable_on_empty_input(env):
              .collect())
     assert full.schema.field("sm").type == empty.schema.field("sm").type \
         == pa.int64()
+
+
+class TestLagLead:
+    def test_lag_lead_match_pandas(self, env):
+        s, data, df = env
+        out = (s.read.parquet(data)
+               .with_window("prev", "lag", partition_by=["grp"],
+                            order_by=["rid"], value="qty")
+               .with_window("nxt", "lead", partition_by=["grp"],
+                            order_by=["rid"], value="qty")
+               .with_window("prev2", "lag", partition_by=["grp"],
+                            order_by=["rid"], value="qty", offset=2)
+               .collect().to_pandas().sort_values("rid"))
+        base = df.sort_values("rid")
+        g = base.groupby("grp")["qty"]
+        pd.testing.assert_series_equal(
+            out["prev"].reset_index(drop=True),
+            g.shift(1).reset_index(drop=True), check_names=False)
+        pd.testing.assert_series_equal(
+            out["nxt"].reset_index(drop=True),
+            g.shift(-1).reset_index(drop=True), check_names=False)
+        pd.testing.assert_series_equal(
+            out["prev2"].reset_index(drop=True),
+            g.shift(2).reset_index(drop=True), check_names=False)
+        # Type preserved: qty is int64, shifted column stays int64
+        # (nulls at partition edges).
+        tbl = (s.read.parquet(data)
+               .with_window("p", "lag", partition_by=["grp"],
+                            order_by=["rid"], value="qty").collect())
+        assert tbl.schema.field("p").type == pa.int64()
+
+    def test_lag_from_sql_q47_shape(self, env):
+        """TPC-DS q47's prev-period comparison from SQL text."""
+        s, data, df = env
+        from hyperspace_tpu.sql import sql
+
+        ds = sql(s, """
+            SELECT grp, rid, qty,
+                   lag(qty, 1) OVER (PARTITION BY grp ORDER BY rid)
+                       AS prev_qty
+            FROM sales
+        """, tables={"sales": s.read.parquet(data)})
+        out = ds.collect().to_pandas().sort_values("rid")
+        want = df.sort_values("rid").groupby("grp")["qty"].shift(1)
+        pd.testing.assert_series_equal(
+            out["prev_qty"].reset_index(drop=True),
+            want.reset_index(drop=True), check_names=False)
+
+    def test_lag_requires_order_by(self, env):
+        s, data, _df = env
+        with pytest.raises(ValueError, match="ORDER BY"):
+            s.read.parquet(data).with_window(
+                "p", "lag", partition_by=["grp"], value="qty")
+
+
+def test_lag_preserves_int64_exactly(tmp_path):
+    """No pandas float round-trip: values above 2^53 survive lag/lead
+    bit-for-bit (review finding)."""
+    d = str(tmp_path / "big")
+    os.makedirs(d)
+    big = 2**53 + 1
+    pq.write_table(pa.table({
+        "g": pa.array([1, 1], type=pa.int64()),
+        "o": pa.array([1, 2], type=pa.int64()),
+        "v": pa.array([big, 7], type=pa.int64()),
+    }), os.path.join(d, "p.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    out = (s.read.parquet(d)
+           .with_window("p", "lag", partition_by=["g"], order_by=["o"],
+                        value="v")
+           .with_window("nx", "lead", partition_by=["g"], order_by=["o"],
+                        value="v")
+           .sort("o").collect())
+    assert out.column("p").to_pylist() == [None, big]
+    assert out.column("nx").to_pylist() == [7, None]
+    assert out.schema.field("p").type == pa.int64()
