@@ -220,3 +220,36 @@ def test_delta_hybrid_answer_equivalence(delta_catalog, pred):
     session.disable_hyperspace()
     expected = ds.collect()
     assert _canon(got) == _canon(expected), f"pred={pred!r}"
+
+
+@settings(max_examples=max(20, _EXAMPLES // 3), deadline=None,
+          suppress_health_check=[HealthCheck.function_scoped_fixture])
+@given(pred=predicates())
+def test_resident_cache_answer_equivalence(catalog, pred):
+    """With the HBM cache eager and the resident threshold at 1, device
+    routing fires across repeats — answers must match the host path for
+    ANY predicate, warm or cold."""
+    from hyperspace_tpu.execution.device_cache import global_cache
+
+    session, data = catalog
+    saved = (session.conf.device_cache_policy,
+             session.conf.device_resident_min_rows,
+             session.conf.device_filter_min_rows)
+    session.disable_hyperspace()
+    try:
+        session.conf.device_cache_policy = "off"
+        session.conf.device_filter_min_rows = 1 << 60
+        ds = session.read.parquet(data).filter(pred).select("a", "b", "f")
+        host = ds.collect()
+        session.conf.device_cache_policy = "eager"
+        session.conf.device_resident_min_rows = 1
+        session.conf.device_filter_min_rows = None
+        cold = ds.collect()   # populates eligible columns
+        warm = ds.collect()   # resident repeat
+        assert _canon(cold) == _canon(host), f"cold diverged: {pred!r}"
+        assert _canon(warm) == _canon(host), f"warm diverged: {pred!r}"
+    finally:
+        (session.conf.device_cache_policy,
+         session.conf.device_resident_min_rows,
+         session.conf.device_filter_min_rows) = saved
+        global_cache().clear()
