@@ -30,7 +30,7 @@ from hyperspace_tpu.plan.expr import (
 )
 from hyperspace_tpu.session import HyperspaceSession
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"
 
 __all__ = [
     "Hyperspace",
