@@ -532,3 +532,87 @@ def test_union_mismatched_names_rejected(env):
         sql(s, "SELECT o_orderkey FROM orders UNION ALL "
                "SELECT c_custkey FROM customer",
             tables=_tables(s, paths))
+
+
+class TestComposition:
+    """Cross-feature integration: each round-4 surface composed with the
+    others in single queries."""
+
+    def test_union_branch_with_exists(self, env):
+        s, paths = env
+        odf = pd.read_parquet(paths["orders"])
+        ldf = pd.read_parquet(paths["lineitem"])
+        out = sql(s, """
+            SELECT o_orderkey AS k FROM orders
+            WHERE EXISTS (SELECT 1 FROM lineitem l
+                          WHERE l.l_orderkey = orders.o_orderkey
+                            AND l.l_quantity > 48)
+            UNION
+            SELECT o_orderkey AS k FROM orders WHERE o_totalprice > 995
+            ORDER BY k
+        """, tables=_tables(s, paths)).collect()
+        big = set(ldf[ldf["l_quantity"] > 48]["l_orderkey"])
+        want = sorted(set(odf[odf["o_orderkey"].isin(big)]["o_orderkey"])
+                      | set(odf[odf["o_totalprice"] > 995]["o_orderkey"]))
+        assert out.column("k").to_pylist() == want
+
+    def test_window_over_derived_with_in_subquery(self, env):
+        s, paths = env
+        out = sql(s, """
+            SELECT * FROM (
+                SELECT o_custkey, o_totalprice,
+                       row_number() OVER (PARTITION BY o_custkey
+                                          ORDER BY o_totalprice DESC)
+                           AS rn
+                FROM orders
+                WHERE o_custkey IN (SELECT c_custkey FROM customer
+                                    WHERE c_mktsegment = 'BUILDING')
+            ) ranked
+            WHERE rn = 1 ORDER BY o_custkey
+        """, tables=_tables(s, paths)).collect().to_pandas()
+        odf = pd.read_parquet(paths["orders"])
+        cdf = pd.read_parquet(paths["customer"])
+        keys = set(cdf[cdf["c_mktsegment"] == "BUILDING"]["c_custkey"])
+        sub = odf[odf["o_custkey"].isin(keys)]
+        want = sub.groupby("o_custkey")["o_totalprice"].max()
+        assert len(out) == len(want)
+        np.testing.assert_allclose(
+            out.sort_values("o_custkey")["o_totalprice"].to_numpy(),
+            want.sort_index().to_numpy())
+
+    def test_year_exists_lag_in_one_query(self, env):
+        s, paths = env
+        ds = sql(s, """
+            SELECT o_custkey, o_orderkey,
+                   lag(o_totalprice) OVER (PARTITION BY o_custkey
+                                           ORDER BY o_orderkey) AS prev
+            FROM orders
+            WHERE year(o_orderdate) >= 1993
+              AND EXISTS (SELECT 1 FROM lineitem l
+                          WHERE l.l_orderkey = orders.o_orderkey)
+            ORDER BY o_custkey, o_orderkey
+        """, tables=_tables(s, paths))
+        tree = ds.optimized_plan().tree_string()
+        assert "year(" not in tree           # canonicalized through all
+        assert "semi" in tree.lower()        # EXISTS rewrote
+        out = ds.collect().to_pandas()
+        odf = pd.read_parquet(paths["orders"])
+        ldf = pd.read_parquet(paths["lineitem"])
+        sub = odf[(pd.to_datetime(odf["o_orderdate"]).dt.year >= 1993)
+                  & odf["o_orderkey"].isin(set(ldf["l_orderkey"]))]
+        assert len(out) == len(sub)
+        want = (sub.sort_values(["o_custkey", "o_orderkey"])
+                .groupby("o_custkey")["o_totalprice"].shift(1))
+        np.testing.assert_allclose(out["prev"].to_numpy(),
+                                   want.to_numpy(), equal_nan=True)
+
+    def test_scalar_subquery_with_coalesce_threshold(self, env):
+        s, paths = env
+        odf = pd.read_parquet(paths["orders"])
+        n = sql(s, """
+            SELECT o_orderkey FROM orders
+            WHERE coalesce(o_totalprice, 0.0) >
+                  (SELECT avg(o2.o_totalprice) AS a FROM orders o2)
+        """, tables=_tables(s, paths)).count()
+        assert n == int((odf["o_totalprice"]
+                         > odf["o_totalprice"].mean()).sum())
