@@ -165,6 +165,18 @@ class Dataset:
         ``.distinct()`` for SQL UNION."""
         return Dataset(Union([self.plan, other.plan]), self.session)
 
+    def cache(self) -> "Dataset":
+        """Materialize this dataset's CURRENT result and return a Dataset
+        over the in-memory table (Spark's ``df.cache()`` role, eagerly).
+        Later queries over it skip IO and re-optimization of the subtree;
+        underlying file changes no longer affect it (like a cached RDD).
+        Device-side residency is separate: the HBM column cache
+        (execution/device_cache.py) keeps hot INDEX columns on-chip
+        keyed by file identity."""
+        from hyperspace_tpu.plan.nodes import InMemory
+
+        return Dataset(InMemory(self.collect()), self.session)
+
     def group_by(self, *columns: str) -> "GroupedDataset":
         return GroupedDataset(self, columns)
 

@@ -524,11 +524,13 @@ class BucketUnion(LogicalPlan):
 
 
 class InMemory(LogicalPlan):
-    """A materialized table literal.  Execution-internal: the bucket-aligned
-    hybrid join routes appended rows through the build hash kernel into
-    per-bucket batches and re-injects each batch via this node (the analog
-    of the reference's on-the-fly RepartitionByExpression output,
-    RuleUtils.scala:511-570).  Never produced by the rewrite rules."""
+    """A materialized table literal — the leaf behind ``Dataset.cache()``
+    and two internal uses: the bucket-aligned hybrid join re-injects
+    hash-routed appended rows through it (the analog of the reference's
+    on-the-fly RepartitionByExpression output, RuleUtils.scala:511-570),
+    and the NOT-IN rewrite joins against its materialized subquery.
+    Rules treat it as an opaque leaf (it is not a Scan, so no index
+    rewrite applies)."""
 
     def __init__(self, table) -> None:
         self.table = table
@@ -538,8 +540,13 @@ class InMemory(LogicalPlan):
         return list(self.table.column_names)
 
     def with_children(self, children) -> "InMemory":
+        # A fresh node object, like Scan's handling in _uniquify: cached
+        # datasets reused under several branches (c.join(c, ...)) must
+        # not share one node identity, or identity-keyed rewrite state
+        # would cross-contaminate branches.  The TABLE is shared — only
+        # the plan node is remade.
         assert not children
-        return self
+        return InMemory(self.table)
 
     def simple_string(self) -> str:
         return f"InMemory [{self.table.num_rows} rows]"

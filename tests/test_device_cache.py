@@ -243,3 +243,90 @@ def test_eager_stops_lowering_after_budget_rejection(env):
     assert q() == 1000
     st2 = s.last_execution_stats
     assert st2["filters"][-1]["strategy"] == "host", st2["filters"]
+
+
+def test_refresh_rebuild_invalidates_index_residency(tmp_path):
+    """An index REFRESH writes a new version directory: the query's file
+    list (and so the cache fingerprint) changes, resident arrays from
+    the old version can never serve, and answers track the new data."""
+    from hyperspace_tpu import Hyperspace, IndexConfig
+
+    data = str(tmp_path / "data")
+    os.makedirs(data)
+    n = 20_000
+    pq.write_table(pa.table({
+        "k": pa.array(np.arange(n, dtype=np.int64)),
+        "v": pa.array(np.arange(n, dtype=np.int64) % 5),
+    }), os.path.join(data, "p.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    s.conf.num_buckets = 2
+    s.conf.device_cache_policy = "eager"
+    s.conf.device_resident_min_rows = 1
+    hs = Hyperspace(s)
+    hs.create_index(s.read.parquet(data), IndexConfig("rix", ["k"], ["v"]))
+    s.enable_hyperspace()
+    global_cache().clear()
+
+    def q():
+        return (s.read.parquet(data).filter(col("k") >= n - 100)
+                .select("k", "v").collect())
+
+    assert q().num_rows == 100
+    assert q().num_rows == 100  # warm: resident on the index files
+    assert s.last_execution_stats["filters"][-1]["resident"] is True
+    # Append source data + full refresh -> new v__=1 index files.
+    pq.write_table(pa.table({
+        "k": pa.array(np.arange(n, n + 50, dtype=np.int64)),
+        "v": pa.array(np.zeros(50, dtype=np.int64)),
+    }), os.path.join(data, "p2.parquet"))
+    hs.refresh_index("rix", mode="full")
+    out = q()
+    assert out.num_rows == 150  # new rows visible, no stale arrays
+    assert s.last_execution_stats["filters"][-1]["resident"] is False
+
+
+def test_dataset_cache_materializes(tmp_path):
+    d = str(tmp_path / "data")
+    os.makedirs(d)
+    pq.write_table(pa.table({"k": pa.array([1, 2, 3], type=pa.int64())}),
+                   os.path.join(d, "p.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    cached = s.read.parquet(d).filter(col("k") > 1).cache()
+    assert cached.count() == 2
+    # Like a cached RDD: later file changes do not affect it.
+    pq.write_table(pa.table({"k": pa.array([9], type=pa.int64())}),
+                   os.path.join(d, "p2.parquet"))
+    assert cached.count() == 2
+    assert s.read.parquet(d).filter(col("k") > 1).count() == 3
+    assert cached.filter(col("k") == 3).count() == 1
+
+
+def test_cached_dataset_self_join_uniquifies(tmp_path):
+    """A cached Dataset reused on both sides of a join is a DAG; the
+    optimizer's uniquify pass must split the shared InMemory leaf into
+    distinct node objects (identity-keyed rewrite state must not
+    cross-contaminate branches)."""
+    d = str(tmp_path / "data")
+    os.makedirs(d)
+    pq.write_table(pa.table({"k": pa.array([1, 2, 3], type=pa.int64()),
+                             "v": pa.array([10, 20, 30], type=pa.int64())}),
+                   os.path.join(d, "p.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    c = s.read.parquet(d).cache()
+    joined = c.join(c, col("k") == col("k"))
+    plan = joined.optimized_plan()
+    from hyperspace_tpu.plan.nodes import InMemory
+
+    leaves = []
+
+    def walk(p):
+        if isinstance(p, InMemory):
+            leaves.append(p)
+        for ch in p.children:
+            walk(ch)
+
+    walk(plan)
+    assert len(leaves) == 2
+    assert leaves[0] is not leaves[1]
+    assert leaves[0].table is leaves[1].table  # data itself stays shared
+    assert joined.collect().num_rows == 3
