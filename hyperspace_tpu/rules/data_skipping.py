@@ -23,16 +23,28 @@ from hyperspace_tpu.actions.data_skipping import (
     SKETCH_FILE_MTIME,
     SKETCH_FILE_NAME,
     SKETCH_FILE_SIZE,
+    SKETCH_ROW_COUNT,
     _bloom_col,
     _max_col,
     _min_col,
+    _null_col,
     _values_col,
     bloom_may_contain,
     bloom_positions,
     read_sketch,
 )
 from hyperspace_tpu.index.log_entry import IndexLogEntry, States
-from hyperspace_tpu.plan.expr import And, BinOp, Col, Expr, IsIn, Lit, Or
+from hyperspace_tpu.plan.expr import (
+    And,
+    BinOp,
+    Col,
+    Expr,
+    IsIn,
+    IsNull,
+    Lit,
+    Not,
+    Or,
+)
 from hyperspace_tpu.plan.nodes import Filter, LogicalPlan, Project, Scan, ScanRelation
 from hyperspace_tpu.rules import rule_utils
 from hyperspace_tpu.rules.filter_rule import _extract_filter_nodes
@@ -54,6 +66,12 @@ class _Constraint:
         self.hi = None
         self.hi_open = False
         self.values: Optional[set] = None  # IN / == value set
+        # Explicit null-ness constraints (IS NULL / IS NOT NULL):
+        # sketches store per-file null counts, so a file with no nulls
+        # cannot satisfy IS NULL, and an all-null file cannot satisfy
+        # IS NOT NULL.
+        self.require_null = False
+        self.require_non_null = False
 
     def add_cmp(self, op: str, value) -> None:
         if op == "==":
@@ -101,12 +119,19 @@ def _copy(c: _Constraint) -> _Constraint:
     out.lo, out.lo_open = c.lo, c.lo_open
     out.hi, out.hi_open = c.hi, c.hi_open
     out.values = None if c.values is None else set(c.values)
+    out.require_null = c.require_null
+    out.require_non_null = c.require_non_null
     return out
 
 
 def _is_false(c: _Constraint) -> bool:
-    """An intersected-to-empty value set: the branch matches no row."""
-    return c.values is not None and len(c.values) == 0
+    """An unsatisfiable constraint: empty value set, or IS NULL combined
+    with anything only non-null rows can satisfy."""
+    if c.values is not None and len(c.values) == 0:
+        return True
+    return c.require_null and (c.require_non_null
+                               or c.values is not None
+                               or c.lo is not None or c.hi is not None)
 
 
 def _union(a: _Constraint, b: _Constraint) -> Optional[_Constraint]:
@@ -120,6 +145,9 @@ def _union(a: _Constraint, b: _Constraint) -> Optional[_Constraint]:
     if _is_false(b):
         return _copy(a)
     out = _Constraint()
+    # Null-ness survives an OR only when BOTH branches require it.
+    out.require_null = a.require_null and b.require_null
+    out.require_non_null = a.require_non_null and b.require_non_null
     if a.values is not None and b.values is not None \
             and a.lo is None and a.hi is None and b.lo is None and b.hi is None:
         out.values = a.values | b.values
@@ -137,9 +165,12 @@ def _union(a: _Constraint, b: _Constraint) -> Optional[_Constraint]:
             lo_open = hi_open = False
         return lo, lo_open, hi, hi_open
 
+    def flags_only():
+        return out if (out.require_null or out.require_non_null) else None
+
     ba, bb = bounds(a), bounds(b)
     if ba is None or bb is None:
-        return None
+        return flags_only()
     try:
         if ba[0] is None or bb[0] is None:
             out.lo = None
@@ -153,14 +184,16 @@ def _union(a: _Constraint, b: _Constraint) -> Optional[_Constraint]:
                                       key=lambda t: (t[0], t[1]))
             out.hi_open = not out.hi_open
     except TypeError:
-        return None
+        return flags_only()
     if out.lo is None and out.hi is None:
-        return None
+        return flags_only()
     return out
 
 
 def _intersect_into(target: _Constraint, c: _Constraint) -> None:
     """AND ``c`` into ``target`` (both constrain the same column)."""
+    target.require_null |= c.require_null
+    target.require_non_null |= c.require_non_null
     if c.values is not None:
         target.values = set(c.values) if target.values is None \
             else target.values & c.values
@@ -188,6 +221,15 @@ def _analyze(expr: Expr) -> Optional[Dict[str, _Constraint]]:
         c = _Constraint()
         c.add_values(expr.values)
         return {expr.child.name.lower(): c}
+    if isinstance(expr, IsNull) and isinstance(expr.child, Col):
+        c = _Constraint()
+        c.require_null = True
+        return {expr.child.name.lower(): c}
+    if isinstance(expr, Not) and isinstance(expr.child, IsNull) \
+            and isinstance(expr.child.child, Col):
+        c = _Constraint()
+        c.require_non_null = True
+        return {expr.child.child.name.lower(): c}
     if isinstance(expr, And):
         left = _analyze(expr.left) or {}
         right = _analyze(expr.right) or {}
@@ -261,6 +303,19 @@ def _typed_probe(entry: IndexLogEntry, col_name: str,
 
 def _file_ok(row: dict, col_name: str, constraint: _Constraint,
              probe: _TypedProbe) -> bool:
+    if _is_false(constraint):
+        return False
+    nulls = row.get(_null_col(col_name))
+    if constraint.require_null and nulls is not None and nulls == 0:
+        return False  # no null anywhere in the file: IS NULL never holds
+    if constraint.require_non_null:
+        rows = row.get(SKETCH_ROW_COUNT)
+        if nulls is not None and rows is not None and nulls >= rows:
+            return False  # all-null file: IS NOT NULL never holds
+    if constraint.require_null:
+        # A null row satisfies no range/value constraint, so when ONLY
+        # null rows are wanted the min/max checks below do not apply.
+        return True
     fvalues = row.get(_values_col(col_name))
     if constraint.values is not None and fvalues is not None \
             and probe.values is not None:
@@ -325,11 +380,20 @@ class DataSkippingFilterRule:
 
         # Cheap predicate check FIRST: the file listing (a full directory
         # walk + stat) only happens when some entry can actually constrain.
+        # A bare IS NOT NULL (the ubiquitous join null-guard) is NOT
+        # actionable on its own — it could only drop fully-all-null
+        # files, which almost never exist, so paying the listing for it
+        # on every such query would be a poor trade.
+        def actionable(c: _Constraint) -> bool:
+            return (c.values is not None or c.lo is not None
+                    or c.hi is not None or c.require_null)
+
         with_constraints = []
         for entry in ds_entries:
             constraints = extract_constraints(
                 filter_node.condition, entry.derived_dataset.sketched_columns)
-            if constraints:
+            if constraints and any(actionable(c)
+                                   for c in constraints.values()):
                 with_constraints.append((entry, constraints))
         if not with_constraints:
             return None
@@ -425,6 +489,14 @@ def prune_index_files_by_sketch(entry: IndexLogEntry, condition: Expr
     if not entry.is_covering:
         return None
     constraints = extract_constraints(condition, entry.indexed_columns)
+    # This sketch stores min/max only: a require_null constraint cannot
+    # prune here — file_may_match treats None min/max (an all-null file)
+    # as non-matching, which is exactly the file holding the NULL rows.
+    # Drop those columns from consideration (always conservative).
+    # require_non_null-only constraints are sound as-is: the min/max-None
+    # rule prunes precisely the all-null files.
+    constraints = {c: k for c, k in constraints.items()
+                   if not k.require_null}
     if not constraints:
         return None
     files = [f.name for f in entry.content.file_infos()]

@@ -492,3 +492,129 @@ class TestBloomFilterSketch:
         session.disable_hyperspace()
         expected = ds.collect()
         assert got.num_rows == expected.num_rows == 1
+
+
+class TestNullnessPruning:
+    """IS [NOT] NULL prune on the sketches' per-file null counts."""
+
+    @pytest.fixture()
+    def null_env(self, tmp_path, session):
+        data = str(tmp_path / "nulldata")
+        os.makedirs(data)
+        # File 0: no nulls.  File 1: mixed.  File 2: all-null v.
+        pq.write_table(pa.table({
+            "id": pa.array([0, 1, 2], type=pa.int64()),
+            "v": pa.array([10, 11, 12], type=pa.int64())}),
+            os.path.join(data, "part-00000.parquet"))
+        pq.write_table(pa.table({
+            "id": pa.array([3, 4, 5], type=pa.int64()),
+            "v": pa.array([13, None, 15], type=pa.int64())}),
+            os.path.join(data, "part-00001.parquet"))
+        pq.write_table(pa.table({
+            "id": pa.array([6, 7, 8], type=pa.int64()),
+            "v": pa.array([None, None, None], type=pa.int64())}),
+            os.path.join(data, "part-00002.parquet"))
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(data),
+                        DataSkippingIndexConfig("nds", ["v"]))
+        session.enable_hyperspace()
+        return session, data
+
+    def _pruned_files(self, ds):
+        plan = ds.optimized_plan()
+        scans = [s for s in plan.leaf_relations()
+                 if s.relation.data_skipping_of]
+        assert scans, plan.tree_string()
+        return len(scans[0].relation.file_paths)
+
+    def test_is_null_prunes_no_null_files(self, null_env):
+        session, data = null_env
+        ds = (session.read.parquet(data)
+              .filter(col("v").is_null()).select("id"))
+        assert self._pruned_files(ds) == 2  # file 0 dropped
+        assert sorted(ds.collect().column("id").to_pylist()) == [4, 6, 7, 8]
+
+    def test_bare_is_not_null_not_actionable(self, null_env):
+        """The ubiquitous join null-guard must not pay the listing cost:
+        a bare IS NOT NULL triggers no DS rewrite (answers unchanged)."""
+        session, data = null_env
+        ds = (session.read.parquet(data)
+              .filter(col("v").is_not_null()).select("id"))
+        plan = ds.optimized_plan()
+        assert not [s for s in plan.leaf_relations()
+                    if s.relation.data_skipping_of], plan.tree_string()
+        assert sorted(ds.collect().column("id").to_pylist()) \
+            == [0, 1, 2, 3, 5]
+
+    def test_is_not_null_with_range_prunes_all_null_files(self, null_env):
+        session, data = null_env
+        ds = (session.read.parquet(data)
+              .filter(col("v").is_not_null() & (col("v") >= 13))
+              .select("id"))
+        assert self._pruned_files(ds) == 1  # files 0 (range) + 2 (nulls)
+        assert sorted(ds.collect().column("id").to_pylist()) == [3, 5]
+
+    def test_null_and_range_contradiction_prunes_to_schema_file(
+            self, null_env):
+        """v IS NULL AND v > 5 is unsatisfiable: the rule prunes to the
+        single schema-retention file and the filter yields zero rows."""
+        session, data = null_env
+        ds = (session.read.parquet(data)
+              .filter(col("v").is_null() & (col("v") > 5)).select("id"))
+        assert self._pruned_files(ds) == 1
+        assert ds.collect().num_rows == 0
+
+    def test_or_keeps_nullness_only_when_both_branches(self, null_env):
+        session, data = null_env
+        # One branch IS NULL, the other a range: no null constraint
+        # survives the OR; range union also unusable -> full file list.
+        ds = (session.read.parquet(data)
+              .filter(col("v").is_null() | (col("v") >= 13)).select("id"))
+        got = sorted(ds.collect().column("id").to_pylist())
+        assert got == [3, 4, 5, 6, 7, 8]
+        # Both branches null-requiring: still prunes file 0.
+        ds2 = (session.read.parquet(data)
+               .filter(col("v").is_null() | col("v").is_null())
+               .select("id"))
+        assert self._pruned_files(ds2) == 2
+
+    def test_answers_match_unindexed(self, null_env):
+        session, data = null_env
+        for pred in (col("v").is_null(), col("v").is_not_null(),
+                     col("v").is_null() & (col("v") > 5),
+                     col("v").is_null() | (col("v") >= 13)):
+            ds = session.read.parquet(data).filter(pred).select("id")
+            session.enable_hyperspace()
+            on = sorted(ds.collect().column("id").to_pylist())
+            session.disable_hyperspace()
+            off = sorted(ds.collect().column("id").to_pylist())
+            session.enable_hyperspace()
+            assert on == off, pred
+
+
+def test_covering_sketch_never_prunes_null_holders(tmp_path, session):
+    """Review regression: an IS NULL predicate through the COVERING-index
+    sketch path (min/max only) must keep the all-null index files — they
+    are exactly the files holding the matching rows."""
+    data = str(tmp_path / "cidata")
+    os.makedirs(data)
+    n = 6000
+    vals = pa.array([float(i) if i % 3 else None for i in range(n)])
+    pq.write_table(pa.table({
+        "k": pa.array(np.arange(n, dtype=np.int64)),
+        "v": vals,
+    }), os.path.join(data, "p.parquet"))
+    session.conf.num_buckets = 1
+    session.conf.index_max_rows_per_file = 1000
+    hs = Hyperspace(session)
+    hs.create_index(session.read.parquet(data),
+                    IndexConfig("ci_null", ["v"], ["k"]))
+    session.conf.index_max_rows_per_file = 0
+    session.enable_hyperspace()
+    ds = session.read.parquet(data).filter(col("v").is_null()).select("k")
+    on = sorted(ds.collect().column("k").to_pylist())
+    session.disable_hyperspace()
+    off = sorted(ds.collect().column("k").to_pylist())
+    session.enable_hyperspace()
+    assert on == off
+    assert len(on) == n // 3
