@@ -17,14 +17,20 @@ from hyperspace_tpu.hyperspace import Hyperspace
 from hyperspace_tpu.index.index_config import DataSkippingIndexConfig, IndexConfig
 from hyperspace_tpu.plan.expr import (
     col,
+    concat,
     dayofmonth,
     exists,
     in_subquery,
+    length,
     lit,
+    lower,
     month,
     outer_ref,
     quarter,
     scalar,
+    substring,
+    trim,
+    upper,
     when,
     year,
 )
@@ -51,4 +57,10 @@ __all__ = [
     "in_subquery",
     "outer_ref",
     "exists",
+    "upper",
+    "lower",
+    "length",
+    "trim",
+    "substring",
+    "concat",
 ]

@@ -42,6 +42,7 @@ from hyperspace_tpu.plan.expr import (
     Neg,
     Not,
     Or,
+    StringFn,
     StringMatch,
 )
 from hyperspace_tpu.plan.nodes import (
@@ -1737,6 +1738,40 @@ def _arrow_eval(expr: Expr, table: pa.Table):
         # Spark's year()/month()/... return INT (32-bit); arrow yields
         # int64 — match Spark so downstream casts/joins see the same type.
         return pc.cast(out, pa.int32())
+    if isinstance(expr, StringFn):
+        args = [_arrow_eval(a, table) for a in expr.args]
+        if expr.name == "upper":
+            return pc.utf8_upper(args[0])
+        if expr.name == "lower":
+            return pc.utf8_lower(args[0])
+        if expr.name == "length":
+            return pc.cast(pc.utf8_length(args[0]), pa.int32())
+        if expr.name == "trim":
+            return pc.utf8_trim_whitespace(args[0])
+        if expr.name == "ltrim":
+            return pc.utf8_ltrim_whitespace(args[0])
+        if expr.name == "rtrim":
+            return pc.utf8_rtrim_whitespace(args[0])
+        if expr.name == "substring":
+            # SQL 1-based start (validated >= 1 at construction).
+            begin = expr.args[1].value - 1
+            if len(expr.args) == 2:
+                return pc.utf8_slice_codeunits(args[0], begin)
+            return pc.utf8_slice_codeunits(args[0], begin,
+                                           begin + expr.args[2].value)
+        # concat: Spark casts every part to string and nulls the WHOLE
+        # result when any part is null.  Scalars stay scalars —
+        # binary_join_element_wise broadcasts them without an O(rows)
+        # literal array.
+        def as_str(part):
+            t = part.type
+            if not (pa.types.is_string(t) or pa.types.is_large_string(t)):
+                part = pc.cast(part, pa.string())
+            return part
+
+        parts = [as_str(a) for a in args]
+        return pc.binary_join_element_wise(
+            *parts, "", null_handling="emit_null")
     if isinstance(expr, StringMatch):
         child = _arrow_eval(expr.child, table)
         if expr.kind == "like":

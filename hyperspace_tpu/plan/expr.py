@@ -325,6 +325,79 @@ def when(condition: Expr, value: Any) -> CaseBuilder:
     return CaseBuilder([(condition, _lift(value))])
 
 
+class StringFn(Expr):
+    """Scalar string functions — Spark's upper/lower/length/trim/
+    substring/concat surface (the reference rides Spark; TPC-H Q22's
+    ``substring(c_phone, 1, 2)`` is the canonical use).  Host-evaluated
+    (strings never take the device path).  SQL semantics: null inputs
+    null the result (concat nulls if ANY argument is null); substring is
+    1-BASED with an optional length."""
+
+    NAMES = ("upper", "lower", "length", "trim", "ltrim", "rtrim",
+             "substring", "concat")
+
+    def __init__(self, name: str, args: Sequence["Expr"]) -> None:
+        if name not in self.NAMES:
+            raise ValueError(f"Unsupported string function {name!r}; "
+                             f"one of {self.NAMES}")
+        if name == "substring":
+            if len(args) not in (2, 3):
+                raise ValueError("substring(expr, start[, length])")
+            for a in args[1:]:
+                if not (isinstance(a, Lit) and isinstance(a.value, int)
+                        and not isinstance(a.value, bool)):
+                    raise ValueError(
+                        "substring start/length must be integer literals")
+            if args[1].value < 1:
+                raise ValueError(
+                    "substring start is 1-BASED and must be >= 1 "
+                    "(Spark's 0/negative-start forms are not supported)")
+            if len(args) == 3 and args[2].value < 0:
+                raise ValueError("substring length must be >= 0")
+        elif name == "concat":
+            if len(args) < 2:
+                raise ValueError("concat needs at least two arguments")
+        elif len(args) != 1:
+            raise ValueError(f"{name}() takes one argument")
+        self.name = name
+        self.args = tuple(args)
+
+    def __repr__(self) -> str:
+        inner = ", ".join(repr(a) for a in self.args)
+        return f"{self.name}({inner})"
+
+
+def upper(e: "Expr | str") -> StringFn:
+    return StringFn("upper", [Col(e) if isinstance(e, str) else e])
+
+
+def lower(e: "Expr | str") -> StringFn:
+    return StringFn("lower", [Col(e) if isinstance(e, str) else e])
+
+
+def length(e: "Expr | str") -> StringFn:
+    return StringFn("length", [Col(e) if isinstance(e, str) else e])
+
+
+def trim(e: "Expr | str") -> StringFn:
+    return StringFn("trim", [Col(e) if isinstance(e, str) else e])
+
+
+def substring(e: "Expr | str", start: int, length_: "int | None" = None
+              ) -> StringFn:
+    """SQL SUBSTRING: 1-based ``start``, optional ``length``."""
+    args = [Col(e) if isinstance(e, str) else e, Lit(int(start))]
+    if length_ is not None:
+        args.append(Lit(int(length_)))
+    return StringFn("substring", args)
+
+
+def concat(*parts: "Expr | str") -> StringFn:
+    return StringFn("concat",
+                    [Col(p) if isinstance(p, str) else _lift(p)
+                     for p in parts])
+
+
 class Extract(Expr):
     """Calendar field extraction from a date/timestamp expression —
     Spark's ``year(d_date)`` / ``month(...)`` / ``dayofmonth(...)`` /
@@ -497,6 +570,9 @@ def _collect_columns(e: Expr, out: Set[str]) -> None:
         _collect_columns(e.child, out)
     elif isinstance(e, InSubquery):
         _collect_columns(e.child, out)
+    elif isinstance(e, StringFn):
+        for a in e.args:
+            _collect_columns(a, out)
     # ScalarSubquery/OuterRef: no OUTER columns of their own; the
     # subquery rewrite runs before any pass that consumes column sets.
     elif isinstance(e, Case):

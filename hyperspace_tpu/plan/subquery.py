@@ -59,6 +59,7 @@ from hyperspace_tpu.plan.expr import (
     Or,
     OuterRef,
     ScalarSubquery,
+    StringFn,
     StringMatch,
     split_conjuncts,
 )
@@ -88,6 +89,9 @@ def _walk_exprs(e: Expr, fn) -> None:
         c = getattr(e, attr, None)
         if isinstance(c, Expr):
             _walk_exprs(c, fn)
+    if isinstance(e, StringFn):
+        for a in e.args:
+            _walk_exprs(a, fn)
     if isinstance(e, Case):
         for c, v in e.branches:
             _walk_exprs(c, fn)
@@ -153,6 +157,8 @@ def _map_expr(e: Expr, fn) -> Expr:
         return fn(Extract(e.field, _map_expr(e.child, fn)))
     if isinstance(e, StringMatch):
         return fn(StringMatch(e.kind, _map_expr(e.child, fn), e.pattern))
+    if isinstance(e, StringFn):
+        return fn(StringFn(e.name, [_map_expr(a, fn) for a in e.args]))
     if isinstance(e, Case):
         return fn(Case([(_map_expr(c, fn), _map_expr(v, fn))
                         for c, v in e.branches],

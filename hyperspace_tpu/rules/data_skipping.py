@@ -492,11 +492,14 @@ def prune_index_files_by_sketch(entry: IndexLogEntry, condition: Expr
     # This sketch stores min/max only: a require_null constraint cannot
     # prune here — file_may_match treats None min/max (an all-null file)
     # as non-matching, which is exactly the file holding the NULL rows.
-    # Drop those columns from consideration (always conservative).
-    # require_non_null-only constraints are sound as-is: the min/max-None
-    # rule prunes precisely the all-null files.
+    # And a require_non_null-ONLY constraint (the ubiquitous join
+    # null-guard) could only drop fully-all-null index files, which
+    # never repays the listing + sketch reads — same actionability
+    # trade as DataSkippingFilterRule.  Keep value/range constraints.
     constraints = {c: k for c, k in constraints.items()
-                   if not k.require_null}
+                   if not k.require_null
+                   and (k.values is not None or k.lo is not None
+                        or k.hi is not None)}
     if not constraints:
         return None
     files = [f.name for f in entry.content.file_infos()]

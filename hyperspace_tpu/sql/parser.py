@@ -51,6 +51,7 @@ from hyperspace_tpu.plan.expr import (
     Or,
     OuterRef,
     ScalarSubquery,
+    StringFn,
     StringMatch,
 )
 
@@ -627,6 +628,32 @@ class _Parser:
             while self.take_op(","):
                 args.append(self.parse_expr())
         self.expect_op(")")
+        if name in ("substr", "substring"):
+            if distinct or star:
+                self.fail("substring() takes plain expression arguments")
+            if len(args) not in (2, 3):
+                self.fail("substring(expr, start[, length])")
+            folded = [args[0]]
+            for a in args[1:]:
+                if isinstance(a, Neg) and isinstance(a.child, Lit):
+                    a = Lit(-a.child.value)  # unary minus parses as Neg
+                if not (isinstance(a, Lit) and isinstance(a.value, int)
+                        and not isinstance(a.value, bool)):
+                    self.fail("substring start/length must be integer "
+                              "literals")
+                folded.append(a)
+            try:
+                return StringFn("substring", folded)
+            except ValueError as e:
+                self.fail(str(e))
+        if name in ("upper", "lower", "length", "trim", "ltrim", "rtrim"):
+            if distinct or star or len(args) != 1:
+                self.fail(f"{name}() takes one argument")
+            return StringFn(name, args)
+        if name == "concat":
+            if distinct or star or len(args) < 2:
+                self.fail("concat() needs at least two plain arguments")
+            return StringFn("concat", args)
         if name in ("coalesce", "ifnull", "nvl", "nullif") \
                 and (distinct or star):
             self.fail(f"{name}() takes plain expression arguments")

@@ -1,0 +1,148 @@
+"""String scalar functions: upper/lower/length/trim/substring/concat.
+
+The reference gets these from Spark (TPC-H Q22 uses
+``substring(c_phone, 1, 2)``); here they are host-evaluated arrow
+kernels with Spark null semantics.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import (
+    HyperspaceSession,
+    col,
+    concat,
+    length,
+    lit,
+    lower,
+    substring,
+    trim,
+    upper,
+)
+from hyperspace_tpu.sql import SqlError, sql
+
+
+@pytest.fixture()
+def env(tmp_path):
+    d = str(tmp_path / "data")
+    os.makedirs(d)
+    pq.write_table(pa.table({
+        "k": pa.array([0, 1, 2, 3], type=pa.int64()),
+        "s": pa.array(["Hello", "  pad  ", None, "13-555-0101"]),
+        "t": pa.array(["X", "Y", "Z", None]),
+    }), os.path.join(d, "p.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    return s, d
+
+
+def test_basic_functions(env):
+    s, d = env
+    out = (s.read.parquet(d)
+           .select("k", u=upper("s"), lo=lower("s"), n=length("s"),
+                   tr=trim("s"))
+           .collect())
+    assert out.column("u").to_pylist() == ["HELLO", "  PAD  ", None,
+                                           "13-555-0101"]
+    assert out.column("lo").to_pylist() == ["hello", "  pad  ", None,
+                                            "13-555-0101"]
+    assert out.column("n").to_pylist() == [5, 7, None, 11]
+    assert out.schema.field("n").type == pa.int32()  # Spark INT
+    assert out.column("tr").to_pylist() == ["Hello", "pad", None,
+                                            "13-555-0101"]
+
+
+def test_substring_one_based_and_clamps(env):
+    s, d = env
+    out = (s.read.parquet(d)
+           .select(a=substring("s", 1, 2), b=substring("s", 4),
+                   c=substring("s", 1, 0))
+           .collect())
+    assert out.column("a").to_pylist() == ["He", "  ", None, "13"]
+    assert out.column("b").to_pylist() == ["lo", "ad  ", None, "555-0101"]
+    assert out.column("c").to_pylist() == ["", "", None, ""]
+
+
+def test_concat_nulls_whole_result(env):
+    s, d = env
+    out = (s.read.parquet(d)
+           .select(j=concat("s", lit("-"), "t"))
+           .collect())
+    # Spark: any null part nulls the concat.
+    assert out.column("j").to_pylist() == ["Hello-X", "  pad  -Y", None,
+                                           None]
+
+
+def test_q22_phone_prefix_shape(env):
+    """The real Q22 shape: substring(c_phone, 1, 2) IN (...)."""
+    s, d = env
+    n = (s.read.parquet(d)
+         .filter(substring("s", 1, 2).isin(["13", "He"]))
+         .count())
+    assert n == 2
+
+
+def test_sql_surface(env):
+    s, d = env
+    out = sql(s, """
+        SELECT k, upper(s) AS u, substring(s, 1, 2) AS pre,
+               concat(t, '_', t) AS tt, length(trim(s)) AS n
+        FROM t WHERE s IS NOT NULL ORDER BY k
+    """, tables={"t": d}).collect()
+    assert out.column("u").to_pylist() == ["HELLO", "  PAD  ",
+                                           "13-555-0101"]
+    assert out.column("pre").to_pylist() == ["He", "  ", "13"]
+    assert out.column("tt").to_pylist() == ["X_X", "Y_Y", None]
+    assert out.column("n").to_pylist() == [5, 3, 11]
+    # In WHERE too.
+    n = sql(s, "SELECT k FROM t WHERE substring(s, 1, 2) = '13'",
+            tables={"t": d}).count()
+    assert n == 1
+
+
+def test_sql_errors(env):
+    s, d = env
+    with pytest.raises(SqlError, match="one argument"):
+        sql(s, "SELECT upper(s, t) AS x FROM t", tables={"t": d})
+    with pytest.raises(SqlError, match="integer literals"):
+        sql(s, "SELECT substring(s, k) AS x FROM t", tables={"t": d})
+
+
+def test_composes_with_group_and_subquery(env):
+    s, d = env
+    out = sql(s, """
+        SELECT substring(s, 1, 1) AS first_ch, count(*) AS n
+        FROM t WHERE s IS NOT NULL
+        GROUP BY first_ch ORDER BY first_ch
+    """, tables={"t": d}).collect()
+    assert out.column("first_ch").to_pylist() == [" ", "1", "H"]
+    assert out.column("n").to_pylist() == [1, 1, 1]
+
+
+def test_substring_rejects_nonpositive_start(env):
+    with pytest.raises(ValueError, match="1-BASED"):
+        substring("s", 0, 3)
+    with pytest.raises(ValueError, match="length must be"):
+        substring("s", 1, -2)
+
+
+def test_sql_substring_errors_are_sql_errors(env):
+    s, d = env
+    with pytest.raises(SqlError, match="1-BASED"):
+        sql(s, "SELECT substring(s, 0, 2) AS x FROM t", tables={"t": d})
+    with pytest.raises(SqlError, match="integer literals"):
+        sql(s, "SELECT substring(s, TRUE) AS x FROM t", tables={"t": d})
+    with pytest.raises(SqlError, match="1-BASED"):
+        sql(s, "SELECT substring(s, -1, 2) AS x FROM t", tables={"t": d})
+
+
+def test_concat_casts_non_strings(env):
+    s, d = env
+    out = sql(s, "SELECT k, concat(t, '_', k) AS x FROM t ORDER BY k",
+              tables={"t": d}).collect()
+    assert out.column("x").to_pylist() == ["X_0", "Y_1", "Z_2", None]
