@@ -96,10 +96,12 @@ class Dataset:
     def with_window(self, name: str, func: str,
                     partition_by: Sequence[str] = (),
                     order_by: Sequence = (),
-                    value: str = None, offset: int = 1) -> "Dataset":
+                    value: str = None, offset: int = 1,
+                    frame=None) -> "Dataset":
         """Append one analytic column: ``func(value) OVER (PARTITION BY
-        partition_by ORDER BY order_by)`` — Spark's window surface
-        (rank/row_number/dense_rank/sum/min/max/mean/count).
+        partition_by ORDER BY order_by [ROWS frame])`` — Spark's window
+        surface (rank/row_number/dense_rank/ntile/sum/min/max/mean/
+        count/lag/lead/first_value/last_value).
 
             df.with_window("rk", "rank", partition_by=["grp"],
                            order_by=[("revenue", False)])
@@ -109,7 +111,12 @@ class Dataset:
         (Spark's default RANGE frame: rows tied on the order key share
         one value); without one they reduce the whole partition.
         ``lag``/``lead`` shift ``value`` by ``offset`` rows within the
-        partition's order (out-of-partition positions yield null)."""
+        partition's order (out-of-partition positions yield null);
+        ``ntile`` reads its tile count from ``offset``.  ``frame`` is an
+        explicit ROWS frame as an (lo, hi) pair of row offsets relative
+        to the current row (negative = preceding, None = unbounded):
+        ``frame=(None, 0)`` is ROWS BETWEEN UNBOUNDED PRECEDING AND
+        CURRENT ROW, ``frame=(-2, 2)`` a centered 5-row frame."""
         normalized = []
         for k in order_by:
             if isinstance(k, str):
@@ -121,8 +128,15 @@ class Dataset:
                 raise ValueError(
                     f"Window order key must be a column name or a "
                     f"(column, ascending) pair, got {k!r}")
+        if frame is not None:
+            if (not isinstance(frame, (tuple, list)) or len(frame) != 2):
+                raise ValueError(
+                    f"frame must be an (lo, hi) pair of row offsets "
+                    f"(None = unbounded), got {frame!r}")
+            frame = (frame[0], frame[1])
         return Dataset(Window(name, func, value, list(partition_by),
-                              normalized, self.plan, offset=offset),
+                              normalized, self.plan, offset=offset,
+                              frame=frame),
                        self.session)
 
     def join(self, other: "Dataset", condition: Expr, how: str = "inner") -> "Dataset":

@@ -1317,40 +1317,93 @@ def _sort_indices(table: pa.Table, keys) -> pa.Array:
     return pc.sort_indices(work, sort_keys=sort_keys)
 
 
+def _window_empty_type(table: pa.Table, plan: Window):
+    """Output type for a zero-row input — must match the rowful path so
+    the schema doesn't depend on whether the input had rows."""
+    out_type = {"row_number": pa.int32(), "rank": pa.int32(),
+                "dense_rank": pa.int32(), "ntile": pa.int32(),
+                "count": pa.int64(),
+                "mean": pa.float64()}.get(plan.func)
+    if out_type is None and plan.func in ("lag", "lead", "first_value",
+                                          "last_value"):
+        out_type = table.schema.field(plan.value).type
+    if out_type is None and plan.func == "sum":
+        src = table.schema.field(plan.value).type
+        out_type = pa.int64() \
+            if pa.types.is_integer(src) or pa.types.is_boolean(src) \
+            else pa.float64()
+    if out_type is None:  # min/max follow the input column
+        out_type = table.schema.field(plan.value).type \
+            if plan.value else pa.int64()
+    return out_type
+
+
+def _np_window_values(v_sorted: pa.Array):
+    """(values, valid) numpy views of a sorted Arrow column for the
+    frame kernels: temporals/bools lower to their integer repr so int
+    arithmetic stays exact; nulls are filled with 0 and tracked in
+    ``valid``.  Non-numeric types return (None, valid) — the caller
+    decides whether that's an error or an Arrow-side path."""
+    t = v_sorted.type
+    valid = np.asarray(pc.is_valid(v_sorted)
+                       .to_numpy(zero_copy_only=False))
+    num = None
+    if pa.types.is_boolean(t):
+        num = v_sorted.cast(pa.int8())
+    elif pa.types.is_date32(t) or pa.types.is_time32(t):
+        num = v_sorted.cast(pa.int32())
+    elif (pa.types.is_date64(t) or pa.types.is_time64(t)
+            or pa.types.is_timestamp(t) or pa.types.is_duration(t)):
+        num = v_sorted.cast(pa.int64())
+    elif pa.types.is_integer(t) or pa.types.is_floating(t):
+        num = v_sorted
+    # Decimals deliberately return None: a float64 view would sum with
+    # rounded increments and could argmin the WRONG row when two
+    # decimals collide at float precision — the caller picks an exact
+    # Arrow-side path or fails loudly.
+    if num is None:
+        return None, valid
+    filled = pc.fill_null(num, pa.scalar(0, type=num.type)
+                          if not pa.types.is_floating(num.type)
+                          else pa.scalar(0.0, type=num.type))
+    vals = filled.to_numpy(zero_copy_only=False)
+    return vals, valid
+
+
+def _whole_partition_agg_arrow(v_sorted: pa.Array, part: np.ndarray,
+                               func: str) -> pa.Array:
+    """Whole-partition aggregates for types the numpy kernels don't
+    take (strings, binary, decimals): Arrow hash aggregation broadcast
+    back by the dense partition code — exact in the value's own type."""
+    t = pa.table({"__c": pa.array(part), "__v": v_sorted})
+    agg = t.group_by("__c").aggregate([("__v", func)])
+    agg = agg.sort_by("__c")
+    by_code = agg.column(f"__v_{func}")
+    if isinstance(by_code, pa.ChunkedArray):
+        by_code = by_code.combine_chunks()
+    return by_code.take(pa.array(part))
+
+
 def _window(table: pa.Table, plan: Window) -> pa.Table:
-    """One analytic column over ``table`` (host path: sort + segmented
-    pandas scans).  Semantics in the Window node's docstring."""
-    import pandas as pd
+    """One analytic column over ``table``: sort once by (partition,
+    order keys), then evaluate with the vectorized segment kernels in
+    :mod:`hyperspace_tpu.ops.window` — no per-partition Python/pandas
+    loop.  Semantics in the Window node's docstring."""
+    from hyperspace_tpu.ops import window as W
 
     n = table.num_rows
     if n == 0:
-        out_type = {"row_number": pa.int32(), "rank": pa.int32(),
-                    "dense_rank": pa.int32(), "count": pa.int64(),
-                    "mean": pa.float64()}.get(plan.func)
-        if out_type is None and plan.func in ("lag", "lead"):
-            out_type = table.schema.field(plan.value).type
-        if out_type is None and plan.func == "sum":
-            # Same widening as _window_cast: the schema must not depend
-            # on whether the input had rows.
-            src = table.schema.field(plan.value).type
-            out_type = pa.int64() if pa.types.is_integer(src) \
-                else pa.float64()
-        if out_type is None:  # min/max follow the input column
-            out_type = table.schema.field(plan.value).type \
-                if plan.value else pa.int64()
-        return table.append_column(plan.name,
-                                   pa.array([], type=out_type))
+        return table.append_column(
+            plan.name, pa.array([], type=_window_empty_type(table, plan)))
 
-    # Partition codes: null-safe grouping over the partition columns.
-    if plan.partition_by:
-        pdf = table.select(list(plan.partition_by)).to_pandas()
-        part_orig = pdf.groupby(list(plan.partition_by), dropna=False,
-                                sort=False).ngroup().to_numpy()
-    else:
-        part_orig = np.zeros(n, dtype=np.int64)
-    work = table.append_column("__part", pa.array(part_orig))
-    perm = _sort_indices(
-        work, [("__part", True)] + list(plan.order_by))
+    part_orig = W.partition_codes(table, plan.partition_by)
+    pname = "__part"
+    suffix = 1
+    while pname in table.column_names:  # user column collision guard
+        pname = f"__part__{suffix}"
+        suffix += 1
+    work = table.append_column(pname, pa.array(part_orig))
+    perm = _sort_indices(work, [(pname, True)] + list(plan.order_by))
     perm_np = np.asarray(perm)
     part = part_orig[perm_np]
     new_part = np.empty(n, dtype=bool)
@@ -1373,16 +1426,12 @@ def _window(table: pa.Table, plan: Window) -> pa.Table:
         same = (valid[1:] == valid[:-1]) & (~valid[1:] | eq)
         new_tie[1:] |= ~same.astype(bool)
 
-    part_s = pd.Series(part)
-    tg = np.cumsum(new_tie) - 1  # tie-group id (global)
-
+    part_start, part_end = W.segment_bounds(new_part)
     func = plan.func
     if func in ("lag", "lead"):
-        # Exact index shift within partitions on the sorted layout — no
-        # pandas float round-trip (groupby().shift() promotes int64 to
-        # float64 and would silently round values above 2^53).  Arrow
-        # take preserves the value type bit-for-bit; out-of-partition
-        # positions null via the validity mask.
+        # Exact index shift within partitions on the sorted layout;
+        # Arrow take preserves the value type bit-for-bit and
+        # out-of-partition positions null via the validity mask.
         src_type = table.schema.field(plan.value).type
         v_sorted = table.column(plan.value).take(perm)
         if isinstance(v_sorted, pa.ChunkedArray):
@@ -1397,73 +1446,85 @@ def _window(table: pa.Table, plan: Window) -> pa.Table:
         out = pc.if_else(pa.array(valid), taken,
                          pa.scalar(None, type=src_type))
     elif func == "row_number":
-        res = (part_s.groupby(part).cumcount() + 1).to_numpy()
-        out = pa.array(res.astype(np.int32))
-    elif func in ("rank", "dense_rank"):
-        dense = pd.Series(new_tie.astype(np.int64)) \
-            .groupby(part).cumsum().to_numpy()
-        if func == "dense_rank":
-            out = pa.array(dense.astype(np.int32))
-        else:
-            rn = (part_s.groupby(part).cumcount() + 1).to_numpy()
-            first_rn = pd.Series(rn).groupby(tg).transform("first") \
-                .to_numpy()
-            out = pa.array(first_rn.astype(np.int32))
+        out = pa.array(W.row_number(part_start))
+    elif func == "rank":
+        out = pa.array(W.rank_from_ties(part_start, new_tie))
+    elif func == "dense_rank":
+        out = pa.array(W.dense_rank_from_ties(new_part, new_tie))
+    elif func == "ntile":
+        out = pa.array(W.ntile(part_start, part_end, plan.offset))
     else:
+        _, tie_end = W.segment_bounds(new_tie)
+        lo, hi = W.frame_bounds(part_start, part_end, tie_end,
+                                plan.frame, bool(plan.order_by))
         src_type = table.schema.field(plan.value).type if plan.value \
             else None
+        v_sorted = None
         if plan.value is not None:
-            v = table.column(plan.value).take(perm).to_pandas()
+            v_sorted = table.column(plan.value).take(perm)
+            if isinstance(v_sorted, pa.ChunkedArray):
+                v_sorted = v_sorted.combine_chunks()
+        if func in ("first_value", "last_value"):
+            arg, nonempty = W.frame_first_last(lo, hi,
+                                               func == "first_value")
+            taken = v_sorted.take(pa.array(arg))
+            out = pc.if_else(pa.array(nonempty), taken,
+                             pa.scalar(None, type=src_type))
+        elif func == "count" and plan.value is None:
+            out = pa.array(W.frame_count(None, lo, hi))
         else:
-            v = pd.Series(np.ones(n))  # count(*): every row counts
-        valid_v = v.notna()
-        if not plan.order_by:
-            # Whole-partition aggregate.
-            if func == "count":
-                res = valid_v.groupby(part).transform("sum") \
-                    .to_numpy().astype(np.int64)
-                out = pa.array(res)
-            else:
-                r = v.groupby(part).transform(func)
-                # pandas sums an all-null group to 0; Spark keeps null.
-                any_valid = valid_v.groupby(part).transform("any")
-                r[~any_valid] = None
-                out = _window_cast(r, func, src_type)
-        else:
-            # Running aggregate over the RANGE frame: cumulative within
-            # the partition, then rows tied on the order key share the
-            # tie group's LAST value.
-            cnt = valid_v.astype(np.int64).groupby(part).cumsum()
-            if func == "count":
-                r = cnt.astype("float64")
-            elif func in ("sum", "mean"):
-                filled = v.fillna(0.0) if v.dtype.kind == "f" \
-                    else v.fillna(0)
-                r = filled.groupby(part).cumsum().astype("float64") \
-                    if func == "mean" else filled.groupby(part).cumsum()
-                if func == "mean":
-                    r = r / cnt.to_numpy()
-            else:  # min / max
-                try:
-                    r = getattr(v.groupby(part), f"cum{func}")()
-                except (TypeError, NotImplementedError) as e:
+            vals, valid = _np_window_values(v_sorted)
+            if vals is None:
+                # Strings/binary/decimals: exact Arrow hash-agg path for
+                # whole-partition shapes, loud error for running frames
+                # (parity with the round-4 engine; decimals additionally
+                # avoid a lossy float64 round-trip).
+                whole = plan.frame is None and not plan.order_by
+                arrow_funcs = ("min", "max") \
+                    if not pa.types.is_decimal(v_sorted.type) \
+                    else ("min", "max", "sum", "mean")
+                if func in arrow_funcs and whole:
+                    out = _whole_partition_agg_arrow(v_sorted, part, func)
+                    if func in ("sum", "mean"):
+                        out = pc.cast(out, pa.float64())
+                elif func == "count":
+                    out = pa.array(W.frame_count(valid, lo, hi))
+                else:
                     raise ValueError(
                         f"Running window {func}() over a "
-                        f"{v.dtype} column is not supported; drop the "
-                        f"ORDER BY for a whole-partition {func}, or "
-                        f"cast the column to a numeric/temporal type"
-                    ) from e
-                # NaN rows don't poison, but their position shows NaN:
-                # carry the previous extremum forward within the
-                # partition (Spark ignores nulls in the frame).
-                r = r.groupby(part).ffill()
-            r = pd.Series(np.asarray(r)).groupby(tg).transform("last")
-            r[cnt.groupby(tg).transform("last").to_numpy() == 0] = None
-            if func == "count":
-                out = pa.array(pd.Series(r).fillna(0).to_numpy()
-                               .astype(np.int64))
-            else:
-                out = _window_cast(pd.Series(r), func, src_type)
+                        f"{v_sorted.type} column is not supported; "
+                        f"drop the ORDER BY for a whole-partition "
+                        f"{func}, or cast the column to a "
+                        f"numeric/temporal type")
+            elif func == "count":
+                out = pa.array(W.frame_count(valid, lo, hi))
+            elif func == "sum":
+                sums, cnt = W.frame_sum(vals, valid, lo, hi)
+                if vals.dtype.kind == "u":
+                    # uint64 sums computed in uint64; the int64 result
+                    # column overflows loudly, never wraps.
+                    if sums.size and sums.max() > np.iinfo(np.int64).max:
+                        raise ValueError(
+                            "window sum() over a uint64 column "
+                            "overflows the int64 result type")
+                    out = pa.array(sums.astype(np.int64),
+                                   mask=(cnt == 0))
+                elif vals.dtype.kind in "ib":
+                    out = pa.array(sums.astype(np.int64),
+                                   mask=(cnt == 0))
+                else:
+                    out = pa.array(sums.astype(np.float64),
+                                   mask=(cnt == 0))
+            elif func == "mean":
+                means, cnt = W.frame_mean(vals, valid, lo, hi)
+                out = pa.array(means, mask=(cnt == 0))
+            else:  # min / max
+                arg, cnt = W.frame_min_max(
+                    vals, valid, lo, hi, part_start, part_end,
+                    plan.frame, is_min=(func == "min"))
+                taken = v_sorted.take(pa.array(arg))
+                out = pc.if_else(pa.array(cnt > 0), taken,
+                                 pa.scalar(None, type=src_type))
     # Scatter back to the original row order.
     inverse = np.empty(n, dtype=np.int64)
     inverse[perm_np] = np.arange(n)
@@ -1472,21 +1533,6 @@ def _window(table: pa.Table, plan: Window) -> pa.Table:
         return table.set_column(table.column_names.index(plan.name),
                                 plan.name, out)
     return table.append_column(plan.name, out)
-
-
-def _window_cast(series, func: str, src_type) -> pa.Array:
-    """Result typing: mean -> float64; sum widens int->int64 and keeps
-    float64; min/max restore the INPUT type (dates stay dates)."""
-    arr = pa.Array.from_pandas(series)
-    if func == "mean":
-        return pc.cast(arr, pa.float64())
-    if func == "sum":
-        if src_type is not None and pa.types.is_integer(src_type):
-            return pc.cast(arr, pa.int64())
-        return pc.cast(arr, pa.float64())
-    if src_type is not None and arr.type != src_type:
-        return pc.cast(arr, src_type)
-    return arr
 
 
 def _concat_horizontal(left: pa.Table, right: pa.Table) -> pa.Table:

@@ -1,0 +1,355 @@
+"""Vectorized window-function kernels over sorted partition segments.
+
+The executor sorts the table once by (partition, order keys) and hands the
+engine plain numpy arrays in that sorted layout; every window function is
+then a segment operation with NO per-partition Python or pandas loop:
+
+  - ranking (row_number/rank/dense_rank/ntile): arithmetic on the
+    partition/tie boundary masks;
+  - frame aggregates (sum/count/mean): prefix-sum differences, with an
+    exact int64 path for integer inputs (no float64 round-trip — values
+    above 2^53 stay exact);
+  - frame min/max: ARGmin/ARGmax so the result is always taken from the
+    source Arrow column and keeps its type bit-for-bit (dates stay
+    dates).  Prefix/suffix frames use a Hillis–Steele doubling scan
+    (O(n log n), clamped at partition starts); frames bounded on both
+    sides use a sparse-table range query;
+  - first_value/last_value: a take at the frame boundary row.
+
+Frames are ROWS frames [lo_i, hi_i] (inclusive, sorted coordinates)
+computed by :func:`frame_bounds`; the default SQL RANGE frame (UNBOUNDED
+PRECEDING .. CURRENT ROW with peers) is expressed as lo = partition
+start, hi = tie-group end, so one engine serves both.
+
+Reference contract: Spark's window exec consumed by the corpus queries
+(TPC-DS q51 `ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW`,
+/root/reference/src/test/resources/tpcds/queries/q51.sql:1-8; q36/q44
+rank() shapes).  Spark semantics matched: null order, peers share RANGE
+frame values, aggregate null-if-empty-frame, NaN treated as missing in
+running min/max (matching the round-4 pandas engine).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+__all__ = [
+    "partition_codes", "segment_bounds", "frame_bounds",
+    "row_number", "rank_from_ties", "dense_rank_from_ties", "ntile",
+    "frame_count", "frame_sum", "frame_mean", "frame_min_max",
+    "frame_first_last",
+]
+
+
+def partition_codes(table: pa.Table, keys: Sequence[str]) -> np.ndarray:
+    """Null-safe group codes (int64) for the partition columns: equal
+    tuples (nulls equal to nulls, Spark grouping semantics) share a
+    code.  Codes are dense but NOT ordered by value — only identity
+    matters, the sort orders them."""
+    n = table.num_rows
+    if not keys:
+        return np.zeros(n, dtype=np.int64)
+    combined = np.zeros(n, dtype=np.int64)
+    for name in keys:
+        col = table.column(name)
+        if isinstance(col, pa.ChunkedArray):
+            col = col.combine_chunks()
+        enc = col.dictionary_encode()
+        idx = pc.fill_null(enc.indices, -1).to_numpy(zero_copy_only=False)
+        card = len(enc.dictionary) + 1  # +1 for the null slot
+        codes = idx.astype(np.int64) + 1
+        if combined.size and card > 1:
+            hi = combined.max() if n else 0
+            if hi > (2**62) // card:
+                # Re-densify to dodge int64 overflow on wide key spaces.
+                _, combined = np.unique(combined, return_inverse=True)
+        combined = combined * card + codes
+    _, dense = np.unique(combined, return_inverse=True)
+    return dense.astype(np.int64)
+
+
+def segment_bounds(new_seg: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-row segment start/end indices (inclusive) from a boundary
+    mask over the SORTED layout (``new_seg[0]`` must be True)."""
+    n = new_seg.shape[0]
+    idx = np.arange(n, dtype=np.int64)
+    start = np.maximum.accumulate(np.where(new_seg, idx, 0))
+    seg_id = np.cumsum(new_seg) - 1
+    last = np.zeros(seg_id[-1] + 1 if n else 0, dtype=np.int64)
+    last[seg_id] = idx  # later rows win: per-segment last index
+    end = last[seg_id]
+    return start, end
+
+
+def frame_bounds(part_start: np.ndarray, part_end: np.ndarray,
+                 tie_end: Optional[np.ndarray],
+                 frame: Optional[Tuple[Optional[int], Optional[int]]],
+                 has_order: bool) -> Tuple[np.ndarray, np.ndarray]:
+    """Inclusive [lo, hi] row-index bounds per row, sorted coordinates.
+
+    frame=None reproduces SQL defaults: whole partition without ORDER
+    BY, RANGE UNBOUNDED PRECEDING..CURRENT ROW (peers included, via
+    ``tie_end``) with one.  An explicit ROWS frame (lo_off, hi_off) uses
+    offsets relative to the current row, None meaning unbounded."""
+    n = part_start.shape[0]
+    idx = np.arange(n, dtype=np.int64)
+    if frame is None:
+        if not has_order:
+            return part_start, part_end
+        return part_start, tie_end
+    lo_off, hi_off = frame
+    lo = part_start if lo_off is None else \
+        np.maximum(part_start, idx + lo_off)
+    hi = part_end if hi_off is None else np.minimum(part_end, idx + hi_off)
+    return lo, hi
+
+
+# ---------------------------------------------------------------- ranking
+
+def row_number(part_start: np.ndarray) -> np.ndarray:
+    n = part_start.shape[0]
+    return (np.arange(n, dtype=np.int64) - part_start + 1) \
+        .astype(np.int32)
+
+
+def dense_rank_from_ties(new_part: np.ndarray,
+                         new_tie: np.ndarray) -> np.ndarray:
+    n = new_part.shape[0]
+    cum = np.cumsum(new_tie.astype(np.int64))
+    # Tie-changes counted before each partition start (the start row's
+    # own tie flag is always set, hence cum-1 there).
+    offset = np.maximum.accumulate(np.where(new_part, cum - 1, 0))
+    return (cum - offset).astype(np.int32)
+
+
+def rank_from_ties(part_start: np.ndarray,
+                   new_tie: np.ndarray) -> np.ndarray:
+    n = part_start.shape[0]
+    rn = np.arange(n, dtype=np.int64) - part_start + 1
+    tie_start = np.maximum.accumulate(
+        np.where(new_tie, np.arange(n, dtype=np.int64), 0))
+    return rn[tie_start].astype(np.int32)
+
+
+def ntile(part_start: np.ndarray, part_end: np.ndarray,
+          k: int) -> np.ndarray:
+    """Spark NTile: the first ``size % k`` buckets get one extra row."""
+    i = np.arange(part_start.shape[0], dtype=np.int64) - part_start
+    size = part_end - part_start + 1
+    base, rem = size // k, size % k
+    cut = rem * (base + 1)
+    big = i // np.maximum(base + 1, 1)
+    small = rem + (i - cut) // np.maximum(base, 1)
+    return (np.where(i < cut, big, small) + 1).astype(np.int32)
+
+
+# ----------------------------------------------------------- frame aggs
+
+def _prefix(x: np.ndarray) -> np.ndarray:
+    out = np.zeros(x.shape[0] + 1, dtype=x.dtype)
+    np.cumsum(x, out=out[1:])
+    return out
+
+
+def frame_count(valid: Optional[np.ndarray], lo: np.ndarray,
+                hi: np.ndarray) -> np.ndarray:
+    """count(value) over the frame (valid=None → count(*))."""
+    n = lo.shape[0]
+    if valid is None:
+        return np.maximum(hi - lo + 1, 0)
+    c = _prefix(valid.astype(np.int64))
+    safe_hi = np.minimum(hi + 1, n)
+    out = c[safe_hi] - c[np.minimum(lo, n)]
+    return np.where(hi < lo, 0, out)
+
+
+def frame_sum(vals: np.ndarray, valid: np.ndarray, lo: np.ndarray,
+              hi: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """(sums, valid_counts).  Integer input sums exactly in int64 (never
+    through float64 — the round-4 advisor caught 2^55+3 rounding);
+    uint64 sums in its own domain (an int64 view would wrap values
+    above 2^63); floats sum in float64."""
+    if vals.dtype.kind == "u":
+        work = np.where(valid, vals, 0).astype(np.uint64)
+    elif vals.dtype.kind in "ib":
+        work = np.where(valid, vals, 0).astype(np.int64)
+    else:
+        work = np.where(valid, vals, 0.0).astype(np.float64)
+    s, c = _prefix(work), _prefix(valid.astype(np.int64))
+    n = vals.shape[0]
+    safe_hi, safe_lo = np.minimum(hi + 1, n), np.minimum(lo, n)
+    sums = s[safe_hi] - s[safe_lo]
+    cnt = np.where(hi < lo, 0, c[safe_hi] - c[safe_lo])
+    return np.where(cnt > 0, sums, 0), cnt
+
+
+def frame_mean(vals: np.ndarray, valid: np.ndarray, lo: np.ndarray,
+               hi: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    if vals.dtype.kind in "iub":
+        work = np.where(valid, vals, 0).astype(np.float64)
+    else:
+        work = np.where(valid, vals, 0.0).astype(np.float64)
+    s, c = _prefix(work), _prefix(valid.astype(np.int64))
+    n = vals.shape[0]
+    safe_hi, safe_lo = np.minimum(hi + 1, n), np.minimum(lo, n)
+    cnt = np.where(hi < lo, 0, c[safe_hi] - c[safe_lo])
+    with np.errstate(invalid="ignore", divide="ignore"):
+        mean = (s[safe_hi] - s[safe_lo]) / cnt
+    return mean, cnt
+
+
+def _arg_scan(work: np.ndarray, part_start: np.ndarray,
+              pick_smaller: bool) -> np.ndarray:
+    """Hillis–Steele prefix ARGmin/ARGmax clamped at partition starts:
+    after the k-th pass res[i] is the argext over
+    [max(part_start_i, i-2^k+1), i]; log2(n) numpy passes, no
+    per-partition loop."""
+    n = work.shape[0]
+    idx = np.arange(n, dtype=np.int64)
+    arg = idx.copy()
+    best = work.copy()
+    shift = 1
+    while shift < n:
+        src = idx - shift
+        ok = src >= part_start
+        if not ok.any():
+            break
+        s_best = best[src[ok]]
+        s_arg = arg[src[ok]]
+        cur = best[ok]
+        take = s_best < cur if pick_smaller else s_best > cur
+        # Ties keep the earlier (leftmost) row for determinism.
+        tie = (s_best == cur) & (s_arg < arg[ok])
+        take |= tie
+        nb, na = cur.copy(), arg[ok].copy()
+        nb[take], na[take] = s_best[take], s_arg[take]
+        best = best.copy()
+        arg = arg.copy()
+        best[ok], arg[ok] = nb, na
+        shift *= 2
+    return arg
+
+
+def _sparse_arg(work: np.ndarray, lo: np.ndarray, hi: np.ndarray,
+                pick_smaller: bool) -> np.ndarray:
+    """Sparse-table range ARGext for frames bounded on both sides.
+    Memory O(n · log max_width); widths here are the (small) constant
+    ROWS offsets, clamped at partition edges."""
+    n = work.shape[0]
+    width = np.maximum(hi - lo + 1, 1)
+    max_w = int(width.max()) if n else 1
+    levels = max(int(np.floor(np.log2(max_w))), 0)
+    val_tab = [work]
+    arg_tab = [np.arange(n, dtype=np.int64)]
+    for k in range(1, levels + 1):
+        half = 1 << (k - 1)
+        if half >= n:
+            break
+        prev_v, prev_a = val_tab[-1], arg_tab[-1]
+        left_v, right_v = prev_v[:n - half], prev_v[half:]
+        left_a, right_a = prev_a[:n - half], prev_a[half:]
+        take = right_v < left_v if pick_smaller else right_v > left_v
+        take = take | ((right_v == left_v) & (right_a < left_a))
+        nv, na = left_v.copy(), left_a.copy()
+        nv[take], na[take] = right_v[take], right_a[take]
+        val_tab.append(np.concatenate([nv, prev_v[n - half:]]))
+        arg_tab.append(np.concatenate([na, prev_a[n - half:]]))
+    k_i = np.floor(np.log2(width)).astype(np.int64)
+    out = np.empty(n, dtype=np.int64)
+    for k in range(levels + 1):
+        mask = k_i == k
+        if not mask.any():
+            continue
+        span = 1 << k
+        a = lo[mask]
+        b = hi[mask] - span + 1
+        va, aa = val_tab[k][a], arg_tab[k][a]
+        vb, ab = val_tab[k][np.maximum(b, 0)], arg_tab[k][np.maximum(b, 0)]
+        take = vb < va if pick_smaller else vb > va
+        take = take | ((vb == va) & (ab < aa))
+        res = aa.copy()
+        res[take] = ab[take]
+        out[mask] = res
+    return out
+
+
+def frame_min_max(vals: np.ndarray, valid: np.ndarray, lo: np.ndarray,
+                  hi: np.ndarray, part_start: np.ndarray,
+                  part_end: np.ndarray,
+                  frame: Optional[Tuple[Optional[int], Optional[int]]],
+                  is_min: bool) -> Tuple[np.ndarray, np.ndarray]:
+    """(arg_rows, valid_counts): the row index (sorted coordinates) of
+    the frame extremum per row — the caller takes from the source Arrow
+    column so any orderable type keeps its exact representation.  NaN
+    and null are skipped (sentinel-filled), matching the round-4
+    engine; an all-skipped frame is nulled via the count."""
+    if vals.dtype.kind == "u":
+        # uint64 stays in its own domain: an int64 view would wrap
+        # values above 2^63 and mis-order the comparisons.
+        work = vals.astype(np.uint64, copy=True)
+        sentinel = np.iinfo(np.uint64).max if is_min else 0
+        skip = ~valid
+    elif vals.dtype.kind == "i":
+        work = vals.astype(np.int64, copy=True)
+        sentinel = np.iinfo(np.int64).max if is_min \
+            else np.iinfo(np.int64).min
+        skip = ~valid
+    elif vals.dtype.kind == "b":
+        work = vals.astype(np.int64)
+        sentinel = np.iinfo(np.int64).max if is_min \
+            else np.iinfo(np.int64).min
+        skip = ~valid
+    elif vals.dtype.kind == "M":  # datetime64 — view as int64, NaT skip
+        work = vals.view("i8").astype(np.int64, copy=True)
+        sentinel = np.iinfo(np.int64).max if is_min \
+            else np.iinfo(np.int64).min
+        skip = ~valid
+    elif vals.dtype.kind == "f":
+        work = vals.astype(np.float64, copy=True)
+        sentinel = np.inf if is_min else -np.inf
+        skip = ~valid | np.isnan(vals.astype(np.float64))
+    else:
+        raise ValueError(
+            f"Running window min/max over a {vals.dtype} column is not "
+            f"supported; drop the ORDER BY for a whole-partition "
+            f"reduction, or cast the column to a numeric/temporal type")
+    work[skip] = sentinel
+    eff_valid = ~skip
+
+    # Empty frames (hi < lo, possible when a bounded offset lands past
+    # the partition) are masked by cnt==0 below — clamp the indexing so
+    # the gather itself can't go out of bounds.
+    n_rows = work.shape[0]
+    lo_c = np.clip(lo, 0, n_rows - 1)
+    hi_c = np.clip(hi, 0, n_rows - 1)
+    lo_unbounded = frame is None or frame[0] is None
+    hi_unbounded = frame is not None and frame[1] is None
+    if lo_unbounded:
+        scan = _arg_scan(work, part_start, pick_smaller=is_min)
+        arg = scan[hi_c]
+    elif hi_unbounded:
+        # Suffix frame: mirror the array and run the prefix scan.
+        rev_work = work[::-1].copy()
+        rev_start = (n_rows - 1) - part_end[::-1]
+        scan = _arg_scan(rev_work, rev_start, pick_smaller=is_min)
+        arg = (n_rows - 1) - scan[(n_rows - 1) - lo_c]
+    else:
+        arg = _sparse_arg(work, np.minimum(lo_c, hi_c), hi_c,
+                          pick_smaller=is_min)
+    c = _prefix(eff_valid.astype(np.int64))
+    n = vals.shape[0]
+    cnt = np.where(hi < lo, 0,
+                   c[np.minimum(hi + 1, n)] - c[np.minimum(lo, n)])
+    return arg, cnt
+
+
+def frame_first_last(lo: np.ndarray, hi: np.ndarray,
+                     first: bool) -> Tuple[np.ndarray, np.ndarray]:
+    """(arg_rows, nonempty_mask) for first_value/last_value: the frame
+    boundary row itself (Spark default respects nulls)."""
+    arg = lo if first else hi
+    nonempty = hi >= lo
+    return np.where(nonempty, arg, 0), nonempty

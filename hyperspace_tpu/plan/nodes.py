@@ -227,21 +227,26 @@ class Window(LogicalPlan):
     ranking functions deterministic regardless of input order.
     """
 
-    RANKING = ("row_number", "rank", "dense_rank")
+    RANKING = ("row_number", "rank", "dense_rank", "ntile")
     AGGREGATES = ("sum", "min", "max", "mean", "count")
     SHIFTS = ("lag", "lead")  # TPC-DS q47/q57's prev/next-period shape
+    POSITIONAL = ("first_value", "last_value")
 
     def __init__(self, name: str, func: str, value: Optional[str],
                  partition_by: Sequence[str],
                  order_by: Sequence[Tuple[str, bool]],
-                 child: LogicalPlan, offset: int = 1) -> None:
-        if func not in self.RANKING + self.AGGREGATES + self.SHIFTS:
+                 child: LogicalPlan, offset: int = 1,
+                 frame: Optional[Tuple[Optional[int],
+                                       Optional[int]]] = None) -> None:
+        all_funcs = (self.RANKING + self.AGGREGATES + self.SHIFTS
+                     + self.POSITIONAL)
+        if func not in all_funcs:
             raise ValueError(
                 f"Unsupported window function {func!r}; one of "
-                f"{self.RANKING + self.AGGREGATES + self.SHIFTS}")
+                f"{all_funcs}")
         if func in self.RANKING + self.SHIFTS and not order_by:
             raise ValueError(f"{func}() requires an ORDER BY")
-        if func in self.RANKING and value is not None:
+        if func in self.RANKING and func != "ntile" and value is not None:
             raise ValueError(f"{func}() takes no value column")
         if func in self.AGGREGATES and func != "count" and value is None:
             raise ValueError(f"window {func}() needs a value column")
@@ -251,10 +256,35 @@ class Window(LogicalPlan):
             if not isinstance(offset, int) or offset < 0:
                 raise ValueError(f"{func}() offset must be a "
                                  f"non-negative int, got {offset!r}")
+        if func == "ntile":
+            if not isinstance(offset, int) or offset < 1:
+                raise ValueError(f"ntile(n) needs a positive integer "
+                                 f"tile count, got {offset!r}")
+            if value is not None:
+                raise ValueError("ntile() takes no value column")
+        if func in self.POSITIONAL and value is None:
+            raise ValueError(f"{func}() needs a value column")
+        if frame is not None:
+            if func not in self.AGGREGATES + self.POSITIONAL:
+                raise ValueError(
+                    f"A ROWS frame only applies to aggregate/"
+                    f"first_value/last_value windows, not {func}()")
+            if not order_by:
+                raise ValueError("A ROWS frame requires an ORDER BY")
+            lo, hi = frame
+            for b in (lo, hi):
+                if b is not None and not isinstance(b, int):
+                    raise ValueError(f"Frame bounds must be ints or "
+                                     f"None (unbounded), got {b!r}")
+            if lo is not None and hi is not None and lo > hi:
+                raise ValueError(
+                    f"Frame lower bound {lo} is above upper bound {hi}")
+            frame = (lo, hi)
         self.name = name
         self.func = func
         self.value = value
         self.offset = int(offset)
+        self.frame = frame
         self.partition_by = tuple(partition_by)
         self.order_by = tuple((c, bool(a)) for c, a in order_by)
         self.children = (child,)
@@ -270,12 +300,25 @@ class Window(LogicalPlan):
     def with_children(self, children) -> "Window":
         (child,) = children
         return Window(self.name, self.func, self.value, self.partition_by,
-                      self.order_by, child, offset=self.offset)
+                      self.order_by, child, offset=self.offset,
+                      frame=self.frame)
+
+    @staticmethod
+    def _bound_string(off: Optional[int], upper: bool) -> str:
+        if off is None:
+            return ("UNBOUNDED FOLLOWING" if upper
+                    else "UNBOUNDED PRECEDING")
+        if off == 0:
+            return "CURRENT ROW"
+        return (f"{off} FOLLOWING" if off > 0
+                else f"{-off} PRECEDING")
 
     def simple_string(self) -> str:
         arg = self.value or ""
         if self.func in self.SHIFTS:
             arg = f"{arg}, {self.offset}"
+        elif self.func == "ntile":
+            arg = str(self.offset)
         over = []
         if self.partition_by:
             over.append(f"PARTITION BY {', '.join(self.partition_by)}")
@@ -283,6 +326,10 @@ class Window(LogicalPlan):
             keys = ", ".join(f"{c}{'' if a else ' DESC'}"
                              for c, a in self.order_by)
             over.append(f"ORDER BY {keys}")
+        if self.frame is not None:
+            lo, hi = self.frame
+            over.append(f"ROWS BETWEEN {self._bound_string(lo, False)} "
+                        f"AND {self._bound_string(hi, True)}")
         return (f"Window {self.name} := {self.func}({arg}) "
                 f"OVER ({' '.join(over)})")
 

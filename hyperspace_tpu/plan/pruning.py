@@ -118,7 +118,7 @@ def _prune(plan: LogicalPlan, required: Optional[Set[str]],
         if new_child is not plan.child:
             return Window(plan.name, plan.func, plan.value,
                           plan.partition_by, plan.order_by, new_child,
-                          offset=plan.offset)
+                          offset=plan.offset, frame=plan.frame)
         return plan
     if isinstance(plan, Aggregate):
         # Like Project, an Aggregate defines exactly what its subtree must

@@ -75,12 +75,13 @@ class _AggCall(Expr):
 
 class _WindowCall(Expr):
     def __init__(self, func, value, partition_by, order_by,
-                 offset: int = 1) -> None:
+                 offset: int = 1, frame=None) -> None:
         self.func = func
         self.value = value
         self.partition_by = partition_by
         self.order_by = order_by
         self.offset = offset
+        self.frame = frame
 
     def __repr__(self) -> str:
         return f"_window_{self.func}"
@@ -89,8 +90,9 @@ class _WindowCall(Expr):
 _AGG_FUNCS = {"sum": "sum", "min": "min", "max": "max", "avg": "mean",
               "mean": "mean", "count": "count", "stddev": "stddev",
               "variance": "variance"}
-_WINDOW_FUNCS = ("row_number", "rank", "dense_rank", "sum", "min", "max",
-                 "avg", "count", "lag", "lead")
+_WINDOW_FUNCS = ("row_number", "rank", "dense_rank", "ntile", "sum",
+                 "min", "max", "avg", "count", "lag", "lead",
+                 "first_value", "last_value")
 _EXTRACT_FUNCS = {"year": "year", "month": "month", "day": "day",
                   "dayofmonth": "day", "quarter": "quarter"}
 
@@ -228,6 +230,67 @@ class _Parser:
         if t[0] != "num":
             self.fail("expected a number after LIMIT")
         return int(t[1])
+
+    def _parse_frame_bound(self):
+        """One frame bound → ("unb", ±1) or ("off", signed_row_offset)."""
+        if self.take_kw("UNBOUNDED"):
+            if self.take_kw("PRECEDING"):
+                return ("unb", -1)
+            if self.take_kw("FOLLOWING"):
+                return ("unb", 1)
+            self.fail("expected PRECEDING or FOLLOWING after UNBOUNDED")
+        if self.take_kw("CURRENT"):
+            self.expect_kw("ROW")
+            return ("off", 0)
+        t = self.next()
+        if t[0] != "num" or "." in str(t[1]):
+            self.fail("expected UNBOUNDED, CURRENT ROW, or an integer "
+                      "frame offset")
+        k = int(t[1])
+        if self.take_kw("PRECEDING"):
+            return ("off", -k)
+        if self.take_kw("FOLLOWING"):
+            return ("off", k)
+        self.fail("expected PRECEDING or FOLLOWING after the frame "
+                  "offset")
+
+    def parse_frame_clause(self):
+        """Optional window frame.  ROWS frames lower to the engine's
+        (lo, hi) row-offset pair (None = unbounded); RANGE accepts only
+        the shapes equal to SQL's DEFAULT frame (UNBOUNDED PRECEDING ..
+        CURRENT ROW, the form TPC-DS q51 spells out —
+        /root/reference/src/test/resources/tpcds/queries/q51.sql:1-8)
+        and returns None so peers share values."""
+        is_range = False
+        if self.take_kw("ROWS"):
+            pass
+        elif self.take_kw("RANGE"):
+            is_range = True
+        else:
+            return None
+        if self.take_kw("BETWEEN"):
+            lo_b = self._parse_frame_bound()
+            self.expect_kw("AND")
+            hi_b = self._parse_frame_bound()
+        else:  # SQL shorthand: <bound> means BETWEEN <bound> AND CURRENT
+            lo_b = self._parse_frame_bound()
+            hi_b = ("off", 0)
+        if lo_b == ("unb", 1):
+            self.fail("frame cannot start at UNBOUNDED FOLLOWING")
+        if hi_b == ("unb", -1):
+            self.fail("frame cannot end at UNBOUNDED PRECEDING")
+        lo = None if lo_b[0] == "unb" else lo_b[1]
+        hi = None if hi_b[0] == "unb" else hi_b[1]
+        if is_range:
+            if not (lo is None and hi == 0):
+                self.fail("Only RANGE BETWEEN UNBOUNDED PRECEDING AND "
+                          "CURRENT ROW is supported; use a ROWS frame "
+                          "for offset frames")
+            return None  # identical to the default frame
+        if lo is not None and hi is not None and lo > hi:
+            self.fail(f"frame lower bound {lo} is above upper bound "
+                      f"{hi}")
+        return (lo, hi)
 
     def _skip_to_from(self) -> None:
         depth = 0
@@ -700,6 +763,7 @@ class _Parser:
                     order.append((c.name, asc))
                     if not self.take_op(","):
                         break
+            frame = self.parse_frame_clause()
             self.expect_op(")")
             if name not in _WINDOW_FUNCS:
                 self.fail(f"Unsupported window function {name}")
@@ -708,8 +772,9 @@ class _Parser:
             func = {"avg": "mean"}.get(name, name)
             value = None
             offset = 1
-            if func in ("sum", "min", "max", "mean", "count",
-                        "lag", "lead") and arg is not None:
+            if func in ("sum", "min", "max", "mean", "count", "lag",
+                        "lead", "first_value", "last_value") \
+                    and arg is not None:
                 if not isinstance(arg, Col):
                     self.fail("window function arguments must be columns")
                 value = arg.name
@@ -724,7 +789,15 @@ class _Parser:
                         self.fail(f"{func}() offset must be an integer "
                                   f"literal")
                     offset = off.value
-            return _WindowCall(func, value, partition, order, offset)
+            if func == "ntile":
+                if not args or not isinstance(args[0], Lit) \
+                        or not isinstance(args[0].value, int):
+                    self.fail("ntile(n) needs an integer literal "
+                              "tile count")
+                offset = args[0].value
+                value = None
+            return _WindowCall(func, value, partition, order, offset,
+                               frame=frame)
         if name in _AGG_FUNCS:
             func = _AGG_FUNCS[name]
             if name == "count":
@@ -894,7 +967,7 @@ def _lower(p: _Parser, ds, items, distinct, where, group_by, having,
     for alias, w in windows_to_apply:
         ds = ds.with_window(alias, w.func, partition_by=w.partition_by,
                             order_by=w.order_by, value=w.value,
-                            offset=w.offset)
+                            offset=w.offset, frame=w.frame)
 
     if not star and out_items:
         names = [n for n, _e in out_items]
@@ -968,11 +1041,21 @@ def sql(session, text: str, tables: Dict[str, Any]):
             prev_cols, next_cols = ds.columns, nxt.columns
         except Exception:
             pass  # unresolvable schema: let execution surface it
-        if prev_cols is not None and set(prev_cols) != set(next_cols):
-            raise SqlError(
-                f"UNION branches must produce the same column names "
-                f"(the engine unions BY NAME): {prev_cols} vs "
-                f"{next_cols}; alias the outputs to match")
+        if prev_cols is not None:
+            if len(prev_cols) != len(next_cols):
+                raise SqlError(
+                    f"UNION branches must produce the same number of "
+                    f"columns: {prev_cols} vs {next_cols}")
+            if len(set(prev_cols)) != len(prev_cols):
+                raise SqlError(
+                    f"UNION over duplicate column names is not "
+                    f"supported: {prev_cols}; alias them apart")
+            if list(prev_cols) != list(next_cols):
+                # Spark SQL resolves UNION BY POSITION: the second
+                # branch's columns are renamed to the first branch's
+                # names pairwise, regardless of their own names.
+                nxt = nxt.select(**{pn: Col(nc) for pn, nc
+                                    in zip(prev_cols, next_cols)})
         ds = ds.union(nxt)
         if dedup:
             ds = ds.distinct()

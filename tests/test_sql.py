@@ -526,10 +526,24 @@ class TestUnion:
         assert set(out.column_names) == {"id", "v"}
 
 
-def test_union_mismatched_names_rejected(env):
+def test_union_resolves_by_position(env):
+    # Spark SQL resolves UNION by POSITION: differently-named branches
+    # pair up column-by-column under the first branch's names.
     s, paths = env
-    with pytest.raises(SqlError, match="same column names"):
-        sql(s, "SELECT o_orderkey FROM orders UNION ALL "
+    odf = pd.read_parquet(paths["orders"])
+    cdf = pd.read_parquet(paths["customer"])
+    out = sql(s, "SELECT o_orderkey FROM orders UNION ALL "
+                 "SELECT c_custkey FROM customer",
+              tables=_tables(s, paths)).collect()
+    assert out.column_names == ["o_orderkey"]
+    expect = sorted(list(odf["o_orderkey"]) + list(cdf["c_custkey"]))
+    assert sorted(out.column("o_orderkey").to_pylist()) == expect
+
+
+def test_union_mismatched_arity_rejected(env):
+    s, paths = env
+    with pytest.raises(SqlError, match="same number of columns"):
+        sql(s, "SELECT o_orderkey, o_custkey FROM orders UNION ALL "
                "SELECT c_custkey FROM customer",
             tables=_tables(s, paths))
 

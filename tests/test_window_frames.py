@@ -1,0 +1,522 @@
+"""Window frames + new analytic functions (round 5).
+
+Covers the vectorized segment engine (`ops/window.py`):
+  - explicit ROWS frames (TPC-DS q51's `ROWS BETWEEN UNBOUNDED
+    PRECEDING AND CURRENT ROW`, bounded/centered frames, suffix frames);
+  - first_value / last_value / ntile;
+  - exact int64 running sums (the round-4 advisor's 2^55+3 case);
+  - the `__part` helper-column collision;
+  - fuzz parity against a per-row naive frame evaluator.
+"""
+import math
+import os
+import random
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import HyperspaceSession
+from hyperspace_tpu.plan.expr import col
+from hyperspace_tpu.sql import sql
+
+
+@pytest.fixture()
+def session(tmp_path):
+    return HyperspaceSession(system_path=str(tmp_path / "ix"))
+
+
+def _write(tmp_path, table, name="t"):
+    d = os.path.join(str(tmp_path), name)
+    os.makedirs(d, exist_ok=True)
+    pq.write_table(table, os.path.join(d, "part.parquet"))
+    return d
+
+
+def _base(tmp_path):
+    return _write(tmp_path, pa.table({
+        "g": pa.array([1, 1, 1, 1, 2, 2, 2], type=pa.int64()),
+        "o": pa.array([1, 2, 3, 4, 1, 2, 3], type=pa.int64()),
+        "v": pa.array([10, None, 30, 40, 5, 6, None], type=pa.int64()),
+    }))
+
+
+# ------------------------------------------------------------- ROWS frames
+
+def test_rows_unbounded_preceding_current(session, tmp_path):
+    d = _base(tmp_path)
+    out = (session.read.parquet(d)
+           .with_window("rs", "sum", partition_by=["g"], order_by=["o"],
+                        value="v", frame=(None, 0))
+           .sort("g", "o").collect())
+    assert out.column("rs").to_pylist() == [10, 10, 40, 80, 5, 11, 11]
+
+
+def test_rows_frame_differs_from_range_on_ties(session, tmp_path):
+    d = _write(tmp_path, pa.table({
+        "g": pa.array([1, 1, 1], type=pa.int64()),
+        "o": pa.array([1, 1, 2], type=pa.int64()),  # rows 0,1 are peers
+        "v": pa.array([10, 20, 30], type=pa.int64()),
+    }))
+    ds = session.read.parquet(d)
+    range_out = (ds.with_window("rs", "sum", partition_by=["g"],
+                                order_by=["o"], value="v")
+                 .sort("o").collect())
+    rows_out = (ds.with_window("rs", "sum", partition_by=["g"],
+                               order_by=["o"], value="v", frame=(None, 0))
+                .sort("o").collect())
+    # Default RANGE frame: peers share the tie group's total.
+    assert range_out.column("rs").to_pylist() == [30, 30, 60]
+    # ROWS frame: strictly positional.
+    assert sorted(rows_out.column("rs").to_pylist()) == [10, 30, 60]
+
+
+def test_rows_centered_frame(session, tmp_path):
+    d = _base(tmp_path)
+    out = (session.read.parquet(d)
+           .with_window("m", "sum", partition_by=["g"], order_by=["o"],
+                        value="v", frame=(-1, 1))
+           .sort("g", "o").collect())
+    assert out.column("m").to_pylist() == [10, 40, 70, 70, 11, 11, 6]
+
+
+def test_rows_suffix_frame_min(session, tmp_path):
+    d = _base(tmp_path)
+    out = (session.read.parquet(d)
+           .with_window("m", "min", partition_by=["g"], order_by=["o"],
+                        value="v", frame=(0, None))
+           .sort("g", "o").collect())
+    assert out.column("m").to_pylist() == [10, 30, 30, 40, 5, 6, None]
+
+
+def test_rows_frame_empty_yields_null(session, tmp_path):
+    d = _base(tmp_path)
+    out = (session.read.parquet(d)
+           .with_window("s", "sum", partition_by=["g"], order_by=["o"],
+                        value="v", frame=(2, 3))
+           .sort("g", "o").collect())
+    # Last rows of each partition have empty frames.
+    assert out.column("s").to_pylist() == [70, 40, None, None, None,
+                                           None, None]
+
+
+def test_rows_frame_count_star_counts_rows(session, tmp_path):
+    d = _base(tmp_path)
+    out = (session.read.parquet(d)
+           .with_window("c", "count", partition_by=["g"], order_by=["o"],
+                        frame=(-1, 0))
+           .sort("g", "o").collect())
+    assert out.column("c").to_pylist() == [1, 2, 2, 2, 1, 2, 2]
+
+
+def test_rows_frame_bounded_max_dates(session, tmp_path):
+    import datetime
+    days = [datetime.date(2026, 1, x) for x in (5, 2, 9, 1)]
+    d = _write(tmp_path, pa.table({
+        "o": pa.array([1, 2, 3, 4], type=pa.int64()),
+        "dt": pa.array(days, type=pa.date32()),
+    }))
+    out = (session.read.parquet(d)
+           .with_window("mx", "max", order_by=["o"], value="dt",
+                        frame=(-1, 0))
+           .sort("o").collect())
+    assert out.schema.field("mx").type == pa.date32()
+    assert out.column("mx").to_pylist() == [
+        datetime.date(2026, 1, 5), datetime.date(2026, 1, 5),
+        datetime.date(2026, 1, 9), datetime.date(2026, 1, 9)]
+
+
+# ----------------------------------------------------- new analytic funcs
+
+def test_first_last_value_default_frame(session, tmp_path):
+    d = _base(tmp_path)
+    ds = session.read.parquet(d)
+    out = (ds.with_window("fv", "first_value", partition_by=["g"],
+                          order_by=["o"], value="v")
+           .with_window("lv", "last_value", partition_by=["g"],
+                        order_by=["o"], value="v")
+           .sort("g", "o").collect())
+    assert out.column("fv").to_pylist() == [10, 10, 10, 10, 5, 5, 5]
+    # Default frame ends at the current row: last_value == current value
+    # (respecting nulls, Spark default).
+    assert out.column("lv").to_pylist() == [10, None, 30, 40, 5, 6, None]
+
+
+def test_last_value_unbounded_following(session, tmp_path):
+    d = _base(tmp_path)
+    out = (session.read.parquet(d)
+           .with_window("lv", "last_value", partition_by=["g"],
+                        order_by=["o"], value="v", frame=(None, None))
+           .sort("g", "o").collect())
+    assert out.column("lv").to_pylist() == [40, 40, 40, 40, None, None,
+                                            None]
+
+
+def test_first_value_without_order_by_whole_partition(session, tmp_path):
+    d = _base(tmp_path)
+    out = (session.read.parquet(d)
+           .with_window("fv", "first_value", partition_by=["g"],
+                        value="v")
+           .sort("g", "o").collect())
+    assert out.column("fv").to_pylist() == [10, 10, 10, 10, 5, 5, 5]
+
+
+def test_ntile_spark_distribution(session, tmp_path):
+    d = _write(tmp_path, pa.table({
+        "o": pa.array(list(range(7)), type=pa.int64()),
+    }))
+    out = (session.read.parquet(d)
+           .with_window("t", "ntile", order_by=["o"], offset=3)
+           .sort("o").collect())
+    # 7 rows, 3 tiles -> sizes 3,2,2 (first size%k tiles get the extra).
+    assert out.column("t").to_pylist() == [1, 1, 1, 2, 2, 3, 3]
+    assert out.schema.field("t").type == pa.int32()
+
+
+def test_ntile_more_tiles_than_rows(session, tmp_path):
+    d = _write(tmp_path, pa.table({
+        "o": pa.array([1, 2], type=pa.int64()),
+    }))
+    out = (session.read.parquet(d)
+           .with_window("t", "ntile", order_by=["o"], offset=5)
+           .sort("o").collect())
+    assert out.column("t").to_pylist() == [1, 2]
+
+
+# -------------------------------------------------- advisor regressions
+
+def test_running_int_sum_exact_above_2_53(session, tmp_path):
+    big = 2 ** 55
+    d = _write(tmp_path, pa.table({
+        "g": pa.array([1, 1, 1], type=pa.int64()),
+        "o": pa.array([1, 2, 3], type=pa.int64()),
+        "v": pa.array([big, None, 3], type=pa.int64()),
+    }))
+    out = (session.read.parquet(d)
+           .with_window("rs", "sum", partition_by=["g"], order_by=["o"],
+                        value="v")
+           .sort("o").collect())
+    # float64 would round 2^55 + 3 to 2^55 + 4; int64 path stays exact.
+    assert out.column("rs").to_pylist() == [big, big, big + 3]
+    assert out.schema.field("rs").type == pa.int64()
+
+
+def test_user_part_column_does_not_collide(session, tmp_path):
+    d = _write(tmp_path, pa.table({
+        "__part": pa.array([1, 1, 2], type=pa.int64()),
+        "o": pa.array([1, 2, 1], type=pa.int64()),
+        "v": pa.array([10, 20, 30], type=pa.int64()),
+    }))
+    out = (session.read.parquet(d)
+           .with_window("rn", "row_number", partition_by=["__part"],
+                        order_by=["o"])
+           .sort("__part", "o").collect())
+    assert out.column("rn").to_pylist() == [1, 2, 1]
+    assert "__part" in out.column_names
+
+
+# ------------------------------------------------------------- SQL surface
+
+def test_sql_rows_between(session, tmp_path):
+    d = _base(tmp_path)
+    out = sql(session, """
+        SELECT g, o, sum(v) OVER (PARTITION BY g ORDER BY o
+            ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) AS rs
+        FROM t ORDER BY g, o
+    """, tables={"t": d}).collect()
+    assert out.column("rs").to_pylist() == [10, 10, 40, 80, 5, 11, 11]
+
+
+def test_sql_rows_shorthand_and_bounded(session, tmp_path):
+    d = _base(tmp_path)
+    out = sql(session, """
+        SELECT g, o,
+               sum(v) OVER (PARTITION BY g ORDER BY o
+                            ROWS 1 PRECEDING) AS s1,
+               sum(v) OVER (PARTITION BY g ORDER BY o
+                            ROWS BETWEEN 1 PRECEDING
+                                     AND 1 FOLLOWING) AS s2
+        FROM t ORDER BY g, o
+    """, tables={"t": d}).collect()
+    assert out.column("s1").to_pylist() == [10, 10, 30, 70, 5, 11, 6]
+    assert out.column("s2").to_pylist() == [10, 40, 70, 70, 11, 11, 6]
+
+
+def test_sql_first_last_ntile(session, tmp_path):
+    d = _base(tmp_path)
+    out = sql(session, """
+        SELECT g, o,
+               first_value(v) OVER (PARTITION BY g ORDER BY o) AS fv,
+               ntile(2) OVER (PARTITION BY g ORDER BY o) AS nt
+        FROM t ORDER BY g, o
+    """, tables={"t": d}).collect()
+    assert out.column("fv").to_pylist() == [10, 10, 10, 10, 5, 5, 5]
+    assert out.column("nt").to_pylist() == [1, 1, 2, 2, 1, 1, 2]
+
+
+def test_sql_range_default_form_accepted(session, tmp_path):
+    d = _base(tmp_path)
+    out = sql(session, """
+        SELECT g, o, sum(v) OVER (PARTITION BY g ORDER BY o
+            RANGE BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) AS rs
+        FROM t ORDER BY g, o
+    """, tables={"t": d}).collect()
+    assert out.column("rs").to_pylist() == [10, 10, 40, 80, 5, 11, 11]
+
+
+def test_sql_range_offset_form_rejected(session, tmp_path):
+    from hyperspace_tpu.sql.parser import SqlError
+    d = _base(tmp_path)
+    with pytest.raises(SqlError, match="RANGE"):
+        sql(session, """
+            SELECT sum(v) OVER (ORDER BY o
+                RANGE BETWEEN 1 PRECEDING AND CURRENT ROW) AS rs
+            FROM t
+        """, tables={"t": d}).collect()
+
+
+def test_frame_requires_order_by(session, tmp_path):
+    d = _base(tmp_path)
+    with pytest.raises(ValueError, match="ORDER BY"):
+        (session.read.parquet(d)
+         .with_window("s", "sum", partition_by=["g"], value="v",
+                      frame=(None, 0)).collect())
+
+
+def test_frame_rejected_for_ranking(session, tmp_path):
+    d = _base(tmp_path)
+    with pytest.raises(ValueError, match="frame"):
+        (session.read.parquet(d)
+         .with_window("r", "rank", partition_by=["g"], order_by=["o"],
+                      frame=(None, 0)).collect())
+
+
+def test_frame_entirely_outside_partition(session, tmp_path):
+    # Bounds landing past the partition edges must clamp, not crash
+    # (review regression: unclamped scan indexing in frame_min_max).
+    d = _write(tmp_path, pa.table({
+        "o": pa.array([1], type=pa.int64()),
+        "v": pa.array([7], type=pa.int64()),
+    }))
+    out = (session.read.parquet(d)
+           .with_window("m", "min", order_by=["o"], value="v",
+                        frame=(2, None))
+           .collect())
+    assert out.column("m").to_pylist() == [None]
+    d2 = _write(tmp_path, pa.table({
+        "o": pa.array([1, 2, 3], type=pa.int64()),
+        "v": pa.array([7, 8, 9], type=pa.int64()),
+    }), name="t2")
+    out2 = (session.read.parquet(d2)
+            .with_window("m", "max", order_by=["o"], value="v",
+                         frame=(None, -5))
+            .sort("o").collect())
+    assert out2.column("m").to_pylist() == [None, None, None]
+
+
+def test_uint64_window_exact_above_2_63(session, tmp_path):
+    big = 2 ** 63 + 10
+    d = _write(tmp_path, pa.table({
+        "o": pa.array([1, 2], type=pa.int64()),
+        "v": pa.array([big, 1], type=pa.uint64()),
+    }))
+    ds = session.read.parquet(d)
+    out = (ds.with_window("m", "min", order_by=["o"], value="v",
+                          frame=(None, None)).sort("o").collect())
+    # An int64 view would wrap `big` negative and beat 1.
+    assert out.column("m").to_pylist() == [1, 1]
+    with pytest.raises(ValueError, match="overflows"):
+        (ds.with_window("s", "sum", order_by=["o"], value="v",
+                        frame=(None, None)).collect())
+
+
+def test_decimal_window_min_exact(session, tmp_path):
+    import decimal
+    a = decimal.Decimal("12345678901234567.89")
+    b = decimal.Decimal("12345678901234567.88")  # float64-identical
+    d = _write(tmp_path, pa.table({
+        "o": pa.array([1, 2], type=pa.int64()),
+        "v": pa.array([a, b], type=pa.decimal128(38, 2)),
+    }))
+    out = (session.read.parquet(d)
+           .with_window("m", "min", value="v")
+           .sort("o").collect())
+    # float64 can't tell a from b; the arrow path must return b exactly.
+    assert out.column("m").to_pylist() == [b, b]
+    # Running decimal frames fail loudly instead of rounding silently.
+    with pytest.raises(ValueError, match="not supported"):
+        (session.read.parquet(d)
+         .with_window("s", "sum", order_by=["o"], value="v")
+         .collect())
+
+
+def test_bool_window_sum_schema_stable_on_empty(session, tmp_path):
+    d = _write(tmp_path, pa.table({
+        "o": pa.array([1, 2], type=pa.int64()),
+        "v": pa.array([True, False], type=pa.bool_()),
+    }))
+    ds = session.read.parquet(d)
+    full = ds.with_window("s", "sum", value="v").collect()
+    empty = (ds.filter(col("o") < 0)
+             .with_window("s", "sum", value="v").collect())
+    assert full.schema.field("s").type == pa.int64()
+    assert empty.schema.field("s").type == pa.int64()
+    assert full.column("s").to_pylist() == [1, 1]
+
+
+def test_frame_survives_column_pruning(session, tmp_path):
+    # Column pruning reconstructs Window nodes; the frame must ride
+    # along (regression: pruning dropped `frame=` on rebuild).
+    d = _write(tmp_path, pa.table({
+        "g": pa.array([1, 1, 1], type=pa.int64()),
+        "o": pa.array([1, 2, 3], type=pa.int64()),
+        "v": pa.array([10, 20, 30], type=pa.int64()),
+        "unused": pa.array([0, 0, 0], type=pa.int64()),
+    }))
+    out = (session.read.parquet(d)
+           .with_window("s", "sum", partition_by=["g"], order_by=["o"],
+                        value="v", frame=(-1, 0))
+           .select("o", "s")
+           .sort("o").collect())
+    assert out.column("s").to_pylist() == [10, 30, 50]
+
+
+# ----------------------------------------------------------- fuzz parity
+
+def _naive_window(df, func, value, part_cols, order_cols, frame, offset=1):
+    """Per-row reference evaluator: O(n^2) literal frame semantics."""
+    n = len(df)
+    key = df[part_cols].apply(tuple, axis=1) if part_cols \
+        else pd.Series([()] * n)
+    # Sort exactly like the engine: partition, then order keys with
+    # nulls first ascending (stable).
+    sort_cols, ascending = [], []
+    aux = df.copy()
+    aux["__k"] = key
+    aux["__pos"] = np.arange(n)
+    order = aux.sort_values(
+        by=["__k"] + [c for c, _a in order_cols],
+        ascending=[True] + [a for _c, a in order_cols],
+        kind="stable", na_position="first")
+    # pandas sorts NaN last regardless on ascending; emulate Spark's
+    # nulls-first-ascending/nulls-last-descending with a validity key.
+    def spark_perm():
+        cols = {"__k": aux["__k"]}
+        by = ["__k"]
+        asc = [True]
+        for c, a in order_cols:
+            vkey = f"__valid_{c}"
+            cols[vkey] = aux[c].notna()
+            cols[c] = aux[c]
+            by += [vkey, c]
+            asc += [a, a]
+        tmp = pd.DataFrame(cols)
+        return tmp.sort_values(by=by, ascending=asc,
+                               kind="stable").index.to_numpy()
+    perm = spark_perm()
+    sdf = df.iloc[perm].reset_index(drop=True)
+    skey = key.iloc[perm].reset_index(drop=True)
+    svals = sdf[value] if value else None
+    res = [None] * n
+    for i in range(n):
+        # partition bounds
+        lo_p = i
+        while lo_p > 0 and skey[lo_p - 1] == skey[i]:
+            lo_p -= 1
+        hi_p = i
+        while hi_p < n - 1 and skey[hi_p + 1] == skey[i]:
+            hi_p += 1
+        if frame is None:
+            if order_cols:
+                # default RANGE: partition start .. end of tie group
+                def same_tie(a, b):
+                    for c, _a2 in order_cols:
+                        va, vb = sdf[c].iloc[a], sdf[c].iloc[b]
+                        if pd.isna(va) != pd.isna(vb):
+                            return False
+                        if not pd.isna(va) and va != vb:
+                            return False
+                    return True
+                lo, hi = lo_p, i
+                while hi < hi_p and same_tie(hi + 1, i):
+                    hi += 1
+            else:
+                lo, hi = lo_p, hi_p
+        else:
+            flo, fhi = frame
+            lo = lo_p if flo is None else max(lo_p, i + flo)
+            hi = hi_p if fhi is None else min(hi_p, i + fhi)
+        window = [] if hi < lo else list(range(lo, hi + 1))
+        vals = [svals.iloc[j] for j in window] if value else None
+        if func == "count":
+            res[i] = len(window) if value is None \
+                else sum(0 if pd.isna(x) else 1 for x in vals)
+        elif func == "sum":
+            vs = [x for x in vals if not pd.isna(x)]
+            res[i] = sum(vs) if vs else None
+        elif func == "mean":
+            vs = [x for x in vals if not pd.isna(x)]
+            res[i] = (sum(vs) / len(vs)) if vs else None
+        elif func in ("min", "max"):
+            vs = [x for x in vals if not pd.isna(x)]
+            res[i] = (min(vs) if func == "min" else max(vs)) if vs \
+                else None
+        elif func == "first_value":
+            res[i] = None if not window else svals.iloc[window[0]]
+        elif func == "last_value":
+            res[i] = None if not window else svals.iloc[window[-1]]
+        else:
+            raise AssertionError(func)
+    out = pd.Series(res)
+    # scatter back
+    inv = np.empty(n, dtype=np.int64)
+    inv[perm] = np.arange(n)
+    return out.iloc[inv].reset_index(drop=True)
+
+
+FRAMES = [None, (None, 0), (None, None), (0, None), (-1, 1), (-2, 0),
+          (0, 2), (1, 3), (-3, -1)]
+
+
+def test_fuzz_frames_vs_naive(session, tmp_path):
+    rng = random.Random(1234)
+    for trial in range(12):
+        n = rng.randint(1, 40)
+        ints = [rng.choice([None] + list(range(-5, 20)))
+                for _ in range(n)]
+        tbl = pa.table({
+            "g": pa.array([rng.randint(0, 3) for _ in range(n)],
+                          type=pa.int64()),
+            "o": pa.array([rng.randint(0, 6) for _ in range(n)],
+                          type=pa.int64()),
+            "v": pa.array(ints, type=pa.int64()),
+        })
+        d = _write(tmp_path, tbl, name=f"fz{trial}")
+        df = tbl.to_pandas()
+        ds = session.read.parquet(d)
+        for func in ("sum", "count", "mean", "min", "max",
+                     "first_value", "last_value"):
+            for frame in FRAMES:
+                if frame is not None or func in ("first_value",
+                                                 "last_value"):
+                    order = [("o", True)]
+                else:
+                    order = [("o", True)] if rng.random() < 0.5 else []
+                if func in ("first_value", "last_value") and not order:
+                    order = [("o", True)]
+                got = (ds.with_window("w", func, partition_by=["g"],
+                                      order_by=order, value="v",
+                                      frame=frame)
+                       .collect().column("w").to_pylist())
+                want = _naive_window(df, func, "v", ["g"], order,
+                                     frame).tolist()
+                for g_, w_ in zip(got, want):
+                    if w_ is None or (isinstance(w_, float)
+                                      and math.isnan(w_)):
+                        assert g_ is None, (func, frame, got, want)
+                    elif isinstance(w_, float):
+                        assert g_ == pytest.approx(w_), (func, frame)
+                    else:
+                        assert g_ == w_, (func, frame, got, want)
