@@ -226,7 +226,26 @@ def _kernel_microbench() -> dict:
 N_ORDERS_SF10 = 15_000_000
 N_LINEITEM_SF10 = 60_000_000
 SF10_FILES = 64
-SF10_REPEATS = 2
+# Target reps (round-5 verdict: enough reps for <=±15% spreads); any
+# workload whose first rep exceeds SF10_SLOW_REP_S adapts down to 2 reps
+# with the actual count recorded — a 5x repeat of a multi-minute full
+# scan would starve the SF100 step of its time budget.
+SF10_REPEATS = int(os.environ.get("HS_BENCH_SF10_REPS", "5"))
+SF10_SLOW_REP_S = 90.0
+
+# SF100 (BASELINE.json north star: covering-index build seconds and
+# Q3/Q10 wall-clock at SF100).  600M-row lineitem / 150M-row orders
+# through the streaming spill build; queries repeat on the INDEXED path
+# (>=3 reps) — full-scan baselines at this scale are measured once, for
+# the point filter only (a 5-rep 25 GB scan per workload would say
+# nothing new and cost the whole budget).
+N_ORDERS_SF100 = 150_000_000
+N_LINEITEM_SF100 = 600_000_000
+SF100_FILES = 200
+SF100_REPEATS = 3
+SF100_TIME_BUDGET_S = float(os.environ.get("HS_BENCH_SF100_BUDGET",
+                                           "6000"))
+SF100_MIN_DISK_GB = 60.0
 # The SF10 section self-skips when the SF1 portion already consumed this
 # much wall-clock (a degraded tunnel day must not kill the whole bench).
 SF10_TIME_BUDGET_S = float(os.environ.get("HS_BENCH_SF10_BUDGET", "2400"))
@@ -238,12 +257,36 @@ def _peak_rss_mb() -> float:
     return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
 
 
+def _time_adaptive(fn, target_reps: int, slow_s: float = SF10_SLOW_REP_S
+                   ) -> dict:
+    """Like _time, but if the FIRST rep is slow the remaining reps drop
+    to one more (2 total) so multi-minute full scans don't burn the
+    whole budget; the actual rep count and spread are recorded."""
+    import statistics
+
+    times = []
+    t0 = time.perf_counter()
+    fn()
+    times.append(time.perf_counter() - t0)
+    reps = target_reps if times[0] <= slow_s else min(target_reps, 2)
+    for _ in range(reps - 1):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    med = statistics.median(times)
+    return {"median": med, "min": min(times), "max": max(times),
+            "reps": len(times),
+            "spread_pct": round(100.0 * (max(times) - min(times))
+                                / max(med, 1e-9), 1)}
+
+
 def _sf10_section(session, hs, root: str, tables_equal) -> dict:
-    """SF10-scale credibility step (round-3 verdict item 6): a 60M-row
+    """SF10-scale credibility step: a 60M-row, 15-column (TPC-H-width)
     lineitem through the streaming spill build, then the headline query
-    shapes with the same answer-equality gates.  Generation and reads are
-    per-file so peak memory stays bounded; the spill build's peak RSS is
-    recorded."""
+    shapes — filter, DS range, Q3/Q10, join-only, Z-order second-dim —
+    with the same answer-equality gates as SF1.  Generation and reads
+    are per-file so peak memory stays bounded; the spill build's peak
+    RSS is recorded."""
     import numpy as np
     import pyarrow as pa
     import pyarrow.parquet as pq
@@ -252,7 +295,9 @@ def _sf10_section(session, hs, root: str, tables_equal) -> dict:
 
     out: dict = {"lineitem_rows": N_LINEITEM_SF10,
                  "orders_rows": N_ORDERS_SF10,
-                 "files_per_table": SF10_FILES, "reps": SF10_REPEATS}
+                 "files_per_table": SF10_FILES,
+                 "lineitem_columns": 15,
+                 "target_reps": SF10_REPEATS}
     li_dir = os.path.join(root, "sf10_lineitem")
     ord_dir = os.path.join(root, "sf10_orders")
     os.makedirs(li_dir)
@@ -265,7 +310,7 @@ def _sf10_section(session, hs, root: str, tables_equal) -> dict:
     for f in range(SF10_FILES):
         n = min(per_li, N_LINEITEM_SF10 - f * per_li)
         base = f * per_li
-        pq.write_table(pa.table({
+        cols = {
             "l_orderkey": rng.integers(0, N_ORDERS_SF10, n),
             "l_quantity": rng.integers(1, 50, n).astype(np.float64),
             "l_extendedprice": rng.random(n) * 1e4,
@@ -273,9 +318,14 @@ def _sf10_section(session, hs, root: str, tables_equal) -> dict:
             # Monotone across the dataset: per-file sketch ranges stay
             # narrow, like any time-correlated ingest.
             "l_shipdate": np.arange(base, base + n, dtype=np.int64),
-            "l_pad0": rng.random(n),
-            "l_pad1": rng.random(n),
-        }), os.path.join(li_dir, f"part-{f:05d}.parquet"))
+            "l_status": rng.integers(0, 4, n),
+        }
+        # Pad out to TPC-H lineitem's 15-16 column width so column
+        # pruning and scan costs are like-for-like with the SF1 table.
+        for i in range(9):
+            cols[f"l_pad{i}"] = rng.random(n)
+        pq.write_table(pa.table(cols),
+                       os.path.join(li_dir, f"part-{f:05d}.parquet"))
         n_o = min(per_ord, N_ORDERS_SF10 - f * per_ord)
         pq.write_table(pa.table({
             "o_orderkey": np.arange(f * per_ord, f * per_ord + n_o,
@@ -297,6 +347,22 @@ def _sf10_section(session, hs, root: str, tables_equal) -> dict:
                                 ["o_custkey", "o_totalprice"]))
     hs.create_index(session.read.parquet(li_dir),
                     DataSkippingIndexConfig("sf10_ds", ["l_shipdate"]))
+    # Z-order at SF10 (second-dimension pruning workload): one logical
+    # bucket, global two-pass layout.
+    saved_buckets = session.conf.num_buckets
+    saved_max_rows = session.conf.index_max_rows_per_file
+    try:
+        session.conf.index_max_rows_per_file = N_LINEITEM_SF10 // 64
+        session.conf.num_buckets = 1
+        hs.create_index(session.read.parquet(li_dir),
+                        IndexConfig("sf10_z",
+                                    ["l_shipdate", "l_extendedprice"],
+                                    ["l_quantity"], layout="zorder"))
+    finally:
+        # A failed z-order build must not leak num_buckets=1 into the
+        # SF100 section's north-star builds.
+        session.conf.num_buckets = saved_buckets
+        session.conf.index_max_rows_per_file = saved_max_rows
     out["index_build_s"] = round(time.perf_counter() - t0, 2)
     out["build_phases"] = getattr(session, "build_stats_log",
                                   [])[phases_before:]
@@ -315,6 +381,24 @@ def _sf10_section(session, hs, root: str, tables_equal) -> dict:
         return (session.read.parquet(li_dir)
                 .filter((col("l_shipdate") >= lo) & (col("l_shipdate") < hi))
                 .select("l_shipdate", "l_extendedprice").collect())
+
+    def q_join():
+        return (session.read.parquet(ord_dir)
+                .filter(col("o_totalprice") < 200.0)
+                .join(session.read.parquet(li_dir),
+                      col("o_orderkey") == col("l_orderkey"))
+                .select("o_orderkey", "o_totalprice", "l_quantity")
+                .collect())
+
+    def q_zorder():
+        # Range on the SECOND Z-order dimension: only the Z-layout can
+        # prune it (the row layout correlates shipdate, not price).
+        return (session.read.parquet(li_dir)
+                .filter((col("l_extendedprice") >= 9_990.0)
+                        & (col("l_shipdate") >= 30_000_000)
+                        & (col("l_shipdate") < 31_000_000))
+                .select("l_shipdate", "l_extendedprice", "l_quantity")
+                .collect())
 
     def q_q3():
         return (session.read.parquet(ord_dir)
@@ -339,15 +423,16 @@ def _sf10_section(session, hs, root: str, tables_equal) -> dict:
 
     speedups = {}
     for name, q in (("filter", q_filter), ("ds_range", q_ds_range),
+                    ("join", q_join), ("zorder", q_zorder),
                     ("q3_shape", q_q3), ("q10_shape", q_q10)):
         session.disable_hyperspace()
         expected = q()
-        base = _time(q, repeats=SF10_REPEATS)
+        base = _time_adaptive(q, SF10_REPEATS)
         session.enable_hyperspace()
         got = q()
         if not tables_equal(got, expected):
             raise SystemExit(f"sf10 {name}: indexed answer diverged")
-        idx = _time(q, repeats=SF10_REPEATS)
+        idx = _time_adaptive(q, SF10_REPEATS)
         out[f"{name}_scan_s"] = {k: round(v, 4) if isinstance(v, float)
                                  else v for k, v in base.items()}
         out[f"{name}_indexed_s"] = {k: round(v, 4) if isinstance(v, float)
@@ -357,6 +442,152 @@ def _sf10_section(session, hs, root: str, tables_equal) -> dict:
     out["geomean_speedup"] = round(math.exp(
         sum(math.log(s) for s in speedups.values()) / len(speedups)), 3)
     out["query_peak_rss_mb"] = round(_peak_rss_mb(), 1)
+    return out
+
+
+def _sf100_section(session, hs, root: str, tables_equal) -> dict:
+    """SF100 — the BASELINE.json north-star metric verbatim: TPC-H
+    SF100-scale covering-index build seconds and Q3/Q10 wall-clock.
+    600M-row lineitem (narrow 5-column projection of the query columns;
+    disk budget — noted in the output) through the streaming spill
+    build.  Indexed queries repeat SF100_REPEATS times; the full-scan
+    baseline is measured ONCE and only for the point filter.  The
+    section deletes its data before returning so the bench root stays
+    within disk."""
+    import shutil as _shutil
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from hyperspace_tpu import IndexConfig, col
+
+    free_gb = _shutil.disk_usage(root).free / 1e9
+    if free_gb < SF100_MIN_DISK_GB:
+        return {"skipped": f"only {free_gb:.0f} GB free < "
+                           f"{SF100_MIN_DISK_GB:.0f} GB needed"}
+    out: dict = {"lineitem_rows": N_LINEITEM_SF100,
+                 "orders_rows": N_ORDERS_SF100,
+                 "files_per_table": SF100_FILES,
+                 "reps": SF100_REPEATS,
+                 "note": "narrow 5-column lineitem (disk budget); build "
+                         "is the full streaming spill path; scan "
+                         "baseline measured once, filter only"}
+    li_dir = os.path.join(root, "sf100_lineitem")
+    ord_dir = os.path.join(root, "sf100_orders")
+    os.makedirs(li_dir)
+    os.makedirs(ord_dir)
+    try:
+        t0 = time.perf_counter()
+        rng = np.random.default_rng(23)
+        per_li = -(-N_LINEITEM_SF100 // SF100_FILES)
+        per_ord = -(-N_ORDERS_SF100 // SF100_FILES)
+        for f in range(SF100_FILES):
+            n = min(per_li, N_LINEITEM_SF100 - f * per_li)
+            base = f * per_li
+            pq.write_table(pa.table({
+                "l_orderkey": rng.integers(0, N_ORDERS_SF100, n),
+                "l_quantity": rng.integers(1, 50, n).astype(np.float64),
+                "l_extendedprice": rng.random(n) * 1e4,
+                "l_discount": rng.random(n) * 0.1,
+                "l_shipdate": np.arange(base, base + n, dtype=np.int64),
+            }), os.path.join(li_dir, f"part-{f:05d}.parquet"))
+            n_o = min(per_ord, N_ORDERS_SF100 - f * per_ord)
+            pq.write_table(pa.table({
+                "o_orderkey": np.arange(f * per_ord, f * per_ord + n_o,
+                                        dtype=np.int64),
+                "o_custkey": rng.integers(0, 2_000_000, n_o),
+                "o_totalprice": rng.random(n_o) * 1e5,
+            }), os.path.join(ord_dir, f"part-{f:05d}.parquet"))
+        out["datagen_s"] = round(time.perf_counter() - t0, 2)
+
+        from hyperspace_tpu import DataSkippingIndexConfig
+
+        rss_before = _peak_rss_mb()
+        phases_before = len(getattr(session, "build_stats_log", []))
+        t0 = time.perf_counter()
+        # l_shipdate is covered so Q10's range filter rewrites too —
+        # otherwise its "indexed" reps would secretly be raw scans.
+        hs.create_index(session.read.parquet(li_dir),
+                        IndexConfig("sf100_li", ["l_orderkey"],
+                                    ["l_quantity", "l_extendedprice",
+                                     "l_discount", "l_shipdate"]))
+        out["lineitem_build_s"] = round(time.perf_counter() - t0, 2)
+        t0 = time.perf_counter()
+        hs.create_index(session.read.parquet(ord_dir),
+                        IndexConfig("sf100_ord", ["o_orderkey"],
+                                    ["o_custkey", "o_totalprice"]))
+        out["orders_build_s"] = round(time.perf_counter() - t0, 2)
+        t0 = time.perf_counter()
+        hs.create_index(session.read.parquet(li_dir),
+                        DataSkippingIndexConfig("sf100_ds",
+                                                ["l_shipdate"]))
+        out["ds_build_s"] = round(time.perf_counter() - t0, 2)
+        out["build_phases"] = getattr(session, "build_stats_log",
+                                      [])[phases_before:]
+        out["build_peak_rss_mb"] = round(_peak_rss_mb(), 1)
+        out["peak_rss_before_build_mb"] = round(rss_before, 1)
+
+        probe_key = 123_456_789
+
+        def q_filter():
+            return (session.read.parquet(li_dir)
+                    .filter(col("l_orderkey") == probe_key)
+                    .select("l_orderkey", "l_quantity").collect())
+
+        def q_q3():
+            return (session.read.parquet(ord_dir)
+                    .filter(col("o_totalprice") < 1_000.0)
+                    .join(session.read.parquet(li_dir),
+                          col("o_orderkey") == col("l_orderkey"))
+                    .group_by("o_custkey")
+                    .agg(revenue=(col("l_extendedprice")
+                                  * (1 - col("l_discount")), "sum"))
+                    .sort(("revenue", False)).limit(10).collect())
+
+        def q_q10():
+            return (session.read.parquet(li_dir)
+                    .filter((col("l_shipdate") >= 100_000_000)
+                            & (col("l_shipdate") < 115_000_000))
+                    .join(session.read.parquet(ord_dir),
+                          col("l_orderkey") == col("o_orderkey"))
+                    .group_by("o_custkey")
+                    .agg(revenue=(col("l_extendedprice")
+                                  * (1 - col("l_discount")), "sum"))
+                    .sort(("revenue", False)).limit(20).collect())
+
+        # One full-scan baseline rep per workload: it both verifies the
+        # indexed answers and gives honest absolute context — repeating
+        # 25 GB scans would add nothing.
+        session.disable_hyperspace()
+        t0 = time.perf_counter()
+        expected_filter = q_filter()
+        out["filter_scan_once_s"] = round(time.perf_counter() - t0, 2)
+        t0 = time.perf_counter()
+        expected_q3 = q_q3()
+        out["q3_shape_scan_once_s"] = round(time.perf_counter() - t0, 2)
+        t0 = time.perf_counter()
+        expected_q10 = q_q10()
+        out["q10_shape_scan_once_s"] = round(time.perf_counter() - t0, 2)
+        session.enable_hyperspace()
+        for name, q, expected in (
+                ("filter", q_filter, expected_filter),
+                ("q3_shape", q_q3, expected_q3),
+                ("q10_shape", q_q10, expected_q10)):
+            got = q()
+            if not tables_equal(got, expected):
+                raise SystemExit(f"sf100 {name}: indexed answer diverged")
+            out[f"{name}_indexed_s"] = {
+                k: round(v, 4) if isinstance(v, float) else v
+                for k, v in _time(q, repeats=SF100_REPEATS).items()}
+        out["filter_speedup_vs_single_scan"] = round(
+            out["filter_scan_once_s"]
+            / out["filter_indexed_s"]["median"], 3)
+        out["query_peak_rss_mb"] = round(_peak_rss_mb(), 1)
+    finally:
+        session.disable_hyperspace()
+        _shutil.rmtree(li_dir, ignore_errors=True)
+        _shutil.rmtree(ord_dir, ignore_errors=True)
     return out
 
 
@@ -781,6 +1012,77 @@ def main() -> None:
                     "is by the calibrated resident threshold",
         }
 
+        # Warm-resident JOIN + fused join-aggregate (round-5 verdict
+        # item 1): with the eager policy, the first run ships the
+        # referenced columns once; warm repeats run the device kernels on
+        # HBM-resident inputs, routed ORGANICALLY by the resident
+        # threshold.  warm_q3/warm_q10 run the WHOLE pipeline on device
+        # (join match -> gather -> expression -> segment reduce -> top-N)
+        # with only the final groups crossing back.
+        def _warm_workload(name, make_q, fired_fn):
+            out = {}
+            session.conf.device_cache_policy = "off"
+            session.conf.device_join_min_rows = 1 << 60
+            host_tbl = make_q()
+            out["host_s"] = stat(_time(make_q, repeats=3))
+            session.conf.device_join_min_rows = None  # calibrated
+            session.conf.device_cache_policy = "eager"
+            global_cache().clear()
+            t0 = time.perf_counter()
+            cold_tbl = make_q()  # populate pass: pay the transfer once
+            out["cold_populate_s"] = round(time.perf_counter() - t0, 4)
+            warm_tbl = make_q()
+            out["warm_fired_organically"] = fired_fn(
+                session.last_execution_stats or {})
+            out["warm_s"] = stat(_time(make_q, repeats=3))
+            out["warm_speedup_vs_host"] = round(
+                out["host_s"]["median"] / out["warm_s"]["median"], 3)
+            for got, label in ((cold_tbl, "cold"), (warm_tbl, "warm")):
+                if not _tables_equal(got, host_tbl):
+                    raise SystemExit(
+                        f"{name} ({label}) diverged from host")
+            out["groups_or_rows"] = host_tbl.num_rows
+            session.conf.device_cache_policy = "off"
+            return out
+
+        def _join_fired(st):
+            ks = st.get("join_kernels", [])
+            return bool(ks and ks[-1]["strategy"] == "device"
+                        and ks[-1]["resident"])
+
+        def _fused_fired(st):
+            ag = st.get("aggregates", [])
+            return bool(ag and ag[-1]["strategy"] == "device-join-agg"
+                        and ag[-1]["resident"])
+
+        saved_policy2 = session.conf.device_cache_policy
+        saved_join_thresh = session.conf.device_join_min_rows
+        session.disable_hyperspace()
+        try:
+            def warm_join_q():
+                return (session.read.parquet(orders_dir)
+                        .filter(col("o_totalprice") < 2_000.0)
+                        .join(session.read.parquet(lineitem_dir),
+                              col("o_orderkey") == col("l_orderkey"))
+                        .select("o_orderkey", "o_totalprice",
+                                "l_quantity").collect())
+
+            detail["warm_resident_join"] = _warm_workload(
+                "warm_resident_join", warm_join_q, _join_fired)
+
+            # The north-star shapes, warm: indexes ON so the fused
+            # pipeline consumes the rewritten index scans.
+            session.enable_hyperspace()
+            detail["warm_q3"] = _warm_workload(
+                "warm_q3", q_q3_shape, _fused_fired)
+            detail["warm_q10"] = _warm_workload(
+                "warm_q10", q_q10_shape, _fused_fired)
+        finally:
+            session.disable_hyperspace()
+            session.conf.device_cache_policy = saved_policy2
+            session.conf.device_join_min_rows = saved_join_thresh
+            global_cache().clear()
+
         # Transfer-excluded kernel throughput (round-3 verdict item 1):
         # what the chip does on RESIDENT data, vs the host mirrors.
         detail["kernel_bench"] = _kernel_microbench()
@@ -815,6 +1117,26 @@ def main() -> None:
                 raise  # correctness-gate failures must fail the bench
             except Exception as e:  # resource exhaustion must not
                 detail["sf10"] = {"skipped": f"{type(e).__name__}: {e}"}
+        # SF100 north-star step (round-5 verdict item 2), last: budget-
+        # and disk-gated so the headline line always prints.  The SF10
+        # source data is spent — reclaim its disk for the SF100 step.
+        for spent in ("sf10_lineitem", "sf10_orders"):
+            shutil.rmtree(os.path.join(root, spent), ignore_errors=True)
+        elapsed = time.perf_counter() - bench_t0
+        if os.environ.get("HS_BENCH_SF100", "1") == "0":
+            detail["sf100"] = {"skipped": "HS_BENCH_SF100=0"}
+        elif elapsed > SF100_TIME_BUDGET_S:
+            detail["sf100"] = {
+                "skipped": f"earlier sections took {elapsed:.0f}s > "
+                           f"{SF100_TIME_BUDGET_S:.0f}s budget"}
+        else:
+            try:
+                detail["sf100"] = _sf100_section(session, hs, root,
+                                                 _tables_equal)
+            except SystemExit:
+                raise
+            except Exception as e:
+                detail["sf100"] = {"skipped": f"{type(e).__name__}: {e}"}
         detail["platform"] = _platform()
         line = {
             "metric": "tpch_sf1_indexed_query_speedup_geomean",

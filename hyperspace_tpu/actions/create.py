@@ -66,11 +66,19 @@ class CreateActionBase(Action):
         # Per-phase wall-clock of this build (read / kernel / write /
         # sketch, seconds) — appended to session.build_stats_log on
         # completion so bench.py can attribute build time (the round-2
-        # regression was unattributable without this).
+        # regression was unattributable without this).  Concurrent spill
+        # route workers update it, hence the lock (note: summed seconds
+        # are CPU-attributed time and can exceed wall-clock once routing
+        # overlaps reads).
         self.build_phases: Dict[str, float] = {}
+        import threading
+
+        self._phase_lock = threading.Lock()
 
     def _phase(self, name: str, seconds: float) -> None:
-        self.build_phases[name] = self.build_phases.get(name, 0.0) + seconds
+        with self._phase_lock:
+            self.build_phases[name] = \
+                self.build_phases.get(name, 0.0) + seconds
 
     def _publish_build_stats(self) -> None:
         log = getattr(self.session, "build_stats_log", None)
@@ -203,18 +211,34 @@ class CreateActionBase(Action):
 
     def _stream_build(self, files, columns, relation, lineage, resolved,
                       batch_rows, streaming, spill) -> None:
+        # Source decode is prefetched one file ahead on a reader thread
+        # (decode overlaps the routing work); chunk ROUTING itself runs on
+        # the spill's worker pool when cores allow, so the stream loop is
+        # never serialized behind hash+write of the previous chunk.
+        from concurrent.futures import ThreadPoolExecutor
+
         buffer: List[pa.Table] = []
         buffered = 0
-        for f in files:
-            t = self._read_chunk(f, columns, relation, lineage)
-            buffer.append(t)
-            buffered += t.num_rows
-            while streaming and buffered > batch_rows:
-                combined = pa.concat_tables(buffer, promote_options="default")
-                spill.add_chunk(combined.slice(0, batch_rows))
-                rest = combined.slice(batch_rows)
-                buffer = [rest] if rest.num_rows else []
-                buffered = rest.num_rows
+        with ThreadPoolExecutor(max_workers=1) as reader:
+            pending = None
+            queue = list(files)
+            if queue:
+                pending = reader.submit(self._read_chunk, queue.pop(0),
+                                        columns, relation, lineage)
+            while pending is not None:
+                t = pending.result()
+                pending = reader.submit(
+                    self._read_chunk, queue.pop(0), columns, relation,
+                    lineage) if queue else None
+                buffer.append(t)
+                buffered += t.num_rows
+                while streaming and buffered > batch_rows:
+                    combined = pa.concat_tables(buffer,
+                                                promote_options="default")
+                    spill.add_chunk(combined.slice(0, batch_rows))
+                    rest = combined.slice(batch_rows)
+                    buffer = [rest] if rest.num_rows else []
+                    buffered = rest.num_rows
         remainder = pa.concat_tables(buffer, promote_options="default") \
             if buffer else None
         if not spill.spilled:
@@ -358,9 +382,9 @@ class CreateActionBase(Action):
                 for fid, st, en in zip(uniq, starts, ends):
                     d = os.path.join(run_dir, f"file={int(fid):06d}")
                     os.makedirs(d, exist_ok=True)
-                    pq.write_table(
+                    _write_run(
                         routed.slice(int(st), int(en - st)),
-                        os.path.join(d, f"run-{chunk_no:05d}.parquet"))
+                        os.path.join(d, f"run-{chunk_no:05d}.arrow"))
                 self._phase("spill_route_s", _time.perf_counter() - t0)
             if offset != n:
                 raise HyperspaceError(
@@ -375,7 +399,7 @@ class CreateActionBase(Action):
                 d = os.path.join(run_dir, dname)
                 runs = sorted(os.listdir(d))  # chunk order = stable ties
                 bt = pa.concat_tables(
-                    [pq.read_table(os.path.join(d, r)) for r in runs],
+                    [_read_run(os.path.join(d, r)) for r in runs],
                     promote_options="default")
                 z = np.asarray(bt.column(z_col).to_numpy(
                     zero_copy_only=False))
@@ -386,6 +410,7 @@ class CreateActionBase(Action):
                 # one bucket.
                 write_bucket_run(bt, 0, out_dir, 0,
                                  compression=self.conf.index_file_compression)
+                shutil.rmtree(d, ignore_errors=True)  # runs consumed
 
             from hyperspace_tpu.utils.parallel_map import parallel_map_ordered
 
@@ -544,6 +569,20 @@ class CreateActionBase(Action):
         )
 
 
+def _write_run(table: pa.Table, path: str) -> None:
+    """Temporary spill run file as RAW Arrow IPC: no parquet
+    encode/decode for data that is read back exactly once and deleted —
+    on a single-core host the encode was most of the spill cost."""
+    with pa.OSFile(path, "wb") as sink:
+        with pa.ipc.new_file(sink, table.schema) as writer:
+            writer.write_table(table)
+
+
+def _read_run(path: str) -> pa.Table:
+    with pa.memory_map(path, "rb") as source:
+        return pa.ipc.open_file(source).read_all()
+
+
 def _footer_row_count(files, relation) -> Optional[int]:
     """Total rows from parquet footers (no decode), or None when any file
     is non-parquet/unreadable — a cheap 'does it fit one batch' probe."""
@@ -571,6 +610,14 @@ class _BucketSpill:
     storm the cache) — runs are concatenated in chunk order, so the stable
     sort reproduces the monolithic build's tie order exactly."""
 
+    # Route workers: chunk routing (hash + stable sort + run-file write)
+    # is independent per chunk once its number is assigned, so on
+    # multi-core hosts chunks route concurrently while the stream loop
+    # keeps decoding.  Single-core hosts degrade to inline routing (a
+    # pool of GIL-sharing workers would only add overhead there).
+    _MAX_ROUTE_WORKERS = 4
+    _MAX_IN_FLIGHT = 3  # each in-flight chunk pins one device batch in RAM
+
     def __init__(self, action: "CreateActionBase", resolved: IndexConfig) -> None:
         self.action = action
         self.resolved = resolved
@@ -579,8 +626,34 @@ class _BucketSpill:
         self._schema = None
         self._dir = None  # created on first spill; non-spilling builds
         # never touch disk
+        self._pool = None
+        self._futures: List = []
+
+    def _route_pool(self):
+        import os as _os
+
+        if self._pool is None and (_os.cpu_count() or 1) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(
+                max_workers=min(self._MAX_ROUTE_WORKERS,
+                                _os.cpu_count() or 1))
+        return self._pool
+
+    def _drain(self) -> None:
+        """Wait for in-flight route jobs; re-raise the first failure."""
+        futures, self._futures = self._futures, []
+        for fut in futures:
+            fut.result()
 
     def cleanup(self) -> None:
+        try:
+            self._drain()
+        except BaseException:
+            pass  # cleanup path: the original error is already in flight
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
         if self._dir is not None:
             import shutil
 
@@ -588,15 +661,6 @@ class _BucketSpill:
             self._dir = None
 
     def add_chunk(self, table: pa.Table) -> None:
-        import time as _time
-
-        import pyarrow.parquet as pq
-
-        from hyperspace_tpu.ops.hash import bucket_ids, bucket_ids_np
-        from hyperspace_tpu.ops.sort import _pad_rows
-
-        _t0 = _time.perf_counter()
-
         if self._dir is None:
             import tempfile
 
@@ -604,6 +668,24 @@ class _BucketSpill:
         self.spilled = True
         if self._schema is None:
             self._schema = table.schema
+        chunk_no = self._chunk_no
+        self._chunk_no += 1
+        pool = self._route_pool()
+        if pool is None:
+            self._route_chunk(table, chunk_no)
+            return
+        while len(self._futures) >= self._MAX_IN_FLIGHT:
+            self._futures.pop(0).result()
+        self._futures.append(
+            pool.submit(self._route_chunk, table, chunk_no))
+
+    def _route_chunk(self, table: pa.Table, chunk_no: int) -> None:
+        import time as _time
+
+        from hyperspace_tpu.ops.hash import bucket_ids, bucket_ids_np
+        from hyperspace_tpu.ops.sort import _pad_rows
+
+        _t0 = _time.perf_counter()
         n = table.num_rows
         # Z-order builds never spill here (they take the dedicated
         # two-pass path that preserves the global curve), so partitions
@@ -637,20 +719,22 @@ class _BucketSpill:
                 continue
             bdir = os.path.join(self._dir, f"bucket={b:05d}")
             os.makedirs(bdir, exist_ok=True)
-            pq.write_table(routed.slice(int(starts[b]), rows),
-                           os.path.join(bdir, f"run-{self._chunk_no:05d}.parquet"))
-        self._chunk_no += 1
+            # Run files are TEMPORARY (read back once, deleted): raw Arrow
+            # IPC skips the parquet encode/decode entirely — on the
+            # single-core bench host this was most of the spill cost.
+            _write_run(routed.slice(int(starts[b]), rows),
+                       os.path.join(bdir, f"run-{chunk_no:05d}.arrow"))
         self.action._phase("spill_route_s", _time.perf_counter() - _t0)
 
     def finish(self) -> None:
         import shutil
         import time as _time
 
-        import pyarrow.parquet as pq
-
-        from hyperspace_tpu.io.parquet import bucket_file_name
-
         _t0 = _time.perf_counter()
+        self._drain()  # all route jobs must land before buckets close
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
         action = self.action
         resolved = self.resolved
         version = action.data_manager.get_next_version()
@@ -665,12 +749,16 @@ class _BucketSpill:
             bucket = int(bname.split("=")[1])
             runs = sorted(os.listdir(bdir))  # chunk order = stable ties
             btable = pa.concat_tables(
-                [pq.read_table(os.path.join(bdir, r)) for r in runs],
+                [_read_run(os.path.join(bdir, r)) for r in runs],
                 promote_options="default")
             perm = self._sort_permutation(btable)
             btable = btable.take(pa.array(perm))
             write_bucket_run(btable, bucket, out_dir, max_rows,
                              compression=action.conf.index_file_compression)
+            # This bucket's runs are consumed: delete them NOW so peak
+            # disk is source + runs + a few finished buckets, not
+            # source + runs + the whole final index (matters at SF100).
+            shutil.rmtree(bdir, ignore_errors=True)
 
         from hyperspace_tpu.utils.parallel_map import parallel_map_ordered
 

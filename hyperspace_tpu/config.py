@@ -228,9 +228,13 @@ class HyperspaceConf:
 
     def device_min_rows(self, kind: str) -> int:
         """Effective host-vs-device threshold for ``kind`` (one of
-        filter/join/agg/build): an explicitly set conf value wins;
-        otherwise the calibrated (or conservative-fallback) value."""
-        explicit = getattr(self, f"device_{kind}_min_rows")
+        filter/join/agg/join_agg/build): an explicitly set conf value
+        wins; otherwise the calibrated (or conservative-fallback) value.
+        The fused join+aggregate has no conf field of its own — an
+        explicit join threshold governs it (it IS the join's device
+        decision, with the aggregation fused behind it)."""
+        field = "join" if kind == "join_agg" else kind
+        explicit = getattr(self, f"device_{field}_min_rows")
         if explicit is not None:
             return int(explicit)
         from hyperspace_tpu.utils.calibrate import calibrated_min_rows

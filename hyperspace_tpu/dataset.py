@@ -22,6 +22,7 @@ from hyperspace_tpu.plan.nodes import (
     Limit,
     LogicalPlan,
     Project,
+    SetOp,
     Sort,
     Union,
     Window,
@@ -178,6 +179,18 @@ class Dataset:
         incompatible same-named types fail at execution.  Chain
         ``.distinct()`` for SQL UNION."""
         return Dataset(Union([self.plan, other.plan]), self.session)
+
+    def intersect(self, other: "Dataset") -> "Dataset":
+        """SQL INTERSECT: distinct rows present in BOTH datasets, rows
+        compared positionally and null-safely (Spark's intersect)."""
+        return Dataset(SetOp("intersect", self.plan, other.plan),
+                       self.session)
+
+    def subtract(self, other: "Dataset") -> "Dataset":
+        """SQL EXCEPT: distinct rows of this dataset absent from
+        ``other`` (Spark's subtract/except), null-safe comparison."""
+        return Dataset(SetOp("except", self.plan, other.plan),
+                       self.session)
 
     def cache(self) -> "Dataset":
         """Materialize this dataset's CURRENT result and return a Dataset

@@ -57,6 +57,7 @@ from hyperspace_tpu.plan.nodes import (
     LogicalPlan,
     Project,
     Scan,
+    SetOp,
     Sort,
     Union,
     Window,
@@ -95,6 +96,37 @@ class Executor:
     def _scan_identity(self, table: pa.Table) -> Optional[Tuple[str, frozenset]]:
         entry = self._scan_fp.get(id(table))
         return (entry[0], entry[1]) if entry is not None else None
+
+    def _register_derived_identity(self, out: pa.Table, parent_identity,
+                                   transform: str) -> None:
+        """Content identity for a table DERIVED from an identified scan by
+        a deterministic transform (a filter predicate): the derived
+        fingerprint hashes the parent fingerprint with the transform's
+        stable repr, so a warm repeat of the same query over the same
+        files addresses the same cached device arrays — the bridge that
+        lets filtered join inputs go HBM-resident.  A different predicate
+        or a changed file set changes the fingerprint; stale serving is
+        impossible."""
+        if parent_identity is None or out is None:
+            return
+        import hashlib
+
+        fp, cacheable = parent_identity
+        derived = hashlib.md5(
+            f"{fp}|{transform}".encode()).hexdigest()
+        self._scan_fp[id(out)] = (
+            derived, cacheable & frozenset(out.column_names), out)
+
+    def _propagate_identity(self, out: pa.Table, parent: pa.Table) -> None:
+        """Row-preserving transforms (column selection) keep the parent's
+        fingerprint: the surviving columns are the same arrays, so cache
+        entries stay addressable under the same keys."""
+        entry = self._scan_fp.get(id(parent))
+        if entry is None or out is None:
+            return
+        fp, cacheable, _ref = entry
+        self._scan_fp[id(out)] = (
+            fp, cacheable & frozenset(out.column_names), out)
 
     def _cache_key(self, identity, column: str, kind: str):
         if identity is None:
@@ -179,7 +211,10 @@ class Executor:
                 # (the payoff of plan/pruning.py).
                 return self._scan(plan.child, columns=plan.columns)
             table = self.execute(plan.child)
-            return table.select(plan.columns)
+            out = table.select(plan.columns)
+            # Selection keeps rows (same arrays): identity carries over.
+            self._propagate_identity(out, table)
+            return out
         if isinstance(plan, Compute):
             table = self.execute(plan.child)
             data = {name: _eval_column(e, table) for name, e in plan.exprs}
@@ -214,6 +249,12 @@ class Executor:
             table = self.execute(plan.child)
             return _sorted_table(table, plan.keys)
         if isinstance(plan, Limit):
+            if (isinstance(plan.child, Sort) and plan.n > 0
+                    and isinstance(plan.child.child, Aggregate)):
+                fused = self._topn_join_aggregate(
+                    plan.child.child, plan.child, plan.n)
+                if fused is not None:
+                    return fused
             if isinstance(plan.child, Sort) and plan.n > 0:
                 # Top-N fusion: O(n log k) partial selection instead of a
                 # full sort + slice.  "Unstable" only affects tie order,
@@ -233,6 +274,8 @@ class Executor:
                 return table.take(idx)
             table = self.execute(plan.child)
             return table.slice(0, plan.n)
+        if isinstance(plan, SetOp):
+            return self._set_op(plan)
         if isinstance(plan, (BucketUnion, Union)):
             tables = [self.execute(c) for c in plan.children]
             # Public Union: "permissive" widens same-named numeric columns
@@ -247,9 +290,52 @@ class Executor:
             return pa.concat_tables(tables, promote_options=promote)
         raise ValueError(f"Unknown plan node: {type(plan).__name__}")
 
+    # -- set operations -----------------------------------------------------
+    def _set_op(self, plan: SetOp) -> pa.Table:
+        """INTERSECT/EXCEPT with SQL null-safe row equality: both sides
+        stack into one promoted table, every row gets a dense null-safe
+        group code (the window engine's encoder), and membership is one
+        vectorized isin — no hashing of Python tuples, no join-predicate
+        null semantics leaking in."""
+        from hyperspace_tpu.ops.window import partition_codes
+
+        left = self.execute(plan.left)
+        right = self.execute(plan.right)
+        if len(left.column_names) != len(right.column_names):
+            raise ValueError(
+                f"{plan.kind.upper()} needs equal column counts: "
+                f"{left.column_names} vs {right.column_names}")
+        r_renamed = right.rename_columns(left.column_names)
+        stacked = pa.concat_tables([left, r_renamed],
+                                   promote_options="permissive")
+        if stacked.num_rows == 0:
+            return stacked
+        codes = partition_codes(stacked, stacked.column_names)
+        ca = codes[:left.num_rows]
+        cb = codes[left.num_rows:]
+        in_b = np.isin(ca, cb)
+        keep = in_b if plan.kind == "intersect" else ~in_b
+        kept_rows = np.flatnonzero(keep)
+        if kept_rows.size == 0:
+            return stacked.slice(0, 0)
+        # Distinct: first occurrence per code, in left-row order.
+        _uniq, first = np.unique(ca[kept_rows], return_index=True)
+        rows = np.sort(kept_rows[first])
+        return stacked.take(pa.array(rows))
+
     # -- aggregate ----------------------------------------------------------
     def _aggregate(self, plan: Aggregate) -> pa.Table:
-        table = self.execute(plan.child)
+        attempt = self._try_join_aggregate(plan)
+        if attempt is not None:
+            kind, payload = attempt
+            if kind == "done":
+                return payload
+            # Sides were materialized for the attempt; joined on host.
+            return self._aggregate_on_table(plan, payload)
+        return self._aggregate_on_table(plan, self.execute(plan.child))
+
+    def _aggregate_on_table(self, plan: Aggregate,
+                            table: pa.Table) -> pa.Table:
         # Scan provenance survives the hidden-column appends below (the
         # appended table is a new object); only the ORIGINAL columns stay
         # cacheable — computed inputs are query-specific.
@@ -408,6 +494,324 @@ class Executor:
                 data[out_name] = pa.array(res)
         return pa.table(data)
 
+    # -- fused join+aggregate (the whole Q3/Q10 hot path on device) ---------
+    _JOIN_AGG_OPS = ("sum", "min", "max", "mean", "count", "count_all")
+
+    def _topn_join_aggregate(self, agg: Aggregate, sort: Sort,
+                             n: int) -> Optional[pa.Table]:
+        """ORDER BY <aggregate output> LIMIT n over a fused join+agg:
+        the ranking runs on device too, so only n groups come home —
+        the full Q3/Q10 pipeline (filter ⨝ index → group → top-N) with
+        O(n) host traffic.  None = not applicable, take the normal
+        path."""
+        if len(sort.keys) != 1:
+            return None
+        key, asc = sort.keys[0]
+        agg_index = next((i for i, (_f, _in, out) in enumerate(agg.aggs)
+                          if out == key), None)
+        if agg_index is None:  # ordering by a group column: no device win
+            return None
+        attempt = self._try_join_aggregate(
+            agg, topn=(agg_index, bool(asc), int(n)))
+        if attempt is None:
+            return None
+        kind, payload = attempt
+        if kind == "done":
+            table = payload  # k rows already — exact re-sort is cheap
+        else:
+            table = self._aggregate_on_table(agg, payload)
+        return _sorted_table(table, sort.keys).slice(0, n)
+
+    def _static_column_type(self, node, name: str):
+        """Arrow type of ``name`` in ``node``'s output when derivable
+        WITHOUT executing anything (Filter/Project/Sort/Limit chains
+        over Scan/InMemory — the shapes join sides actually take);
+        None when unknown."""
+        while True:
+            if isinstance(node, (Filter, Sort, Limit)):
+                node = node.child
+                continue
+            if isinstance(node, Project):
+                if name not in node.columns:
+                    return None
+                node = node.child
+                continue
+            if isinstance(node, InMemory):
+                if name not in node.table.column_names:
+                    return None
+                return node.table.schema.field(name).type
+            if isinstance(node, Scan):
+                try:
+                    from hyperspace_tpu.io.parquet import schema_to_arrow
+
+                    m = {k.lower(): v for k, v in
+                         self.session.schema_map_of(node).items()}
+                    t = m.get(name.lower())
+                    return schema_to_arrow({"c": t}).field(0).type \
+                        if t is not None else None
+                except Exception:
+                    return None
+            return None
+
+    def _join_agg_static_pregate(self, plan: Aggregate,
+                                 child: Join) -> bool:
+        """False when the fused path is KNOWABLY ineligible before any
+        execution — ambiguous/missing columns, or statically resolvable
+        types outside the kernel's domain.  An early False preserves
+        the normal path (with its bucketed join) at zero cost; unknowns
+        stay True and the data-dependent checks decide later."""
+        try:
+            l_cols = set(child.left.output_columns(self.session.schema_of))
+            r_cols = set(child.right.output_columns(self.session.schema_of))
+        except Exception:
+            return True  # unresolvable statically: decide after exec
+        refs = set(plan.group_by)
+        for _func, agg_in, _out in plan.aggs:
+            if isinstance(agg_in, Col):
+                refs.add(agg_in.name)
+            elif isinstance(agg_in, str):
+                if agg_in:
+                    refs.add(agg_in)
+            elif isinstance(agg_in, Expr):
+                refs |= set(agg_in.referenced_columns())
+        for name in refs:
+            in_l, in_r = name in l_cols, name in r_cols
+            if in_l == in_r:  # missing or ambiguous
+                return False
+            side = child.left if in_l else child.right
+            t = self._static_column_type(side, name)
+            if t is None:
+                continue  # unknown: the late check decides
+            if name in plan.group_by:
+                if not (pa.types.is_integer(t) or pa.types.is_boolean(t)
+                        or pa.types.is_temporal(t)) \
+                        or pa.types.is_uint64(t):
+                    return False
+            elif not (pa.types.is_integer(t) or pa.types.is_floating(t)) \
+                    or pa.types.is_uint64(t):
+                return False
+        return True
+
+    def _try_join_aggregate(self, plan: Aggregate, topn=None):
+        """Route ``aggregate(inner equi-join)`` through the fused device
+        pipeline (ops/join_agg.py): join match, gather, expression
+        evaluation, and segment reduction all happen in HBM; only
+        per-group results return.  The north-star shapes
+        (BASELINE.md Q3/Q10) are exactly this pattern — executed
+        separately, the full joined row set would cross the attachment.
+
+        Returns None to leave the plan alone (structural mismatch, or
+        the device isn't plausibly profitable); ("done", table) with the
+        fused result; or ("joined", table) when the sides were
+        materialized for the attempt but eligibility failed — the
+        caller aggregates the host-joined table without re-executing.
+        """
+        conf = self.session.conf
+        if not plan.group_by:
+            return None
+        child = plan.child
+        if not isinstance(child, Join) or child.how != "inner":
+            return None
+        # Plausibility gate BEFORE touching anything: the eager populate
+        # policy (pay the transfer once, serve repeats from HBM), or a
+        # genuinely LOW calibrated cold threshold (locally attached
+        # chips, where cold device joins win outright).  Anything else —
+        # including the conservative static defaults — leaves the
+        # regular path, bucketed host join included, untouched.
+        if conf.device_cache_policy != "eager" \
+                and conf.device_min_rows("join_agg") > (1 << 22):
+            return None
+        if any(func not in self._JOIN_AGG_OPS
+               for func, _i, _o in plan.aggs):
+            return None
+        # min/max need a plain column (their result restores its type):
+        # statically decidable, so decide it BEFORE materializing sides.
+        for func, agg_in, _out in plan.aggs:
+            if func in ("min", "max") and not isinstance(agg_in,
+                                                         (Col, str)):
+                return None
+        from hyperspace_tpu.plan.expr import as_equi_join_pairs
+
+        pairs = as_equi_join_pairs(child.condition)
+        if pairs is None or len(pairs) != 1:
+            return None
+        if not self._join_agg_static_pregate(plan, child):
+            # Statically ineligible: leave the plan alone so the normal
+            # path (bucketed host join included) runs untouched.
+            return None
+
+        left = self.execute(child.left)
+        right = self.execute(child.right)
+
+        def fallback():
+            self.stats["joins"].append(
+                {"strategy": "plain", "how": "inner"})
+            return ("joined", self._host_join_tables(
+                left, right, child.condition, "inner"))
+
+        a, b = pairs[0]
+        if a in left.column_names and b in right.column_names:
+            lk_name, rk_name = a, b
+        elif b in left.column_names and a in right.column_names:
+            lk_name, rk_name = b, a
+        else:
+            return fallback()
+        if (lk_name == rk_name or lk_name in right.column_names
+                or rk_name in left.column_names):
+            # Name present on both sides: the flat column index below
+            # couldn't tell them apart.
+            return fallback()
+        if not (columnar.is_numeric_type(
+                    left.schema.field(lk_name).type)
+                and columnar.is_numeric_type(
+                    right.schema.field(rk_name).type)):
+            return fallback()
+        # Inner join: null keys never match — drop them up front (with
+        # derived identity so residency carries across repeats).
+        lv, rv = left, right
+        if left.column(lk_name).null_count > 0:
+            lv = left.filter(pc.is_valid(left.column(lk_name)))
+            self._register_derived_identity(
+                lv, self._scan_identity(left), f"dropnull:{lk_name}")
+        if right.column(rk_name).null_count > 0:
+            rv = right.filter(pc.is_valid(right.column(rk_name)))
+            self._register_derived_identity(
+                rv, self._scan_identity(right), f"dropnull:{rk_name}")
+        if lv.num_rows == 0 or rv.num_rows == 0:
+            return fallback()
+
+        def side_of(name: str) -> Optional[str]:
+            in_l = name in lv.column_names
+            in_r = name in rv.column_names
+            if in_l == in_r:  # missing or ambiguous
+                return None
+            return "l" if in_l else "r"
+
+        def table_of(side: str) -> pa.Table:
+            return lv if side == "l" else rv
+
+        # Group keys: int/bool/temporal (int64 device domain), null-free.
+        for k in plan.group_by:
+            side = side_of(k)
+            if side is None:
+                return fallback()
+            t = table_of(side).schema.field(k).type
+            if not (pa.types.is_integer(t) or pa.types.is_boolean(t)
+                    or pa.types.is_temporal(t)) or pa.types.is_uint64(t):
+                return fallback()
+            if table_of(side).column(k).null_count > 0:
+                return fallback()
+        # Aggregate inputs: strictly int/float null-free references;
+        # min/max need a plain column (the result restores its type).
+        from hyperspace_tpu.ops.filter import build_value_fn
+
+        agg_ref_names: List[str] = []
+        for func, agg_in, _out in plan.aggs:
+            if func == "count_all":
+                continue
+            refs = [agg_in.name] if isinstance(agg_in, Col) else (
+                [agg_in] if isinstance(agg_in, str)
+                else list(agg_in.referenced_columns()))
+            if func in ("min", "max") and not (
+                    isinstance(agg_in, (Col, str))):
+                return fallback()
+            for r in refs:
+                side = side_of(r)
+                if side is None:
+                    return fallback()
+                t = table_of(side).schema.field(r).type
+                if not (pa.types.is_integer(t)
+                        or pa.types.is_floating(t)) \
+                        or pa.types.is_uint64(t):
+                    return fallback()
+                if table_of(side).column(r).null_count > 0:
+                    return fallback()
+                agg_ref_names.append(r)
+
+        # Routing: cold-transfer break-even, or the resident/eager
+        # threshold when every referenced column of a side is cached
+        # (or will be) for that side's — possibly filter-derived —
+        # identity.
+        id_l = self._scan_identity(lv)
+        id_r = self._scan_identity(rv)
+        need_l = sorted({lk_name} | {
+            c for c in set(plan.group_by) | set(agg_ref_names)
+            if side_of(c) == "l"})
+        need_r = sorted({rk_name} | {
+            c for c in set(plan.group_by) | set(agg_ref_names)
+            if side_of(c) == "r"})
+        pl = [(c, "num") for c in need_l]
+        pr = [(c, "num") for c in need_r]
+        max_rows = max(lv.num_rows, rv.num_rows)
+        cold = conf.device_min_rows("join_agg")
+        use_device = max_rows >= cold
+        if not use_device:
+            eff = max(self._cache_aware_min_rows(id_l, pl, "join_agg"),
+                      self._cache_aware_min_rows(id_r, pr, "join_agg"))
+            use_device = eff < cold and max_rows >= eff
+        if not use_device:
+            return fallback()
+        resident = self._all_resident(id_l, pl) \
+            and self._all_resident(id_r, pr)
+
+        # Device arrays for every referenced column (cache-aware).
+        ref_order: List[Tuple[str, str]] = \
+            [("l", c) for c in need_l] + [("r", c) for c in need_r]
+        col_ix = {c: i for i, (_s, c) in enumerate(ref_order)}
+        columns = [self._device_column(
+            table_of(s), c, id_l if s == "l" else id_r, "num")
+            for s, c in ref_order]
+        sides = [s for s, _c in ref_order]
+        group_ix = [col_ix[k] for k in plan.group_by]
+        value_fns, lits_list, agg_ops = [], [], []
+        for func, agg_in, _out in plan.aggs:
+            agg_ops.append(func)
+            if func in ("count", "count_all"):
+                continue
+            expr = Col(agg_in) if isinstance(agg_in, str) else agg_in
+            try:
+                fn, lits = build_value_fn(
+                    expr, [c for _s, c in ref_order])
+            except ValueError:
+                return fallback()
+            value_fns.append(fn)
+            lits_list.append(lits)
+
+        from hyperspace_tpu.ops.join_agg import join_group_aggregate
+
+        li_first, ri_first, counts, results = join_group_aggregate(
+            columns[col_ix[lk_name]], columns[col_ix[rk_name]],
+            columns, sides, group_ix, agg_ops, value_fns, lits_list,
+            topn=topn)
+        self.stats["joins"].append({
+            "strategy": "device-fused-agg", "how": "inner",
+            "resident": resident})
+        self.stats.setdefault("aggregates", []).append({
+            "strategy": "device-join-agg", "groups": int(len(counts)),
+            "rows": int(max_rows), "resident": resident,
+            "topn": None if topn is None else int(topn[2])})
+        data = {}
+        for k in plan.group_by:
+            if side_of(k) == "l":
+                data[k] = lv.column(k).take(pa.array(li_first))
+            else:
+                data[k] = rv.column(k).take(pa.array(ri_first))
+        # `results` is aligned with plan.aggs: the segment kernel emits
+        # one output per op (count slots carry the group counts).
+        for (func, agg_in, out_name), res in zip(plan.aggs, results):
+            if func in ("count", "count_all"):
+                data[out_name] = pa.array(counts.astype(np.int64))
+                continue
+            if func in ("min", "max"):
+                name = agg_in.name if isinstance(agg_in, Col) else agg_in
+                src_type = table_of(side_of(name)).schema.field(name).type
+                data[out_name] = pc.cast(pa.array(res), src_type)
+            elif func == "mean":
+                data[out_name] = pa.array(res.astype(np.float64))
+            else:  # sum: dtype carried by the device result
+                data[out_name] = pa.array(res)
+        return ("done", pa.table(data))
+
     # -- scan ---------------------------------------------------------------
     def _scan(self, plan: Scan, columns: Optional[List[str]] = None) -> pa.Table:
         rel = plan.relation
@@ -468,7 +872,15 @@ class Executor:
         if table.num_rows == 0:
             return table
         mask = self._eval_predicate(plan.condition, table)
-        return table.filter(pa.array(mask))
+        out = table.filter(pa.array(mask))
+        # The filtered rows are a pure function of (scan files, predicate):
+        # give the output a derived identity so repeats of the same query
+        # can serve its columns from the HBM cache (the resident join's
+        # filtered sides depend on this).
+        self._register_derived_identity(
+            out, self._scan_identity(table),
+            f"filter:{plan.condition!r}")
+        return out
 
     def _eval_predicate(self, expr: Expr, table: pa.Table) -> np.ndarray:
         cols = expr.referenced_columns()
@@ -695,6 +1107,15 @@ class Executor:
         r_map = _valid_key_positions(right, r_keys)
         lv = left if len(l_map) == left.num_rows else left.take(pa.array(l_map))
         rv = right if len(r_map) == right.num_rows else right.take(pa.array(r_map))
+        # Null-key drops are a pure function of (files, key columns):
+        # identity derives through so the resident join still addresses
+        # the cache when an identified side has nullable keys.
+        if lv is not left:
+            self._register_derived_identity(
+                lv, self._scan_identity(left), f"dropnull:{l_keys}")
+        if rv is not right:
+            self._register_derived_identity(
+                rv, self._scan_identity(right), f"dropnull:{r_keys}")
         li, ri = self._inner_match_pairs(lv, rv, l_keys, r_keys)
         li = l_map[li] if len(l_map) != left.num_rows else li
         ri = r_map[ri] if len(r_map) != right.num_rows else ri
@@ -741,16 +1162,37 @@ class Executor:
         if single_numeric:
             from hyperspace_tpu.ops.join import sorted_equi_join, sorted_equi_join_np
 
-            lk = columnar.to_device_numeric(left.column(l_keys[0]))
-            rk = columnar.to_device_numeric(right.column(r_keys[0]))
-            # Small joins stay on host (same cost model as filters): the
-            # device kernel's two transfers + one sync are pure latency
-            # until the batch is large (conf device_join_min_rows).
-            if max(left.num_rows, right.num_rows) \
-                    >= self.session.conf.device_min_rows("join"):
+            # Routing: the cold-transfer break-even normally; when BOTH
+            # sides' key columns are HBM-resident for their (possibly
+            # filter-derived) scan identities, only round-trip latency and
+            # the match-index pull remain, so the much smaller resident
+            # threshold applies (the contract the covering-index design
+            # states: join kernels over HBM-resident batches,
+            # JoinIndexRule.scala:36-50).
+            max_rows = max(left.num_rows, right.num_rows)
+            cold = self.session.conf.device_min_rows("join")
+            id_l = self._scan_identity(left)
+            id_r = self._scan_identity(right)
+            pl = [(l_keys[0], "num")]
+            pr = [(r_keys[0], "num")]
+            use_device = max_rows >= cold
+            if not use_device:
+                eff = max(self._cache_aware_min_rows(id_l, pl, "join"),
+                          self._cache_aware_min_rows(id_r, pr, "join"))
+                use_device = eff < cold and max_rows >= eff
+            resident = use_device and self._all_resident(id_l, pl) \
+                and self._all_resident(id_r, pr)
+            if use_device:
+                lk = self._device_column(left, l_keys[0], id_l, "num")
+                rk = self._device_column(right, r_keys[0], id_r, "num")
                 li, ri = sorted_equi_join(lk, rk)
             else:
+                lk = columnar.to_device_numeric(left.column(l_keys[0]))
+                rk = columnar.to_device_numeric(right.column(r_keys[0]))
                 li, ri = sorted_equi_join_np(lk, rk)
+            self.stats.setdefault("join_kernels", []).append({
+                "strategy": "device" if use_device else "host",
+                "rows": int(max_rows), "resident": resident})
             return np.asarray(li, dtype=np.int64), np.asarray(ri, dtype=np.int64)
         # Composite/string keys: digest join on device (or its host
         # mirror below the size threshold) with exact verification —

@@ -102,6 +102,44 @@ def _structure_key(e: Expr, parts: List, literals: List[float]) -> None:
     raise ValueError(f"Unsupported predicate node: {e!r}")
 
 
+def build_value_fn(expr: Expr, column_order: Sequence[str]
+                   ) -> Tuple[Callable, List[float]]:
+    """(fn, literals) for a pure VALUE expression (column refs, literals,
+    + - * arithmetic, negation): ``fn(columns, literals)`` returns the
+    elementwise result.  Used by the fused join+aggregate pipeline to
+    evaluate expression aggregate inputs (sum(price * (1 - discount)))
+    on device-gathered columns.  Not jitted here — callers splice it
+    into a larger jitted program.  Raises ValueError on anything outside
+    the device-arithmetic subset (division's x/0→null 3VL is host-only,
+    matching compile_predicate)."""
+    col_ix = {name: i for i, name in enumerate(column_order)}
+    literals: List[float] = []
+
+    def build(e: Expr) -> Callable:
+        if isinstance(e, Col):
+            i = col_ix[e.name]
+            return lambda cols, lits: cols[i]
+        if isinstance(e, Lit):
+            j = len(literals)
+            literals.append(e.value)
+            return lambda cols, lits: lits[j]
+        if isinstance(e, Arith):
+            if e.op == "/":
+                raise ValueError(
+                    f"Division is not device-evaluable: {e!r}")
+            fl, fr = build(e.left), build(e.right)
+            fn = {"+": lambda a, b: a + b,
+                  "-": lambda a, b: a - b,
+                  "*": lambda a, b: a * b}[e.op]
+            return lambda cols, lits: fn(fl(cols, lits), fr(cols, lits))
+        if isinstance(e, Neg):
+            f = build(e.child)
+            return lambda cols, lits: -f(cols, lits)
+        raise ValueError(f"Unsupported value expression: {e!r}")
+
+    return build(expr), literals
+
+
 def compile_predicate(expr: Expr, column_order: Sequence[str]
                       ) -> Tuple[Callable, List[float]]:
     """Build (jitted_fn, literals) where ``jitted_fn(columns, literals)``

@@ -185,9 +185,17 @@ def sorted_equi_join(left_keys: np.ndarray, right_keys: np.ndarray
     from hyperspace_tpu.utils.xla_cache import ensure_persistent_xla_cache
 
     ensure_persistent_xla_cache()
-    left_keys = np.asarray(left_keys)
-    right_keys = np.asarray(right_keys)
-    if (np.issubdtype(left_keys.dtype, np.integer)
+    # HBM-resident inputs (jax arrays from the device column cache) stay
+    # on device: np.asarray would pull them back through the very
+    # transfer residency exists to avoid.  Value-scan narrowing is
+    # host-only for the same reason — resident int64 keys sort in x64.
+    resident = isinstance(left_keys, jax.Array) \
+        or isinstance(right_keys, jax.Array)
+    if not resident:
+        left_keys = np.asarray(left_keys)
+        right_keys = np.asarray(right_keys)
+    if (not resident
+            and np.issubdtype(left_keys.dtype, np.integer)
             and np.issubdtype(right_keys.dtype, np.integer)
             and left_keys.size and right_keys.size):
 

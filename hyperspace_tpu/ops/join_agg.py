@@ -1,0 +1,164 @@
+"""Fused device join→aggregate pipeline: the whole Q3/Q10 hot path on chip.
+
+The north-star workloads (BASELINE.md: TPC-H Q3/Q10 wall-clock) are
+``aggregate(filter ⨝ index)`` shapes.  Executing the join and the
+aggregation as separate engines forces the full joined row set through
+host memory — and over a narrow attachment, back across the wire.  This
+pipeline keeps the intermediate entirely in HBM:
+
+  1. sorted equi-join over the (resident) key columns — searchsorted
+     match ranges, one host sync for the match count (the standard XLA
+     dynamic-shape point, same as ops/join.py);
+  2. device gather of every referenced column through the match indices
+     (group keys, aggregate inputs) — the joined table never
+     materializes anywhere;
+  3. expression aggregate inputs (sum(price * (1 - discount))) evaluated
+     elementwise on the gathered arrays (ops/filter.build_value_fn);
+  4. group-by via the segment machinery (ops/aggregate._group_sort /
+     _segment_reduce) — second host sync for the group count;
+  5. only the per-group results cross back to host: counts, reductions,
+     and one (left, right) row-index pair per group so the executor can
+     take the group-key VALUES from the host arrow tables in their exact
+     original types.
+
+Reference contract: Spark executes the rewritten plans of
+JoinIndexRule.scala:36-50 as exchange-free SMJ + HashAggregate; this is
+the TPU-native fusion of the two with O(groups) — not O(matches) —
+host traffic.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hyperspace_tpu.ops.aggregate import _group_sort, _segment_reduce
+from hyperspace_tpu.ops.join import _expand, _match_ranges
+from hyperspace_tpu.utils.shapes import round_up_pow2
+
+
+@partial(jax.jit, static_argnames=("k", "ascending", "capacity"))
+def _topk_groups(col, n_valid, *, k: int, ascending: bool,
+                 capacity: int):
+    """Indices of the top/bottom-k VALID group slots by ``col`` —
+    the device form of ORDER BY <agg> LIMIT k, so only k groups (not
+    all of them) ever cross the attachment.  Invalid (padding) slots
+    are parked with sentinels; ``k`` and the capacity are static, the
+    valid count is traced."""
+    valid = jnp.arange(capacity) < n_valid
+    if jnp.issubdtype(col.dtype, jnp.floating):
+        sentinel = jnp.array(-jnp.inf, dtype=col.dtype)
+    else:
+        sentinel = jnp.iinfo(col.dtype).min
+    work = col if not ascending else -col
+    work = jnp.where(valid, work, sentinel)
+    _vals, idx = jax.lax.top_k(work, k)
+    return idx
+
+
+def _int_order_words(x: jnp.ndarray) -> jnp.ndarray:
+    """(n, 2) uint32 monotone order words from an int64-domain array
+    (ints, bools, temporals in their numeric normalization): flip the
+    sign bit, split halves.  Bit layout matches what the group sort
+    needs — any monotone injective encoding works, order falls out."""
+    ux = (x.astype(jnp.int64) ^ jnp.int64(-(2 ** 63))).astype(jnp.uint64)
+    hi = (ux >> np.uint64(32)).astype(jnp.uint32)
+    lo = (ux & np.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+    return jnp.stack([hi, lo], axis=-1)
+
+
+def join_group_aggregate(
+    l_key,
+    r_key,
+    columns: Sequence,
+    column_sides: Sequence[str],
+    group_col_ix: Sequence[int],
+    agg_ops: Sequence[str],
+    value_fns: Sequence[Callable],
+    literals: Sequence[Sequence[float]],
+    topn: Optional[Tuple[int, bool, int]] = None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, List[np.ndarray]]:
+    """Inner-join two sides on single numeric keys, then group-aggregate
+    the joined rows — all on device.
+
+    Args:
+      l_key/r_key: numeric key arrays (device-resident jax arrays pass
+        through untouched; numpy ships once).
+      columns: referenced column arrays, each tagged "l"/"r" in
+        ``column_sides`` (lengths match their side's key).
+      group_col_ix: indices into ``columns`` forming the group key, in
+        group-by order (int64-domain values).
+      agg_ops: per aggregate, one of sum/min/max/mean/count/count_all.
+      value_fns/literals: per NON-count aggregate, an elementwise
+        builder over the gathered columns (ops/filter.build_value_fn)
+        and its literal vector.
+      topn: optional (agg_index, ascending, k) — keep only the k
+        groups ranking first by that aggregate's result (ORDER BY
+        <agg> LIMIT k fused on device; host traffic drops from
+        O(groups) to O(k)).
+
+    Returns:
+      (li_first, ri_first, counts, results): per group, the ORIGINAL
+      (left, right) row indices of its first joined row — the executor
+      takes group-key values from the host tables with these — plus row
+      counts and one result array per aggregate.
+    """
+    from hyperspace_tpu.utils.xla_cache import ensure_persistent_xla_cache
+
+    ensure_persistent_xla_cache()
+    with jax.enable_x64():
+        lk = jnp.asarray(l_key)
+        rk = jnp.asarray(r_key)
+        if lk.shape[0] == 0 or rk.shape[0] == 0:
+            return (np.empty(0, np.int64), np.empty(0, np.int64),
+                    np.empty(0, np.int32), [np.empty(0) for _ in agg_ops])
+        r_perm = jnp.argsort(rk)
+        lo, hi = _match_ranges(lk, rk[r_perm])
+        total = int(jnp.sum(hi - lo))  # sync 1: match count
+        if total == 0:
+            return (np.empty(0, np.int64), np.empty(0, np.int64),
+                    np.empty(0, np.int32), [np.empty(0) for _ in agg_ops])
+        capacity = round_up_pow2(total)
+        li, right_pos = _expand(lo, hi, capacity)
+        ri = r_perm[jnp.clip(right_pos, 0, rk.shape[0] - 1)]
+        gathered = [
+            jnp.asarray(c)[li if side == "l" else ri]
+            for c, side in zip(columns, column_sides)]
+        key_words = tuple(_int_order_words(gathered[i])
+                          for i in group_col_ix)
+        # Literal dtype follows numpy inference: all-int literal vectors
+        # stay integral so int expression aggregates don't silently
+        # promote to float (host arrow keeps them int64).
+        value_cols = tuple(
+            fn(gathered, jnp.asarray(np.asarray(lits))
+               if lits else jnp.zeros(0))
+            for fn, lits in zip(value_fns, literals))
+        perm, boundaries, n_groups = _group_sort(key_words, total)
+        g = int(n_groups)  # sync 2: group count
+        if g == 0:
+            return (np.empty(0, np.int64), np.empty(0, np.int64),
+                    np.empty(0, np.int32), [np.empty(0) for _ in agg_ops])
+        gcap = round_up_pow2(g)
+        out = _segment_reduce(perm, boundaries, total, value_cols,
+                              ops=tuple(agg_ops), capacity=gcap)
+        if topn is not None:
+            agg_i, ascending, k = topn
+            k_eff = min(int(k), g)
+            sel = _topk_groups(out[2 + agg_i], g, k=k_eff,
+                               ascending=bool(ascending), capacity=gcap)
+            first_rows = out[0][sel]
+            li_first = np.asarray(li[first_rows], dtype=np.int64)
+            ri_first = np.asarray(ri[first_rows], dtype=np.int64)
+            counts = np.asarray(out[1][sel])
+            results = [np.asarray(r[sel]) for r in out[2:]]
+            return li_first, ri_first, counts, results
+        first_rows = out[0][:g]
+        li_first = np.asarray(li[first_rows], dtype=np.int64)
+        ri_first = np.asarray(ri[first_rows], dtype=np.int64)
+        counts = np.asarray(out[1])[:g]
+        results = [np.asarray(r)[:g] for r in out[2:]]
+    return li_first, ri_first, counts, results

@@ -171,12 +171,16 @@ def frame_sum(vals: np.ndarray, valid: np.ndarray, lo: np.ndarray,
     """(sums, valid_counts).  Integer input sums exactly in int64 (never
     through float64 — the round-4 advisor caught 2^55+3 rounding);
     uint64 sums in its own domain (an int64 view would wrap values
-    above 2^63); floats sum in float64."""
+    above 2^63); floats sum in float64.  NaN values are treated as
+    missing — a prefix-sum engine would otherwise poison EVERY frame at
+    or after one NaN row, not just the frames containing it (and
+    frame_min_max already skips NaN, as the round-4 engine did)."""
     if vals.dtype.kind == "u":
         work = np.where(valid, vals, 0).astype(np.uint64)
     elif vals.dtype.kind in "ib":
         work = np.where(valid, vals, 0).astype(np.int64)
     else:
+        valid = valid & ~np.isnan(vals.astype(np.float64))
         work = np.where(valid, vals, 0.0).astype(np.float64)
     s, c = _prefix(work), _prefix(valid.astype(np.int64))
     n = vals.shape[0]
@@ -191,6 +195,8 @@ def frame_mean(vals: np.ndarray, valid: np.ndarray, lo: np.ndarray,
     if vals.dtype.kind in "iub":
         work = np.where(valid, vals, 0).astype(np.float64)
     else:
+        # NaN as missing, like frame_sum/frame_min_max.
+        valid = valid & ~np.isnan(vals.astype(np.float64))
         work = np.where(valid, vals, 0.0).astype(np.float64)
     s, c = _prefix(work), _prefix(valid.astype(np.int64))
     n = vals.shape[0]

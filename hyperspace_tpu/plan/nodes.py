@@ -433,6 +433,48 @@ class Distinct(LogicalPlan):
         return "Distinct"
 
 
+class SetOp(LogicalPlan):
+    """INTERSECT / EXCEPT with SQL SET semantics: distinct rows of the
+    left side that do (intersect) or do not (except) appear in the right
+    side, comparing rows null-safely (SQL set operations treat NULL as
+    equal to NULL, unlike join predicates).  Columns pair POSITIONALLY —
+    the SQL layer renames the right branch to the left's names before
+    constructing this node, Spark-style.
+
+    Reference contract: the TPC-DS corpus the reference validates
+    against uses INTERSECT (e.g. q14's cross-channel item selection,
+    /root/reference/src/test/resources/tpcds/queries/q14a.sql); Spark
+    plans these as left-semi/anti joins with null-safe equality."""
+
+    KINDS = ("intersect", "except")
+
+    def __init__(self, kind: str, left: LogicalPlan,
+                 right: LogicalPlan) -> None:
+        if kind not in self.KINDS:
+            raise ValueError(f"SetOp kind must be one of {self.KINDS}, "
+                             f"got {kind!r}")
+        self.kind = kind
+        self.children = (left, right)
+
+    @property
+    def left(self) -> LogicalPlan:
+        return self.children[0]
+
+    @property
+    def right(self) -> LogicalPlan:
+        return self.children[1]
+
+    def output_columns(self, schema_of) -> List[str]:
+        return self.left.output_columns(schema_of)
+
+    def with_children(self, children) -> "SetOp":
+        left, right = children
+        return SetOp(self.kind, left, right)
+
+    def simple_string(self) -> str:
+        return self.kind.upper()
+
+
 class Sort(LogicalPlan):
     """Total order by ``keys`` — (column, ascending) pairs.  Like
     Aggregate, the rewrite rules pass through it and rewrite the patterns

@@ -23,6 +23,7 @@ from hyperspace_tpu.plan.nodes import (
     BucketUnion,
     Compute,
     Distinct,
+    SetOp,
     Filter,
     Join,
     Limit,
@@ -156,6 +157,13 @@ def _prune(plan: LogicalPlan, required: Optional[Set[str]],
         new_child = _prune(plan.child, None, schema_of)
         if new_child is not plan.child:
             return Distinct(new_child)
+        return plan
+    if isinstance(plan, SetOp):
+        # Set operations compare FULL rows on both sides: no narrowing.
+        new_left = _prune(plan.left, None, schema_of)
+        new_right = _prune(plan.right, None, schema_of)
+        if new_left is not plan.left or new_right is not plan.right:
+            return SetOp(plan.kind, new_left, new_right)
         return plan
     if isinstance(plan, Join):
         cond_cols = set(plan.condition.referenced_columns())

@@ -445,7 +445,8 @@ class _Parser:
     def _at_clause_kw(self) -> bool:
         return self.at_kw("WHERE", "GROUP", "HAVING", "ORDER", "LIMIT",
                           "JOIN", "INNER", "LEFT", "RIGHT", "FULL", "SEMI",
-                          "ANTI", "ON", "AS", "UNION")
+                          "ANTI", "ON", "AS", "UNION", "INTERSECT",
+                          "EXCEPT", "MINUS")
 
     # -- expressions (precedence climbing) -------------------------------
     def parse_expr(self) -> Expr:
@@ -1016,55 +1017,110 @@ def _reject_markers(e: Expr, where: str, kinds=None) -> None:
     _walk_exprs(e, check)
 
 
-def sql(session, text: str, tables: Dict[str, Any]):
-    """Parse ``text`` and lower it to a Dataset against ``session``.
+def _align_positional(op_name: str, ds, nxt):
+    """Spark SQL resolves set operations BY POSITION: the second
+    branch's columns are renamed to the first branch's names pairwise,
+    regardless of their own names."""
+    prev_cols, next_cols = None, None
+    try:
+        prev_cols, next_cols = ds.columns, nxt.columns
+    except Exception:
+        return nxt  # unresolvable schema: let execution surface it
+    if len(prev_cols) != len(next_cols):
+        raise SqlError(
+            f"{op_name} branches must produce the same number of "
+            f"columns: {prev_cols} vs {next_cols}")
+    if len(set(prev_cols)) != len(prev_cols):
+        raise SqlError(
+            f"{op_name} over duplicate column names is not "
+            f"supported: {prev_cols}; alias them apart")
+    if list(prev_cols) != list(next_cols):
+        nxt = nxt.select(**{pn: Col(nc) for pn, nc
+                            in zip(prev_cols, next_cols)})
+    return nxt
 
-    ``tables`` maps SQL table names to Datasets or parquet directory
-    paths (the FROM resolution — the engine has no catalog).
-    """
-    p = _Parser(text, session, dict(tables))
-    has_union = _has_top_level_union(p)
-    ds = p.parse_select(allow_tail=not has_union)
-    while p.take_kw("UNION"):
-        # SQL set semantics: bare UNION dedups the accumulated result;
-        # UNION ALL keeps bags.  Left-associative like SQL.
-        dedup = True
+
+def _parse_intersect_chain(p: "_Parser", allow_tail: bool):
+    """select (INTERSECT select)* — INTERSECT binds tighter than
+    UNION/EXCEPT, per the SQL grammar."""
+    ds = p.parse_select(allow_tail=allow_tail)
+    while p.take_kw("INTERSECT"):
         if p.take_kw("ALL"):
-            dedup = False
-        else:
-            p.take_kw("DISTINCT")
+            p.fail("INTERSECT ALL is not supported; use INTERSECT")
+        p.take_kw("DISTINCT")
         branch = p.fork()
         nxt = branch.parse_select(allow_tail=False)
         p.i = branch.i
-        prev_cols, next_cols = None, None
-        try:
-            prev_cols, next_cols = ds.columns, nxt.columns
-        except Exception:
-            pass  # unresolvable schema: let execution surface it
-        if prev_cols is not None:
-            if len(prev_cols) != len(next_cols):
-                raise SqlError(
-                    f"UNION branches must produce the same number of "
-                    f"columns: {prev_cols} vs {next_cols}")
-            if len(set(prev_cols)) != len(prev_cols):
-                raise SqlError(
-                    f"UNION over duplicate column names is not "
-                    f"supported: {prev_cols}; alias them apart")
-            if list(prev_cols) != list(next_cols):
-                # Spark SQL resolves UNION BY POSITION: the second
-                # branch's columns are renamed to the first branch's
-                # names pairwise, regardless of their own names.
-                nxt = nxt.select(**{pn: Col(nc) for pn, nc
-                                    in zip(prev_cols, next_cols)})
-        ds = ds.union(nxt)
-        if dedup:
-            ds = ds.distinct()
-    if has_union:
+        ds = ds.intersect(_align_positional("INTERSECT", ds, nxt))
+    return ds
+
+
+def _parse_query(p: "_Parser"):
+    """Full query expression: set-operation chain plus the trailing
+    ORDER BY / LIMIT that binds the WHOLE chain (SQL)."""
+    has_setop = _has_top_level_setop(p)
+    ds = _parse_intersect_chain(p, allow_tail=not has_setop)
+    while True:
+        if p.take_kw("UNION"):
+            # SQL set semantics: bare UNION dedups the accumulated
+            # result; UNION ALL keeps bags.  Left-associative.
+            dedup = True
+            if p.take_kw("ALL"):
+                dedup = False
+            else:
+                p.take_kw("DISTINCT")
+            nxt = _parse_intersect_chain(p, allow_tail=False)
+            ds = ds.union(_align_positional("UNION", ds, nxt))
+            if dedup:
+                ds = ds.distinct()
+        elif p.take_kw("EXCEPT") or p.take_kw("MINUS"):
+            if p.take_kw("ALL"):
+                p.fail("EXCEPT ALL is not supported; use EXCEPT")
+            p.take_kw("DISTINCT")
+            nxt = _parse_intersect_chain(p, allow_tail=False)
+            ds = ds.subtract(_align_positional("EXCEPT", ds, nxt))
+        else:
+            break
+    if has_setop:
         if p.take_kw("ORDER"):
             p.expect_kw("BY")
             ds = ds.sort(*p.parse_order_keys())
         if p.take_kw("LIMIT"):
             ds = ds.limit(p.parse_limit_count())
+    return ds
+
+
+def sql(session, text: str, tables: Dict[str, Any]):
+    """Parse ``text`` and lower it to a Dataset against ``session``.
+
+    ``tables`` maps SQL table names to Datasets or parquet directory
+    paths (the FROM resolution — the engine has no catalog).  Supports
+    WITH (common table expressions), UNION [ALL], INTERSECT, and
+    EXCEPT/MINUS — the constructs the reference's TPC-DS plan-stability
+    corpus leans on (goldstandard/TPCDSBase.scala:35; q51's
+    ``WITH ... AS`` shape, q14's INTERSECT)."""
+    p = _Parser(text, session, dict(tables))
+    if p.take_kw("WITH"):
+        if p.take_kw("RECURSIVE"):
+            p.fail("WITH RECURSIVE is not supported")
+        while True:
+            t = p.next()
+            if t[0] != "ident":
+                p.fail("expected a CTE name after WITH")
+            cte_name = t[1]
+            p.expect_kw("AS")
+            p.expect_op("(")
+            body = _Parser(p.text, session, dict(p.tables))
+            body.tokens, body.i = p.tokens, p.i
+            cte_ds = _parse_query(body)
+            p.i = body.i
+            p.expect_op(")")
+            # Later CTEs and the main query see this one by name;
+            # same-named external tables are shadowed (SQL scoping).
+            p.tables[cte_name] = cte_ds
+            if not p.take_op(","):
+                break
+    ds = _parse_query(p)
     while p.take_op(";"):  # .sql files commonly end with a semicolon
         pass
     t = p.peek()
@@ -1073,13 +1129,21 @@ def sql(session, text: str, tables: Dict[str, Any]):
     return ds
 
 
-def _has_top_level_union(p: _Parser) -> bool:
+_SETOP_KWS = ("UNION", "INTERSECT", "EXCEPT", "MINUS")
+
+
+def _has_top_level_setop(p: "_Parser") -> bool:
+    """Any set operator at THIS query's nesting level — the scan stops
+    where the enclosing parenthesis closes, so a parenthesized subquery
+    context never sees its parent's operators."""
     depth = 0
     for kind, val, _pos in p.tokens[p.i:]:
         if kind == "op" and val == "(":
             depth += 1
         elif kind == "op" and val == ")":
             depth -= 1
-        elif depth == 0 and kind == "ident" and val.upper() == "UNION":
+            if depth < 0:
+                return False
+        elif depth == 0 and kind == "ident" and val.upper() in _SETOP_KWS:
             return True
     return False

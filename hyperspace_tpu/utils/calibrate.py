@@ -41,6 +41,7 @@ STATIC_MIN_ROWS: Dict[str, int] = {
     "filter": 1 << 26,
     "join": 1 << 26,
     "agg": 1 << 26,
+    "join_agg": 1 << 26,
     "build": 1 << 22,
 }
 
@@ -55,6 +56,9 @@ STATIC_RESIDENT_MIN_ROWS: Dict[str, int] = {
     "filter": 1 << 24,
     "join": 1 << 22,
     "agg": 1 << 22,
+    # Fused join+aggregate returns O(groups) — not O(rows) — so its
+    # resident break-even sits well below the plain join's.
+    "join_agg": 1 << 20,
     "build": 1 << 22,
 }
 
@@ -67,6 +71,10 @@ _BYTES_PER_ROW: Dict[str, float] = {
     "filter": 17.0,
     "join": 32.0,
     "agg": 24.0,
+    # join_agg ships keys for both sides plus ~3 referenced value/group
+    # columns cold; results return per GROUP, so the down direction is
+    # negligible per row.
+    "join_agg": 40.0,
     "build": 24.0,
 }
 
@@ -81,9 +89,22 @@ class DeviceProfile:
     d2h_bytes_per_s: float     # device->host bandwidth
     host_rows_per_s: Dict[str, float]  # per op kind
 
+    def _host_rate(self, kind: str) -> float:
+        """Per-kind host rate; profiles predating the fused join_agg
+        kind (or built by tests) derive it from join + agg — the host
+        mirror literally runs both."""
+        rate = self.host_rows_per_s.get(kind)
+        if rate is None and kind == "join_agg":
+            j = self.host_rows_per_s["join"]
+            a = self.host_rows_per_s["agg"]
+            rate = 1.0 / (1.0 / j + 1.0 / a)
+        if rate is None:
+            raise KeyError(f"Unknown device op kind: {kind!r}")
+        return rate
+
     def min_rows(self, kind: str) -> int:
         """Break-even row count for ``kind`` under this profile."""
-        host_s_per_row = 1.0 / self.host_rows_per_s[kind]
+        host_s_per_row = 1.0 / self._host_rate(kind)
         transfer_s_per_row = _BYTES_PER_ROW[kind] / self.h2d_bytes_per_s
         margin = host_s_per_row - transfer_s_per_row
         if margin <= 0:
@@ -103,7 +124,12 @@ class DeviceProfile:
         has to repay its round-trip latency (x2 margin: the two-phase
         kernels sync a scalar mid-flight), assuming device compute beats
         the host mirror at any size that clears this."""
-        rows = 2.0 * self.latency_s * self.host_rows_per_s[kind]
+        # The fused join+aggregate pipeline syncs twice (match count,
+        # group count) and pulls only per-group results: three round
+        # trips to repay.  The other two-phase kernels sync once
+        # mid-flight (x2).
+        trips = 3.0 if kind == "join_agg" else 2.0
+        rows = trips * self.latency_s * self._host_rate(kind)
         threshold = 1 << max(12, (max(1, int(rows)) - 1).bit_length())
         return min(threshold, NEVER_MIN_ROWS)
 
@@ -156,6 +182,8 @@ def _probe_host_rates(n: int = 1 << 20) -> Dict[str, float]:
         "filter": n / max(t_filter, 1e-9),
         "join": n / max(t_join, 1e-9),
         "agg": n / max(t_agg, 1e-9),
+        # The fused pipeline's host mirror does BOTH: join then hash-agg.
+        "join_agg": n / max(t_join + t_agg, 1e-9),
         "build": n / max(t_build, 1e-9),
     }
 

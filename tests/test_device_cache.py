@@ -330,3 +330,111 @@ def test_cached_dataset_self_join_uniquifies(tmp_path):
     assert leaves[0] is not leaves[1]
     assert leaves[0].table is leaves[1].table  # data itself stays shared
     assert joined.collect().num_rows == 3
+
+
+# -- resident device JOIN path (round-5 verdict item 1) ----------------------
+
+@pytest.fixture()
+def join_env(tmp_path):
+    left_dir = str(tmp_path / "orders")
+    right_dir = str(tmp_path / "lineitem")
+    os.makedirs(left_dir)
+    os.makedirs(right_dir)
+    rng = np.random.default_rng(5)
+    n_o, n_l = 8_000, 30_000
+    pq.write_table(pa.table({
+        "o_orderkey": pa.array(np.arange(n_o, dtype=np.int64)),
+        "o_totalprice": pa.array(rng.random(n_o) * 100_000),
+    }), os.path.join(left_dir, "p.parquet"))
+    pq.write_table(pa.table({
+        "l_orderkey": pa.array(rng.integers(0, n_o, n_l).astype(np.int64)),
+        "l_quantity": pa.array(rng.integers(1, 50, n_l).astype(np.int64)),
+    }), os.path.join(right_dir, "p.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    global_cache().clear()
+    return s, left_dir, right_dir
+
+
+def _join_q(s, left_dir, right_dir, price_cap=20_000.0):
+    return (s.read.parquet(left_dir)
+            .filter(col("o_totalprice") < price_cap)
+            .join(s.read.parquet(right_dir),
+                  col("o_orderkey") == col("l_orderkey"))
+            .collect())
+
+
+def test_warm_repeat_join_fires_resident_device_path(join_env):
+    s, left_dir, right_dir = join_env
+    s.conf.device_cache_policy = "eager"
+    s.conf.device_resident_min_rows = 1
+
+    first = _join_q(s, left_dir, right_dir)
+    st1 = s.last_execution_stats
+    assert st1["join_kernels"][-1]["strategy"] == "device"
+    assert st1["join_kernels"][-1]["resident"] is False  # populating pass
+
+    second = _join_q(s, left_dir, right_dir)
+    st2 = s.last_execution_stats
+    assert st2["join_kernels"][-1]["strategy"] == "device"
+    # The warm repeat is routed by residency: both key columns (one of
+    # them FILTER-DERIVED) served from the cache, zero shipped.
+    assert st2["join_kernels"][-1]["resident"] is True
+    assert st2["device_cache"]["hits"] >= 2
+    assert st2["device_cache"].get("misses", 0) == 0
+    assert first.num_rows == second.num_rows
+
+    # Answer parity with the pure host join.
+    s.conf.device_cache_policy = "off"
+    s.conf.device_join_min_rows = 1 << 60
+    host = _join_q(s, left_dir, right_dir)
+    assert sorted(host.column("l_quantity").to_pylist()) \
+        == sorted(second.column("l_quantity").to_pylist())
+
+
+def test_changed_filter_predicate_never_serves_stale_join(join_env):
+    s, left_dir, right_dir = join_env
+    s.conf.device_cache_policy = "eager"
+    s.conf.device_resident_min_rows = 1
+
+    _join_q(s, left_dir, right_dir, price_cap=20_000.0)
+    warm = _join_q(s, left_dir, right_dir, price_cap=20_000.0)
+    assert s.last_execution_stats["join_kernels"][-1]["resident"] is True
+    # A DIFFERENT predicate produces a different derived identity: the
+    # filtered key column must re-ship, never alias the cached rows.
+    other = _join_q(s, left_dir, right_dir, price_cap=60_000.0)
+    assert s.last_execution_stats["join_kernels"][-1]["resident"] is False
+    assert other.num_rows > warm.num_rows
+    # Host parity for the new predicate.
+    s.conf.device_cache_policy = "off"
+    s.conf.device_join_min_rows = 1 << 60
+    host = _join_q(s, left_dir, right_dir, price_cap=60_000.0)
+    assert host.num_rows == other.num_rows
+
+
+def test_null_keys_resident_join_matches_host(join_env, tmp_path):
+    s, _left, right_dir = join_env
+    nl_dir = str(tmp_path / "orders_nulls")
+    os.makedirs(nl_dir)
+    keys = np.arange(8_000, dtype=np.int64)
+    pq.write_table(pa.table({
+        "o_orderkey": pa.array(
+            [None if i % 7 == 0 else int(k) for i, k in enumerate(keys)],
+            type=pa.int64()),
+        "o_totalprice": pa.array(np.linspace(0, 100_000, 8_000)),
+    }), os.path.join(nl_dir, "p.parquet"))
+    s.conf.device_cache_policy = "eager"
+    s.conf.device_resident_min_rows = 1
+
+    def q():
+        return (s.read.parquet(nl_dir)
+                .join(s.read.parquet(right_dir),
+                      col("o_orderkey") == col("l_orderkey"))
+                .collect())
+
+    first = q()
+    second = q()
+    assert s.last_execution_stats["join_kernels"][-1]["resident"] is True
+    s.conf.device_cache_policy = "off"
+    s.conf.device_join_min_rows = 1 << 60
+    host = q()
+    assert host.num_rows == first.num_rows == second.num_rows

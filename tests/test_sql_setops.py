@@ -1,0 +1,178 @@
+"""WITH (CTEs), INTERSECT, EXCEPT/MINUS — round-5 verdict item 3's SQL
+constructs (the reference's TPC-DS corpus leans on WITH and INTERSECT:
+goldstandard/TPCDSBase.scala:35, queries/q51.sql, q14a.sql)."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import HyperspaceSession, col
+from hyperspace_tpu.sql import sql
+from hyperspace_tpu.sql.parser import SqlError
+
+
+@pytest.fixture()
+def env(tmp_path):
+    d1 = str(tmp_path / "t1")
+    d2 = str(tmp_path / "t2")
+    os.makedirs(d1)
+    os.makedirs(d2)
+    pq.write_table(pa.table({
+        "k": pa.array([1, 2, 3, 4, 5, 5, None], type=pa.int64()),
+        "v": pa.array([10, 20, 30, 40, 50, 50, 70], type=pa.int64()),
+    }), os.path.join(d1, "p.parquet"))
+    pq.write_table(pa.table({
+        "k2": pa.array([3, 4, 5, 6, None], type=pa.int64()),
+        "v2": pa.array([30, 40, 50, 60, 70], type=pa.int64()),
+    }), os.path.join(d2, "p.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    return s, {"t1": d1, "t2": d2}
+
+
+class TestCte:
+    def test_single_cte(self, env):
+        s, tables = env
+        out = sql(s, """
+            WITH big AS (SELECT k, v FROM t1 WHERE v >= 30)
+            SELECT k FROM big ORDER BY k
+        """, tables=tables).collect()
+        assert out.column("k").to_pylist() == [None, 3, 4, 5, 5]
+
+    def test_cte_chain_references_earlier_cte(self, env):
+        s, tables = env
+        out = sql(s, """
+            WITH big AS (SELECT k, v FROM t1 WHERE v >= 30),
+                 biggest AS (SELECT k FROM big WHERE v >= 50)
+            SELECT count(*) AS n FROM biggest
+        """, tables=tables).collect()
+        assert out.column("n").to_pylist() == [3]
+
+    def test_cte_shadows_external_table(self, env):
+        s, tables = env
+        out = sql(s, """
+            WITH t1 AS (SELECT k2 AS k FROM t2)
+            SELECT count(*) AS n FROM t1
+        """, tables=tables).collect()
+        assert out.column("n").to_pylist() == [5]
+
+    def test_cte_used_twice(self, env):
+        s, tables = env
+        out = sql(s, """
+            WITH base AS (SELECT k, v FROM t1 WHERE k IS NOT NULL)
+            SELECT a.k AS k FROM base a
+            JOIN base b ON a.k = b.k
+            WHERE a.v >= 50
+        """, tables=tables).collect()
+        # k=5 appears twice in base -> 2x2 self-join pairs.
+        assert sorted(out.column("k").to_pylist()) == [5, 5, 5, 5]
+
+    def test_cte_body_may_contain_union(self, env):
+        s, tables = env
+        out = sql(s, """
+            WITH u AS (SELECT k FROM t1 WHERE k = 1
+                       UNION ALL SELECT k2 FROM t2 WHERE k2 = 6)
+            SELECT count(*) AS n FROM u
+        """, tables=tables).collect()
+        assert out.column("n").to_pylist() == [2]
+
+    def test_with_recursive_rejected(self, env):
+        s, tables = env
+        with pytest.raises(SqlError, match="RECURSIVE"):
+            sql(s, "WITH RECURSIVE r AS (SELECT k FROM t1) "
+                   "SELECT * FROM r", tables=tables)
+
+
+class TestSetOps:
+    def test_intersect_basic_positional(self, env):
+        s, tables = env
+        out = sql(s, """
+            SELECT k FROM t1 INTERSECT SELECT k2 FROM t2
+            ORDER BY k
+        """, tables=tables).collect()
+        # NULL intersects NULL (SQL set ops are null-safe), 5 dedups.
+        assert out.column("k").to_pylist() == [None, 3, 4, 5]
+
+    def test_except_basic(self, env):
+        s, tables = env
+        out = sql(s, """
+            SELECT k FROM t1 EXCEPT SELECT k2 FROM t2
+            ORDER BY k
+        """, tables=tables).collect()
+        assert out.column("k").to_pylist() == [1, 2]
+
+    def test_minus_alias(self, env):
+        s, tables = env
+        out = sql(s, "SELECT k FROM t1 MINUS SELECT k2 FROM t2",
+                  tables=tables).collect()
+        assert sorted(out.column("k").to_pylist()) == [1, 2]
+
+    def test_intersect_binds_tighter_than_union(self, env):
+        s, tables = env
+        # A UNION B INTERSECT C  ==  A UNION (B INTERSECT C)
+        out = sql(s, """
+            SELECT k FROM t1 WHERE k = 1
+            UNION
+            SELECT k FROM t1 WHERE k IS NOT NULL
+            INTERSECT
+            SELECT k2 FROM t2 WHERE k2 = 3
+        """, tables=tables).collect()
+        assert sorted(out.column("k").to_pylist()) == [1, 3]
+
+    def test_trailing_order_limit_bind_whole_chain(self, env):
+        s, tables = env
+        out = sql(s, """
+            SELECT k FROM t1 WHERE k IS NOT NULL
+            EXCEPT SELECT k2 FROM t2
+            ORDER BY k DESC LIMIT 1
+        """, tables=tables).collect()
+        assert out.column("k").to_pylist() == [2]
+
+    def test_except_all_rejected(self, env):
+        s, tables = env
+        with pytest.raises(SqlError, match="EXCEPT ALL"):
+            sql(s, "SELECT k FROM t1 EXCEPT ALL SELECT k2 FROM t2",
+                tables=tables)
+
+    def test_arity_mismatch_rejected(self, env):
+        s, tables = env
+        with pytest.raises(SqlError, match="number of columns"):
+            sql(s, "SELECT k, v FROM t1 INTERSECT SELECT k2 FROM t2",
+                tables=tables)
+
+    def test_multi_column_rows_compare_as_tuples(self, env):
+        s, tables = env
+        out = sql(s, """
+            SELECT k, v FROM t1 INTERSECT SELECT k2, v2 FROM t2
+            ORDER BY k
+        """, tables=tables).collect()
+        # (None, 70) exists on both sides: null-safe tuples intersect.
+        assert out.column("k").to_pylist() == [None, 3, 4, 5]
+        assert out.column("v").to_pylist() == [70, 30, 40, 50]
+
+    def test_dsl_intersect_subtract(self, env):
+        s, tables = env
+        a = s.read.parquet(tables["t1"]).select("k")
+        b = (s.read.parquet(tables["t2"])
+             .select(k=col("k2")))
+        inter = a.intersect(b).collect()
+        assert sorted(x for x in inter.column("k").to_pylist()
+                      if x is not None) == [3, 4, 5]
+        sub = a.subtract(b).collect()
+        assert sorted(sub.column("k").to_pylist()) == [1, 2]
+
+    def test_pandas_cross_check(self, env):
+        s, tables = env
+        t1 = pd.read_parquet(tables["t1"])
+        t2 = pd.read_parquet(tables["t2"])
+        expect = sorted(set(t1["k"].dropna().astype(int))
+                        & set(t2["k2"].dropna().astype(int)))
+        out = sql(s, "SELECT k FROM t1 WHERE k IS NOT NULL "
+                     "INTERSECT SELECT k2 FROM t2 WHERE k2 IS NOT NULL",
+                  tables=tables).collect()
+        assert sorted(out.column("k").to_pylist()) == expect

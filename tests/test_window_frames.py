@@ -520,3 +520,20 @@ def test_fuzz_frames_vs_naive(session, tmp_path):
                         assert g_ == pytest.approx(w_), (func, frame)
                     else:
                         assert g_ == w_, (func, frame, got, want)
+
+
+def test_nan_does_not_poison_other_frames(session, tmp_path):
+    # A NaN row must act as missing for ITS frames only — prefix sums
+    # must not propagate NaN into every later frame (review regression).
+    d = _write(tmp_path, pa.table({
+        "o": pa.array([1, 2, 3], type=pa.int64()),
+        "v": pa.array([float("nan"), 1.0, 2.0], type=pa.float64()),
+    }), name="nan")
+    out = (session.read.parquet(d)
+           .with_window("s", "sum", order_by=["o"], value="v",
+                        frame=(0, 0))
+           .with_window("m", "mean", order_by=["o"], value="v",
+                        frame=(None, 0))
+           .sort("o").collect())
+    assert out.column("s").to_pylist() == [None, 1.0, 2.0]
+    assert out.column("m").to_pylist() == [None, 1.0, 1.5]
