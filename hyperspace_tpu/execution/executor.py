@@ -553,6 +553,29 @@ class Executor:
                     return None
             return None
 
+    def _plan_row_upper_bound(self, node) -> Optional[int]:
+        """Row UPPER BOUND for a join side without executing it: parquet
+        footer counts under Filter/Project chains (filters only shrink).
+        None when the shape or format doesn't allow a cheap answer."""
+        while isinstance(node, (Filter, Project, Sort, Limit)):
+            node = node.child
+        if isinstance(node, InMemory):
+            return node.table.num_rows
+        if not isinstance(node, Scan):
+            return None
+        rel = node.relation
+        try:
+            import pyarrow.parquet as pq
+
+            if rel.file_paths is not None:
+                paths = list(rel.file_paths)
+            else:
+                paths = [f.name for f in list_data_files(rel.root_paths)]
+            return sum(pq.ParquetFile(p).metadata.num_rows
+                       for p in paths)
+        except Exception:
+            return None
+
     def _join_agg_static_pregate(self, plan: Aggregate,
                                  child: Join) -> bool:
         """False when the fused path is KNOWABLY ineligible before any
@@ -610,7 +633,8 @@ class Executor:
         if not plan.group_by:
             return None
         child = plan.child
-        if not isinstance(child, Join) or child.how != "inner":
+        if not isinstance(child, Join) or child.how != "inner" \
+                or child.residual is not None:
             return None
         # Plausibility gate BEFORE touching anything: the eager populate
         # policy (pay the transfer once, serve repeats from HBM), or a
@@ -638,6 +662,17 @@ class Executor:
         if not self._join_agg_static_pregate(plan, child):
             # Statically ineligible: leave the plan alone so the normal
             # path (bucketed host join included) runs untouched.
+            return None
+        # Row pre-gate from parquet FOOTERS: when even the upper bound
+        # cannot clear the lowest applicable threshold, the device can
+        # never win — bail before materializing anything so small joins
+        # keep their bucketed host path.
+        lo_thresh = min(conf.device_min_rows("join_agg"),
+                        conf.resident_min_rows("join_agg"))
+        est_l = self._plan_row_upper_bound(child.left)
+        est_r = self._plan_row_upper_bound(child.right)
+        if est_l is not None and est_r is not None \
+                and max(est_l, est_r) < lo_thresh:
             return None
 
         left = self.execute(child.left)
@@ -705,10 +740,24 @@ class Executor:
         # min/max need a plain column (the result restores its type).
         from hyperspace_tpu.ops.filter import build_value_fn
 
+        from hyperspace_tpu.ops.filter import build_value_fn as _bvf
+
         agg_ref_names: List[str] = []
         for func, agg_in, _out in plan.aggs:
             if func == "count_all":
                 continue
+            if func == "count" and isinstance(agg_in, Expr) \
+                    and not isinstance(agg_in, Col):
+                # count(expr): the kernel counts group rows, which only
+                # equals count(non-null expr) when the expression can
+                # never produce null from null-free inputs — true for
+                # the device arithmetic subset (+ - * neg), NOT for
+                # division (x/0 -> null).  Validate through the same
+                # compiler; ineligible shapes take the host path.
+                try:
+                    _bvf(agg_in, sorted(agg_in.referenced_columns()))
+                except ValueError:
+                    return fallback()
             refs = [agg_in.name] if isinstance(agg_in, Col) else (
                 [agg_in] if isinstance(agg_in, str)
                 else list(agg_in.referenced_columns()))
@@ -1076,10 +1125,12 @@ class Executor:
                                         "how": plan.how})
         left = self.execute(plan.left)
         right = self.execute(plan.right)
-        return self._host_join_tables(left, right, plan.condition, plan.how)
+        return self._host_join_tables(left, right, plan.condition,
+                                      plan.how, residual=plan.residual)
 
     def _host_join_tables(self, left: pa.Table, right: pa.Table,
-                          condition: Expr, how: str) -> pa.Table:
+                          condition: Expr, how: str,
+                          residual: Optional[Expr] = None) -> pa.Table:
         """Join two materialized tables.  Match pairs come from the inner
         equi-join kernels over the VALID-key rows (SQL: null keys never
         match); the join type then shapes the output from those pairs —
@@ -1119,6 +1170,16 @@ class Executor:
         li, ri = self._inner_match_pairs(lv, rv, l_keys, r_keys)
         li = l_map[li] if len(l_map) != left.num_rows else li
         ri = r_map[ri] if len(r_map) != right.num_rows else ri
+        if residual is not None and len(li):
+            # Inequality correlations etc.: the residual predicate
+            # filters the MATCHED pairs before the join type shapes the
+            # output (NULL => no match, like any join predicate) — so an
+            # anti join keeps exactly the left rows with no SURVIVING
+            # match, the literal NOT EXISTS semantics.
+            combined = _concat_horizontal(left.take(pa.array(li)),
+                                          right.take(pa.array(ri)))
+            mask = self._eval_arrow(residual, combined)
+            li, ri = li[mask], ri[mask]
 
         if how == "inner":
             return _concat_horizontal(left.take(pa.array(li)),
@@ -1238,6 +1299,11 @@ class Executor:
         files — the executed form of the reference's on-the-fly shuffle
         (RuleUtils.scala:511-570), keeping the index side exchange-free
         instead of degrading to a full-table merge."""
+        if plan.residual is not None:
+            # Residual joins (subquery inequality correlations) take the
+            # plain path: they're semi/anti existence shapes, not the
+            # bucketed-index fan-out this optimizes.
+            return None
         precheck = bucketed_join_precheck(self.session, plan)
         if precheck is None:
             return None

@@ -379,12 +379,20 @@ class Join(LogicalPlan):
     HOW = ("inner", "left", "right", "full", "semi", "anti")
 
     def __init__(self, left: LogicalPlan, right: LogicalPlan,
-                 condition: Expr, how: str = "inner") -> None:
+                 condition: Expr, how: str = "inner",
+                 residual: "Optional[Expr]" = None) -> None:
         if how not in self.HOW:
             raise ValueError(f"Unsupported join type {how!r}; "
                              f"expected one of {self.HOW}")
         self.condition = condition
         self.how = how
+        # Residual predicate over the MATCHED pair rows, evaluated after
+        # the equi match (NULL => no match, SQL semantics).  Constructed
+        # by the subquery rewrite for inequality correlations (TPC-H
+        # Q21's literal EXISTS: l2.l_suppkey <> l1.l_suppkey rides the
+        # l_orderkey equality as a residual) — the public join() surface
+        # stays equi-only, like JoinIndexRule.scala:134-140's scope.
+        self.residual = residual
         self.children = (left, right)
 
     @property
@@ -404,9 +412,13 @@ class Join(LogicalPlan):
 
     def with_children(self, children) -> "Join":
         left, right = children
-        return Join(left, right, self.condition, self.how)
+        return Join(left, right, self.condition, self.how,
+                    residual=self.residual)
 
     def simple_string(self) -> str:
+        if self.residual is not None:
+            return (f"Join {self.how} on {self.condition!r} "
+                    f"residual {self.residual!r}")
         return f"Join {self.how} on {self.condition!r}"
 
 

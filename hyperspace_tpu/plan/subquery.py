@@ -259,9 +259,16 @@ def _simplify_exists(plan: LogicalPlan):
         return ("plan", plan)
 
 
-def _split_correlations(plan: LogicalPlan):
+def _split_correlations(plan: LogicalPlan, residuals=None):
     """Remove ``inner == outer_ref`` conjuncts from the Filters of a
-    subplan chain; returns (new_plan, [(outer_name, inner_name)])."""
+    subplan chain; returns (new_plan, [(outer_name, inner_name)]).
+
+    When ``residuals`` (a list) is given, NON-equality correlated
+    conjuncts (``inner <> outer_ref``, ``inner < outer_ref`` — TPC-H
+    Q21's literal EXISTS shape) are collected into it instead of
+    raising, provided every inner column they reference hoists cleanly
+    past the intervening Computes; the caller turns them into a
+    residual join predicate."""
     pairs: List[Tuple[str, str]] = []
     trapped: List[str] = []
 
@@ -320,6 +327,15 @@ def _split_correlations(plan: LogicalPlan):
                         trapped.append(corr[1])
                         keep.append(conj)  # redefining Compute above ->
                         continue           # specific error at the caller
+                    if residuals is not None:
+                        inner_refs = conj.referenced_columns()
+                        if all(passes_computes(c, computes)
+                               for c in inner_refs):
+                            residuals.append(conj)
+                            continue
+                        trapped.extend(sorted(inner_refs))
+                        keep.append(conj)
+                        continue
                     raise SubqueryError(
                         f"Correlated subquery predicates must be "
                         f"inner_col == outer_ref(...) equality conjuncts; "
@@ -376,6 +392,20 @@ def _rewrite_correlated_scalar(outer: LogicalPlan, pred: Expr,
     """Filter(pred(sq)) over ``outer`` -> Project(outer cols)(
     Filter(pred')(outer JOIN sub-aggregated-by-correlation-keys))."""
     sub = sq.plan
+    # Post-aggregate scalar arithmetic (TPC-DS q1's
+    # ``SELECT avg(x) * 1.2``): a single-output Compute over the
+    # aggregate folds into the comparison after the hoist.
+    post = None
+    if isinstance(sub, Compute) and len(sub.exprs) == 1 \
+            and isinstance(sub.child, Aggregate):
+        post = sub.exprs[0]
+        sub = sub.child
+        agg_out = sub.aggs[0][2] if len(sub.aggs) == 1 else None
+        if agg_out is None or not (
+                post[1].referenced_columns() <= {agg_out}):
+            raise SubqueryError(
+                "A correlated scalar subquery's computed output may "
+                "only reference its own aggregate")
     count_like = (isinstance(sub, Aggregate) and len(sub.aggs) == 1
                   and sub.aggs[0][0] in ("count", "count_all",
                                          "count_distinct"))
@@ -433,6 +463,11 @@ def _rewrite_correlated_scalar(outer: LogicalPlan, pred: Expr,
     else:
         joined = Join(outer, renamed, cond, "inner")
         replacement = Col(fresh_agg)
+    if post is not None:
+        base = replacement
+        replacement = _map_expr(
+            post[1], lambda e: base
+            if isinstance(e, Col) and e.name == out_name else e)
     new_pred = _map_expr(pred, lambda e: replacement if e is sq else e)
     outer_cols = outer.output_columns(session.schema_of)
     return Project(list(outer_cols), Filter(new_pred, joined))
@@ -489,7 +524,9 @@ def _rewrite_filter(node: Filter, session, state) -> LogicalPlan:
                 if negated:
                     return rebuild(rest, node.child)
                 return rebuild(rest + [Lit(False)], node.child)
-            stripped, pairs, trapped = _split_correlations(simplified)
+            residuals: List[Expr] = []
+            stripped, pairs, trapped = _split_correlations(simplified,
+                                                           residuals)
             if trapped:
                 raise SubqueryError(
                     f"Correlation column(s) {sorted(set(trapped))} are "
@@ -498,8 +535,13 @@ def _rewrite_filter(node: Filter, session, state) -> LogicalPlan:
                     f"through unchanged")
             if _plan_has_outer_refs(stripped):
                 raise SubqueryError(
-                    "EXISTS correlation must be inner_col == outer_ref() "
-                    "equality conjuncts in the subquery's filters")
+                    "EXISTS correlation must be conjuncts over "
+                    "outer_ref() in the subquery's filters")
+            if residuals and not pairs:
+                raise SubqueryError(
+                    "EXISTS with only non-equality correlations needs "
+                    "at least one inner == outer_ref equality conjunct "
+                    "(pure nested-loop existence is unsupported)")
             if not pairs:
                 # Uncorrelated: existence is one probe, folded here.
                 from hyperspace_tpu.execution.executor import Executor
@@ -510,20 +552,50 @@ def _rewrite_filter(node: Filter, session, state) -> LogicalPlan:
                     return rebuild(rest, node.child)  # always TRUE
                 return rebuild(rest + [Lit(False)], node.child)
             inner_cols = [i for _o, i in pairs]
-            missing = set(inner_cols) - set(
+            res_refs = sorted({c for r in residuals
+                               for c in r.referenced_columns()})
+            needed = sorted(set(inner_cols) | set(res_refs))
+            missing = set(needed) - set(
                 stripped.output_columns(session.schema_of))
             if missing:
                 raise SubqueryError(
                     f"EXISTS correlation column(s) {sorted(missing)} are "
                     f"projected away inside the subquery; keep them "
                     f"visible (or drop the intermediate projection)")
-            cond = conjoin([BinOp("==", Col(o), Col(i))
+            if not residuals:
+                cond = conjoin([BinOp("==", Col(o), Col(i))
+                                for o, i in pairs])
+                # Only existence matters: project the sub to the
+                # correlation columns (its own SELECT list — often
+                # `SELECT 1` — is shed).
+                sub_side = Project(sorted(set(inner_cols)), stripped)
+                return Join(rebuild(rest, node.child), sub_side, cond,
+                            "anti" if negated else "semi")
+            # Inequality correlations (TPC-H Q21's literal EXISTS:
+            # l2.l_suppkey <> l1.l_suppkey riding the l_orderkey
+            # equality): the inner side's columns rename to fresh names
+            # (self-joins share spellings), the equality pairs become
+            # the semi/anti join keys, and the non-equality conjuncts
+            # follow as a RESIDUAL predicate over matched pairs.
+            k = state["n"]
+            state["n"] += 1
+            ren = {c: f"__sq{k}_{c}" for c in needed}
+            sub_side = Compute([(ren[c], Col(c)) for c in needed],
+                               stripped)
+            cond = conjoin([BinOp("==", Col(o), Col(ren[i]))
                             for o, i in pairs])
-            # Only existence matters: project the sub to the correlation
-            # columns (its own SELECT list — often `SELECT 1` — is shed).
-            sub_side = Project(sorted(set(inner_cols)), stripped)
+
+            def bind(e: Expr) -> Expr:
+                if isinstance(e, OuterRef):
+                    return Col(e.name)
+                if isinstance(e, Col):
+                    return Col(ren[e.name])
+                return e
+
+            residual = conjoin([_map_expr(r, bind) for r in residuals])
             return Join(rebuild(rest, node.child), sub_side, cond,
-                        "anti" if negated else "semi")
+                        "anti" if negated else "semi",
+                        residual=residual)
         if isinstance(conj, Not) and isinstance(conj.child, InSubquery):
             inq = conj.child
             if not isinstance(inq.child, Col):

@@ -122,7 +122,8 @@ class JoinIndexRule:
 
         new_left = rewrite_side(join.left, l_scan, l_entry)
         new_right = rewrite_side(join.right, r_scan, r_entry)
-        new_plan = Join(new_left, new_right, join.condition, join.how)
+        new_plan = Join(new_left, new_right, join.condition, join.how,
+                        residual=join.residual)
         get_event_logger().log_event(HyperspaceIndexUsageEvent(
             index_names=[l_entry.name, r_entry.name],
             plan_before=Join(join.left, join.right, join.condition, join.how).tree_string(),

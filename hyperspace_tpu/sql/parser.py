@@ -84,7 +84,13 @@ class _WindowCall(Expr):
         self.frame = frame
 
     def __repr__(self) -> str:
-        return f"_window_{self.func}"
+        # STRUCTURAL repr: ORDER BY-expression resolution matches select
+        # items by repr, so two windows differing only in value/keys/
+        # frame must never collide.
+        return (f"_window_{self.func}({self.value!r}, "
+                f"p={list(self.partition_by)!r}, "
+                f"o={list(self.order_by)!r}, k={self.offset}, "
+                f"f={self.frame!r})")
 
 
 _AGG_FUNCS = {"sum": "sum", "min": "min", "max": "max", "avg": "mean",
@@ -205,6 +211,8 @@ class _Parser:
         where = None
         if self.take_kw("WHERE"):
             where = self.parse_expr()
+        if isinstance(ds, _CommaJoin):
+            ds, where = _assemble_comma_join(self, ds.items, where)
         group_by: List[str] = []
         if self.take_kw("GROUP"):
             self.expect_kw("BY")
@@ -334,22 +342,37 @@ class _Parser:
                 return keys
 
     def parse_order_keys(self):
+        """ORDER BY entries: (column_name, asc) for plain references, or
+        (Expr, asc) for expression keys (``ORDER BY sum(x) DESC`` — the
+        TPC-DS corpus orders by unaliased aggregates); _lower resolves
+        expression keys against the select outputs structurally."""
         keys = []
         while True:
-            t = self.next()
-            if t[0] != "ident":
-                self.fail("ORDER BY keys must be output column names")
+            e = self.parse_expr()
             asc = True
             if self.take_kw("DESC"):
                 asc = False
             else:
                 self.take_kw("ASC")
-            keys.append((t[1], asc))
+            keys.append((e.name if isinstance(e, Col) else e, asc))
             if not self.take_op(","):
                 return keys
 
     # -- FROM / JOIN -----------------------------------------------------
     def parse_from(self):
+        """One FROM clause.  Comma-separated sources (the TPC-DS corpus
+        idiom, ``FROM store_sales, date_dim, item WHERE ...``) return a
+        _CommaJoin placeholder: the join tree is assembled AFTER the
+        WHERE clause parses, from its equi-join conjuncts — explicit
+        JOIN ... ON binds tighter than the comma, per SQL."""
+        items = [self._parse_from_item()]
+        while self.take_op(","):
+            items.append(self._parse_from_item())
+        if len(items) == 1:
+            return items[0]
+        return _CommaJoin(items)
+
+    def _parse_from_item(self):
         ds = self.parse_source()
         while True:
             how = self.parse_join_type()
@@ -529,9 +552,34 @@ class _Parser:
         e = self.parse_multiplicative()
         while self.at_op("+", "-"):
             op = self.next()[1]
+            if self.at_kw("INTERVAL"):
+                # Constant date arithmetic — TPC-DS's
+                # ``cast('1999-02-22' AS DATE) + INTERVAL 30 days``
+                # (q12/q20/q37/q82/q98): folds to a date literal at
+                # parse time.  Non-constant date expressions would need
+                # runtime interval arithmetic — rejected loudly.
+                days = self._parse_interval_days()
+                base = _fold_const_date(e)
+                if base is None:
+                    self.fail("INTERVAL arithmetic needs a constant "
+                              "date left-hand side (a DATE literal or "
+                              "cast('...' AS DATE))")
+                delta = datetime.timedelta(days=days)
+                e = Lit(base + delta if op == "+" else base - delta)
+                continue
             e = (e + self.parse_multiplicative()) if op == "+" \
                 else (e - self.parse_multiplicative())
         return e
+
+    def _parse_interval_days(self) -> int:
+        self.expect_kw("INTERVAL")
+        t = self.next()
+        if t[0] != "num" or "." in str(t[1]):
+            self.fail("INTERVAL needs an integer count")
+        unit = self.next()
+        if unit[0] != "ident" or unit[1].upper() not in ("DAY", "DAYS"):
+            self.fail("Only INTERVAL <n> DAYS is supported")
+        return int(t[1])
 
     def parse_multiplicative(self) -> Expr:
         e = self.parse_unary()
@@ -776,9 +824,20 @@ class _Parser:
             if func in ("sum", "min", "max", "mean", "count", "lag",
                         "lead", "first_value", "last_value") \
                     and arg is not None:
-                if not isinstance(arg, Col):
-                    self.fail("window function arguments must be columns")
-                value = arg.name
+                if isinstance(arg, Col):
+                    value = arg.name
+                elif isinstance(arg, _AggCall) and func in (
+                        "sum", "min", "max", "mean", "count",
+                        "first_value", "last_value"):
+                    # Window over an aggregate output — TPC-DS's
+                    # ``sum(sum(x)) OVER (...)`` idiom (q51/q12/q20):
+                    # the inner aggregate materializes as a hidden
+                    # GROUP BY output and the window runs over it.
+                    value = arg
+                else:
+                    self.fail("window function arguments must be "
+                              "columns (or aggregates in a GROUP BY "
+                              "query)")
             if func in ("lag", "lead"):
                 if len(args) > 2:
                     self.fail(f"{func}(value[, offset]) takes at most "
@@ -833,6 +892,12 @@ def _contains_agg(e: Expr) -> bool:
     return _contains(e, _AggCall)
 
 
+def _contains_window(e: Expr) -> bool:
+    from hyperspace_tpu.plan.subquery import _contains
+
+    return _contains(e, _WindowCall)
+
+
 def _lower(p: _Parser, ds, items, distinct, where, group_by, having,
            order_by, limit):
     if where is not None:
@@ -848,6 +913,10 @@ def _lower(p: _Parser, ds, items, distinct, where, group_by, having,
     # current dataset, (name, expr) for a computed output.
     out_items: List[Tuple[str, Optional[Expr]]] = []
     windows_to_apply: List[Tuple[str, _WindowCall]] = []
+    # ORDER BY may reference select items by EXPRESSION (TPC-DS's
+    # ``ORDER BY sum(x) DESC``): map each original item's structure to
+    # its output name for structural resolution below.
+    repr_to_name: Dict[str, str] = {}
 
     if aggregate_query:
         if star:
@@ -858,7 +927,7 @@ def _lower(p: _Parser, ds, items, distinct, where, group_by, having,
         # as with_column first.
         alias_exprs = {a: e for a, e in items
                        if a is not None and e is not None
-                       and not isinstance(e, _WindowCall)
+                       and not _contains_window(e)
                        and not _contains_agg(e)}
         keys: List[str] = []
         for k in group_by:
@@ -888,36 +957,82 @@ def _lower(p: _Parser, ds, items, distinct, where, group_by, having,
             agg_specs[name] = (inp, call.func)
             return name
 
+        def bind_window(w: _WindowCall) -> _WindowCall:
+            """A window in an aggregate query runs over the GROUPED
+            rows; an aggregate VALUE (sum(sum(x)) OVER ...) becomes a
+            hidden aggregate output the window then reads."""
+            if isinstance(w.value, _AggCall):
+                hidden_name = agg_name(w.value, None)
+                return _WindowCall(w.func, hidden_name, w.partition_by,
+                                   w.order_by, w.offset, frame=w.frame)
+            return w
+
         for alias, e in items:
             if e is None:
                 continue
             if isinstance(e, _WindowCall):
                 if alias is None:
                     raise SqlError("Window select items need AS aliases")
-                windows_to_apply.append((alias, e))
+                windows_to_apply.append((alias, bind_window(e)))
                 out_items.append((alias, None))
+                repr_to_name[repr(e)] = alias
                 continue
             if isinstance(e, _AggCall):
-                out_items.append((agg_name(e, alias), None))
+                name = agg_name(e, alias)
+                out_items.append((name, None))
+                repr_to_name[repr(e)] = name
                 continue
-            if _contains_agg(e):
+            if _contains_window(e):
+                # Window nested in an expression (TPC-DS q12's
+                # ``agg*100/sum(sum(x)) over (...)`` ratio): each window
+                # materializes as a hidden analytic column; the final
+                # Compute (which runs after the windows apply) reads it.
                 if alias is None:
                     raise SqlError(
-                        f"Computed aggregate select items need AS "
+                        f"Computed window select items need AS "
                         f"aliases: {e!r}")
+
+                def repl(x):
+                    if isinstance(x, _WindowCall):
+                        hidden_w = f"__win{len(windows_to_apply)}"
+                        windows_to_apply.append((hidden_w,
+                                                 bind_window(x)))
+                        return Col(hidden_w)
+                    if isinstance(x, _AggCall):
+                        return Col(agg_name(x, None))
+                    return x
+
+                out_items.append((alias, _map(e, repl)))
+                repr_to_name[repr(e)] = alias
+                continue
+            if _contains_agg(e):
+                # Unaliased computed aggregates auto-name positionally
+                # (scalar subqueries read the single output by position:
+                # TPC-DS q1's ``SELECT avg(x) * 1.2``).
+                alias = alias or f"_c{len(out_items)}"
                 new_e = _map(e, lambda x: Col(agg_name(x, None))
                              if isinstance(x, _AggCall) else x)
                 _reject_markers(new_e, "SELECT expressions",
                                 (_WindowCall,))
                 out_items.append((alias, new_e))
+                repr_to_name[repr(e)] = alias
                 continue
-            # Non-aggregate item: must be a group key (or its alias).
+            # Non-aggregate item: must be a group key (or its alias) —
+            # possibly RENAMED in the output (``sr_customer_sk AS
+            # ctr_customer_sk ... GROUP BY sr_customer_sk``, TPC-DS q1).
+            if isinstance(e, Col) and e.name in keys:
+                name = alias or e.name
+                out_items.append(
+                    (name, None if name == e.name else e))
+                repr_to_name[repr(e)] = name
+                continue
             name = alias or (e.name if isinstance(e, Col) else None)
             if name is None or name not in keys:
                 raise SqlError(
                     f"Select item {e!r} is neither aggregated nor a "
                     f"GROUP BY key")
             out_items.append((name, None))
+            repr_to_name[repr(e)] = name
         if not keys:
             ds = ds.agg(**agg_specs)
         else:
@@ -954,10 +1069,31 @@ def _lower(p: _Parser, ds, items, distinct, where, group_by, having,
                     if alias is None:
                         raise SqlError(
                             "Window select items need AS aliases")
+                    if isinstance(e.value, _AggCall):
+                        raise SqlError(
+                            "Window over an aggregate needs a GROUP BY")
                     windows_to_apply.append((alias, e))
                     out_items.append((alias, None))
                 elif isinstance(e, Col) and alias is None:
                     out_items.append((e.name, None))
+                elif _contains_window(e):
+                    if alias is None:
+                        raise SqlError(
+                            f"Computed window select items need AS "
+                            f"aliases: {e!r}")
+
+                    def repl(x):
+                        if isinstance(x, _WindowCall):
+                            if isinstance(x.value, _AggCall):
+                                raise SqlError("Window over an "
+                                               "aggregate needs a "
+                                               "GROUP BY")
+                            hidden_w = f"__win{len(windows_to_apply)}"
+                            windows_to_apply.append((hidden_w, x))
+                            return Col(hidden_w)
+                        return x
+
+                    out_items.append((alias, _map(e, repl)))
                 else:
                     _reject_markers(e, "SELECT expressions",
                                     (_WindowCall,))
@@ -969,6 +1105,44 @@ def _lower(p: _Parser, ds, items, distinct, where, group_by, having,
         ds = ds.with_window(alias, w.func, partition_by=w.partition_by,
                             order_by=w.order_by, value=w.value,
                             offset=w.offset, frame=w.frame)
+
+    # Resolve ORDER BY before the output projection: keys may be select
+    # outputs, expressions matching select items (TPC-DS's ``ORDER BY
+    # sum(x) DESC``), or columns available pre-projection but not
+    # selected (q12 orders by the group key i_item_id without selecting
+    # it) — those thread through as HIDDEN outputs and drop after the
+    # sort.
+    sort_keys: List[Tuple[str, bool]] = []
+    hidden_sort_cols: List[str] = []
+    if order_by:
+        out_names = {n for n, _e in out_items}
+        for k, asc in order_by:
+            if isinstance(k, str):
+                name = k
+            else:
+                name = repr_to_name.get(repr(k))
+                if name is None:
+                    raise SqlError(
+                        f"ORDER BY expression {k!r} must match a select "
+                        f"output; alias it in SELECT and order by the "
+                        f"alias")
+            if not star and out_items and name not in out_names:
+                try:
+                    available = name in ds.columns
+                except Exception:
+                    available = False
+                if not available:
+                    raise SqlError(
+                        f"ORDER BY key {name!r} is neither a select "
+                        f"output nor an available column")
+                if distinct:
+                    raise SqlError(
+                        f"ORDER BY {name!r} with DISTINCT must be a "
+                        f"select output")
+                out_items.append((name, None))
+                out_names.add(name)
+                hidden_sort_cols.append(name)
+            sort_keys.append((name, asc))
 
     if not star and out_items:
         names = [n for n, _e in out_items]
@@ -997,8 +1171,12 @@ def _lower(p: _Parser, ds, items, distinct, where, group_by, having,
             ds = Dataset(Compute(exprs, ds.plan), ds.session)
     if distinct:
         ds = ds.distinct()
-    if order_by:
-        ds = ds.sort(*[(c, asc) for c, asc in order_by])
+    if sort_keys:
+        ds = ds.sort(*sort_keys)
+        if hidden_sort_cols:
+            keep = [n for n, _e in out_items
+                    if n not in hidden_sort_cols]
+            ds = ds.select(*keep)
     if limit is not None:
         ds = ds.limit(limit)
     return ds
@@ -1015,6 +1193,94 @@ def _reject_markers(e: Expr, where: str, kinds=None) -> None:
                            f"{where} (window calls must be top-level "
                            f"select items)")
     _walk_exprs(e, check)
+
+
+def _fold_const_date(e: Expr):
+    """datetime.date value of a constant date expression (DATE literal
+    or cast of a string literal to date), else None."""
+    if isinstance(e, Lit) and isinstance(e.value, datetime.date):
+        return e.value
+    if isinstance(e, Cast) and str(e.type_name).lower() in ("date",
+                                                            "date32") \
+            and isinstance(e.child, Lit) and isinstance(e.child.value,
+                                                        str):
+        try:
+            return datetime.date.fromisoformat(e.child.value)
+        except ValueError:
+            return None
+    return None
+
+
+class _CommaJoin:
+    """Placeholder for comma-separated FROM sources; resolved against
+    the WHERE conjuncts by _assemble_comma_join."""
+
+    def __init__(self, items) -> None:
+        self.items = items
+
+
+def _split_conjuncts(e: Expr) -> List[Expr]:
+    if isinstance(e, And):
+        return _split_conjuncts(e.left) + _split_conjuncts(e.right)
+    return [e]
+
+
+def _assemble_comma_join(p: "_Parser", items, where):
+    """Build the inner-join tree for ``FROM a, b, c WHERE ...`` from the
+    WHERE clause's column-equality conjuncts (classic implicit-join SQL,
+    the TPC-DS corpus style): each step joins one not-yet-connected
+    source through an equi predicate; everything else stays a filter
+    above the joins.  Pure cross joins are rejected — the engine
+    executes equi-joins."""
+    if where is None:
+        p.fail("comma-separated FROM needs WHERE equi-join predicates "
+               "(cross joins are not supported)")
+    cols_of = []
+    for it in items:
+        try:
+            cols_of.append(set(it.columns))
+        except Exception:
+            p.fail("comma-joined sources need resolvable schemas")
+
+    def owner(name: str):
+        hits = [i for i, cs in enumerate(cols_of) if name in cs]
+        return hits[0] if len(hits) == 1 else None
+
+    conjuncts = _split_conjuncts(where)
+    used: set = set()
+    joined = {0}
+    ds = items[0]
+    while len(joined) < len(items):
+        progressed = False
+        for ci, c in enumerate(conjuncts):
+            if ci in used:
+                continue
+            if not (isinstance(c, BinOp) and c.op == "=="
+                    and isinstance(c.left, Col)
+                    and isinstance(c.right, Col)):
+                continue
+            oa, ob = owner(c.left.name), owner(c.right.name)
+            if oa is None or ob is None:
+                continue
+            if (oa in joined) == (ob in joined):
+                continue
+            new = ob if oa in joined else oa
+            ds = ds.join(items[new], c, how="inner")
+            joined.add(new)
+            used.add(ci)
+            progressed = True
+            break
+        if not progressed:
+            p.fail(
+                "comma-separated FROM requires WHERE equi-join "
+                "predicates connecting every table (cross joins are "
+                "not supported)")
+    remaining = None
+    for ci, c in enumerate(conjuncts):
+        if ci in used:
+            continue
+        remaining = c if remaining is None else And(remaining, c)
+    return ds, remaining
 
 
 def _align_positional(op_name: str, ds, nxt):
@@ -1084,7 +1350,11 @@ def _parse_query(p: "_Parser"):
     if has_setop:
         if p.take_kw("ORDER"):
             p.expect_kw("BY")
-            ds = ds.sort(*p.parse_order_keys())
+            keys = p.parse_order_keys()
+            if any(not isinstance(k, str) for k, _a in keys):
+                p.fail("ORDER BY after a set operation must use output "
+                       "column names")
+            ds = ds.sort(*keys)
         if p.take_kw("LIMIT"):
             ds = ds.limit(p.parse_limit_count())
     return ds

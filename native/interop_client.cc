@@ -1,0 +1,162 @@
+// Minimal C++ Arrow-IPC client for the query server
+// (hyperspace_tpu/interop/server.py) — the working non-Python consumer
+// the reference ships as a .NET sample
+// (/root/reference/examples/csharp/HyperspaceApp/Program.cs analog).
+//
+// Protocol: one JSON request line out, "OK\n" + Arrow IPC stream back
+// (or "ERR <message>\n").  The client half-closes its write side after
+// the request so it can read to EOF, then decodes the stream with the
+// Arrow C++ library and prints, for the harness to check:
+//
+//   rows <n>
+//   cols <name> <name> ...
+//   sum <column> <value>        (for each numeric column)
+//
+// Build (arrow headers/libs ship with pyarrow; see tests/test_interop.py):
+//   g++ -std=c++20 interop_client.cc -I<pyarrow>/include \
+//       -L<pyarrow> -l:libarrow.so.2500 -Wl,-rpath,<pyarrow> \
+//       -o interop_client
+//
+// Usage: interop_client <host> <port> '<json request>'
+
+#include <arrow/api.h>
+#include <arrow/io/memory.h>
+#include <arrow/ipc/api.h>
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstring>
+#include <iostream>
+#include <string>
+#include <vector>
+
+namespace {
+
+int Fail(const std::string& msg) {
+  std::cerr << "interop_client: " << msg << std::endl;
+  return 1;
+}
+
+int ConnectTo(const char* host, const char* port) {
+  addrinfo hints {};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  if (getaddrinfo(host, port, &hints, &res) != 0) return -1;
+  int fd = -1;
+  for (addrinfo* p = res; p != nullptr; p = p->ai_next) {
+    fd = socket(p->ai_family, p->ai_socktype, p->ai_protocol);
+    if (fd < 0) continue;
+    if (connect(fd, p->ai_addr, p->ai_addrlen) == 0) break;
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  return fd;
+}
+
+bool SendAll(int fd, const std::string& data) {
+  size_t off = 0;
+  while (off < data.size()) {
+    ssize_t n = send(fd, data.data() + off, data.size() - off, 0);
+    if (n <= 0) return false;
+    off += static_cast<size_t>(n);
+  }
+  return true;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc != 4) {
+    return Fail("usage: interop_client <host> <port> '<json request>'");
+  }
+  int fd = ConnectTo(argv[1], argv[2]);
+  if (fd < 0) return Fail("connect failed");
+
+  std::string request(argv[3]);
+  request.push_back('\n');
+  if (!SendAll(fd, request)) return Fail("send failed");
+  // Half-close: the server sees EOF after serving and closes, so the
+  // reply is simply everything until EOF.
+  shutdown(fd, SHUT_WR);
+
+  std::vector<uint8_t> reply;
+  uint8_t buf[1 << 16];
+  for (;;) {
+    ssize_t n = recv(fd, buf, sizeof(buf), 0);
+    if (n < 0) return Fail("recv failed");
+    if (n == 0) break;
+    reply.insert(reply.end(), buf, buf + n);
+  }
+  close(fd);
+
+  auto nl = std::find(reply.begin(), reply.end(), '\n');
+  if (nl == reply.end()) return Fail("no status line in reply");
+  std::string status(reply.begin(), nl);
+  if (status.rfind("ERR", 0) == 0) return Fail("server error: " + status);
+  if (status != "OK") return Fail("unexpected status: " + status);
+
+  size_t body_off = static_cast<size_t>(nl - reply.begin()) + 1;
+  auto buffer = std::make_shared<arrow::Buffer>(
+      reply.data() + body_off, static_cast<int64_t>(reply.size() - body_off));
+  auto reader_res = arrow::ipc::RecordBatchStreamReader::Open(
+      std::make_shared<arrow::io::BufferReader>(buffer));
+  if (!reader_res.ok()) {
+    return Fail("IPC open: " + reader_res.status().ToString());
+  }
+  auto table_res = (*reader_res)->ToTable();
+  if (!table_res.ok()) {
+    return Fail("IPC read: " + table_res.status().ToString());
+  }
+  std::shared_ptr<arrow::Table> table = *table_res;
+
+  std::cout << "rows " << table->num_rows() << "\n";
+  std::cout << "cols";
+  for (const auto& f : table->schema()->fields()) {
+    std::cout << " " << f->name();
+  }
+  std::cout << "\n";
+  for (int i = 0; i < table->num_columns(); ++i) {
+    const auto& field = table->schema()->field(i);
+    const auto& col = table->column(i);
+    double total = 0.0;
+    bool numeric = true;
+    for (const auto& chunk : col->chunks()) {
+      switch (chunk->type_id()) {
+        case arrow::Type::INT64: {
+          auto a = std::static_pointer_cast<arrow::Int64Array>(chunk);
+          for (int64_t j = 0; j < a->length(); ++j) {
+            if (a->IsValid(j)) total += static_cast<double>(a->Value(j));
+          }
+          break;
+        }
+        case arrow::Type::INT32: {
+          auto a = std::static_pointer_cast<arrow::Int32Array>(chunk);
+          for (int64_t j = 0; j < a->length(); ++j) {
+            if (a->IsValid(j)) total += static_cast<double>(a->Value(j));
+          }
+          break;
+        }
+        case arrow::Type::DOUBLE: {
+          auto a = std::static_pointer_cast<arrow::DoubleArray>(chunk);
+          for (int64_t j = 0; j < a->length(); ++j) {
+            if (a->IsValid(j)) total += a->Value(j);
+          }
+          break;
+        }
+        default:
+          numeric = false;
+      }
+      if (!numeric) break;
+    }
+    if (numeric) {
+      std::cout << "sum " << field->name() << " " << total << "\n";
+    }
+  }
+  return 0;
+}

@@ -290,3 +290,56 @@ def test_fused_topn_by_group_column_not_attempted(env):
     assert all(a.get("topn") in (None,) for a in aggs)
     host = _host(s, q, orders, lineitem)
     _assert_tables_close(dev, host)
+
+
+def test_count_of_division_expr_falls_back(env):
+    # count(a/b) can produce nulls (x/0) the fused kernel would miss:
+    # it must take the host path and match it exactly.
+    s, orders, lineitem = env
+    s.conf.device_cache_policy = "eager"
+    s.conf.device_resident_min_rows = 1
+
+    def q(s_, orders_, lineitem_):
+        return (s_.read.parquet(orders_)
+                .join(s_.read.parquet(lineitem_),
+                      col("o_orderkey") == col("l_orderkey"))
+                .group_by("o_shippriority")
+                .agg(n=(col("l_extendedprice") / col("l_discount"),
+                        "count"))
+                .sort("o_shippriority").collect())
+
+    dev = q(s, orders, lineitem)
+    aggs = s.last_execution_stats.get("aggregates", [])
+    assert not aggs or aggs[-1]["strategy"] != "device-join-agg"
+    host = _host(s, q, orders, lineitem)
+    _assert_tables_close(dev, host)
+
+
+def test_small_join_keeps_normal_path_under_eager(env, tmp_path):
+    # Footer pre-gate: tiny inputs can never clear the device threshold,
+    # so the sides must not be materialized for a doomed attempt (the
+    # normal path, bucketed join included, runs untouched).
+    import pyarrow.parquet as pq_
+
+    small = str(tmp_path / "small")
+    os.makedirs(small)
+    pq_.write_table(pa.table({
+        "o_orderkey": pa.array([1, 2, 3], type=pa.int64()),
+        "o_shippriority": pa.array([0, 1, 0], type=pa.int64()),
+    }), os.path.join(small, "p.parquet"))
+    s, _orders, lineitem = env
+    s.conf.device_cache_policy = "eager"
+    # Calibrated/static thresholds (no override): 3 rows can never win.
+    s.conf.device_resident_min_rows = None
+
+    def q():
+        return (s.read.parquet(small)
+                .join(s.read.parquet(lineitem),
+                      col("o_orderkey") == col("l_orderkey"))
+                .group_by("o_shippriority")
+                .agg(n=(col("l_quantity"), "count"))
+                .sort("o_shippriority").collect())
+
+    q()
+    aggs = s.last_execution_stats.get("aggregates", [])
+    assert not aggs or aggs[-1]["strategy"] != "device-join-agg"

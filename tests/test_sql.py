@@ -347,11 +347,22 @@ class TestReviewFixes:
                    "IN (-1, 3, 5)", tables=_tables(s, paths)).count()
         assert n == 2
 
-    def test_nested_window_call_rejected(self, env):
+    def test_nested_window_call_computes(self, env):
+        # Round 5: windows may nest inside select expressions (TPC-DS
+        # q12's ratio shape) — the hidden analytic column materializes
+        # first, the expression computes after.
         s, paths = env
-        with pytest.raises(SqlError, match="top-level"):
-            sql(s, "SELECT row_number() OVER (ORDER BY o_orderkey) + 0 "
-                   "AS r FROM orders", tables=_tables(s, paths))
+        out = sql(s, "SELECT row_number() OVER (ORDER BY o_orderkey) + 0 "
+                     "AS r FROM orders ORDER BY r LIMIT 3",
+                  tables=_tables(s, paths)).collect()
+        assert out.column("r").to_pylist() == [1, 2, 3]
+
+    def test_window_in_where_still_rejected(self, env):
+        s, paths = env
+        with pytest.raises(SqlError):
+            sql(s, "SELECT o_orderkey FROM orders "
+                   "WHERE row_number() OVER (ORDER BY o_orderkey) < 5",
+                tables=_tables(s, paths))
 
 
 class TestSecondReviewFixes:

@@ -262,25 +262,20 @@ def _sql_texts():
         "t21": """
             SELECT s_name, count(*) AS numwait
             FROM supplier JOIN nation ON s_nationkey = n_nationkey
-                 JOIN lineitem ON s_suppkey = l_suppkey
+                 JOIN lineitem l1 ON s_suppkey = l1.l_suppkey
                  JOIN orders ON l_orderkey = o_orderkey
             WHERE n_name = 'GERMANY'
               AND l_receiptdate > l_commitdate
               AND o_orderstatus = 'F'
-              AND l_orderkey IN (
-                  SELECT l_orderkey FROM
-                      (SELECT l_orderkey,
-                              count(DISTINCT l_suppkey) AS nsupp
-                       FROM lineitem GROUP BY l_orderkey) x
-                  WHERE nsupp > 1)
-              AND l_orderkey IN (
-                  SELECT l_orderkey FROM
-                      (SELECT l_orderkey,
-                              count(DISTINCT l_suppkey) AS nlate
-                       FROM lineitem
-                       WHERE l_receiptdate > l_commitdate
-                       GROUP BY l_orderkey) y
-                  WHERE nlate = 1)
+              AND EXISTS (
+                  SELECT 1 FROM lineitem l2
+                  WHERE l2.l_orderkey = l1.l_orderkey
+                    AND l2.l_suppkey <> l1.l_suppkey)
+              AND NOT EXISTS (
+                  SELECT 1 FROM lineitem l3
+                  WHERE l3.l_orderkey = l1.l_orderkey
+                    AND l3.l_suppkey <> l1.l_suppkey
+                    AND l3.l_receiptdate > l3.l_commitdate)
             GROUP BY s_name ORDER BY numwait DESC, s_name LIMIT 100""",
         "t22": """
             SELECT c_phonecode, count(*) AS numcust,

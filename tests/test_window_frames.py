@@ -537,3 +537,22 @@ def test_nan_does_not_poison_other_frames(session, tmp_path):
            .sort("o").collect())
     assert out.column("s").to_pylist() == [None, 1.0, 2.0]
     assert out.column("m").to_pylist() == [None, 1.0, 1.5]
+
+
+def test_order_by_distinguishes_same_func_windows(session, tmp_path):
+    # Two sum()-windows differing only in value must not collide in the
+    # ORDER BY expression resolver (structural _WindowCall repr).
+    d = _write(tmp_path, pa.table({
+        "g": pa.array([1, 1, 2, 2], type=pa.int64()),
+        "a": pa.array([1, 2, 100, 200], type=pa.int64()),
+        "b": pa.array([50, 60, 1, 2], type=pa.int64()),
+    }), name="wsel")
+    out = sql(session, """
+        SELECT g,
+               sum(sum(a)) OVER (PARTITION BY g) AS m,
+               sum(sum(b)) OVER (PARTITION BY g) AS n
+        FROM wsel GROUP BY g
+        ORDER BY sum(sum(a)) OVER (PARTITION BY g)
+    """, tables={"wsel": d}).collect()
+    # ordered by m (3, 300), not n (110, 3)
+    assert out.column("m").to_pylist() == [3, 300]
