@@ -1083,6 +1083,92 @@ def main() -> None:
             session.conf.device_join_min_rows = saved_join_thresh
             global_cache().clear()
 
+        # Window engine (round-5 verdict item 7): the vectorized numpy
+        # segment kernels timed at bench scale, plus the whole-partition
+        # device path over resident columns (organic routing flag, like
+        # resident_agg).
+        session.disable_hyperspace()
+        saved_policy3 = session.conf.device_cache_policy
+        saved_agg3 = session.conf.device_agg_min_rows
+        try:
+            session.conf.device_cache_policy = "off"
+            # Host baselines must be HOST even on fast attachments whose
+            # calibrated cold threshold would route windows on-device.
+            session.conf.device_agg_min_rows = 1 << 60
+
+            def w_running():
+                return (session.read.parquet(lineitem_dir)
+                        .select("l_status", "l_shipdate",
+                                "l_extendedprice")
+                        .with_window("rs", "sum",
+                                     partition_by=["l_status"],
+                                     order_by=["l_shipdate"],
+                                     value="l_extendedprice")
+                        .collect())
+
+            def w_rank():
+                return (session.read.parquet(lineitem_dir)
+                        .select("l_status", "l_extendedprice")
+                        .with_window("rk", "rank",
+                                     partition_by=["l_status"],
+                                     order_by=[("l_extendedprice",
+                                                False)])
+                        .collect())
+
+            def w_frame():
+                return (session.read.parquet(lineitem_dir)
+                        .select("l_status", "l_shipdate", "l_quantity")
+                        .with_window("m", "sum",
+                                     partition_by=["l_status"],
+                                     order_by=["l_shipdate"],
+                                     value="l_quantity",
+                                     frame=(-6, 0))
+                        .collect())
+
+            def w_whole():
+                return (session.read.parquet(lineitem_dir)
+                        .with_window("t", "sum",
+                                     partition_by=["l_status"],
+                                     value="l_extendedprice")
+                        .select("l_status", "t").collect())
+
+            wb = {"rows": N_LINEITEM}
+            for name, fn in (("running_sum", w_running),
+                             ("rank", w_rank),
+                             ("trailing7_frame", w_frame),
+                             ("whole_partition_sum", w_whole)):
+                stats = _time(fn, repeats=2)
+                wb[f"{name}_s"] = stat(stats)
+                wb[f"{name}_mrows_per_s"] = round(
+                    N_LINEITEM / max(stats["median"], 1e-9) / 1e6, 2)
+            # Warm-resident whole-partition window through the device
+            # segment kernel (eager populate, organic routing).  The
+            # host baseline above ran with the cache off; the first
+            # eager run pays the transfer once.
+            host_w = w_whole()
+            session.conf.device_agg_min_rows = None  # back to calibrated
+            session.conf.device_cache_policy = "eager"
+            global_cache().clear()
+            t0 = time.perf_counter()
+            cold_w = w_whole()  # populate pass
+            wb["whole_cold_populate_s"] = round(
+                time.perf_counter() - t0, 4)
+            warm_tbl = w_whole()
+            st = session.last_execution_stats or {}
+            ws = st.get("windows", [])
+            wb["whole_warm_fired_organically"] = bool(
+                ws and ws[-1]["strategy"] == "device-segment"
+                and ws[-1]["resident"])
+            wb["whole_warm_s"] = stat(_time(w_whole, repeats=2))
+            if not _tables_equal(warm_tbl, host_w) \
+                    or not _tables_equal(cold_w, host_w):
+                raise SystemExit("window warm answers diverged from host")
+            detail["window_bench"] = wb
+        finally:
+            session.conf.device_cache_policy = saved_policy3
+            session.conf.device_agg_min_rows = saved_agg3
+            global_cache().clear()
+
         # Transfer-excluded kernel throughput (round-3 verdict item 1):
         # what the chip does on RESIDENT data, vs the host mirrors.
         detail["kernel_bench"] = _kernel_microbench()

@@ -232,7 +232,14 @@ class Executor:
         if isinstance(plan, Join):
             return self._join(plan)
         if isinstance(plan, Window):
-            return _window(self.execute(plan.child), plan)
+            table = self.execute(plan.child)
+            dev = self._try_device_window(table, plan)
+            out = dev if dev is not None else _window(table, plan)
+            # Appending an analytic column preserves rows and the source
+            # arrays: identity carries so a SECOND window (or any
+            # downstream op) still routes by residency.
+            self._propagate_identity(out, table)
+            return out
         if isinstance(plan, Aggregate):
             return self._aggregate(plan)
         if isinstance(plan, Distinct):
@@ -493,6 +500,86 @@ class Executor:
                 # own promotion for sums.
                 data[out_name] = pa.array(res)
         return pa.table(data)
+
+    # -- device windows (whole-partition aggregates over resident data) -----
+    def _try_device_window(self, table: pa.Table,
+                           plan: Window) -> Optional[pa.Table]:
+        """Whole-partition window aggregates (``sum(x) OVER (PARTITION
+        BY k)``) over HBM-resident columns: the reduction runs on the
+        segment kernel (ops/aggregate.py — the round-4 verdict's ask),
+        only per-GROUP results return, and the broadcast back to rows is
+        one host searchsorted.  Scope: single int/bool partition key,
+        null-free numeric value, no ORDER BY/frame (running frames are
+        the vectorized host engine's job); routing by the resident
+        'agg' threshold, like grouped aggregation."""
+        conf = self.session.conf
+        if (plan.frame is not None or plan.order_by
+                or len(plan.partition_by) != 1
+                or plan.func not in ("sum", "min", "max", "mean",
+                                     "count")
+                or table.num_rows == 0):
+            return None
+        key = plan.partition_by[0]
+        kt = table.schema.field(key).type
+        if not (pa.types.is_integer(kt) or pa.types.is_boolean(kt)) \
+                or pa.types.is_uint64(kt) \
+                or table.column(key).null_count > 0:
+            return None
+        pairs = [(key, "order")]
+        src_type = None
+        if plan.func == "count":
+            # count over a null-free value equals the group row count:
+            # nothing ships beyond the key, and the value column must
+            # not enter `pairs` (it is never cached, so it would pin
+            # _all_resident to False forever).
+            if plan.value is not None \
+                    and table.column(plan.value).null_count > 0:
+                return None
+        else:
+            if plan.value is None:
+                return None
+            src_type = table.schema.field(plan.value).type
+            if not (pa.types.is_integer(src_type)
+                    or pa.types.is_floating(src_type)) \
+                    or pa.types.is_uint64(src_type) \
+                    or table.column(plan.value).null_count > 0:
+                return None
+            pairs.append((plan.value, "num"))
+        identity = self._scan_identity(table)
+        if table.num_rows < self._cache_aware_min_rows(identity, pairs,
+                                                       "agg"):
+            return None
+        from hyperspace_tpu.ops.aggregate import grouped_aggregate
+
+        resident = self._all_resident(identity, pairs)
+        key_words = [self._device_column(table, key, identity, "order")]
+        value_cols = [] if plan.func == "count" else [
+            self._device_column(table, plan.value, identity, "num")]
+        first_rows, counts, results = grouped_aggregate(
+            key_words, value_cols, [plan.func if plan.func != "count"
+                                    else "count_all"],
+            pad_to=conf.device_batch_rows)
+        group_keys = table.column(key).take(pa.array(first_rows))
+        gk = np.asarray(columnar.to_device_numeric(group_keys))
+        rows = np.asarray(
+            columnar.to_device_numeric(table.column(key)))
+        idx = np.searchsorted(gk, rows)  # groups ascend by key
+        res = results[0]
+        if plan.func == "count":
+            out = pa.array(counts.astype(np.int64)).take(pa.array(idx))
+        elif plan.func in ("min", "max"):
+            out = pc.cast(pa.array(res), src_type).take(pa.array(idx))
+        elif plan.func == "mean":
+            out = pa.array(res.astype(np.float64)).take(pa.array(idx))
+        else:  # sum: int64 / float64 by the device result dtype
+            out = pa.array(res).take(pa.array(idx))
+        self.stats.setdefault("windows", []).append({
+            "strategy": "device-segment", "rows": table.num_rows,
+            "groups": int(len(counts)), "resident": resident})
+        if plan.name in table.column_names:
+            return table.set_column(
+                table.column_names.index(plan.name), plan.name, out)
+        return table.append_column(plan.name, out)
 
     # -- fused join+aggregate (the whole Q3/Q10 hot path on device) ---------
     _JOIN_AGG_OPS = ("sum", "min", "max", "mean", "count", "count_all")

@@ -438,3 +438,75 @@ def test_null_keys_resident_join_matches_host(join_env, tmp_path):
     s.conf.device_join_min_rows = 1 << 60
     host = q()
     assert host.num_rows == first.num_rows == second.num_rows
+
+
+def test_warm_repeat_window_aggregate_resident(env):
+    """Whole-partition window aggregates route through the segment
+    kernel over resident columns (round-5: windows' device story)."""
+    s, data = env
+    s.conf.device_cache_policy = "eager"
+    s.conf.device_resident_min_rows = 1
+
+    def q():
+        return (s.read.parquet(data)
+                .with_window("total", "sum", partition_by=["g"],
+                             value="v")
+                .with_window("n", "count", partition_by=["g"])
+                .sort("k").collect())
+
+    first = q()
+    st1 = s.last_execution_stats
+    # BOTH windows route device-side (identity propagates through the
+    # first window's output to the chained count window).
+    assert len(st1["windows"]) == 2
+    assert all(w["strategy"] == "device-segment"
+               for w in st1["windows"])
+    assert st1["windows"][0]["resident"] is False
+    second = q()
+    st2 = s.last_execution_stats
+    assert len(st2["windows"]) == 2
+    assert all(w["resident"] for w in st2["windows"])
+    assert first.column("total").equals(second.column("total"))
+    # Parity with the pure host window engine.
+    s.conf.device_cache_policy = "off"
+    s.conf.device_agg_min_rows = 1 << 60
+    host = q()
+    assert "windows" not in (s.last_execution_stats or {})
+    np.testing.assert_allclose(host.column("total").to_numpy(),
+                               second.column("total").to_numpy())
+    assert host.column("n").equals(second.column("n"))
+
+
+def test_device_window_ineligible_shapes_stay_host(env):
+    s, data = env
+    s.conf.device_cache_policy = "eager"
+    s.conf.device_resident_min_rows = 1
+    # ORDER BY (running frame) -> host engine, answers still right.
+    out = (s.read.parquet(data)
+           .with_window("rs", "sum", partition_by=["g"],
+                        order_by=["k"], value="v")
+           .collect())
+    st = s.last_execution_stats
+    assert "windows" not in (st or {})
+    assert out.num_rows == 20_000
+
+
+def test_device_count_star_window_matches_host(env):
+    s, data = env
+    s.conf.device_cache_policy = "eager"
+    s.conf.device_resident_min_rows = 1
+
+    def q():
+        return (s.read.parquet(data)
+                .with_window("n", "count", partition_by=["g"])
+                .sort("k").collect())
+
+    dev = q()
+    st = s.last_execution_stats
+    assert st["windows"][-1]["strategy"] == "device-segment"
+    s.conf.device_cache_policy = "off"
+    s.conf.device_agg_min_rows = 1 << 60
+    host = q()
+    assert "windows" not in (s.last_execution_stats or {})
+    assert host.column("n").equals(dev.column("n"))
+    assert dev.schema.field("n").type == pa.int64()
