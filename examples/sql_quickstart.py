@@ -73,6 +73,28 @@ def main() -> None:
     """, tables=tables)
     print(top.collect().to_pandas())
 
+    # CTEs, window frames, and set operations (round-5 surface): a
+    # 7-row trailing revenue per order day, and the orders that appear
+    # in lineitem but fall under the price cut.
+    cume = sql(session, """
+        WITH daily AS (
+            SELECT o_orderdate, sum(o_totalprice) AS day_total
+            FROM orders GROUP BY o_orderdate)
+        SELECT o_orderdate, day_total,
+               sum(day_total) OVER (ORDER BY o_orderdate
+                   ROWS BETWEEN 6 PRECEDING AND CURRENT ROW) AS trailing7
+        FROM daily ORDER BY o_orderdate LIMIT 10;
+    """, tables=tables)
+    print(cume.collect().to_pandas())
+
+    cheap_active = sql(session, """
+        SELECT o_orderkey FROM orders WHERE o_totalprice < 100
+        INTERSECT
+        SELECT l_orderkey FROM lineitem
+        ORDER BY o_orderkey LIMIT 5;
+    """, tables=tables)
+    print(cheap_active.collect().to_pandas())
+
 
 if __name__ == "__main__":
     main()

@@ -102,11 +102,14 @@ _WINDOW_FUNCS = ("row_number", "rank", "dense_rank", "ntile", "sum",
 _EXTRACT_FUNCS = {"year": "year", "month": "month", "day": "day",
                   "dayofmonth": "day", "quarter": "quarter"}
 
+_NAME_KINDS = ("ident", "qident")
+
 _TOKEN_RE = re.compile(r"""
     \s+
   | --[^\n]*
   | (?P<num>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+|\d+(?:[eE][+-]?\d+)?)
   | (?P<str>'(?:[^']|'')*')
+  | (?P<bq>`[^`]*`)
   | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
   | (?P<op><>|!=|<=|>=|=|<|>|\(|\)|,|\.|\*|\+|-|/|;)
 """, re.VERBOSE)
@@ -126,6 +129,12 @@ def _tokenize(text: str) -> List[Tuple[str, str, int]]:
         elif m.lastgroup == "str":
             out.append(("str", m.group("str")[1:-1].replace("''", "'"),
                         m.start()))
+        elif m.lastgroup == "bq":
+            # Backtick-quoted identifier (TPC-DS q32/q92 alias spelling):
+            # its OWN token kind, so quoting a reserved word (`from`,
+            # `order`) never trips the keyword matchers — only the
+            # name-position readers accept it (_NAME_KINDS).
+            out.append(("qident", m.group("bq")[1:-1], m.start()))
         elif m.lastgroup == "ident":
             out.append(("ident", m.group("ident"), m.start()))
         elif m.lastgroup == "op":
@@ -136,13 +145,18 @@ def _tokenize(text: str) -> List[Tuple[str, str, int]]:
 
 class _Parser:
     def __init__(self, text: str, session, tables: Dict[str, Any],
-                 outer_aliases: Tuple[str, ...] = ()) -> None:
+                 outer_aliases: Tuple[str, ...] = (),
+                 outer_columns: frozenset = frozenset()) -> None:
         self.text = text
         self.tokens = _tokenize(text)
         self.i = 0
         self.session = session
         self.tables = tables
         self.outer_aliases = outer_aliases
+        # Column names visible in the ENCLOSING query's scope: a bare
+        # name unknown here but known there is an implicit correlation
+        # (TPC-DS q32/q92 correlate through bare names).
+        self.outer_columns = outer_columns
         self.aliases: List[str] = []  # this query's own scope
         # FROM-order source registry: ({names}, [columns] or None) per
         # source, for qualified-reference validation.
@@ -323,10 +337,10 @@ class _Parser:
             alias = None
             if self.take_kw("AS"):
                 t = self.next()
-                if t[0] != "ident":
+                if t[0] not in _NAME_KINDS:
                     self.fail("expected an alias after AS")
                 alias = t[1]
-            elif self.peek()[0] == "ident" and not self.at_kw(
+            elif self.peek()[0] in _NAME_KINDS and not self.at_kw(
                     "FROM", "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT"):
                 alias = self.next()[1]
             items.append((alias, e))
@@ -411,21 +425,21 @@ class _Parser:
 
     def parse_source(self):
         if self.take_op("("):
-            sub = _Parser(self.text, self.session, self.tables,
-                          self.outer_aliases)
-            sub.tokens, sub.i = self.tokens, self.i
+            sub = self.fork()
+            sub.outer_aliases = self.outer_aliases
             ds = sub.parse_select()
             self.i = sub.i
             self.expect_op(")")
             names = set()
-            if self.peek()[0] == "ident" and not self._at_clause_kw():
+            if self.peek()[0] in _NAME_KINDS \
+                    and not self._at_clause_kw():
                 alias = self.next()[1]
                 self.aliases.append(alias)
                 names.add(alias)
             self._register_source(names, ds)
             return ds
         t = self.next()
-        if t[0] != "ident":
+        if t[0] not in _NAME_KINDS:
             self.fail("expected a table name")
         name = t[1]
         src = self.tables.get(name)
@@ -436,7 +450,8 @@ class _Parser:
         ds = self.session.read.parquet(src) if isinstance(src, str) else src
         names = {name}
         self.aliases.append(name)
-        if self.peek()[0] == "ident" and not self._at_clause_kw():
+        if self.peek()[0] in _NAME_KINDS \
+                and not self._at_clause_kw():
             alias = self.next()[1]
             self.aliases.append(alias)
             names.add(alias)
@@ -453,6 +468,7 @@ class _Parser:
         child.session = self.session
         child.tables = self.tables
         child.outer_aliases = ()
+        child.outer_columns = frozenset()
         child.aliases = []
         child.sources = []
         child._in_join_on = False
@@ -597,9 +613,14 @@ class _Parser:
         return self.parse_primary()
 
     def _parse_subquery(self):
-        sub = _Parser(self.text, self.session, self.tables,
-                      tuple(self.aliases) + self.outer_aliases)
-        sub.tokens, sub.i = self.tokens, self.i
+        own_cols = set()
+        for _names, cols in self.sources:
+            own_cols |= set(cols or ())
+        # fork() shares the token stream — no re-lex of the whole text
+        # per subquery — then the correlation scope attaches.
+        sub = self.fork()
+        sub.outer_aliases = tuple(self.aliases) + self.outer_aliases
+        sub.outer_columns = frozenset(own_cols) | self.outer_columns
         ds = sub.parse_select()
         self.i = sub.i
         self.expect_op(")")
@@ -621,6 +642,9 @@ class _Parser:
         if t[0] == "str":
             self.next()
             return Lit(t[1])
+        if t[0] == "qident":
+            self.next()
+            return Col(t[1])
         if t[0] != "ident":
             self.fail("expected an expression")
         word = t[1]
@@ -673,7 +697,7 @@ class _Parser:
         self.next()
         if self.take_op("."):
             c = self.next()
-            if c[0] != "ident":
+            if c[0] not in _NAME_KINDS:
                 self.fail("expected a column after '.'")
             if word in self.aliases:
                 return self._qualified_col(word, c[1])
@@ -682,6 +706,14 @@ class _Parser:
             raise SqlError(
                 f"Unknown table alias {word!r} (in scope: "
                 f"{self.aliases + list(self.outer_aliases)})")
+        if self.outer_columns and word in self.outer_columns \
+                and not any(cols is None or word in cols
+                            for _n, cols in self.sources):
+            # Unknown in every LOCAL source (all of which have resolved
+            # schemas) but known in the enclosing scope: SQL's implicit
+            # correlated reference.  Innermost scope always wins when a
+            # local source could plausibly own the name.
+            return OuterRef(word)
         return Col(word)
 
     def _qualified_col(self, alias: str, column: str) -> Expr:
@@ -1375,7 +1407,7 @@ def sql(session, text: str, tables: Dict[str, Any]):
             p.fail("WITH RECURSIVE is not supported")
         while True:
             t = p.next()
-            if t[0] != "ident":
+            if t[0] not in _NAME_KINDS:
                 p.fail("expected a CTE name after WITH")
             cte_name = t[1]
             p.expect_kw("AS")

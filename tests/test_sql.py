@@ -641,3 +641,52 @@ class TestComposition:
         """, tables=_tables(s, paths)).count()
         assert n == int((odf["o_totalprice"]
                          > odf["o_totalprice"].mean()).sum())
+
+
+class TestRound5ParserFeatures:
+    def test_backtick_identifiers(self, env):
+        s, paths = env
+        out = sql(s, "SELECT count(*) AS `Row Count ` FROM orders",
+                  tables=_tables(s, paths)).collect()
+        assert out.column_names == ["Row Count "]
+
+    def test_bare_name_outer_correlation(self, env):
+        # TPC-DS q32/q92 correlate through BARE names: a column unknown
+        # in every local source but defined in the enclosing scope.
+        s, paths = env
+        odf = pd.read_parquet(paths["orders"])
+        out = sql(s, """
+            SELECT count(*) AS n FROM orders
+            WHERE o_totalprice > (
+                SELECT 1.5 * avg(l_quantity) FROM lineitem
+                WHERE l_orderkey = o_orderkey)
+        """, tables=_tables(s, paths)).collect()
+        ldf = pd.read_parquet(paths["lineitem"])
+        avg_q = ldf.groupby("l_orderkey").l_quantity.mean()
+        joined = odf[odf.o_orderkey.isin(avg_q.index)]
+        want = int((joined.o_totalprice
+                    > 1.5 * joined.o_orderkey.map(avg_q)).sum())
+        assert out.column("n").to_pylist() == [want]
+
+    def test_bare_name_local_still_wins(self, env):
+        # A name both scopes define binds to the INNERMOST (SQL).
+        s, paths = env
+        out = sql(s, """
+            SELECT count(*) AS n FROM orders o1
+            WHERE o_totalprice > (
+                SELECT avg(o_totalprice) FROM orders)
+        """, tables=_tables(s, paths)).collect()
+        odf = pd.read_parquet(paths["orders"])
+        want = int((odf.o_totalprice > odf.o_totalprice.mean()).sum())
+        assert out.column("n").to_pylist() == [want]
+
+    def test_backtick_quoted_keyword_alias(self, env):
+        # Quoting a reserved word is the primary use of backticks: the
+        # quoted token must never trip the keyword matchers.
+        s, paths = env
+        out = sql(s, "SELECT o_orderkey AS `from` FROM orders LIMIT 2",
+                  tables=_tables(s, paths)).collect()
+        assert out.column_names == ["from"]
+        out2 = sql(s, "SELECT count(*) AS `order` FROM orders",
+                   tables=_tables(s, paths)).collect()
+        assert out2.column_names == ["order"]

@@ -18,8 +18,10 @@ sides of its full-outer self-join through qualified duplicate names
 (web.item_sk / store.item_sk); this engine requires renaming one side
 through a derived table (the parser's own suggestion) because joined
 outputs expose first-source copies under ambiguous names.  Everything
-else — including q1's correlated CTE subquery and the
-``sum(sum(x)) OVER (...)`` windows of q12/q20/q98 — is the v1.4 text.
+else — q1's correlated CTE subquery, q6/q32/q92's correlated scalar
+averages (bare-name correlation, post-aggregate arithmetic, backtick
+aliases), and the ``sum(sum(x)) OVER (...)`` windows of q12/q20/q98 —
+is the v1.4 text.
 """
 
 from __future__ import annotations
@@ -125,7 +127,7 @@ def _overrides(name: str, n: int, rng) -> dict:
     if name == "item":
         cats = ["Sports", "Books", "Home", "Music", "Men"]
         manu_pool = [128, 677, 940, 694, 808, 129, 270, 821, 423,
-                     1, 2, 3, 4, 5]
+                     977, 350, 1, 2, 3]
         return {
             "i_item_id": pa.array([f"ITEM{i % 60:08d}" for i in range(n)]),
             "i_category": pa.array([cats[i % len(cats)] for i in range(n)]),
@@ -324,6 +326,29 @@ GROUP BY dt.d_year, item.i_brand, item.i_brand_id
 ORDER BY dt.d_year, sum_agg DESC, brand_id
 LIMIT 100
 """,
+    "q6": """
+SELECT
+  a.ca_state state,
+  count(*) cnt
+FROM
+  customer_address a, customer c, store_sales s, date_dim d, item i
+WHERE a.ca_address_sk = c.c_current_addr_sk
+  AND c.c_customer_sk = s.ss_customer_sk
+  AND s.ss_sold_date_sk = d.d_date_sk
+  AND s.ss_item_sk = i.i_item_sk
+  AND d.d_month_seq =
+  (SELECT DISTINCT (d_month_seq)
+  FROM date_dim
+  WHERE d_year = 2000 AND d_moy = 1)
+  AND i.i_current_price > 1.2 *
+  (SELECT avg(j.i_current_price)
+  FROM item j
+  WHERE j.i_category = i.i_category)
+GROUP BY a.ca_state
+HAVING count(*) >= 10
+ORDER BY cnt
+LIMIT 100
+""",
     "q7": """
 SELECT
   i_item_id,
@@ -446,6 +471,23 @@ WHERE cs_sold_date_sk = d_date_sk AND
   d_year = 2000
 GROUP BY i_item_id
 ORDER BY i_item_id
+LIMIT 100
+""",
+    "q32": """
+SELECT 1 AS `excess discount amount `
+FROM
+  catalog_sales, item, date_dim
+WHERE
+  i_manufact_id = 977
+    AND i_item_sk = cs_item_sk
+    AND d_date BETWEEN '2000-01-27' AND (cast('2000-01-27' AS DATE) + interval 90 days)
+    AND d_date_sk = cs_sold_date_sk
+    AND cs_ext_discount_amt > (
+    SELECT 1.3 * avg(cs_ext_discount_amt)
+    FROM catalog_sales, date_dim
+    WHERE cs_item_sk = i_item_sk
+      AND d_date BETWEEN '2000-01-27' AND (cast('2000-01-27' AS DATE) + interval 90 days)
+      AND d_date_sk = cs_sold_date_sk)
 LIMIT 100
 """,
     "q37": """
@@ -624,6 +666,24 @@ WHERE
     AND ca_gmt_offset = -7
 GROUP BY cc_call_center_id, cc_name, cc_manager, cd_marital_status, cd_education_status
 ORDER BY sum(cr_net_loss) DESC
+""",
+    "q92": """
+SELECT sum(ws_ext_discount_amt) AS `Excess Discount Amount `
+FROM web_sales, item, date_dim
+WHERE i_manufact_id = 350
+  AND i_item_sk = ws_item_sk
+  AND d_date BETWEEN '2000-01-27' AND (cast('2000-01-27' AS DATE) + INTERVAL 90 days)
+  AND d_date_sk = ws_sold_date_sk
+  AND ws_ext_discount_amt >
+  (
+    SELECT 1.3 * avg(ws_ext_discount_amt)
+    FROM web_sales, date_dim
+    WHERE ws_item_sk = i_item_sk
+      AND d_date BETWEEN '2000-01-27' AND (cast('2000-01-27' AS DATE) + INTERVAL 90 days)
+      AND d_date_sk = ws_sold_date_sk
+  )
+ORDER BY sum(ws_ext_discount_amt)
+LIMIT 100
 """,
     "q96": """
 SELECT count(*)
