@@ -13,7 +13,11 @@ Reference contract: actions/Action.scala:35-105 —
 
 An action that dies mid-flight leaves the transient entry as the latest log
 record; subsequent actions refuse to run and the user recovers with
-``cancel()`` (actions/CancelAction.scala:25-58).
+``cancel()`` (actions/CancelAction.scala:25-58) — or, with
+``hyperspace.index.autoRecovery.enabled``, the next lifecycle call through
+the collection manager performs that rollback implicitly
+(index/manager.py).  Crash points are exercised under injected faults
+(io/faults.py, tests/test_concurrency.py's TestCrashRecovery).
 """
 
 from __future__ import annotations
@@ -23,6 +27,7 @@ from typing import Optional, Type
 from hyperspace_tpu.exceptions import ConcurrentWriteError, HyperspaceError, NoChangesError
 from hyperspace_tpu.index.log_entry import IndexLogEntry, States
 from hyperspace_tpu.index.log_manager import IndexLogManager
+from hyperspace_tpu.io import faults
 from hyperspace_tpu.telemetry.events import HyperspaceEvent, _IndexActionEvent, get_event_logger
 
 
@@ -103,6 +108,12 @@ class Action:
         try:
             self.begin()
             self.op()
+            # Crash checkpoint (io/faults.py): the work is done but the
+            # final entry is not committed — the state a killed process
+            # leaves behind, which cancel()/auto-recovery must roll back.
+            # InjectedCrash is a BaseException, so the handlers below
+            # (like a real kill -9) never see it.
+            faults.check("action.commit")
             self.end()
             emit(self.final_state)
         except ConcurrentWriteError:

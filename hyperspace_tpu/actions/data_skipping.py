@@ -269,7 +269,9 @@ def read_sketch(entry: IndexLogEntry) -> pa.Table:
     files = [f.name for f in entry.content.file_infos()]
     if not files:
         return pa.table({})
-    return pa.concat_tables([pq.read_table(p) for p in files],
+    from hyperspace_tpu.io.parquet import read_parquet_file
+
+    return pa.concat_tables([read_parquet_file(p) for p in files],
                             promote_options="default")
 
 

@@ -39,6 +39,7 @@ from hyperspace_tpu.io import columnar
 from hyperspace_tpu.io.parquet import (
     bucket_file_name,
     bucket_id_of_file,
+    read_parquet_file,
     sort_permutation_host,
     write_bucket_run,
 )
@@ -126,7 +127,8 @@ class OptimizeAction(Action):
                                                       "lexicographic")
         for bucket, files in sorted(mergeable.items()):
             merged = pa.concat_tables(
-                [pq.read_table(f.name) for f in files], promote_options="default")
+                [read_parquet_file(f.name) for f in files],
+                promote_options="default")
             # Layout-aware: a Z-ordered index must stay Z-ordered through
             # compaction — Morton sort AND Z-cell-aligned file cuts — or its
             # per-file sketches go wide on every non-primary dimension.

@@ -48,6 +48,15 @@ GLOBBING_PATTERN = "hyperspace.source.globbingPattern"
 DISPLAY_MODE = "hyperspace.explain.displayMode"
 HIGHLIGHT_BEGIN_TAG = "hyperspace.explain.displayMode.highlight.beginTag"
 HIGHLIGHT_END_TAG = "hyperspace.explain.displayMode.highlight.endTag"
+AUTO_RECOVERY_ENABLED = "hyperspace.index.autoRecovery.enabled"
+IO_RETRY_MAX_ATTEMPTS = "hyperspace.system.io.retry.maxAttempts"
+IO_RETRY_INITIAL_BACKOFF_MS = "hyperspace.system.io.retry.initialBackoffMs"
+IO_RETRY_MAX_BACKOFF_MS = "hyperspace.system.io.retry.maxBackoffMs"
+FAULT_INJECTION_ENABLED = "hyperspace.system.faultInjection.enabled"
+FAULT_INJECTION_SITE = "hyperspace.system.faultInjection.site"
+FAULT_INJECTION_KIND = "hyperspace.system.faultInjection.kind"
+FAULT_INJECTION_AT = "hyperspace.system.faultInjection.at"
+FAULT_INJECTION_COUNT = "hyperspace.system.faultInjection.count"
 
 _DEFAULT_NUM_BUCKETS = 200  # IndexConstants.scala:31-32 (spark.sql.shuffle.partitions default)
 
@@ -179,6 +188,29 @@ class HyperspaceConf:
     display_mode: str = "plaintext"
     highlight_begin_tag: str = ""
     highlight_end_tag: str = ""
+    # When the latest log entry of an index is a TRANSIENT state (a prior
+    # action died mid-flight), lifecycle calls through the collection
+    # manager first roll it back to the last stable state — an implicit
+    # cancel() (actions/CancelAction.scala:25-58).  Off by default: the
+    # reference's contract is explicit user recovery, and an in-flight
+    # concurrent action looks identical to a crashed one (the rollback is
+    # still SAFE either way — the optimistic log write arbitrates — but
+    # it would make the racer that started LATER win).
+    auto_recovery_enabled: bool = False
+    # Transient-IO retry for the op-log's file primitives (EIO/ENOSPC/
+    # EAGAIN/EINTR): total attempts and exponential-backoff bounds, with
+    # uniform jitter so racing writers don't re-collide in lockstep.
+    io_retry_max_attempts: int = 3
+    io_retry_initial_backoff_ms: float = 10.0
+    io_retry_max_backoff_ms: float = 1000.0
+    # Deterministic fault injection (io/faults.py): fire ``kind`` at the
+    # ``at``-th call of ``site``, ``count`` times.  Test-only machinery;
+    # disabled costs one None check per file-level IO op.
+    fault_injection_enabled: bool = False
+    fault_injection_site: str = ""
+    fault_injection_kind: str = ""
+    fault_injection_at: int = 1
+    fault_injection_count: int = 1
     # Keys explicitly applied through set(); drives canonical-vs-legacy key
     # precedence.
     _set_keys: set = dataclasses.field(default_factory=set, repr=False,
@@ -218,6 +250,15 @@ class HyperspaceConf:
         DISPLAY_MODE: "display_mode",
         HIGHLIGHT_BEGIN_TAG: "highlight_begin_tag",
         HIGHLIGHT_END_TAG: "highlight_end_tag",
+        AUTO_RECOVERY_ENABLED: "auto_recovery_enabled",
+        IO_RETRY_MAX_ATTEMPTS: "io_retry_max_attempts",
+        IO_RETRY_INITIAL_BACKOFF_MS: "io_retry_initial_backoff_ms",
+        IO_RETRY_MAX_BACKOFF_MS: "io_retry_max_backoff_ms",
+        FAULT_INJECTION_ENABLED: "fault_injection_enabled",
+        FAULT_INJECTION_SITE: "fault_injection_site",
+        FAULT_INJECTION_KIND: "fault_injection_kind",
+        FAULT_INJECTION_AT: "fault_injection_at",
+        FAULT_INJECTION_COUNT: "fault_injection_count",
     }
 
     # Auto-calibrated routing thresholds: None = derive from measured

@@ -25,6 +25,7 @@ import pyarrow as pa
 import pyarrow.compute as pc
 
 from hyperspace_tpu.io import columnar
+from hyperspace_tpu.utils.compat import enable_x64 as _enable_x64
 from hyperspace_tpu.io.files import list_data_files
 from hyperspace_tpu.io.parquet import bucket_id_of_file, read_table
 from hyperspace_tpu.plan.expr import (
@@ -166,7 +167,7 @@ class Executor:
         import jax
 
         host = convert(table.column(column))
-        with jax.enable_x64():  # int64 columns must keep full width
+        with _enable_x64():  # int64 columns must keep full width
             dev = jax.device_put(np.asarray(host))
         cache.put(key, dev, self.session.conf.device_cache_bytes)
         counters["misses"] += 1
@@ -827,8 +828,6 @@ class Executor:
         # min/max need a plain column (the result restores its type).
         from hyperspace_tpu.ops.filter import build_value_fn
 
-        from hyperspace_tpu.ops.filter import build_value_fn as _bvf
-
         agg_ref_names: List[str] = []
         for func, agg_in, _out in plan.aggs:
             if func == "count_all":
@@ -842,7 +841,7 @@ class Executor:
                 # division (x/0 -> null).  Validate through the same
                 # compiler; ineligible shapes take the host path.
                 try:
-                    _bvf(agg_in, sorted(agg_in.referenced_columns()))
+                    build_value_fn(agg_in, sorted(agg_in.referenced_columns()))
                 except ValueError:
                     return fallback()
             refs = [agg_in.name] if isinstance(agg_in, Col) else (
@@ -1162,7 +1161,7 @@ class Executor:
             return eval_predicate_on_mesh(fn, device_cols, literals)
         device_cols = [self._device_column(table, c, identity, "num")
                        for c in order]
-        with jax.enable_x64():
+        with _enable_x64():
             mask = fn(device_cols, literals)
         return np.asarray(mask)
 

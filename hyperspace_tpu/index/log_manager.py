@@ -11,6 +11,18 @@ Reference contract: index/IndexLogManager.scala:33-166 —
 On a local POSIX filesystem, ``open(path, 'x')`` gives the atomic
 create-if-absent we need; object-store backends can subclass and use
 conditional puts.
+
+Failure envelope (exercised by tests/test_log_manager.py's fault-injection
+cases, via io/faults.py):
+  - transient IO errors (EIO/ENOSPC/...) retry with bounded exponential
+    backoff + jitter (utils/retry.py; tuned by ``hyperspace.system.io.retry.*``)
+  - a torn/corrupt entry — a writer died mid-write — is DETECTED AND
+    SKIPPED by every reader (reads fall back to the newest parseable
+    entry), never repaired in place: the file keeps its id so the
+    append-only numbering stays collision-free
+  - a crash around the latestStable rename leaves either the old pointer,
+    no pointer, or the new pointer — all three resolve correctly (the
+    pointer is a cache; the numbered entries are the truth).
 """
 
 from __future__ import annotations
@@ -21,6 +33,8 @@ from typing import List, Optional
 
 from hyperspace_tpu.exceptions import ConcurrentWriteError
 from hyperspace_tpu.index.log_entry import IndexLogEntry, States
+from hyperspace_tpu.io import faults
+from hyperspace_tpu.utils.retry import RetryPolicy
 
 HYPERSPACE_LOG_DIR = "_hyperspace_log"  # IndexConstants.scala:66
 LATEST_STABLE = "latestStable"
@@ -29,32 +43,54 @@ LATEST_STABLE = "latestStable"
 class IndexLogManager:
     """Manages the operation log of one index (IndexLogManager.scala:33-55)."""
 
+    # Transient-IO retry budget; the collection manager overrides the
+    # instance attribute from session conf (subclass __init__ signatures —
+    # the logManagerClass seam — stay (index_path) only).
+    retry: RetryPolicy = RetryPolicy()
+
     def __init__(self, index_path: str) -> None:
         self.index_path = index_path
         self.log_dir = os.path.join(index_path, HYPERSPACE_LOG_DIR)
 
     # -- reads --------------------------------------------------------------
     def get_log(self, log_id: int) -> Optional[IndexLogEntry]:
+        """Entry ``log_id``, or None when missing OR torn/corrupt (a
+        writer that died mid-write leaves a partial JSON file; readers
+        skip it — the id itself stays burned for numbering)."""
         path = os.path.join(self.log_dir, str(log_id))
         if not os.path.isfile(path):
             return None
-        with open(path, "r", encoding="utf-8") as f:
-            return IndexLogEntry.from_dict(json.load(f))
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                return IndexLogEntry.from_dict(json.load(f))
+        except (ValueError, KeyError):
+            return None
 
     def get_latest_id(self) -> Optional[int]:
-        """Highest committed id (IndexLogManager.scala:83-92)."""
+        """Highest committed id (IndexLogManager.scala:83-92).  Torn
+        entries COUNT: their id is burned, so writers derived from this
+        never collide with a partial file."""
         if not os.path.isdir(self.log_dir):
             return None
         ids = [int(n) for n in os.listdir(self.log_dir) if n.isdigit()]
         return max(ids) if ids else None
 
     def get_latest_log(self) -> Optional[IndexLogEntry]:
+        """Newest PARSEABLE entry: a torn trailing record (crashed
+        writer) must not make the whole index look absent."""
         latest = self.get_latest_id()
-        return self.get_log(latest) if latest is not None else None
+        if latest is None:
+            return None
+        for log_id in range(latest, -1, -1):
+            entry = self.get_log(log_id)
+            if entry is not None:
+                return entry
+        return None
 
     def get_latest_stable_log(self) -> Optional[IndexLogEntry]:
         """The latestStable pointer file if valid, else reverse-scan
-        (IndexLogManager.scala:94-113)."""
+        (IndexLogManager.scala:94-113).  Torn numbered entries are
+        skipped by the scan (get_log returns None for them)."""
         stable_path = os.path.join(self.log_dir, LATEST_STABLE)
         if os.path.isfile(stable_path):
             try:
@@ -78,23 +114,35 @@ class IndexLogManager:
     # -- writes -------------------------------------------------------------
     def write_log(self, log_id: int, entry: IndexLogEntry) -> bool:
         """Atomically create log file ``log_id``; False if it already exists
-        (the optimistic-concurrency check, IndexLogManager.scala:149-165)."""
+        (the optimistic-concurrency check, IndexLogManager.scala:149-165).
+        Transient IO errors retry — each attempt unlinks its partial file
+        first, so the create-if-absent probe stays honest."""
         os.makedirs(self.log_dir, exist_ok=True)
         path = os.path.join(self.log_dir, str(log_id))
         entry.id = log_id
-        try:
-            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-        except FileExistsError:
-            return False
-        try:
-            with os.fdopen(fd, "w", encoding="utf-8") as f:
-                json.dump(entry.to_dict(), f, indent=2)
-                f.flush()
-                os.fsync(f.fileno())
-        except BaseException:
-            os.unlink(path)
-            raise
-        return True
+        payload = json.dumps(entry.to_dict(), indent=2).encode("utf-8")
+
+        def attempt() -> bool:
+            try:
+                fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                return False
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    faults.write_payload(f, payload, "log.write")
+                    f.flush()
+                    os.fsync(f.fileno())
+            except faults.InjectedCrash:
+                # Simulated process death: a real crash runs no cleanup,
+                # so the partial file STAYS (that torn state is exactly
+                # what the readers above must survive).
+                raise
+            except BaseException:
+                os.unlink(path)
+                raise
+            return True
+
+        return self.retry.call(attempt)
 
     def write_log_or_raise(self, log_id: int, entry: IndexLogEntry) -> None:
         if not self.write_log(log_id, entry):
@@ -104,18 +152,23 @@ class IndexLogManager:
 
     def create_latest_stable_log(self, log_id: int) -> bool:
         """Copy entry ``log_id`` to the latestStable pointer file
-        (IndexLogManager.scala:115-147)."""
+        (IndexLogManager.scala:115-147).  tmp + atomic rename: a crash on
+        either side of the rename leaves a resolvable pointer state."""
         src = os.path.join(self.log_dir, str(log_id))
         if not os.path.isfile(src):
             return False
         dst = os.path.join(self.log_dir, LATEST_STABLE)
         tmp = dst + ".tmp"
-        with open(src, "rb") as f_in, open(tmp, "wb") as f_out:
-            f_out.write(f_in.read())
-            f_out.flush()
-            os.fsync(f_out.fileno())
-        os.replace(tmp, dst)  # atomic on POSIX
-        return True
+
+        def attempt() -> bool:
+            with open(src, "rb") as f_in, open(tmp, "wb") as f_out:
+                f_out.write(f_in.read())
+                f_out.flush()
+                os.fsync(f_out.fileno())
+            faults.atomic_replace(tmp, dst, "log.rename")
+            return True
+
+        return self.retry.call(attempt)
 
     def delete_latest_stable_log(self) -> bool:
         path = os.path.join(self.log_dir, LATEST_STABLE)

@@ -35,8 +35,30 @@ class IndexCollectionManager:
 
     # -- manager plumbing (index/factories.scala:24-54) ---------------------
     def _log_manager(self, name: str) -> IndexLogManager:
+        from hyperspace_tpu.utils.retry import policy_from_conf
+
         cls = _resolve_log_manager_class(self.session.conf.log_manager_class)
-        return cls(self.path_resolver.get_index_path(name))
+        mgr = cls(self.path_resolver.get_index_path(name))
+        # Attribute, not constructor kwarg: pluggable subclasses keep the
+        # (index_path)-only __init__ contract.
+        mgr.retry = policy_from_conf(self.session.conf)
+        return mgr
+
+    def _maybe_recover(self, name: str) -> None:
+        """With ``hyperspace.index.autoRecovery.enabled``, roll a
+        transient latest entry (a prior action died mid-flight) back to
+        the last stable state before dispatching — an implicit cancel()
+        (actions/CancelAction.scala:25-58).  Safe against a merely SLOW
+        concurrent action: the rollback and that action's commit race on
+        the same log id, and the create-if-absent write arbitrates."""
+        if not self.session.conf.auto_recovery_enabled:
+            return
+        from hyperspace_tpu.actions.cancel import CancelAction
+
+        mgr = self._log_manager(name)
+        latest = mgr.get_latest_log()
+        if latest is not None and latest.state not in States.STABLE:
+            CancelAction(mgr).run()
 
     def _data_manager(self, name: str) -> IndexDataManager:
         return IndexDataManager(self.path_resolver.get_index_path(name))
@@ -47,6 +69,7 @@ class IndexCollectionManager:
         from hyperspace_tpu.actions.data_skipping import CreateDataSkippingAction
         from hyperspace_tpu.index.index_config import DataSkippingIndexConfig
 
+        self._maybe_recover(config.index_name)
         action_cls = CreateDataSkippingAction \
             if isinstance(config, DataSkippingIndexConfig) else CreateAction
         action_cls(self._log_manager(config.index_name),
@@ -56,16 +79,19 @@ class IndexCollectionManager:
     def delete(self, name: str) -> None:
         from hyperspace_tpu.actions.delete import DeleteAction
 
+        self._maybe_recover(name)
         DeleteAction(self._log_manager(name)).run()
 
     def restore(self, name: str) -> None:
         from hyperspace_tpu.actions.restore import RestoreAction
 
+        self._maybe_recover(name)
         RestoreAction(self._log_manager(name)).run()
 
     def vacuum(self, name: str) -> None:
         from hyperspace_tpu.actions.vacuum import VacuumAction
 
+        self._maybe_recover(name)
         VacuumAction(self._log_manager(name), self._data_manager(name)).run()
 
     def cancel(self, name: str) -> None:
@@ -86,6 +112,7 @@ class IndexCollectionManager:
                "quick": RefreshQuickAction}.get(mode)
         if cls is None:
             raise HyperspaceError(f"Unknown refresh mode {mode!r}")
+        self._maybe_recover(name)
         # Data-skipping sketches are rebuilt/patched by their own action
         # (quick refresh is kind-agnostic: metadata only).  The stable entry
         # read here is handed to the action so the log parses once.
@@ -100,6 +127,7 @@ class IndexCollectionManager:
 
         if mode not in ("quick", "full"):
             raise HyperspaceError(f"Unknown optimize mode {mode!r}")
+        self._maybe_recover(name)
         OptimizeAction(self._log_manager(name), self._data_manager(name),
                        self.session, mode).run()
 

@@ -1,0 +1,186 @@
+"""Deterministic fault injection for the IO / op-log / action layers.
+
+The operation log's crash-consistency story (numbered entries,
+create-if-absent, atomic rename — IndexLogManager.scala:33-166) is easy
+to assert by design and hard to trust without exercising it: partial
+writes, interrupted renames, and transient IO errors are exactly the
+failure envelope a lake indexing subsystem exists to survive (cf. Delta
+Lake's optimistic log protocol and Spark's task-retry model).  This
+module is the switchboard: IO primitives call :func:`check` /
+:func:`write_payload` at named *sites*, and an installed
+:class:`FaultPlan` decides whether the Nth call at that site fails — and
+how.
+
+Disabled is the default and costs one ``is None`` check per *file-level*
+IO operation (never per row): the query hot path has zero sites, and the
+op-log writes one small file per action.
+
+Sites (grep for ``faults.check`` / ``faults.write_payload``):
+
+========================  ====================================================
+``log.write``             payload write of a numbered log entry
+                          (IndexLogManager.write_log)
+``log.rename``            the latestStable tmp → pointer atomic rename
+                          (IndexLogManager.create_latest_stable_log)
+``data.write``            an index data (parquet) file write
+                          (io/parquet.write_bucket_run)
+``action.commit``         between an action's op() and end() — work done,
+                          final entry not yet committed (actions/base.run)
+========================  ====================================================
+
+Kinds:
+
+========================  ====================================================
+``enospc`` / ``eio``      raise ``OSError`` with that errno (transient from
+                          the retry layer's point of view)
+``torn``                  write only half the payload, then die
+                          (:class:`InjectedCrash`) — models a power cut mid
+                          write; the partial file STAYS on disk
+``crash``                 die at the site before doing anything
+``crash-before-rename``   die with the tmp file written, rename not done
+``crash-after-rename``    perform the rename, then die
+========================  ====================================================
+
+A crash is modeled as :class:`InjectedCrash`, a ``BaseException``:
+``except Exception`` cleanup handlers — which a real ``kill -9`` would
+never run — don't catch it, so the on-disk state the next process sees
+is the honest post-crash state.  Cleanup code that would mask the
+simulation (e.g. ``write_log``'s unlink-on-error) explicitly re-raises
+it first.
+
+Configured either directly (``faults.install(FaultPlan(...))``, what the
+tests do) or via conf keys (``hyperspace.system.faultInjection.*``,
+applied by ``HyperspaceSession``) so multi-process scenarios can arm the
+injector through a child's session conf.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import errno
+import threading
+from typing import Optional
+
+_KNOWN_KINDS = ("enospc", "eio", "torn", "crash", "crash-before-rename",
+                "crash-after-rename")
+
+
+class InjectedCrash(BaseException):
+    """Simulated process death at a fault site.
+
+    Deliberately NOT an ``Exception``: a crashed process runs no cleanup
+    handlers, so ``except Exception`` blocks must not swallow this (the
+    few ``except BaseException`` cleanup paths on the instrumented
+    routes re-raise it explicitly before cleaning up).
+    """
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """One armed fault: fire ``count`` times starting at the ``at``-th
+    call of ``site`` (1-based), with the given ``kind``."""
+
+    site: str
+    kind: str
+    at: int = 1
+    count: int = 1  # -1 = every matching call from ``at`` on
+
+    def __post_init__(self) -> None:
+        if self.kind not in _KNOWN_KINDS:
+            raise ValueError(
+                f"Unknown fault kind {self.kind!r}; expected one of "
+                f"{_KNOWN_KINDS}")
+        self._calls = 0
+        self._fired = 0
+        self._lock = threading.Lock()
+
+    def _should_fire(self, site: str) -> bool:
+        if site != self.site:
+            return False
+        with self._lock:
+            self._calls += 1
+            if self._calls < self.at:
+                return False
+            if self.count >= 0 and self._fired >= self.count:
+                return False
+            self._fired += 1
+            return True
+
+    def _raise(self) -> None:
+        if self.kind == "enospc":
+            raise OSError(errno.ENOSPC, "injected: no space left on device")
+        if self.kind == "eio":
+            raise OSError(errno.EIO, "injected: input/output error")
+        raise InjectedCrash(f"injected crash at {self.site}")
+
+
+_PLAN: Optional[FaultPlan] = None
+
+
+def install(plan: Optional[FaultPlan]) -> None:
+    """Arm ``plan`` process-globally (None disarms)."""
+    global _PLAN
+    _PLAN = plan
+
+
+def clear() -> None:
+    install(None)
+
+
+def active() -> Optional[FaultPlan]:
+    return _PLAN
+
+
+def install_from_conf(conf) -> None:
+    """Arm the injector from ``hyperspace.system.faultInjection.*`` conf
+    keys (no-op unless enabled; called at session construction)."""
+    if not getattr(conf, "fault_injection_enabled", False):
+        return
+    install(FaultPlan(site=conf.fault_injection_site,
+                      kind=conf.fault_injection_kind,
+                      at=int(conf.fault_injection_at),
+                      count=int(conf.fault_injection_count)))
+
+
+def check(site: str) -> None:
+    """Fault checkpoint: raises the armed fault when ``site`` matches and
+    the call counter lines up; free (one None check) otherwise."""
+    plan = _PLAN
+    if plan is None or not plan._should_fire(site):
+        return
+    plan._raise()
+
+
+def write_payload(f, data: bytes, site: str) -> None:
+    """Write ``data`` to the open binary file ``f``, honoring faults at
+    ``site``: ``enospc``/``eio`` fail before any byte lands (the OS
+    rejected the write), ``torn`` persists exactly half the payload and
+    then dies, ``crash`` dies before writing."""
+    plan = _PLAN
+    if plan is None or not plan._should_fire(site):
+        f.write(data)
+        return
+    if plan.kind == "torn":
+        f.write(data[:max(1, len(data) // 2)])
+        f.flush()
+        raise InjectedCrash(f"injected torn write at {site}")
+    plan._raise()
+
+
+def atomic_replace(tmp: str, dst: str, site: str) -> None:
+    """``os.replace`` with faults at ``site``: ``crash-before-rename``
+    dies leaving the tmp file behind and ``dst`` untouched;
+    ``crash-after-rename`` dies with the rename durably done;
+    ``enospc``/``eio`` fail the rename itself."""
+    import os
+
+    plan = _PLAN
+    if plan is None or not plan._should_fire(site):
+        os.replace(tmp, dst)
+        return
+    if plan.kind == "crash-after-rename":
+        os.replace(tmp, dst)
+        raise InjectedCrash(f"injected crash after rename at {site}")
+    if plan.kind in ("crash", "crash-before-rename", "torn"):
+        raise InjectedCrash(f"injected crash before rename at {site}")
+    plan._raise()

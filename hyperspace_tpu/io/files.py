@@ -44,7 +44,24 @@ def list_data_files(root_paths: Sequence[str],
     Walk + stat go through the native runtime when available
     (native/hs_native.cc — the per-query signature check makes this the
     metadata hot loop); the Python fallback below is byte-identical.
+    Transient IO errors (a flaky mount mid-walk) retry with the default
+    bounded backoff, and the ``io.list`` fault site lets tests inject
+    them (io/faults.py); with injection disarmed the overhead is one
+    None check per LISTING, not per file.
     """
+    from hyperspace_tpu.io import faults
+    from hyperspace_tpu.utils.retry import RetryPolicy
+
+    def attempt() -> List[FileInfo]:
+        faults.check("io.list")
+        return _list_data_files(root_paths, tracker, extension)
+
+    return RetryPolicy().call(attempt)
+
+
+def _list_data_files(root_paths: Sequence[str],
+                     tracker: Optional[FileIdTracker],
+                     extension: Optional[str]) -> List[FileInfo]:
     from hyperspace_tpu import native
 
     normalized = [normalize_path(r) for r in expand_globs(root_paths)]

@@ -99,13 +99,23 @@ def read_table(paths: Sequence[str], file_format: str = "parquet",
     return pa.concat_tables(tables, promote_options="default")
 
 
+def read_parquet_file(path: str, columns=None) -> pa.Table:
+    """One parquet FILE, exactly its own columns.  ``partitioning=None``
+    matters: newer pyarrow (observed at 22.0) hive-infers partition
+    columns from the file's OWN path segments, so reading an index file
+    under ``v__=N/`` would grow a phantom ``v__`` column — corrupting
+    optimize compaction, sketches, and schema checks.  Every
+    single-file read in the engine goes through here."""
+    return pq.read_table(path, columns=columns, partitioning=None)
+
+
 def _read_one(path: str, file_format: str, columns, options: Dict[str, str]) -> pa.Table:
     if file_format == "parquet":
         # columns=[] is meaningful: read NO data columns but keep the row
         # count (a projection of partition-only columns).
         if columns is not None:
             try:
-                return pq.read_table(path, columns=list(columns))
+                return read_parquet_file(path, columns=list(columns))
             except (pa.ArrowInvalid, KeyError):
                 # Mixed-schema file set (a column added by a later append):
                 # read the columns this file has; concat promotes the rest
@@ -113,9 +123,9 @@ def _read_one(path: str, file_format: str, columns, options: Dict[str, str]) -> 
                 # (row count preserved).  The footer is only read twice on
                 # this rare path, not per file in the uniform-schema case.
                 present = set(pq.read_schema(path).names)
-                return pq.read_table(
+                return read_parquet_file(
                     path, columns=[c for c in columns if c in present])
-        return pq.read_table(path)
+        return read_parquet_file(path)
     if file_format == "csv":
         import pyarrow.csv as pacsv
 
@@ -290,9 +300,15 @@ def write_bucket_run(sorted_bucket_table: pa.Table, bucket: int,
     else:
         chunks = bucket_chunks(sorted_bucket_table.num_rows,
                                max_rows_per_file)
+    from hyperspace_tpu.io import faults
+
     out: List[str] = []
     for off, rows in chunks:
         path = os.path.join(out_dir, bucket_file_name(bucket))
+        # Crash checkpoint: an action killed mid-data-write leaves partial
+        # index data under an uncommitted version dir + a transient log
+        # state — the shape cancel()/auto-recovery must clean up after.
+        faults.check("data.write")
         pq.write_table(sorted_bucket_table.slice(off, rows), path,
                        compression=_codec(compression))
         out.append(path)
@@ -380,8 +396,13 @@ def write_bucketed(table: pa.Table, bucket_ids: np.ndarray, sort_perm: np.ndarra
             jobs.append((b, int(starts[b]) + off, rows))
 
     def write(job) -> str:
+        from hyperspace_tpu.io import faults
+
         b, start, rows = job
         path = os.path.join(out_dir, bucket_file_name(b))
+        # Crash checkpoint, same site as write_bucket_run: both writers
+        # are "an index data file lands on disk".
+        faults.check("data.write")
         pq.write_table(sorted_table.slice(start, rows), path,
                        compression=_codec(compression))
         return path

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from hyperspace_tpu.utils.compat import enable_x64 as _enable_x64
 from hyperspace_tpu.utils.shapes import round_up_pow2
 
 AGG_OPS = ("sum", "min", "max", "mean", "count", "count_all")
@@ -138,11 +139,14 @@ def grouped_aggregate(
     capacity_rows = n
     if pad_to and pad_to > 0:
         capacity_rows = -(-max(n, 1) // pad_to) * pad_to
-    # Device-resident inputs (jax arrays from the HBM cache) pass through
-    # _pad_rows untouched — it pads them on device instead of pulling.
-    kw = tuple(_pad_rows(w, capacity_rows) for w in key_words)
-    vc = tuple(_pad_rows(v, capacity_rows) for v in value_cols)
-    with jax.enable_x64():
+    with _enable_x64():
+        # Device-resident inputs (jax arrays from the HBM cache) pass
+        # through _pad_rows untouched — it pads them on device instead of
+        # pulling.  Padding must run INSIDE the x64 region: jnp.pad of a
+        # float64/int64 device array under 32-bit mode silently downcasts,
+        # which cost float sums ~1e-6 relative error.
+        kw = tuple(_pad_rows(w, capacity_rows) for w in key_words)
+        vc = tuple(_pad_rows(v, capacity_rows) for v in value_cols)
         perm, boundaries, n_groups = _group_sort(kw, n)
         g = int(n_groups)
         if g == 0:

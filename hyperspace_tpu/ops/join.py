@@ -23,6 +23,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from hyperspace_tpu.utils.compat import enable_x64 as _enable_x64
 from hyperspace_tpu.utils.shapes import round_up_pow2
 
 
@@ -207,7 +208,7 @@ def sorted_equi_join(left_keys: np.ndarray, right_keys: np.ndarray
         if fits32(left_keys) and fits32(right_keys):
             left_keys = left_keys.astype(np.int32, copy=False)
             right_keys = right_keys.astype(np.int32, copy=False)
-    with jax.enable_x64():
+    with _enable_x64():
         lk = jnp.asarray(left_keys)
         rk = jnp.asarray(right_keys)
         r_perm = jnp.argsort(rk)

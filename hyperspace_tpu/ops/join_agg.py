@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from hyperspace_tpu.ops.aggregate import _group_sort, _segment_reduce
+from hyperspace_tpu.utils.compat import enable_x64 as _enable_x64
 from hyperspace_tpu.ops.join import _expand, _match_ranges
 from hyperspace_tpu.utils.shapes import round_up_pow2
 
@@ -52,9 +53,19 @@ def _topk_groups(col, n_valid, *, k: int, ascending: bool,
     valid = jnp.arange(capacity) < n_valid
     if jnp.issubdtype(col.dtype, jnp.floating):
         sentinel = jnp.array(-jnp.inf, dtype=col.dtype)
+        work = col if not ascending else -col
+        # NaN must map to the sentinel BEFORE top_k: lax.top_k ranks NaN
+        # unpredictably, so an ORDER BY <agg> LIMIT k could otherwise
+        # pick different boundary groups than the host sort.  (-NaN is
+        # still NaN, so one check after the flip covers both orders.)
+        work = jnp.where(jnp.isnan(work), sentinel, work)
     else:
         sentinel = jnp.iinfo(col.dtype).min
-    work = col if not ascending else -col
+        # Ascending via BITWISE not (monotone decreasing, total on the
+        # whole domain): arithmetic negation overflows at iinfo.min, so
+        # ORDER BY <agg> ASC could mis-rank a group whose count/sum hit
+        # the extreme value.
+        work = col if not ascending else ~col
     work = jnp.where(valid, work, sentinel)
     _vals, idx = jax.lax.top_k(work, k)
     return idx
@@ -110,7 +121,7 @@ def join_group_aggregate(
     from hyperspace_tpu.utils.xla_cache import ensure_persistent_xla_cache
 
     ensure_persistent_xla_cache()
-    with jax.enable_x64():
+    with _enable_x64():
         lk = jnp.asarray(l_key)
         rk = jnp.asarray(r_key)
         if lk.shape[0] == 0 or rk.shape[0] == 0:

@@ -21,6 +21,7 @@ from typing import Callable, List, Sequence
 import numpy as np
 
 from hyperspace_tpu.parallel.mesh import SHARD_AXIS, build_mesh
+from hyperspace_tpu.utils.compat import enable_x64 as _enable_x64
 
 
 def eval_predicate_on_mesh(fn: Callable, columns: Sequence[np.ndarray],
@@ -33,7 +34,7 @@ def eval_predicate_on_mesh(fn: Callable, columns: Sequence[np.ndarray],
     width regardless of the caller."""
     import jax
 
-    with jax.enable_x64():
+    with _enable_x64():
         from jax.sharding import NamedSharding, PartitionSpec
 
         if mesh is None:

@@ -29,6 +29,7 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map as _shard_map
 
 from hyperspace_tpu.parallel.mesh import SHARD_AXIS
+from hyperspace_tpu.utils.compat import enable_x64 as _enable_x64
 from hyperspace_tpu.utils.shapes import round_up_pow2
 
 
@@ -134,7 +135,7 @@ def copartitioned_join_ragged(
 
 def _copartitioned_join_padded(lk, lvalid, rk, rvalid, D, L, R, mesh):
     # Scoped x64: int64 join keys keep full width (see ops/join.py).
-    with jax.enable_x64():
+    with _enable_x64():
         counts = np.asarray(_count_program(lk, lvalid, rk, rvalid, mesh=mesh))
         capacity = int(counts.max()) if counts.size else 0
         if capacity == 0:

@@ -470,7 +470,9 @@ def _load_index_sketch(path: str) -> List[dict]:
     key = (path, st.st_size, st.st_mtime_ns)
     rows = _INDEX_SKETCH_CACHE.get(key)
     if rows is None:
-        rows = pq.read_table(path).to_pylist()
+        from hyperspace_tpu.io.parquet import read_parquet_file
+
+        rows = read_parquet_file(path).to_pylist()
         if len(_INDEX_SKETCH_CACHE) >= _SKETCH_CACHE_MAX:
             _INDEX_SKETCH_CACHE.clear()
         _INDEX_SKETCH_CACHE[key] = rows

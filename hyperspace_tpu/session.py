@@ -86,6 +86,13 @@ class HyperspaceSession:
             from hyperspace_tpu.telemetry.events import apply_conf_event_logger
 
             apply_conf_event_logger(self.conf.event_logger)
+        if self.conf.fault_injection_enabled:
+            # Deterministic fault injection (io/faults.py) armed via conf:
+            # lets multi-process crash tests configure a child process
+            # through its session conf alone.
+            from hyperspace_tpu.io import faults
+
+            faults.install_from_conf(self.conf)
         self._schema_cache: Dict[object, Dict[str, str]] = {}
         # optimize() mutates shared state (the cached IndexLogEntry tags it
         # clears per pass), so concurrent queries — e.g. interop server

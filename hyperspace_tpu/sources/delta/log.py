@@ -215,7 +215,9 @@ class DeltaLog:
         import pyarrow.parquet as pq
 
         path = os.path.join(self.log_path, f"{version:020d}.checkpoint.parquet")
-        table = pq.read_table(path)
+        from hyperspace_tpu.io.parquet import read_parquet_file
+
+        table = read_parquet_file(path)
         metadata = DeltaMetadata()
         active: Dict[str, AddFile] = {}
         tombstones: Dict[str, RemoveFile] = {}

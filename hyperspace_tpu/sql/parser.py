@@ -1303,6 +1303,19 @@ def _assemble_comma_join(p: "_Parser", items, where):
             progressed = True
             break
         if not progressed:
+            # Distinguish the REAL limitation: two aliases of the same
+            # table make every shared column ambiguous to owner(), so no
+            # equi conjunct can ever connect them — that is a self-join
+            # gap, not a cross join, and saying "cross joins" sent users
+            # down the wrong path.
+            pending = [i for i in range(len(items)) if i not in joined]
+            if any(cols_of[i] == cols_of[j]
+                   for i in pending for j in range(len(items)) if i != j):
+                p.fail(
+                    "comma-style self-joins (the same table under two "
+                    "aliases) are not supported: the join columns are "
+                    "ambiguous — use explicit JOIN ... ON with "
+                    "qualified aliases")
             p.fail(
                 "comma-separated FROM requires WHERE equi-join "
                 "predicates connecting every table (cross joins are "
@@ -1412,8 +1425,13 @@ def sql(session, text: str, tables: Dict[str, Any]):
             cte_name = t[1]
             p.expect_kw("AS")
             p.expect_op("(")
-            body = _Parser(p.text, session, dict(p.tables))
-            body.tokens, body.i = p.tokens, p.i
+            # fork() shares the token stream — re-tokenizing the whole
+            # SQL text per CTE (the old _Parser(p.text, ...) constructor
+            # route) cost one full lex per CTE for nothing.  The body
+            # needs its OWN tables snapshot: earlier CTEs are visible,
+            # its registrations must not leak back.
+            body = p.fork()
+            body.tables = dict(p.tables)
             cte_ds = _parse_query(body)
             p.i = body.i
             p.expect_op(")")

@@ -1,0 +1,19 @@
+"""Version-compat shims for the small jax API surface the engine leans on.
+
+``jax.enable_x64`` (the context-manager form) is only a top-level alias in
+newer jax; older releases ship it as ``jax.experimental.enable_x64``.  The
+engine wraps every int64-precision region in it, so a missing alias took
+down the whole device data plane on otherwise-supported jax versions.
+Import it from here instead of from jax directly.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:
+    enable_x64 = jax.enable_x64
+except AttributeError:  # older jax: context manager lives in experimental
+    from jax.experimental import enable_x64  # type: ignore[no-redef]
+
+__all__ = ["enable_x64"]

@@ -1,0 +1,72 @@
+"""Bounded retry with exponential backoff + jitter for transient IO errors.
+
+The op-log writes one small file per action; a transient ``EIO`` (flaky
+NFS/FUSE mount, object-store 5xx surfaced as an errno) or ``ENOSPC``
+(another process's spill just got reclaimed) should not abort an index
+build whose data files are already durably written.  Retries are bounded
+and per-attempt delays are jittered so two racing writers don't
+re-collide in lockstep (the Spark task-retry model, scoped down to
+single file operations).
+
+Retryable = the classic transient errnos.  Everything else — including
+``FileExistsError`` (the optimistic-concurrency signal, which must
+surface immediately) — propagates on first failure.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import errno
+import random
+import time
+from typing import Callable, TypeVar
+
+T = TypeVar("T")
+
+# EIO: flaky transport.  ENOSPC: space can be reclaimed between attempts.
+# EAGAIN/EINTR: definitionally transient.
+TRANSIENT_ERRNOS = frozenset(
+    {errno.EIO, errno.ENOSPC, errno.EAGAIN, errno.EINTR})
+
+
+def is_transient(exc: BaseException) -> bool:
+    return (isinstance(exc, OSError)
+            and not isinstance(exc, FileExistsError)
+            and exc.errno in TRANSIENT_ERRNOS)
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """``max_attempts`` total tries; delay before retry *i* is
+    ``initial_backoff_ms * 2**(i-1)`` capped at ``max_backoff_ms``, each
+    multiplied by a uniform [0.5, 1.0) jitter factor."""
+
+    max_attempts: int = 3
+    initial_backoff_ms: float = 10.0
+    max_backoff_ms: float = 1000.0
+
+    def delay_s(self, attempt: int, rng: random.Random) -> float:
+        base = min(self.initial_backoff_ms * (2.0 ** attempt),
+                   self.max_backoff_ms)
+        return base * (0.5 + 0.5 * rng.random()) / 1000.0
+
+    def call(self, fn: Callable[[], T]) -> T:
+        """Run ``fn``, retrying transient OSErrors up to the budget."""
+        rng = random.Random()
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except OSError as e:
+                attempt += 1
+                if not is_transient(e) or attempt >= max(1, self.max_attempts):
+                    raise
+                time.sleep(self.delay_s(attempt - 1, rng))
+
+
+def policy_from_conf(conf) -> RetryPolicy:
+    """RetryPolicy from ``hyperspace.system.io.retry.*`` conf keys."""
+    return RetryPolicy(
+        max_attempts=int(conf.io_retry_max_attempts),
+        initial_backoff_ms=float(conf.io_retry_initial_backoff_ms),
+        max_backoff_ms=float(conf.io_retry_max_backoff_ms))

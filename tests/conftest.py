@@ -33,6 +33,42 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest
 
+# Heavy suites excluded from the `pytest -m quick` tier (round-5 verdict:
+# cap suite growth — the TPC corpora + fuzz nets grow wall-clock
+# superlinearly): everything NOT listed here is auto-marked `quick` below,
+# so the quick tier stays under ~3 minutes while `run-tests.py` (and CI's
+# full job) keeps running the whole suite.
+_HEAVY_MODULES = frozenset({
+    "test_tpcds",               # 20-query TPC-DS corpus, rules on+off
+    "test_sql_tpch",            # TPC-H corpus
+    "test_plan_stability_tpch",  # golden-plan diffs over the corpus
+    "test_fuzz_equivalence",    # hypothesis nets
+    "test_fuzz_queries",
+    "test_concurrency",         # cross-process races (spawn pools)
+    "test_multiprocess",        # multi-host jax.distributed smoke
+    "test_interop",             # Arrow-IPC server + C++ client build
+    "test_external_build",      # streaming spill builds
+    "test_bench_resilience",    # runs bench.py end-to-end in subprocesses
+})
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        module = getattr(item, "module", None)
+        name = getattr(module, "__name__", "").rpartition(".")[2]
+        if name not in _HEAVY_MODULES:
+            item.add_marker(pytest.mark.quick)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_fault_injection():
+    """The fault injector (io/faults.py) is process-global; a test that
+    arms it and then fails must never leak faults into the next test."""
+    yield
+    from hyperspace_tpu.io import faults
+
+    faults.clear()
+
 
 @pytest.fixture()
 def tmp_index_root(tmp_path):

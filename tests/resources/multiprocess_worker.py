@@ -24,6 +24,13 @@ if "host_platform_device_count" not in flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+# Cross-process collectives on the CPU backend need the gloo transport on
+# jax versions where the default CPU client ships none ("Multiprocess
+# computations aren't implemented on the CPU backend" otherwise).
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:  # older jax: option absent, default transport works
+    pass
 
 import jax.numpy as jnp
 import numpy as np

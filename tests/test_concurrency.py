@@ -15,6 +15,7 @@ import os
 import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as pq
+import pytest
 
 
 def _race_write_log(args):
@@ -142,6 +143,134 @@ def test_concurrent_optimize_and_collect_threads(tmp_path):
     assert not any(t.is_alive() for t in threads), "worker deadlocked"
     assert not errors, errors
     assert len(results) == 12
+
+
+class TestCrashRecovery:
+    """An action killed mid-flight (simulated via io/faults.py's
+    InjectedCrash — a BaseException, so no cleanup handler can mask the
+    crash) leaves a transient log state; the next lifecycle call must
+    recover it, either explicitly (cancel) or implicitly
+    (hyperspace.index.autoRecovery.enabled)."""
+
+    def _env(self, tmp_path, n=300):
+        from hyperspace_tpu import Hyperspace, HyperspaceSession
+
+        d = str(tmp_path / "data")
+        os.makedirs(d, exist_ok=True)
+        pq.write_table(pa.table({
+            "k": pa.array(np.arange(n, dtype=np.int64)),
+            "v": pa.array(np.arange(n) * 0.5),
+        }), os.path.join(d, "p.parquet"))
+        s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+        s.conf.num_buckets = 2
+        return s, Hyperspace(s), d
+
+    def test_create_killed_mid_data_write_next_create_recovers(
+            self, tmp_path):
+        from hyperspace_tpu import IndexConfig, col
+        from hyperspace_tpu.io import faults
+
+        s, hs, d = self._env(tmp_path)
+        faults.install(faults.FaultPlan(site="data.write", kind="crash"))
+        with pytest.raises(faults.InjectedCrash):
+            hs.create_index(s.read.parquet(d),
+                            IndexConfig("cr", ["k"], ["v"]))
+        faults.clear()
+        # The crash left the transient begin entry as the latest record.
+        mgr = s.index_collection_manager._log_manager("cr")
+        assert mgr.get_latest_log().state == "CREATING"
+        assert mgr.get_latest_stable_log() is None
+        # Without auto-recovery the state machine refuses (the reference
+        # contract: explicit user cancel)...
+        from hyperspace_tpu.exceptions import HyperspaceError
+
+        with pytest.raises(HyperspaceError, match="already exists"):
+            hs.create_index(s.read.parquet(d),
+                            IndexConfig("cr", ["k"], ["v"]))
+        # ...and with it, the next create rolls the corpse back and
+        # builds a working index.
+        s.conf.auto_recovery_enabled = True
+        hs.create_index(s.read.parquet(d), IndexConfig("cr", ["k"], ["v"]))
+        entry = s.index_collection_manager.get_index("cr")
+        assert entry is not None and entry.state == "ACTIVE"
+        s.enable_hyperspace()
+        out = (s.read.parquet(d).filter(col("k") == 7)
+               .select("k", "v").collect())
+        assert out.column("v").to_pylist() == [3.5]
+
+    def test_crash_before_commit_then_explicit_cancel(self, tmp_path):
+        """Killed AFTER op() did the work but BEFORE end() committed:
+        cancel() rolls back to the last stable state and normal
+        operation resumes (the reference recovery path)."""
+        from hyperspace_tpu import IndexConfig
+        from hyperspace_tpu.io import faults
+
+        s, hs, d = self._env(tmp_path)
+        hs.create_index(s.read.parquet(d), IndexConfig("cc", ["k"], ["v"]))
+        faults.install(faults.FaultPlan(site="action.commit",
+                                        kind="crash"))
+        with pytest.raises(faults.InjectedCrash):
+            hs.delete_index("cc")
+        faults.clear()
+        mgr = s.index_collection_manager._log_manager("cc")
+        assert mgr.get_latest_log().state == "DELETING"
+        # latestStable still serves queries on the pre-crash state.
+        assert mgr.get_latest_stable_log().state == "ACTIVE"
+        hs.cancel("cc")
+        assert mgr.get_latest_log().state == "ACTIVE"
+        hs.delete_index("cc")  # normal operation resumes
+        assert mgr.get_latest_log().state == "DELETED"
+
+    def test_vacuum_killed_mid_op_next_create_recovers(self, tmp_path):
+        """VACUUMING corpse -> auto-recovery cancels it to DOESNOTEXIST
+        (CancelAction.scala:44-53's special case) and a fresh create over
+        the same name succeeds."""
+        from hyperspace_tpu import IndexConfig, col
+        from hyperspace_tpu.io import faults
+
+        s, hs, d = self._env(tmp_path)
+        hs.create_index(s.read.parquet(d), IndexConfig("vx", ["k"], ["v"]))
+        hs.delete_index("vx")
+        faults.install(faults.FaultPlan(site="action.commit",
+                                        kind="crash"))
+        with pytest.raises(faults.InjectedCrash):
+            hs.vacuum_index("vx")
+        faults.clear()
+        mgr = s.index_collection_manager._log_manager("vx")
+        assert mgr.get_latest_log().state == "VACUUMING"
+        s.conf.auto_recovery_enabled = True
+        hs.create_index(s.read.parquet(d), IndexConfig("vx", ["k"], ["v"]))
+        entry = s.index_collection_manager.get_index("vx")
+        assert entry is not None and entry.state == "ACTIVE"
+        s.enable_hyperspace()
+        out = (s.read.parquet(d).filter(col("k") == 3)
+               .select("k", "v").collect())
+        assert out.num_rows == 1
+
+    def test_conf_armed_injection_via_session(self, tmp_path):
+        """The faultInjection.* conf keys arm the injector at session
+        construction — the channel multi-process crash tests use."""
+        from hyperspace_tpu import HyperspaceConf, HyperspaceSession, IndexConfig, Hyperspace
+        from hyperspace_tpu.io import faults
+
+        conf = HyperspaceConf()
+        conf.set("hyperspace.system.faultInjection.enabled", True)
+        conf.set("hyperspace.system.faultInjection.site", "log.write")
+        conf.set("hyperspace.system.faultInjection.kind", "torn")
+        d = str(tmp_path / "data")
+        os.makedirs(d)
+        pq.write_table(pa.table({
+            "k": pa.array(np.arange(50, dtype=np.int64)),
+            "v": pa.array(np.arange(50) * 1.0),
+        }), os.path.join(d, "p.parquet"))
+        s = HyperspaceSession(system_path=str(tmp_path / "ix"), conf=conf)
+        assert faults.active() is not None
+        with pytest.raises(faults.InjectedCrash):
+            Hyperspace(s).create_index(s.read.parquet(d),
+                                       IndexConfig("ct", ["k"], []))
+        faults.clear()
+        # The torn begin entry reads as absent; the index never existed.
+        assert s.index_collection_manager.get_index("ct") is None
 
 
 def test_lake_schema_memo_is_thread_local(tmp_path):

@@ -52,11 +52,13 @@ def test_create_index_writes_bucketed_sorted_data(env):
         b = bucket_id_of_file(f.name)
         assert b is not None and 0 <= b < 4
     # Index data holds exactly the projected columns and all rows.
-    import pyarrow.parquet as pq
+    # (read_parquet_file, not raw pq.read_table: newer pyarrow would
+    # hive-infer a phantom v__ column from the version-dir path.)
+    from hyperspace_tpu.io.parquet import read_parquet_file
 
     total = 0
     for f in files:
-        t = pq.read_table(f.name)
+        t = read_parquet_file(f.name)
         assert t.column_names == ["id", "name"]
         ids = t.column("id").to_pylist()
         assert ids == sorted(ids), "rows not sorted within bucket"

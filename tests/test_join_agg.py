@@ -343,3 +343,47 @@ def test_small_join_keeps_normal_path_under_eager(env, tmp_path):
     q()
     aggs = s.last_execution_stats.get("aggregates", [])
     assert not aggs or aggs[-1]["strategy"] != "device-join-agg"
+
+
+class TestTopkGroups:
+    """_topk_groups edge ordering (round-5 advisor #1): int64 extremes
+    under ascending order (arithmetic negation overflows) and NaN
+    aggregate results (lax.top_k ranks NaN unpredictably)."""
+
+    @staticmethod
+    def _topk(col_np, n_valid, k, ascending):
+        import jax.numpy as jnp
+
+        from hyperspace_tpu.ops.join_agg import _topk_groups
+        from hyperspace_tpu.utils.compat import enable_x64 as _x64
+
+        with _x64():
+            idx = _topk_groups(jnp.asarray(col_np), n_valid, k=k,
+                               ascending=ascending,
+                               capacity=len(col_np))
+        return sorted(np.asarray(idx).tolist())
+
+    def test_int64_min_ranks_first_ascending(self):
+        lo = np.iinfo(np.int64).min
+        vals = np.array([5, lo, 7, 0], dtype=np.int64)  # all valid
+        # ORDER BY ASC LIMIT 2 -> the min value and 0, NOT the overflow
+        # artifact (-lo wraps back to lo, parking the true minimum last).
+        assert self._topk(vals, 4, 2, ascending=True) == [1, 3]
+
+    def test_int64_max_ranks_first_descending(self):
+        hi = np.iinfo(np.int64).max
+        vals = np.array([5, hi, -3, 0], dtype=np.int64)
+        assert self._topk(vals, 4, 2, ascending=False) == [0, 1]
+
+    def test_nan_never_selected_over_real_values(self):
+        vals = np.array([1.0, np.nan, 3.0, -2.0], dtype=np.float64)
+        # Descending top-2: 3.0 then 1.0 — never the NaN slot.
+        assert self._topk(vals, 4, 2, ascending=False) == [0, 2]
+        # Ascending top-2: -2.0 then 1.0 — negation keeps NaN NaN, so the
+        # pre-top_k sentinel mapping must still exclude it.
+        assert self._topk(vals, 4, 2, ascending=True) == [0, 3]
+
+    def test_padding_never_beats_valid_groups(self):
+        vals = np.array([4, 2, 9, 9], dtype=np.int64)  # slots 2+ = padding
+        assert self._topk(vals, 2, 2, ascending=False) == [0, 1]
+        assert self._topk(vals, 2, 2, ascending=True) == [0, 1]

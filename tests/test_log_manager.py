@@ -1,14 +1,20 @@
-"""Operation-log manager tests: create-if-absent, latestStable fallback.
+"""Operation-log manager tests: create-if-absent, latestStable fallback,
+and crash consistency under injected faults (torn writes, interrupted
+renames, transient IO errors — io/faults.py).
 
-Mirrors index/IndexLogManagerImplTest.scala.
+Mirrors index/IndexLogManagerImplTest.scala; the fault cases are this
+engine's own (the reference asserts the protocol by design only).
 """
 
+import errno
 import os
 
 import pytest
 
 from hyperspace_tpu.index.log_entry import States
 from hyperspace_tpu.index.log_manager import IndexLogManager
+from hyperspace_tpu.io import faults
+from hyperspace_tpu.utils.retry import RetryPolicy
 from tests.utils import sample_entry
 
 
@@ -43,6 +49,136 @@ def test_get_latest_log_empty(tmp_index_root):
     assert mgr.get_latest_id() is None
     assert mgr.get_latest_log() is None
     assert mgr.get_latest_stable_log() is None
+
+
+@pytest.fixture()
+def stable_idx(tmp_index_root):
+    """CREATING at 1, ACTIVE at 2, latestStable -> 2."""
+    mgr = IndexLogManager(os.path.join(tmp_index_root, "idx"))
+    mgr.write_log(1, sample_entry(state=States.CREATING))
+    mgr.write_log(2, sample_entry(state=States.ACTIVE))
+    mgr.create_latest_stable_log(2)
+    return mgr
+
+
+class TestFaultInjection:
+    def test_torn_trailing_entry_is_skipped(self, stable_idx):
+        """A writer that dies mid-write leaves a partial JSON file;
+        every reader must skip it and the id must stay burned."""
+        mgr = stable_idx
+        faults.install(faults.FaultPlan(site="log.write", kind="torn"))
+        with pytest.raises(faults.InjectedCrash):
+            mgr.write_log(3, sample_entry(state=States.REFRESHING))
+        faults.clear()
+        # The partial file exists on disk (a real crash runs no cleanup)...
+        assert os.path.isfile(os.path.join(mgr.log_dir, "3"))
+        assert mgr.get_latest_id() == 3  # ...and burns its id,
+        assert mgr.get_log(3) is None  # but parses as absent,
+        # so the newest PARSEABLE entry wins...
+        assert mgr.get_latest_log().state == States.ACTIVE
+        # ...for latestStable resolution too, pointer or reverse scan.
+        assert mgr.get_latest_stable_log().id == 2
+        mgr.delete_latest_stable_log()
+        assert mgr.get_latest_stable_log().id == 2
+        # The next writer derives base ids PAST the torn file: no
+        # collision, append-only numbering intact.
+        assert mgr.write_log(4, sample_entry(state=States.DELETING))
+        assert mgr.get_latest_log().state == States.DELETING
+
+    @pytest.mark.parametrize("kind", ["eio", "enospc"])
+    def test_transient_write_error_retries(self, stable_idx, kind):
+        mgr = stable_idx
+        faults.install(faults.FaultPlan(site="log.write", kind=kind,
+                                        count=1))
+        assert mgr.write_log(3, sample_entry(state=States.DELETING))
+        # The retried write is complete and parseable.
+        assert mgr.get_log(3).state == States.DELETING
+
+    def test_retry_budget_is_bounded(self, stable_idx):
+        mgr = stable_idx
+        mgr.retry = RetryPolicy(max_attempts=2, initial_backoff_ms=1)
+        faults.install(faults.FaultPlan(site="log.write", kind="eio",
+                                        count=-1))
+        with pytest.raises(OSError) as exc:
+            mgr.write_log(3, sample_entry(state=States.DELETING))
+        assert exc.value.errno == errno.EIO
+        faults.clear()
+        # Failed attempts never leave partial files behind (only a real
+        # CRASH does): the id is still writable.
+        assert mgr.write_log(3, sample_entry(state=States.DELETING))
+
+    def test_concurrent_write_conflict_is_not_retried(self, stable_idx):
+        """FileExistsError is the optimistic-concurrency signal — it must
+        surface immediately, not spin through the retry budget."""
+        mgr = stable_idx
+        mgr.retry = RetryPolicy(max_attempts=5, initial_backoff_ms=200)
+        import time as _time
+
+        t0 = _time.perf_counter()
+        assert mgr.write_log(2, sample_entry(state=States.ACTIVE)) is False
+        assert _time.perf_counter() - t0 < 0.2  # no backoff sleeps
+
+    def test_crash_before_rename_resolves_last_good_entry(self, stable_idx):
+        """The end() protocol order is delete-pointer, write final entry,
+        recreate pointer.  A crash BEFORE the recreate's rename leaves no
+        pointer and an orphan tmp file — resolution must reverse-scan to
+        the newest stable numbered entry, never read the tmp garbage."""
+        mgr = stable_idx
+        mgr.write_log(3, sample_entry(state=States.DELETING))
+        mgr.delete_latest_stable_log()
+        mgr.write_log(4, sample_entry(state=States.DELETED))
+        faults.install(faults.FaultPlan(site="log.rename",
+                                        kind="crash-before-rename"))
+        with pytest.raises(faults.InjectedCrash):
+            mgr.create_latest_stable_log(4)
+        faults.clear()
+        assert os.path.isfile(
+            os.path.join(mgr.log_dir, "latestStable.tmp"))
+        assert not os.path.isfile(os.path.join(mgr.log_dir, "latestStable"))
+        resolved = mgr.get_latest_stable_log()
+        assert resolved.id == 4 and resolved.state == States.DELETED
+        # A stale-but-valid pointer (crash before an earlier update got
+        # around to deleting it) also resolves to a stable entry.
+        mgr.create_latest_stable_log(2)
+        assert mgr.get_latest_stable_log().state in States.STABLE
+
+    def test_crash_after_rename_is_durable(self, stable_idx):
+        mgr = stable_idx
+        mgr.write_log(3, sample_entry(state=States.DELETING))
+        mgr.write_log(4, sample_entry(state=States.DELETED))
+        faults.install(faults.FaultPlan(site="log.rename",
+                                        kind="crash-after-rename"))
+        with pytest.raises(faults.InjectedCrash):
+            mgr.create_latest_stable_log(4)
+        faults.clear()
+        assert mgr.get_latest_stable_log().id == 4
+        assert mgr.get_latest_stable_log().state == States.DELETED
+
+    def test_file_listing_retries_transient_errors(self, tmp_path):
+        """io/files.py's listing (the per-query signature hot loop) rides
+        the same bounded-retry policy via the io.list fault site."""
+        from hyperspace_tpu.io.files import list_data_files
+
+        d = tmp_path / "data"
+        d.mkdir()
+        (d / "p.parquet").write_bytes(b"x")
+        faults.install(faults.FaultPlan(site="io.list", kind="eio",
+                                        count=1))
+        out = list_data_files([str(d)])
+        assert [os.path.basename(f.name) for f in out] == ["p.parquet"]
+        faults.clear()
+        faults.install(faults.FaultPlan(site="io.list", kind="eio",
+                                        count=-1))
+        with pytest.raises(OSError):
+            list_data_files([str(d)])
+
+    def test_end_protocol_crash_between_delete_and_write(self, stable_idx):
+        """Action.end() deletes the pointer, writes the final entry, then
+        recreates the pointer.  A crash in the window where the pointer
+        is absent must still resolve latestStable via the reverse scan."""
+        mgr = stable_idx
+        mgr.delete_latest_stable_log()  # the crash window
+        assert mgr.get_latest_stable_log().id == 2
 
 
 class ConditionalPutLogManager(IndexLogManager):

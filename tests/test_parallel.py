@@ -15,6 +15,7 @@ import jax
 
 from hyperspace_tpu.io import columnar
 from hyperspace_tpu.ops.hash import bucket_ids
+from hyperspace_tpu.utils.compat import enable_x64 as _enable_x64
 from hyperspace_tpu.ops.sort import bucket_sort_permutation
 from hyperspace_tpu.parallel import (
     bucket_shuffle,
@@ -216,7 +217,7 @@ class TestMeshFilter:
         n = 10_003  # deliberately not a multiple of 8
         a = rng.integers(0, 200, n)
         b = rng.random(n)
-        with jax.enable_x64():
+        with _enable_x64():
             want = np.asarray(fn([a, b], literals))
             got = eval_predicate_on_mesh(fn, [a, b], literals, mesh)
         np.testing.assert_array_equal(got, want)

@@ -690,3 +690,24 @@ class TestRound5ParserFeatures:
         out2 = sql(s, "SELECT count(*) AS `order` FROM orders",
                    tables=_tables(s, paths)).collect()
         assert out2.column_names == ["order"]
+
+
+class TestCommaJoinDiagnostics:
+    """The comma-join assembler's failure messages must name the ACTUAL
+    limitation (round-5 advisor #3): a duplicate-schema self-join is not
+    a cross join."""
+
+    def test_self_join_reports_self_join_gap(self, env):
+        s, paths = env
+        with pytest.raises(SqlError, match="comma-style self-joins"):
+            sql(s, "SELECT o_orderkey FROM orders o1, orders o2 "
+                   "WHERE o_totalprice > 1",
+                {"orders": s.read.parquet(paths["orders"])})
+
+    def test_unconnected_tables_still_report_cross_join(self, env):
+        s, paths = env
+        with pytest.raises(SqlError, match="cross joins are not supported"):
+            sql(s, "SELECT o_orderkey FROM orders, customer "
+                   "WHERE o_totalprice > 1",
+                {"orders": s.read.parquet(paths["orders"]),
+                 "customer": s.read.parquet(paths["customer"])})
