@@ -1252,6 +1252,20 @@ def _sec_device_agg_probe(ctx: dict) -> dict:
     }}
 
 
+def _break_even_repeats(cold_s: float, host_s: float, warm_s: float):
+    """Warm repeats k after which eager caching has paid for itself:
+    ``cold + k*warm < (k+1)*host``.  None when the warm path never beats
+    host on this attachment (transfer-dominated tunnel); 0 when even the
+    populate pass beat a host run (locally attached + device-bound op).
+    The break-even narrative lives in docs/11-optimize.md."""
+    saving = host_s - warm_s
+    if saving <= 0:
+        return None
+    import math
+
+    return max(0, math.ceil((cold_s - host_s) / saving))
+
+
 def _sec_resident_agg(ctx: dict) -> dict:
     """Warm-resident aggregation (round-3 verdict item 2): with the HBM
     cache's 'eager' policy, the FIRST group-by over the scan ships the
@@ -1304,6 +1318,11 @@ def _sec_resident_agg(ctx: dict) -> dict:
         "warm_resident_s": _stat(warm_res),
         "warm_speedup_vs_host": round(
             host_res["median"] / warm_res["median"], 3),
+        # Repeats before eager caching pays on THIS attachment:
+        # cold + k*warm < (k+1)*host  =>  k > (cold-host)/(host-warm).
+        # None = warm never beats host here (see docs/11-optimize.md).
+        "warm_break_even_repeats": _break_even_repeats(
+            cold_s, host_res["median"], warm_res["median"]),
         # True = the warm repeat was ROUTED to the resident device
         # path by the calibrated threshold itself, no forcing.  False
         # is honest too: this attachment's measured latency says even
@@ -1380,6 +1399,11 @@ def _sec_warm(ctx: dict, which: str) -> dict:
         out["warm_s"] = _stat(_time(make_q, repeats=3))
         out["warm_speedup_vs_host"] = round(
             out["host_s"]["median"] / out["warm_s"]["median"], 3)
+        # Warm-path economics (round-5 verdict item 7): how many warm
+        # repeats amortize the cold populate pass on this attachment.
+        out["warm_break_even_repeats"] = _break_even_repeats(
+            out["cold_populate_s"], out["host_s"]["median"],
+            out["warm_s"]["median"])
         for got, label in ((cold_tbl, "cold"), (warm_tbl, "warm")):
             if not ctx["tables_equal"](got, host_tbl):
                 raise SystemExit(f"{which} ({label}) diverged from host")

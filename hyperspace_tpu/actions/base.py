@@ -18,10 +18,27 @@ record; subsequent actions refuse to run and the user recovers with
 the collection manager performs that rollback implicitly
 (index/manager.py).  Crash points are exercised under injected faults
 (io/faults.py, tests/test_concurrency.py's TestCrashRecovery).
+
+Beyond the reference: ``run()`` is an **optimistic transaction loop**
+(the Delta-style commit model).  A ``ConcurrentWriteError`` no longer
+necessarily aborts the action — when the collection manager armed
+``hyperspace.index.concurrency.maxRetries``, the action REBASES
+(recaptures ``base_id`` / the previous entry from the state the winning
+writer left behind), re-validates, and retries the whole
+begin→op→end sequence after a jittered backoff.  A retry whose
+re-validation finds nothing left to do (the winner did our work) exits
+through the normal NoChangesError no-op path; one that finds a
+structurally impossible state (e.g. create over a now-ACTIVE index)
+surfaces the validation error.  Work a failed attempt already wrote
+(an uncommitted ``v__=N`` data dir, a stale transient entry below the
+winner's commits) is exactly the state cancel()/auto-recovery and
+vacuum already clean up.
 """
 
 from __future__ import annotations
 
+import random
+import time
 from typing import Optional, Type
 
 from hyperspace_tpu.exceptions import ConcurrentWriteError, HyperspaceError, NoChangesError
@@ -29,6 +46,7 @@ from hyperspace_tpu.index.log_entry import IndexLogEntry, States
 from hyperspace_tpu.index.log_manager import IndexLogManager
 from hyperspace_tpu.io import faults
 from hyperspace_tpu.telemetry.events import HyperspaceEvent, _IndexActionEvent, get_event_logger
+from hyperspace_tpu.utils.retry import RetryPolicy
 
 
 class Action:
@@ -36,6 +54,14 @@ class Action:
     transient_state: str = ""
     final_state: str = ""
     event_class: Optional[Type[_IndexActionEvent]] = None
+    # Conflict-retry budget + backoff schedule for the optimistic
+    # transaction loop.  Class-level default 0 keeps directly-constructed
+    # actions on the reference's abort-on-conflict contract; the
+    # collection manager overrides the INSTANCE attributes from
+    # ``hyperspace.index.concurrency.maxRetries`` / the io.retry backoff
+    # keys (index/manager._dispatch).
+    concurrency_max_retries: int = 0
+    conflict_backoff: RetryPolicy = RetryPolicy()
 
     def __init__(self, log_manager: IndexLogManager) -> None:
         self.log_manager = log_manager
@@ -45,6 +71,9 @@ class Action:
         latest = self.log_manager.get_latest_id()
         self._base_id: int = 0 if latest is None else latest
         self.previous_log_entry: Optional[IndexLogEntry] = self.log_manager.get_latest_log()
+        # Conflicts absorbed by the transaction loop this run (observable
+        # by tests and telemetry consumers).
+        self.conflict_retries: int = 0
 
     # -- protocol pieces ----------------------------------------------------
     @property
@@ -91,8 +120,21 @@ class Action:
         self.log_manager.write_log_or_raise(self.base_id + 2, entry)
         self.log_manager.create_latest_stable_log(self.base_id + 2)
 
+    def _rebase(self) -> None:
+        """Recapture the optimistic-concurrency baseline after a conflict:
+        the next attempt must validate against — and write at ids derived
+        from — the state the WINNING writer committed, or the retry would
+        just re-collide (or worse, resurrect state the winner superseded).
+        Subclasses with richer captured state (refresh's previous stable
+        entry + file-id tracker) extend this."""
+        latest = self.log_manager.get_latest_id()
+        self._base_id = 0 if latest is None else latest
+        self.previous_log_entry = self.log_manager.get_latest_log()
+
     def run(self) -> None:
-        """Action.scala:84-105."""
+        """Action.scala:84-105, wrapped in the conflict-retrying
+        transaction loop (concurrency_max_retries=0 ⇒ reference
+        behavior: first conflict aborts)."""
         logger = get_event_logger()
 
         def emit(state: str, message: str = "") -> None:
@@ -100,6 +142,24 @@ class Action:
                 logger.log_event(self.event_class(
                     index_name=self.index_name, state=state, message=message))
 
+        rng = random.Random()
+        while True:
+            try:
+                self._attempt(emit)
+                return
+            except ConcurrentWriteError:
+                if self.conflict_retries >= self.concurrency_max_retries:
+                    emit("FAILURE", "concurrent modification")
+                    raise
+                self.conflict_retries += 1
+                # Jittered backoff so two rebased racers don't re-collide
+                # in lockstep (and a stale object-store listing gets its
+                # visibility window to pass before the re-validation).
+                time.sleep(self.conflict_backoff.delay_s(
+                    self.conflict_retries - 1, rng))
+                self._rebase()
+
+    def _attempt(self, emit) -> None:
         try:
             self.validate()
         except NoChangesError as e:
@@ -117,8 +177,7 @@ class Action:
             self.end()
             emit(self.final_state)
         except ConcurrentWriteError:
-            emit("FAILURE", "concurrent modification")
-            raise
+            raise  # run()'s transaction loop arbitrates: retry or FAILURE
         except Exception as e:
             emit("FAILURE", str(e))
             raise

@@ -405,6 +405,16 @@ class RefreshDataSkippingAction(CreateDataSkippingAction):
         self._previous_entry = prev
         self._file_id_tracker = FileIdTracker.from_log_entry(prev)
 
+    def _rebase(self) -> None:
+        """Conflict retry (actions/base.py): re-sketch against the stable
+        entry the winning writer committed — same contract as
+        RefreshActionBase._rebase."""
+        super()._rebase()
+        stable = self.log_manager.get_latest_stable_log()
+        if stable is not None:
+            self._previous_entry = stable
+            self._file_id_tracker = FileIdTracker.from_log_entry(stable)
+
     def _changed_files(self):
         recorded = {(f.name, f.size, f.mtime)
                     for f in self._previous_entry.source_file_infos()}

@@ -116,6 +116,18 @@ class RefreshActionBase(CreateActionBase):
 
         return copy.deepcopy(self._previous_entry)
 
+    def _rebase(self) -> None:
+        """Conflict retry: diff and merge against the stable entry the
+        WINNING writer committed, not the one captured at construction —
+        or the retry would re-index files the winner already covered and
+        merge against a superseded content tree (the lost-update shape
+        the transaction loop exists to prevent)."""
+        super()._rebase()
+        stable = self.log_manager.get_latest_stable_log()
+        if stable is not None:
+            self._previous_entry = stable
+            self._file_id_tracker = FileIdTracker.from_log_entry(stable)
+
 
 class RefreshAction(RefreshActionBase):
     """Full rebuild (RefreshAction.scala:33-59)."""

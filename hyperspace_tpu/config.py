@@ -29,6 +29,10 @@ CACHE_EXPIRY_SECONDS = "hyperspace.index.cache.expiryDurationInSeconds"
 SOURCE_PROVIDERS = "hyperspace.index.sources.fileBasedBuilders"
 SIGNATURE_PROVIDER = "hyperspace.index.signatureProvider"
 LOG_MANAGER_CLASS = "hyperspace.index.logManagerClass"
+LOG_STORE_CLASS = "hyperspace.index.logStoreClass"
+CONCURRENCY_MAX_RETRIES = "hyperspace.index.concurrency.maxRetries"
+DEGRADED_FALLBACK_TO_SOURCE = "hyperspace.system.degraded.fallbackToSource"
+OBJECT_STORE_STALE_LIST_MS = "hyperspace.system.objectStore.staleListMs"
 EVENT_LOGGER = "hyperspace.eventLoggerClass"
 SUPPORTED_FILE_FORMATS = "hyperspace.index.supportedFileFormats"
 DEVICE_BATCH_ROWS = "hyperspace.tpu.deviceBatchRows"
@@ -102,6 +106,24 @@ class HyperspaceConf:
     # hard part of the reference's HDFS-rename assumption.
     log_manager_class: str = (
         "hyperspace_tpu.index.log_manager.IndexLogManager")
+    # Storage backend for ObjectStoreLogManager (a LogStore subclass,
+    # io/log_store.py): conditional-put primitives the rename-less log
+    # protocol is built on.  Ignored by the default POSIX manager.
+    log_store_class: str = "hyperspace_tpu.io.log_store.EmulatedObjectStore"
+    # Optimistic transaction loop (actions/base.py): on a concurrent-write
+    # conflict the action re-validates against the new latest log id and
+    # retries with jittered backoff, up to this many extra attempts
+    # (0 = the reference's abort-on-conflict behavior).
+    concurrency_max_retries: int = 3
+    # Degraded-mode querying: an index whose log is unreadable, torn past
+    # recovery, or whose store is erroring is SKIPPED by the rewrite rules
+    # — the query answers from the source scan and telemetry records an
+    # IndexDegradedEvent.  Off = such an index raises instead (strict).
+    degraded_fallback_to_source: bool = True
+    # EmulatedObjectStore listing-visibility window (ms): keys committed
+    # within the window are hidden from list operations (point reads stay
+    # strong) — the eventual-consistency shape object-store listings have.
+    object_store_stale_list_ms: float = 0.0
     event_logger: str = ""
     # Reference default allow-list (HyperspaceConf.scala:97).
     supported_file_formats: str = "avro,csv,json,orc,parquet,text"
@@ -232,6 +254,10 @@ class HyperspaceConf:
         SOURCE_PROVIDERS: "source_providers",
         SIGNATURE_PROVIDER: "signature_provider",
         LOG_MANAGER_CLASS: "log_manager_class",
+        LOG_STORE_CLASS: "log_store_class",
+        CONCURRENCY_MAX_RETRIES: "concurrency_max_retries",
+        DEGRADED_FALLBACK_TO_SOURCE: "degraded_fallback_to_source",
+        OBJECT_STORE_STALE_LIST_MS: "object_store_stale_list_ms",
         EVENT_LOGGER: "event_logger",
         SUPPORTED_FILE_FORMATS: "supported_file_formats",
         DEVICE_BATCH_ROWS: "device_batch_rows",

@@ -22,12 +22,25 @@ from hyperspace_tpu.plan.nodes import (
     Limit,
     LogicalPlan,
     Project,
+    Scan,
     SetOp,
     Sort,
     Union,
     Window,
     WithColumns,
 )
+
+
+def _index_scans_of(plan: LogicalPlan) -> List[str]:
+    """Names of indexes the optimized plan reads (Scan relations carrying
+    the index marker) — the guard for degraded re-execution: only a plan
+    that actually touches index data qualifies for the source fallback."""
+    out: List[str] = []
+    if isinstance(plan, Scan) and plan.relation.index_scan_of is not None:
+        out.append(plan.relation.index_scan_of)
+    for child in plan.children:
+        out.extend(_index_scans_of(child))
+    return sorted(set(out))
 
 
 class GroupedDataset:
@@ -212,14 +225,39 @@ class Dataset:
         return GroupedDataset(self, ()).agg(**named_specs)
 
     # -- execution ----------------------------------------------------------
-    def optimized_plan(self) -> LogicalPlan:
-        return self.session.optimize(self.plan)
+    def optimized_plan(self, use_indexes: bool = True) -> LogicalPlan:
+        return self.session.optimize(self.plan, use_indexes=use_indexes)
 
     def collect(self) -> pa.Table:
         from hyperspace_tpu.execution.executor import Executor
 
         executor = Executor(self.session)
-        out = executor.execute(self.optimized_plan())
+        plan = self.optimized_plan()
+        try:
+            out = executor.execute(plan)
+        except Exception as e:  # noqa: BLE001 — InjectedCrash is a
+            # BaseException and still dies like a real crash.
+            index_names = _index_scans_of(plan)
+            if not index_names or \
+                    not self.session.conf.degraded_fallback_to_source:
+                raise
+            # Degraded mode, execution stage: the REWRITTEN plan died and
+            # it reads index data — an index whose files are torn, vacuumed
+            # under us, or on an erroring store must cost this query its
+            # acceleration, never its answer.  Re-plan WITHOUT index
+            # rewrites and run the source scan; a failure of that plan is
+            # a genuine source problem and propagates.
+            from hyperspace_tpu.telemetry.events import (
+                IndexDegradedEvent,
+                get_event_logger,
+            )
+
+            get_event_logger().log_event(IndexDegradedEvent(
+                index_name=",".join(index_names),
+                reason=f"index scan failed at execution: {e!r}",
+                message="re-executed against the source scan"))
+            executor = Executor(self.session)
+            out = executor.execute(self.optimized_plan(use_indexes=False))
         # Physical stats of the most recent execution (join strategies,
         # scan file counts) — read by verbose explain and tests.
         self.session.last_execution_stats = executor.stats

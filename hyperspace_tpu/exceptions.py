@@ -23,3 +23,15 @@ class NoChangesError(HyperspaceError):
 class ConcurrentWriteError(HyperspaceError):
     """Optimistic-concurrency conflict: a log id was committed by another
     writer between ``base_id`` capture and ``write_log`` (IndexLogManager.scala:149-165)."""
+
+
+class CorruptMetadataError(HyperspaceError):
+    """A source table's metadata file (Delta ``_delta_log`` commit,
+    Iceberg metadata JSON or Avro manifest) is truncated or corrupt.
+    Always names the bad file so the operator can repair or vacuum it —
+    a raw JSONDecodeError with no path is not a diagnosis."""
+
+
+class DegradedIndexError(HyperspaceError):
+    """An index's operation log is unreadable and degraded-mode fallback
+    (``hyperspace.system.degraded.fallbackToSource``) is disabled."""

@@ -53,7 +53,11 @@ class CachingIndexCollectionManager(IndexCollectionManager):
         cached = self._cache.get(self.session.conf.cache_expiry_seconds)
         if cached is None:
             cached = super().get_indexes(None)
-            self._cache.set(cached)
+            # A degraded listing (an unreadable index was skipped) is
+            # never cached: pinning the partial view for the TTL would
+            # hide a recovered store — and keep strict mode from raising.
+            if not self.last_listing_degraded:
+                self._cache.set(cached)
         if states is None:
             return list(cached)
         return [e for e in cached if e.state in states]

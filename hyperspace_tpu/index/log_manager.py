@@ -52,6 +52,12 @@ class IndexLogManager:
         self.index_path = index_path
         self.log_dir = os.path.join(index_path, HYPERSPACE_LOG_DIR)
 
+    def configure(self, conf) -> None:
+        """Post-construction conf hook: the collection manager calls this
+        after the (index_path)-only constructor so pluggable subclasses
+        (e.g. ObjectStoreLogManager's store class / staleness window) can
+        read session conf without widening the constructor seam."""
+
     # -- reads --------------------------------------------------------------
     def get_log(self, log_id: int) -> Optional[IndexLogEntry]:
         """Entry ``log_id``, or None when missing OR torn/corrupt (a
@@ -70,9 +76,10 @@ class IndexLogManager:
         """Highest committed id (IndexLogManager.scala:83-92).  Torn
         entries COUNT: their id is burned, so writers derived from this
         never collide with a partial file."""
-        if not os.path.isdir(self.log_dir):
-            return None
-        ids = [int(n) for n in os.listdir(self.log_dir) if n.isdigit()]
+        from hyperspace_tpu.io.files import list_dir
+
+        ids = [int(n) for n in list_dir(self.log_dir, self.retry)
+               if n.isdigit()]
         return max(ids) if ids else None
 
     def get_latest_log(self) -> Optional[IndexLogEntry]:
@@ -179,6 +186,7 @@ class IndexLogManager:
         return True
 
     def log_ids(self) -> List[int]:
-        if not os.path.isdir(self.log_dir):
-            return []
-        return sorted(int(n) for n in os.listdir(self.log_dir) if n.isdigit())
+        from hyperspace_tpu.io.files import list_dir
+
+        return sorted(int(n) for n in list_dir(self.log_dir, self.retry)
+                      if n.isdigit())

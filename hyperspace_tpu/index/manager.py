@@ -4,6 +4,18 @@ Reference contract: index/IndexManager.scala:24-116 (trait) and
 index/IndexCollectionManager.scala:28-170 — create/delete/restore/vacuum/
 refresh/optimize/cancel dispatch to Action instances over per-index log/data
 managers; ``get_indexes`` scans the system path for latest stable entries.
+
+Robustness beyond the reference:
+  - every dispatched action is armed with the optimistic transaction loop
+    (``hyperspace.index.concurrency.maxRetries``; actions/base.py) so a
+    concurrent-write conflict rebases and retries instead of aborting;
+  - ``get_indexes`` is the query path's one gateway to index metadata, so
+    DEGRADED MODE lives here: an index whose log is unreadable, torn past
+    recovery, or whose store is erroring is skipped (telemetry records an
+    IndexDegradedEvent) rather than breaking the query — the Hyperspace
+    contract that a damaged index only stops accelerating.  Disable the
+    fallback (``hyperspace.system.degraded.fallbackToSource=false``) to
+    get a strict DegradedIndexError instead.
 """
 
 from __future__ import annotations
@@ -11,7 +23,7 @@ from __future__ import annotations
 import os
 from typing import List, Optional
 
-from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.exceptions import DegradedIndexError, HyperspaceError
 from hyperspace_tpu.index.data_manager import IndexDataManager
 from hyperspace_tpu.index.index_config import IndexConfig
 from hyperspace_tpu.index.log_entry import IndexLogEntry, States
@@ -21,8 +33,8 @@ from hyperspace_tpu.index.path_resolver import PathResolver
 
 def _resolve_log_manager_class(name: str) -> type:
     """Conf-pluggable operation-log backend (the object-store seam:
-    stores without atomic rename plug a conditional-put IndexLogManager
-    subclass into ``hyperspace.index.logManagerClass``)."""
+    stores without atomic rename plug ObjectStoreLogManager — or their
+    own conditional-put subclass — into ``hyperspace.index.logManagerClass``)."""
     from hyperspace_tpu.utils.reflection import load_class
 
     return load_class(name, IndexLogManager, HyperspaceError)
@@ -32,6 +44,10 @@ class IndexCollectionManager:
     def __init__(self, session) -> None:
         self.session = session
         self.path_resolver = PathResolver(session.conf)
+        # True when the most recent get_indexes skipped at least one
+        # unreadable index — the caching subclass refuses to cache such a
+        # listing so a recovered store is picked up immediately.
+        self.last_listing_degraded: bool = False
 
     # -- manager plumbing (index/factories.scala:24-54) ---------------------
     def _log_manager(self, name: str) -> IndexLogManager:
@@ -40,9 +56,24 @@ class IndexCollectionManager:
         cls = _resolve_log_manager_class(self.session.conf.log_manager_class)
         mgr = cls(self.path_resolver.get_index_path(name))
         # Attribute, not constructor kwarg: pluggable subclasses keep the
-        # (index_path)-only __init__ contract.
+        # (index_path)-only __init__ contract; configure() is the richer
+        # post-construction conf hook (store class, staleness window).
         mgr.retry = policy_from_conf(self.session.conf)
+        mgr.configure(self.session.conf)
         return mgr
+
+    def _dispatch(self, action) -> None:
+        """Arm the optimistic transaction loop from session conf, then
+        run: a ConcurrentWriteError rebases + re-validates + retries with
+        jittered backoff up to ``hyperspace.index.concurrency.maxRetries``
+        times, composing with _maybe_recover's rollback (which already
+        ran before the action was built)."""
+        from hyperspace_tpu.utils.retry import policy_from_conf
+
+        action.concurrency_max_retries = int(
+            self.session.conf.concurrency_max_retries)
+        action.conflict_backoff = policy_from_conf(self.session.conf)
+        action.run()
 
     def _maybe_recover(self, name: str) -> None:
         """With ``hyperspace.index.autoRecovery.enabled``, roll a
@@ -58,7 +89,7 @@ class IndexCollectionManager:
         mgr = self._log_manager(name)
         latest = mgr.get_latest_log()
         if latest is not None and latest.state not in States.STABLE:
-            CancelAction(mgr).run()
+            self._dispatch(CancelAction(mgr))
 
     def _data_manager(self, name: str) -> IndexDataManager:
         return IndexDataManager(self.path_resolver.get_index_path(name))
@@ -72,32 +103,33 @@ class IndexCollectionManager:
         self._maybe_recover(config.index_name)
         action_cls = CreateDataSkippingAction \
             if isinstance(config, DataSkippingIndexConfig) else CreateAction
-        action_cls(self._log_manager(config.index_name),
-                   self._data_manager(config.index_name),
-                   self.session, dataset.plan, config).run()
+        self._dispatch(action_cls(self._log_manager(config.index_name),
+                                  self._data_manager(config.index_name),
+                                  self.session, dataset.plan, config))
 
     def delete(self, name: str) -> None:
         from hyperspace_tpu.actions.delete import DeleteAction
 
         self._maybe_recover(name)
-        DeleteAction(self._log_manager(name)).run()
+        self._dispatch(DeleteAction(self._log_manager(name)))
 
     def restore(self, name: str) -> None:
         from hyperspace_tpu.actions.restore import RestoreAction
 
         self._maybe_recover(name)
-        RestoreAction(self._log_manager(name)).run()
+        self._dispatch(RestoreAction(self._log_manager(name)))
 
     def vacuum(self, name: str) -> None:
         from hyperspace_tpu.actions.vacuum import VacuumAction
 
         self._maybe_recover(name)
-        VacuumAction(self._log_manager(name), self._data_manager(name)).run()
+        self._dispatch(VacuumAction(self._log_manager(name),
+                                    self._data_manager(name)))
 
     def cancel(self, name: str) -> None:
         from hyperspace_tpu.actions.cancel import CancelAction
 
-        CancelAction(self._log_manager(name)).run()
+        self._dispatch(CancelAction(self._log_manager(name)))
 
     def refresh(self, name: str, mode: str = "full") -> None:
         from hyperspace_tpu.actions.data_skipping import RefreshDataSkippingAction
@@ -119,8 +151,8 @@ class IndexCollectionManager:
         stable = self._log_manager(name).get_latest_stable_log()
         if stable is not None and not stable.is_covering and mode != "quick":
             cls = RefreshDataSkippingAction
-        cls(self._log_manager(name), self._data_manager(name), self.session,
-            previous=stable).run()
+        self._dispatch(cls(self._log_manager(name), self._data_manager(name),
+                           self.session, previous=stable))
 
     def optimize(self, name: str, mode: str = "quick") -> None:
         from hyperspace_tpu.actions.optimize import OptimizeAction
@@ -128,17 +160,56 @@ class IndexCollectionManager:
         if mode not in ("quick", "full"):
             raise HyperspaceError(f"Unknown optimize mode {mode!r}")
         self._maybe_recover(name)
-        OptimizeAction(self._log_manager(name), self._data_manager(name),
-                       self.session, mode).run()
+        self._dispatch(OptimizeAction(self._log_manager(name),
+                                      self._data_manager(name),
+                                      self.session, mode))
 
     # -- queries (IndexCollectionManager.scala:109-170) ---------------------
+    def _degrade(self, name: str, reason: str) -> None:
+        """Record (or, in strict mode, raise) one index's degradation."""
+        if not self.session.conf.degraded_fallback_to_source:
+            raise DegradedIndexError(
+                f"Index {name!r} is unreadable ({reason}) and "
+                "hyperspace.system.degraded.fallbackToSource is disabled")
+        self.last_listing_degraded = True
+        from hyperspace_tpu.telemetry.events import (
+            IndexDegradedEvent,
+            get_event_logger,
+        )
+
+        get_event_logger().log_event(IndexDegradedEvent(
+            index_name=name, reason=reason,
+            message=f"index {name!r} skipped: {reason}"))
+
     def get_indexes(self, states: Optional[List[str]] = None) -> List[IndexLogEntry]:
+        from hyperspace_tpu.io.files import list_dir
+
+        self.last_listing_degraded = False
         root = self.path_resolver.system_path
-        if not os.path.isdir(root):
-            return []
         out: List[IndexLogEntry] = []
-        for name in sorted(os.listdir(root)):
-            entry = self._log_manager(name).get_latest_stable_log()
+        try:
+            names = sorted(n for n in list_dir(root)
+                           if os.path.isdir(os.path.join(root, n)))
+        except OSError as e:
+            self._degrade("", f"system path listing failed: {e}")
+            return out
+        for name in names:
+            mgr = self._log_manager(name)
+            try:
+                entry = mgr.get_latest_stable_log()
+                if entry is None and mgr.log_ids() \
+                        and mgr.get_latest_log() is None:
+                    # Entries exist but NONE parses: torn past recovery
+                    # (an empty log or a mid-lifecycle transient state is
+                    # NOT corruption — those read as absent/unstable).
+                    self._degrade(name, "operation log torn past recovery")
+                    continue
+            except DegradedIndexError:
+                raise  # strict mode: _degrade already diagnosed it
+            except Exception as e:  # noqa: BLE001 — InjectedCrash is a
+                # BaseException and still propagates (a crash is a crash).
+                self._degrade(name, f"operation log unreadable: {e}")
+                continue
             if entry is not None and (states is None or entry.state in states):
                 out.append(entry)
         return out

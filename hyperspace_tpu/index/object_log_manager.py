@@ -1,0 +1,208 @@
+"""Operation-log manager for stores WITHOUT atomic rename.
+
+``IndexLogManager`` (the default) needs two POSIX gifts: ``O_EXCL``
+create-if-absent for numbered entries and atomic rename for the
+``latestStable`` pointer.  Object stores (GCS/S3) offer neither — what
+they offer instead is per-key generations and conditional puts, and this
+manager rebuilds the same protocol from those primitives, the way Delta
+Lake's log does (Armbrust et al., VLDB 2020):
+
+  - a numbered entry commits with ``put_if_absent`` — the same
+    exactly-one-winner arbitration, now server-side;
+  - ``latestStable`` is maintained by a **generation-CAS loop**: read
+    (pointer, generation), then ``put_if_generation_match``.  A lost CAS
+    re-reads; a pointer that already names a NEWER stable entry wins
+    outright (monotonic ids ⇒ no lost update, no ABA);
+  - listing may be stale (the store's visibility window), so
+    ``get_latest_id`` treats the listing as a hint and **probes forward
+    with point reads** — which are strongly consistent — until the first
+    miss.  Correctness never rests on listing freshness: a stale-derived
+    id collides at ``put_if_absent`` and the action layer's transaction
+    loop rebases and retries.
+
+Plugs into ``hyperspace.index.logManagerClass``; the store backend itself
+is a second seam (``hyperspace.index.logStoreClass``), so tests can run
+the identical protocol over :class:`PosixLogStore` and
+:class:`EmulatedObjectStore`.  The failure envelope matches the POSIX
+manager: transient store errors retry (``hyperspace.system.io.retry.*``),
+a torn put burns its id and is skipped by every reader, and the pointer
+is a cache — the numbered entries stay the truth.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import time
+from typing import List, Optional
+
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.index.log_entry import IndexLogEntry, States
+from hyperspace_tpu.index.log_manager import (
+    HYPERSPACE_LOG_DIR,
+    LATEST_STABLE,
+    IndexLogManager,
+)
+from hyperspace_tpu.io.log_store import EmulatedObjectStore, LogStore
+
+# Bound on CAS re-read loops: each iteration means a concurrent pointer
+# writer won an update in the read-CAS window; with monotonic-id yielding
+# the loop converges long before this (the bound only caps pathological
+# fault-injection storms).
+_CAS_ATTEMPTS = 16
+
+
+class ObjectStoreLogManager(IndexLogManager):
+    """IndexLogManager over a :class:`LogStore` (conditional puts, no
+    rename).  Keeps the ``(index_path)``-only constructor contract of the
+    ``logManagerClass`` seam; the collection manager pushes conf through
+    :meth:`configure` after construction."""
+
+    store_class: str = "hyperspace_tpu.io.log_store.EmulatedObjectStore"
+    stale_list_s: float = 0.0
+
+    def __init__(self, index_path: str) -> None:
+        super().__init__(index_path)
+        self._store: Optional[LogStore] = None
+
+    def configure(self, conf) -> None:
+        self.store_class = conf.log_store_class
+        self.stale_list_s = float(conf.object_store_stale_list_ms) / 1000.0
+
+    @property
+    def store(self) -> LogStore:
+        if self._store is None:
+            from hyperspace_tpu.utils.reflection import load_class
+
+            cls = load_class(self.store_class, LogStore, HyperspaceError)
+            self._store = cls(os.path.join(self.index_path,
+                                           HYPERSPACE_LOG_DIR),
+                              stale_list_s=self.stale_list_s)
+        return self._store
+
+    # -- reads --------------------------------------------------------------
+    def _parse(self, data: Optional[bytes]) -> Optional[IndexLogEntry]:
+        """None for absent AND for torn/corrupt payloads (a burned id)."""
+        if data is None:
+            return None
+        try:
+            return IndexLogEntry.from_dict(json.loads(data.decode("utf-8")))
+        except (ValueError, KeyError, UnicodeDecodeError):
+            return None
+
+    def get_log(self, log_id: int) -> Optional[IndexLogEntry]:
+        def attempt() -> Optional[IndexLogEntry]:
+            try:
+                return self._parse(self.store.read(str(log_id)))
+            except FileNotFoundError:
+                return None
+
+        return self.retry.call(attempt)
+
+    def _probe_past(self, latest: Optional[int]) -> Optional[int]:
+        """Walk point reads past ``latest`` until the first miss.  Ids are
+        contiguous (every writer commits at base+1/base+2 and collisions
+        rebase), except that the action protocol never writes id 0 — so an
+        empty hint probes both 0 and 1 before concluding the log is empty."""
+        starts = [0, 1] if latest is None else [latest + 1]
+        for start in starts:
+            probe = start
+            while self.store.exists(str(probe)):
+                latest = probe
+                probe += 1
+            if latest is not None:
+                break
+        return latest
+
+    def get_latest_id(self) -> Optional[int]:
+        """Listing as a hint, point reads as the truth: probe ids past the
+        listed maximum until the first miss, so a stale list can delay a
+        reader by at most one probe round — never yield a colliding id to
+        a writer (put_if_absent arbitrates regardless)."""
+        def attempt() -> Optional[int]:
+            ids = [int(k) for k in self.store.list_keys() if k.isdigit()]
+            return self._probe_past(max(ids) if ids else None)
+
+        return self.retry.call(attempt)
+
+    def get_latest_stable_log(self) -> Optional[IndexLogEntry]:
+        def read_pointer() -> Optional[IndexLogEntry]:
+            try:
+                return self._parse(self.store.read(LATEST_STABLE))
+            except FileNotFoundError:
+                return None
+
+        entry = self.retry.call(read_pointer)
+        if entry is not None and entry.state in States.STABLE:
+            return entry
+        latest = self.get_latest_id()
+        if latest is None:
+            return None
+        for log_id in range(latest, -1, -1):
+            entry = self.get_log(log_id)
+            if entry is not None and entry.state in States.STABLE:
+                return entry
+        return None
+
+    def log_ids(self) -> List[int]:
+        def attempt() -> List[int]:
+            ids = {int(k) for k in self.store.list_keys() if k.isdigit()}
+            # Same forward probe as get_latest_id: ids the stale listing
+            # hides are still discoverable by point reads.
+            latest = self._probe_past(max(ids) if ids else None)
+            if latest is not None:
+                ids.update(i for i in range(latest + 1)
+                           if i in ids or self.store.exists(str(i)))
+            return sorted(ids)
+
+        return self.retry.call(attempt)
+
+    # -- writes -------------------------------------------------------------
+    def write_log(self, log_id: int, entry: IndexLogEntry) -> bool:
+        entry.id = log_id
+        payload = json.dumps(entry.to_dict(), indent=2).encode("utf-8")
+
+        def attempt() -> bool:
+            return self.store.put_if_absent(str(log_id), payload)
+
+        return self.retry.call(attempt)
+
+    def create_latest_stable_log(self, log_id: int) -> bool:
+        """Point ``latestStable`` at entry ``log_id`` via generation-CAS.
+
+        Loop invariant: the pointer only ever moves to a stable entry
+        with an id ≥ its current one.  A racer that commits a newer
+        stable entry between our read and CAS makes our CAS fail; the
+        re-read then sees their pointer and we YIELD (ids are monotonic,
+        so "newer id wins" is exactly "no lost update")."""
+        try:
+            payload = self.retry.call(lambda: self.store.read(str(log_id)))
+        except FileNotFoundError:
+            return False
+        rng = random.Random()
+        for attempt in range(_CAS_ATTEMPTS):
+            cur, gen = self.retry.call(
+                lambda: self.store.read_with_generation(LATEST_STABLE))
+            cur_entry = self._parse(cur)
+            if cur_entry is not None and cur_entry.state in States.STABLE \
+                    and (cur_entry.id or 0) >= log_id:
+                return True  # a newer stable pointer already won
+            # A torn/corrupt pointer (cur_entry None with gen > 0) is
+            # OVERWRITTEN here — the generation check still makes the
+            # overwrite race-safe.
+            if self.retry.call(lambda: self.store.put_if_generation_match(
+                    LATEST_STABLE, payload, gen)):
+                return True
+            time.sleep(self.retry.delay_s(min(attempt, 4), rng))
+        # Pointer update lost a pathological storm: the pointer is only a
+        # cache, get_latest_stable_log's reverse scan stays correct.
+        return False
+
+    def delete_latest_stable_log(self) -> bool:
+        """No-op by design: every caller (Action.end, cancel) immediately
+        recreates the pointer, and the CAS overwrite in
+        create_latest_stable_log subsumes delete+create WITHOUT the
+        pointer-absent window a rename-less store could not close
+        atomically."""
+        return True

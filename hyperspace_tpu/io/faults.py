@@ -26,6 +26,15 @@ Sites (grep for ``faults.check`` / ``faults.write_payload``):
                           (io/parquet.write_bucket_run)
 ``action.commit``         between an action's op() and end() — work done,
                           final entry not yet committed (actions/base.run)
+``io.list``               a directory/prefix listing (io/files.list_data_files,
+                          list_dir — log discovery routes through the latter)
+``data.read``             a single source/index data-file read
+                          (io/parquet.read_parquet_file and friends)
+``store.put``             a LogStore conditional put (io/log_store.py;
+                          ``torn`` COMMITS half the payload, then dies)
+``store.read``            a LogStore point read / generation probe
+``store.list``            a LogStore key listing
+``store.delete``          a LogStore delete
 ========================  ====================================================
 
 Kinds:
@@ -149,6 +158,20 @@ def check(site: str) -> None:
     if plan is None or not plan._should_fire(site):
         return
     plan._raise()
+
+
+def fire(site: str) -> Optional[str]:
+    """Like :func:`check`, but a ``torn`` fault RETURNS ``"torn"`` instead
+    of raising, so backends whose commit is atomic (conditional-put
+    stores) can decide for themselves what a torn upload leaves behind;
+    every other kind raises here."""
+    plan = _PLAN
+    if plan is None or not plan._should_fire(site):
+        return None
+    if plan.kind == "torn":
+        return "torn"
+    plan._raise()
+    return None  # unreachable; keeps the signature honest
 
 
 def write_payload(f, data: bytes, site: str) -> None:

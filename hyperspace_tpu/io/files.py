@@ -18,6 +18,28 @@ from hyperspace_tpu.utils.paths import is_data_file, normalize_path
 _GLOB_CHARS = ("*", "?", "[")
 
 
+def list_dir(path: str, retry=None) -> List[str]:
+    """``os.listdir`` behind the ``io.list`` fault site with bounded
+    transient-IO retry — the single listing primitive for METADATA
+    discovery (operation-log ids, system-path index names), so
+    ``hyperspace.system.io.retry.*`` and injected listing faults cover
+    log discovery exactly like data listing.  Missing directories read
+    as empty (every caller treated ENOENT that way already)."""
+    from hyperspace_tpu.io import faults
+    from hyperspace_tpu.utils.retry import RetryPolicy
+
+    policy = retry if retry is not None else RetryPolicy()
+
+    def attempt() -> List[str]:
+        faults.check("io.list")
+        try:
+            return os.listdir(path)
+        except (FileNotFoundError, NotADirectoryError):
+            return []
+
+    return policy.call(attempt)
+
+
 def expand_globs(root_paths: Sequence[str]) -> List[str]:
     """Expand glob patterns among ``root_paths`` (sorted matches); plain
     paths pass through.  Globbing patterns let an index cover directories

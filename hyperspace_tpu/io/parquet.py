@@ -79,13 +79,18 @@ def read_table(paths: Sequence[str], file_format: str = "parquet",
             # Context-managed so the fd closes deterministically — a wide
             # scan through the shared pool must not hold descriptors until
             # GC runs.
-            with pq.ParquetFile(path) as pf:
-                present = set(pf.schema_arrow.names)
-                file_spec = {k: t for k, t in spec.items() if k not in present}
-                if columns is not None:
-                    cols = [c for c in columns if c not in file_spec]
-                t = pf.read(columns=None if cols is None
-                            else [c for c in cols if c in present])
+            def _read_with_spec():
+                with pq.ParquetFile(path) as pf:
+                    present = set(pf.schema_arrow.names)
+                    fspec = {k: t for k, t in spec.items()
+                             if k not in present}
+                    fcols = cols if cols is None \
+                        else [c for c in columns if c not in fspec]
+                    return fspec, pf.read(
+                        columns=None if fcols is None
+                        else [c for c in fcols if c in present])
+
+            file_spec, t = _read_retry(_read_with_spec)
         else:
             t = _read_one(path, file_format, cols, options or {})
         if file_spec:
@@ -99,17 +104,45 @@ def read_table(paths: Sequence[str], file_format: str = "parquet",
     return pa.concat_tables(tables, promote_options="default")
 
 
+def _read_retry(fn):
+    """Single-file READ primitive wrapper: the ``data.read`` fault site
+    plus bounded transient-IO retry (the write side has had this since
+    PR 1 — a flaky mount mid-query deserves the same envelope as one
+    mid-build).  Disarmed cost: one None check per FILE, never per row."""
+    from hyperspace_tpu.io import faults
+    from hyperspace_tpu.utils.retry import RetryPolicy
+
+    def attempt():
+        faults.check("data.read")
+        return fn()
+
+    return RetryPolicy().call(attempt)
+
+
 def read_parquet_file(path: str, columns=None) -> pa.Table:
     """One parquet FILE, exactly its own columns.  ``partitioning=None``
     matters: newer pyarrow (observed at 22.0) hive-infers partition
     columns from the file's OWN path segments, so reading an index file
     under ``v__=N/`` would grow a phantom ``v__`` column — corrupting
     optimize compaction, sketches, and schema checks.  Every
-    single-file read in the engine goes through here."""
-    return pq.read_table(path, columns=columns, partitioning=None)
+    single-file read in the engine goes through here (and through the
+    ``data.read`` fault site + transient retry)."""
+    return _read_retry(
+        lambda: pq.read_table(path, columns=columns, partitioning=None))
 
 
 def _read_one(path: str, file_format: str, columns, options: Dict[str, str]) -> pa.Table:
+    if file_format != "parquet":
+        # Parquet delegates to read_parquet_file (already wrapped); every
+        # other format wraps here so each single-file read counts exactly
+        # one data.read site call.
+        return _read_retry(
+            lambda: _read_one_raw(path, file_format, columns, options))
+    return _read_one_raw(path, file_format, columns, options)
+
+
+def _read_one_raw(path: str, file_format: str, columns,
+                  options: Dict[str, str]) -> pa.Table:
     if file_format == "parquet":
         # columns=[] is meaningful: read NO data columns but keep the row
         # count (a projection of partition-only columns).
@@ -174,7 +207,7 @@ def read_schema(path: str, file_format: str = "parquet",
                 options: Optional[Dict[str, str]] = None) -> Dict[str, str]:
     """Column name → arrow dtype string for one file."""
     if file_format == "parquet":
-        schema = pq.read_schema(path)
+        schema = _read_retry(lambda: pq.read_schema(path))
         return {f.name: str(f.type) for f in schema}
     if file_format == "orc":
         import pyarrow.orc as paorc

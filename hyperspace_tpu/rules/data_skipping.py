@@ -515,9 +515,16 @@ def prune_index_files_by_sketch(entry: IndexLogEntry, condition: Expr
         if not os.path.isfile(sketch_path):
             surviving.extend(fs)
             continue
+        try:
+            sketch_rows = _load_index_sketch(sketch_path)
+        except Exception:  # noqa: BLE001 — a corrupt/unreadable sketch
+            # (torn write, erroring store) must never fail the query;
+            # pruning is an optimization, keeping every file is always
+            # sound.  InjectedCrash (BaseException) still propagates.
+            surviving.extend(fs)
+            continue
         any_sketch = True
-        by_name = {r[SKETCH_FILE_NAME]: r
-                   for r in _load_index_sketch(sketch_path)}
+        by_name = {r[SKETCH_FILE_NAME]: r for r in sketch_rows}
         for f in fs:
             row = by_name.get(f)
             if row is None:

@@ -211,7 +211,8 @@ class HyperspaceSession:
 
         return CachingIndexCollectionManager(self)
 
-    def optimize(self, plan: LogicalPlan) -> LogicalPlan:
+    def optimize(self, plan: LogicalPlan,
+                 use_indexes: bool = True) -> LogicalPlan:
         """Apply the rewrite rules if enabled — Join before Filter, the fixed
         order with the rationale in package.scala:25-35.  ACTIVE entries are
         loaded once and shared across both rules so per-scan signature
@@ -237,9 +238,10 @@ class HyperspaceSession:
 
         plan = rewrite_subqueries(plan, self)
         with self._optimize_lock:
-            return self._optimize_locked(plan)
+            return self._optimize_locked(plan, use_indexes)
 
-    def _optimize_locked(self, plan: LogicalPlan) -> LogicalPlan:
+    def _optimize_locked(self, plan: LogicalPlan,
+                         use_indexes: bool = True) -> LogicalPlan:
         from hyperspace_tpu.plan.pruning import prune_columns
 
         # Save/restore instead of set/None: subquery folding re-enters
@@ -264,7 +266,11 @@ class HyperspaceSession:
 
             plan = canonicalize_temporal(plan, self.schema_map_of)
             plan = prune_columns(plan, self.schema_of)
-            if not self._hyperspace_enabled:
+            # ``use_indexes=False`` is the degraded re-plan channel
+            # (Dataset.collect's execution fallback): same normalization,
+            # no index rewrites — WITHOUT flipping the session-global
+            # enable switch under concurrent queries.
+            if not self._hyperspace_enabled or not use_indexes:
                 return plan
             from hyperspace_tpu.index.log_entry import States
             from hyperspace_tpu.rules.filter_rule import FilterIndexRule
@@ -276,18 +282,23 @@ class HyperspaceSession:
             # pass clean.
             for e in entries:
                 e._tags.clear()
-            plan = JoinIndexRule(self, entries).apply(plan)
-            plan = FilterIndexRule(self, entries).apply(plan)
+            plan = self._apply_rule_degradable(
+                "JoinIndexRule", JoinIndexRule(self, entries).apply, plan)
+            plan = self._apply_rule_degradable(
+                "FilterIndexRule", FilterIndexRule(self, entries).apply, plan)
             # Filters above join-rewritten index scans still prune buckets
             # (rules/bucket_prune.py).
             from hyperspace_tpu.rules.bucket_prune import BucketPruneRule
 
-            plan = BucketPruneRule(self, entries).apply(plan)
+            plan = self._apply_rule_degradable(
+                "BucketPruneRule", BucketPruneRule(self, entries).apply, plan)
             # Data skipping last: a covering rewrite beats file pruning, and
             # the rule skips scans the other rules already rewrote.
             from hyperspace_tpu.rules.data_skipping import DataSkippingFilterRule
 
-            plan = DataSkippingFilterRule(self, entries).apply(plan)
+            plan = self._apply_rule_degradable(
+                "DataSkippingFilterRule",
+                DataSkippingFilterRule(self, entries).apply, plan)
             # The rules rebuild rewritten sides in Filter-above-Project
             # form; one more pushdown + prune reaches the same normal
             # form a second optimize() would — keeping optimize
@@ -297,6 +308,33 @@ class HyperspaceSession:
             return plan
         finally:
             self._lake_schema_memo = prev_memo
+
+    def _apply_rule_degradable(self, rule_name: str, apply_fn,
+                               plan: LogicalPlan) -> LogicalPlan:
+        """Degraded-mode boundary for one rewrite rule: a rule that dies
+        reading index metadata/sketches (erroring store, corrupt files)
+        must cost the query its acceleration, never its answer — the plan
+        is returned un-rewritten and telemetry records the degradation
+        (``hyperspace.system.degraded.fallbackToSource``; strict mode
+        re-raises).  InjectedCrash is a BaseException and still
+        propagates: a simulated process death is not a fallback."""
+        try:
+            return apply_fn(plan)
+        except Exception as e:  # noqa: BLE001 — the contract is "any
+            # index-side failure degrades"; source-side failures surface
+            # again when the fallback plan executes the source scan.
+            if not self.conf.degraded_fallback_to_source:
+                raise
+            from hyperspace_tpu.telemetry.events import (
+                IndexDegradedEvent,
+                get_event_logger,
+            )
+
+            get_event_logger().log_event(IndexDegradedEvent(
+                reason=f"{rule_name} failed: {e!r}",
+                message=f"{rule_name} skipped; query answers from the "
+                        "source scan"))
+            return plan
 
 
 def _uniquify(plan: LogicalPlan) -> LogicalPlan:

@@ -23,6 +23,8 @@ import re
 import urllib.parse
 from typing import Any, Dict, List, Optional
 
+from hyperspace_tpu.exceptions import CorruptMetadataError
+
 DELTA_LOG_DIR = "_delta_log"
 _COMMIT_RE = re.compile(r"^(\d{20})\.json$")
 _CHECKPOINT_RE = re.compile(r"^(\d{20})\.checkpoint\.parquet$")
@@ -205,19 +207,32 @@ class DeltaLog:
         path = self._commit_path(version)
         out: List[Dict[str, Any]] = []
         with open(path, "r", encoding="utf-8") as f:
-            for line in f:
+            for lineno, line in enumerate(f, start=1):
                 line = line.strip()
-                if line:
+                if not line:
+                    continue
+                try:
                     out.append(json.loads(line))
+                except ValueError as e:
+                    # A truncated/torn commit (writer died mid-append, or a
+                    # partial object-store upload) must name the bad file —
+                    # a bare JSONDecodeError is undebuggable at lake scale.
+                    raise CorruptMetadataError(
+                        f"Truncated or corrupt Delta log entry {path!r} "
+                        f"(action line {lineno}): {e}") from e
         return out
 
     def _read_checkpoint(self, version: int):
-        import pyarrow.parquet as pq
+        import pyarrow as pa
 
         path = os.path.join(self.log_path, f"{version:020d}.checkpoint.parquet")
         from hyperspace_tpu.io.parquet import read_parquet_file
 
-        table = read_parquet_file(path)
+        try:
+            table = read_parquet_file(path)
+        except pa.ArrowInvalid as e:
+            raise CorruptMetadataError(
+                f"Truncated or corrupt Delta checkpoint {path!r}: {e}") from e
         metadata = DeltaMetadata()
         active: Dict[str, AddFile] = {}
         tombstones: Dict[str, RemoveFile] = {}

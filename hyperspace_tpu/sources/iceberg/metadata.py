@@ -22,6 +22,7 @@ import os
 import re
 from typing import Any, Dict, List, Optional
 
+from hyperspace_tpu.exceptions import CorruptMetadataError
 from hyperspace_tpu.io import avro
 
 METADATA_DIR = "metadata"
@@ -167,7 +168,14 @@ class IcebergTable:
             version = self.latest_metadata_version()
         path = os.path.join(self.metadata_path, f"v{version}.metadata.json")
         with open(path, "r", encoding="utf-8") as f:
-            raw = json.load(f)
+            try:
+                raw = json.load(f)
+            except ValueError as e:
+                # A truncated metadata JSON (torn upload, partial copy)
+                # must name the bad file, not surface a bare decode error.
+                raise CorruptMetadataError(
+                    f"Truncated or corrupt Iceberg metadata {path!r}: "
+                    f"{e}") from e
         snapshots = [
             IcebergSnapshot(
                 snapshot_id=int(s["snapshot-id"]),
@@ -214,9 +222,10 @@ class IcebergTable:
         if snapshot is None:
             return []
         out: List[DataFile] = []
-        for mf in avro.read_container(snapshot.manifest_list):
+        for mf in self._read_manifest_avro(snapshot.manifest_list,
+                                           "manifest list"):
             manifest_path = self._absolute(mf["manifest_path"])
-            for entry in avro.read_container(manifest_path):
+            for entry in self._read_manifest_avro(manifest_path, "manifest"):
                 if entry["status"] == STATUS_DELETED:
                     continue
                 df = entry["data_file"]
@@ -224,6 +233,18 @@ class IcebergTable:
                                     int(df["file_size_in_bytes"]),
                                     int(df["record_count"])))
         return sorted(out, key=lambda f: f.path)
+
+    @staticmethod
+    def _read_manifest_avro(path: str, kind: str):
+        """Avro container read with a torn-file diagnostic: a truncated
+        manifest (the io/avro reader raises EOFError/ValueError/KeyError
+        mid-decode) names the file and its role instead of surfacing a
+        low-level decode error."""
+        try:
+            return avro.read_container(path)
+        except (ValueError, KeyError, EOFError, IndexError, TypeError) as e:
+            raise CorruptMetadataError(
+                f"Truncated or corrupt Iceberg {kind} {path!r}: {e}") from e
 
     def _absolute(self, path: str) -> str:
         if os.path.isabs(path):

@@ -71,6 +71,19 @@ class OptimizeActionEvent(_IndexActionEvent):
 
 
 @dataclasses.dataclass
+class IndexDegradedEvent(HyperspaceEvent):
+    """An index was SKIPPED at query time because its operation log is
+    unreadable, torn past recovery, or the backing store is erroring —
+    the query fell back to the source scan instead of raising
+    (``hyperspace.system.degraded.fallbackToSource``).  The Hyperspace
+    contract: a broken index may stop accelerating a query, never break
+    it."""
+
+    index_name: str = ""
+    reason: str = ""
+
+
+@dataclasses.dataclass
 class HyperspaceIndexUsageEvent(HyperspaceEvent):
     """Emitted when a rule rewrites a query to use indexes
     (HyperspaceEvent.scala:150-156)."""

@@ -18,12 +18,21 @@ import pyarrow.parquet as pq
 import pytest
 
 
+def _make_log_manager(kind: str, index_path: str):
+    if kind == "posix":
+        from hyperspace_tpu.index.log_manager import IndexLogManager
+
+        return IndexLogManager(index_path)
+    from hyperspace_tpu.index.object_log_manager import ObjectStoreLogManager
+
+    return ObjectStoreLogManager(index_path)
+
+
 def _race_write_log(args):
-    index_path, worker = args
-    from hyperspace_tpu.index.log_manager import IndexLogManager
+    index_path, worker, kind = args
     from tests.utils import sample_entry
 
-    mgr = IndexLogManager(index_path)
+    mgr = _make_log_manager(kind, index_path)
     entry = sample_entry(name=f"w{worker}")
     entry.id = 5
     try:
@@ -31,6 +40,12 @@ def _race_write_log(args):
         return ("win", worker)
     except Exception as e:
         return ("lose", type(e).__name__)
+
+
+def _race_cas_pointer(args):
+    index_path, log_id = args
+    mgr = _make_log_manager("objstore", index_path)
+    return mgr.create_latest_stable_log(log_id)
 
 
 def _race_create_index(args):
@@ -53,20 +68,45 @@ def _race_create_index(args):
         return ("lose", type(e).__name__)
 
 
-def test_write_log_same_id_across_processes(tmp_path):
+@pytest.mark.parametrize("kind", ["posix", "objstore"])
+def test_write_log_same_id_across_processes(tmp_path, kind):
+    """Exactly-one-winner for a contended log id — across real OS
+    processes, for BOTH backends: POSIX O_EXCL and the object store's
+    conditional put (flock-serialized in the emulation)."""
     index_path = str(tmp_path / "idx")
     os.makedirs(index_path)
     ctx = mp.get_context("spawn")
     with ctx.Pool(4) as pool:
         results = pool.map(_race_write_log,
-                           [(index_path, i) for i in range(8)])
+                           [(index_path, i, kind) for i in range(8)])
     wins = [r for r in results if r[0] == "win"]
     assert len(wins) == 1, results
     # The surviving record is intact and parseable.
-    from hyperspace_tpu.index.log_manager import IndexLogManager
-
-    entry = IndexLogManager(index_path).get_log(5)
+    entry = _make_log_manager(kind, index_path).get_log(5)
     assert entry is not None and entry.id == 5
+
+
+def test_cas_pointer_storm_across_processes(tmp_path):
+    """Cross-process latestStable CAS storm over the emulated object
+    store: 8 processes race the pointer toward different stable ids —
+    no lost update means the final pointer is the MAXIMUM id, and it
+    always parses to a stable entry."""
+    index_path = str(tmp_path / "idx")
+    os.makedirs(index_path)
+    from tests.utils import sample_entry
+
+    mgr = _make_log_manager("objstore", index_path)
+    for i in range(1, 9):
+        from hyperspace_tpu.index.log_entry import States
+
+        assert mgr.write_log(i, sample_entry(state=States.ACTIVE))
+    ctx = mp.get_context("spawn")
+    with ctx.Pool(4) as pool:
+        results = pool.map(_race_cas_pointer,
+                           [(index_path, i) for i in range(1, 9)])
+    assert all(results), results  # every racer converged (won or yielded)
+    resolved = mgr.get_latest_stable_log()
+    assert resolved is not None and resolved.id == 8
 
 
 def test_create_index_race_one_winner(tmp_path):
@@ -271,6 +311,247 @@ class TestCrashRecovery:
         faults.clear()
         # The torn begin entry reads as absent; the index never existed.
         assert s.index_collection_manager.get_index("ct") is None
+
+
+class TestConflictRetry:
+    """The optimistic transaction loop (actions/base.py): a
+    ConcurrentWriteError rebases against the winner's committed state,
+    re-validates, and retries — instead of aborting the whole action."""
+
+    def _env(self, tmp_path):
+        from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig
+
+        d = str(tmp_path / "data")
+        os.makedirs(d, exist_ok=True)
+        self._add(d, "p.parquet", 0, 100)
+        s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+        s.conf.num_buckets = 2
+        hs = Hyperspace(s)
+        hs.create_index(s.read.parquet(d), IndexConfig("rr", ["k"], ["v"]))
+        return s, hs, d
+
+    @staticmethod
+    def _add(d, name, lo, hi):
+        pq.write_table(pa.table({
+            "k": pa.array(np.arange(lo, hi, dtype=np.int64)),
+            "v": pa.array(np.arange(lo, hi) * 1.0),
+        }), os.path.join(d, name))
+
+    def test_racing_refresh_retries_and_commits(self, tmp_path):
+        """Two refreshes from the same base: the loser conflicts at
+        begin(), rebases onto the winner's stable entry, re-validates
+        (its own delta is still unindexed) and COMMITS — both writers'
+        rows end up queryable, log ids stay contiguous."""
+        from hyperspace_tpu import col
+        from hyperspace_tpu.actions.refresh import RefreshIncrementalAction
+
+        s, hs, d = self._env(tmp_path)
+        api = s.index_collection_manager
+        self._add(d, "p2.parquet", 100, 150)
+        # R2 captures its base BEFORE the winner commits.
+        r2 = RefreshIncrementalAction(api._log_manager("rr"),
+                                      api._data_manager("rr"), s)
+        r2.concurrency_max_retries = 3
+        hs.refresh_index("rr", mode="incremental")       # the winner
+        self._add(d, "p3.parquet", 150, 180)             # R2's own delta
+        r2.run()
+        assert r2.conflict_retries == 1
+        ids = api._log_manager("rr").log_ids()
+        assert ids == list(range(1, len(ids) + 1)), ids  # contiguous
+        entry = api.get_index("rr")
+        assert entry is not None and entry.state == "ACTIVE"
+        s.enable_hyperspace()
+        for k, v in ((120, 120.0), (170, 170.0)):
+            out = (s.read.parquet(d).filter(col("k") == k)
+                   .select("k", "v").collect())
+            assert out.column("v").to_pylist() == [v]
+        assert any(x["is_index"] for x in s.last_execution_stats["scans"])
+
+    def test_racing_refresh_with_no_own_delta_noops(self, tmp_path):
+        """The loser whose work the winner already did exits through the
+        NoChangesError no-op path — success, no duplicate commit."""
+        from hyperspace_tpu.actions.refresh import RefreshIncrementalAction
+
+        s, hs, d = self._env(tmp_path)
+        api = s.index_collection_manager
+        self._add(d, "p2.parquet", 100, 150)
+        r2 = RefreshIncrementalAction(api._log_manager("rr"),
+                                      api._data_manager("rr"), s)
+        r2.concurrency_max_retries = 3
+        hs.refresh_index("rr", mode="incremental")  # winner covers p2
+        before = api._log_manager("rr").log_ids()
+        r2.run()                                    # conflict -> rebase -> no-op
+        assert r2.conflict_retries == 1
+        assert api._log_manager("rr").log_ids() == before
+
+    def test_exhausted_retries_still_raise(self, tmp_path):
+        """maxRetries=0 (or a storm outlasting the budget) preserves the
+        reference abort: ConcurrentWriteError surfaces."""
+        from hyperspace_tpu.actions.refresh import RefreshIncrementalAction
+        from hyperspace_tpu.exceptions import ConcurrentWriteError
+
+        s, hs, d = self._env(tmp_path)
+        api = s.index_collection_manager
+        self._add(d, "p2.parquet", 100, 150)
+        r2 = RefreshIncrementalAction(api._log_manager("rr"),
+                                      api._data_manager("rr"), s)
+        assert r2.concurrency_max_retries == 0  # direct construction
+        hs.refresh_index("rr", mode="incremental")
+        self._add(d, "p3.parquet", 150, 180)
+        with pytest.raises(ConcurrentWriteError):
+            r2.run()
+
+    def test_dispatched_actions_inherit_conf_budget(self, tmp_path):
+        import unittest.mock as mock
+
+        from hyperspace_tpu.index.manager import IndexCollectionManager
+
+        s, hs, d = self._env(tmp_path)
+        s.conf.set("hyperspace.index.concurrency.maxRetries", 7)
+        captured = {}
+        real_dispatch = IndexCollectionManager._dispatch
+
+        def spy(self, action):
+            real_dispatch(self, action)
+            captured["retries"] = action.concurrency_max_retries
+
+        with mock.patch.object(IndexCollectionManager, "_dispatch", spy):
+            hs.delete_index("rr")
+        assert captured["retries"] == 7
+
+
+def _stress_worker(args):
+    """One racer in the create/refresh/optimize storm: its own session,
+    the object-store log backend, conf-armed fault injection, conflict
+    retries + autoRecovery on.  Returns (worker, [(op, outcome), ...]) —
+    the parent asserts invariants, not a fixed schedule."""
+    root, worker, fault = args
+    os.environ["HS_DEVICE_BATCH_ROWS"] = "1024"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from hyperspace_tpu import Hyperspace, HyperspaceConf, HyperspaceSession, IndexConfig
+    from hyperspace_tpu.exceptions import ConcurrentWriteError, HyperspaceError
+
+    conf = HyperspaceConf()
+    conf.num_buckets = 2
+    conf.parallel_build = "off"
+    conf.auto_recovery_enabled = True
+    conf.log_manager_class = (
+        "hyperspace_tpu.index.object_log_manager.ObjectStoreLogManager")
+    conf.set("hyperspace.system.objectStore.staleListMs", 50)
+    if fault is not None:
+        site, kind, at = fault
+        conf.set("hyperspace.system.faultInjection.enabled", True)
+        conf.set("hyperspace.system.faultInjection.site", site)
+        conf.set("hyperspace.system.faultInjection.kind", kind)
+        conf.set("hyperspace.system.faultInjection.at", at)
+        conf.set("hyperspace.system.faultInjection.count", 1)
+    s = HyperspaceSession(system_path=os.path.join(root, "ix"), conf=conf)
+    hs = Hyperspace(s)
+    d = os.path.join(root, "data")
+    outcomes = []
+
+    def attempt(op, fn):
+        from hyperspace_tpu.io import faults as _faults
+
+        try:
+            fn()
+            outcomes.append((op, "ok"))
+        except ConcurrentWriteError:
+            outcomes.append((op, "conflict"))
+        except HyperspaceError as e:
+            outcomes.append((op, f"refused:{type(e).__name__}"))
+        except _faults.InjectedCrash:
+            outcomes.append((op, "crashed"))
+        except BaseException as e:  # noqa: BLE001
+            outcomes.append((op, f"error:{type(e).__name__}:{e}"))
+
+    attempt("create", lambda: hs.create_index(
+        s.read.parquet(d), IndexConfig("storm", ["k"], ["v"])))
+    # Each worker contributes its own delta, then races refresh+optimize.
+    pq.write_table(pa.table({
+        "k": pa.array(np.arange(1000 + worker * 10,
+                                1010 + worker * 10, dtype=np.int64)),
+        "v": pa.array(np.arange(10) * 1.0),
+    }), os.path.join(d, f"w{worker}.parquet"))
+    attempt("refresh", lambda: hs.refresh_index("storm", mode="incremental"))
+    attempt("optimize", lambda: hs.optimize_index("storm"))
+    return (worker, outcomes)
+
+
+def test_multiprocess_stress_objectstore_with_faults(tmp_path):
+    """ISSUE-2 acceptance: race create/refresh/optimize across processes
+    through EmulatedObjectStore (stale listing armed) with injected
+    faults, then assert the log's global invariants:
+
+      - collision-free CONTIGUOUS ids (no lost update, no gaps),
+      - latestStable resolves to a parseable STABLE entry,
+      - every aborted writer either retried to success or left a state
+        autoRecovery rolls back (proved by a final recovering refresh),
+      - the index answers queries correctly afterwards — and covers
+        every delta a successful refresh committed."""
+    root = str(tmp_path)
+    d = os.path.join(root, "data")
+    os.makedirs(d)
+    pq.write_table(pa.table({
+        "k": pa.array(np.arange(200, dtype=np.int64)),
+        "v": pa.array(np.arange(200) * 1.0),
+    }), os.path.join(d, "p.parquet"))
+    faults_by_worker = [
+        None,                          # clean writer
+        ("store.put", "eio", 2),       # transient store error mid-storm
+        ("store.put", "torn", 3),      # killed mid-put: burned id + corpse
+    ]
+    ctx = mp.get_context("spawn")
+    with ctx.Pool(3) as pool:
+        results = pool.map(_stress_worker,
+                           [(root, i, faults_by_worker[i]) for i in range(3)])
+    outcomes = {w: dict(ops) for w, ops in results}
+    # AT MOST one create committed (put_if_absent arbitrates); zero means
+    # the winner was the crash-injected worker — its corpse is what the
+    # recovery pass below must roll back.  Every loser failed CLEANLY.
+    create_wins = [w for w, o in outcomes.items() if o["create"] == "ok"]
+    assert len(create_wins) <= 1, outcomes
+    for w, o in outcomes.items():
+        for op, res in o.items():
+            assert res.split(":")[0] in ("ok", "conflict", "refused",
+                                         "crashed"), (w, op, res, outcomes)
+
+    # Post-storm invariants, read through the same backend.
+    from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
+    from hyperspace_tpu.index.log_entry import States
+
+    s = HyperspaceSession(system_path=os.path.join(root, "ix"))
+    s.conf.num_buckets = 2
+    s.conf.log_manager_class = (
+        "hyperspace_tpu.index.object_log_manager.ObjectStoreLogManager")
+    s.conf.auto_recovery_enabled = True
+    mgr = s.index_collection_manager._log_manager("storm")
+    ids = mgr.log_ids()
+    assert ids == list(range(1, len(ids) + 1)), ids  # contiguous, no gaps
+    # latestStable NEVER resolves to garbage or a transient state — at
+    # worst it is absent (the create winner died before ACTIVE).
+    stable = mgr.get_latest_stable_log()
+    assert stable is None or stable.state in States.STABLE
+    # Final recovering pass: auto-recovery rolls back any crashed writer's
+    # transient corpse, then create/refresh converges on every data file.
+    hs = Hyperspace(s)
+    if stable is None or stable.state != States.ACTIVE:
+        hs.create_index(s.read.parquet(d),
+                        IndexConfig("storm", ["k"], ["v"]))
+    else:
+        hs.refresh_index("storm", mode="incremental")  # no-op if converged
+    entry = s.index_collection_manager.get_index("storm")
+    assert entry is not None and entry.state == States.ACTIVE
+    s.enable_hyperspace()
+    # Every worker's delta answers identically with and without the index.
+    for w in range(3):
+        k = 1000 + w * 10 + 5
+        out = (s.read.parquet(d).filter(col("k") == k)
+               .select("k", "v").collect())
+        assert out.column("v").to_pylist() == [5.0], (w, out)
+    assert any(x["is_index"] for x in s.last_execution_stats["scans"])
 
 
 def test_lake_schema_memo_is_thread_local(tmp_path):

@@ -1,0 +1,152 @@
+"""Degraded-mode querying: a corrupted/unreadable index must never break
+a query — only stop accelerating it (the Hyperspace availability
+contract; ``hyperspace.system.degraded.fallbackToSource``).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
+from hyperspace_tpu.exceptions import DegradedIndexError
+from hyperspace_tpu.telemetry.events import (
+    CollectingEventLogger,
+    IndexDegradedEvent,
+    set_event_logger,
+)
+
+
+@pytest.fixture()
+def accelerated(tmp_path):
+    """An index over a small parquet dir, verified to accelerate a filter."""
+    d = str(tmp_path / "data")
+    os.makedirs(d)
+    pq.write_table(pa.table({"k": pa.array(np.arange(200, dtype=np.int64)),
+                             "v": pa.array(np.arange(200) * 2.0)}),
+                   os.path.join(d, "p.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    s.conf.num_buckets = 2
+    hs = Hyperspace(s)
+    hs.create_index(s.read.parquet(d), IndexConfig("dg", ["k"], ["v"]))
+    s.enable_hyperspace()
+    out = s.read.parquet(d).filter(col("k") == 7).select("k", "v").collect()
+    assert out.column("v").to_pylist() == [14.0]
+    assert any(x["is_index"] for x in s.last_execution_stats["scans"])
+    yield s, d, str(tmp_path / "ix")
+    set_event_logger(None)
+
+
+def _corrupt_log(ix_root: str, name: str) -> None:
+    for f in glob.glob(os.path.join(ix_root, name, "_hyperspace_log", "*")):
+        with open(f, "w", encoding="utf-8") as fh:
+            fh.write('{"torn')
+
+
+def test_corrupt_log_falls_back_to_source_scan(accelerated):
+    s, d, ix = accelerated
+    _corrupt_log(ix, "dg")
+    s.index_collection_manager.clear_cache()
+    log = CollectingEventLogger()
+    set_event_logger(log)
+    out = s.read.parquet(d).filter(col("k") == 7).select("k", "v").collect()
+    # Correct answer, via the SOURCE scan, with telemetry recording why.
+    assert out.column("v").to_pylist() == [14.0]
+    assert not any(x["is_index"] for x in s.last_execution_stats["scans"])
+    degraded = [e for e in log.events if isinstance(e, IndexDegradedEvent)]
+    assert degraded and degraded[0].index_name == "dg"
+    assert "torn past recovery" in degraded[0].reason
+
+
+def test_corrupt_log_join_falls_back(accelerated):
+    """A join whose side was index-accelerated still answers correctly."""
+    s, d, ix = accelerated
+    baseline = (s.read.parquet(d).filter(col("k") < 5)
+                .join(s.read.parquet(d), col("k") == col("k"))
+                .select("k", "v").collect())
+    _corrupt_log(ix, "dg")
+    s.index_collection_manager.clear_cache()
+    set_event_logger(CollectingEventLogger())
+    out = (s.read.parquet(d).filter(col("k") < 5)
+           .join(s.read.parquet(d), col("k") == col("k"))
+           .select("k", "v").collect())
+    assert sorted(out.column("k").to_pylist()) == \
+        sorted(baseline.column("k").to_pylist())
+
+
+def test_strict_mode_raises(accelerated):
+    s, d, ix = accelerated
+    _corrupt_log(ix, "dg")
+    s.index_collection_manager.clear_cache()
+    s.conf.degraded_fallback_to_source = False
+    with pytest.raises(DegradedIndexError, match="dg"):
+        s.read.parquet(d).filter(col("k") == 7).select("k", "v").collect()
+
+
+def test_degraded_listing_is_not_cached(accelerated):
+    """A listing that skipped an unreadable index must not pin the partial
+    view for the cache TTL: repairing the log is picked up immediately."""
+    import shutil
+
+    s, d, ix = accelerated
+    log_dir = os.path.join(ix, "dg", "_hyperspace_log")
+    backup = os.path.join(ix, "dg", "_log_backup")
+    shutil.copytree(log_dir, backup)
+    _corrupt_log(ix, "dg")
+    s.index_collection_manager.clear_cache()
+    set_event_logger(CollectingEventLogger())
+    s.read.parquet(d).filter(col("k") == 7).collect()
+    assert not any(x["is_index"] for x in s.last_execution_stats["scans"])
+    # Repair WITHOUT clearing the cache: the degraded listing was never
+    # cached, so the next query re-reads and re-accelerates.
+    shutil.rmtree(log_dir)
+    shutil.copytree(backup, log_dir)
+    out = s.read.parquet(d).filter(col("k") == 7).select("k", "v").collect()
+    assert out.column("v").to_pylist() == [14.0]
+    assert any(x["is_index"] for x in s.last_execution_stats["scans"])
+
+
+def test_missing_index_data_degrades_rule_not_query(accelerated):
+    """The log is FINE but the index data files vanished (an erroring data
+    store): the rewrite rule dies mid-apply and the degraded boundary in
+    session.optimize returns the un-rewritten plan."""
+    import shutil
+
+    s, d, ix = accelerated
+    for v in glob.glob(os.path.join(ix, "dg", "v__=*")):
+        shutil.rmtree(v)
+    s.index_collection_manager.clear_cache()
+    log = CollectingEventLogger()
+    set_event_logger(log)
+    out = s.read.parquet(d).filter(col("k") == 7).select("k", "v").collect()
+    assert out.column("v").to_pylist() == [14.0]
+    degraded = [e for e in log.events if isinstance(e, IndexDegradedEvent)]
+    assert degraded, [e.kind for e in log.events]
+
+
+def test_erroring_store_degrades_via_injected_faults(accelerated):
+    """Persistent store.read errors through the object-store backend: the
+    query still answers from source."""
+    s, d, ix = accelerated
+    from hyperspace_tpu.io import faults
+
+    s.conf.log_manager_class = (
+        "hyperspace_tpu.index.object_log_manager.ObjectStoreLogManager")
+    s.index_collection_manager.clear_cache()
+    log = CollectingEventLogger()
+    set_event_logger(log)
+    # Point reads against the store fail past the retry budget — the
+    # "store is erroring" degradation, exercised through the injector.
+    faults.install(faults.FaultPlan(site="store.read", kind="eio",
+                                    count=-1))
+    out = s.read.parquet(d).filter(col("k") == 7).select("k", "v").collect()
+    faults.clear()
+    assert out.column("v").to_pylist() == [14.0]
+    assert not any(x["is_index"] for x in s.last_execution_stats["scans"])
+    degraded = [e for e in log.events if isinstance(e, IndexDegradedEvent)]
+    assert degraded and degraded[0].index_name == "dg"

@@ -57,6 +57,37 @@ class TestDeltaLog:
         assert len(log.snapshot(0).files) == 1
         assert len(log.snapshot(1).files) == 2
 
+    def test_truncated_commit_names_the_bad_file(self, tmp_path):
+        """A torn _delta_log JSON entry diagnoses itself: the error names
+        the commit file (and line) instead of a raw JSONDecodeError."""
+        from hyperspace_tpu.exceptions import CorruptMetadataError
+
+        path = str(tmp_path / "t")
+        write_delta(_table([1, 2]), path)
+        write_delta(_table([3, 4]), path, mode="append")
+        commit = os.path.join(path, "_delta_log", f"{1:020d}.json")
+        with open(commit, "r", encoding="utf-8") as f:
+            body = f.read()
+        with open(commit, "w", encoding="utf-8") as f:
+            f.write(body[:len(body) // 2])  # torn mid-upload
+        with pytest.raises(CorruptMetadataError) as e:
+            DeltaLog(path).snapshot()
+        assert commit in str(e.value)
+        # Time travel BEFORE the torn commit still works.
+        assert len(DeltaLog(path).snapshot(0).files) == 1
+
+    def test_truncated_checkpoint_names_the_bad_file(self, tmp_path):
+        from hyperspace_tpu.exceptions import CorruptMetadataError
+
+        path = str(tmp_path / "t")
+        write_delta(_table([1, 2]), path)
+        cp = os.path.join(path, "_delta_log", f"{0:020d}.checkpoint.parquet")
+        with open(cp, "wb") as f:
+            f.write(b"PAR1garbage")  # looks like parquet, is not
+        with pytest.raises(CorruptMetadataError) as e:
+            DeltaLog(path).snapshot()
+        assert cp in str(e.value)
+
     def test_overwrite_removes_old_files(self, tmp_path):
         path = str(tmp_path / "t")
         write_delta(_table([1, 2]), path)

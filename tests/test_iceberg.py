@@ -111,6 +111,41 @@ class TestIcebergTable:
         assert len(table.plan_files(md.snapshot_by_id(s0), md)) == 1
         assert len(table.plan_files(md.snapshot_by_id(s1), md)) == 2
 
+    def test_truncated_metadata_json_names_the_bad_file(self, tmp_path):
+        from hyperspace_tpu.exceptions import CorruptMetadataError
+
+        path = str(tmp_path / "t")
+        write_iceberg(_table([1, 2]), path)
+        table = IcebergTable(path)
+        v = table.latest_metadata_version()
+        md_path = os.path.join(path, "metadata", f"v{v}.metadata.json")
+        with open(md_path, "r", encoding="utf-8") as f:
+            body = f.read()
+        with open(md_path, "w", encoding="utf-8") as f:
+            f.write(body[:len(body) // 2])
+        with pytest.raises(CorruptMetadataError) as e:
+            table.load_metadata()
+        assert md_path in str(e.value)
+
+    def test_truncated_manifest_names_the_bad_file(self, tmp_path):
+        """A torn Avro manifest (or manifest list) raises a diagnostic
+        carrying the file path and its role."""
+        from hyperspace_tpu.exceptions import CorruptMetadataError
+
+        path = str(tmp_path / "t")
+        write_iceberg(_table([1, 2]), path)
+        table = IcebergTable(path)
+        md = table.load_metadata()
+        manifest_list = md.current_snapshot().manifest_list
+        with open(manifest_list, "rb") as f:
+            body = f.read()
+        with open(manifest_list, "wb") as f:
+            f.write(body[:len(body) // 2])
+        with pytest.raises(CorruptMetadataError) as e:
+            table.plan_files()
+        assert manifest_list in str(e.value)
+        assert "manifest list" in str(e.value)
+
     def test_overwrite_replaces_files(self, tmp_path):
         path = str(tmp_path / "t")
         write_iceberg(_table([1, 2]), path)

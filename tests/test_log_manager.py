@@ -172,6 +172,52 @@ class TestFaultInjection:
         with pytest.raises(OSError):
             list_data_files([str(d)])
 
+    def test_log_discovery_rides_listing_retry(self, stable_idx):
+        """get_latest_id / log_ids route through io/files.list_dir: a
+        transient listing error retries instead of failing discovery
+        (they used to call os.listdir bare)."""
+        mgr = stable_idx
+        faults.install(faults.FaultPlan(site="io.list", kind="eio",
+                                        count=1))
+        assert mgr.get_latest_id() == 2
+        faults.clear()
+        faults.install(faults.FaultPlan(site="io.list", kind="eio",
+                                        count=1))
+        assert mgr.log_ids() == [1, 2]
+        faults.clear()
+        # ...and a persistent fault still surfaces after the budget.
+        mgr.retry = RetryPolicy(max_attempts=2, initial_backoff_ms=1)
+        faults.install(faults.FaultPlan(site="io.list", kind="eio",
+                                        count=-1))
+        with pytest.raises(OSError):
+            mgr.get_latest_id()
+
+    def test_data_read_site_retries_transient_errors(self, tmp_path):
+        """io/parquet read paths ride the data.read fault site + retry —
+        a flaky mount mid-query retries like the write side does."""
+        import numpy as np
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from hyperspace_tpu.io.parquet import read_parquet_file, read_schema
+
+        p = str(tmp_path / "t.parquet")
+        pq.write_table(pa.table({"a": pa.array(np.arange(5))}), p)
+        faults.install(faults.FaultPlan(site="data.read", kind="eio",
+                                        count=1))
+        assert read_parquet_file(p).num_rows == 5
+        faults.clear()
+        faults.install(faults.FaultPlan(site="data.read", kind="eio",
+                                        count=1))
+        assert read_schema(p) == {"a": "int64"}
+        faults.clear()
+        # Persistent errors surface with the errno intact.
+        faults.install(faults.FaultPlan(site="data.read", kind="eio",
+                                        count=-1))
+        with pytest.raises(OSError) as e:
+            read_parquet_file(p)
+        assert e.value.errno == errno.EIO
+
     def test_end_protocol_crash_between_delete_and_write(self, stable_idx):
         """Action.end() deletes the pointer, writes the final entry, then
         recreates the pointer.  A crash in the window where the pointer

@@ -1,0 +1,302 @@
+"""LogStore backends + ObjectStoreLogManager: conditional-put semantics,
+generation-CAS pointer maintenance, stale-listing tolerance, and the
+fault matrix (io/faults.py) at every store operation.
+
+The protocol claim under test (docs/14-object-store.md): the operation
+log never depends on rename atomicity or listing freshness — numbered
+entries arbitrate via ``put_if_absent``, the ``latestStable`` pointer
+moves only through compare-and-swap to monotonically newer stable ids,
+and every torn/corrupt payload burns its key without breaking a reader.
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import threading
+
+import pytest
+
+from hyperspace_tpu.index.log_entry import States
+from hyperspace_tpu.index.object_log_manager import ObjectStoreLogManager
+from hyperspace_tpu.io import faults
+from hyperspace_tpu.io.log_store import EmulatedObjectStore, PosixLogStore
+from hyperspace_tpu.utils.retry import RetryPolicy
+from tests.utils import sample_entry
+
+
+@pytest.fixture(params=[PosixLogStore, EmulatedObjectStore])
+def store(request, tmp_path):
+    """Both real backends satisfy the identical conditional-put contract."""
+    return request.param(str(tmp_path / "bucket"))
+
+
+class TestLogStoreContract:
+    def test_put_if_absent_exactly_once(self, store):
+        assert store.put_if_absent("k", b"v1") is True
+        assert store.put_if_absent("k", b"v2") is False
+        assert store.read("k") == b"v1"
+        assert store.generation("k") == 1
+
+    def test_generation_cas(self, store):
+        store.put_if_absent("k", b"v1")
+        assert store.put_if_generation_match("k", b"v2", 1) is True
+        assert store.put_if_generation_match("k", b"v3", 1) is False
+        data, gen = store.read_with_generation("k")
+        assert (data, gen) == (b"v2", 2)
+
+    def test_delete_then_recreate(self, store):
+        store.put_if_absent("k", b"v1")
+        store.delete("k")
+        assert store.generation("k") == 0
+        assert store.read_with_generation("k") == (None, 0)
+        with pytest.raises(FileNotFoundError):
+            store.read("k")
+        assert store.put_if_absent("k", b"v2") is True
+
+    def test_list_keys_prefix(self, store):
+        for k in ("1", "2", "latestStable"):
+            store.put_if_absent(k, b"x")
+        assert store.list_keys() == ["1", "2", "latestStable"]
+        assert store.list_keys(prefix="latest") == ["latestStable"]
+
+    def test_missing_key_reads(self, store):
+        assert store.generation("nope") == 0
+        assert not store.exists("nope")
+        assert store.list_keys() == []
+
+
+class TestEmulatedObjectStoreSemantics:
+    def test_flat_keys_with_slashes(self, tmp_path):
+        """Keys containing '/' are DATA, not directory structure — the
+        flat-namespace property of real object stores."""
+        st = EmulatedObjectStore(str(tmp_path / "b"))
+        assert st.put_if_absent("a/b/c", b"x")
+        assert st.read("a/b/c") == b"x"
+        assert st.list_keys() == ["a/b/c"]
+        # No directory tree materialized under the bucket root.
+        assert not any(os.path.isdir(os.path.join(st.root, n))
+                       for n in os.listdir(st.root))
+
+    def test_stale_list_window_hides_recent_commits(self, tmp_path):
+        st = EmulatedObjectStore(str(tmp_path / "b"), stale_list_s=60.0)
+        st.put_if_absent("7", b"x")
+        assert st.list_keys() == []     # listing lags...
+        assert st.exists("7")           # ...point reads are strong
+        assert st.read("7") == b"x"
+        assert st.put_if_absent("7", b"y") is False  # and so are puts
+
+    def test_cross_thread_cas_single_winner(self, tmp_path):
+        st = EmulatedObjectStore(str(tmp_path / "b"))
+        st.put_if_absent("k", b"v0")
+        wins = []
+        barrier = threading.Barrier(8)
+
+        def racer(i):
+            barrier.wait()
+            if st.put_if_generation_match("k", b"w%d" % i, 1):
+                wins.append(i)
+
+        threads = [threading.Thread(target=racer, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(wins) == 1
+        assert st.read("k") == b"w%d" % wins[0]
+
+
+class TestStoreFaultMatrix:
+    @pytest.mark.parametrize("kind", ["eio", "enospc"])
+    def test_transient_put_is_not_committed(self, store, kind):
+        faults.install(faults.FaultPlan(site="store.put", kind=kind))
+        with pytest.raises(OSError):
+            store.put_if_absent("k", b"v")
+        faults.clear()
+        assert store.generation("k") == 0  # nothing half-committed
+        assert store.put_if_absent("k", b"v")
+
+    def test_torn_put_commits_partial_with_generation(self, store):
+        """A torn upload the store accepted: the key is burned (real
+        generation, half payload) and the writer is dead."""
+        faults.install(faults.FaultPlan(site="store.put", kind="torn"))
+        with pytest.raises(faults.InjectedCrash):
+            store.put_if_absent("k", b"0123456789")
+        faults.clear()
+        data, gen = store.read_with_generation("k")
+        assert gen == 1 and data == b"01234"
+        assert store.put_if_absent("k", b"again") is False  # id stays burned
+
+    def test_read_and_list_faults_fire(self, store):
+        store.put_if_absent("k", b"v")
+        faults.install(faults.FaultPlan(site="store.read", kind="eio"))
+        with pytest.raises(OSError) as e:
+            store.read("k")
+        assert e.value.errno == errno.EIO
+        faults.clear()
+        faults.install(faults.FaultPlan(site="store.list", kind="eio"))
+        with pytest.raises(OSError):
+            store.list_keys()
+        faults.clear()
+        faults.install(faults.FaultPlan(site="store.delete", kind="eio"))
+        with pytest.raises(OSError):
+            store.delete("k")
+
+
+@pytest.fixture()
+def obj_mgr(tmp_index_root):
+    mgr = ObjectStoreLogManager(os.path.join(tmp_index_root, "idx"))
+    mgr.retry = RetryPolicy(max_attempts=3, initial_backoff_ms=1)
+    return mgr
+
+
+class TestObjectStoreLogManager:
+    def test_protocol_parity_with_posix_manager(self, obj_mgr):
+        """The base IndexLogManager contract, rebuilt on conditional puts:
+        create-if-absent ids, latestStable resolution, reverse-scan
+        fallback."""
+        assert obj_mgr.get_latest_id() is None
+        assert obj_mgr.write_log(1, sample_entry(state=States.CREATING))
+        assert not obj_mgr.write_log(1, sample_entry(state=States.CREATING))
+        assert obj_mgr.write_log(2, sample_entry(state=States.ACTIVE))
+        assert obj_mgr.create_latest_stable_log(2)
+        assert obj_mgr.get_latest_stable_log().state == States.ACTIVE
+        obj_mgr.write_log(3, sample_entry(state=States.REFRESHING))
+        assert obj_mgr.get_latest_stable_log().id == 2
+        assert obj_mgr.log_ids() == [1, 2, 3]
+
+    def test_stale_listing_never_hides_ids_from_writers(self, tmp_index_root):
+        """With a 60 s visibility window NOTHING is listable, yet latest-id
+        discovery (forward point-read probe) and put_if_absent arbitration
+        keep the numbering collision-free."""
+        mgr = ObjectStoreLogManager(os.path.join(tmp_index_root, "idx"))
+        mgr.stale_list_s = 60.0
+        for i in (1, 2, 3):
+            assert mgr.write_log(i, sample_entry(state=States.CREATING))
+        assert mgr.store.list_keys() == []
+        assert mgr.get_latest_id() == 3
+        assert mgr.log_ids() == [1, 2, 3]
+        assert mgr.write_log(3, sample_entry(state=States.ACTIVE)) is False
+
+    def test_torn_entry_burned_and_skipped(self, obj_mgr):
+        obj_mgr.write_log(1, sample_entry(state=States.CREATING))
+        obj_mgr.write_log(2, sample_entry(state=States.ACTIVE))
+        obj_mgr.create_latest_stable_log(2)
+        faults.install(faults.FaultPlan(site="store.put", kind="torn"))
+        with pytest.raises(faults.InjectedCrash):
+            obj_mgr.write_log(3, sample_entry(state=States.REFRESHING))
+        faults.clear()
+        assert obj_mgr.get_latest_id() == 3      # id burned
+        assert obj_mgr.get_log(3) is None        # parses as absent
+        assert obj_mgr.get_latest_log().id == 2  # newest parseable wins
+        assert obj_mgr.get_latest_stable_log().id == 2
+        assert obj_mgr.write_log(4, sample_entry(state=States.DELETING))
+
+    def test_transient_store_errors_retry(self, obj_mgr):
+        faults.install(faults.FaultPlan(site="store.put", kind="eio",
+                                        count=1))
+        assert obj_mgr.write_log(1, sample_entry(state=States.CREATING))
+        faults.clear()
+        faults.install(faults.FaultPlan(site="store.read", kind="eio",
+                                        count=1))
+        assert obj_mgr.get_log(1).state == States.CREATING
+        faults.clear()
+        faults.install(faults.FaultPlan(site="store.list", kind="eio",
+                                        count=1))
+        assert obj_mgr.get_latest_id() == 1
+
+    def test_retry_budget_bounded(self, obj_mgr):
+        obj_mgr.retry = RetryPolicy(max_attempts=2, initial_backoff_ms=1)
+        faults.install(faults.FaultPlan(site="store.put", kind="eio",
+                                        count=-1))
+        with pytest.raises(OSError) as e:
+            obj_mgr.write_log(1, sample_entry(state=States.CREATING))
+        assert e.value.errno == errno.EIO
+        faults.clear()
+        assert obj_mgr.write_log(1, sample_entry(state=States.CREATING))
+
+    def test_pointer_cas_yields_to_newer_stable(self, obj_mgr):
+        """No lost update: a CAS attempt for an OLDER id observes the newer
+        pointer and yields — the pointer's id is monotone."""
+        obj_mgr.write_log(1, sample_entry(state=States.CREATING))
+        obj_mgr.write_log(2, sample_entry(state=States.ACTIVE))
+        obj_mgr.write_log(3, sample_entry(state=States.DELETED))
+        assert obj_mgr.create_latest_stable_log(3)
+        assert obj_mgr.create_latest_stable_log(2)  # returns True: newer won
+        assert obj_mgr.get_latest_stable_log().id == 3
+
+    def test_corrupt_pointer_overwritten_by_cas(self, obj_mgr):
+        obj_mgr.write_log(1, sample_entry(state=States.CREATING))
+        obj_mgr.write_log(2, sample_entry(state=States.ACTIVE))
+        obj_mgr.store.put_if_absent("latestStable", b'{"torn')
+        # Resolution falls back to the reverse scan past the garbage...
+        assert obj_mgr.get_latest_stable_log().id == 2
+        # ...and the next pointer update repairs it via CAS overwrite.
+        assert obj_mgr.create_latest_stable_log(2)
+        data, gen = obj_mgr.store.read_with_generation("latestStable")
+        assert gen == 2 and b'"ACTIVE"' in data
+
+    def test_cas_storm_no_lost_update(self, obj_mgr):
+        """N threads each CAS the pointer toward a different stable id,
+        with injected transient faults in the storm: the final pointer
+        must resolve to the MAXIMUM stable id (monotone, no lost update)
+        and always parse."""
+        n = 12
+        for i in range(1, n + 1):
+            obj_mgr.write_log(i, sample_entry(state=States.ACTIVE))
+        faults.install(faults.FaultPlan(site="store.put", kind="eio",
+                                        at=3, count=4))
+        barrier = threading.Barrier(n)
+        errors = []
+
+        def racer(i):
+            try:
+                barrier.wait()
+                obj_mgr.create_latest_stable_log(i)
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+
+        threads = [threading.Thread(target=racer, args=(i,))
+                   for i in range(1, n + 1)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        faults.clear()
+        assert not errors, errors
+        resolved = obj_mgr.get_latest_stable_log()
+        assert resolved is not None and resolved.id == n
+
+
+def test_object_store_manager_via_conf(tmp_path):
+    """hyperspace.index.logManagerClass + logStoreClass route a full
+    lifecycle (create → query) through the object-store protocol, and the
+    staleListMs conf reaches the store."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
+
+    d = str(tmp_path / "data")
+    os.makedirs(d)
+    pq.write_table(pa.table({"k": pa.array(np.arange(100, dtype=np.int64)),
+                             "v": pa.array(np.arange(100) * 0.5)}),
+                   os.path.join(d, "p.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    s.conf.num_buckets = 2
+    s.conf.log_manager_class = (
+        "hyperspace_tpu.index.object_log_manager.ObjectStoreLogManager")
+    s.conf.set("hyperspace.system.objectStore.staleListMs", 60000)
+    hs = Hyperspace(s)
+    hs.create_index(s.read.parquet(d), IndexConfig("obj", ["k"], ["v"]))
+    mgr = s.index_collection_manager._log_manager("obj")
+    assert isinstance(mgr, ObjectStoreLogManager)
+    assert mgr.stale_list_s == 60.0           # conf reached configure()
+    assert mgr.store.list_keys() == []        # listing really is stale
+    assert mgr.log_ids() == [1, 2]            # probe still sees the log
+    s.enable_hyperspace()
+    out = (s.read.parquet(d).filter(col("k") == 7).select("k", "v")
+           .collect())
+    assert out.column("v").to_pylist() == [3.5]
+    assert any(x["is_index"] for x in s.last_execution_stats["scans"])
