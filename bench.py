@@ -854,6 +854,7 @@ def main() -> None:
             harness.section("kernel_bench",
                             lambda: {"kernel_bench": _kernel_microbench()})
             harness.section("calibration", lambda: _sec_calibration())
+            harness.section("integrity", lambda: _sec_integrity(root))
             harness.section("sf10", lambda: _sec_sf10(ctx, root, harness))
             harness.section("sf100", lambda: _sec_sf100(ctx, root, harness))
         except _Finalize:
@@ -865,7 +866,7 @@ def main() -> None:
             for name in ("setup", "sf1_queries", "device_agg_probe",
                          "resident_agg", "warm_resident_join", "warm_q3",
                          "warm_q10", "window_bench", "kernel_bench",
-                         "calibration", "sf10", "sf100"):
+                         "calibration", "integrity", "sf10", "sf100"):
                 if name not in harness.detail \
                         and not any(s["section"] == name
                                     for s in harness.sections):
@@ -1517,6 +1518,89 @@ def _sec_calibration() -> dict:
     from hyperspace_tpu.utils.calibrate import profile_summary
 
     return {"calibration": profile_summary()}
+
+
+def _sec_integrity(root: str) -> dict:
+    """Integrity subsystem cost model (docs/15-integrity.md): what does
+    digest-on-write cost the build, and how fast does a full scrub
+    re-read + re-hash the index?  The same covering-index build runs with
+    ``hyperspace.system.integrity.digestOnWrite`` off then on
+    (``write_overhead_pct``), and ``verify_index(mode="full")`` over the
+    built data gives ``scrub_mb_s``.  Self-contained (own source table,
+    one throwaway session per build) so the shared SF1 session's indexes
+    and caches are untouched."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig
+
+    n = max(10_000, N_LINEITEM // 10)
+    files = 8
+    src = os.path.join(root, "integrity_src")
+    os.makedirs(src, exist_ok=True)
+    rng = np.random.default_rng(17)
+    table = pa.table({
+        "k": pa.array(rng.integers(0, max(1, n // 4), size=n),
+                      type=pa.int64()),
+        "v1": rng.random(n),
+        "v2": rng.random(n),
+    })
+    step = -(-n // files)
+    for f in range(files):
+        pq.write_table(table.slice(f * step, step),
+                       os.path.join(src, f"part-{f:05d}.parquet"))
+
+    seq = iter(range(1 << 20))
+    last: dict = {}
+
+    def build(digest_on: bool) -> None:
+        s = HyperspaceSession(system_path=os.path.join(
+            root, f"integrity_ix_{next(seq)}"))
+        s.conf.num_buckets = NUM_BUCKETS
+        s.conf.integrity_digest_on_write = digest_on
+        hs = Hyperspace(s)
+        hs.create_index(s.read.parquet(src),
+                        IndexConfig("iix", ["k"], ["v1", "v2"]))
+        last["session"], last["hs"] = s, hs
+
+    reps = min(3, REPEATS)
+    build(False)  # untimed warmup: JIT/import costs land here, not in
+    # the off-median of a comparison measuring a few percent
+    t_off = _time(lambda: build(False), repeats=reps)
+    t_on = _time(lambda: build(True), repeats=reps)
+    overhead_pct = ((t_on["median"] - t_off["median"])
+                    / t_off["median"] * 100.0)
+
+    # Scrub the last (digest-on) build: every file re-read and re-hashed.
+    session, hs = last["session"], last["hs"]
+    entry = session.index_collection_manager.get_index("iix")
+    infos = entry.content.file_infos()
+    index_mb = sum(f.size for f in infos) / 1e6
+    report: dict = {}
+
+    def scrub() -> None:
+        report["table"] = hs.verify_index("iix", mode="full")
+
+    t_scrub = _time(scrub, repeats=reps)
+    statuses = report["table"].column("status").to_pylist()
+    bad = sorted({st for st in statuses if st != "ok"})
+    if bad:
+        # A freshly built index scrubbing anything but clean means a
+        # writer path skipped digest recording (or worse): correctness
+        # gate, same policy as a diverged query answer.
+        raise SystemExit(f"integrity bench: full scrub of a fresh build "
+                         f"not clean: {bad}")
+    return {"integrity": {
+        "rows": n,
+        "index_files": len(infos),
+        "index_mb": round(index_mb, 2),
+        "build_digest_off_s": _stat(t_off),
+        "build_digest_on_s": _stat(t_on),
+        "write_overhead_pct": round(overhead_pct, 2),
+        "scrub_full_s": _stat(t_scrub),
+        "scrub_mb_s": round(index_mb / t_scrub["median"], 1),
+    }}
 
 
 def _sec_sf10(ctx: dict, root: str, harness: "_Harness") -> dict:

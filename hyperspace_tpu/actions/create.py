@@ -147,6 +147,11 @@ class CreateActionBase(Action):
         per-bucket run files, and each bucket is then sorted independently —
         peak memory is bounded by max(batch, largest bucket), not the
         dataset."""
+        from hyperspace_tpu.io import integrity
+
+        # Digest-on-write follows THIS session's conf (the recorder is
+        # process-global, like the fault injector).
+        integrity.configure_from_conf(self.conf)
         relation = self._relation()
         resolved = self._resolved_config()
         lineage = self.lineage_enabled

@@ -259,9 +259,14 @@ def write_index_file_sketch(out_dir: str, columns: Sequence[str]) -> None:
 
 
 def write_sketch(rows: List[Dict], out_dir: str) -> str:
+    from hyperspace_tpu.io import integrity
+
     os.makedirs(out_dir, exist_ok=True)
     path = os.path.join(out_dir, f"sketch-{uuid.uuid4().hex[:12]}.parquet")
     pq.write_table(pa.Table.from_pylist(rows), path)
+    # Sketches are the data-skipping index's DATA: digest them like
+    # bucket files so verify_index covers both index kinds.
+    integrity.record_file(path)
     return path
 
 

@@ -116,6 +116,9 @@ class OptimizeAction(Action):
                 "a single file or files exceed the size threshold)")
 
     def op(self) -> None:
+        from hyperspace_tpu.io import integrity
+
+        integrity.configure_from_conf(self.session.conf)
         entry = self.previous_log_entry
         mergeable = self._candidates()
         version = self.data_manager.get_next_version()
@@ -158,11 +161,17 @@ class OptimizeAction(Action):
         write_index_file_sketch(out_dir, sort_cols)
 
     def log_entry(self) -> IndexLogEntry:
+        from hyperspace_tpu.io import integrity
+
         entry = copy.deepcopy(self.previous_log_entry)
         tracker = FileIdTracker()
         new_infos = []
         for path in self._new_files:
             st = os.stat(path)
-            new_infos.append(FileInfo(path, st.st_size, int(st.st_mtime_ns), -1))
+            # Compacted files carry the digest recorded as they were
+            # written (write_bucket_run); retained files keep the digests
+            # their own build committed.
+            new_infos.append(FileInfo(path, st.st_size, int(st.st_mtime_ns),
+                                      -1, integrity.recorded_digest(path)))
         entry.content = Content.from_leaf_files(self._retained + new_infos)
         return entry

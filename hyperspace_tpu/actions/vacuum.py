@@ -33,6 +33,11 @@ class VacuumAction(Action):
     def op(self) -> None:
         for version in reversed(self.data_manager.versions()):
             self.data_manager.delete(version)
+        # Each delete() dropped its version's quarantine records; sweep
+        # whatever remains (records that never mapped to a version dir)
+        # so a vacuumed index leaves zero orphaned quarantine keys.
+        if getattr(self.data_manager, "quarantine", None) is not None:
+            self.data_manager.quarantine.clear()
 
     def log_entry(self) -> IndexLogEntry:
         return self.log_entry_for_begin()

@@ -53,6 +53,10 @@ DISPLAY_MODE = "hyperspace.explain.displayMode"
 HIGHLIGHT_BEGIN_TAG = "hyperspace.explain.displayMode.highlight.beginTag"
 HIGHLIGHT_END_TAG = "hyperspace.explain.displayMode.highlight.endTag"
 AUTO_RECOVERY_ENABLED = "hyperspace.index.autoRecovery.enabled"
+AUTO_REPAIR_ENABLED = "hyperspace.index.autoRepair.enabled"
+INTEGRITY_DIGEST_ON_WRITE = "hyperspace.system.integrity.digestOnWrite"
+INTEGRITY_QUARANTINE_ON_FAILURE = \
+    "hyperspace.system.integrity.quarantineOnReadFailure"
 IO_RETRY_MAX_ATTEMPTS = "hyperspace.system.io.retry.maxAttempts"
 IO_RETRY_INITIAL_BACKOFF_MS = "hyperspace.system.io.retry.initialBackoffMs"
 IO_RETRY_MAX_BACKOFF_MS = "hyperspace.system.io.retry.maxBackoffMs"
@@ -219,6 +223,25 @@ class HyperspaceConf:
     # still SAFE either way — the optimistic log write arbitrates — but
     # it would make the racer that started LATER win).
     auto_recovery_enabled: bool = False
+    # Integrity subsystem (io/integrity.py, actions/verify.py,
+    # index/quarantine.py; docs/15-integrity.md):
+    #   - digestOnWrite: hash every index data file as it lands and record
+    #     the content digest in its FileInfo (xxh64; ~memory-speed, paid
+    #     once per file at build time).  Off = files commit digest-less
+    #     and full scrub reports them status="unknown".
+    #   - quarantineOnReadFailure: when an index scan dies at execution,
+    #     probe that index's files, QUARANTINE the unreadable/mismatched
+    #     ones and re-plan with only the damaged buckets read from source
+    #     — before PR 2's whole-index fallback (which stays the last
+    #     resort).
+    #   - autoRepair: after such a containment re-plan answers the query,
+    #     rebuild the quarantined buckets in the background of the call
+    #     (refresh mode="repair") so the NEXT query runs clean.  Off by
+    #     default: repair re-reads source data, which is an operator
+    #     decision on metered storage.
+    integrity_digest_on_write: bool = True
+    integrity_quarantine_on_failure: bool = True
+    auto_repair_enabled: bool = False
     # Transient-IO retry for the op-log's file primitives (EIO/ENOSPC/
     # EAGAIN/EINTR): total attempts and exponential-backoff bounds, with
     # uniform jitter so racing writers don't re-collide in lockstep.
@@ -277,6 +300,9 @@ class HyperspaceConf:
         HIGHLIGHT_BEGIN_TAG: "highlight_begin_tag",
         HIGHLIGHT_END_TAG: "highlight_end_tag",
         AUTO_RECOVERY_ENABLED: "auto_recovery_enabled",
+        AUTO_REPAIR_ENABLED: "auto_repair_enabled",
+        INTEGRITY_DIGEST_ON_WRITE: "integrity_digest_on_write",
+        INTEGRITY_QUARANTINE_ON_FAILURE: "integrity_quarantine_on_failure",
         IO_RETRY_MAX_ATTEMPTS: "io_retry_max_attempts",
         IO_RETRY_INITIAL_BACKOFF_MS: "io_retry_initial_backoff_ms",
         IO_RETRY_MAX_BACKOFF_MS: "io_retry_max_backoff_ms",

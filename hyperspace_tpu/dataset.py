@@ -43,6 +43,62 @@ def _index_scans_of(plan: LogicalPlan) -> List[str]:
     return sorted(set(out))
 
 
+def _index_scan_files(plan: LogicalPlan) -> List:
+    """(index name, file paths) per index scan in the plan."""
+    out: List = []
+    if isinstance(plan, Scan) and plan.relation.index_scan_of is not None:
+        out.append((plan.relation.index_scan_of,
+                    list(plan.relation.file_paths or ())))
+    for child in plan.children:
+        out.extend(_index_scan_files(child))
+    return out
+
+
+def _quarantine_damaged_index_files(session, plan: LogicalPlan) -> List[str]:
+    """Containment probe after an execution failure on a plan that reads
+    index data: stat + parquet-footer-check every index file the plan
+    touches, then (for files that pass) re-hash against the digest the
+    entry records.  Unreadable/mismatched files are QUARANTINED
+    (index/quarantine.py) so the re-plan serves their buckets from
+    source.  Returns the newly quarantined paths — empty means the
+    failure was not attributable to index data and the caller falls
+    through to the whole-plan source fallback."""
+    import os
+
+    import pyarrow.parquet as pq
+
+    from hyperspace_tpu.io import integrity
+
+    mgr = session.index_collection_manager
+    newly: List[str] = []
+    for name, paths in _index_scan_files(plan):
+        quarantine = mgr.quarantine_manager(name)
+        entry = mgr.get_index(name)
+        digest_of = {} if entry is None else \
+            {f.name: f.digest for f in entry.content.file_infos()}
+        for path in paths:
+            reason = None
+            try:
+                os.stat(path)
+            except OSError as err:
+                reason = f"stat failed: {err}"
+            else:
+                try:
+                    pq.read_metadata(path)
+                except Exception as err:  # noqa: BLE001 — any footer
+                    # parse failure means the file cannot serve reads
+                    reason = f"unreadable: {err}"
+                else:
+                    digest = digest_of.get(path)
+                    if digest is not None and \
+                            integrity.verify_file(path, digest) is False:
+                        reason = f"content digest mismatch ({digest})"
+            if reason is not None and \
+                    quarantine.add(path, f"execution-failure probe: {reason}"):
+                newly.append(path)
+    return newly
+
+
 class GroupedDataset:
     """``df.group_by(...)`` intermediate; ``agg`` specs are pandas-style
     keyword pairs: ``agg(total=("l_quantity", "sum"))``."""
@@ -232,7 +288,26 @@ class Dataset:
         from hyperspace_tpu.execution.executor import Executor
 
         executor = Executor(self.session)
-        plan = self.optimized_plan()
+        try:
+            plan = self.optimized_plan()
+        except Exception as e:  # noqa: BLE001 — InjectedCrash propagates.
+            # PLANNING died with index rewrites on (e.g. every file of an
+            # index unreadable, so even its schema cannot be fetched).
+            # Degraded mode owns this stage too: re-plan without indexes;
+            # a failure of THAT plan is a genuine query error and
+            # propagates from a planning pass indexes never touched.
+            if not self.session.is_hyperspace_enabled() or \
+                    not self.session.conf.degraded_fallback_to_source:
+                raise
+            from hyperspace_tpu.telemetry.events import (
+                IndexDegradedEvent,
+                get_event_logger,
+            )
+
+            get_event_logger().log_event(IndexDegradedEvent(
+                reason=f"index-aware planning failed: {e!r}",
+                message="re-planned without index rewrites"))
+            plan = self.optimized_plan(use_indexes=False)
         try:
             out = executor.execute(plan)
         except Exception as e:  # noqa: BLE001 — InjectedCrash is a
@@ -241,23 +316,56 @@ class Dataset:
             if not index_names or \
                     not self.session.conf.degraded_fallback_to_source:
                 raise
-            # Degraded mode, execution stage: the REWRITTEN plan died and
-            # it reads index data — an index whose files are torn, vacuumed
-            # under us, or on an erroring store must cost this query its
-            # acceleration, never its answer.  Re-plan WITHOUT index
-            # rewrites and run the source scan; a failure of that plan is
-            # a genuine source problem and propagates.
             from hyperspace_tpu.telemetry.events import (
                 IndexDegradedEvent,
                 get_event_logger,
             )
 
-            get_event_logger().log_event(IndexDegradedEvent(
-                index_name=",".join(index_names),
-                reason=f"index scan failed at execution: {e!r}",
-                message="re-executed against the source scan"))
-            executor = Executor(self.session)
-            out = executor.execute(self.optimized_plan(use_indexes=False))
+            # CONTAINMENT first (the integrity loop, docs/15-integrity.md):
+            # probe the index files the dead plan read, quarantine the
+            # damaged ones, and re-plan WITH indexes — the rewrite rules
+            # now serve only the damaged buckets from source.  One rotten
+            # bucket costs one bucket's source IO, not the whole index.
+            out = None
+            if self.session.conf.integrity_quarantine_on_failure:
+                damaged = _quarantine_damaged_index_files(self.session, plan)
+                if damaged:
+                    get_event_logger().log_event(IndexDegradedEvent(
+                        index_name=",".join(index_names),
+                        reason=f"index scan failed at execution: {e!r}; "
+                               f"quarantined {len(damaged)} damaged "
+                               f"file(s)",
+                        message="re-planned with damaged buckets read "
+                                "from source"))
+                    try:
+                        executor = Executor(self.session)
+                        out = executor.execute(self.optimized_plan())
+                    except Exception:  # noqa: BLE001 — containment is
+                        # best-effort; the full fallback below still owns
+                        # the answer (InjectedCrash stays fatal).
+                        out = None
+                    if out is not None and \
+                            self.session.conf.auto_repair_enabled:
+                        # Opt-in self-heal: rebuild the quarantined
+                        # buckets now so the NEXT query runs clean.  A
+                        # repair failure must never cost this query its
+                        # (already computed) answer.
+                        for name in index_names:
+                            try:
+                                self.session.index_collection_manager \
+                                    .refresh(name, "repair")
+                            except Exception:  # noqa: BLE001
+                                pass
+            if out is None:
+                # Degraded mode, execution stage — the LAST resort: re-plan
+                # WITHOUT index rewrites and run the source scan; a failure
+                # of that plan is a genuine source problem and propagates.
+                get_event_logger().log_event(IndexDegradedEvent(
+                    index_name=",".join(index_names),
+                    reason=f"index scan failed at execution: {e!r}",
+                    message="re-executed against the source scan"))
+                executor = Executor(self.session)
+                out = executor.execute(self.optimized_plan(use_indexes=False))
         # Physical stats of the most recent execution (join strategies,
         # scan file counts) — read by verbose explain and tests.
         self.session.last_execution_stats = executor.stats

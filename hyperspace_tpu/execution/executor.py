@@ -33,6 +33,7 @@ from hyperspace_tpu.plan.expr import (
     Arith,
     BinOp,
     Case,
+    BucketIn,
     Cast,
     Col,
     Expr,
@@ -2240,6 +2241,21 @@ def _arrow_eval(expr: Expr, table: pa.Table):
         return pc.or_kleene(_arrow_eval(expr.left, table), _arrow_eval(expr.right, table))
     if isinstance(expr, Not):
         return pc.invert(_arrow_eval(expr.child, table))
+    if isinstance(expr, BucketIn):
+        # Quarantine containment (rules/hybrid.py): membership of each
+        # row's hash bucket — computed with the build kernel's own host
+        # mirror, so "rows of bucket b" here can never disagree with
+        # which rows the damaged index file actually held.  Nulls hash to
+        # their deterministic sentinel bucket (same as the build): the
+        # mask is null-free.
+        from hyperspace_tpu.io.columnar import to_hash_words
+        from hyperspace_tpu.ops.hash import bucket_ids_np
+
+        word_cols = [np.asarray(to_hash_words(table.column(c)))
+                     for c in expr.columns]
+        row_buckets = bucket_ids_np(word_cols, expr.num_buckets)
+        return pa.array(np.isin(
+            row_buckets, np.asarray(expr.buckets, dtype=row_buckets.dtype)))
     if isinstance(expr, IsIn):
         child = _arrow_eval(expr.child, table)
         # Spark 3VL, which arrow's is_in does not implement:

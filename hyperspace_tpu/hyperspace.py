@@ -36,7 +36,22 @@ class Hyperspace:
         self.index_manager.vacuum(name)
 
     def refresh_index(self, name: str, mode: str = "full") -> None:
+        """Modes: ``full`` (rebuild), ``incremental``, ``quick``
+        (metadata-only), and ``repair`` — rebuild only the buckets whose
+        files are quarantined, then clear their quarantine records
+        (docs/15-integrity.md)."""
         self.index_manager.refresh(name, mode)
+
+    def verify_index(self, name: str, mode: str = "quick") -> pa.Table:
+        """Scrub ``name``'s index data files against its log entry and
+        return the per-file report (columns: file, status, detail,
+        quarantined).  ``quick`` checks existence/size/mtime; ``full``
+        additionally re-reads every file and re-hashes it against the
+        content digest recorded at write time.  Damaged files are
+        QUARANTINED: later queries keep using the index with only the
+        damaged buckets read from source, and
+        ``refresh_index(name, mode="repair")`` rebuilds them."""
+        return self.index_manager.verify(name, mode)
 
     def optimize_index(self, name: str, mode: str = "quick") -> None:
         self.index_manager.optimize(name, mode)

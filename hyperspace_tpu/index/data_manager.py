@@ -22,8 +22,12 @@ INDEX_VERSION_DIR_PREFIX = "v__="  # IndexConstants.scala:67
 
 
 class IndexDataManager:
-    def __init__(self, index_path: str) -> None:
+    def __init__(self, index_path: str, quarantine=None) -> None:
         self.index_path = index_path
+        # Optional QuarantineManager (index/quarantine.py): when attached
+        # (the collection manager always does), deleting a version also
+        # drops that version's quarantine records.
+        self.quarantine = quarantine
 
     def version_path(self, version: int) -> str:
         return os.path.join(self.index_path, f"{INDEX_VERSION_DIR_PREFIX}{version}")
@@ -35,7 +39,11 @@ class IndexDataManager:
         for name in os.listdir(self.index_path):
             if name.startswith(INDEX_VERSION_DIR_PREFIX):
                 suffix = name[len(INDEX_VERSION_DIR_PREFIX):]
-                if suffix.isdigit():
+                # Directories only: a stray FILE named v__=N (a partial
+                # upload, a tool's scratch) must not inflate the version
+                # counter or feed delete() a non-directory.
+                if suffix.isdigit() and os.path.isdir(
+                        os.path.join(self.index_path, name)):
                     out.append(int(suffix))
         return sorted(out)
 
@@ -51,3 +59,8 @@ class IndexDataManager:
         path = self.version_path(version)
         if os.path.isdir(path):
             shutil.rmtree(path)
+        if self.quarantine is not None:
+            # A vacuumed version must not leave orphaned quarantine keys:
+            # the files are gone, the records would read as eternally
+            # "missing" to every future scrub.
+            self.quarantine.clear_version(version)

@@ -54,19 +54,31 @@ class States:
 @dataclasses.dataclass(frozen=True)
 class FileInfo:
     """One leaf file (IndexLogEntry.scala:321-345). ``id`` comes from the
-    FileIdTracker and is stable across log versions."""
+    FileIdTracker and is stable across log versions.  ``digest`` is the
+    optional content digest (``"<algo>:<hex>"``, io/integrity.py) recorded
+    at write time for index data files; source files — and every entry
+    serialized before digests existed — carry None, which a scrub reports
+    as ``status="unknown"`` rather than a mismatch."""
 
     name: str
     size: int
     mtime: int
     id: int = -1
+    digest: Optional[str] = None
 
     def to_dict(self) -> Dict[str, Any]:
-        return {"name": self.name, "size": self.size, "modifiedTime": self.mtime, "id": self.id}
+        d = {"name": self.name, "size": self.size,
+             "modifiedTime": self.mtime, "id": self.id}
+        if self.digest is not None:
+            # Digest-less entries keep the exact pre-digest JSON shape:
+            # old readers (and golden files) never see a new key.
+            d["digest"] = self.digest
+        return d
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "FileInfo":
-        return FileInfo(d["name"], d["size"], d["modifiedTime"], d.get("id", -1))
+        return FileInfo(d["name"], d["size"], d["modifiedTime"],
+                        d.get("id", -1), d.get("digest"))
 
 
 @dataclasses.dataclass
@@ -134,7 +146,8 @@ class Directory:
                     nxt = Directory(name=part)
                     node.subdirs.append(nxt)
                 node = nxt
-            node.files.append(FileInfo(os.path.basename(f.name), f.size, f.mtime, f.id))
+            node.files.append(FileInfo(os.path.basename(f.name), f.size,
+                                       f.mtime, f.id, f.digest))
         return root
 
     @staticmethod
@@ -162,10 +175,17 @@ class Directory:
                 if entry.is_dir():
                     subdirs.append(Directory._scan(entry.path, file_id_tracker))
                 elif is_data_file(entry.name):
+                    from hyperspace_tpu.io import integrity
+
                     st = entry.stat()
                     fid = file_id_tracker.add_file(
                         os.path.abspath(entry.path), st.st_size, int(st.st_mtime_ns))
-                    files.append(FileInfo(entry.name, st.st_size, int(st.st_mtime_ns), fid))
+                    # Index data writers record content digests at write
+                    # time (io/integrity.py); source files were never
+                    # recorded and keep digest=None.
+                    files.append(FileInfo(
+                        entry.name, st.st_size, int(st.st_mtime_ns), fid,
+                        integrity.recorded_digest(os.path.abspath(entry.path))))
         return Directory(os.path.basename(path) or "/", files, subdirs)
 
 
@@ -197,7 +217,8 @@ class Content:
             if node.name == "/":
                 base = "/"
             for f in node.files:
-                out.append(FileInfo(os.path.join(base, f.name), f.size, f.mtime, f.id))
+                out.append(FileInfo(os.path.join(base, f.name), f.size,
+                                    f.mtime, f.id, f.digest))
             for sub in node.subdirs:
                 walk(sub, base)
 

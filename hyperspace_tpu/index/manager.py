@@ -92,7 +92,28 @@ class IndexCollectionManager:
             self._dispatch(CancelAction(mgr))
 
     def _data_manager(self, name: str) -> IndexDataManager:
-        return IndexDataManager(self.path_resolver.get_index_path(name))
+        # The quarantine manager rides along so version deletion (vacuum)
+        # also drops that version's quarantine records — no orphaned keys.
+        return IndexDataManager(self.path_resolver.get_index_path(name),
+                                quarantine=self.quarantine_manager(name))
+
+    def quarantine_manager(self, name: str):
+        """Per-index quarantine set (index/quarantine.py), persisted
+        through the LogStore seam (``hyperspace.index.logStoreClass``)."""
+        from hyperspace_tpu.index.quarantine import quarantine_manager_for
+
+        return quarantine_manager_for(self.session.conf,
+                                      self.path_resolver.get_index_path(name))
+
+    def verify(self, name: str, mode: str = "quick"):
+        """Scrub ``name``'s data files against its log entry
+        (actions/verify.py); returns the per-file report table."""
+        from hyperspace_tpu.actions.verify import VerifyIndexAction
+
+        return VerifyIndexAction(self._log_manager(name),
+                                 self._data_manager(name),
+                                 self.quarantine_manager(name),
+                                 mode=mode).run()
 
     # -- lifecycle APIs (IndexCollectionManager.scala:36-107) ---------------
     def create(self, dataset, config: IndexConfig) -> None:
@@ -139,6 +160,18 @@ class IndexCollectionManager:
             RefreshQuickAction,
         )
 
+        if mode == "repair":
+            # Integrity self-heal: rebuild only the quarantined buckets
+            # and clear their records (actions/repair.py).
+            from hyperspace_tpu.actions.repair import RepairAction
+
+            self._maybe_recover(name)
+            self._dispatch(RepairAction(
+                self._log_manager(name), self._data_manager(name),
+                self.session,
+                previous=self._log_manager(name).get_latest_stable_log(),
+                quarantine=self.quarantine_manager(name)))
+            return
         cls = {"full": RefreshAction,
                "incremental": RefreshIncrementalAction,
                "quick": RefreshQuickAction}.get(mode)

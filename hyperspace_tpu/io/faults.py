@@ -48,7 +48,22 @@ Kinds:
 ``crash``                 die at the site before doing anything
 ``crash-before-rename``   die with the tmp file written, rename not done
 ``crash-after-rename``    perform the rename, then die
+``bitrot``                flip bytes mid-file IN PLACE, keeping size AND
+                          mtime — silent corruption only a content digest
+                          can see (:func:`corrupt_file` sites:
+                          ``data.write`` corrupts the file just written,
+                          ``data.read`` corrupts it just before the read)
+``truncate``              cut the file to half its size — a torn put the
+                          store accepted; size changes, so even a quick
+                          (stat-only) scrub catches it
 ========================  ====================================================
+
+The corruption kinds never raise: the write/read call itself SUCCEEDS
+and the damage sits on disk for the integrity layer (io/integrity.py,
+actions/verify.py) to detect — which is exactly the failure they model.
+They fire only through :func:`corrupt_file`; :func:`check` and friends
+skip them without consuming the plan's call counter, so ``at=N`` counts
+only the calls that can actually fire the armed kind.
 
 A crash is modeled as :class:`InjectedCrash`, a ``BaseException``:
 ``except Exception`` cleanup handlers — which a real ``kill -9`` would
@@ -71,7 +86,10 @@ import threading
 from typing import Optional
 
 _KNOWN_KINDS = ("enospc", "eio", "torn", "crash", "crash-before-rename",
-                "crash-after-rename")
+                "crash-after-rename", "bitrot", "truncate")
+# Kinds that damage file CONTENT instead of failing the call; they fire
+# only through corrupt_file().
+_CORRUPT_KINDS = ("bitrot", "truncate")
 
 
 class InjectedCrash(BaseException):
@@ -103,8 +121,13 @@ class FaultPlan:
         self._fired = 0
         self._lock = threading.Lock()
 
-    def _should_fire(self, site: str) -> bool:
+    def _should_fire(self, site: str, corrupting: bool = False) -> bool:
         if site != self.site:
+            return False
+        if (self.kind in _CORRUPT_KINDS) != corrupting:
+            # Mismatched call type (a corruption kind at a check() site or
+            # vice versa): not merely "don't fire" — don't COUNT, so at=N
+            # indexes only calls that could fire this kind.
             return False
         with self._lock:
             self._calls += 1
@@ -188,6 +211,36 @@ def write_payload(f, data: bytes, site: str) -> None:
         f.flush()
         raise InjectedCrash(f"injected torn write at {site}")
     plan._raise()
+
+
+def corrupt_file(site: str, path: str) -> None:
+    """Corruption checkpoint for file-content fault kinds: ``bitrot``
+    flips 8 bytes in the middle of ``path`` in place, restoring mtime so
+    the damage is invisible to a stat (only a content digest or an actual
+    decode sees it); ``truncate`` cuts the file to half its size (size
+    changes — a stat-level scrub catches it).  The call at the SITE
+    itself still succeeds: these model damage around an IO that worked."""
+    import os
+
+    plan = _PLAN
+    if plan is None or not plan._should_fire(site, corrupting=True):
+        return
+    st = os.stat(path)
+    if plan.kind == "truncate":
+        with open(path, "r+b") as f:
+            f.truncate(max(1, st.st_size // 2))
+        return
+    with open(path, "r+b") as f:
+        off = max(0, st.st_size // 2 - 4)
+        f.seek(off)
+        chunk = f.read(8)
+        f.seek(off)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+        f.flush()
+        os.fsync(f.fileno())
+    # Bit-rot does not touch metadata: size is unchanged by the in-place
+    # flip, and the pre-damage timestamps are restored.
+    os.utime(path, ns=(st.st_atime_ns, st.st_mtime_ns))
 
 
 def atomic_replace(tmp: str, dst: str, site: str) -> None:

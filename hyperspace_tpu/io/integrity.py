@@ -1,0 +1,143 @@
+"""Content digests for index data files — the detection layer of the
+integrity subsystem (detect → quarantine → serve degraded → repair).
+
+The operation log got its crash-safety in PR 1/PR 2; the index *data*
+files under ``v__=N/`` carried none.  Silent corruption (bit-rot, a
+truncated put, a partial object-store write) previously surfaced only as
+an unexplained scan failure whose sole remedy was the whole-index
+degraded fallback.  This module closes the detection gap:
+
+  - every index data file written through ``io/parquet.write_bucket_run``
+    (create / refresh / optimize / repair all funnel there) is hashed as
+    it lands and the digest recorded here;
+  - ``index/log_entry.Directory._scan`` picks the recorded digest up when
+    the action builds its content tree, so the committed ``FileInfo``
+    carries ``digest`` alongside (size, mtime);
+  - ``VerifyIndexAction`` (actions/verify.py) re-hashes on demand and
+    quarantines mismatches (index/quarantine.py).
+
+Digest format is ``"<algo>:<hex>"`` — ``xxh64`` when the C extension is
+available (the normal container), ``blake2b16`` (8-byte blake2b, stdlib)
+otherwise — so a scrub always re-hashes with the ALGORITHM THE WRITER
+USED, and moving an index between environments can never manufacture a
+false mismatch.  Entries serialized before digests existed load with
+``digest=None`` and scrub as ``status="unknown"``.
+
+Recording is a process-global map (abspath → digest), like the fault
+injector: the writer (``write_bucket_run``) and the consumer
+(``Directory._scan``) are separated by the action layer and a
+thread-pool, so threading a handle through every call chain would touch
+a dozen signatures for what is one put and one get per file.  The map is
+bounded (LRU) — an abandoned build can never grow it without limit.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+try:  # the normal container ships the C extension; stdlib fallback below
+    import xxhash as _xxhash
+except ImportError:  # pragma: no cover - exercised via the algo registry
+    _xxhash = None
+
+_CHUNK = 1 << 20  # streamed hashing granularity (1 MiB)
+_MAX_RECORDED = 8192  # LRU bound on the write-site recorder
+
+
+def _xxh64_hasher():
+    return _xxhash.xxh64()
+
+
+def _blake2b16_hasher():
+    import hashlib
+
+    return hashlib.blake2b(digest_size=8)
+
+
+# algo name -> zero-arg hasher factory (objects expose update/hexdigest).
+_ALGOS = {}
+if _xxhash is not None:
+    _ALGOS["xxh64"] = _xxh64_hasher
+_ALGOS["blake2b16"] = _blake2b16_hasher
+
+DEFAULT_ALGO = "xxh64" if _xxhash is not None else "blake2b16"
+
+
+def digest_bytes(data: bytes, algo: str = None) -> str:
+    algo = algo or DEFAULT_ALGO
+    h = _ALGOS[algo]()
+    h.update(data)
+    return f"{algo}:{h.hexdigest()}"
+
+
+def digest_file(path: str, algo: str = None) -> str:
+    """Streamed content digest of ``path`` (never loads the file whole)."""
+    algo = algo or DEFAULT_ALGO
+    h = _ALGOS[algo]()
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(_CHUNK)
+            if not chunk:
+                break
+            h.update(chunk)
+    return f"{algo}:{h.hexdigest()}"
+
+
+def verify_file(path: str, expected: str) -> Optional[bool]:
+    """True/False for a recomputable digest; None when ``expected`` names
+    an algorithm this environment cannot run (scrub reports "unknown"
+    instead of inventing a mismatch)."""
+    algo = expected.split(":", 1)[0] if ":" in expected else ""
+    if algo not in _ALGOS:
+        return None
+    return digest_file(path, algo) == expected
+
+
+# ---------------------------------------------------------------------------
+# The write-site recorder
+# ---------------------------------------------------------------------------
+_enabled = True
+_recorded: "OrderedDict[str, str]" = OrderedDict()
+_lock = threading.Lock()
+
+
+def configure_from_conf(conf) -> None:
+    """Apply ``hyperspace.system.integrity.digestOnWrite`` (sessions call
+    this at construction; actions re-apply before writing so the latest
+    conf value wins even for a long-lived session object)."""
+    set_enabled(bool(getattr(conf, "integrity_digest_on_write", True)))
+
+
+def set_enabled(enabled: bool) -> None:
+    global _enabled
+    _enabled = bool(enabled)
+
+
+def record_file(path: str) -> Optional[str]:
+    """Hash the just-written file at ``path`` and remember its digest for
+    the content-tree builder; no-op (None) when digest-on-write is off."""
+    if not _enabled:
+        return None
+    digest = digest_file(path)
+    key = os.path.abspath(path)
+    with _lock:
+        _recorded[key] = digest
+        _recorded.move_to_end(key)
+        while len(_recorded) > _MAX_RECORDED:
+            _recorded.popitem(last=False)
+    return digest
+
+
+def recorded_digest(path: str) -> Optional[str]:
+    """The digest recorded for ``path`` at write time, if any (source
+    files are never recorded, so their FileInfos keep digest=None)."""
+    with _lock:
+        return _recorded.get(os.path.abspath(path))
+
+
+def clear_recorded() -> None:
+    with _lock:
+        _recorded.clear()

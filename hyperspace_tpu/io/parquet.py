@@ -127,6 +127,13 @@ def read_parquet_file(path: str, columns=None) -> pa.Table:
     optimize compaction, sketches, and schema checks.  Every
     single-file read in the engine goes through here (and through the
     ``data.read`` fault site + transient retry)."""
+    from hyperspace_tpu.io import faults
+
+    # Corruption checkpoint: a bitrot/truncate plan armed at data.read
+    # damages the file ON DISK just before this read — the read then
+    # fails (or decodes garbage) exactly like bit-rot discovered at
+    # query time, and stays failed on retry (corruption is persistent).
+    faults.corrupt_file("data.read", path)
     return _read_retry(
         lambda: pq.read_table(path, columns=columns, partitioning=None))
 
@@ -335,6 +342,8 @@ def write_bucket_run(sorted_bucket_table: pa.Table, bucket: int,
                                max_rows_per_file)
     from hyperspace_tpu.io import faults
 
+    from hyperspace_tpu.io import integrity
+
     out: List[str] = []
     for off, rows in chunks:
         path = os.path.join(out_dir, bucket_file_name(bucket))
@@ -344,6 +353,11 @@ def write_bucket_run(sorted_bucket_table: pa.Table, bucket: int,
         faults.check("data.write")
         pq.write_table(sorted_bucket_table.slice(off, rows), path,
                        compression=_codec(compression))
+        # Digest of the INTENDED bytes first, then the corruption
+        # checkpoint (bitrot keeps size+mtime, truncate halves the file):
+        # the damage lands after a write the writer believed good.
+        integrity.record_file(path)
+        faults.corrupt_file("data.write", path)
         out.append(path)
     return out
 
@@ -429,7 +443,7 @@ def write_bucketed(table: pa.Table, bucket_ids: np.ndarray, sort_perm: np.ndarra
             jobs.append((b, int(starts[b]) + off, rows))
 
     def write(job) -> str:
-        from hyperspace_tpu.io import faults
+        from hyperspace_tpu.io import faults, integrity
 
         b, start, rows = job
         path = os.path.join(out_dir, bucket_file_name(b))
@@ -438,6 +452,11 @@ def write_bucketed(table: pa.Table, bucket_ids: np.ndarray, sort_perm: np.ndarra
         faults.check("data.write")
         pq.write_table(sorted_table.slice(start, rows), path,
                        compression=_codec(compression))
+        # Digest of the INTENDED bytes first, then the corruption
+        # checkpoint: bitrot/truncate model damage after a write the
+        # writer believed good — exactly what the digest must catch.
+        integrity.record_file(path)
+        faults.corrupt_file("data.write", path)
         return path
 
     return parallel_map_ordered(write, jobs)

@@ -210,6 +210,30 @@ class IsIn(Expr):
         return f"{self.child!r}.isin({self.values!r})"
 
 
+class BucketIn(Expr):
+    """Rows whose hash bucket over ``columns`` (the build kernel's
+    bucketing, ops/hash.bucket_ids_np — bit-identical host mirror) is in
+    ``buckets``.  Built only by the quarantine-containment rewrite
+    (rules/hybrid.py): the source-side branch that replaces a quarantined
+    index bucket is ``Filter(BucketIn(indexed, num_buckets, {b}), Scan)``,
+    so exactly the rows the damaged bucket held are re-read from source.
+    Host-evaluated (never null: nulls hash to their own deterministic
+    bucket, same as the build); opaque to the device router and to every
+    pruning analysis."""
+
+    def __init__(self, columns: Sequence[str], num_buckets: int,
+                 buckets: Sequence[int]) -> None:
+        if not columns or num_buckets <= 0:
+            raise ValueError("BucketIn needs columns and num_buckets > 0")
+        self.columns = tuple(columns)
+        self.num_buckets = int(num_buckets)
+        self.buckets = tuple(sorted({int(b) for b in buckets}))
+
+    def __repr__(self) -> str:
+        return (f"bucket_in({list(self.columns)!r}, {self.num_buckets}, "
+                f"{list(self.buckets)!r})")
+
+
 class StringMatch(Expr):
     """SQL string predicate: like / startswith / endswith / contains.
     Null input yields null (the row drops), matching SQL LIKE."""
@@ -560,6 +584,8 @@ def _collect_columns(e: Expr, out: Set[str]) -> None:
         _collect_columns(e.child, out)
     elif isinstance(e, IsIn):
         _collect_columns(e.child, out)
+    elif isinstance(e, BucketIn):
+        out.update(e.columns)
     elif isinstance(e, IsNull):
         _collect_columns(e.child, out)
     elif isinstance(e, StringMatch):

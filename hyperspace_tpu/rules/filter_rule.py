@@ -76,7 +76,15 @@ class FilterIndexRule:
 
             appended, deleted = hybrid_file_lists(best, scan)
             hybrid_needed = bool(appended or deleted)
-        if hybrid_needed:
+        # Quarantined buckets route through the hybrid transform even on
+        # an exact signature match: the index side drops the damaged
+        # buckets and a BucketIn source branch re-reads exactly their
+        # rows (rules/hybrid.py) — containment instead of PR 2's
+        # whole-index fallback.
+        from hyperspace_tpu.rules.hybrid import quarantined_split
+
+        _, qbuckets = quarantined_split(self.session, best)
+        if hybrid_needed or qbuckets:
             from hyperspace_tpu.rules.hybrid import transform_plan_to_use_hybrid_scan
 
             # Bucket pruning applies to the index PORTION of a hybrid scan

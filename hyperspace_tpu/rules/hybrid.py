@@ -11,16 +11,28 @@ Reference contract: index/rules/RuleUtils.scala —
     deleted_ids)) when rows were deleted (:399-408); appended files are read
     through a separate scan and merged with BucketUnion (join side, so
     bucketing survives, :422-439) or plain Union (filter side).
+
+Beyond the reference — QUARANTINE CONTAINMENT: index data files recorded
+as corrupt (index/quarantine.py; flagged by ``verify_index`` or by an
+execution-time read failure) are treated as deleted-from-index.  The
+whole hash BUCKET a quarantined file belongs to is dropped from the
+index side, and exactly that bucket's source rows are re-read through a
+``Filter(BucketIn(indexed, numBuckets, buckets), Scan(common source
+files))`` branch unioned back in — the same merge shape the
+appended-files path already uses.  One corrupt bucket costs one bucket's
+worth of source IO, not the whole index; PR 2's full source fallback
+remains the last resort.
 """
 
 from __future__ import annotations
 
 import os
-from typing import List, Optional, Sequence, Tuple
+from typing import FrozenSet, List, Optional, Sequence, Tuple
 
 from hyperspace_tpu.actions.create import DATA_FILE_ID_COLUMN
+from hyperspace_tpu.exceptions import HyperspaceError
 from hyperspace_tpu.index.log_entry import FileInfo, IndexLogEntry, IndexLogEntryTags
-from hyperspace_tpu.plan.expr import Col, IsIn, Not
+from hyperspace_tpu.plan.expr import BucketIn, Col, IsIn, Not
 from hyperspace_tpu.plan.nodes import (
     BucketUnion,
     Filter,
@@ -33,6 +45,7 @@ from hyperspace_tpu.plan.nodes import (
 from hyperspace_tpu.rules import rule_utils
 
 _HYBRID_INFO_TAG = "hybridScanFileLists"  # (appended, deleted) FileInfo lists
+_QUARANTINE_TAG = "quarantineSplit"  # (excluded paths, buckets | None)
 
 
 def _file_key(f: FileInfo) -> Tuple[str, int, int]:
@@ -90,6 +103,60 @@ def get_hybrid_scan_candidates(session, entries: Sequence[IndexLogEntry],
     return out
 
 
+def quarantined_split(session, entry: IndexLogEntry
+                      ) -> Tuple[FrozenSet[str], Optional[Tuple[int, ...]]]:
+    """(excluded index file paths, affected bucket ids) for ``entry``.
+
+    A quarantined file poisons its whole BUCKET (a bucket split across
+    several files must drop entirely, or the source branch would
+    duplicate the healthy siblings' rows).  ``buckets is None`` with a
+    non-empty exclusion means the entry is UNUSABLE for containment — a
+    quarantined file whose bucket id cannot be recovered from its name,
+    or a quarantine covering every file — and candidate selection drops
+    it (the query falls back to source, PR 2's behavior).  Memoized per
+    optimize pass through the entry tag (tags reset each pass), so the
+    quarantine store is listed once per entry per query.
+    """
+    cached = entry.get_tag(_QUARANTINE_TAG)
+    if cached is not None:
+        return cached
+    from hyperspace_tpu.io.parquet import bucket_id_of_file
+
+    qpaths = session.index_collection_manager \
+        .quarantine_manager(entry.name).paths()
+    result: Tuple[FrozenSet[str], Optional[Tuple[int, ...]]]
+    if not qpaths:
+        result = (frozenset(), ())
+    else:
+        infos = entry.content.file_infos()
+        flagged = [f.name for f in infos if f.name in qpaths]
+        if not flagged:
+            result = (frozenset(), ())
+        else:
+            buckets = {bucket_id_of_file(p) for p in flagged}
+            if None in buckets:
+                result = (frozenset(f.name for f in infos), None)
+            else:
+                excluded = frozenset(
+                    f.name for f in infos
+                    if bucket_id_of_file(f.name) in buckets)
+                if len(excluded) == len(infos):
+                    # Nothing healthy left to scan: containment would be
+                    # a pure source scan wearing an index costume.
+                    result = (excluded, None)
+                else:
+                    result = (excluded, tuple(sorted(buckets)))
+    entry.set_tag(_QUARANTINE_TAG, result)
+    return result
+
+
+def quarantine_excludes_entry(session, entry: IndexLogEntry) -> bool:
+    """True when quarantine leaves no usable containment plan for
+    ``entry`` (drop it from the candidates; source answers the query)."""
+    excluded, buckets = quarantined_split(session, entry)
+    return bool(excluded) and buckets is None
+
+
 def hybrid_file_lists(entry: IndexLogEntry, scan: Scan
                       ) -> Tuple[List[FileInfo], List[FileInfo]]:
     """(appended, deleted) for this entry vs this scan: the candidate-math
@@ -109,11 +176,27 @@ def transform_plan_to_use_hybrid_scan(session, plan: LogicalPlan, target: Scan,
     swap it for ``target``.  ``prune_to_buckets`` restricts the INDEX side's
     buckets (the appended side is unbucketed raw data and always scans)."""
     appended, deleted = hybrid_file_lists(entry, target)
+    excluded, qbuckets = quarantined_split(session, entry)
+    if excluded and qbuckets is None:
+        # Callers filter unusable entries out of the candidates; reaching
+        # here means a caller skipped that check — refuse loudly (the
+        # degradable rule boundary turns this into a source-scan plan).
+        raise HyperspaceError(
+            f"index {entry.name!r} has unusable quarantined files")
+    if excluded and bucket_union:
+        # The join side's merge is bucket-aligned; a source-side bucket
+        # branch has no bucket structure to align.  JoinIndexRule drops
+        # quarantined entries from its candidates, so this is a guard.
+        raise HyperspaceError(
+            f"index {entry.name!r} has quarantined buckets; bucket-aligned "
+            "join merge is not possible")
     visible_cols = entry.derived_dataset.all_columns
 
+    index_files = None if not excluded else tuple(
+        f.name for f in entry.content.file_infos() if f.name not in excluded)
     index_side: LogicalPlan = Scan(rule_utils.index_scan_relation(
         entry, use_bucket_spec=bucket_union or prune_to_buckets is not None,
-        prune_to_buckets=prune_to_buckets))
+        prune_to_buckets=prune_to_buckets, file_paths=index_files))
     if deleted:
         # Filter(Not(In(lineage, deleted ids))) (RuleUtils.scala:399-408).
         deleted_ids = sorted({f.id for f in deleted})
@@ -121,8 +204,31 @@ def transform_plan_to_use_hybrid_scan(session, plan: LogicalPlan, target: Scan,
                             index_side)
     index_side = Project(visible_cols, index_side)
 
+    src_rel = target.relation
+    repair_side: Optional[LogicalPlan] = None
+    if qbuckets:
+        # Containment branch: the quarantined buckets' rows, re-read from
+        # the COMMON source files (recorded minus deleted — appended
+        # files' rows come through the appended branch for every bucket,
+        # and deleted files' rows must not reappear).  BucketIn uses the
+        # build kernel's host mirror, so the branch returns exactly the
+        # rows the dropped index files held.
+        deleted_keys = {_file_key(f) for f in deleted}
+        common = [f for f in entry.source_file_infos()
+                  if _file_key(f) not in deleted_keys]
+        if common:
+            repair_scan = Scan(ScanRelation(
+                root_paths=src_rel.root_paths,
+                file_format=src_rel.file_format,
+                options=src_rel.options,
+                file_paths=tuple(f.name for f in common),
+            ))
+            repair_side = Project(visible_cols, Filter(
+                BucketIn(tuple(entry.indexed_columns), entry.num_buckets,
+                         qbuckets),
+                repair_scan))
+
     if appended:
-        src_rel = target.relation
         appended_scan = Scan(ScanRelation(
             root_paths=src_rel.root_paths,
             file_format=src_rel.file_format,
@@ -141,7 +247,12 @@ def transform_plan_to_use_hybrid_scan(session, plan: LogicalPlan, target: Scan,
         else:
             # strict: the index ∪ its own source must not silently widen
             # on schema drift (see Union's docstring).
-            merged = Union([index_side, appended_side], strict=True)
+            sides = [index_side, appended_side]
+            if repair_side is not None:
+                sides.append(repair_side)
+            merged = Union(sides, strict=True)
+    elif repair_side is not None:
+        merged = Union([index_side, repair_side], strict=True)
     else:
         merged = index_side
 

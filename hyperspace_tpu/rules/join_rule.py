@@ -95,6 +95,16 @@ class JoinIndexRule:
             entries = self.session.index_collection_manager.get_indexes([States.ACTIVE])
         l_candidates = rule_utils.get_candidate_indexes(self.session, entries, l_scan)
         r_candidates = rule_utils.get_candidate_indexes(self.session, entries, r_scan)
+        # The join rewrite's whole value is the bucket-ALIGNED merge; a
+        # quarantined bucket's source-side replacement has no bucket
+        # structure to align, so any quarantine disqualifies the entry
+        # here (the filter rule still serves it with containment).
+        from hyperspace_tpu.rules.hybrid import quarantined_split
+
+        l_candidates = [e for e in l_candidates
+                        if not quarantined_split(self.session, e)[0]]
+        r_candidates = [e for e in r_candidates
+                        if not quarantined_split(self.session, e)[0]]
         l_usable = _usable_indexes(l_candidates, l_keys, l_required)
         r_usable = _usable_indexes(r_candidates, r_keys, r_required)
         compatible = _compatible_pairs(l_usable, r_usable, l_keys, r_keys)

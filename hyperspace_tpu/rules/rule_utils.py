@@ -34,6 +34,16 @@ def get_candidate_indexes(session, entries: Sequence[IndexLogEntry],
     entries = [e for e in entries if e.is_covering]
     if is_index_applied(scan):
         return []
+    # Integrity gate: an entry whose quarantine leaves no containment plan
+    # (every bucket damaged, or a file→bucket mapping lost) is not a
+    # candidate at all — the query answers from source.  Partially
+    # quarantined entries STAY candidates; the transforms read only the
+    # healthy buckets and re-read the damaged ones from source
+    # (rules/hybrid.py quarantined_split / the BucketIn repair branch).
+    from hyperspace_tpu.rules.hybrid import quarantine_excludes_entry
+
+    entries = [e for e in entries
+               if not quarantine_excludes_entry(session, e)]
     if session.conf.hybrid_scan_enabled:
         from hyperspace_tpu.rules.hybrid import get_hybrid_scan_candidates
 

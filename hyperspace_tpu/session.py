@@ -93,6 +93,11 @@ class HyperspaceSession:
             from hyperspace_tpu.io import faults
 
             faults.install_from_conf(self.conf)
+        # Digest-on-write for index data files (io/integrity.py); actions
+        # re-apply before each build so later conf.set() calls also win.
+        from hyperspace_tpu.io import integrity
+
+        integrity.configure_from_conf(self.conf)
         self._schema_cache: Dict[object, Dict[str, str]] = {}
         # optimize() mutates shared state (the cached IndexLogEntry tags it
         # clears per pass), so concurrent queries — e.g. interop server
@@ -173,10 +178,24 @@ class HyperspaceSession:
 
                 from hyperspace_tpu.sources.interfaces import physical_read_format
 
-                schema = read_schema(
-                    scan.relation.file_paths[0],
-                    physical_read_format(scan.relation.file_format),
-                    scan.relation.options_dict)
+                # The files of one relation share a schema, so any ONE
+                # readable footer serves — and a corrupt first file
+                # (bit-rot, torn put) must not kill PLANNING when a
+                # healthy sibling can answer; the corrupt file itself
+                # fails at execution, where quarantine containment
+                # (dataset.collect) owns the recovery.
+                schema = None
+                for i, path in enumerate(scan.relation.file_paths):
+                    try:
+                        schema = read_schema(
+                            path,
+                            physical_read_format(scan.relation.file_format),
+                            scan.relation.options_dict)
+                        break
+                    except Exception:  # noqa: BLE001 — unreadable file;
+                        # re-raise only if NO file yields a schema
+                        if i == len(scan.relation.file_paths) - 1:
+                            raise
                 if scan.relation.index_scan_of is None:
                     # Source-file subsets (hybrid scan) still carry hive
                     # partition columns parsed below the root paths.

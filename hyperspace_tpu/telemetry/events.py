@@ -84,6 +84,20 @@ class IndexDegradedEvent(HyperspaceEvent):
 
 
 @dataclasses.dataclass
+class IndexScrubEvent(HyperspaceEvent):
+    """One ``verify_index`` pass over an index's data files
+    (actions/verify.py): how many files were checked in which mode
+    (``quick`` = stat-level, ``full`` = re-read + re-hash) and how many
+    were flagged (and quarantined).  ``flagged == 0`` is the healthy
+    heartbeat a scrub cron watches for."""
+
+    index_name: str = ""
+    mode: str = ""
+    files_checked: int = 0
+    files_flagged: int = 0
+
+
+@dataclasses.dataclass
 class HyperspaceIndexUsageEvent(HyperspaceEvent):
     """Emitted when a rule rewrites a query to use indexes
     (HyperspaceEvent.scala:150-156)."""

@@ -574,3 +574,68 @@ def test_lake_schema_memo_is_thread_local(tmp_path):
     t.join()
     assert seen["before"] is None
     assert s._lake_schema_memo == {"mine": {"a": "int64"}}
+
+
+@pytest.mark.parametrize("qstore", [
+    "hyperspace_tpu.io.log_store.PosixLogStore",
+    "hyperspace_tpu.io.log_store.EmulatedObjectStore"])
+def test_concurrent_queries_converge_on_one_quarantine(tmp_path, qstore):
+    """Several threads hit the same torn index file mid-query at once:
+    every thread answers bit-equal with the baseline, and the quarantine
+    converges to EXACTLY one record (put_if_absent arbitration) through
+    either LogStore backend."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
+
+    d = str(tmp_path / "data")
+    os.makedirs(d)
+    rng = np.random.default_rng(13)
+    pq.write_table(pa.table({
+        "k": pa.array(np.arange(300, dtype=np.int64) % 17),
+        "v": pa.array(rng.random(300))}), os.path.join(d, "p.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    s.conf.num_buckets = 2
+    s.conf.log_store_class = qstore
+    hs = Hyperspace(s)
+    hs.create_index(s.read.parquet(d), IndexConfig("cq", ["k"], ["v"]))
+
+    def run_query():
+        return (s.read.parquet(d).filter(col("k") < 9)
+                .select("k", "v").collect()
+                .sort_by([("k", "ascending"), ("v", "ascending")]))
+
+    s.disable_hyperspace()
+    expected = run_query()
+    s.enable_hyperspace()
+
+    # Tear EVERY index file so any thread's bucket hits damage.
+    entry = s.index_collection_manager.get_index("cq")
+    paths = [f.name for f in entry.content.file_infos()]
+    victim = paths[0]
+    with open(victim, "r+b") as f:
+        f.truncate(os.path.getsize(victim) // 2)
+
+    import threading
+
+    results, errors = [None] * 4, []
+
+    def worker(i):
+        try:
+            results[i] = run_query()
+        except Exception as e:  # noqa: BLE001 — collected for assertion
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    for r in results:
+        assert r.equals(expected)
+    qm = s.index_collection_manager.quarantine_manager("cq")
+    assert qm.paths() == {victim}
+    assert len(qm.records()) == 1  # concurrent discoverers: one record

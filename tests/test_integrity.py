@@ -1,0 +1,596 @@
+"""End-to-end index data integrity: checksummed index files, scrub/verify,
+per-file quarantine containment, and repair (docs/15-integrity.md).
+
+The loop under test: DETECT (content digests + verify_index) →
+CONTAIN (quarantine; hybrid-scan serves the damaged bucket from source)
+→ REPAIR (refresh mode="repair" rebuilds only the damaged buckets).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import shutil
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.io import faults, integrity
+from hyperspace_tpu.io.parquet import bucket_id_of_file
+from hyperspace_tpu.plan.expr import BucketIn
+from hyperspace_tpu.plan.nodes import Filter, Scan
+from hyperspace_tpu.telemetry.events import (
+    CollectingEventLogger,
+    IndexDegradedEvent,
+    IndexScrubEvent,
+    set_event_logger,
+)
+
+NUM_BUCKETS = 4
+
+
+def _make_session(tmp_path, subdir="ix"):
+    s = HyperspaceSession(system_path=str(tmp_path / subdir))
+    s.conf.num_buckets = NUM_BUCKETS
+    return s
+
+
+@pytest.fixture()
+def indexed(tmp_path):
+    """Multi-file source + a 4-bucket covering index; yields
+    (session, hyperspace, source_dir, query builder, expected table)."""
+    d = str(tmp_path / "data")
+    os.makedirs(d)
+    rng = np.random.default_rng(7)
+    for i in range(3):
+        n = 120
+        pq.write_table(pa.table({
+            "k": pa.array((np.arange(n) + i * n) % 37, type=pa.int64()),
+            "v": pa.array(rng.random(n)),
+        }), os.path.join(d, f"p{i}.parquet"))
+    s = _make_session(tmp_path)
+    hs = Hyperspace(s)
+    hs.create_index(s.read.parquet(d), IndexConfig("ix", ["k"], ["v"]))
+
+    def query():
+        return (s.read.parquet(d).filter(col("k") == 5)
+                .select("k", "v").collect())
+
+    s.disable_hyperspace()
+    expected = query()
+    s.enable_hyperspace()
+    yield s, hs, d, query, expected
+    set_event_logger(None)
+
+
+def _entry(s, name="ix"):
+    return s.index_collection_manager.get_index(name)
+
+
+def _index_files(s, name="ix"):
+    return [f.name for f in _entry(s, name).content.file_infos()]
+
+
+def _victim_for_value(s, value=5, name="ix"):
+    """The index file of the bucket ``value`` hashes to — the file the
+    fixture's ``k == value`` query actually reads (bucket pruning would
+    never touch any other bucket's file)."""
+    from hyperspace_tpu.io.columnar import to_hash_words
+    from hyperspace_tpu.ops.hash import bucket_ids_np
+
+    bucket = int(bucket_ids_np(
+        [np.asarray(to_hash_words(pa.array([value], type=pa.int64())))],
+        NUM_BUCKETS)[0])
+    for path in _index_files(s, name):
+        if bucket_id_of_file(path) == bucket:
+            return path
+    raise AssertionError(f"no index file for bucket {bucket}")
+
+
+def _bitrot(path: str) -> None:
+    """Flip bytes mid-file, keeping size AND mtime (silent corruption)."""
+    st = os.stat(path)
+    with open(path, "r+b") as f:
+        off = max(0, st.st_size // 2 - 4)
+        f.seek(off)
+        chunk = f.read(8)
+        f.seek(off)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+    os.utime(path, ns=(st.st_atime_ns, st.st_mtime_ns))
+
+
+def _bitrot_pages(path: str) -> None:
+    """Garble the whole data-page region (between the leading magic and
+    the footer), leaving the footer VALID and size+mtime untouched:
+    ``pq.read_metadata`` succeeds, any actual decode fails — the shape
+    only the digest probe can attribute."""
+    st = os.stat(path)
+    with open(path, "rb") as f:
+        data = bytearray(f.read())
+    footer_len = int.from_bytes(data[-8:-4], "little")
+    footer_start = len(data) - 8 - footer_len
+    assert footer_start > 4, "file too small to garble"
+    for i in range(4, footer_start):
+        data[i] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(data)
+    os.utime(path, ns=(st.st_atime_ns, st.st_mtime_ns))
+
+
+def _tables_equal(a: pa.Table, b: pa.Table) -> bool:
+    return a.sort_by([("k", "ascending"), ("v", "ascending")]).equals(
+        b.sort_by([("k", "ascending"), ("v", "ascending")]))
+
+
+# ---------------------------------------------------------------------------
+# Digest-on-write
+# ---------------------------------------------------------------------------
+class TestDigestOnWrite:
+    def test_create_records_digests(self, indexed):
+        s, hs, d, query, expected = indexed
+        infos = _entry(s).content.file_infos()
+        assert infos and all(
+            f.digest and f.digest.startswith(integrity.DEFAULT_ALGO + ":")
+            for f in infos)
+        # The recorded digest matches an independent streamed re-hash.
+        for f in infos:
+            assert integrity.digest_file(f.name) == f.digest
+
+    def test_source_files_have_no_digest(self, indexed):
+        s, hs, d, query, expected = indexed
+        assert all(f.digest is None
+                   for f in _entry(s).source_file_infos())
+
+    def test_refresh_and_optimize_record_digests(self, indexed, tmp_path):
+        s, hs, d, query, expected = indexed
+        rng = np.random.default_rng(8)
+        pq.write_table(pa.table({
+            "k": pa.array(np.arange(50) % 37, type=pa.int64()),
+            "v": pa.array(rng.random(50))}),
+            os.path.join(d, "p3.parquet"))
+        hs.refresh_index("ix", mode="full")
+        assert all(f.digest for f in _entry(s).content.file_infos())
+        s.conf.optimize_file_size_threshold = 1 << 30
+        hs.refresh_index("ix", mode="incremental") \
+            if False else None  # (incremental needs lineage; full above)
+        hs.optimize_index("ix", mode="full")
+        assert all(f.digest for f in _entry(s).content.file_infos())
+
+    def test_digest_on_write_disabled(self, tmp_path):
+        d = str(tmp_path / "data2")
+        os.makedirs(d)
+        pq.write_table(pa.table({"k": pa.array(np.arange(40) % 7,
+                                               type=pa.int64()),
+                                 "v": pa.array(np.arange(40) * 1.0)}),
+                       os.path.join(d, "p.parquet"))
+        s = _make_session(tmp_path, "ix2")
+        s.conf.integrity_digest_on_write = False
+        hs = Hyperspace(s)
+        hs.create_index(s.read.parquet(d), IndexConfig("nodig", ["k"], ["v"]))
+        assert all(f.digest is None
+                   for f in _entry(s, "nodig").content.file_infos())
+        # Full scrub reports "unknown" for digest-less files — never a
+        # fabricated mismatch, and nothing is quarantined.
+        report = hs.verify_index("nodig", mode="full")
+        assert set(report.column("status").to_pylist()) == {"unknown"}
+        assert not any(report.column("quarantined").to_pylist())
+
+
+# ---------------------------------------------------------------------------
+# Scrub
+# ---------------------------------------------------------------------------
+class TestScrub:
+    def test_clean_scrub_both_modes(self, indexed):
+        s, hs, d, query, expected = indexed
+        log = CollectingEventLogger()
+        set_event_logger(log)
+        for mode in ("quick", "full"):
+            report = hs.verify_index("ix", mode=mode)
+            assert set(report.column("status").to_pylist()) == {"ok"}
+        scrubs = [e for e in log.events if isinstance(e, IndexScrubEvent)]
+        assert [e.mode for e in scrubs] == ["quick", "full"]
+        assert all(e.files_flagged == 0 for e in scrubs)
+        assert all(e.files_checked == len(_index_files(s)) for e in scrubs)
+
+    def test_full_scrub_flags_exactly_the_bitrotted_file(self, indexed):
+        s, hs, d, query, expected = indexed
+        victim = _index_files(s)[0]
+        _bitrot(victim)
+        # Quick mode is stat-level and bit-rot preserves size+mtime:
+        # it MUST miss this (that's what full mode exists for).
+        quick = hs.verify_index("ix", mode="quick")
+        assert set(quick.column("status").to_pylist()) == {"ok"}
+        full = hs.verify_index("ix", mode="full")
+        by = dict(zip(full.column("file").to_pylist(),
+                      full.column("status").to_pylist()))
+        assert by[victim] == "digest-mismatch"
+        assert sum(1 for v in by.values() if v != "ok") == 1
+        qm = s.index_collection_manager.quarantine_manager("ix")
+        assert qm.paths() == {victim}
+
+    def test_quick_scrub_flags_truncate_and_missing(self, indexed):
+        s, hs, d, query, expected = indexed
+        files = _index_files(s)
+        truncated, missing = files[0], files[1]
+        with open(truncated, "r+b") as f:
+            f.truncate(os.path.getsize(truncated) // 2)
+        os.unlink(missing)
+        report = hs.verify_index("ix", mode="quick")
+        by = dict(zip(report.column("file").to_pylist(),
+                      report.column("status").to_pylist()))
+        assert by[truncated] == "size-mismatch"
+        assert by[missing] == "missing"
+        qm = s.index_collection_manager.quarantine_manager("ix")
+        assert qm.paths() == {truncated, missing}
+
+    def test_full_scrub_releases_restored_file(self, indexed, tmp_path):
+        s, hs, d, query, expected = indexed
+        victim = _index_files(s)[0]
+        backup = str(tmp_path / "backup.parquet")
+        st = os.stat(victim)
+        shutil.copy2(victim, backup)
+        _bitrot(victim)
+        hs.verify_index("ix", mode="full")
+        qm = s.index_collection_manager.quarantine_manager("ix")
+        assert victim in qm.paths()
+        # Restore from backup (content AND mtime): full scrub verifies
+        # the bytes end to end and releases the quarantine record.
+        shutil.copy2(backup, victim)
+        os.utime(victim, ns=(st.st_atime_ns, st.st_mtime_ns))
+        report = hs.verify_index("ix", mode="full")
+        assert set(report.column("status").to_pylist()) == {"ok"}
+        assert qm.paths() == set()
+
+    def test_verify_unknown_mode_and_missing_index(self, indexed):
+        s, hs, d, query, expected = indexed
+        with pytest.raises(HyperspaceError, match="mode"):
+            hs.verify_index("ix", mode="paranoid")
+        with pytest.raises(HyperspaceError, match="does not exist"):
+            hs.verify_index("nope", mode="quick")
+
+
+# ---------------------------------------------------------------------------
+# Containment: the acceptance scenario
+# ---------------------------------------------------------------------------
+class TestContainment:
+    def test_quarantined_bucket_served_from_source(self, indexed):
+        """THE acceptance loop: bitrot one file → full scrub flags exactly
+        it → the next query still uses the index with only the affected
+        bucket read from source (plan assertion; strict mode proves no
+        DegradedIndexError is involved) → results bit-equal to the
+        no-index run → repair rebuilds only that bucket → clean scrub."""
+        s, hs, d, query, expected = indexed
+        victim = _index_files(s)[0]
+        victim_bucket = bucket_id_of_file(victim)
+        _bitrot(victim)
+        full = hs.verify_index("ix", mode="full")
+        flagged = [f for f, st_ in zip(full.column("file").to_pylist(),
+                                       full.column("status").to_pylist())
+                   if st_ != "ok"]
+        assert flagged == [victim]
+
+        # Strict mode: containment is a normal rewrite, NOT degradation.
+        s.conf.degraded_fallback_to_source = False
+        ds = s.read.parquet(d).filter(col("k") == 5).select("k", "v")
+        plan = ds.optimized_plan()
+        index_scans = [n for n in plan.leaf_relations()
+                       if n.relation.index_scan_of == "ix"]
+        assert index_scans, "index must still be used"
+        for n in index_scans:
+            assert victim not in (n.relation.file_paths or ())
+        bucket_filters = _bucket_in_filters(plan)
+        assert bucket_filters, "source-side BucketIn branch must exist"
+        for f in bucket_filters:
+            assert f.condition.buckets == (victim_bucket,)
+            assert f.condition.num_buckets == NUM_BUCKETS
+        got = ds.collect()
+        assert _tables_equal(got, expected)
+
+        # Repair: only the damaged bucket's files are rewritten.
+        before = set(_index_files(s))
+        hs.refresh_index("ix", mode="repair")
+        after = set(_index_files(s))
+        kept = before & after
+        assert victim not in after
+        assert all(bucket_id_of_file(p) != victim_bucket for p in kept)
+        assert {bucket_id_of_file(p) for p in after - kept} \
+            == {victim_bucket}
+        report = hs.verify_index("ix", mode="full")
+        assert set(report.column("status").to_pylist()) == {"ok"}
+        qm = s.index_collection_manager.quarantine_manager("ix")
+        assert qm.paths() == set()
+        # And the repaired index answers bit-equal, with no BucketIn
+        # branch left in the plan.
+        assert not _bucket_in_filters(ds.optimized_plan())
+        assert _tables_equal(ds.collect(), expected)
+
+    def test_multifile_bucket_drops_whole_bucket(self, tmp_path):
+        """A bucket split across several files (maxRowsPerFile) must drop
+        ENTIRELY when one of its files is quarantined — else the source
+        branch would duplicate the healthy siblings' rows."""
+        d = str(tmp_path / "data")
+        os.makedirs(d)
+        rng = np.random.default_rng(3)
+        n = 400
+        pq.write_table(pa.table({
+            "k": pa.array(np.arange(n) % 11, type=pa.int64()),
+            "v": pa.array(rng.random(n))}), os.path.join(d, "p.parquet"))
+        s = _make_session(tmp_path)
+        s.conf.num_buckets = 2
+        s.conf.index_max_rows_per_file = 40  # several files per bucket
+        hs = Hyperspace(s)
+        hs.create_index(s.read.parquet(d), IndexConfig("mf", ["k"], ["v"]))
+        s.enable_hyperspace()
+        ds = s.read.parquet(d).filter(col("k") < 6).select("k", "v")
+        s.disable_hyperspace()
+        expected = ds.collect()
+        s.enable_hyperspace()
+
+        files = [f.name for f in _entry(s, "mf").content.file_infos()]
+        victim = files[0]
+        bucket = bucket_id_of_file(victim)
+        siblings = [p for p in files if bucket_id_of_file(p) == bucket]
+        assert len(siblings) > 1, "fixture must split the bucket"
+        _bitrot(victim)
+        hs.verify_index("mf", mode="full")
+        plan = ds.optimized_plan()
+        for node in plan.leaf_relations():
+            if node.relation.index_scan_of == "mf":
+                for sib in siblings:
+                    assert sib not in (node.relation.file_paths or ())
+        assert _tables_equal(ds.collect(), expected)
+
+    def test_quarantine_persists_across_sessions(self, indexed, tmp_path):
+        s, hs, d, query, expected = indexed
+        victim = _index_files(s)[0]
+        _bitrot(victim)
+        hs.verify_index("ix", mode="full")
+        # A brand-new session over the same system path sees the
+        # quarantine (it lives in the LogStore, not in memory).
+        s2 = HyperspaceSession(system_path=s.conf.system_path)
+        s2.conf.num_buckets = NUM_BUCKETS
+        s2.enable_hyperspace()
+        ds = s2.read.parquet(d).filter(col("k") == 5).select("k", "v")
+        plan = ds.optimized_plan()
+        assert _bucket_in_filters(plan)
+        assert _tables_equal(ds.collect(), expected)
+
+    def test_join_rule_skips_quarantined_entry(self, indexed):
+        s, hs, d, query, expected = indexed
+        ds = (s.read.parquet(d).filter(col("k") < 3)
+              .join(s.read.parquet(d), col("k") == col("k"))
+              .select("k", "v"))
+        s.disable_hyperspace()
+        base = ds.collect()
+        s.enable_hyperspace()
+        _bitrot(_index_files(s)[0])
+        hs.verify_index("ix", mode="full")
+        out = ds.collect()
+        assert sorted(out.column("k").to_pylist()) == \
+            sorted(base.column("k").to_pylist())
+
+    def test_fully_quarantined_index_falls_back_to_source(self, indexed):
+        """Every bucket damaged: the entry stops being a candidate and the
+        query answers from a plain source scan (PR 2's fallback remains
+        the last resort)."""
+        s, hs, d, query, expected = indexed
+        for path in _index_files(s):
+            _bitrot(path)
+        hs.verify_index("ix", mode="full")
+        got = query()
+        assert _tables_equal(got, expected)
+        assert not any(x["is_index"]
+                       for x in s.last_execution_stats["scans"])
+
+
+def _bucket_in_filters(plan):
+    out = []
+
+    def walk(node):
+        if isinstance(node, Filter) and isinstance(node.condition, BucketIn):
+            out.append(node)
+        for c in node.children:
+            walk(c)
+
+    walk(plan)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Execution-time quarantine + re-plan (dataset.collect containment)
+# ---------------------------------------------------------------------------
+class TestExecutionContainment:
+    def test_truncate_discovered_at_execution(self, indexed):
+        """Corruption that nobody scrubbed: the query's index read dies,
+        the probe quarantines the file, and the SAME collect() answers
+        from the containment re-plan — index still used."""
+        s, hs, d, query, expected = indexed
+        victim = _victim_for_value(s)
+        with open(victim, "r+b") as f:
+            f.truncate(os.path.getsize(victim) // 2)
+        log = CollectingEventLogger()
+        set_event_logger(log)
+        got = query()
+        assert _tables_equal(got, expected)
+        qm = s.index_collection_manager.quarantine_manager("ix")
+        assert victim in qm.paths()
+        # The containment re-plan still reads the index (healthy buckets).
+        assert any(x["is_index"] for x in s.last_execution_stats["scans"])
+        degraded = [e for e in log.events
+                    if isinstance(e, IndexDegradedEvent)]
+        assert degraded and "quarantined" in degraded[0].reason
+
+    def test_bitrot_discovered_at_execution_via_digest_probe(self, indexed):
+        """Mid-file bitrot passes the footer probe; the digest pass still
+        attributes the failure and quarantines the right file."""
+        s, hs, d, query, expected = indexed
+        victim = _victim_for_value(s)
+        _bitrot_pages(victim)
+        # Footer is intact — only digest or decode can see the damage.
+        pq.read_metadata(victim)
+        got = query()
+        assert _tables_equal(got, expected)
+        qm = s.index_collection_manager.quarantine_manager("ix")
+        recs = {r["path"]: r["reason"] for r in qm.records()}
+        assert victim in recs
+
+    def test_containment_disabled_falls_back_whole_index(self, indexed):
+        s, hs, d, query, expected = indexed
+        s.conf.integrity_quarantine_on_failure = False
+        victim = _victim_for_value(s)
+        with open(victim, "r+b") as f:
+            f.truncate(os.path.getsize(victim) // 2)
+        got = query()
+        assert _tables_equal(got, expected)
+        # Whole-index fallback: nothing quarantined, no index scan.
+        qm = s.index_collection_manager.quarantine_manager("ix")
+        assert qm.paths() == set()
+        assert not any(x["is_index"]
+                       for x in s.last_execution_stats["scans"])
+
+    def test_auto_repair_heals_after_containment(self, indexed):
+        s, hs, d, query, expected = indexed
+        s.conf.auto_repair_enabled = True
+        victim = _victim_for_value(s)
+        with open(victim, "r+b") as f:
+            f.truncate(os.path.getsize(victim) // 2)
+        got = query()
+        assert _tables_equal(got, expected)
+        # The same collect() repaired the index behind the answer.
+        qm = s.index_collection_manager.quarantine_manager("ix")
+        assert qm.paths() == set()
+        report = hs.verify_index("ix", mode="full")
+        assert set(report.column("status").to_pylist()) == {"ok"}
+        assert victim not in _index_files(s)
+
+
+# ---------------------------------------------------------------------------
+# Repair edge cases
+# ---------------------------------------------------------------------------
+class TestRepair:
+    def test_repair_noop_without_quarantine(self, indexed):
+        s, hs, d, query, expected = indexed
+        mgr = s.index_collection_manager
+        before = mgr._log_manager("ix").get_latest_id()
+        hs.refresh_index("ix", mode="repair")  # NoChangesError no-op path
+        assert mgr._log_manager("ix").get_latest_id() == before
+
+    def test_repair_rejects_drifted_source(self, indexed):
+        s, hs, d, query, expected = indexed
+        _bitrot(_index_files(s)[0])
+        hs.verify_index("ix", mode="full")
+        # Mutate a source file AFTER indexing: repair must refuse (it
+        # would mix snapshots) and point at refresh instead.
+        src = sorted(glob.glob(os.path.join(d, "*.parquet")))[0]
+        t = pq.read_table(src)
+        pq.write_table(t.slice(0, t.num_rows - 1), src)
+        with pytest.raises(HyperspaceError, match="refresh"):
+            hs.refresh_index("ix", mode="repair")
+
+    def test_repair_with_lineage_preserves_hybrid_deletes(self, tmp_path):
+        """Repair of a lineage index keeps the lineage column intact (the
+        deleted-row filter of hybrid scan must survive a repair)."""
+        d = str(tmp_path / "data")
+        os.makedirs(d)
+        rng = np.random.default_rng(5)
+        for i in range(2):
+            pq.write_table(pa.table({
+                "k": pa.array(np.arange(60) % 13, type=pa.int64()),
+                "v": pa.array(rng.random(60))}),
+                os.path.join(d, f"p{i}.parquet"))
+        s = _make_session(tmp_path)
+        s.conf.lineage_enabled = True
+        hs = Hyperspace(s)
+        hs.create_index(s.read.parquet(d), IndexConfig("lin", ["k"], ["v"]))
+        entry = _entry(s, "lin")
+        assert entry.has_lineage_column()
+        victim = entry.content.file_infos()[0].name
+        _bitrot(victim)
+        hs.verify_index("lin", mode="full")
+        hs.refresh_index("lin", mode="repair")
+        repaired = _entry(s, "lin")
+        assert repaired.has_lineage_column()
+        # New files still carry the lineage column.
+        new_files = [f.name for f in repaired.content.file_infos()
+                     if f.name not in {x.name
+                                       for x in entry.content.file_infos()}]
+        assert new_files
+        for p in new_files:
+            assert "_data_file_id" in pq.read_schema(p).names
+
+
+# ---------------------------------------------------------------------------
+# Hybrid scan × quarantine
+# ---------------------------------------------------------------------------
+class TestHybridQuarantine:
+    def test_appended_files_plus_quarantined_bucket(self, indexed):
+        """Hybrid scan (appended source files) AND a quarantined bucket at
+        once: index side ∪ appended branch ∪ BucketIn branch, bit-equal
+        to the source answer."""
+        s, hs, d, query, expected = indexed
+        s.conf.hybrid_scan_enabled = True
+        rng = np.random.default_rng(9)
+        pq.write_table(pa.table({
+            "k": pa.array(np.full(10, 5), type=pa.int64()),
+            "v": pa.array(rng.random(10))}),
+            os.path.join(d, "appended.parquet"))
+        _bitrot(_index_files(s)[0])
+        hs.verify_index("ix", mode="full")
+        ds = s.read.parquet(d).filter(col("k") == 5).select("k", "v")
+        s.disable_hyperspace()
+        fresh_expected = ds.collect()
+        s.enable_hyperspace()
+        plan = ds.optimized_plan()
+        assert any(n.relation.index_scan_of == "ix"
+                   for n in plan.leaf_relations())
+        assert _bucket_in_filters(plan)
+        assert _tables_equal(ds.collect(), fresh_expected)
+
+
+# ---------------------------------------------------------------------------
+# Lifecycle hygiene
+# ---------------------------------------------------------------------------
+class TestLifecycle:
+    def test_vacuum_clears_quarantine_records(self, indexed):
+        s, hs, d, query, expected = indexed
+        victim = _index_files(s)[0]
+        _bitrot(victim)
+        hs.verify_index("ix", mode="full")
+        qm = s.index_collection_manager.quarantine_manager("ix")
+        assert qm.paths()
+        hs.delete_index("ix")
+        hs.vacuum_index("ix")
+        assert qm.paths() == set()
+
+    def test_versions_skips_stray_files(self, indexed):
+        s, hs, d, query, expected = indexed
+        ix_path = s.index_collection_manager.path_resolver \
+            .get_index_path("ix")
+        with open(os.path.join(ix_path, "v__=7"), "w") as f:
+            f.write("not a directory")
+        from hyperspace_tpu.index.data_manager import IndexDataManager
+
+        assert IndexDataManager(ix_path).versions() == [0]
+
+    def test_quarantine_store_backends(self, indexed):
+        """The quarantine set works identically through both LogStore
+        backends (the logStoreClass seam)."""
+        s, hs, d, query, expected = indexed
+        victim = _index_files(s)[0]
+        for cls in ("hyperspace_tpu.io.log_store.PosixLogStore",
+                    "hyperspace_tpu.io.log_store.EmulatedObjectStore"):
+            s.conf.log_store_class = cls
+            qm = s.index_collection_manager.quarantine_manager("ix")
+            qm.clear()
+            assert qm.add(victim, "test")
+            assert not qm.add(victim, "test-again")  # idempotent
+            assert qm.paths() == {victim}
+            assert qm.is_quarantined(victim)
+            recs = qm.records()
+            assert recs[0]["path"] == victim and recs[0]["reason"] == "test"
+            qm.remove(victim)
+            assert qm.paths() == set()

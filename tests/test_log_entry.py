@@ -175,3 +175,91 @@ def test_bad_latest_stable_pointer_falls_back(tmp_index_root):
     with open(os.path.join(mgr.log_dir, LATEST_STABLE), "w") as f:
         f.write('{"version": "9.9"}')
     assert mgr.get_latest_stable_log().id == 2
+
+
+# ---------------------------------------------------------------------------
+# Integrity backward-compat: entries serialized BEFORE content digests
+# existed (no "digest" key anywhere) must round-trip unchanged, and a
+# scrub of such an index must report status="unknown", never fail.
+# ---------------------------------------------------------------------------
+def test_pre_digest_file_info_roundtrips():
+    # The exact pre-PR-3 JSON shape: four keys, no "digest".
+    legacy = {"name": "/a/b/f1.parquet", "size": 1, "modifiedTime": 10,
+              "id": 0}
+    f = FileInfo.from_dict(legacy)
+    assert f.digest is None
+    # Serializing a digest-less FileInfo reproduces the legacy shape
+    # byte for byte — old readers and golden files never see a new key.
+    assert f.to_dict() == legacy
+    withd = FileInfo("/a/b/f1.parquet", 1, 10, 0, "xxh64:00ff")
+    assert FileInfo.from_dict(withd.to_dict()) == withd
+    assert withd.to_dict()["digest"] == "xxh64:00ff"
+
+
+def test_pre_digest_entry_roundtrips_and_content_walk_keeps_digests():
+    entry = sample_entry()
+    d = entry.to_dict()
+    # No digest keys anywhere in a digest-less entry's serialization.
+    import json
+
+    assert '"digest"' not in json.dumps(d)
+    back = IndexLogEntry.from_dict(d)
+    assert all(f.digest is None for f in back.content.file_infos())
+    # And a digested tree keeps digests through the leaf walk + rebuild.
+    files = [FileInfo("/a/b/f1.parquet", 1, 10, 0, "xxh64:aa"),
+             FileInfo("/a/b/f2.parquet", 2, 20, 1, None)]
+    content = Content.from_leaf_files(files)
+    walked = {f.name: f.digest for f in content.file_infos()}
+    assert walked == {"/a/b/f1.parquet": "xxh64:aa",
+                      "/a/b/f2.parquet": None}
+    rebuilt = Content.from_dict(content.to_dict())
+    assert {f.name: f.digest for f in rebuilt.file_infos()} == walked
+
+
+def test_pre_digest_entry_scrubs_as_unknown(tmp_path):
+    """An index whose committed log predates digests (simulated by
+    stripping every digest key from the log) scrubs as "unknown" in full
+    mode — and quarantines nothing."""
+    import glob
+    import json
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig
+
+    d = str(tmp_path / "data")
+    os.makedirs(d)
+    pq.write_table(pa.table({"k": pa.array(np.arange(40, dtype=np.int64) % 7),
+                             "v": pa.array(np.arange(40) * 1.0)}),
+                   os.path.join(d, "p.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    s.conf.num_buckets = 2
+    hs = Hyperspace(s)
+    hs.create_index(s.read.parquet(d), IndexConfig("old", ["k"], ["v"]))
+
+    def strip_digests(node):
+        if isinstance(node, dict):
+            node.pop("digest", None)
+            for v in node.values():
+                strip_digests(v)
+        elif isinstance(node, list):
+            for v in node:
+                strip_digests(v)
+
+    for path in glob.glob(str(tmp_path / "ix" / "old" /
+                              "_hyperspace_log" / "*")):
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+        strip_digests(data)
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(data, f)
+    s.index_collection_manager.clear_cache()
+
+    report = hs.verify_index("old", mode="full")
+    assert set(report.column("status").to_pylist()) == {"unknown"}
+    assert not any(report.column("quarantined").to_pylist())
+    # Quick mode still fully validates what it can (stat-level).
+    report = hs.verify_index("old", mode="quick")
+    assert set(report.column("status").to_pylist()) == {"ok"}

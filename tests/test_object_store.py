@@ -300,3 +300,161 @@ def test_object_store_manager_via_conf(tmp_path):
            .collect())
     assert out.column("v").to_pylist() == [3.5]
     assert any(x["is_index"] for x in s.last_execution_stats["scans"])
+
+
+# ---------------------------------------------------------------------------
+# Index-data corruption matrix: the new bitrot/truncate fault kinds at the
+# data.write / data.read sites, with the QUARANTINE persisted through both
+# LogStore backends.  The loop must converge — damaged file quarantined,
+# repair restores a clean scrub — and every query must stay bit-equal with
+# the no-fault answer.
+# ---------------------------------------------------------------------------
+_QSTORE_BACKENDS = ["hyperspace_tpu.io.log_store.PosixLogStore",
+                    "hyperspace_tpu.io.log_store.EmulatedObjectStore"]
+
+
+def _integrity_fixture(tmp_path, backend):
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
+
+    d = str(tmp_path / "data")
+    os.makedirs(d)
+    rng = np.random.default_rng(11)
+    for i in range(2):
+        pq.write_table(pa.table({
+            "k": pa.array(np.arange(i * 90, (i + 1) * 90,
+                                    dtype=np.int64) % 23),
+            "v": pa.array(rng.random(90))}),
+            os.path.join(d, f"p{i}.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    s.conf.num_buckets = 3
+    s.conf.log_store_class = backend
+
+    def query():
+        return (s.read.parquet(d).filter(col("k") < 9)
+                .select("k", "v").collect()
+                .sort_by([("k", "ascending"), ("v", "ascending")]))
+
+    return s, Hyperspace(s), d, query
+
+
+@pytest.mark.parametrize("backend", _QSTORE_BACKENDS)
+def test_data_write_bitrot_converges(tmp_path, backend):
+    """bitrot fired at data.write during the build: the committed entry
+    carries the INTENDED digest over silently damaged bytes (size, mtime
+    and even the parquet footer stay valid, so the build's own sketch
+    pass cannot see it).  Full scrub flags exactly the damaged file,
+    queries stay bit-equal via containment, and repair restores a clean
+    index."""
+    s, hs, d, query = _integrity_fixture(tmp_path, backend)
+    expected = query()  # no index yet: the no-fault source answer
+
+    faults.install(faults.FaultPlan(site="data.write", kind="bitrot",
+                                    at=1, count=1))
+    from hyperspace_tpu import IndexConfig
+
+    hs.create_index(s.read.parquet(d), IndexConfig("cw", ["k"], ["v"]))
+    faults.clear()
+
+    report = hs.verify_index("cw", mode="full")
+    statuses = dict(zip(report.column("file").to_pylist(),
+                        report.column("status").to_pylist()))
+    flagged = {f for f, st in statuses.items() if st != "ok"}
+    assert len(flagged) == 1
+    assert statuses[flagged.pop()] == "digest-mismatch"
+    qm = s.index_collection_manager.quarantine_manager("cw")
+    assert len(qm.paths()) == 1  # convergence: exactly the damaged file
+
+    s.enable_hyperspace()
+    assert query().equals(expected)  # parity under containment
+    hs.refresh_index("cw", mode="repair")
+    assert qm.paths() == set()
+    report = hs.verify_index("cw", mode="full")
+    assert set(report.column("status").to_pylist()) == {"ok"}
+    assert query().equals(expected)  # parity after repair
+    assert any(x["is_index"] for x in s.last_execution_stats["scans"])
+
+
+@pytest.mark.parametrize("backend", _QSTORE_BACKENDS)
+def test_data_write_truncate_never_commits(tmp_path, backend):
+    """truncate fired at data.write: the build's sketch pass re-reads the
+    footers of its own output, so a torn index data file fails the CREATE
+    loudly instead of committing — and the query still answers with
+    parity from source (no index, no quarantine needed)."""
+    s, hs, d, query = _integrity_fixture(tmp_path, backend)
+    expected = query()
+
+    faults.install(faults.FaultPlan(site="data.write", kind="truncate",
+                                    at=1, count=1))
+    from hyperspace_tpu import IndexConfig
+
+    with pytest.raises(Exception):
+        hs.create_index(s.read.parquet(d), IndexConfig("cw", ["k"], ["v"]))
+    faults.clear()
+    assert s.index_collection_manager.get_index("cw") is None
+    s.enable_hyperspace()
+    assert query().equals(expected)
+    # The failed attempt left only a transient entry; a clean rebuild
+    # (after auto-recovery) commits and accelerates.
+    s.conf.set("hyperspace.index.autoRecovery.enabled", True)
+    hs.create_index(s.read.parquet(d), IndexConfig("cw", ["k"], ["v"]))
+    assert query().equals(expected)
+    assert any(x["is_index"] for x in s.last_execution_stats["scans"])
+
+
+@pytest.mark.parametrize("backend", _QSTORE_BACKENDS)
+@pytest.mark.parametrize("kind", ["bitrot", "truncate"])
+def test_data_read_corruption_converges(tmp_path, backend, kind):
+    """``kind`` fired at data.read: the file is damaged on disk at read
+    time (rot discovered at query time).  The engine's read raises, the
+    execution-failure probe quarantines the file, and the query still
+    answers bit-equal."""
+    from hyperspace_tpu import IndexConfig
+    from hyperspace_tpu.io.parquet import read_parquet_file
+
+    s, hs, d, query = _integrity_fixture(tmp_path, backend)
+    expected = query()
+    hs.create_index(s.read.parquet(d), IndexConfig("cr", ["k"], ["v"]))
+    victim = s.index_collection_manager.get_index("cr") \
+        .content.file_infos()[0].name
+
+    faults.install(faults.FaultPlan(site="data.read", kind=kind,
+                                    at=1, count=1))
+    # The armed read: corruption lands on disk just before this read of
+    # the chosen index file (truncate makes it raise immediately; bitrot
+    # may or may not — the damage persists either way).
+    try:
+        read_parquet_file(victim)
+    except Exception:
+        pass
+    faults.clear()
+
+    # The damage is REAL and persistent: a full scrub sees it.
+    report = hs.verify_index("cr", mode="full")
+    statuses = dict(zip(report.column("file").to_pylist(),
+                        report.column("status").to_pylist()))
+    assert statuses[victim] in ("digest-mismatch", "size-mismatch")
+    qm = s.index_collection_manager.quarantine_manager("cr")
+    assert qm.paths() == {victim}
+
+    s.enable_hyperspace()
+    assert query().equals(expected)
+    hs.refresh_index("cr", mode="repair")
+    assert qm.paths() == set()
+    assert query().equals(expected)
+
+
+def test_corruption_kinds_do_not_fire_at_check_sites():
+    """bitrot/truncate are content kinds: a plan armed with them must not
+    consume calls (or raise) at the ordinary check()/fire() sites."""
+    plan = faults.FaultPlan(site="log.write", kind="bitrot", at=1, count=1)
+    faults.install(plan)
+    try:
+        faults.check("log.write")       # must not raise or count
+        assert faults.fire("log.write") is None
+        assert plan._calls == 0
+    finally:
+        faults.clear()
