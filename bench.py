@@ -42,6 +42,9 @@ Environment knobs:
                         bounded by the remaining global budget only)
   HS_BENCH_RESULTS      per-section checkpoint file (JSONL; default
                         bench_results.jsonl, "" disables)
+  HS_BENCH_TRACE        span-trace sink (JSONL, one root span per bench
+                        section / traced query; default
+                        <HS_BENCH_RESULTS>.trace.jsonl, "" disables)
   HS_BENCH_LINEITEM / HS_BENCH_ORDERS / HS_BENCH_FILES / HS_BENCH_REPS
                         SF1 scale overrides (resilience tests shrink them)
   HS_BENCH_SF10 / HS_BENCH_SF100 / HS_BENCH_SF10_BUDGET /
@@ -72,6 +75,8 @@ REPEATS = int(os.environ.get("HS_BENCH_REPS", 5))
 BUDGET_S = float(os.environ.get("HS_BENCH_BUDGET", "6300"))
 SECTION_CAP_S = float(os.environ.get("HS_BENCH_SECTION_CAP", "0"))
 RESULTS_PATH = os.environ.get("HS_BENCH_RESULTS", "bench_results.jsonl")
+TRACE_PATH = os.environ.get(
+    "HS_BENCH_TRACE", (RESULTS_PATH + ".trace.jsonl") if RESULTS_PATH else "")
 
 # Soft deadline for the CURRENT section (monotonic seconds): the timing
 # helpers stop launching new reps once it passes, so a section winds down
@@ -706,6 +711,21 @@ class _Harness:
                 self._results_broken = True
                 print(f"bench: results file unwritable ({e}); "
                       "checkpoints go to stdout only", file=sys.stderr)
+        if TRACE_PATH:
+            # Span tracing for the whole run: each section (and every
+            # query.collect inside it) lands as one root span in the
+            # JSONL sink — the machine-readable trace the CI smoke step
+            # greps for required span kinds (docs/16-observability.md).
+            from hyperspace_tpu.telemetry import trace
+
+            try:
+                open(TRACE_PATH, "w").close()  # one file per run
+                trace.add_sink(trace.JsonlTraceSink(TRACE_PATH))
+                trace.enable_tracing()
+                self.detail["trace_file"] = TRACE_PATH
+            except OSError as e:
+                print(f"bench: trace sink unwritable ({e}); tracing off",
+                      file=sys.stderr)
         signal.signal(signal.SIGALRM, self._on_alarm)
         signal.signal(signal.SIGTERM, self._on_term)
 
@@ -774,7 +794,10 @@ class _Harness:
                 # wind down softly; a single runaway op gets interrupted.
                 signal.alarm(max(1, int(cap) + 5))
             self._in_section = True
-            updates = fn()
+            from hyperspace_tpu.telemetry.trace import span as _span
+
+            with _span(f"bench.{name}"):
+                updates = fn()
             self._in_section = False
         except _SkipSection as e:
             self._mark(name, "skipped", time.perf_counter() - t0, str(e))
@@ -854,6 +877,8 @@ def main() -> None:
             harness.section("kernel_bench",
                             lambda: {"kernel_bench": _kernel_microbench()})
             harness.section("calibration", lambda: _sec_calibration())
+            harness.section("telemetry_overhead",
+                            lambda: _sec_telemetry_overhead(ctx))
             harness.section("integrity", lambda: _sec_integrity(root))
             harness.section("sf10", lambda: _sec_sf10(ctx, root, harness))
             harness.section("sf100", lambda: _sec_sf100(ctx, root, harness))
@@ -866,7 +891,8 @@ def main() -> None:
             for name in ("setup", "sf1_queries", "device_agg_probe",
                          "resident_agg", "warm_resident_join", "warm_q3",
                          "warm_q10", "window_bench", "kernel_bench",
-                         "calibration", "integrity", "sf10", "sf100"):
+                         "calibration", "telemetry_overhead", "integrity",
+                         "sf10", "sf100"):
                 if name not in harness.detail \
                         and not any(s["section"] == name
                                     for s in harness.sections):
@@ -1518,6 +1544,59 @@ def _sec_calibration() -> dict:
     from hyperspace_tpu.utils.calibrate import profile_summary
 
     return {"calibration": profile_summary()}
+
+
+def _sec_telemetry_overhead(ctx: dict) -> dict:
+    """The observability cost contract (docs/16-observability.md):
+    tracing OFF must be unmeasurable on the hot path — the disabled
+    ``span()`` is one module-global bool check returning a shared no-op,
+    microbenched here and CORRECTNESS-GATED at < 10 µs/call (measured
+    ~0.3 µs; the gate is loose only for noisy CI hosts) — and tracing ON
+    is recorded as a percentage on a real indexed query (spans are
+    file/operator-granular, so single-digit percent is the expectation,
+    not a gate: a 2 ms query under a 5-span trace is dominated by
+    timer noise)."""
+    from hyperspace_tpu.telemetry import trace
+
+    _require(ctx, "session", "queries")
+    q = dict(ctx["queries"])["filter"]
+
+    was_enabled = trace.tracing_enabled()
+    try:
+        # Disabled-path microbench: cost per span() call with tracing off.
+        trace.disable_tracing()
+        n = 200_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with trace.span("bench.noop"):
+                pass
+        disabled_ns = (time.perf_counter() - t0) / n * 1e9
+
+        reps = max(3, REPEATS)
+        q()  # warm: imports/JIT land outside the off-vs-on comparison
+        t_off = _time(q, repeats=reps)
+        trace.enable_tracing()
+        t_on = _time(q, repeats=reps)
+    finally:
+        if was_enabled:
+            trace.enable_tracing()
+        else:
+            trace.disable_tracing()
+    overhead_pct = ((t_on["median"] - t_off["median"])
+                    / t_off["median"] * 100.0)
+    if disabled_ns > 10_000:
+        # The "zero cost when disabled" contract broke: someone put real
+        # work on the disabled span path.  Same policy as a diverged
+        # query answer — fail the bench loudly.
+        raise SystemExit(
+            f"telemetry bench: disabled span() costs {disabled_ns:.0f} "
+            f"ns/call (contract: unmeasurable, gate 10000 ns)")
+    return {"telemetry_overhead": {
+        "span_disabled_ns_per_call": round(disabled_ns, 1),
+        "query_tracing_off_s": _stat(t_off),
+        "query_tracing_on_s": _stat(t_on),
+        "tracing_on_overhead_pct": round(overhead_pct, 2),
+    }}
 
 
 def _sec_integrity(root: str) -> dict:

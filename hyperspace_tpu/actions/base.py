@@ -45,7 +45,7 @@ from hyperspace_tpu.exceptions import ConcurrentWriteError, HyperspaceError, NoC
 from hyperspace_tpu.index.log_entry import IndexLogEntry, States
 from hyperspace_tpu.index.log_manager import IndexLogManager
 from hyperspace_tpu.io import faults
-from hyperspace_tpu.telemetry.events import HyperspaceEvent, _IndexActionEvent, get_event_logger
+from hyperspace_tpu.telemetry.events import HyperspaceEvent, _IndexActionEvent, emit_event
 from hyperspace_tpu.utils.retry import RetryPolicy
 
 
@@ -134,30 +134,44 @@ class Action:
     def run(self) -> None:
         """Action.scala:84-105, wrapped in the conflict-retrying
         transaction loop (concurrency_max_retries=0 ⇒ reference
-        behavior: first conflict aborts)."""
-        logger = get_event_logger()
+        behavior: first conflict aborts).
+
+        Every turn of the loop is telemetry-visible: a ``CONFLICT_RETRY
+        n/max`` ActionEvent per absorbed conflict (attempt number +
+        conflict reason in ``state``/``message``) and the
+        ``action.conflict.retries`` counter, so PR 2's silent rebases can
+        be audited per action after the fact."""
+        from hyperspace_tpu.telemetry.trace import span
 
         def emit(state: str, message: str = "") -> None:
             if self.event_class is not None:
-                logger.log_event(self.event_class(
+                emit_event(self.event_class(
                     index_name=self.index_name, state=state, message=message))
 
         rng = random.Random()
-        while True:
-            try:
-                self._attempt(emit)
-                return
-            except ConcurrentWriteError:
-                if self.conflict_retries >= self.concurrency_max_retries:
-                    emit("FAILURE", "concurrent modification")
-                    raise
-                self.conflict_retries += 1
-                # Jittered backoff so two rebased racers don't re-collide
-                # in lockstep (and a stale object-store listing gets its
-                # visibility window to pass before the re-validation).
-                time.sleep(self.conflict_backoff.delay_s(
-                    self.conflict_retries - 1, rng))
-                self._rebase()
+        with span(f"action.{type(self).__name__}",
+                  index=self.index_name) as sp:
+            while True:
+                try:
+                    self._attempt(emit)
+                    sp.set(conflict_retries=self.conflict_retries)
+                    return
+                except ConcurrentWriteError as e:
+                    if self.conflict_retries >= self.concurrency_max_retries:
+                        emit("FAILURE", "concurrent modification")
+                        raise
+                    self.conflict_retries += 1
+                    emit(f"CONFLICT_RETRY "
+                         f"{self.conflict_retries}/"
+                         f"{self.concurrency_max_retries}",
+                         f"concurrent write at base_id={self.base_id}: {e}")
+                    # Jittered backoff so two rebased racers don't
+                    # re-collide in lockstep (and a stale object-store
+                    # listing gets its visibility window to pass before
+                    # the re-validation).
+                    time.sleep(self.conflict_backoff.delay_s(
+                        self.conflict_retries - 1, rng))
+                    self._rebase()
 
     def _attempt(self, emit) -> None:
         try:

@@ -37,7 +37,7 @@ from hyperspace_tpu.index.log_entry import IndexLogEntry
 from hyperspace_tpu.index.log_manager import IndexLogManager
 from hyperspace_tpu.index.quarantine import QuarantineManager
 from hyperspace_tpu.io import integrity
-from hyperspace_tpu.telemetry.events import IndexScrubEvent, get_event_logger
+from hyperspace_tpu.telemetry.events import IndexScrubEvent, emit_event
 
 # Statuses a scrub can assign; FLAGGED ones are quarantined.
 STATUS_OK = "ok"
@@ -145,7 +145,7 @@ class VerifyIndexAction:
             # reports and a leak over many repair cycles.
             for stale in already - referenced:
                 self.quarantine.remove(stale)
-        get_event_logger().log_event(IndexScrubEvent(
+        emit_event(IndexScrubEvent(
             index_name=entry.name, mode=self.mode,
             files_checked=len(infos), files_flagged=flagged,
             message=f"scrub[{self.mode}] {entry.name}: "

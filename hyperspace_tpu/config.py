@@ -60,6 +60,8 @@ INTEGRITY_QUARANTINE_ON_FAILURE = \
 IO_RETRY_MAX_ATTEMPTS = "hyperspace.system.io.retry.maxAttempts"
 IO_RETRY_INITIAL_BACKOFF_MS = "hyperspace.system.io.retry.initialBackoffMs"
 IO_RETRY_MAX_BACKOFF_MS = "hyperspace.system.io.retry.maxBackoffMs"
+TELEMETRY_TRACING_ENABLED = "hyperspace.system.telemetry.tracing.enabled"
+TELEMETRY_TRACE_SINK = "hyperspace.system.telemetry.trace.sink"
 FAULT_INJECTION_ENABLED = "hyperspace.system.faultInjection.enabled"
 FAULT_INJECTION_SITE = "hyperspace.system.faultInjection.site"
 FAULT_INJECTION_KIND = "hyperspace.system.faultInjection.kind"
@@ -248,6 +250,15 @@ class HyperspaceConf:
     io_retry_max_attempts: int = 3
     io_retry_initial_backoff_ms: float = 10.0
     io_retry_max_backoff_ms: float = 1000.0
+    # Observability (telemetry/trace.py; docs/16-observability.md):
+    # tracing.enabled turns on per-query span trees (disabled cost: one
+    # module-global bool check per instrumented site); trace.sink is a
+    # JSONL file path every finished root span is appended to — the
+    # machine-readable artifact bench.py and production runs leave.
+    # Run reports and the metrics registry are always on (their cost is
+    # a contextvar read / a dict increment at file/action granularity).
+    telemetry_tracing_enabled: bool = False
+    telemetry_trace_sink: str = ""
     # Deterministic fault injection (io/faults.py): fire ``kind`` at the
     # ``at``-th call of ``site``, ``count`` times.  Test-only machinery;
     # disabled costs one None check per file-level IO op.
@@ -306,6 +317,8 @@ class HyperspaceConf:
         IO_RETRY_MAX_ATTEMPTS: "io_retry_max_attempts",
         IO_RETRY_INITIAL_BACKOFF_MS: "io_retry_initial_backoff_ms",
         IO_RETRY_MAX_BACKOFF_MS: "io_retry_max_backoff_ms",
+        TELEMETRY_TRACING_ENABLED: "telemetry_tracing_enabled",
+        TELEMETRY_TRACE_SINK: "telemetry_trace_sink",
         FAULT_INJECTION_ENABLED: "fault_injection_enabled",
         FAULT_INJECTION_SITE: "fault_injection_site",
         FAULT_INJECTION_KIND: "fault_injection_kind",

@@ -285,7 +285,47 @@ class Dataset:
         return self.session.optimize(self.plan, use_indexes=use_indexes)
 
     def collect(self) -> pa.Table:
+        """Optimize + execute, wrapped in the query-lifecycle trace and a
+        :class:`~hyperspace_tpu.telemetry.report.QueryRunReport`: every
+        branch this method can take (re-plan, quarantine containment,
+        source fallback) is recorded so ``last_run_report()`` can explain
+        the query afterwards — docs/16-observability.md."""
+        from hyperspace_tpu.telemetry import report as run_report
+        from hyperspace_tpu.telemetry import trace
+
+        # Conf set after session construction still wins (same contract as
+        # the fault injector / integrity conf re-application).
+        trace.configure_from_conf(self.session.conf)
+        token = run_report.start()
+        query_span = None
+        try:
+            with trace.span("query.collect") as sp:
+                query_span = sp  # the real Span when tracing is enabled
+                out = self._collect_traced()
+        except Exception:
+            rep = run_report.active()
+            if rep is not None:
+                rep.outcome = "error"
+            raise
+        finally:
+            rep = run_report.finish(token)
+            if isinstance(query_span, trace.Span):
+                rep.root_span = query_span
+            self.session.last_run_report_value = rep
+        return out
+
+    def last_run_report(self):
+        """The run report of this session's most recent ``collect()`` on
+        the calling thread (None before any query), explaining which
+        indexes were considered/used/skipped, every degraded/quarantine
+        decision, and — when tracing was enabled — where time went."""
+        return self.session.last_run_report_value
+
+    def _collect_traced(self) -> pa.Table:
         from hyperspace_tpu.execution.executor import Executor
+        from hyperspace_tpu.telemetry import report as run_report
+        from hyperspace_tpu.telemetry import metrics
+        from hyperspace_tpu.telemetry.trace import span
 
         executor = Executor(self.session)
         try:
@@ -301,15 +341,19 @@ class Dataset:
                 raise
             from hyperspace_tpu.telemetry.events import (
                 IndexDegradedEvent,
-                get_event_logger,
+                emit_event,
             )
 
-            get_event_logger().log_event(IndexDegradedEvent(
+            emit_event(IndexDegradedEvent(
                 reason=f"index-aware planning failed: {e!r}",
                 message="re-planned without index rewrites"))
-            plan = self.optimized_plan(use_indexes=False)
+            run_report.record("replan", mode="source-fallback",
+                              stage="planning")
+            with span("optimize.replan", mode="source-fallback"):
+                plan = self.optimized_plan(use_indexes=False)
         try:
-            out = executor.execute(plan)
+            with span("execute"):
+                out = executor.execute(plan)
         except Exception as e:  # noqa: BLE001 — InjectedCrash is a
             # BaseException and still dies like a real crash.
             index_names = _index_scans_of(plan)
@@ -318,7 +362,7 @@ class Dataset:
                 raise
             from hyperspace_tpu.telemetry.events import (
                 IndexDegradedEvent,
-                get_event_logger,
+                emit_event,
             )
 
             # CONTAINMENT first (the integrity loop, docs/15-integrity.md):
@@ -328,18 +372,28 @@ class Dataset:
             # bucket costs one bucket's source IO, not the whole index.
             out = None
             if self.session.conf.integrity_quarantine_on_failure:
-                damaged = _quarantine_damaged_index_files(self.session, plan)
+                with span("containment.probe") as sp:
+                    damaged = _quarantine_damaged_index_files(
+                        self.session, plan)
+                    sp.set(quarantined=len(damaged))
                 if damaged:
-                    get_event_logger().log_event(IndexDegradedEvent(
+                    metrics.inc("quarantine.files", len(damaged))
+                    run_report.record(
+                        "quarantine", index=",".join(index_names),
+                        files=damaged)
+                    emit_event(IndexDegradedEvent(
                         index_name=",".join(index_names),
                         reason=f"index scan failed at execution: {e!r}; "
                                f"quarantined {len(damaged)} damaged "
                                f"file(s)",
                         message="re-planned with damaged buckets read "
                                 "from source"))
+                    run_report.record("replan", mode="containment",
+                                      stage="execution")
                     try:
                         executor = Executor(self.session)
-                        out = executor.execute(self.optimized_plan())
+                        with span("execute.replan", mode="containment"):
+                            out = executor.execute(self.optimized_plan())
                     except Exception:  # noqa: BLE001 — containment is
                         # best-effort; the full fallback below still owns
                         # the answer (InjectedCrash stays fatal).
@@ -360,12 +414,16 @@ class Dataset:
                 # Degraded mode, execution stage — the LAST resort: re-plan
                 # WITHOUT index rewrites and run the source scan; a failure
                 # of that plan is a genuine source problem and propagates.
-                get_event_logger().log_event(IndexDegradedEvent(
+                emit_event(IndexDegradedEvent(
                     index_name=",".join(index_names),
                     reason=f"index scan failed at execution: {e!r}",
                     message="re-executed against the source scan"))
+                run_report.record("replan", mode="source-fallback",
+                                  stage="execution")
                 executor = Executor(self.session)
-                out = executor.execute(self.optimized_plan(use_indexes=False))
+                with span("execute.replan", mode="source-fallback"):
+                    out = executor.execute(
+                        self.optimized_plan(use_indexes=False))
         # Physical stats of the most recent execution (join strategies,
         # scan file counts) — read by verbose explain and tests.
         self.session.last_execution_stats = executor.stats

@@ -75,13 +75,17 @@ class DeviceColumnCache:
         self.evictions = 0
 
     def get(self, key: Key):
+        from hyperspace_tpu.telemetry import metrics
+
         with self._lock:
             arr = self._entries.get(key)
             if arr is None:
                 self.misses += 1
+                metrics.inc("cache.device.misses")
                 return None
             self._entries.move_to_end(key)
             self.hits += 1
+            metrics.inc("cache.device.hits")
             return arr
 
     def contains(self, key: Key) -> bool:
@@ -102,6 +106,8 @@ class DeviceColumnCache:
                     self._rejected.clear()
                 self._rejected.add(key)
             return
+        from hyperspace_tpu.telemetry import metrics
+
         with self._lock:
             if key in self._entries:
                 self._entries.move_to_end(key)
@@ -110,9 +116,11 @@ class DeviceColumnCache:
                 old_key, _old = self._entries.popitem(last=False)
                 self.bytes_cached -= self._nbytes.pop(old_key)
                 self.evictions += 1
+                metrics.inc("cache.device.evictions")
             self._entries[key] = arr
             self._nbytes[key] = nbytes
             self.bytes_cached += nbytes
+            metrics.set_gauge("cache.device.bytes", self.bytes_cached)
 
     def clear(self) -> None:
         with self._lock:

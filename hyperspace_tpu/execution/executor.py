@@ -334,14 +334,21 @@ class Executor:
 
     # -- aggregate ----------------------------------------------------------
     def _aggregate(self, plan: Aggregate) -> pa.Table:
-        attempt = self._try_join_aggregate(plan)
-        if attempt is not None:
-            kind, payload = attempt
-            if kind == "done":
-                return payload
-            # Sides were materialized for the attempt; joined on host.
-            return self._aggregate_on_table(plan, payload)
-        return self._aggregate_on_table(plan, self.execute(plan.child))
+        from hyperspace_tpu.telemetry.trace import span
+
+        with span("exec.aggregate", groups=len(plan.group_by)) as sp:
+            attempt = self._try_join_aggregate(plan)
+            if attempt is not None:
+                kind, payload = attempt
+                if kind == "done":
+                    sp.set(strategy="fused_join_agg", rows=payload.num_rows)
+                    return payload
+                # Sides were materialized for the attempt; joined on host.
+                out = self._aggregate_on_table(plan, payload)
+            else:
+                out = self._aggregate_on_table(plan, self.execute(plan.child))
+            sp.set(rows=out.num_rows)
+            return out
 
     def _aggregate_on_table(self, plan: Aggregate,
                             table: pa.Table) -> pa.Table:
@@ -950,6 +957,14 @@ class Executor:
 
     # -- scan ---------------------------------------------------------------
     def _scan(self, plan: Scan, columns: Optional[List[str]] = None) -> pa.Table:
+        from hyperspace_tpu.telemetry.trace import span
+
+        with span("exec.scan") as sp:
+            out = self._scan_inner(plan, columns, sp)
+            sp.set(rows=out.num_rows)
+            return out
+
+    def _scan_inner(self, plan: Scan, columns, sp) -> pa.Table:
         rel = plan.relation
         read_format = physical_read_format(rel.file_format)
         lake_relation = None
@@ -974,6 +989,9 @@ class Executor:
             "files_read": len(paths),
             "files_listed": len(all_paths),
         })
+        sp.set(relation=rel.index_scan_of or ",".join(rel.root_paths),
+               is_index=bool(rel.index_scan_of), files_read=len(paths),
+               files_listed=len(all_paths))
         if not paths:
             # Bucket pruning removed every file (key hashes to an empty
             # bucket): the result is empty but MUST keep the scan schema so
@@ -1204,16 +1222,27 @@ class Executor:
 
     # -- join ---------------------------------------------------------------
     def _join(self, plan: Join, _record: bool = True) -> pa.Table:
-        bucketed = self._try_bucketed_join(plan)
-        if bucketed is not None:
-            return bucketed
-        if _record:
-            self.stats["joins"].append({"strategy": "plain",
-                                        "how": plan.how})
-        left = self.execute(plan.left)
-        right = self.execute(plan.right)
-        return self._host_join_tables(left, right, plan.condition,
-                                      plan.how, residual=plan.residual)
+        from hyperspace_tpu.telemetry.trace import span
+
+        with span("exec.join", how=plan.how) as sp:
+            joins_mark = len(self.stats["joins"])
+            bucketed = self._try_bucketed_join(plan)
+            if bucketed is not None:
+                if len(self.stats["joins"]) > joins_mark:
+                    sp.set(strategy=self.stats["joins"][joins_mark]
+                           .get("strategy"))
+                sp.set(rows=bucketed.num_rows)
+                return bucketed
+            if _record:
+                self.stats["joins"].append({"strategy": "plain",
+                                            "how": plan.how})
+            sp.set(strategy="plain")
+            left = self.execute(plan.left)
+            right = self.execute(plan.right)
+            out = self._host_join_tables(left, right, plan.condition,
+                                         plan.how, residual=plan.residual)
+            sp.set(rows=out.num_rows)
+            return out
 
     def _host_join_tables(self, left: pa.Table, right: pa.Table,
                           condition: Expr, how: str,

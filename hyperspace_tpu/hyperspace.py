@@ -72,3 +72,28 @@ class Hyperspace:
         from hyperspace_tpu.plananalysis.explain import explain_string
 
         return explain_string(dataset, self.session, verbose=verbose)
+
+    def metrics(self) -> dict:
+        """Point-in-time snapshot of the process-wide metrics registry
+        (telemetry/metrics.py): counters like ``io.retry.attempts``,
+        ``log.cas.conflicts``, ``rule.filter.applied``,
+        ``degraded.fallbacks``, ``scrub.files_flagged``, and derived
+        ratios like ``cache.device.hit_ratio`` — the operational
+        aggregate across every query and action this process ran
+        (docs/16-observability.md has the catalog)."""
+        from hyperspace_tpu.telemetry import metrics as m
+
+        return m.snapshot()
+
+    def metrics_text(self) -> str:
+        """The same registry as a Prometheus-style text exposition —
+        scrape it, or drop it in a log line."""
+        from hyperspace_tpu.telemetry import metrics as m
+
+        return m.registry().render_prometheus()
+
+    def reset_metrics(self) -> None:
+        """Zero every series (tests; a bench section isolating deltas)."""
+        from hyperspace_tpu.telemetry import metrics as m
+
+        m.reset()

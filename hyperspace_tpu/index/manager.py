@@ -207,10 +207,10 @@ class IndexCollectionManager:
         self.last_listing_degraded = True
         from hyperspace_tpu.telemetry.events import (
             IndexDegradedEvent,
-            get_event_logger,
+            emit_event,
         )
 
-        get_event_logger().log_event(IndexDegradedEvent(
+        emit_event(IndexDegradedEvent(
             index_name=name, reason=reason,
             message=f"index {name!r} skipped: {reason}"))
 

@@ -231,11 +231,20 @@ class PosixLogStore(LogStore):
 
     def put_if_generation_match(self, key: str, data: bytes,
                                 expected_generation: int) -> bool:
+        from hyperspace_tpu.telemetry import metrics
+        from hyperspace_tpu.telemetry.trace import span
+
         kind = faults.fire("store.put")  # enospc/eio/crash raise here
-        with self._locked():
+        with span("store.put", key=key) as sp, self._locked():
+            metrics.inc("log.store.puts")
             cur = self._meta(key)[0]
             if cur != int(expected_generation):
+                # The optimistic-concurrency signal: some other writer
+                # moved the key's generation between read and CAS.
+                metrics.inc("log.cas.conflicts")
+                sp.set(outcome="conflict")
                 return False
+            sp.set(outcome="committed", bytes=len(data))
             if kind == "torn":
                 # The store ACCEPTED a partial upload: commit half the
                 # payload with a real generation, then the writer dies.

@@ -68,6 +68,8 @@ def read_table(paths: Sequence[str], file_format: str = "parquet",
             # Partition columns come from paths, not file data.
             file_columns = [c for c in columns if c not in spec]
 
+    from hyperspace_tpu.telemetry.trace import span as _span
+
     def load(path: str) -> pa.Table:
         file_spec, cols = spec, file_columns
         if spec and file_format == "parquet":
@@ -98,25 +100,33 @@ def read_table(paths: Sequence[str], file_format: str = "parquet",
                                          columns)
         return t
 
-    tables = parallel_map_ordered(load, paths)
-    if not tables:
-        return pa.table({})
-    return pa.concat_tables(tables, promote_options="default")
+    with _span("io.read", files=len(paths), format=file_format) as sp:
+        tables = parallel_map_ordered(load, paths)
+        if not tables:
+            return pa.table({})
+        out = pa.concat_tables(tables, promote_options="default")
+        sp.set(rows=out.num_rows, bytes=out.nbytes)
+        return out
 
 
 def _read_retry(fn):
     """Single-file READ primitive wrapper: the ``data.read`` fault site
     plus bounded transient-IO retry (the write side has had this since
     PR 1 — a flaky mount mid-query deserves the same envelope as one
-    mid-build).  Disarmed cost: one None check per FILE, never per row."""
+    mid-build).  Disarmed cost: one None check per FILE, never per row.
+    Every single-file read in the engine passes here, so this is also
+    where ``io.files.read`` counts."""
     from hyperspace_tpu.io import faults
+    from hyperspace_tpu.telemetry import metrics
     from hyperspace_tpu.utils.retry import RetryPolicy
 
     def attempt():
         faults.check("data.read")
         return fn()
 
-    return RetryPolicy().call(attempt)
+    out = RetryPolicy().call(attempt)
+    metrics.inc("io.files.read")
+    return out
 
 
 def read_parquet_file(path: str, columns=None) -> pa.Table:
@@ -343,22 +353,30 @@ def write_bucket_run(sorted_bucket_table: pa.Table, bucket: int,
     from hyperspace_tpu.io import faults
 
     from hyperspace_tpu.io import integrity
+    from hyperspace_tpu.telemetry import metrics
+    from hyperspace_tpu.telemetry.trace import span
 
     out: List[str] = []
-    for off, rows in chunks:
-        path = os.path.join(out_dir, bucket_file_name(bucket))
-        # Crash checkpoint: an action killed mid-data-write leaves partial
-        # index data under an uncommitted version dir + a transient log
-        # state — the shape cancel()/auto-recovery must clean up after.
-        faults.check("data.write")
-        pq.write_table(sorted_bucket_table.slice(off, rows), path,
-                       compression=_codec(compression))
-        # Digest of the INTENDED bytes first, then the corruption
-        # checkpoint (bitrot keeps size+mtime, truncate halves the file):
-        # the damage lands after a write the writer believed good.
-        integrity.record_file(path)
-        faults.corrupt_file("data.write", path)
-        out.append(path)
+    with span("io.write", bucket=bucket,
+              rows=sorted_bucket_table.num_rows) as sp:
+        for off, rows in chunks:
+            path = os.path.join(out_dir, bucket_file_name(bucket))
+            # Crash checkpoint: an action killed mid-data-write leaves
+            # partial index data under an uncommitted version dir + a
+            # transient log state — the shape cancel()/auto-recovery must
+            # clean up after.
+            faults.check("data.write")
+            pq.write_table(sorted_bucket_table.slice(off, rows), path,
+                           compression=_codec(compression))
+            # Digest of the INTENDED bytes first, then the corruption
+            # checkpoint (bitrot keeps size+mtime, truncate halves the
+            # file): the damage lands after a write the writer believed
+            # good.
+            integrity.record_file(path)
+            faults.corrupt_file("data.write", path)
+            metrics.inc("io.files.written")
+            out.append(path)
+        sp.set(files=len(out))
     return out
 
 
@@ -444,6 +462,7 @@ def write_bucketed(table: pa.Table, bucket_ids: np.ndarray, sort_perm: np.ndarra
 
     def write(job) -> str:
         from hyperspace_tpu.io import faults, integrity
+        from hyperspace_tpu.telemetry import metrics
 
         b, start, rows = job
         path = os.path.join(out_dir, bucket_file_name(b))
@@ -457,6 +476,10 @@ def write_bucketed(table: pa.Table, bucket_ids: np.ndarray, sort_perm: np.ndarra
         # writer believed good — exactly what the digest must catch.
         integrity.record_file(path)
         faults.corrupt_file("data.write", path)
+        metrics.inc("io.files.written")
         return path
 
-    return parallel_map_ordered(write, jobs)
+    from hyperspace_tpu.telemetry.trace import span
+
+    with span("io.write", rows=table.num_rows, files=len(jobs)):
+        return parallel_map_ordered(write, jobs)

@@ -74,9 +74,18 @@ def _build_header(stream: BufferStream, title: str) -> None:
 def explain_string(dataset, session, verbose: bool = False) -> str:
     """Hyperspace.explain analog (Hyperspace.scala:152-155)."""
     was_enabled = session.is_hyperspace_enabled()
+    # A run report around the with-indexes pass captures which indexes
+    # were considered and what each rule decided (applied / no match /
+    # skipped + reason) — the verbose section renders it below.
+    from hyperspace_tpu.telemetry import report as run_report
+
     try:
         session.enable_hyperspace()
-        plan_with = session.optimize(dataset.plan)
+        token = run_report.start()
+        try:
+            plan_with = session.optimize(dataset.plan)
+        finally:
+            optimize_report = run_report.finish(token)
         session.disable_hyperspace()
         # Optimized without the index rules (column pruning still runs), the
         # same both-sides-compiled comparison as PlanAnalyzer.scala:167-182.
@@ -141,4 +150,30 @@ def explain_string(dataset, session, verbose: bool = False) -> str:
         for line in without_details:
             stream.write_line(line)
         stream.write_line()
+        # Which indexes the optimizer pass above considered/used/skipped,
+        # and each rule's decision — the run-report view of PLANNING.
+        _build_header(stream, "Optimizer decisions:")
+        stream.write_line(
+            "indexes considered: "
+            + (", ".join(optimize_report.indexes_considered) or "(none)"))
+        stream.write_line(
+            "indexes used:       "
+            + (", ".join(optimize_report.indexes_used) or "(none)"))
+        skipped = optimize_report.skipped_indexes()
+        if skipped:
+            stream.write_line("indexes skipped:    " + ", ".join(skipped))
+        for d in optimize_report.rules():
+            state = "applied" if d.get("applied") else (
+                f"skipped ({d['skipped_reason']})"
+                if d.get("skipped_reason") else "no match")
+            stream.write_line(f"rule {d.get('rule')}: {state}")
+        stream.write_line()
+        # Where time went the last time this SESSION ran a query
+        # (ds.last_run_report() — per-span timings need tracing enabled).
+        last = session.last_run_report_value
+        if last is not None:
+            _build_header(stream, "Last run report:")
+            for line in last.render().splitlines():
+                stream.write_line(line)
+            stream.write_line()
     return stream.with_tag()

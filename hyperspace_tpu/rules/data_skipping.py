@@ -48,7 +48,7 @@ from hyperspace_tpu.plan.expr import (
 from hyperspace_tpu.plan.nodes import Filter, LogicalPlan, Project, Scan, ScanRelation
 from hyperspace_tpu.rules import rule_utils
 from hyperspace_tpu.rules.filter_rule import _extract_filter_nodes
-from hyperspace_tpu.telemetry.events import HyperspaceIndexUsageEvent, get_event_logger
+from hyperspace_tpu.telemetry.events import HyperspaceIndexUsageEvent, emit_event
 
 # In-process memo of loaded sketches keyed by the sketch files' identity
 # (name, size, mtime): correct across rebuilds AND across same-name indexes
@@ -447,7 +447,7 @@ class DataSkippingFilterRule:
             return new_scan if node is scan else node
 
         new_plan = plan.transform_up(swap)
-        get_event_logger().log_event(HyperspaceIndexUsageEvent(
+        emit_event(HyperspaceIndexUsageEvent(
             index_names=[entry.name],
             plan_before=plan.tree_string(),
             plan_after=new_plan.tree_string(),

@@ -27,7 +27,7 @@ from hyperspace_tpu.plan.expr import And, BinOp, Col, Expr, IsIn, Lit, Not, Or, 
 from hyperspace_tpu.plan.nodes import Filter, LogicalPlan, Project, Scan
 from hyperspace_tpu.rules import rule_utils
 from hyperspace_tpu.rules.rankers import rank_filter_indexes
-from hyperspace_tpu.telemetry.events import HyperspaceIndexUsageEvent, get_event_logger
+from hyperspace_tpu.telemetry.events import HyperspaceIndexUsageEvent, emit_event
 from hyperspace_tpu.utils.resolver import resolve
 
 
@@ -107,7 +107,7 @@ class FilterIndexRule:
             new_plan = rule_utils.transform_plan_to_use_index_only_scan(
                 plan, scan, best, use_bucket_spec, prune, file_paths,
                 file_stats)
-        get_event_logger().log_event(HyperspaceIndexUsageEvent(
+        emit_event(HyperspaceIndexUsageEvent(
             index_names=[best.name],
             plan_before=plan.tree_string(),
             plan_after=new_plan.tree_string(),

@@ -22,7 +22,7 @@ from hyperspace_tpu.plan.expr import as_equi_join_pairs
 from hyperspace_tpu.plan.nodes import Join, LogicalPlan, Scan
 from hyperspace_tpu.rules import rule_utils
 from hyperspace_tpu.rules.rankers import rank_join_index_pairs
-from hyperspace_tpu.telemetry.events import HyperspaceIndexUsageEvent, get_event_logger
+from hyperspace_tpu.telemetry.events import HyperspaceIndexUsageEvent, emit_event
 from hyperspace_tpu.utils.resolver import resolve
 
 
@@ -134,7 +134,7 @@ class JoinIndexRule:
         new_right = rewrite_side(join.right, r_scan, r_entry)
         new_plan = Join(new_left, new_right, join.condition, join.how,
                         residual=join.residual)
-        get_event_logger().log_event(HyperspaceIndexUsageEvent(
+        emit_event(HyperspaceIndexUsageEvent(
             index_names=[l_entry.name, r_entry.name],
             plan_before=Join(join.left, join.right, join.condition, join.how).tree_string(),
             plan_after=new_plan.tree_string(),

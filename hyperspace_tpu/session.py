@@ -98,6 +98,12 @@ class HyperspaceSession:
         from hyperspace_tpu.io import integrity
 
         integrity.configure_from_conf(self.conf)
+        # Observability conf (telemetry/trace.py): span tracing + JSONL
+        # sink.  Re-applied per query (Dataset.collect) so conf.set()
+        # after construction also wins.
+        from hyperspace_tpu.telemetry import trace
+
+        trace.configure_from_conf(self.conf)
         self._schema_cache: Dict[object, Dict[str, str]] = {}
         # optimize() mutates shared state (the cached IndexLogEntry tags it
         # clears per pass), so concurrent queries — e.g. interop server
@@ -120,6 +126,9 @@ class HyperspaceSession:
         # (see Executor.stats; the property pair below).
         self._exec_stats = threading.local()
         self.last_execution_stats = None
+        # Run report of the most recent Dataset.collect() — THREAD LOCAL
+        # for the same reason (telemetry/report.py; ds.last_run_report()).
+        self._run_report = threading.local()
 
     @property
     def _lake_schema_memo(self) -> Optional[Dict[object, Dict[str, str]]]:
@@ -137,6 +146,14 @@ class HyperspaceSession:
     @last_execution_stats.setter
     def last_execution_stats(self, value: Optional[Dict[str, list]]) -> None:
         self._exec_stats.value = value
+
+    @property
+    def last_run_report_value(self):
+        return getattr(self._run_report, "value", None)
+
+    @last_run_report_value.setter
+    def last_run_report_value(self, value) -> None:
+        self._run_report.value = value
 
     # -- plumbing -----------------------------------------------------------
     @property
@@ -254,10 +271,12 @@ class HyperspaceSession:
         # is "serialize the OPTIMIZE step only").  Nested optimize calls
         # for the subplans take the lock briefly themselves.
         from hyperspace_tpu.plan.subquery import rewrite_subqueries
+        from hyperspace_tpu.telemetry.trace import span
 
-        plan = rewrite_subqueries(plan, self)
-        with self._optimize_lock:
-            return self._optimize_locked(plan, use_indexes)
+        with span("optimize", use_indexes=use_indexes):
+            plan = rewrite_subqueries(plan, self)
+            with self._optimize_lock:
+                return self._optimize_locked(plan, use_indexes)
 
     def _optimize_locked(self, plan: LogicalPlan,
                          use_indexes: bool = True) -> LogicalPlan:
@@ -301,6 +320,10 @@ class HyperspaceSession:
             # pass clean.
             for e in entries:
                 e._tags.clear()
+            from hyperspace_tpu.telemetry import report
+
+            report.record("indexes.considered",
+                          names=[e.name for e in entries])
             plan = self._apply_rule_degradable(
                 "JoinIndexRule", JoinIndexRule(self, entries).apply, plan)
             plan = self._apply_rule_degradable(
@@ -336,24 +359,60 @@ class HyperspaceSession:
         is returned un-rewritten and telemetry records the degradation
         (``hyperspace.system.degraded.fallbackToSource``; strict mode
         re-raises).  InjectedCrash is a BaseException and still
-        propagates: a simulated process death is not a fallback."""
-        try:
-            return apply_fn(plan)
-        except Exception as e:  # noqa: BLE001 — the contract is "any
-            # index-side failure degrades"; source-side failures surface
-            # again when the fallback plan executes the source scan.
-            if not self.conf.degraded_fallback_to_source:
-                raise
-            from hyperspace_tpu.telemetry.events import (
-                IndexDegradedEvent,
-                get_event_logger,
-            )
+        propagates: a simulated process death is not a fallback.
 
-            get_event_logger().log_event(IndexDegradedEvent(
-                reason=f"{rule_name} failed: {e!r}",
-                message=f"{rule_name} skipped; query answers from the "
-                        "source scan"))
-            return plan
+        Observability boundary too: each rule gets a span and a run-report
+        decision (applied / no match / skipped+reason) plus a
+        ``rule.<slug>.applied`` counter — the one seam every rewrite rule
+        passes through."""
+        from hyperspace_tpu.telemetry import metrics, report
+        from hyperspace_tpu.telemetry.trace import span
+
+        slug = _rule_slug(rule_name)
+        with span(f"optimize.rule.{slug}") as sp:
+            try:
+                new_plan = apply_fn(plan)
+            except Exception as e:  # noqa: BLE001 — the contract is "any
+                # index-side failure degrades"; source-side failures
+                # surface again when the fallback plan executes the
+                # source scan.
+                if not self.conf.degraded_fallback_to_source:
+                    raise
+                from hyperspace_tpu.telemetry.events import (
+                    IndexDegradedEvent,
+                    emit_event,
+                )
+
+                sp.set(applied=False, skipped=repr(e))
+                metrics.inc(f"rule.{slug}.skipped")
+                report.record("rule", rule=rule_name, applied=False,
+                              skipped_reason=f"{e!r}")
+                emit_event(IndexDegradedEvent(
+                    reason=f"{rule_name} failed: {e!r}",
+                    message=f"{rule_name} skipped; query answers from the "
+                            "source scan"))
+                return plan
+            applied = new_plan is not plan
+            sp.set(applied=applied)
+            if applied:
+                metrics.inc(f"rule.{slug}.applied")
+            report.record("rule", rule=rule_name, applied=applied)
+            return new_plan
+
+
+def _rule_slug(rule_name: str) -> str:
+    """``FilterIndexRule`` → ``filter``, ``BucketPruneRule`` →
+    ``bucket_prune`` — the metric-catalog naming of a rule class."""
+    name = rule_name
+    for suffix in ("Rule", "Index", "Filter"):
+        if name.endswith(suffix) and name != suffix:
+            name = name[:-len(suffix)]
+    out = []
+    for i, ch in enumerate(name):
+        if ch.isupper() and i > 0:
+            out.append("_")
+        out.append(ch.lower())
+    return "".join(out)
 
 
 def _uniquify(plan: LogicalPlan) -> LogicalPlan:

@@ -12,6 +12,25 @@ from hyperspace_tpu.telemetry.events import (
     EventLogger,
     NoOpEventLogger,
     CollectingEventLogger,
+    emit_event,
     get_event_logger,
     set_event_logger,
+)
+from hyperspace_tpu.telemetry.metrics import (
+    MetricsRegistry,
+)
+from hyperspace_tpu.telemetry.report import (
+    QueryRunReport,
+)
+from hyperspace_tpu.telemetry.trace import (
+    CollectingTraceSink,
+    JsonlTraceSink,
+    Span,
+    TraceSink,
+    current_span,
+    disable_tracing,
+    enable_tracing,
+    profiler_trace,
+    span,
+    tracing_enabled,
 )

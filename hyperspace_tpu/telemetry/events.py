@@ -139,6 +139,18 @@ def get_event_logger() -> EventLogger:
     return _logger
 
 
+def emit_event(event: HyperspaceEvent) -> None:
+    """The canonical emission path: hand ``event`` to the installed logger
+    AND to the observability layer (telemetry/report.py), which folds it
+    into the active query's run report and the process metrics registry.
+    Sites call this instead of ``get_event_logger().log_event`` so the
+    event taxonomy feeds metrics from exactly one mapping."""
+    _logger.log_event(event)
+    from hyperspace_tpu.telemetry import report
+
+    report.observe_event(event)
+
+
 def set_event_logger(logger: Optional[EventLogger]) -> None:
     """Install a logger programmatically — this wins over the conf key;
     passing ``NoOpEventLogger()`` is an explicit opt-out.  ``None`` resets

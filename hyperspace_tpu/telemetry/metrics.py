@@ -1,0 +1,195 @@
+"""Process-wide metrics registry: named counters, gauges, and histograms.
+
+The operational counterpart of the per-query trace (telemetry/trace.py):
+where a trace explains ONE query, the registry aggregates ACROSS queries
+and actions — how many transient-IO retries fired this process, how many
+CAS conflicts the op-log absorbed, how often queries degraded to the
+source scan.  The shape follows the Prometheus client-library contract
+(counters only go up, gauges are set, histograms bucket observations)
+without the dependency: a snapshot dict for programmatic consumers
+(``Hyperspace.metrics()``) and a text exposition dump for scraping or a
+log line (``render_prometheus``).
+
+Design constraints, in order:
+
+  - **lock-safe**: instrumentation points run on executor worker threads,
+    interop server threads, and the user's thread concurrently; every
+    mutation takes the registry lock (one uncontended lock acquire per
+    increment — far below the cost of the file-level IO operations the
+    instrumented sites perform).
+  - **bounded**: metric names come from a fixed catalog in code
+    (docs/16-observability.md), never from user data, and the registry
+    enforces a hard cap anyway so a buggy caller interpolating paths
+    into names cannot grow it without bound.  Histograms keep fixed
+    log-scale buckets plus count/sum/min/max — O(1) per observation,
+    O(buckets) memory.
+  - **resettable**: ``reset()`` zeroes everything (tests; a bench section
+    isolating its own deltas).
+
+Disabled-cost note: there is no enable switch — an increment is a dict
+update under a lock, cheap enough to leave always-on at the file/action
+granularity the engine instruments (never per row).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+# Hard cap on distinct metric names: the in-code catalog is ~dozens; hitting
+# this means a caller is interpolating unbounded data into names.
+_MAX_SERIES = 4096
+
+# Histogram bucket upper bounds (milliseconds-oriented log scale; also fine
+# for counts).  Fixed for every histogram: cross-metric comparability beats
+# per-metric tuning here, and the bound keeps memory O(1).
+_BUCKETS = (1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+            1000.0, 2500.0, 5000.0, 10000.0, float("inf"))
+
+
+class _Histogram:
+    __slots__ = ("count", "sum", "min", "max", "buckets")
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self.buckets = [0] * len(_BUCKETS)
+
+    def observe(self, value: float) -> None:
+        self.count += 1
+        self.sum += value
+        self.min = value if self.min is None else min(self.min, value)
+        self.max = value if self.max is None else max(self.max, value)
+        for i, bound in enumerate(_BUCKETS):
+            if value <= bound:
+                self.buckets[i] += 1
+                break
+
+    def snapshot(self) -> Dict[str, object]:
+        return {
+            "count": self.count,
+            "sum": round(self.sum, 6),
+            "min": self.min,
+            "max": self.max,
+            "mean": round(self.sum / self.count, 6) if self.count else None,
+            "buckets": {("+Inf" if b == float("inf") else b): n
+                        for b, n in zip(_BUCKETS, self.buckets)},
+        }
+
+
+class MetricsRegistry:
+    """Thread-safe registry of counters, gauges, and histograms."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, float] = {}
+        self._histograms: Dict[str, _Histogram] = {}
+
+    def _room(self) -> bool:
+        return (len(self._counters) + len(self._gauges)
+                + len(self._histograms)) < _MAX_SERIES
+
+    def inc(self, name: str, value: float = 1.0) -> None:
+        """Increment counter ``name`` (created at 0 on first use)."""
+        with self._lock:
+            if name in self._counters or self._room():
+                self._counters[name] = self._counters.get(name, 0.0) + value
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            if name in self._gauges or self._room():
+                self._gauges[name] = float(value)
+
+    def observe(self, name: str, value: float) -> None:
+        """Record one observation into histogram ``name``."""
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                if not self._room():
+                    return
+                h = self._histograms[name] = _Histogram()
+            h.observe(float(value))
+
+    def counter(self, name: str) -> float:
+        with self._lock:
+            return self._counters.get(name, 0.0)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+
+    def snapshot(self) -> Dict[str, object]:
+        """Point-in-time dict of every series, plus the derived ratios the
+        catalog promises (``cache.device.hit_ratio``)."""
+        with self._lock:
+            out: Dict[str, object] = {}
+            out.update(sorted(self._counters.items()))
+            out.update(sorted(self._gauges.items()))
+            for name, h in sorted(self._histograms.items()):
+                out[name] = h.snapshot()
+            hits = self._counters.get("cache.device.hits", 0.0)
+            misses = self._counters.get("cache.device.misses", 0.0)
+            if hits + misses > 0:
+                out["cache.device.hit_ratio"] = round(
+                    hits / (hits + misses), 4)
+            return out
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition (names dotted→underscored, histograms
+        as ``_bucket``/``_sum``/``_count`` series with ``le`` labels)."""
+        def prom(name: str) -> str:
+            return "hyperspace_" + name.replace(".", "_").replace("-", "_")
+
+        lines: List[str] = []
+        with self._lock:
+            for name, v in sorted(self._counters.items()):
+                lines.append(f"# TYPE {prom(name)} counter")
+                lines.append(f"{prom(name)} {v:g}")
+            for name, v in sorted(self._gauges.items()):
+                lines.append(f"# TYPE {prom(name)} gauge")
+                lines.append(f"{prom(name)} {v:g}")
+            for name, h in sorted(self._histograms.items()):
+                lines.append(f"# TYPE {prom(name)} histogram")
+                cumulative = 0
+                for bound, n in zip(_BUCKETS, h.buckets):
+                    cumulative += n
+                    le = "+Inf" if bound == float("inf") else f"{bound:g}"
+                    lines.append(
+                        f'{prom(name)}_bucket{{le="{le}"}} {cumulative}')
+                lines.append(f"{prom(name)}_sum {h.sum:g}")
+                lines.append(f"{prom(name)}_count {h.count}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+# One registry per process: the subsystems it observes (device cache, IO
+# pool, op-log stores) are process-level resources themselves.
+_REGISTRY = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    return _REGISTRY
+
+
+def inc(name: str, value: float = 1.0) -> None:
+    _REGISTRY.inc(name, value)
+
+
+def set_gauge(name: str, value: float) -> None:
+    _REGISTRY.set_gauge(name, value)
+
+
+def observe(name: str, value: float) -> None:
+    _REGISTRY.observe(name, value)
+
+
+def snapshot() -> Dict[str, object]:
+    return _REGISTRY.snapshot()
+
+
+def reset() -> None:
+    _REGISTRY.reset()

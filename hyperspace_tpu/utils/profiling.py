@@ -1,29 +1,14 @@
-"""Profiling surface: XLA traces for the device data plane.
+"""Deprecation alias: the XLA profiling seam moved into the tracing layer.
 
-SURVEY.md §5: the reference inherits its observability from the Spark UI;
-the TPU build's equivalent is the JAX/XLA profiler.  ``profiler_trace``
-wraps a region (an index build, a query) and writes a TensorBoard-loadable
-trace of every XLA program launch, transfer, and kernel.
+``profiler_trace`` now lives in ``hyperspace_tpu.telemetry.trace`` — one
+timing subsystem (spans time the engine's decisions, the XLA trace times
+the device kernels) instead of two.  This module re-exports it so
+existing callers keep working; new code should import from
+``hyperspace_tpu.telemetry``.
 """
 
 from __future__ import annotations
 
-import contextlib
-from typing import Iterator
+from hyperspace_tpu.telemetry.trace import profiler_trace
 
-
-@contextlib.contextmanager
-def profiler_trace(log_dir: str) -> Iterator[None]:
-    """Trace device activity in the with-block into ``log_dir`` (view with
-    TensorBoard's profile plugin or Perfetto).
-
-    >>> with profiler_trace("/tmp/hs-trace"):
-    ...     hs.create_index(df, config)
-    """
-    import jax
-
-    jax.profiler.start_trace(log_dir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
+__all__ = ["profiler_trace"]

@@ -51,7 +51,10 @@ class RetryPolicy:
         return base * (0.5 + 0.5 * rng.random()) / 1000.0
 
     def call(self, fn: Callable[[], T]) -> T:
-        """Run ``fn``, retrying transient OSErrors up to the budget."""
+        """Run ``fn``, retrying transient OSErrors up to the budget.
+        Every absorbed retry feeds ``io.retry.attempts`` and the active
+        query's run report — a query that silently survived a flaky
+        mount stays explainable after the fact."""
         rng = random.Random()
         attempt = 0
         while True:
@@ -61,6 +64,11 @@ class RetryPolicy:
                 attempt += 1
                 if not is_transient(e) or attempt >= max(1, self.max_attempts):
                     raise
+                from hyperspace_tpu.telemetry import metrics, report
+
+                metrics.inc("io.retry.attempts")
+                report.record("io.retry", attempt=attempt,
+                              error=f"{type(e).__name__}: {e}")
                 time.sleep(self.delay_s(attempt - 1, rng))
 
 

@@ -70,6 +70,20 @@ def _disarm_fault_injection():
     faults.clear()
 
 
+@pytest.fixture(autouse=True)
+def _reset_telemetry():
+    """Tracing state and sinks (telemetry/trace.py) are process-global
+    like the fault injector: a test that enables tracing (or a session
+    conf that installs a JSONL sink) must not leak into the next test.
+    Metrics are NOT reset here — the registry is additive by design and
+    tests assert deltas or reset explicitly."""
+    yield
+    from hyperspace_tpu.telemetry import trace
+
+    trace.disable_tracing()
+    trace.clear_sinks()
+
+
 @pytest.fixture()
 def tmp_index_root(tmp_path):
     """Per-test index system path (HyperspaceSuite.scala:28-121 analog)."""

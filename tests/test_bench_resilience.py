@@ -21,7 +21,8 @@ BENCH = os.path.join(os.path.dirname(os.path.dirname(
 
 SECTIONS = ("setup", "sf1_queries", "device_agg_probe", "resident_agg",
             "warm_resident_join", "warm_q3", "warm_q10", "window_bench",
-            "kernel_bench", "calibration", "integrity", "sf10", "sf100")
+            "kernel_bench", "calibration", "telemetry_overhead",
+            "integrity", "sf10", "sf100")
 
 
 def _env(tmp_path, budget: str) -> dict:
@@ -155,3 +156,24 @@ def test_headline_shape_matches_prior_rounds(tmp_path):
     assert detail["sf10"]["skipped"] == "HS_BENCH_SF10=0"
     assert detail["sf100"]["skipped"] == "HS_BENCH_SF100=0"
     assert detail["platform"]
+    # Telemetry contract: the overhead section ran its gate and the JSONL
+    # trace sink holds the required span kinds (the CI smoke step greps
+    # the same names, so the sink format cannot silently drift).
+    to = detail["telemetry_overhead"]
+    assert to["span_disabled_ns_per_call"] < 10_000
+    assert "tracing_on_overhead_pct" in to
+    trace_path = str(tmp_path / "results.jsonl") + ".trace.jsonl"
+    assert detail["trace_file"] == trace_path
+    roots = [json.loads(ln) for ln in open(trace_path, encoding="utf-8")]
+    names = {s["name"] for r in roots for s in _walk(r)}
+    for required in ("bench.setup", "bench.sf1_queries", "query.collect",
+                     "optimize", "optimize.rule.filter", "execute",
+                     "exec.scan", "io.read"):
+        assert required in names, (required, sorted(names)[:40])
+    assert all("duration_ms" in r and "status" in r for r in roots)
+
+
+def _walk(span_dict):
+    yield span_dict
+    for c in span_dict.get("children", ()):
+        yield from _walk(c)

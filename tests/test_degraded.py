@@ -79,6 +79,48 @@ def test_corrupt_log_join_falls_back(accelerated):
         sorted(baseline.column("k").to_pylist())
 
 
+def test_run_report_names_skipped_index_and_reason(accelerated):
+    """Observability acceptance: the degraded query's last_run_report()
+    names the skipped index, the fallback reason, and — with tracing on —
+    per-span timings (ISSUE 4 acceptance criterion)."""
+    from hyperspace_tpu.telemetry import trace
+
+    s, d, ix = accelerated
+    _corrupt_log(ix, "dg")
+    s.index_collection_manager.clear_cache()
+    trace.enable_tracing()
+    try:
+        ds = s.read.parquet(d).filter(col("k") == 7).select("k", "v")
+        out = ds.collect()
+    finally:
+        trace.disable_tracing()
+    assert out.column("v").to_pylist() == [14.0]
+    rep = ds.last_run_report()
+    assert rep is not None and rep.degraded
+    assert rep.outcome == "degraded"
+    assert "dg" in rep.skipped_indexes()
+    assert any("torn past recovery" in r for r in rep.degraded_reasons())
+    assert rep.indexes_used == []
+    timings = rep.span_timings()
+    names = {t["name"] for t in timings}
+    assert {"query.collect", "optimize", "execute"} <= names
+    assert all(t["duration_ms"] >= 0.0 for t in timings)
+    rendered = rep.render()
+    assert "dg" in rendered and "torn past recovery" in rendered
+    assert "where time went:" in rendered
+
+
+def test_run_report_metrics_count_degradation(accelerated):
+    from hyperspace_tpu.telemetry import metrics
+
+    s, d, ix = accelerated
+    _corrupt_log(ix, "dg")
+    s.index_collection_manager.clear_cache()
+    metrics.reset()
+    s.read.parquet(d).filter(col("k") == 7).select("k", "v").collect()
+    assert metrics.snapshot()["degraded.fallbacks"] >= 1
+
+
 def test_strict_mode_raises(accelerated):
     s, d, ix = accelerated
     _corrupt_log(ix, "dg")

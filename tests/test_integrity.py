@@ -452,6 +452,42 @@ class TestExecutionContainment:
         assert not any(x["is_index"]
                        for x in s.last_execution_stats["scans"])
 
+    def test_run_report_on_quarantined_query(self, indexed):
+        """Observability acceptance: a query that hit execution-time
+        corruption yields a ``last_run_report()`` naming the quarantined
+        file + index, the containment re-plan, the fallback reason, and
+        (tracing on) per-span timings covering the recovery path."""
+        from hyperspace_tpu.telemetry import trace
+
+        s, hs, d, query, expected = indexed
+        victim = _victim_for_value(s)
+        with open(victim, "r+b") as f:
+            f.truncate(os.path.getsize(victim) // 2)
+        trace.enable_tracing()
+        try:
+            ds = s.read.parquet(d).filter(col("k") == 5).select("k", "v")
+            got = ds.collect()
+        finally:
+            trace.disable_tracing()
+        assert _tables_equal(got, expected)
+        rep = ds.last_run_report()
+        assert rep is not None and rep.outcome == "degraded"
+        quarantines = [dec for dec in rep.decisions
+                       if dec["kind"] == "quarantine"]
+        assert quarantines and victim in quarantines[0]["files"]
+        assert quarantines[0]["index"] == "ix"
+        assert any(dec["kind"] == "replan"
+                   and dec["mode"] == "containment"
+                   for dec in rep.decisions)
+        assert any("quarantined" in r for r in rep.degraded_reasons())
+        names = {t["name"] for t in rep.span_timings()}
+        assert {"query.collect", "execute", "containment.probe",
+                "execute.replan"} <= names
+        assert all(t["duration_ms"] >= 0.0 for t in rep.span_timings())
+        # The rendered report names the story end to end.
+        text = rep.render()
+        assert "quarantine" in text and "containment" in text
+
     def test_auto_repair_heals_after_containment(self, indexed):
         s, hs, d, query, expected = indexed
         s.conf.auto_repair_enabled = True
