@@ -1,0 +1,45 @@
+"""Workload-aware index advisor: capture → what-if → recommend → build.
+
+The loop the Hyperspace paper names as the next step after transparent
+index *use*: decide which indexes are worth *building* (the AutoAdmin
+what-if / index-selection direction, Chaudhuri & Narasayya VLDB '97).
+
+  - :mod:`~hyperspace_tpu.advisor.workload` — opt-in capture of a
+    bounded, deduplicated log of query fingerprints (filter/join/group
+    columns, measured bytes scanned — never data values), persisted
+    through the LogStore seam so it works over Posix and the emulated
+    object store and survives restarts.
+  - :mod:`~hyperspace_tpu.advisor.hypothetical` — synthesize
+    ACTIVE-looking, zero-data-file index entries and plan queries
+    against them (``session.optimize(hypothetical=[...])``,
+    ``ds.explain(whatif=[...])``); the executor refuses such plans, the
+    log refuses such entries, and nothing touches disk.
+  - :mod:`~hyperspace_tpu.advisor.candidates` /
+    :mod:`~hyperspace_tpu.advisor.recommend` — enumerate candidate
+    covering indexes from the captured workload and rank them by
+    workload-weighted estimated benefit minus estimated build cost
+    (``Hyperspace.recommend_indexes`` / ``apply_recommendations``).
+
+docs/17-advisor.md is the walkthrough.
+"""
+
+from hyperspace_tpu.advisor.hypothetical import (
+    WhatIfReport,
+    hypothetical_entry,
+    whatif,
+)
+from hyperspace_tpu.advisor.recommend import (
+    apply_recommendations,
+    recommend_indexes,
+)
+from hyperspace_tpu.advisor.workload import capture, workload_table
+
+__all__ = [
+    "WhatIfReport",
+    "hypothetical_entry",
+    "whatif",
+    "recommend_indexes",
+    "apply_recommendations",
+    "capture",
+    "workload_table",
+]

@@ -1,0 +1,115 @@
+"""Ranked recommendations + one-call apply.
+
+``Hyperspace.recommend_indexes(top_k)`` delegates here: read the captured
+workload (pending counters flushed), enumerate candidates, score them
+(advisor/candidates.py's bytes model), and return an arrow table — one
+row per candidate with its supporting-query weight and benefit/cost
+estimates.  ``apply_recommendations(top_k)`` builds the winners through
+the NORMAL CreateAction path (same validation, same log protocol, same
+bucketed build as a hand-written ``create_index``), skipping candidates
+an existing ACTIVE index already covers.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from hyperspace_tpu.advisor import candidates as _cand
+from hyperspace_tpu.advisor import workload as _workload
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.index.log_entry import States
+
+
+def scored_candidates(session) -> List[_cand.Candidate]:
+    recs = _workload.records(session.conf)
+    cands = _cand.generate_candidates(
+        recs, session.conf.advisor_max_candidates)
+    return _cand.score_candidates(session, cands, recs)
+
+
+def recommend_indexes(session, top_k: int = 5):
+    """The ranked recommendation table (see Hyperspace.recommend_indexes
+    for the user-facing contract)."""
+    import pyarrow as pa
+
+    from hyperspace_tpu.telemetry.trace import span
+
+    with span("advisor.recommend", top_k=top_k):
+        ranked = scored_candidates(session)[:max(0, int(top_k))]
+    return pa.table({
+        "candidate": [c.name for c in ranked],
+        "relation": [",".join(c.roots) for c in ranked],
+        "indexedColumns": [list(c.indexed) for c in ranked],
+        "includedColumns": [list(c.included) for c in ranked],
+        "supportingQueries": [len(c.supporting_keys) for c in ranked],
+        "supportingHits": [c.supporting_hits for c in ranked],
+        "estBenefitBytes": [round(c.est_benefit_bytes, 1) for c in ranked],
+        "estBuildCostBytes": [round(c.est_build_cost_bytes, 1)
+                              for c in ranked],
+        "score": [round(c.score, 1) for c in ranked],
+    })
+
+
+def _already_covered(session, cand: _cand.Candidate) -> bool:
+    """An ACTIVE covering index with the same indexed columns over the
+    same relation that covers the candidate's included set makes building
+    the candidate pointless."""
+    try:
+        entries = session.index_collection_manager.get_indexes(
+            [States.ACTIVE])
+    except Exception:  # noqa: BLE001 — a degraded listing must not stop
+        return False   # the build; CreateAction re-validates anyway.
+    want_indexed = [c.lower() for c in cand.indexed]
+    want_cols = {c.lower() for c in cand.indexed + cand.included}
+    roots = set(cand.roots)
+    for e in entries:
+        if not e.is_covering:
+            continue
+        if sorted(c.lower() for c in e.indexed_columns) \
+                != sorted(want_indexed):
+            continue
+        if not want_cols <= {c.lower()
+                             for c in e.derived_dataset.all_columns}:
+            continue
+        entry_roots = {r for rel in e.relations for r in rel.root_paths}
+        if roots <= entry_roots:
+            return True
+    return False
+
+
+def _unique_name(session, base: str) -> str:
+    mgr = session.index_collection_manager
+    name, n = base, 1
+    while True:
+        try:
+            taken = mgr.get_index(name) is not None
+        except Exception:  # noqa: BLE001 — unreadable log: the name is
+            taken = True   # occupied by SOMETHING; move on
+        if not taken:
+            return name
+        n += 1
+        name = f"{base}_{n}"
+
+
+def apply_recommendations(session, top_k: int = 1,
+                          min_score: Optional[float] = None) -> List[str]:
+    """Build the top ``top_k`` recommended indexes through the normal
+    CreateAction path; returns the names built.  ``min_score`` (bytes)
+    skips candidates below it; by default every requested winner builds —
+    the operator asked for them."""
+    from hyperspace_tpu.dataset import Dataset
+    from hyperspace_tpu.telemetry.trace import span
+
+    built: List[str] = []
+    with span("advisor.apply", top_k=top_k):
+        for cand in scored_candidates(session)[:max(0, int(top_k))]:
+            if min_score is not None and cand.score < min_score:
+                continue
+            if _already_covered(session, cand):
+                continue
+            name = _unique_name(session, cand.name)
+            ds = Dataset(cand.source_scan(), session)
+            session.index_collection_manager.create(
+                ds, IndexConfig(name, cand.indexed, cand.included))
+            built.append(name)
+    return built

@@ -62,6 +62,9 @@ IO_RETRY_INITIAL_BACKOFF_MS = "hyperspace.system.io.retry.initialBackoffMs"
 IO_RETRY_MAX_BACKOFF_MS = "hyperspace.system.io.retry.maxBackoffMs"
 TELEMETRY_TRACING_ENABLED = "hyperspace.system.telemetry.tracing.enabled"
 TELEMETRY_TRACE_SINK = "hyperspace.system.telemetry.trace.sink"
+ADVISOR_CAPTURE_ENABLED = "hyperspace.advisor.capture.enabled"
+ADVISOR_CAPTURE_MAX_ENTRIES = "hyperspace.advisor.capture.maxEntries"
+ADVISOR_MAX_CANDIDATES = "hyperspace.advisor.maxCandidates"
 FAULT_INJECTION_ENABLED = "hyperspace.system.faultInjection.enabled"
 FAULT_INJECTION_SITE = "hyperspace.system.faultInjection.site"
 FAULT_INJECTION_KIND = "hyperspace.system.faultInjection.kind"
@@ -259,6 +262,22 @@ class HyperspaceConf:
     # a contextvar read / a dict increment at file/action granularity).
     telemetry_tracing_enabled: bool = False
     telemetry_trace_sink: str = ""
+    # Index advisor (hyperspace_tpu/advisor/; docs/17-advisor.md):
+    #   - capture.enabled: persist a bounded, deduplicated log of query
+    #     FINGERPRINTS (filter/join/group columns + measured bytes
+    #     scanned, never data values) under
+    #     ``<systemPath>/_hyperspace_workload`` through the LogStore seam.
+    #     Off by default: capture writes small files per *distinct* query
+    #     shape (repeats fold into a hit counter, flushed at
+    #     power-of-two hit counts so the steady-state cost is one dict
+    #     update) — bench gates the overhead < 3% on the filter workload.
+    #   - capture.maxEntries: cap on distinct fingerprints; new shapes
+    #     beyond it are dropped (counted in advisor.capture.dropped).
+    #   - maxCandidates: how many candidate indexes
+    #     ``recommend_indexes`` enumerates/scores from the workload.
+    advisor_capture_enabled: bool = False
+    advisor_capture_max_entries: int = 512
+    advisor_max_candidates: int = 20
     # Deterministic fault injection (io/faults.py): fire ``kind`` at the
     # ``at``-th call of ``site``, ``count`` times.  Test-only machinery;
     # disabled costs one None check per file-level IO op.
@@ -319,6 +338,9 @@ class HyperspaceConf:
         IO_RETRY_MAX_BACKOFF_MS: "io_retry_max_backoff_ms",
         TELEMETRY_TRACING_ENABLED: "telemetry_tracing_enabled",
         TELEMETRY_TRACE_SINK: "telemetry_trace_sink",
+        ADVISOR_CAPTURE_ENABLED: "advisor_capture_enabled",
+        ADVISOR_CAPTURE_MAX_ENTRIES: "advisor_capture_max_entries",
+        ADVISOR_MAX_CANDIDATES: "advisor_max_candidates",
         FAULT_INJECTION_ENABLED: "fault_injection_enabled",
         FAULT_INJECTION_SITE: "fault_injection_site",
         FAULT_INJECTION_KIND: "fault_injection_kind",

@@ -312,7 +312,33 @@ class Dataset:
             if isinstance(query_span, trace.Span):
                 rep.root_span = query_span
             self.session.last_run_report_value = rep
+        if self.session.conf.advisor_capture_enabled:
+            # Workload capture (advisor/workload.py): the run report just
+            # finished is the feed — fingerprint + measured bytes, folded
+            # into the deduplicated workload log.  capture() never raises.
+            from hyperspace_tpu.advisor import workload as _workload
+
+            _workload.capture(self.session, self.plan, rep,
+                              result_rows=out.num_rows)
         return out
+
+    def explain(self, verbose: bool = False, whatif=None) -> str:
+        """The with/without-indexes plan comparison
+        (``Hyperspace.explain`` without needing the Hyperspace object).
+
+        ``whatif`` switches to advisor mode: a list of
+        :class:`~hyperspace_tpu.index.index_config.IndexConfig` specs (or
+        pre-built hypothetical entries) to plan AGAINST AS IF BUILT —
+        returns the rendered plan diff plus the estimated bytes-scanned
+        delta, touching no data and never executing
+        (docs/17-advisor.md)."""
+        if whatif is not None:
+            from hyperspace_tpu.advisor.hypothetical import whatif as _whatif
+
+            return _whatif(self.session, self, whatif).render()
+        from hyperspace_tpu.plananalysis.explain import explain_string
+
+        return explain_string(self, self.session, verbose=verbose)
 
     def last_run_report(self):
         """The run report of this session's most recent ``collect()`` on

@@ -18,6 +18,8 @@ Scan semantics:
 
 from __future__ import annotations
 
+import os
+
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -966,6 +968,17 @@ class Executor:
 
     def _scan_inner(self, plan: Scan, columns, sp) -> pa.Table:
         rel = plan.relation
+        if rel.hypothetical:
+            # A what-if plan leaked past the advisor (advisor/hypothetical
+            # .py): hypothetical index scans have zero data files and MUST
+            # never execute — answering from one would silently return an
+            # empty table for a query that has rows.
+            from hyperspace_tpu.exceptions import HyperspaceError
+
+            raise HyperspaceError(
+                f"Plan contains a hypothetical index scan "
+                f"({rel.index_scan_of!r}); what-if plans are for analysis "
+                f"only and can never execute (docs/17-advisor.md)")
         read_format = physical_read_format(rel.file_format)
         lake_relation = None
         if rel.file_paths is not None:
@@ -983,15 +996,32 @@ class Executor:
             wanted = set(rel.prune_to_buckets)
             paths = [p for p in paths
                      if (b := bucket_id_of_file(p)) is None or b in wanted]
-        self.stats["scans"].append({
+        # Bytes are measured by stat (the files are about to be read, so
+        # the inodes are hot); a vanished file surfaces in read_table with
+        # a better error than here.
+        bytes_read = 0
+        for p in paths:
+            try:
+                bytes_read += os.path.getsize(p)
+            except OSError:
+                pass
+        scan_record = {
             "relation": rel.index_scan_of or ",".join(rel.root_paths),
             "is_index": bool(rel.index_scan_of),
             "files_read": len(paths),
             "files_listed": len(all_paths),
-        })
+            "bytes_read": bytes_read,
+        }
+        self.stats["scans"].append(scan_record)
         sp.set(relation=rel.index_scan_of or ",".join(rel.root_paths),
                is_index=bool(rel.index_scan_of), files_read=len(paths),
-               files_listed=len(all_paths))
+               files_listed=len(all_paths), bytes_read=bytes_read)
+        # The run report carries per-scan IO too: it is what the advisor's
+        # workload capture consumes (bytes actually scanned per relation)
+        # and what "why was my query slow" reads (telemetry/report.py).
+        from hyperspace_tpu.telemetry import report as run_report
+
+        run_report.record("scan", **scan_record)
         if not paths:
             # Bucket pruning removed every file (key hashes to an empty
             # bucket): the result is empty but MUST keep the scan schema so
@@ -1017,6 +1047,7 @@ class Executor:
                          partition_roots=roots)
         if columns:
             out = out.select(columns)
+        scan_record["rows"] = out.num_rows
         self._register_scan_identity(out, paths)
         return out
 

@@ -66,12 +66,60 @@ class Hyperspace:
         from hyperspace_tpu.index.statistics import index_statistics_table
 
         entry = self.index_manager.get_index(name)
-        return index_statistics_table([entry] if entry else [], extended=True)
+        return index_statistics_table([entry] if entry else [], extended=True,
+                                      path_resolver=self.index_manager
+                                      .path_resolver)
 
     def explain(self, dataset: Dataset, verbose: bool = False) -> str:
         from hyperspace_tpu.plananalysis.explain import explain_string
 
         return explain_string(dataset, self.session, verbose=verbose)
+
+    # -- the index advisor (docs/17-advisor.md) -----------------------------
+    def whatif(self, dataset: Dataset, candidates):
+        """Plan ``dataset`` as if ``candidates`` (IndexConfig specs or
+        hypothetical entries) were built — the real optimizer's plan
+        diff plus an estimated bytes-scanned delta, with zero files
+        written and nothing executed.  Returns a
+        :class:`~hyperspace_tpu.advisor.hypothetical.WhatIfReport`."""
+        from hyperspace_tpu.advisor.hypothetical import whatif
+
+        return whatif(self.session, dataset, candidates)
+
+    def captured_workload(self) -> pa.Table:
+        """The captured query-fingerprint workload
+        (``hyperspace.advisor.capture.enabled``) as one row per distinct
+        query shape: hit count, the filter/join/group/projected columns,
+        measured bytes scanned."""
+        from hyperspace_tpu.advisor.workload import workload_table
+
+        return workload_table(self.session.conf)
+
+    def clear_captured_workload(self) -> None:
+        from hyperspace_tpu.advisor.workload import clear
+
+        clear(self.session.conf)
+
+    def recommend_indexes(self, top_k: int = 5) -> pa.Table:
+        """Rank candidate covering indexes for the CAPTURED workload:
+        columns ``candidate``, ``relation``, ``indexedColumns``,
+        ``includedColumns``, ``supportingQueries``, ``supportingHits``,
+        ``estBenefitBytes``, ``estBuildCostBytes``, ``score`` — benefit
+        is workload-weighted measured-minus-estimated bytes, cost is one
+        covered-column pass over the source (the model in
+        advisor/candidates.py; docs/17-advisor.md)."""
+        from hyperspace_tpu.advisor.recommend import recommend_indexes
+
+        return recommend_indexes(self.session, top_k)
+
+    def apply_recommendations(self, top_k: int = 1) -> list:
+        """Build the top ``top_k`` recommendations through the normal
+        ``create_index`` path (same validation/log protocol/build);
+        returns the index names built.  Candidates an existing ACTIVE
+        index already covers are skipped."""
+        from hyperspace_tpu.advisor.recommend import apply_recommendations
+
+        return apply_recommendations(self.session, top_k)
 
     def metrics(self) -> dict:
         """Point-in-time snapshot of the process-wide metrics registry

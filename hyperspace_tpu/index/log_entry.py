@@ -30,6 +30,12 @@ from hyperspace_tpu.utils.paths import is_data_file
 
 LOG_ENTRY_VERSION = "0.1"  # IndexLogEntry.scala:609
 
+# Property key marking a what-if entry (advisor/hypothetical.py).  Lives
+# here, next to the entry model, so the persistence guards in the log
+# managers and the executor's scan guard can never drift from the tag the
+# advisor sets.
+HYPOTHETICAL_PROPERTY = "hypothetical"
+
 
 # ---------------------------------------------------------------------------
 # States (actions/Constants.scala:19-33)
@@ -526,6 +532,17 @@ class IndexLogEntry:
     @property
     def is_covering(self) -> bool:
         return isinstance(self.derived_dataset, CoveringIndex)
+
+    @property
+    def is_hypothetical(self) -> bool:
+        """True for what-if entries synthesized by the advisor
+        (advisor/hypothetical.py): ACTIVE-looking but with zero data
+        files.  The optimizer only sees them when they are passed
+        explicitly to ``session.optimize(hypothetical=[...])``; the log
+        managers refuse to persist them and the executor refuses to run
+        scans over them."""
+        return self.properties.get(HYPOTHETICAL_PROPERTY, "").lower() \
+            == "true"
 
     @property
     def indexed_columns(self) -> List[str]:

@@ -40,6 +40,20 @@ HYPERSPACE_LOG_DIR = "_hyperspace_log"  # IndexConstants.scala:66
 LATEST_STABLE = "latestStable"
 
 
+def _refuse_hypothetical(entry: IndexLogEntry) -> None:
+    """What-if entries (advisor/hypothetical.py) are plan-only artifacts
+    with zero data files; persisting one would make later queries trust
+    an index that cannot serve a single row.  Guarded at the write seam
+    of EVERY log backend so no caller can leak one into the log."""
+    if entry.is_hypothetical:
+        from hyperspace_tpu.exceptions import HyperspaceError
+
+        raise HyperspaceError(
+            f"Refusing to persist hypothetical index entry "
+            f"{entry.name!r}: what-if entries are never written to the "
+            f"operation log (docs/17-advisor.md)")
+
+
 class IndexLogManager:
     """Manages the operation log of one index (IndexLogManager.scala:33-55)."""
 
@@ -124,6 +138,7 @@ class IndexLogManager:
         (the optimistic-concurrency check, IndexLogManager.scala:149-165).
         Transient IO errors retry — each attempt unlinks its partial file
         first, so the create-if-absent probe stays honest."""
+        _refuse_hypothetical(entry)
         os.makedirs(self.log_dir, exist_ok=True)
         path = os.path.join(self.log_dir, str(log_id))
         entry.id = log_id

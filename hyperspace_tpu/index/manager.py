@@ -260,4 +260,5 @@ class IndexCollectionManager:
         IndexCollectionManager.scala:109-118)."""
         from hyperspace_tpu.index.statistics import index_statistics_table
 
-        return index_statistics_table(self.get_indexes())
+        return index_statistics_table(self.get_indexes(),
+                                      path_resolver=self.path_resolver)

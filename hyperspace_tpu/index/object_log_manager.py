@@ -160,6 +160,9 @@ class ObjectStoreLogManager(IndexLogManager):
 
     # -- writes -------------------------------------------------------------
     def write_log(self, log_id: int, entry: IndexLogEntry) -> bool:
+        from hyperspace_tpu.index.log_manager import _refuse_hypothetical
+
+        _refuse_hypothetical(entry)
         entry.id = log_id
         payload = json.dumps(entry.to_dict(), indent=2).encode("utf-8")
 

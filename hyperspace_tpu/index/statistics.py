@@ -3,12 +3,19 @@
 Reference contract: index/IndexStatistics.scala:43-196 — one summary row per
 index: name, indexed/included columns, bucket count, state, size, file
 counts, appended/deleted counts, location.
+
+Beyond the reference: the NON-extended summary also carries
+``numIndexFiles``/``sizeIndexFiles`` so the advisor's cost model and
+``hs.indexes()`` read the same numbers, and ``indexLocation`` falls back
+to the path resolver's index root for an entry that lists no content
+files yet (a just-created index, or a what-if entry) instead of
+rendering empty.
 """
 
 from __future__ import annotations
 
 import os
-from typing import List
+from typing import List, Optional
 
 import pyarrow as pa
 
@@ -16,12 +23,12 @@ from hyperspace_tpu.index.log_entry import IndexLogEntry
 
 INDEX_SUMMARY_COLUMNS = [
     "name", "indexedColumns", "includedColumns", "numBuckets", "schema",
-    "indexLocation", "state",
+    "indexLocation", "state", "numIndexFiles", "sizeIndexFiles",
 ]
 
 # Extended field set mirrors IndexStatistics.scala:43-61.
 EXTENDED_COLUMNS = INDEX_SUMMARY_COLUMNS + [
-    "kind", "hasLineage", "numIndexFiles", "sizeIndexFiles",
+    "kind", "hasLineage",
     "numSourceFiles", "sizeSourceFiles", "numAppendedFiles",
     "sizeAppendedFiles", "numDeletedFiles", "sizeDeletedFiles",
     "indexContentPaths",
@@ -29,11 +36,16 @@ EXTENDED_COLUMNS = INDEX_SUMMARY_COLUMNS + [
 
 
 def index_statistics_table(entries: List[IndexLogEntry],
-                           extended: bool = False) -> pa.Table:
+                           extended: bool = False,
+                           path_resolver=None) -> pa.Table:
     rows = {c: [] for c in (EXTENDED_COLUMNS if extended else INDEX_SUMMARY_COLUMNS)}
     for e in entries:
         index_files = e.content.file_infos()
         location = os.path.dirname(index_files[0].name) if index_files else ""
+        if not location and path_resolver is not None:
+            # No content files listed yet (fresh create mid-lifecycle, a
+            # hypothetical entry): the index ROOT is still well-defined.
+            location = path_resolver.get_index_path(e.name)
         rows["name"].append(e.name)
         rows["indexedColumns"].append(e.indexed_columns)
         rows["includedColumns"].append(e.included_columns)
@@ -41,14 +53,14 @@ def index_statistics_table(entries: List[IndexLogEntry],
         rows["schema"].append(str(e.derived_dataset.schema))
         rows["indexLocation"].append(location)
         rows["state"].append(e.state)
+        rows["numIndexFiles"].append(len(index_files))
+        rows["sizeIndexFiles"].append(sum(f.size for f in index_files))
         if extended:
             source_files = e.source_file_infos()
             appended = e.appended_files()
             deleted = e.deleted_files()
             rows["kind"].append(e.derived_dataset.KIND)
             rows["hasLineage"].append(e.has_lineage_column())
-            rows["numIndexFiles"].append(len(index_files))
-            rows["sizeIndexFiles"].append(sum(f.size for f in index_files))
             rows["numSourceFiles"].append(len(source_files))
             rows["sizeSourceFiles"].append(sum(f.size for f in source_files))
             rows["numAppendedFiles"].append(len(appended))

@@ -67,7 +67,13 @@ class _Handler(socketserver.StreamRequestHandler):
             # Concurrent execution is safe: the session serializes its
             # OPTIMIZE step internally (shared entry tags / schema memo);
             # the executor itself only reads shared state.
-            if "sql" in spec:
+            if "verb" in spec:
+                # Observability verbs: the PR 4 surface for remote clients
+                # (docs/07-interop.md).  Same framing as queries — an
+                # arrow table comes back — so existing clients need no
+                # new code paths.
+                table = _serve_verb(self.server.session, spec)
+            elif "sql" in spec:
                 # {"sql": "SELECT ...", "tables": {name: parquet_dir}} —
                 # SQL text over the wire, the reference corpus's native
                 # form (goldstandard/PlanStabilitySuite.scala:81-283).
@@ -103,6 +109,58 @@ class _Handler(socketserver.StreamRequestHandler):
             return True
         except OSError:
             return False  # client hung up mid-response
+
+
+def _serve_verb(session, spec: Dict[str, Any]) -> pa.Table:
+    """Non-query verbs of the wire protocol:
+
+      {"verb": "metrics"}          -> (name, value) rows: counters/gauges
+                                      flat, histograms flattened to
+                                      name.count/name.sum/name.mean
+      {"verb": "last_run_report"}  -> one row, column ``report_json`` —
+                                      the serving session's most recent
+                                      query report ON ANY THREAD is not
+                                      knowable, so this returns the LAST
+                                      report of the CONNECTION's thread
+                                      (query then ask on one connection)
+      {"verb": "workload"}         -> the captured advisor workload table
+                                      (advisor/workload.py)
+    """
+    verb = spec["verb"]
+    if not isinstance(verb, str):
+        raise ValueError('"verb" must be a string')
+    if verb == "metrics":
+        from hyperspace_tpu.telemetry import metrics as m
+
+        names: list = []
+        values: list = []
+
+        def emit(name: str, value) -> None:
+            if isinstance(value, (int, float)) and value is not None:
+                names.append(name)
+                values.append(float(value))
+
+        for name, value in sorted(m.snapshot().items()):
+            if isinstance(value, dict):  # histogram snapshot
+                for part in ("count", "sum", "mean", "min", "max"):
+                    if value.get(part) is not None:
+                        emit(f"{name}.{part}", value[part])
+            else:
+                emit(name, value)
+        return pa.table({"name": pa.array(names, type=pa.string()),
+                         "value": pa.array(values, type=pa.float64())})
+    if verb == "last_run_report":
+        report = session.last_run_report_value
+        payload = json.dumps(report.to_dict() if report is not None
+                             else None)
+        return pa.table({"report_json": pa.array([payload],
+                                                 type=pa.string())})
+    if verb == "workload":
+        from hyperspace_tpu.advisor.workload import workload_table
+
+        return workload_table(session.conf)
+    raise ValueError(f"Unknown verb {verb!r}; expected metrics, "
+                     f"last_run_report, or workload")
 
 
 def _is_loopback(host: str) -> bool:

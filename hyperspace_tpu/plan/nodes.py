@@ -43,6 +43,15 @@ class ScanRelation:
     # (pruned files, total files) for display.
     data_skipping_of: Optional[str] = None
     data_skipping_stats: Optional[Tuple[int, int]] = None
+    # What-if planning (advisor/hypothetical.py): this scan was rewritten
+    # onto a HYPOTHETICAL index — a plan-only artifact with zero data
+    # files.  The executor refuses to run it; only the advisor's plan
+    # diff / bytes estimation ever consumes such a plan.  With no files
+    # to read a footer from, the relation carries its own schema
+    # ((column, dtype) pairs, the index's indexed+included columns) so
+    # downstream pruning/pushdown still resolve.
+    hypothetical: bool = False
+    hypothetical_schema: Optional[Tuple[Tuple[str, str], ...]] = None
 
     @property
     def options_dict(self) -> Dict[str, str]:

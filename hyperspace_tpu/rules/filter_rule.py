@@ -66,7 +66,9 @@ class FilterIndexRule:
             entries = self.session.index_collection_manager.get_indexes([States.ACTIVE])
         candidates = rule_utils.get_candidate_indexes(self.session, entries, scan)
         covering = _find_covering_indexes(candidates, filter_cols, output_cols)
-        best = rank_filter_indexes(covering, scan, self.session.conf.hybrid_scan_enabled)
+        best = rank_filter_indexes(covering, scan,
+                                   self.session.conf.hybrid_scan_enabled,
+                                   filter_cols=filter_cols)
         if best is None:
             return None
 

@@ -9,7 +9,7 @@ Common-bytes tags are keyed by the scan they were computed against
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 from hyperspace_tpu.index.log_entry import IndexLogEntry, IndexLogEntryTags
 from hyperspace_tpu.plan.nodes import Scan
@@ -20,13 +20,45 @@ def _common_bytes(entry: IndexLogEntry, scan: Scan) -> int:
     return v if v is not None else 0
 
 
+def _size_index_files(entry: IndexLogEntry) -> int:
+    return sum(f.size for f in entry.content.file_infos())
+
+
+def _tie_break_key(entry: IndexLogEntry,
+                   filter_cols: Optional[Sequence[str]]) -> tuple:
+    """Deterministic ranking of equally-applicable filter candidates.
+
+    Primary: a candidate whose FIRST indexed column appears in the
+    predicate outranks one admitted only through the Z-order any-column
+    relaxation — the leading column is what bucket pruning and the sort
+    order accelerate.  Then the stability tie-break: fewest included
+    columns (least over-covering => least data read per row), smallest
+    ``sizeIndexFiles``, then name.  The reference returns head() here
+    (FilterIndexRanker.scala:55-57), which made the winner depend on
+    log-listing discovery order: plans — and advisor what-if results —
+    flapped across runs whenever two indexes covered the same query."""
+    first_not_filtered = 1
+    if filter_cols is not None and entry.indexed_columns:
+        lowered = {c.lower() for c in filter_cols}
+        first_not_filtered = \
+            0 if entry.indexed_columns[0].lower() in lowered else 1
+    return (first_not_filtered, len(entry.included_columns),
+            _size_index_files(entry), entry.name)
+
+
 def rank_filter_indexes(candidates: List[IndexLogEntry], scan: Scan,
-                        hybrid_scan: bool) -> Optional[IndexLogEntry]:
+                        hybrid_scan: bool,
+                        filter_cols: Optional[Sequence[str]] = None
+                        ) -> Optional[IndexLogEntry]:
     if not candidates:
         return None
     if hybrid_scan:
-        return max(candidates, key=lambda e: _common_bytes(e, scan))
-    return candidates[0]
+        # Max common bytes (JoinIndexRanker.scala:43-58 analog), with
+        # common-bytes ties broken by the same deterministic key.
+        return min(candidates,
+                   key=lambda e: (-_common_bytes(e, scan),)
+                   + _tie_break_key(e, filter_cols))
+    return min(candidates, key=lambda e: _tie_break_key(e, filter_cols))
 
 
 def rank_join_index_pairs(

@@ -97,6 +97,15 @@ def index_scan_relation(entry: IndexLogEntry,
         file_paths=tuple(files),
         prune_to_buckets=prune_to_buckets,
         data_skipping_stats=file_stats,
+        # What-if entries produce plan-only scans the executor refuses to
+        # run (advisor/hypothetical.py): the tag rides the relation so no
+        # downstream transform can lose it, and the entry's schema rides
+        # along too — with zero files there is no footer to resolve from.
+        hypothetical=entry.is_hypothetical,
+        hypothetical_schema=tuple(
+            (c, entry.derived_dataset.schema.get(c, "string"))
+            for c in entry.derived_dataset.all_columns)
+        if entry.is_hypothetical else None,
     )
 
 

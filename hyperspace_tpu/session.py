@@ -179,6 +179,11 @@ class HyperspaceSession:
         # session.  Their schema read is metadata-only (no file listing).
         from hyperspace_tpu.sources.interfaces import LAKE_DATA_FORMATS
 
+        if scan.relation.hypothetical \
+                and scan.relation.hypothetical_schema is not None:
+            # What-if index scans have zero files; the schema rides the
+            # relation itself (advisor/hypothetical.py).
+            return dict(scan.relation.hypothetical_schema)
         if scan.relation.file_format.lower() in LAKE_DATA_FORMATS \
                 and scan.relation.file_paths is None:
             memo = self._lake_schema_memo
@@ -248,11 +253,20 @@ class HyperspaceSession:
         return CachingIndexCollectionManager(self)
 
     def optimize(self, plan: LogicalPlan,
-                 use_indexes: bool = True) -> LogicalPlan:
+                 use_indexes: bool = True,
+                 hypothetical=None) -> LogicalPlan:
         """Apply the rewrite rules if enabled — Join before Filter, the fixed
         order with the rationale in package.scala:25-35.  ACTIVE entries are
         loaded once and shared across both rules so per-scan signature
         memoization (tags) carries over (RuleUtils.scala:59-74).
+
+        ``hypothetical`` is the advisor's what-if channel
+        (advisor/hypothetical.py; docs/17-advisor.md): extra
+        ``IndexLogEntry`` objects tagged hypothetical that this ONE pass
+        considers alongside the persisted ACTIVE entries.  The resulting
+        plan is for analysis only — its hypothetical scans refuse to
+        execute — and entries without the tag are rejected so the channel
+        cannot smuggle a real-looking index into planning.
 
         Column pruning always runs first — the reference's rules sit after
         Catalyst's ColumnPruning, so minimal per-side column requirements are
@@ -276,10 +290,11 @@ class HyperspaceSession:
         with span("optimize", use_indexes=use_indexes):
             plan = rewrite_subqueries(plan, self)
             with self._optimize_lock:
-                return self._optimize_locked(plan, use_indexes)
+                return self._optimize_locked(plan, use_indexes, hypothetical)
 
     def _optimize_locked(self, plan: LogicalPlan,
-                         use_indexes: bool = True) -> LogicalPlan:
+                         use_indexes: bool = True,
+                         hypothetical=None) -> LogicalPlan:
         from hyperspace_tpu.plan.pruning import prune_columns
 
         # Save/restore instead of set/None: subquery folding re-enters
@@ -315,6 +330,21 @@ class HyperspaceSession:
             from hyperspace_tpu.rules.join_rule import JoinIndexRule
 
             entries = self.index_collection_manager.get_indexes([States.ACTIVE])
+            # Belt-and-braces: the log managers refuse to persist
+            # hypothetical entries, so none should ever come back from the
+            # listing — but a real query must never plan against one even
+            # if that guard regresses.
+            entries = [e for e in entries if not e.is_hypothetical]
+            if hypothetical:
+                bad = [e.name for e in hypothetical if not e.is_hypothetical]
+                if bad:
+                    from hyperspace_tpu.exceptions import HyperspaceError
+
+                    raise HyperspaceError(
+                        f"optimize(hypothetical=...) entries must carry "
+                        f"the hypothetical tag; got untagged {bad} — use "
+                        f"advisor.hypothetical.hypothetical_entry()")
+                entries = entries + list(hypothetical)
             # Cached entries outlive a query; tags memoize per-plan-node
             # state and id()s can be recycled across queries, so start each
             # pass clean.

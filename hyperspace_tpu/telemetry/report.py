@@ -45,6 +45,11 @@ class QueryRunReport:
     ``replan``                the query re-planned (``mode``:
                               ``containment`` or ``source-fallback``)
     ``io.retry``              a transient IO retry fired
+    ``scan``                  one executed scan's IO: ``relation``,
+                              ``is_index``, ``files_read``,
+                              ``files_listed``, ``bytes_read`` — the
+                              measured-bytes feed the advisor's workload
+                              capture consumes (advisor/workload.py)
     ========================  ===============================================
     """
 
@@ -76,6 +81,18 @@ class QueryRunReport:
 
     def rules(self) -> List[Dict[str, Any]]:
         return [d for d in self.decisions if d["kind"] == "rule"]
+
+    def scans(self) -> List[Dict[str, Any]]:
+        """Per-scan IO records of the execution (kind ``scan``)."""
+        return [d for d in self.decisions if d["kind"] == "scan"]
+
+    def bytes_read(self, is_index: Optional[bool] = None) -> int:
+        """Total bytes the query's scans read — all scans, or only the
+        index / only the source side.  A containment/fallback re-plan's
+        scans count too: the report describes what the query actually
+        cost, and the advisor's capture wants exactly that."""
+        return sum(d.get("bytes_read", 0) for d in self.scans()
+                   if is_index is None or bool(d.get("is_index")) == is_index)
 
     def span_timings(self) -> List[Dict[str, Any]]:
         """Flattened (name, duration_ms, status) rows from the attached
@@ -125,6 +142,12 @@ class QueryRunReport:
                              f"files={d.get('files')}")
             elif kind == "replan":
                 lines.append(f"  re-planned: {d.get('mode')}")
+            elif kind == "scan":
+                side = "index" if d.get("is_index") else "source"
+                lines.append(
+                    f"  scan [{side}] {d.get('relation')}: "
+                    f"{d.get('files_read')}/{d.get('files_listed')} files, "
+                    f"{d.get('bytes_read', 0)} bytes")
         timings = self.span_timings()
         if timings:
             lines.append("  where time went:")

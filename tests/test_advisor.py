@@ -1,0 +1,435 @@
+"""Workload-aware index advisor: capture → what-if → recommend → build.
+
+The acceptance loop (ISSUE 5): with capture on and no indexes, run a
+filter+join workload; ``recommend_indexes(top_k=1)`` names a candidate
+covering the hot filter column; ``apply_recommendations`` builds it; the
+re-run's run reports show the new index used and a measured bytes-scanned
+reduction whose SIGN matches the advisor's estimate (within the 16x band
+docs/17-advisor.md documents); the what-if pass itself wrote zero files.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import (
+    Hyperspace,
+    HyperspaceSession,
+    IndexConfig,
+    col,
+)
+from hyperspace_tpu.advisor import workload as wl
+from hyperspace_tpu.advisor.hypothetical import (
+    hypothetical_entry,
+    whatif,
+)
+from hyperspace_tpu.exceptions import HyperspaceError
+
+BOTH_STORES = ["hyperspace_tpu.io.log_store.PosixLogStore",
+               "hyperspace_tpu.io.log_store.EmulatedObjectStore"]
+
+
+def _write_tables(tmp_path, n=4000, files=4):
+    rng = np.random.default_rng(11)
+    fact = str(tmp_path / "fact")
+    dim = str(tmp_path / "dim")
+    os.makedirs(fact)
+    os.makedirs(dim)
+    step = n // files
+    for i in range(files):
+        pq.write_table(pa.table({
+            "k": pa.array(np.arange(i * step, (i + 1) * step,
+                                    dtype=np.int64)),
+            "v": pa.array(rng.integers(0, 50, step), type=pa.int64()),
+            "pad0": rng.random(step),
+            "pad1": rng.random(step),
+            "pad2": rng.random(step),
+        }), os.path.join(fact, f"part-{i:03d}.parquet"))
+    pq.write_table(pa.table({
+        "k2": pa.array(np.arange(n, dtype=np.int64)),
+        "u": rng.random(n),
+    }), os.path.join(dim, "d.parquet"))
+    return fact, dim
+
+
+@pytest.fixture()
+def env(tmp_path):
+    fact, dim = _write_tables(tmp_path)
+    session = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    session.conf.num_buckets = 4
+    wl.reset_cache()
+    yield session, Hyperspace(session), fact, dim
+    wl.reset_cache()
+
+
+def _filter_q(session, fact):
+    return (session.read.parquet(fact)
+            .filter(col("k") == 123).select("k", "v"))
+
+
+def _join_q(session, fact, dim):
+    return (session.read.parquet(fact)
+            .join(session.read.parquet(dim), col("k") == col("k2"))
+            .select("k", "v", "u"))
+
+
+# ---------------------------------------------------------------------------
+# Workload capture
+# ---------------------------------------------------------------------------
+class TestCapture:
+    @pytest.mark.parametrize("store_cls", BOTH_STORES)
+    def test_dedup_and_hit_merge(self, env, store_cls):
+        session, hs, fact, dim = env
+        session.conf.log_store_class = store_cls
+        session.conf.advisor_capture_enabled = True
+        for _ in range(4):  # power-of-two boundary: hits=4 is flushed
+            _filter_q(session, fact).collect()
+        table = hs.captured_workload()
+        assert table.num_rows == 1  # four runs, one fingerprint
+        assert table.column("hits").to_pylist() == [4]
+        assert table.column("eqColumns").to_pylist() == [["k"]]
+        assert "v" in table.column("projectedColumns").to_pylist()[0]
+        assert table.column("lastBytesScanned").to_pylist()[0] > 0
+
+    def test_distinct_shapes_get_distinct_records(self, env):
+        session, hs, fact, dim = env
+        session.conf.advisor_capture_enabled = True
+        _filter_q(session, fact).collect()
+        _join_q(session, fact, dim).collect()
+        # Same shape, different literal: dedups into the filter record.
+        (session.read.parquet(fact).filter(col("k") == 999)
+         .select("k", "v").collect())
+        table = hs.captured_workload()
+        assert table.num_rows == 2
+        assert sorted(table.column("hits").to_pylist()) == [1, 2]
+        joins = [c for c in table.column("joinColumns").to_pylist() if c]
+        assert joins == [["k", "k2"]] or joins == [["k"], ["k2"]] \
+            or sorted(joins[0]) == ["k", "k2"]
+
+    @pytest.mark.parametrize("store_cls", BOTH_STORES)
+    def test_capture_survives_restart(self, env, store_cls, tmp_path):
+        session, hs, fact, dim = env
+        session.conf.log_store_class = store_cls
+        session.conf.advisor_capture_enabled = True
+        for _ in range(2):
+            _filter_q(session, fact).collect()
+        wl.flush_pending(session.conf)
+        wl.reset_cache()  # simulate a fresh process
+        fresh = HyperspaceSession(system_path=str(tmp_path / "ix"))
+        fresh.conf.log_store_class = store_cls
+        table = Hyperspace(fresh).captured_workload()
+        assert table.num_rows == 1
+        assert table.column("hits").to_pylist() == [2]
+        # And the fresh process keeps counting into the same record.
+        fresh.conf.advisor_capture_enabled = True
+        for _ in range(2):
+            _filter_q(fresh, fact).collect()
+        table = Hyperspace(fresh).captured_workload()
+        assert table.column("hits").to_pylist() == [4]
+
+    def test_bounded_by_max_entries(self, env):
+        session, hs, fact, dim = env
+        session.conf.advisor_capture_enabled = True
+        session.conf.advisor_capture_max_entries = 2
+        cols = ["v", "pad0", "pad1", "pad2"]
+        for c in cols:  # four distinct shapes, cap of two
+            (session.read.parquet(fact).filter(col(c) >= 0)
+             .select("k", c).collect())
+        assert hs.captured_workload().num_rows == 2
+        dropped = Hyperspace(session).metrics().get(
+            "advisor.capture.dropped", 0)
+        assert dropped >= 2
+
+    def test_disabled_capture_writes_nothing(self, env, tmp_path):
+        session, hs, fact, dim = env
+        assert session.conf.advisor_capture_enabled is False
+        _filter_q(session, fact).collect()
+        _join_q(session, fact, dim).collect()
+        assert not os.path.exists(str(tmp_path / "ix" / wl.WORKLOAD_DIR))
+        assert hs.captured_workload().num_rows == 0
+
+    def test_capture_failure_never_breaks_the_query(self, env, monkeypatch):
+        session, hs, fact, dim = env
+        session.conf.advisor_capture_enabled = True
+
+        def boom(*a, **k):
+            raise RuntimeError("store down")
+
+        monkeypatch.setattr(wl, "store_for", boom)
+        out = _filter_q(session, fact).collect()  # must still answer
+        assert out.num_rows == 1
+
+
+# ---------------------------------------------------------------------------
+# Hypothetical indexes / what-if
+# ---------------------------------------------------------------------------
+class TestWhatIf:
+    def test_filter_rule_matches_hypothetical(self, env):
+        session, hs, fact, dim = env
+        report = hs.whatif(_filter_q(session, fact),
+                           [IndexConfig("hypo", ["k"], ["v"])])
+        assert report.hypothetical_used == ["hypo"]
+        assert "Hyperspace(Type: CI, Name: hypo)" in report.plan_after
+        assert report.est_bytes_delta > 0  # covering index reads less
+
+    def test_join_rule_matches_hypothetical_both_sides(self, env):
+        session, hs, fact, dim = env
+        report = hs.whatif(_join_q(session, fact, dim),
+                           [IndexConfig("h_l", ["k"], ["v"]),
+                            IndexConfig("h_r", ["k2"], ["u"])])
+        assert report.hypothetical_used == ["h_l", "h_r"]
+
+    def test_whatif_writes_zero_files(self, env, tmp_path):
+        session, hs, fact, dim = env
+        hs.whatif(_filter_q(session, fact),
+                  [IndexConfig("hypo", ["k"], ["v"])])
+        files = [p for p in glob.glob(str(tmp_path / "ix" / "**"),
+                                      recursive=True) if os.path.isfile(p)]
+        assert files == []
+
+    def test_executor_rejects_hypothetical_plan(self, env):
+        session, hs, fact, dim = env
+        from hyperspace_tpu.execution.executor import Executor
+
+        ds = _filter_q(session, fact)
+        entry = hypothetical_entry(session, ds,
+                                   IndexConfig("hypo", ["k"], ["v"]))
+        session.enable_hyperspace()
+        plan = session.optimize(ds.plan, hypothetical=[entry])
+        assert any(s.relation.hypothetical for s in plan.leaf_relations())
+        with pytest.raises(HyperspaceError, match="hypothetical"):
+            Executor(session).execute(plan)
+
+    def test_log_managers_refuse_to_persist(self, env, tmp_path):
+        session, hs, fact, dim = env
+        entry = hypothetical_entry(session, _filter_q(session, fact),
+                                   IndexConfig("hypo", ["k"], ["v"]))
+        from hyperspace_tpu.index.log_manager import IndexLogManager
+        from hyperspace_tpu.index.object_log_manager import (
+            ObjectStoreLogManager,
+        )
+
+        for cls in (IndexLogManager, ObjectStoreLogManager):
+            mgr = cls(str(tmp_path / "ix" / "hypo"))
+            mgr.configure(session.conf)
+            with pytest.raises(HyperspaceError, match="hypothetical"):
+                mgr.write_log(1, entry)
+        assert session.index_collection_manager.get_indexes() == []
+
+    def test_untagged_entry_rejected_by_optimize_channel(self, env):
+        session, hs, fact, dim = env
+        ds = _filter_q(session, fact)
+        entry = hypothetical_entry(session, ds,
+                                   IndexConfig("hypo", ["k"], ["v"]))
+        del entry.properties["hypothetical"]
+        session.enable_hyperspace()
+        with pytest.raises(HyperspaceError, match="hypothetical tag"):
+            session.optimize(ds.plan, hypothetical=[entry])
+
+    def test_real_optimize_never_sees_whatif_entries(self, env):
+        session, hs, fact, dim = env
+        ds = _filter_q(session, fact)
+        hs.whatif(ds, [IndexConfig("hypo", ["k"], ["v"])])
+        session.enable_hyperspace()
+        plan = ds.optimized_plan()  # no hypothetical channel
+        assert not any(s.relation.index_scan_of
+                       for s in plan.leaf_relations())
+        assert ds.collect().num_rows == 1  # and the query still answers
+
+    def test_explain_whatif_renders(self, env):
+        session, hs, fact, dim = env
+        text = _filter_q(session, fact).explain(
+            whatif=[IndexConfig("hypo", ["k"], ["v"])])
+        assert "What-if" in text
+        assert "hypo" in text
+        assert "Estimated bytes scanned" in text
+
+    def test_whatif_under_quarantined_real_index(self, env):
+        """A quarantined/degraded REAL index must not stop the what-if
+        pass from answering (the advisor keeps working while an index is
+        damaged)."""
+        session, hs, fact, dim = env
+        hs.create_index(session.read.parquet(fact),
+                        IndexConfig("real", ["k"], ["v"]))
+        mgr = session.index_collection_manager
+        q = mgr.quarantine_manager("real")
+        entry = mgr.get_index("real")
+        for f in entry.content.file_infos():  # quarantine EVERY file
+            q.add(f.name, "test damage")
+        report = hs.whatif(_filter_q(session, fact),
+                           [IndexConfig("hypo", ["k"], ["pad0", "v"])])
+        assert report.hypothetical_used == ["hypo"]
+
+
+# ---------------------------------------------------------------------------
+# Ranker determinism (satellite: rules/rankers.py)
+# ---------------------------------------------------------------------------
+class TestRankerDeterminism:
+    def test_filter_ties_break_deterministically(self, env):
+        """Two covering candidates: the leaner one (fewer included
+        columns) must win regardless of discovery order."""
+        from hyperspace_tpu.index.log_entry import IndexLogEntryTags
+        from hyperspace_tpu.rules.rankers import rank_filter_indexes
+
+        session, hs, fact, dim = env
+        ds = _filter_q(session, fact)
+        lean = hypothetical_entry(session, ds,
+                                  IndexConfig("lean", ["k"], ["v"]))
+        fat = hypothetical_entry(
+            session, ds, IndexConfig("fat", ["k"], ["v", "pad0", "pad1"]))
+        scan = ds.plan.leaf_relations()[0]
+        for order in ([lean, fat], [fat, lean]):
+            assert rank_filter_indexes(order, scan,
+                                       hybrid_scan=False).name == "lean"
+        # Hybrid path: equal common bytes -> same deterministic winner.
+        for e in (lean, fat):
+            e.set_tag(IndexLogEntryTags.COMMON_BYTES, 100, scan)
+        for order in ([lean, fat], [fat, lean]):
+            assert rank_filter_indexes(order, scan,
+                                       hybrid_scan=True).name == "lean"
+
+    def test_same_shape_candidates_tie_break_by_name(self, env):
+        from hyperspace_tpu.rules.rankers import rank_filter_indexes
+
+        session, hs, fact, dim = env
+        ds = _filter_q(session, fact)
+        a = hypothetical_entry(session, ds, IndexConfig("aaa", ["k"], ["v"]))
+        b = hypothetical_entry(session, ds, IndexConfig("bbb", ["k"], ["v"]))
+        scan = ds.plan.leaf_relations()[0]
+        for order in ([a, b], [b, a]):
+            assert rank_filter_indexes(order, scan,
+                                       hybrid_scan=False).name == "aaa"
+
+
+# ---------------------------------------------------------------------------
+# Statistics satellite
+# ---------------------------------------------------------------------------
+class TestStatistics:
+    def test_summary_carries_size_and_count(self, env):
+        session, hs, fact, dim = env
+        hs.create_index(session.read.parquet(fact),
+                        IndexConfig("ci", ["k"], ["v"]))
+        table = hs.indexes()
+        assert table.column("numIndexFiles").to_pylist()[0] >= 1
+        assert table.column("sizeIndexFiles").to_pylist()[0] > 0
+        # Summary and extended views agree (the advisor reads summary).
+        detail = hs.index("ci")
+        assert table.column("sizeIndexFiles").to_pylist() \
+            == detail.column("sizeIndexFiles").to_pylist()
+
+    def test_location_falls_back_to_index_root(self, env, tmp_path):
+        from hyperspace_tpu.index.statistics import index_statistics_table
+
+        session, hs, fact, dim = env
+        entry = hypothetical_entry(session, _filter_q(session, fact),
+                                   IndexConfig("noFiles", ["k"], ["v"]))
+        mgr = session.index_collection_manager
+        table = index_statistics_table([entry],
+                                       path_resolver=mgr.path_resolver)
+        loc = table.column("indexLocation").to_pylist()[0]
+        assert loc == mgr.path_resolver.get_index_path("noFiles")
+        assert table.column("numIndexFiles").to_pylist() == [0]
+
+
+# ---------------------------------------------------------------------------
+# The acceptance loop
+# ---------------------------------------------------------------------------
+class TestRecommendLoop:
+    def test_capture_recommend_apply_rerun(self, env, tmp_path):
+        session, hs, fact, dim = env
+        session.conf.advisor_capture_enabled = True
+        session.enable_hyperspace()
+
+        # 1. A filter+join workload over an UN-indexed lake.
+        filter_expected = _filter_q(session, fact).collect()
+        for _ in range(3):
+            _filter_q(session, fact).collect()
+        _join_q(session, fact, dim).collect()
+        measured_before = _filter_q(session, fact)
+        out_before = measured_before.collect()
+        rep_before = measured_before.last_run_report()
+        src_bytes_before = rep_before.bytes_read(is_index=False)
+        assert src_bytes_before > 0 and not rep_before.indexes_used
+
+        # 2. What-if first — and prove it wrote nothing.
+        rec = hs.recommend_indexes(top_k=3)
+        assert rec.num_rows >= 1
+        top = rec.to_pylist()[0]
+        assert top["indexedColumns"] == ["k"]  # the hot filter column
+        assert "v" in top["includedColumns"]
+        est_benefit = top["estBenefitBytes"]
+        assert est_benefit > 0
+        report = hs.whatif(_filter_q(session, fact),
+                           [IndexConfig(top["candidate"],
+                                        top["indexedColumns"],
+                                        top["includedColumns"])])
+        est_delta = report.est_bytes_delta
+        assert est_delta > 0
+        data_files = [p for p in glob.glob(str(tmp_path / "ix" / "**"),
+                                           recursive=True)
+                      if os.path.isfile(p)
+                      and wl.WORKLOAD_DIR not in p]
+        assert data_files == []  # nothing but captured workload on disk
+
+        # 3. Build the winner through the normal create path.
+        built = hs.apply_recommendations(top_k=1)
+        assert built == [top["candidate"]]
+        assert hs.indexes().column("state").to_pylist() == ["ACTIVE"]
+
+        # 4. Re-run: the report names the new index; measured reduction
+        #    has the SAME SIGN as the estimate and is within the
+        #    documented 16x band of the what-if delta.
+        rerun = _filter_q(session, fact)
+        out_after = rerun.collect()
+        assert out_after.num_rows == filter_expected.num_rows
+        rep_after = rerun.last_run_report()
+        assert built[0] in rep_after.indexes_used
+        bytes_after = rep_after.bytes_read()
+        measured_delta = src_bytes_before - bytes_after
+        assert measured_delta > 0  # same sign as est_delta
+        assert est_delta / 16 <= measured_delta <= est_delta * 16
+
+        # 5. The applied recommendation is not re-applied.
+        assert hs.apply_recommendations(top_k=1) == []
+
+    def test_recommend_empty_workload(self, env):
+        session, hs, fact, dim = env
+        rec = hs.recommend_indexes()
+        assert rec.num_rows == 0
+
+
+# ---------------------------------------------------------------------------
+# Telemetry wiring
+# ---------------------------------------------------------------------------
+class TestAdvisorTelemetry:
+    def test_spans_and_metrics(self, env):
+        from hyperspace_tpu.telemetry import trace
+
+        session, hs, fact, dim = env
+        session.conf.advisor_capture_enabled = True
+        sink = trace.CollectingTraceSink()
+        trace.add_sink(sink)
+        trace.enable_tracing()
+        try:
+            _filter_q(session, fact).collect()
+            hs.whatif(_filter_q(session, fact),
+                      [IndexConfig("hypo", ["k"], ["v"])])
+            hs.recommend_indexes()
+        finally:
+            trace.disable_tracing()
+        names = {s.name for root in sink.spans for s in root.walk()}
+        assert {"advisor.capture", "advisor.whatif",
+                "advisor.recommend"} <= names
+        m = hs.metrics()
+        assert m.get("advisor.queries_captured", 0) >= 1
+        assert m.get("advisor.whatif.runs", 0) >= 1
+        assert m.get("advisor.candidates_scored", 0) >= 1

@@ -137,6 +137,77 @@ class TestServer:
         assert table.num_rows == 1000
 
 
+class TestObservabilityVerbs:
+    """The PR 4 observability surface over the wire: ``metrics`` and
+    ``last_run_report`` verbs (plus the advisor's captured ``workload``)
+    — same framing as queries, an arrow table back."""
+
+    def test_metrics_verb(self, env):
+        s, data = env
+        with QueryServer(s) as server:
+            from hyperspace_tpu.interop import QueryClient
+
+            with QueryClient(server.address) as client:
+                client.query({"source": {"format": "parquet",
+                                         "path": data},
+                              "select": ["k"]})
+                table = client.query({"verb": "metrics"})
+        assert set(table.column_names) == {"name", "value"}
+        series = dict(zip(table.column("name").to_pylist(),
+                          table.column("value").to_pylist()))
+        assert series.get("io.files.read", 0) >= 1
+
+    def test_last_run_report_verb_same_connection(self, env):
+        s, data = env
+        hs = Hyperspace(s)
+        hs.create_index(s.read.parquet(data), IndexConfig("ki", ["k"], ["v"]))
+        s.enable_hyperspace()
+        from hyperspace_tpu.interop import QueryClient
+
+        with QueryServer(s) as server:
+            with QueryClient(server.address) as client:
+                client.query({"source": {"format": "parquet", "path": data},
+                              "filter": {"op": "==", "col": "k",
+                                         "value": 7},
+                              "select": ["k", "v"]})
+                table = client.query({"verb": "last_run_report"})
+        report = json.loads(table.column("report_json").to_pylist()[0])
+        assert report is not None
+        assert report["indexes_used"] == ["ki"]
+        assert any(d["kind"] == "scan" and d.get("is_index")
+                   for d in report["decisions"])
+
+    def test_last_run_report_before_any_query_is_null(self, env):
+        s, _data = env
+        with QueryServer(s) as server:
+            table = request_query(server.address,
+                                  {"verb": "last_run_report"})
+        assert json.loads(table.column("report_json").to_pylist()[0]) is None
+
+    def test_workload_verb(self, env):
+        s, data = env
+        s.conf.advisor_capture_enabled = True
+        from hyperspace_tpu.advisor import workload as wl
+
+        wl.reset_cache()
+        ds = dataset_from_spec(s, {
+            "source": {"format": "parquet", "path": data},
+            "filter": {"op": "==", "col": "k", "value": 5},
+            "select": ["k", "v"]})
+        ds.collect()
+        with QueryServer(s) as server:
+            table = request_query(server.address, {"verb": "workload"})
+        assert table.num_rows == 1
+        assert table.column("eqColumns").to_pylist() == [["k"]]
+        assert table.column("hits").to_pylist() == [1]
+
+    def test_unknown_verb_reported_on_wire(self, env):
+        s, _data = env
+        with QueryServer(s) as server:
+            with pytest.raises(RuntimeError, match="Unknown verb"):
+                request_query(server.address, {"verb": "nope"})
+
+
 def test_non_loopback_bind_requires_allow_remote(env):
     s, _data = env
     with pytest.raises(ValueError, match="no authentication"):
