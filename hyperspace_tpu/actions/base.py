@@ -74,6 +74,12 @@ class Action:
         # Conflicts absorbed by the transaction loop this run (observable
         # by tests and telemetry consumers).
         self.conflict_retries: int = 0
+        # Per-run performance attribution (telemetry/build_report.py):
+        # owned by the ACTION so spill worker threads can record into it
+        # without contextvar propagation.  run() finalizes and publishes.
+        from hyperspace_tpu.telemetry.build_report import BuildReport
+
+        self.build_report = BuildReport(action=type(self).__name__)
 
     # -- protocol pieces ----------------------------------------------------
     @property
@@ -149,38 +155,95 @@ class Action:
                     index_name=self.index_name, state=state, message=message))
 
         rng = random.Random()
+        # The report times run() itself: construction-to-run gaps (refresh
+        # diffing in __init__) are not this run's wall clock.
+        report = self.build_report
+        report._t0 = time.perf_counter()
+        report.started_at = time.time()
+        report.index = self.index_name
         with span(f"action.{type(self).__name__}",
                   index=self.index_name) as sp:
-            while True:
-                try:
-                    self._attempt(emit)
-                    sp.set(conflict_retries=self.conflict_retries)
-                    return
-                except ConcurrentWriteError as e:
-                    if self.conflict_retries >= self.concurrency_max_retries:
-                        emit("FAILURE", "concurrent modification")
-                        raise
-                    self.conflict_retries += 1
-                    emit(f"CONFLICT_RETRY "
-                         f"{self.conflict_retries}/"
-                         f"{self.concurrency_max_retries}",
-                         f"concurrent write at base_id={self.base_id}: {e}")
-                    # Jittered backoff so two rebased racers don't
-                    # re-collide in lockstep (and a stale object-store
-                    # listing gets its visibility window to pass before
-                    # the re-validation).
-                    time.sleep(self.conflict_backoff.delay_s(
-                        self.conflict_retries - 1, rng))
-                    self._rebase()
+            try:
+                while True:
+                    try:
+                        outcome = self._attempt(emit)
+                        sp.set(conflict_retries=self.conflict_retries)
+                        self._finish_report(outcome, "", sp)
+                        return
+                    except ConcurrentWriteError as e:
+                        if self.conflict_retries >= \
+                                self.concurrency_max_retries:
+                            emit("FAILURE", "concurrent modification")
+                            raise
+                        self.conflict_retries += 1
+                        emit(f"CONFLICT_RETRY "
+                             f"{self.conflict_retries}/"
+                             f"{self.concurrency_max_retries}",
+                             f"concurrent write at base_id={self.base_id}: "
+                             f"{e}")
+                        # Jittered backoff so two rebased racers don't
+                        # re-collide in lockstep (and a stale object-store
+                        # listing gets its visibility window to pass before
+                        # the re-validation).
+                        time.sleep(self.conflict_backoff.delay_s(
+                            self.conflict_retries - 1, rng))
+                        self._rebase()
+            except Exception as e:
+                # Failed runs still report (a crashed SPILL phase is
+                # exactly when attribution matters); InjectedCrash is a
+                # BaseException and skips this like a real kill -9 would.
+                self._finish_report("error", str(e), sp)
+                raise
 
-    def _attempt(self, emit) -> None:
+    def _finish_report(self, outcome: str, error: str, sp) -> None:
+        """Finalize + publish this run's BuildReport; export metrics,
+        synthesize phase spans, and append the perf-ledger record.
+        Diagnostics must never fail the action — everything here is
+        best-effort."""
+        from hyperspace_tpu.telemetry import build_report as br
+        from hyperspace_tpu.telemetry import perf_ledger
+
+        report = self.build_report
+        report.conflict_retries = self.conflict_retries
+        report.index = report.index or self.index_name
+        session = getattr(self, "session", None)
+        conf = session.conf if session is not None else None
+        try:
+            profiled = conf is None or br.profiling_enabled(conf)
+            if profiled:
+                report.sample_memory()
+            report.finish(outcome, error)
+            br.publish(report, session)
+            if profiled:
+                report.export_metrics()
+                report.attach_to_span(sp)
+            if conf is not None and profiled:
+                perf_ledger.append(conf, {
+                    "kind": "action", "name": f"{report.action}"
+                    f"({report.index})" if report.index else report.action,
+                    **{k: v for k, v in report.to_dict().items()
+                       if k not in ("started_at",)},
+                    "fingerprint": perf_ledger.fingerprint(conf)})
+        except Exception:  # noqa: BLE001 — see docstring
+            pass
+
+    def _attempt(self, emit) -> str:
+        """One turn of the transaction loop; returns the outcome
+        (``"ok"``/``"noop"``) for the build report.  The ``validate`` and
+        ``commit`` phases are timed here so a report's phase sum accounts
+        for the whole protocol, not just op()'s build work."""
+        t0 = time.perf_counter()
         try:
             self.validate()
         except NoChangesError as e:
             emit(States.ACTIVE, f"No-op: {e}")
-            return
+            return "noop"
+        finally:
+            self.build_report.add_phase("validate", time.perf_counter() - t0)
         try:
+            t0 = time.perf_counter()
             self.begin()
+            self.build_report.add_phase("commit", time.perf_counter() - t0)
             self.op()
             # Crash checkpoint (io/faults.py): the work is done but the
             # final entry is not committed — the state a killed process
@@ -188,8 +251,11 @@ class Action:
             # InjectedCrash is a BaseException, so the handlers below
             # (like a real kill -9) never see it.
             faults.check("action.commit")
+            t0 = time.perf_counter()
             self.end()
+            self.build_report.add_phase("commit", time.perf_counter() - t0)
             emit(self.final_state)
+            return "ok"
         except ConcurrentWriteError:
             raise  # run()'s transaction loop arbitrates: retry or FAILURE
         except Exception as e:

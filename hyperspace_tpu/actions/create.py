@@ -79,6 +79,9 @@ class CreateActionBase(Action):
         with self._phase_lock:
             self.build_phases[name] = \
                 self.build_phases.get(name, 0.0) + seconds
+        # The structured report keeps the same numbers under bare phase
+        # names (telemetry/build_report.py; locked internally).
+        self.build_report.add_phase(name, seconds)
 
     def _publish_build_stats(self) -> None:
         log = getattr(self.session, "build_stats_log", None)
@@ -147,8 +150,15 @@ class CreateActionBase(Action):
         per-bucket run files, and each bucket is then sorted independently —
         peak memory is bounded by max(batch, largest bucket), not the
         dataset."""
+        import time as _time
+
         from hyperspace_tpu.io import integrity
 
+        # Build planning: conf application, source listing, column
+        # resolution, and the backend probe (_use_distributed_build's
+        # first jax.devices() call initializes the backend — a one-off
+        # cost that must not hide between phases).
+        _t0 = _time.perf_counter()
         # Digest-on-write follows THIS session's conf (the recorder is
         # process-global, like the fault injector).
         integrity.configure_from_conf(self.conf)
@@ -167,6 +177,7 @@ class CreateActionBase(Action):
         # The mesh build shards rows across devices itself — streaming spill
         # is the SINGLE-chip answer to datasets beyond one batch.
         streaming = not self._use_distributed_build()
+        self._phase("plan_s", _time.perf_counter() - _t0)
         if streaming and resolved.layout == "zorder":
             # Z-order builds beyond one batch take a dedicated two-pass
             # path that preserves the GLOBAL layout (hash-partition
@@ -198,6 +209,7 @@ class CreateActionBase(Action):
                        relation.options,
                        partition_roots=relation.root_paths)
         self._phase("read_s", _time.perf_counter() - t0)
+        self.build_report.add_bytes(read=t.nbytes)
         missing = [col_name for col_name in columns
                    if col_name not in t.column_names]
         if missing:
@@ -387,9 +399,10 @@ class CreateActionBase(Action):
                 for fid, st, en in zip(uniq, starts, ends):
                     d = os.path.join(run_dir, f"file={int(fid):06d}")
                     os.makedirs(d, exist_ok=True)
-                    _write_run(
+                    self.build_report.add_bytes(spill=_write_run(
                         routed.slice(int(st), int(en - st)),
-                        os.path.join(d, f"run-{chunk_no:05d}.arrow"))
+                        os.path.join(d, f"run-{chunk_no:05d}.arrow")),
+                        spill_runs=1)
                 self._phase("spill_route_s", _time.perf_counter() - t0)
             if offset != n:
                 raise HyperspaceError(
@@ -413,8 +426,12 @@ class CreateActionBase(Action):
                 # One output file per pass-A chunk (already cell-aligned
                 # and capped), written as bucket 0 — the logical index has
                 # one bucket.
-                write_bucket_run(bt, 0, out_dir, 0,
-                                 compression=self.conf.index_file_compression)
+                written = write_bucket_run(
+                    bt, 0, out_dir, 0,
+                    compression=self.conf.index_file_compression)
+                self.build_report.add_bytes(
+                    written=sum(os.path.getsize(p) for p in written),
+                    files=len(written))
                 shutil.rmtree(d, ignore_errors=True)  # runs consumed
 
             from hyperspace_tpu.utils.parallel_map import parallel_map_ordered
@@ -504,12 +521,16 @@ class CreateActionBase(Action):
         version = self.data_manager.get_next_version() if version is None else version
         out_dir = self.data_manager.version_path(version)
         t0 = _time.perf_counter()
-        write_bucketed(table, np.asarray(buckets), np.asarray(perm),
-                       self.num_buckets, out_dir,
-                       max_rows_per_file=self.conf.index_max_rows_per_file,
-                       split_keys=split_keys, split_key_bits=split_bits,
-                       compression=self.conf.index_file_compression)
+        written = write_bucketed(
+            table, np.asarray(buckets), np.asarray(perm),
+            self.num_buckets, out_dir,
+            max_rows_per_file=self.conf.index_max_rows_per_file,
+            split_keys=split_keys, split_key_bits=split_bits,
+            compression=self.conf.index_file_compression)
         self._phase("write_s", _time.perf_counter() - t0)
+        self.build_report.add_bytes(
+            written=sum(os.path.getsize(p) for p in written),
+            files=len(written))
         t0 = _time.perf_counter()
         self._write_index_file_sketch(out_dir, resolved)
         self._phase("sketch_s", _time.perf_counter() - t0)
@@ -574,13 +595,15 @@ class CreateActionBase(Action):
         )
 
 
-def _write_run(table: pa.Table, path: str) -> None:
+def _write_run(table: pa.Table, path: str) -> int:
     """Temporary spill run file as RAW Arrow IPC: no parquet
     encode/decode for data that is read back exactly once and deleted —
-    on a single-core host the encode was most of the spill cost."""
+    on a single-core host the encode was most of the spill cost.
+    Returns the bytes landed (the build report's spill accounting)."""
     with pa.OSFile(path, "wb") as sink:
         with pa.ipc.new_file(sink, table.schema) as writer:
             writer.write_table(table)
+    return os.path.getsize(path)
 
 
 def _read_run(path: str) -> pa.Table:
@@ -727,8 +750,10 @@ class _BucketSpill:
             # Run files are TEMPORARY (read back once, deleted): raw Arrow
             # IPC skips the parquet encode/decode entirely — on the
             # single-core bench host this was most of the spill cost.
-            _write_run(routed.slice(int(starts[b]), rows),
-                       os.path.join(bdir, f"run-{chunk_no:05d}.arrow"))
+            self.action.build_report.add_bytes(spill=_write_run(
+                routed.slice(int(starts[b]), rows),
+                os.path.join(bdir, f"run-{chunk_no:05d}.arrow")),
+                spill_runs=1)
         self.action._phase("spill_route_s", _time.perf_counter() - _t0)
 
     def finish(self) -> None:
@@ -758,8 +783,12 @@ class _BucketSpill:
                 promote_options="default")
             perm = self._sort_permutation(btable)
             btable = btable.take(pa.array(perm))
-            write_bucket_run(btable, bucket, out_dir, max_rows,
-                             compression=action.conf.index_file_compression)
+            written = write_bucket_run(
+                btable, bucket, out_dir, max_rows,
+                compression=action.conf.index_file_compression)
+            action.build_report.add_bytes(
+                written=sum(os.path.getsize(p) for p in written),
+                files=len(written))
             # This bucket's runs are consumed: delete them NOW so peak
             # disk is source + runs + a few finished buckets, not
             # source + runs + the whole final index (matters at SF100).

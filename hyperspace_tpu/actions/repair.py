@@ -144,6 +144,9 @@ class RepairAction(RefreshActionBase):
         new_files: List[str] = []
         starts = np.searchsorted(sorted_buckets, self._target_buckets, "left")
         ends = np.searchsorted(sorted_buckets, self._target_buckets, "right")
+        import time as _time
+
+        t0 = _time.perf_counter()
         for b, lo, hi in zip(self._target_buckets, starts, ends):
             rows = int(hi - lo)
             if rows == 0:
@@ -159,6 +162,10 @@ class RepairAction(RefreshActionBase):
                 bt = bt.take(pa.array(perm))
                 new_files.extend(write_bucket_run(
                     bt, int(b), out_dir, max_rows, compression=compression))
+        self._phase("write_s", _time.perf_counter() - t0)
+        self.build_report.add_bytes(
+            written=sum(os.stat(p).st_size for p in new_files),
+            files=len(new_files))
         # Per-file min/max sketch for the new version dir, like every
         # build/compaction — repaired buckets keep pruning effective.
         from hyperspace_tpu.actions.data_skipping import write_index_file_sketch

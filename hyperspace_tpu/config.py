@@ -62,6 +62,9 @@ IO_RETRY_INITIAL_BACKOFF_MS = "hyperspace.system.io.retry.initialBackoffMs"
 IO_RETRY_MAX_BACKOFF_MS = "hyperspace.system.io.retry.maxBackoffMs"
 TELEMETRY_TRACING_ENABLED = "hyperspace.system.telemetry.tracing.enabled"
 TELEMETRY_TRACE_SINK = "hyperspace.system.telemetry.trace.sink"
+BUILD_PROFILING_ENABLED = "hyperspace.system.buildProfiling.enabled"
+PERF_LEDGER_ENABLED = "hyperspace.system.perf.ledger.enabled"
+PERF_LEDGER_MAX_ENTRIES = "hyperspace.system.perf.ledger.maxEntries"
 ADVISOR_CAPTURE_ENABLED = "hyperspace.advisor.capture.enabled"
 ADVISOR_CAPTURE_MAX_ENTRIES = "hyperspace.advisor.capture.maxEntries"
 ADVISOR_MAX_CANDIDATES = "hyperspace.advisor.maxCandidates"
@@ -262,6 +265,24 @@ class HyperspaceConf:
     # a contextvar read / a dict increment at file/action granularity).
     telemetry_tracing_enabled: bool = False
     telemetry_trace_sink: str = ""
+    # Build-pipeline profiler (telemetry/build_report.py): every action
+    # run records per-phase wall time, bytes moved, spill counts, and
+    # memory gauges into a BuildReport (Hyperspace.last_build_report()),
+    # exported through the metrics registry (build.phase.*.seconds,
+    # build.spill.bytes, ...).  Disabling keeps the pre-existing
+    # build_stats_log phase seconds but skips the memory sampling,
+    # metric/span export, and the ledger append — the bench
+    # ``build_profile`` section gates the on-vs-off delta < 3%.
+    build_profiling_enabled: bool = True
+    # Persistent perf ledger (telemetry/perf_ledger.py): every completed
+    # action (and bench section) appends a compact structured record —
+    # phases, bytes, outcome, host/jax/conf fingerprint — through the
+    # LogStore seam under <systemPath>/_hyperspace_perf, readable via
+    # Hyperspace.perf_history() and the interop ``perf_history`` verb.
+    # Appends are fault-quiet and never fail the action; the ledger is
+    # bounded (oldest records pruned past maxEntries).
+    perf_ledger_enabled: bool = True
+    perf_ledger_max_entries: int = 2048
     # Index advisor (hyperspace_tpu/advisor/; docs/17-advisor.md):
     #   - capture.enabled: persist a bounded, deduplicated log of query
     #     FINGERPRINTS (filter/join/group columns + measured bytes
@@ -338,6 +359,9 @@ class HyperspaceConf:
         IO_RETRY_MAX_BACKOFF_MS: "io_retry_max_backoff_ms",
         TELEMETRY_TRACING_ENABLED: "telemetry_tracing_enabled",
         TELEMETRY_TRACE_SINK: "telemetry_trace_sink",
+        BUILD_PROFILING_ENABLED: "build_profiling_enabled",
+        PERF_LEDGER_ENABLED: "perf_ledger_enabled",
+        PERF_LEDGER_MAX_ENTRIES: "perf_ledger_max_entries",
         ADVISOR_CAPTURE_ENABLED: "advisor_capture_enabled",
         ADVISOR_CAPTURE_MAX_ENTRIES: "advisor_capture_max_entries",
         ADVISOR_MAX_CANDIDATES: "advisor_max_candidates",

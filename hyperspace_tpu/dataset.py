@@ -452,6 +452,7 @@ class Dataset:
                         self.optimized_plan(use_indexes=False))
         # Physical stats of the most recent execution (join strategies,
         # scan file counts) — read by verbose explain and tests.
+        executor.finalize_stats()
         self.session.last_execution_stats = executor.stats
         return out
 

@@ -202,6 +202,46 @@ class Executor:
             return min(min_rows, conf.resident_min_rows(kind))
         return min_rows
 
+    def finalize_stats(self) -> None:
+        """Close out one query's stats: sample the lightweight memory
+        gauges (one getrusage call; live device-buffer bytes only when a
+        device cache/kernel actually ran this query — walking live arrays
+        is not free) into ``stats["memory"]`` and the process registry
+        (``mem.host.peak_rss_mb`` / ``mem.device.live_bytes``).  Called
+        once per collect(), never per operator."""
+        from hyperspace_tpu.telemetry import metrics
+
+        mem: Dict[str, float] = {}
+        try:
+            import resource
+
+            mem["peak_rss_mb"] = round(
+                resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+                / 1024.0, 1)
+            metrics.set_gauge("mem.host.peak_rss_mb", mem["peak_rss_mb"])
+        except Exception:  # noqa: BLE001 — non-POSIX platform
+            pass
+        touched_device = bool(
+            self.stats.get("device_cache")
+            or any(j.get("strategy") == "device"
+                   for j in self.stats.get("join_kernels", []))
+            or any(a.get("strategy", "").startswith("device")
+                   for a in self.stats.get("aggregates", [])))
+        if touched_device:
+            import sys
+
+            jax = sys.modules.get("jax")
+            if jax is not None:
+                try:
+                    live = int(sum(int(getattr(a, "nbytes", 0))
+                                   for a in jax.live_arrays()))
+                    mem["device_live_bytes"] = live
+                    metrics.set_gauge("mem.device.live_bytes", live)
+                except Exception:  # noqa: BLE001
+                    pass
+        if mem:
+            self.stats["memory"] = mem
+
     def execute(self, plan: LogicalPlan) -> pa.Table:
         if isinstance(plan, InMemory):
             return plan.table

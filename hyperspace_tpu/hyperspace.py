@@ -121,6 +121,32 @@ class Hyperspace:
 
         return apply_recommendations(self.session, top_k)
 
+    def last_build_report(self):
+        """The :class:`~hyperspace_tpu.telemetry.build_report.BuildReport`
+        of the most recent action run through this session (create /
+        refresh / repair / optimize / ...): per-phase wall seconds
+        (read → route → sort → spill → finalize), device-compute vs host
+        split, bytes moved, spill run/file counts, and peak host RSS /
+        live device-buffer bytes.  None before the first action.  See
+        docs/16-observability.md."""
+        report = self.session.last_build_report_value
+        if report is not None:
+            return report
+        from hyperspace_tpu.telemetry.build_report import last_report
+
+        return last_report()
+
+    def perf_history(self) -> pa.Table:
+        """The persistent perf ledger (telemetry/perf_ledger.py) as an
+        arrow table — one row per recorded action/bench-section run under
+        ``<systemPath>/_hyperspace_perf``, oldest first, readable over
+        both LogStore backends.  Columns: key, kind, name, ts,
+        wallSeconds, outcome, phasesJson, bytesWritten, spillBytes,
+        recordJson (the full record)."""
+        from hyperspace_tpu.telemetry.perf_ledger import history_table
+
+        return history_table(self.session.conf)
+
     def metrics(self) -> dict:
         """Point-in-time snapshot of the process-wide metrics registry
         (telemetry/metrics.py): counters like ``io.retry.attempts``,

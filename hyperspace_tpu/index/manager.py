@@ -221,8 +221,12 @@ class IndexCollectionManager:
         root = self.path_resolver.system_path
         out: List[IndexLogEntry] = []
         try:
+            # Underscore-prefixed dirs are SYSTEM state, not indexes (the
+            # parquet convention): _hyperspace_workload (advisor capture),
+            # _hyperspace_perf (perf ledger) live beside the index dirs.
             names = sorted(n for n in list_dir(root)
-                           if os.path.isdir(os.path.join(root, n)))
+                           if not n.startswith("_")
+                           and os.path.isdir(os.path.join(root, n)))
         except OSError as e:
             self._degrade("", f"system path listing failed: {e}")
             return out

@@ -125,6 +125,15 @@ def _serve_verb(session, spec: Dict[str, Any]) -> pa.Table:
                                       (query then ask on one connection)
       {"verb": "workload"}         -> the captured advisor workload table
                                       (advisor/workload.py)
+      {"verb": "perf_history"}     -> the persistent perf ledger
+                                      (telemetry/perf_ledger.py): one row
+                                      per recorded action/bench-section
+                                      run under the serving session's
+                                      systemPath
+      {"verb": "build_report"}     -> one row, column ``report_json`` —
+                                      the session's most recent action
+                                      BuildReport (session-wide: builds
+                                      are serialized by the log protocol)
     """
     verb = spec["verb"]
     if not isinstance(verb, str):
@@ -159,8 +168,19 @@ def _serve_verb(session, spec: Dict[str, Any]) -> pa.Table:
         from hyperspace_tpu.advisor.workload import workload_table
 
         return workload_table(session.conf)
+    if verb == "perf_history":
+        from hyperspace_tpu.telemetry.perf_ledger import history_table
+
+        return history_table(session.conf)
+    if verb == "build_report":
+        report = session.last_build_report_value
+        payload = json.dumps(report.to_dict() if report is not None
+                             else None)
+        return pa.table({"report_json": pa.array([payload],
+                                                 type=pa.string())})
     raise ValueError(f"Unknown verb {verb!r}; expected metrics, "
-                     f"last_run_report, or workload")
+                     f"last_run_report, workload, perf_history, or "
+                     f"build_report")
 
 
 def _is_loopback(host: str) -> bool:
@@ -222,6 +242,84 @@ class QueryServer:
             self._thread.join(timeout=5)
 
     def __enter__(self) -> "QueryServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+class MetricsScrapeServer:
+    """Long-lived Prometheus scrape endpoint: ``GET /metrics`` serves the
+    process metrics registry's text exposition
+    (``telemetry/metrics.render_prometheus`` — the ``build.phase.*``,
+    ``exec.*``, ``io.*`` catalog of docs/16-observability.md).
+
+    This is the pull-based counterpart of the ``metrics`` verb: the verb
+    answers an Arrow client once; this endpoint stays up for a scraper to
+    poll on its own schedule — the ops surface ROADMAP item 2's serving
+    layer reports through.  Same security posture as :class:`QueryServer`:
+    loopback by default, ``allow_remote=True`` required to expose it
+    (metrics leak workload shape, file counts, index names via series
+    values).
+
+    >>> with MetricsScrapeServer(port=9109) as ms:
+    ...     ...  # curl http://127.0.0.1:9109/metrics
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 allow_remote: bool = False) -> None:
+        if not _is_loopback(host) and not allow_remote:
+            raise ValueError(
+                f"MetricsScrapeServer binds {host!r}, a non-loopback "
+                f"interface, without authentication.  Pass "
+                f"allow_remote=True only behind a trusted boundary.")
+        import http.server
+
+        class _MetricsHandler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 — stdlib contract
+                if self.path.split("?")[0] not in ("/metrics", "/"):
+                    self.send_error(404)
+                    return
+                from hyperspace_tpu.telemetry import metrics as m
+
+                body = m.registry().render_prometheus().encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; "
+                                 "charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args: object) -> None:
+                pass  # a scrape per second must not spam stderr
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((host, port), _MetricsHandler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._server.server_address
+
+    def start(self) -> "MetricsScrapeServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="hs-metrics-server", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def __enter__(self) -> "MetricsScrapeServer":
         return self.start()
 
     def __exit__(self, *exc) -> None:

@@ -148,6 +148,34 @@ class FaultPlan:
 
 _PLAN: Optional[FaultPlan] = None
 
+# Thread-local quiet flag: diagnostic IO (the perf ledger's appends,
+# telemetry side-writes) must neither FIRE an armed fault nor CONSUME its
+# call counter — a plan armed "eio at the 3rd store.put" targets the
+# system under test, and an interleaved bookkeeping write shifting the
+# count would silently retarget it.
+_quiet_tls = threading.local()
+
+
+class _QuietSection:
+    def __enter__(self) -> "_QuietSection":
+        self._prev = getattr(_quiet_tls, "depth", 0)
+        _quiet_tls.depth = self._prev + 1
+        return self
+
+    def __exit__(self, *exc: object) -> bool:
+        _quiet_tls.depth = self._prev
+        return False
+
+
+def quiet() -> _QuietSection:
+    """Context manager: fault sites on this thread become free
+    pass-throughs (no fire, no counting) for the duration."""
+    return _QuietSection()
+
+
+def _is_quiet() -> bool:
+    return getattr(_quiet_tls, "depth", 0) > 0
+
 
 def install(plan: Optional[FaultPlan]) -> None:
     """Arm ``plan`` process-globally (None disarms)."""
@@ -178,7 +206,7 @@ def check(site: str) -> None:
     """Fault checkpoint: raises the armed fault when ``site`` matches and
     the call counter lines up; free (one None check) otherwise."""
     plan = _PLAN
-    if plan is None or not plan._should_fire(site):
+    if plan is None or _is_quiet() or not plan._should_fire(site):
         return
     plan._raise()
 
@@ -189,7 +217,7 @@ def fire(site: str) -> Optional[str]:
     stores) can decide for themselves what a torn upload leaves behind;
     every other kind raises here."""
     plan = _PLAN
-    if plan is None or not plan._should_fire(site):
+    if plan is None or _is_quiet() or not plan._should_fire(site):
         return None
     if plan.kind == "torn":
         return "torn"
@@ -203,7 +231,7 @@ def write_payload(f, data: bytes, site: str) -> None:
     rejected the write), ``torn`` persists exactly half the payload and
     then dies, ``crash`` dies before writing."""
     plan = _PLAN
-    if plan is None or not plan._should_fire(site):
+    if plan is None or _is_quiet() or not plan._should_fire(site):
         f.write(data)
         return
     if plan.kind == "torn":
@@ -223,7 +251,8 @@ def corrupt_file(site: str, path: str) -> None:
     import os
 
     plan = _PLAN
-    if plan is None or not plan._should_fire(site, corrupting=True):
+    if plan is None or _is_quiet() \
+            or not plan._should_fire(site, corrupting=True):
         return
     st = os.stat(path)
     if plan.kind == "truncate":
@@ -251,7 +280,7 @@ def atomic_replace(tmp: str, dst: str, site: str) -> None:
     import os
 
     plan = _PLAN
-    if plan is None or not plan._should_fire(site):
+    if plan is None or _is_quiet() or not plan._should_fire(site):
         os.replace(tmp, dst)
         return
     if plan.kind == "crash-after-rename":

@@ -129,6 +129,13 @@ class HyperspaceSession:
         # Run report of the most recent Dataset.collect() — THREAD LOCAL
         # for the same reason (telemetry/report.py; ds.last_run_report()).
         self._run_report = threading.local()
+        # Build report of the most recent ACTION run through this session
+        # (telemetry/build_report.py; Hyperspace.last_build_report()).
+        # Session-wide, not thread-local: builds are rare, serialized by
+        # the log protocol, and "what did the last build cost" is a
+        # whole-session question (the interop build_report verb reads it
+        # from a server thread).
+        self.last_build_report_value = None
 
     @property
     def _lake_schema_memo(self) -> Optional[Dict[object, Dict[str, str]]]:

@@ -16,6 +16,9 @@ from hyperspace_tpu.telemetry.events import (
     get_event_logger,
     set_event_logger,
 )
+from hyperspace_tpu.telemetry.build_report import (
+    BuildReport,
+)
 from hyperspace_tpu.telemetry.metrics import (
     MetricsRegistry,
 )

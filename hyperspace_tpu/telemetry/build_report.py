@@ -1,0 +1,247 @@
+"""Per-action build reports: where an index build/maintenance run spent
+its time, bytes, and memory.
+
+PR 4 made *queries* explain themselves (telemetry/report.py); this is
+the same idea for the build/maintenance path — the side BENCH_r04 showed
+dominating wall-clock (sf10_li: 5.0 s of read vs 43.2 s + 40.9 s of
+spill) with nothing but a flat seconds dict to show for it.  Every
+action run through ``actions/base.Action.run()`` owns one
+:class:`BuildReport`:
+
+  - **phases**: wall seconds per named phase (``read`` → ``spill_route``
+    → ``kernel`` → ``spill_finish`` → ``write`` → ``sketch``, plus the
+    protocol's ``validate``/``commit``), accumulated across conflict
+    retries and across the spill pool's worker threads (the report is
+    lock-protected and owned by the ACTION, not a contextvar — worker
+    threads do not inherit context).  Phases are classified device vs
+    host (``kernel`` is device compute; everything else is host/IO) so
+    ``device_s``/``host_s`` fall out.
+  - **bytes**: decoded source bytes in (``bytes_read``), index data
+    bytes out (``bytes_written``), and the external build's temporary
+    spill-run bytes (``spill_bytes`` — the figure that must match what
+    actually landed on disk) with run/file counts.
+  - **memory**: peak host RSS plus live device-buffer bytes, sampled at
+    action end via :func:`sample_memory` — lightweight gauges, never a
+    profiler.
+
+Finish exports the report into the PR 4 metrics registry
+(``build.phase.<name>.seconds``, ``build.spill.bytes``,
+``build.bytes.written``, ``build.actions``, ``build.peak_rss_mb``
+gauge), synthesizes ``build.phase.<name>`` child spans onto the live
+``action.*`` span (so a JSONL trace greps for phase attribution), and
+publishes the report as ``session.last_build_report_value`` /
+:func:`last_report` — surfaced by ``Hyperspace.last_build_report()``.
+
+Cost contract: ``hyperspace.system.buildProfiling.enabled`` (default on)
+gates the memory sampling, metric export, span synthesis, and the perf
+ledger append; phase timing itself predates this module (the
+``build_stats_log`` seconds bench.py already records) and stays on.  The
+bench ``build_profile`` section gates the on-vs-off delta < 3%.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+# Phase → attribution class.  ``kernel`` is the device hash+sort pass
+# (or its bit-identical host mirror — still "compute", and the mirror
+# only runs when the cost model says the chip would lose); everything
+# else is host-side IO/shuffle.
+_DEVICE_PHASES = frozenset({"kernel"})
+
+
+def _phase_key(name: str) -> str:
+    """Normalize legacy ``<phase>_s`` keys (build_stats_log) to bare
+    phase names."""
+    return name[:-2] if name.endswith("_s") else name
+
+
+class BuildReport:
+    """The explain-yourself artifact of one action run."""
+
+    def __init__(self, action: str = "", index: str = "") -> None:
+        self.action = action
+        self.index = index
+        self.started_at = time.time()
+        self.wall_s = 0.0
+        self.outcome = "ok"  # "ok" | "noop" | "error"
+        self.error = ""
+        self.conflict_retries = 0
+        self.phases: Dict[str, float] = {}
+        self.bytes_read = 0
+        self.bytes_written = 0
+        self.files_written = 0
+        self.spill_bytes = 0
+        self.spill_runs = 0
+        self.peak_rss_mb: Optional[float] = None
+        self.device_live_bytes: Optional[int] = None
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+
+    # -- recording (thread-safe: spill route/finish pools call in) ----------
+    def add_phase(self, name: str, seconds: float) -> None:
+        name = _phase_key(name)
+        with self._lock:
+            self.phases[name] = self.phases.get(name, 0.0) + float(seconds)
+
+    def add_bytes(self, *, read: int = 0, written: int = 0, files: int = 0,
+                  spill: int = 0, spill_runs: int = 0) -> None:
+        with self._lock:
+            self.bytes_read += int(read)
+            self.bytes_written += int(written)
+            self.files_written += int(files)
+            self.spill_bytes += int(spill)
+            self.spill_runs += int(spill_runs)
+
+    def sample_memory(self) -> None:
+        """Peak host RSS + live device-buffer bytes — one getrusage call
+        and, when jax is already loaded, a live-array walk.  Called at
+        action end (never per row/file)."""
+        try:
+            import resource
+
+            self.peak_rss_mb = round(
+                resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+                / 1024.0, 1)
+        except Exception:  # noqa: BLE001 — non-POSIX: report without it
+            pass
+        import sys
+
+        jax = sys.modules.get("jax")
+        if jax is None:
+            return  # never force the jax import for a metadata-only action
+        try:
+            self.device_live_bytes = int(sum(
+                int(getattr(a, "nbytes", 0)) for a in jax.live_arrays()))
+        except Exception:  # noqa: BLE001 — backend without live_arrays
+            pass
+
+    # -- derived -------------------------------------------------------------
+    def phase_total_s(self) -> float:
+        return sum(self.phases.values())
+
+    @property
+    def device_s(self) -> float:
+        return sum(v for k, v in self.phases.items() if k in _DEVICE_PHASES)
+
+    @property
+    def host_s(self) -> float:
+        return sum(v for k, v in self.phases.items()
+                   if k not in _DEVICE_PHASES)
+
+    # -- lifecycle (driven by actions/base.Action.run) -----------------------
+    def finish(self, outcome: str = "ok", error: str = "") -> None:
+        self.wall_s = time.perf_counter() - self._t0
+        self.outcome = outcome
+        self.error = error
+
+    def export_metrics(self) -> None:
+        """One report → the process metrics registry
+        (docs/16-observability.md catalog)."""
+        from hyperspace_tpu.telemetry import metrics
+
+        metrics.inc("build.actions")
+        metrics.observe("build.wall.seconds", self.wall_s * 1000.0)
+        for name, s in self.phases.items():
+            metrics.inc(f"build.phase.{name}.seconds", s)
+        if self.spill_bytes:
+            metrics.inc("build.spill.bytes", self.spill_bytes)
+        if self.spill_runs:
+            metrics.inc("build.spill.runs", self.spill_runs)
+        if self.bytes_written:
+            metrics.inc("build.bytes.written", self.bytes_written)
+        if self.bytes_read:
+            metrics.inc("build.bytes.read", self.bytes_read)
+        if self.peak_rss_mb is not None:
+            metrics.set_gauge("build.peak_rss_mb", self.peak_rss_mb)
+        if self.device_live_bytes is not None:
+            metrics.set_gauge("build.device.live_bytes",
+                              self.device_live_bytes)
+
+    def attach_to_span(self, sp) -> None:
+        """Summarize onto the live ``action.*`` span and synthesize one
+        ``build.phase.<name>`` child per phase, so a JSONL trace carries
+        per-phase build attribution (the CI smoke grep's contract)."""
+        from hyperspace_tpu.telemetry.trace import Span
+
+        sp.set(build_wall_s=round(self.wall_s, 4),
+               build_phase_total_s=round(self.phase_total_s(), 4),
+               build_bytes_written=self.bytes_written,
+               build_spill_bytes=self.spill_bytes)
+        children = getattr(sp, "children", None)
+        if children is None:
+            return  # tracing off: sp is the shared no-op
+        for name, s in sorted(self.phases.items()):
+            child = Span(f"build.phase.{name}", {})
+            child.start_s = self.started_at
+            child.duration_ms = s * 1000.0
+            children.append(child)
+
+    # -- serialization -------------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "action": self.action,
+            "index": self.index,
+            "started_at": self.started_at,
+            "wall_s": round(self.wall_s, 4),
+            "outcome": self.outcome,
+            **({"error": self.error} if self.error else {}),
+            "conflict_retries": self.conflict_retries,
+            "phases_s": {k: round(v, 4)
+                         for k, v in sorted(self.phases.items())},
+            "device_s": round(self.device_s, 4),
+            "host_s": round(self.host_s, 4),
+            "bytes_read": self.bytes_read,
+            "bytes_written": self.bytes_written,
+            "files_written": self.files_written,
+            "spill_bytes": self.spill_bytes,
+            "spill_runs": self.spill_runs,
+            "peak_rss_mb": self.peak_rss_mb,
+            "device_live_bytes": self.device_live_bytes,
+        }
+
+    def render(self) -> str:
+        lines = [f"Build report: {self.action} index={self.index or '?'} "
+                 f"outcome={self.outcome} wall={self.wall_s:.3f}s"]
+        if self.conflict_retries:
+            lines.append(f"  conflicts absorbed: {self.conflict_retries}")
+        for name, s in sorted(self.phases.items(),
+                              key=lambda kv: -kv[1]):
+            side = "device" if name in _DEVICE_PHASES else "host"
+            lines.append(f"  phase {name:<14}{s:>10.3f} s  [{side}]")
+        lines.append(f"  bytes: read={self.bytes_read} "
+                     f"written={self.bytes_written} "
+                     f"spill={self.spill_bytes} "
+                     f"(runs={self.spill_runs}, "
+                     f"files={self.files_written})")
+        if self.peak_rss_mb is not None:
+            lines.append(f"  peak host RSS: {self.peak_rss_mb:.1f} MB")
+        if self.device_live_bytes is not None:
+            lines.append(f"  live device buffers: "
+                         f"{self.device_live_bytes} bytes")
+        return "\n".join(lines)
+
+
+# Last finished report, process-wide (the session carries its own copy;
+# this is the fallback for actions constructed without a session).
+_last: Optional[BuildReport] = None
+_last_lock = threading.Lock()
+
+
+def publish(report: BuildReport, session=None) -> None:
+    global _last
+    with _last_lock:
+        _last = report
+    if session is not None:
+        session.last_build_report_value = report
+
+
+def last_report() -> Optional[BuildReport]:
+    with _last_lock:
+        return _last
+
+
+def profiling_enabled(conf) -> bool:
+    return bool(getattr(conf, "build_profiling_enabled", True))

@@ -22,7 +22,7 @@ BENCH = os.path.join(os.path.dirname(os.path.dirname(
 SECTIONS = ("setup", "sf1_queries", "device_agg_probe", "resident_agg",
             "warm_resident_join", "warm_q3", "warm_q10", "window_bench",
             "kernel_bench", "calibration", "telemetry_overhead",
-            "advisor", "integrity", "sf10", "sf100")
+            "advisor", "integrity", "build_profile", "sf10", "sf100")
 
 
 def _env(tmp_path, budget: str) -> dict:
@@ -177,3 +177,120 @@ def _walk(span_dict):
     yield span_dict
     for c in span_dict.get("children", ()):
         yield from _walk(c)
+
+
+def test_sigterm_during_sf10_build_keeps_headline(tmp_path):
+    """The kill-with-headline path over the sf10 BUILD section (ROADMAP
+    item 3, second half): SIGTERM while the sf10 section runs must still
+    produce the headline (the handler finalizes in-line), rc 0, with the
+    interrupted section marked — or, if the tiny sf10 won the race and
+    completed, its numbers present."""
+    env = _env(tmp_path, budget="0")
+    env.update(HS_BENCH_SF10="1",
+               HS_BENCH_SF10_LINEITEM="400000",
+               HS_BENCH_SF10_ORDERS="100000",
+               HS_BENCH_SF10_FILES="4")
+    err_path = tmp_path / "stderr.txt"
+    with open(err_path, "w") as err_sink:
+        proc = subprocess.Popen(
+            [sys.executable, BENCH], env=env,
+            stdout=subprocess.PIPE, stderr=err_sink, text=True)
+    out_lines = []
+    try:
+        for line in proc.stdout:
+            out_lines.append(line)
+            rec = json.loads(line) if line.strip() else {}
+            # build_profile is the section right before sf10: TERM lands
+            # while sf10 generates/builds.
+            if rec.get("section") == "build_profile":
+                time.sleep(1.0)
+                proc.send_signal(signal.SIGTERM)
+                break
+        rest, _ = proc.communicate(timeout=300)
+        out_lines.append(rest)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == 0, open(err_path).read()[-2000:]
+    _lines, headline = _parse_lines("".join(out_lines))
+    _check_contract(headline, tmp_path / "results.jsonl")
+    detail = headline["detail"]
+    # sf1 completed before the TERM, so the headline VALUE survives.
+    assert isinstance(headline["value"], float)
+    sf10 = detail["sf10"]
+    assert "skipped" in sf10 and "SIGTERM" in sf10["skipped"] \
+        or "index_build_s" in sf10, sf10
+
+
+def test_finalize_from_reconstructs_headline(tmp_path):
+    """A run SIGKILLed before any finalize: --finalize-from rebuilds the
+    headline from the checkpoint file alone — completed sections' numbers
+    in, a partial geomean from the sf1 speedups, every missing section
+    marked."""
+    results = tmp_path / "results.jsonl"
+    with open(results, "w") as f:
+        f.write(json.dumps({"bench": "hyperspace-tpu",
+                            "scale": {"lineitem_rows": 100}}) + "\n")
+        f.write(json.dumps({"section": "setup", "status": "ok",
+                            "elapsed_s": 1.0, "index_build_s": 0.5}) + "\n")
+        f.write(json.dumps({"section": "sf1_queries", "status": "ok",
+                            "elapsed_s": 1.0, "filter_speedup": 4.0,
+                            "join_speedup": 1.0}) + "\n")
+        f.write('{"torn line')  # the kill's last, partial write
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--finalize-from", str(results)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    headline = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert headline["metric"] == "tpch_sf1_indexed_query_speedup_geomean"
+    assert headline["value"] == 2.0  # geomean(4.0, 1.0)
+    detail = headline["detail"]
+    assert detail["index_build_s"] == 0.5
+    assert detail["finalized_from"] == str(results)
+    statuses = {s["section"]: s["status"] for s in detail["sections_run"]}
+    assert statuses["setup"] == "ok"
+    assert statuses["sf100"] == "skipped"
+    assert set(statuses) == set(SECTIONS)
+
+
+def test_compare_only_cli_wiring(tmp_path):
+    """--compare-only diffs two artifacts without running the bench:
+    exit 0 on parity, 3 on a flagged regression (with the attribution
+    table), 2 on a missing baseline."""
+    def write(path, build_s, speedup):
+        with open(path, "w") as f:
+            f.write(json.dumps({"bench": "hyperspace-tpu"}) + "\n")
+            f.write(json.dumps({
+                "section": "setup", "status": "ok", "elapsed_s": 1.0,
+                "index_build_s": build_s,
+                "index_build_phases": [{"index": "li", "read_s": 0.1,
+                                        "spill_route_s": build_s - 0.1}],
+            }) + "\n")
+            f.write(json.dumps({"section": "sf1_queries", "status": "ok",
+                                "elapsed_s": 1.0,
+                                "filter_speedup": speedup}) + "\n")
+        return str(path)
+
+    base = write(tmp_path / "base.jsonl", build_s=2.0, speedup=4.0)
+    same = write(tmp_path / "same.jsonl", build_s=2.0, speedup=4.0)
+    slow = write(tmp_path / "slow.jsonl", build_s=8.0, speedup=1.0)
+
+    def run(current, baseline):
+        return subprocess.run(
+            [sys.executable, BENCH, "--compare", baseline,
+             "--compare-only", current],
+            capture_output=True, text=True, timeout=120)
+
+    ok = run(same, base)
+    assert ok.returncode == 0, ok.stderr[-2000:]
+    assert "no regression" in ok.stdout
+
+    bad = run(slow, base)
+    assert bad.returncode == 3, (bad.stdout, bad.stderr[-500:])
+    assert "index_build_s" in bad.stdout
+    assert "filter_speedup" in bad.stdout
+    assert "per-phase attribution" in bad.stdout
+    assert "spill_route" in bad.stdout
+
+    missing = run(same, str(tmp_path / "nope.jsonl"))
+    assert missing.returncode == 2
