@@ -961,7 +961,8 @@ SECTION_NAMES = ("setup", "sf1_queries", "device_agg_probe",
                  "resident_agg", "warm_resident_join", "warm_q3",
                  "warm_q10", "window_bench", "kernel_bench",
                  "calibration", "telemetry_overhead", "advisor",
-                 "integrity", "build_profile", "sf10", "sf100")
+                 "integrity", "build_profile", "serving", "sf10",
+                 "sf100")
 
 
 def main() -> int:
@@ -1011,6 +1012,7 @@ def main() -> int:
             harness.section("integrity", lambda: _sec_integrity(root))
             harness.section("build_profile",
                             lambda: _sec_build_profile(root))
+            harness.section("serving", lambda: _sec_serving(ctx))
             harness.section("sf10", lambda: _sec_sf10(ctx, root, harness))
             harness.section("sf100", lambda: _sec_sf100(ctx, root, harness))
         except _Finalize:
@@ -1998,6 +2000,167 @@ def _sec_build_profile(root: str) -> dict:
         "report": report.to_dict(),
         "spill_report": spill_report.to_dict(),
         "perf_ledger_rows": ledger_rows,
+    }}
+
+
+def _sec_serving(ctx: dict) -> dict:
+    """Serving layer under concurrent clients (docs/07-interop.md;
+    ROADMAP item 2 acceptance): N clients drive a mixed filter/join/agg
+    workload through the admission-controlled QueryServer — sustained
+    QPS, p50/p99 latency, plan-cache hit rate — then a deliberate
+    overload burst against a 1-worker/1-slot server records the shed
+    rate.  Correctness-gated: every accepted answer must match direct
+    execution, the repeat-heavy mix must HIT the plan cache, and the
+    overload burst must shed with retryable BUSY errors rather than
+    hang."""
+    import threading
+
+    from hyperspace_tpu.interop.server import (
+        QueryClient,
+        QueryServer,
+        ServerBusyError,
+        request_query,
+    )
+    from hyperspace_tpu.telemetry import metrics as _metrics
+
+    _require(ctx, "session", "lineitem_dir", "orders_dir")
+    session = ctx["session"]
+    session.enable_hyperspace()
+    li, orders = ctx["lineitem_dir"], ctx["orders_dir"]
+    keys = [N_ORDERS // 7, N_ORDERS // 3, N_ORDERS // 2]
+    templates = [
+        *({"source": {"format": "parquet", "path": li},
+           "filter": {"op": "==", "col": "l_orderkey", "value": k},
+           "select": ["l_orderkey", "l_quantity"]} for k in keys),
+        {"source": {"format": "parquet", "path": li},
+         "group_by": ["l_status"],
+         "aggs": {"q": ["l_quantity", "sum"]}},
+        {"source": {"format": "parquet", "path": orders},
+         "filter": {"op": "<", "col": "o_totalprice", "value": 5000.0},
+         "join": {"source": {"format": "parquet", "path": li},
+                  "on": {"op": "==", "col": "o_orderkey",
+                         "right_col": "l_orderkey"}},
+         "group_by": ["o_shippriority"],
+         "aggs": {"q": ["l_quantity", "sum"]}},
+    ]
+    from hyperspace_tpu.interop import dataset_from_spec
+
+    expected_rows = [dataset_from_spec(session, dict(t)).collect().num_rows
+                     for t in templates]
+
+    n_clients, reqs_per_client = 6, 12
+    latencies: list = []
+    errors: list = []
+    lock = threading.Lock()
+
+    def snap(*names):
+        return {n: _metrics.registry().counter(n) for n in names}
+
+    before = snap("serve.plan_cache.hits", "serve.plan_cache.misses",
+                  "serve.shed")
+
+    def client(ci: int, address) -> None:
+        try:
+            with QueryClient(address) as qc:
+                for r in range(reqs_per_client):
+                    ti = (ci + r) % len(templates)
+                    t0 = time.perf_counter()
+                    out = qc.query(dict(templates[ti]))
+                    dt = time.perf_counter() - t0
+                    with lock:
+                        latencies.append(dt)
+                        if out.num_rows != expected_rows[ti]:
+                            errors.append(
+                                f"client {ci} req {r}: {out.num_rows} rows"
+                                f" != {expected_rows[ti]}")
+        except Exception as e:  # noqa: BLE001 — gate below reports it
+            with lock:
+                errors.append(f"client {ci}: {type(e).__name__}: {e}")
+
+    wall0 = time.perf_counter()
+    with QueryServer(session) as server:
+        threads = [threading.Thread(target=client,
+                                    args=(i, server.address))
+                   for i in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=max(60.0, SECTION_CAP_S or 120.0))
+        hung = [t for t in threads if t.is_alive()]
+    wall = time.perf_counter() - wall0
+    if hung:
+        raise SystemExit("serving bench: client threads hung — the "
+                         "no-hang contract is broken")
+    if errors:
+        raise SystemExit(f"serving bench: diverged answers/errors under "
+                         f"concurrency: {errors[:5]}")
+    after = snap("serve.plan_cache.hits", "serve.plan_cache.misses",
+                 "serve.shed")
+    hits = after["serve.plan_cache.hits"] - before["serve.plan_cache.hits"]
+    misses = (after["serve.plan_cache.misses"]
+              - before["serve.plan_cache.misses"])
+    if hits <= 0:
+        raise SystemExit("serving bench: zero plan-cache hits on a "
+                         "repeat-heavy workload")
+    lat = sorted(latencies)
+
+    def pct(p: float) -> float:
+        return lat[min(len(lat) - 1, int(p * len(lat)))]
+
+    # Overload burst: clients ≫ workers + queue — the robustness
+    # headline.  Shed requests come back fast with retryable BUSY; the
+    # accepted ones still answer correctly.
+    saved = (session.conf.serving_workers, session.conf.serving_queue_depth)
+    session.conf.serving_workers = 1
+    session.conf.serving_queue_depth = 1
+    busy, ok_rows, burst_errors = [], [], []
+    try:
+        with QueryServer(session) as server2:
+            def burst_client() -> None:
+                try:
+                    out = request_query(server2.address,
+                                        dict(templates[-1]))
+                    with lock:
+                        ok_rows.append(out.num_rows)
+                except ServerBusyError as e:
+                    with lock:
+                        busy.append(e)
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        burst_errors.append(f"{type(e).__name__}: {e}")
+
+            burst = [threading.Thread(target=burst_client)
+                     for _ in range(12)]
+            for t in burst:
+                t.start()
+            for t in burst:
+                t.join(timeout=120)
+            if any(t.is_alive() for t in burst):
+                raise SystemExit("serving bench: overload burst hung")
+    finally:
+        session.conf.serving_workers, session.conf.serving_queue_depth = \
+            saved
+    if burst_errors:
+        raise SystemExit(f"serving bench: overload burst saw non-BUSY "
+                         f"failures: {burst_errors[:5]}")
+    if not busy:
+        raise SystemExit("serving bench: 12 concurrent clients against a "
+                         "1-worker/1-slot server shed nothing")
+    if any(r != expected_rows[-1] for r in ok_rows):
+        raise SystemExit("serving bench: overload burst returned a torn "
+                         "or wrong frame")
+    total = len(latencies)
+    return {"serving": {
+        "clients": n_clients,
+        "requests": total,
+        "sustained_qps": round(total / wall, 2),
+        "latency_p50_ms": round(pct(0.50) * 1000.0, 2),
+        "latency_p99_ms": round(pct(0.99) * 1000.0, 2),
+        "plan_cache_hit_rate": round(hits / max(1, hits + misses), 4),
+        "overload_burst_clients": 12,
+        "overload_shed": len(busy),
+        "overload_served": len(ok_rows),
+        "shed_rate": round(len(busy) / 12.0, 4),
     }}
 
 

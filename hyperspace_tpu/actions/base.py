@@ -167,6 +167,15 @@ class Action:
                 while True:
                     try:
                         outcome = self._attempt(emit)
+                        if outcome == "ok":
+                            # A committed index change makes every cached
+                            # optimize result suspect: bump the serving
+                            # layer's plan-cache generation so the next
+                            # served query re-plans against the new state
+                            # (execution/plan_cache.py).
+                            from hyperspace_tpu.execution import plan_cache
+
+                            plan_cache.bump_generation()
                         sp.set(conflict_retries=self.conflict_retries)
                         self._finish_report(outcome, "", sp)
                         return

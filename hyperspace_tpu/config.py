@@ -68,6 +68,17 @@ PERF_LEDGER_MAX_ENTRIES = "hyperspace.system.perf.ledger.maxEntries"
 ADVISOR_CAPTURE_ENABLED = "hyperspace.advisor.capture.enabled"
 ADVISOR_CAPTURE_MAX_ENTRIES = "hyperspace.advisor.capture.maxEntries"
 ADVISOR_MAX_CANDIDATES = "hyperspace.advisor.maxCandidates"
+SERVING_WORKERS = "hyperspace.serving.workers"
+SERVING_QUEUE_DEPTH = "hyperspace.serving.queueDepth"
+SERVING_MAX_CONNECTIONS = "hyperspace.serving.maxConnections"
+SERVING_DEFAULT_DEADLINE_MS = "hyperspace.serving.defaultDeadlineMs"
+SERVING_REQUEST_TIMEOUT_S = "hyperspace.serving.requestTimeoutS"
+SERVING_SEND_TIMEOUT_S = "hyperspace.serving.sendTimeoutS"
+SERVING_DRAIN_GRACE_S = "hyperspace.serving.drainGraceS"
+SERVING_SHED_RSS_MB = "hyperspace.serving.shed.rssWatermarkMb"
+SERVING_SHED_QUEUE_WAIT_MS = "hyperspace.serving.shed.queueWaitWatermarkMs"
+SERVING_PLAN_CACHE_ENABLED = "hyperspace.serving.planCache.enabled"
+SERVING_PLAN_CACHE_BYTES = "hyperspace.serving.planCacheBytes"
 FAULT_INJECTION_ENABLED = "hyperspace.system.faultInjection.enabled"
 FAULT_INJECTION_SITE = "hyperspace.system.faultInjection.site"
 FAULT_INJECTION_KIND = "hyperspace.system.faultInjection.kind"
@@ -299,6 +310,40 @@ class HyperspaceConf:
     advisor_capture_enabled: bool = False
     advisor_capture_max_entries: int = 512
     advisor_max_candidates: int = 20
+    # Serving layer (interop/server.py; docs/07-interop.md):
+    #   - workers: executor threads per QueryServer — the hard bound on
+    #     concurrent query EXECUTION (socket IO threads are separate and
+    #     bounded by maxConnections).
+    #   - queueDepth: admitted-but-not-yet-running requests; a full queue
+    #     sheds new requests with a retryable ERR BUSY.
+    #   - maxConnections: concurrent client connections; beyond it the
+    #     ACCEPT loop answers ERR BUSY without spawning a handler thread,
+    #     so a connection storm cannot grow the thread count.
+    #   - defaultDeadlineMs: per-request deadline when the request spec
+    #     carries no deadline_ms of its own (0 = none).  The deadline
+    #     propagates into dataset.collect via utils/deadline.py and
+    #     aborts cleanly at executor phase boundaries (ERR DEADLINE).
+    #   - requestTimeoutS / sendTimeoutS: socket read / WRITE timeouts —
+    #     a dead client that stops reading mid-Arrow-stream frees its
+    #     worker after sendTimeoutS instead of pinning it forever.
+    #   - drainGraceS: on drain (SIGTERM), how long in-flight requests
+    #     get to finish before the server closes anyway.
+    #   - shed.rssWatermarkMb / shed.queueWaitWatermarkMs: overload
+    #     watermarks (0 = off) — past either, new requests shed BUSY.
+    #   - planCache.*: the optimize-result cache keyed by the advisor's
+    #     structural plan fingerprint (execution/plan_cache.py), byte-
+    #     budget LRU shared mechanism with the device column cache.
+    serving_workers: int = 4
+    serving_queue_depth: int = 16
+    serving_max_connections: int = 64
+    serving_default_deadline_ms: float = 0.0
+    serving_request_timeout_s: float = 30.0
+    serving_send_timeout_s: float = 30.0
+    serving_drain_grace_s: float = 10.0
+    serving_shed_rss_watermark_mb: float = 0.0
+    serving_shed_queue_wait_watermark_ms: float = 0.0
+    serving_plan_cache_enabled: bool = True
+    serving_plan_cache_bytes: int = 64 << 20
     # Deterministic fault injection (io/faults.py): fire ``kind`` at the
     # ``at``-th call of ``site``, ``count`` times.  Test-only machinery;
     # disabled costs one None check per file-level IO op.
@@ -365,6 +410,17 @@ class HyperspaceConf:
         ADVISOR_CAPTURE_ENABLED: "advisor_capture_enabled",
         ADVISOR_CAPTURE_MAX_ENTRIES: "advisor_capture_max_entries",
         ADVISOR_MAX_CANDIDATES: "advisor_max_candidates",
+        SERVING_WORKERS: "serving_workers",
+        SERVING_QUEUE_DEPTH: "serving_queue_depth",
+        SERVING_MAX_CONNECTIONS: "serving_max_connections",
+        SERVING_DEFAULT_DEADLINE_MS: "serving_default_deadline_ms",
+        SERVING_REQUEST_TIMEOUT_S: "serving_request_timeout_s",
+        SERVING_SEND_TIMEOUT_S: "serving_send_timeout_s",
+        SERVING_DRAIN_GRACE_S: "serving_drain_grace_s",
+        SERVING_SHED_RSS_MB: "serving_shed_rss_watermark_mb",
+        SERVING_SHED_QUEUE_WAIT_MS: "serving_shed_queue_wait_watermark_ms",
+        SERVING_PLAN_CACHE_ENABLED: "serving_plan_cache_enabled",
+        SERVING_PLAN_CACHE_BYTES: "serving_plan_cache_bytes",
         FAULT_INJECTION_ENABLED: "fault_injection_enabled",
         FAULT_INJECTION_SITE: "fault_injection_site",
         FAULT_INJECTION_KIND: "fault_injection_kind",

@@ -284,12 +284,20 @@ class Dataset:
     def optimized_plan(self, use_indexes: bool = True) -> LogicalPlan:
         return self.session.optimize(self.plan, use_indexes=use_indexes)
 
-    def collect(self) -> pa.Table:
+    def collect(self, plan_cache=None) -> pa.Table:
         """Optimize + execute, wrapped in the query-lifecycle trace and a
         :class:`~hyperspace_tpu.telemetry.report.QueryRunReport`: every
         branch this method can take (re-plan, quarantine containment,
         source fallback) is recorded so ``last_run_report()`` can explain
-        the query afterwards — docs/16-observability.md."""
+        the query afterwards — docs/16-observability.md.
+
+        ``plan_cache`` is the serving layer's optimize-result cache
+        (:class:`~hyperspace_tpu.execution.plan_cache.PlanCache`): on a
+        fresh hit the optimizer pass is skipped entirely and the cached
+        plan goes straight to the executor; an entry whose plan fails at
+        execution is dropped before the degraded/containment machinery
+        runs.  Local callers leave it None — caching pays off for the
+        repeat-heavy served workload, not one-shot notebook queries."""
         from hyperspace_tpu.telemetry import report as run_report
         from hyperspace_tpu.telemetry import trace
 
@@ -301,7 +309,7 @@ class Dataset:
         try:
             with trace.span("query.collect") as sp:
                 query_span = sp  # the real Span when tracing is enabled
-                out = self._collect_traced()
+                out = self._collect_traced(plan_cache)
         except Exception:
             rep = run_report.active()
             if rep is not None:
@@ -347,41 +355,81 @@ class Dataset:
         decision, and — when tracing was enabled — where time went."""
         return self.session.last_run_report_value
 
-    def _collect_traced(self) -> pa.Table:
+    def _collect_traced(self, plan_cache=None) -> pa.Table:
+        from hyperspace_tpu.exceptions import DeadlineExceededError
         from hyperspace_tpu.execution.executor import Executor
         from hyperspace_tpu.telemetry import report as run_report
         from hyperspace_tpu.telemetry import metrics
         from hyperspace_tpu.telemetry.trace import span
+        from hyperspace_tpu.utils import deadline as _deadline
 
+        # Deadline phase boundary: a served request that spent its budget
+        # queueing aborts here before paying for planning at all.
+        _deadline.check("planning")
         executor = Executor(self.session)
-        try:
-            plan = self.optimized_plan()
-        except Exception as e:  # noqa: BLE001 — InjectedCrash propagates.
-            # PLANNING died with index rewrites on (e.g. every file of an
-            # index unreadable, so even its schema cannot be fetched).
-            # Degraded mode owns this stage too: re-plan without indexes;
-            # a failure of THAT plan is a genuine query error and
-            # propagates from a planning pass indexes never touched.
-            if not self.session.is_hyperspace_enabled() or \
-                    not self.session.conf.degraded_fallback_to_source:
-                raise
-            from hyperspace_tpu.telemetry.events import (
-                IndexDegradedEvent,
-                emit_event,
-            )
+        plan = None
+        cache_key = None
+        if plan_cache is not None:
+            cache_key = plan_cache.key_for(self.session, self.plan)
+            if cache_key is not None:
+                plan = plan_cache.get(cache_key)
+                if plan is not None:
+                    # The optimizer pass (whose rules feed indexes_used)
+                    # is skipped on a hit: attribute the cached plan's
+                    # index scans so "which index answered this query"
+                    # survives caching.
+                    run_report.record("plan_cache", hit=True)
+                    for name in _index_scans_of(plan):
+                        run_report.record("index.used", index=name,
+                                          message="served from plan cache")
+        if plan is not None:
+            pass  # optimize skipped: the serving layer's repeat fast path
+        else:
+            try:
+                plan = self.optimized_plan()
+                if cache_key is not None:
+                    plan_cache.put(cache_key, plan)
+            except Exception as e:  # noqa: BLE001 — InjectedCrash propagates.
+                # PLANNING died with index rewrites on (e.g. every file of
+                # an index unreadable, so even its schema cannot be
+                # fetched).  Degraded mode owns this stage too: re-plan
+                # without indexes; a failure of THAT plan is a genuine
+                # query error and propagates from a planning pass indexes
+                # never touched.  A deadline expiry is NOT a degraded
+                # condition: re-planning would spend more time past a
+                # deadline that already passed — propagate it.
+                if isinstance(e, DeadlineExceededError):
+                    raise
+                if not self.session.is_hyperspace_enabled() or \
+                        not self.session.conf.degraded_fallback_to_source:
+                    raise
+                from hyperspace_tpu.telemetry.events import (
+                    IndexDegradedEvent,
+                    emit_event,
+                )
 
-            emit_event(IndexDegradedEvent(
-                reason=f"index-aware planning failed: {e!r}",
-                message="re-planned without index rewrites"))
-            run_report.record("replan", mode="source-fallback",
-                              stage="planning")
-            with span("optimize.replan", mode="source-fallback"):
-                plan = self.optimized_plan(use_indexes=False)
+                emit_event(IndexDegradedEvent(
+                    reason=f"index-aware planning failed: {e!r}",
+                    message="re-planned without index rewrites"))
+                run_report.record("replan", mode="source-fallback",
+                                  stage="planning")
+                with span("optimize.replan", mode="source-fallback"):
+                    plan = self.optimized_plan(use_indexes=False)
         try:
             with span("execute"):
                 out = executor.execute(plan)
         except Exception as e:  # noqa: BLE001 — InjectedCrash is a
             # BaseException and still dies like a real crash.
+            if isinstance(e, DeadlineExceededError):
+                # Past-deadline work is the one thing the fallback
+                # machinery must NOT do more of — propagate immediately.
+                raise
+            if cache_key is not None:
+                # The cached plan (or the plan just cached) failed at
+                # execution: drop it so the containment/fallback outcome
+                # below is what the NEXT request re-derives from scratch,
+                # not a replay of this failure.
+                plan_cache.invalidate(cache_key)
             index_names = _index_scans_of(plan)
             if not index_names or \
                     not self.session.conf.degraded_fallback_to_source:

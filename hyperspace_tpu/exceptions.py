@@ -35,3 +35,11 @@ class CorruptMetadataError(HyperspaceError):
 class DegradedIndexError(HyperspaceError):
     """An index's operation log is unreadable and degraded-mode fallback
     (``hyperspace.system.degraded.fallbackToSource``) is disabled."""
+
+
+class DeadlineExceededError(HyperspaceError):
+    """The per-request deadline (utils/deadline.py) expired: the query was
+    aborted at a phase boundary.  Deliberately NOT a degraded-mode
+    trigger — an expired deadline must propagate to the caller as a
+    retryable condition, never silently re-plan (which would spend even
+    more time past the deadline)."""

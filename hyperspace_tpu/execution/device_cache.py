@@ -56,71 +56,102 @@ def files_fingerprint(paths: Iterable[str]) -> Optional[str]:
     return h.hexdigest()
 
 
-class DeviceColumnCache:
-    """Byte-budgeted LRU of device arrays (thread-safe)."""
+class ByteBudgetLRU:
+    """Thread-safe byte-budgeted LRU — the eviction mechanism shared by
+    the device-column cache below and the serving layer's optimize-result
+    plan cache (execution/plan_cache.py): one policy (LRU within an
+    explicit byte budget, oversize entries rejected and tombstoned),
+    one metric shape (``<prefix>.hits/misses/evictions`` counters plus a
+    ``<prefix>.bytes`` gauge when ``metric_prefix`` is set)."""
 
     _REJECTED_MAX = 4096  # bound the tombstone set; clear-all on overflow
 
-    def __init__(self) -> None:
-        self._entries: "OrderedDict[Key, object]" = OrderedDict()
-        self._nbytes: Dict[Key, int] = {}
-        # Keys whose arrays did not fit the byte budget: the eager policy
-        # must stop lowering the routing threshold for them, or every
-        # repeat re-ships the column ("pay the transfer forever").
+    def __init__(self, metric_prefix: Optional[str] = None) -> None:
+        self._entries: "OrderedDict[object, object]" = OrderedDict()
+        self._nbytes: Dict[object, int] = {}
+        # Keys whose values did not fit the byte budget: callers that
+        # make ROUTING decisions off cache presence (the device cache's
+        # eager policy) must stop retrying them, or every repeat pays the
+        # full cost forever.
         self._rejected: set = set()
         self._lock = threading.Lock()
+        self._prefix = metric_prefix
         self.bytes_cached = 0
         self.hits = 0
         self.misses = 0
         self.evictions = 0
 
-    def get(self, key: Key):
-        from hyperspace_tpu.telemetry import metrics
+    def _inc(self, name: str) -> None:
+        if self._prefix is not None:
+            from hyperspace_tpu.telemetry import metrics
 
+            metrics.inc(f"{self._prefix}.{name}")
+
+    def get(self, key):
         with self._lock:
-            arr = self._entries.get(key)
-            if arr is None:
+            value = self._entries.get(key)
+            if value is None:
                 self.misses += 1
-                metrics.inc("cache.device.misses")
+                self._inc("misses")
                 return None
             self._entries.move_to_end(key)
             self.hits += 1
-            metrics.inc("cache.device.hits")
-            return arr
+            self._inc("hits")
+            return value
 
-    def contains(self, key: Key) -> bool:
-        """Presence probe for ROUTING decisions — no hit/miss accounting
-        (the actual fetch follows if the device path is chosen)."""
+    def contains(self, key) -> bool:
+        """Presence probe — no hit/miss accounting (the actual fetch
+        follows if the caller decides to use the cache)."""
         with self._lock:
             return key in self._entries
 
-    def was_rejected(self, key: Key) -> bool:
+    def peek(self, key):
+        """Value without hit/miss accounting or a recency update — for
+        callers that must validate an entry before deciding whether the
+        lookup counts as a hit (the plan cache's staleness check)."""
+        with self._lock:
+            return self._entries.get(key)
+
+    def was_rejected(self, key) -> bool:
         with self._lock:
             return key in self._rejected
 
-    def put(self, key: Key, arr, budget_bytes: int) -> None:
-        nbytes = int(getattr(arr, "nbytes", 0) or 0)
+    def put(self, key, value, nbytes: int, budget_bytes: int) -> bool:
+        """Insert ``value`` accounted at ``nbytes``, evicting LRU entries
+        to stay within ``budget_bytes``.  Returns False (and tombstones
+        the key) when the entry can never fit."""
+        nbytes = int(nbytes or 0)
         if nbytes <= 0 or nbytes > budget_bytes:
             with self._lock:
                 if len(self._rejected) >= self._REJECTED_MAX:
                     self._rejected.clear()
                 self._rejected.add(key)
-            return
-        from hyperspace_tpu.telemetry import metrics
-
+            return False
         with self._lock:
             if key in self._entries:
                 self._entries.move_to_end(key)
-                return
+                return True
             while self.bytes_cached + nbytes > budget_bytes and self._entries:
                 old_key, _old = self._entries.popitem(last=False)
                 self.bytes_cached -= self._nbytes.pop(old_key)
                 self.evictions += 1
-                metrics.inc("cache.device.evictions")
-            self._entries[key] = arr
+                self._inc("evictions")
+            self._entries[key] = value
             self._nbytes[key] = nbytes
             self.bytes_cached += nbytes
-            metrics.set_gauge("cache.device.bytes", self.bytes_cached)
+            if self._prefix is not None:
+                from hyperspace_tpu.telemetry import metrics
+
+                metrics.set_gauge(f"{self._prefix}.bytes", self.bytes_cached)
+        return True
+
+    def pop(self, key) -> None:
+        """Drop one entry (invalidation)."""
+        with self._lock:
+            if key in self._entries:
+                del self._entries[key]
+                self.bytes_cached -= self._nbytes.pop(key)
+            self._rejected.discard(key)
 
     def clear(self) -> None:
         with self._lock:
@@ -135,6 +166,18 @@ class DeviceColumnCache:
                     "evictions": self.evictions,
                     "entries": len(self._entries),
                     "bytes": self.bytes_cached}
+
+
+class DeviceColumnCache(ByteBudgetLRU):
+    """Byte-budgeted LRU of device arrays (thread-safe).  The byte cost
+    of an entry is the array's own ``nbytes``."""
+
+    def __init__(self) -> None:
+        super().__init__(metric_prefix="cache.device")
+
+    def put(self, key: Key, arr, budget_bytes: int) -> None:  # type: ignore[override]
+        super().put(key, arr, int(getattr(arr, "nbytes", 0) or 0),
+                    budget_bytes)
 
 
 # One cache per process: device memory is a process-level resource, and

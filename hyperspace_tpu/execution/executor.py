@@ -27,6 +27,7 @@ import pyarrow as pa
 import pyarrow.compute as pc
 
 from hyperspace_tpu.io import columnar
+from hyperspace_tpu.utils import deadline as _deadline
 from hyperspace_tpu.utils.compat import enable_x64 as _enable_x64
 from hyperspace_tpu.io.files import list_data_files
 from hyperspace_tpu.io.parquet import bucket_id_of_file, read_table
@@ -243,6 +244,11 @@ class Executor:
             self.stats["memory"] = mem
 
     def execute(self, plan: LogicalPlan) -> pa.Table:
+        # Per-request deadline (utils/deadline.py): every operator entry
+        # is a phase boundary — a served query past its deadline aborts
+        # here instead of completing an answer nobody waits for.  One
+        # contextvar read when no deadline is set.
+        _deadline.check(type(plan).__name__)
         if isinstance(plan, InMemory):
             return plan.table
         if isinstance(plan, Scan):

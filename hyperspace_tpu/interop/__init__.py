@@ -12,9 +12,13 @@ any Arrow implementation, no Python required on the client.
 from hyperspace_tpu.interop.query import dataset_from_spec, expr_from_json
 from hyperspace_tpu.interop.server import (
     QueryClient,
+    QueryFailedError,
     QueryServer,
+    ServerBusyError,
+    parse_wire_error,
     request_query,
 )
 
 __all__ = ["dataset_from_spec", "expr_from_json", "QueryClient",
-           "QueryServer", "request_query"]
+           "QueryFailedError", "QueryServer", "ServerBusyError",
+           "parse_wire_error", "request_query"]

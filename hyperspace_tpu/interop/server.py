@@ -7,15 +7,49 @@ socket plus an Arrow library — no HTTP/gRPC dependency):
                      UTF-8, terminated by a newline
   server -> client   the status line ``OK\\n`` followed by an Arrow IPC
                      STREAM of the result (self-delimiting), or
-                     ``ERR <message>\\n`` and the connection closes
+                     ``ERR <CODE> <message>\\n`` and the connection
+                     closes
+
+Error codes split RETRYABLE conditions from permanent ones:
+
+  ``BUSY``      retryable — the server shed the request (admission queue
+                full, connection capacity, overload watermark, draining)
+  ``DEADLINE``  retryable — the request's deadline expired before the
+                result was ready
+  ``BADREQ``    permanent — the request itself is malformed
+  ``FAILED``    permanent — the engine failed executing a valid request
+
+Pre-taxonomy servers sent bare ``ERR <message>``; :func:`parse_wire_error`
+(used by :class:`QueryClient`) still accepts that form, mapping it to
+``FAILED``.
 
 Connections are PIPELINED: after a successful response the client may send
 the next request on the same connection (an error closes it, keeping
-framing unambiguous).  Clients execute CONCURRENTLY — only the optimizer
-step serializes (session-level state); a slow query does not stall other
-connections.  The server executes against ONE session, so enabled indexes
-and conf govern rewrites exactly as for local use — this is the parity
-surface for the reference's py4j bindings / .NET sample
+framing unambiguous).  Execution is ADMISSION-CONTROLLED (ROADMAP item 2):
+socket IO runs on per-connection threads (bounded by
+``hyperspace.serving.maxConnections`` — beyond it the accept loop answers
+``ERR BUSY`` without spawning a thread), while query execution runs on a
+fixed pool of ``hyperspace.serving.workers`` threads fed by a bounded
+admission queue (``hyperspace.serving.queueDepth``).  When the queue is
+full — or the process is past a memory/queue-wait watermark — new
+requests shed FAST with ``ERR BUSY`` instead of piling onto a saturated
+server: under overload the answer degrades to "retry later", never to a
+hang, a thread leak, or a torn frame (only the connection's own handler
+thread ever writes to its socket, one complete response per request).
+
+Per-request deadlines (spec key ``deadline_ms``, or the conf default
+``hyperspace.serving.defaultDeadlineMs``) propagate into
+``dataset.collect`` via utils/deadline.py and abort cleanly at executor
+phase boundaries; expiry surfaces as ``ERR DEADLINE``.  Repeat queries
+skip the optimizer via the plan cache (execution/plan_cache.py), keyed
+by the advisor's structural fingerprint + literal digest.  ``drain()``
+(or SIGTERM with ``handle_sigterm=True``) stops accepting, finishes
+in-flight requests within ``hyperspace.serving.drainGraceS``, then
+closes.
+
+The server executes against ONE session, so enabled indexes and conf
+govern rewrites exactly as for local use — this is the parity surface for
+the reference's py4j bindings / .NET sample
 (python/hyperspace/hyperspace.py:9, examples/csharp/Program.cs): a JVM or
 .NET client sends the JSON spec and reads the stream with its own Arrow
 implementation.
@@ -24,10 +58,13 @@ implementation.
 from __future__ import annotations
 
 import json
+import os
+import queue
 import socket
 import socketserver
 import threading
-from typing import Any, Dict, Optional, Tuple
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import pyarrow as pa
 
@@ -36,9 +73,278 @@ MAX_REQUEST_BYTES = 1 << 20  # a query spec, not a data upload
 
 REQUEST_TIMEOUT_S = 30.0  # an idle connection must not pin a thread + fd
 
+# -- wire error taxonomy ------------------------------------------------------
+ERR_BUSY = "BUSY"
+ERR_DEADLINE = "DEADLINE"
+ERR_BADREQ = "BADREQ"
+ERR_FAILED = "FAILED"
+KNOWN_WIRE_CODES = (ERR_BUSY, ERR_DEADLINE, ERR_BADREQ, ERR_FAILED)
+RETRYABLE_WIRE_CODES = frozenset({ERR_BUSY, ERR_DEADLINE})
 
+
+class WireError(Exception):
+    """Server-side: an error with an explicit wire code (the handler maps
+    everything else through :func:`_classify_error`)."""
+
+    def __init__(self, code: str, message: str) -> None:
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+class QueryFailedError(RuntimeError):
+    """Client-side: the server answered ``ERR ...``.  ``code`` is one of
+    ``BUSY``/``DEADLINE``/``BADREQ``/``FAILED`` (bare pre-taxonomy errors
+    map to ``FAILED``); ``retryable`` is True for overload/deadline sheds
+    — back off and retry on a FRESH connection (errors close the one they
+    arrived on)."""
+
+    def __init__(self, code: str, message: str, payload: str) -> None:
+        super().__init__(f"Query failed: {payload}")
+        self.code = code
+        self.message = message
+
+    @property
+    def retryable(self) -> bool:
+        return self.code in RETRYABLE_WIRE_CODES
+
+
+class ServerBusyError(QueryFailedError):
+    """The server shed this request (``ERR BUSY``): overload, not a bug.
+    Retry with backoff on a new connection."""
+
+
+def parse_wire_error(line: str) -> QueryFailedError:
+    """An ``ERR ...`` status line → the typed client error.  Accepts both
+    the coded form (``ERR BUSY queue full``) and the pre-taxonomy bare
+    form (``ERR something broke`` → code FAILED), so a new client keeps
+    working against an old server."""
+    payload = line[4:] if line.startswith("ERR ") else line
+    code, _, rest = payload.partition(" ")
+    if code in KNOWN_WIRE_CODES and rest:
+        cls = ServerBusyError if code == ERR_BUSY else QueryFailedError
+        return cls(code, rest, payload)
+    return QueryFailedError(ERR_FAILED, payload, payload)
+
+
+def _classify_error(exc: BaseException) -> Tuple[str, str]:
+    """(wire code, message) for an exception crossing the wire boundary."""
+    from hyperspace_tpu.exceptions import DeadlineExceededError
+
+    if isinstance(exc, WireError):
+        return exc.code, exc.message
+    if isinstance(exc, DeadlineExceededError):
+        return ERR_DEADLINE, str(exc)
+    if isinstance(exc, ValueError):
+        # The spec decoders (interop/query.py, the SQL front end) raise
+        # ValueError for malformed requests — the client's fault.
+        return ERR_BADREQ, str(exc)
+    return ERR_FAILED, f"{type(exc).__name__}: {exc}"
+
+
+def _current_rss_mb() -> float:
+    """CURRENT resident set in MB (Linux /proc; falls back to the POSIX
+    peak, which can only over-shed — the conservative failure mode for an
+    overload watermark)."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE") / float(1 << 20)
+    except Exception:  # noqa: BLE001 — non-Linux
+        try:
+            import resource
+
+            return resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss / 1024.0
+        except Exception:  # noqa: BLE001
+            return 0.0
+
+
+# -- the bounded worker pool --------------------------------------------------
+class _Job:
+    """One admitted request: the execute closure plus its rendezvous.
+    Workers compute; the connection's handler thread does ALL socket IO —
+    that single-writer discipline is what makes torn frames impossible."""
+
+    __slots__ = ("fn", "kind", "deadline_at", "enqueued_t", "done",
+                 "result", "error", "report", "abandoned")
+
+    def __init__(self, fn: Callable[[], pa.Table], kind: str,
+                 deadline_at: Optional[float]) -> None:
+        self.fn = fn
+        self.kind = kind
+        self.deadline_at = deadline_at  # absolute time.monotonic(), or None
+        self.enqueued_t = time.monotonic()
+        self.done = threading.Event()
+        self.result: Optional[pa.Table] = None
+        self.error: Optional[BaseException] = None
+        self.report = None  # the query's run report, for the verb surface
+        self.abandoned = False  # handler gave up waiting; discard result
+
+
+class _WorkerPool:
+    """Fixed worker threads over a bounded admission queue — the hard cap
+    on concurrent query execution, and the seam every shed decision goes
+    through."""
+
+    _EWMA_ALPHA = 0.2
+
+    def __init__(self, session, workers: int, queue_depth: int) -> None:
+        self._session = session
+        self._queue: "queue.Queue" = queue.Queue(maxsize=max(1, queue_depth))
+        self._threads: list = []
+        self._stop_sentinel = object()
+        self._lock = threading.Lock()
+        self._idle = threading.Condition(self._lock)
+        self._active = 0  # jobs executing right now
+        self._queued_or_active = 0  # admitted and not yet finished
+        self._queue_wait_ewma_ms = 0.0
+        self._rss_at = 0.0
+        self._rss_mb = 0.0
+        self.draining = False
+        self.workers = max(1, int(workers))
+
+    def start(self) -> None:
+        for i in range(self.workers):
+            t = threading.Thread(target=self._run,
+                                 name=f"hs-serve-worker-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    # -- admission ---------------------------------------------------------
+    def _shed(self, reason: str, message: str) -> None:
+        from hyperspace_tpu.telemetry import metrics
+
+        metrics.inc("serve.shed")
+        metrics.inc(f"serve.shed.{reason}")
+        raise WireError(ERR_BUSY, message)
+
+    def submit(self, job: _Job, conf) -> None:
+        """Admit ``job`` or shed it with a retryable ``ERR BUSY``."""
+        from hyperspace_tpu.telemetry import metrics
+
+        if self.draining:
+            self._shed("draining", "server is draining; retry elsewhere")
+        rss_mark = float(getattr(conf, "serving_shed_rss_watermark_mb", 0.0))
+        if rss_mark > 0:
+            now = time.monotonic()
+            if now - self._rss_at > 0.2:  # memoize: a stat per ~5 admits
+                self._rss_mb = _current_rss_mb()
+                self._rss_at = now
+            if self._rss_mb > rss_mark:
+                self._shed("memory",
+                           f"memory watermark: rss {self._rss_mb:.0f} MB > "
+                           f"{rss_mark:.0f} MB; retry later")
+        wait_mark = float(getattr(conf,
+                                  "serving_shed_queue_wait_watermark_ms",
+                                  0.0))
+        if wait_mark > 0 and self._queue_wait_ewma_ms > wait_mark \
+                and self._queue.qsize() > 0:
+            self._shed("latency",
+                       f"queue-wait watermark: recent wait "
+                       f"{self._queue_wait_ewma_ms:.0f} ms > "
+                       f"{wait_mark:.0f} ms; retry later")
+        # Count BEFORE enqueueing: a worker can finish the job before this
+        # thread resumes, and wait_idle must never observe a transient
+        # zero while work is genuinely in flight.
+        with self._lock:
+            self._queued_or_active += 1
+        try:
+            self._queue.put_nowait(job)
+        except queue.Full:
+            with self._idle:
+                self._queued_or_active -= 1
+                self._idle.notify_all()
+            self._shed("queue_full",
+                       f"admission queue full "
+                       f"(depth {self._queue.maxsize}); retry later")
+        metrics.inc("serve.admitted")
+        metrics.set_gauge("serve.queue_depth", self._queue.qsize())
+
+    # -- workers -----------------------------------------------------------
+    def _run(self) -> None:
+        from hyperspace_tpu.exceptions import DeadlineExceededError
+        from hyperspace_tpu.telemetry import metrics
+        from hyperspace_tpu.telemetry import trace
+        from hyperspace_tpu.utils import deadline as _deadline
+
+        while True:
+            item = self._queue.get()
+            if item is self._stop_sentinel:
+                return
+            job: _Job = item
+            now = time.monotonic()
+            wait_ms = (now - job.enqueued_t) * 1000.0
+            self._queue_wait_ewma_ms += self._EWMA_ALPHA * (
+                wait_ms - self._queue_wait_ewma_ms)
+            metrics.observe("serve.queue_wait_ms", wait_ms)
+            metrics.set_gauge("serve.queue_depth", self._queue.qsize())
+            with self._lock:
+                self._active += 1
+                metrics.set_gauge("serve.inflight", self._active)
+            try:
+                if job.abandoned:
+                    pass  # handler already answered; don't spend compute
+                elif job.deadline_at is not None and now > job.deadline_at:
+                    # Expired while QUEUED: zero execution spent on it.
+                    job.error = DeadlineExceededError(
+                        f"deadline expired after {wait_ms:.0f} ms in the "
+                        f"admission queue")
+                else:
+                    budget = None if job.deadline_at is None \
+                        else job.deadline_at - time.monotonic()
+                    with trace.span("serve.request", kind=job.kind) as sp:
+                        with _deadline.scope(budget):
+                            job.result = job.fn()
+                        sp.set(queue_wait_ms=round(wait_ms, 1))
+                    # The run report lands in this WORKER's thread-local;
+                    # hand it to the connection so the last_run_report
+                    # verb keeps its query-then-ask-same-connection
+                    # contract.
+                    job.report = self._session.last_run_report_value
+            except BaseException as e:  # noqa: BLE001 — a worker must
+                # survive anything a query can throw; the error crosses
+                # the wire instead (the handler classifies it).
+                job.error = e
+            finally:
+                job.done.set()
+                with self._idle:
+                    self._active -= 1
+                    self._queued_or_active -= 1
+                    metrics.set_gauge("serve.inflight", self._active)
+                    self._idle.notify_all()
+
+    # -- lifecycle ---------------------------------------------------------
+    def wait_idle(self, grace_s: float) -> bool:
+        """Block until every admitted job finished, or ``grace_s`` passed.
+        Returns True when the pool drained clean."""
+        deadline_at = time.monotonic() + max(0.0, grace_s)
+        with self._idle:
+            while self._queued_or_active > 0:
+                left = deadline_at - time.monotonic()
+                if left <= 0:
+                    return False
+                self._idle.wait(left)
+        return True
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        for _ in self._threads:
+            self._queue.put(self._stop_sentinel)
+        for t in self._threads:
+            t.join(timeout=timeout_s)
+        self._threads.clear()
+
+
+# -- the connection handler ---------------------------------------------------
 class _Handler(socketserver.StreamRequestHandler):
-    timeout = REQUEST_TIMEOUT_S  # StreamRequestHandler applies it pre-read
+    timeout = REQUEST_TIMEOUT_S  # initial value; per-phase settimeout below
+
+    def setup(self) -> None:
+        super().setup()
+        # The most recent run report of a query served on THIS connection
+        # (queries execute on pool workers, so the session's thread-local
+        # cannot answer the last_run_report verb anymore).
+        self._last_report = None
 
     def handle(self) -> None:
         # Pipelined: serve requests until EOF, idle timeout, or an error
@@ -48,81 +354,168 @@ class _Handler(socketserver.StreamRequestHandler):
             pass
 
     def _serve_one(self) -> bool:
+        from hyperspace_tpu.telemetry import metrics
+
+        conf = self.server.session.conf
         try:
+            self.connection.settimeout(
+                float(conf.serving_request_timeout_s))
             line = self.rfile.readline(MAX_REQUEST_BYTES + 1)
         except (TimeoutError, OSError):
             return False
         if not line:
             return False  # clean EOF between requests
+        metrics.inc("serve.requests")
         try:
-            if len(line) > MAX_REQUEST_BYTES or not line.endswith(b"\n"):
-                raise ValueError(
-                    f"request exceeds {MAX_REQUEST_BYTES} bytes or is not "
-                    f"newline-terminated")
-            spec = json.loads(line.decode("utf-8"))
-            if not isinstance(spec, dict):
-                # A bare JSON string/array is valid JSON — and `"sql" in
-                # spec` on a string would substring-match.
-                raise ValueError("request must be a JSON object")
-            # Concurrent execution is safe: the session serializes its
-            # OPTIMIZE step internally (shared entry tags / schema memo);
-            # the executor itself only reads shared state.
+            spec = self._parse(line)
             if "verb" in spec:
-                # Observability verbs: the PR 4 surface for remote clients
-                # (docs/07-interop.md).  Same framing as queries — an
-                # arrow table comes back — so existing clients need no
-                # new code paths.
-                table = _serve_verb(self.server.session, spec)
-            elif "sql" in spec:
-                # {"sql": "SELECT ...", "tables": {name: parquet_dir}} —
-                # SQL text over the wire, the reference corpus's native
-                # form (goldstandard/PlanStabilitySuite.scala:81-283).
-                from hyperspace_tpu.sql import sql as run_sql
-
-                if not isinstance(spec["sql"], str):
-                    raise ValueError('"sql" must be a string')
-                tables = spec.get("tables", {})
-                if not isinstance(tables, dict) or not all(
-                        isinstance(v, str) for v in tables.values()):
-                    raise ValueError(
-                        '"tables" must map names to parquet directory '
-                        'paths over the wire')
-                table = run_sql(self.server.session, spec["sql"],
-                                tables=tables).collect()
+                # Observability verbs answer INLINE on the connection
+                # thread: they read process state, never the executor, and
+                # must keep working while the admission queue is slammed —
+                # an operator debugging an overload needs `metrics` most
+                # exactly then.
+                table = _serve_verb(self.server.session, spec,
+                                    self._last_report)
             else:
-                from hyperspace_tpu.interop.query import dataset_from_spec
-
-                table = dataset_from_spec(
-                    self.server.session, spec).collect()
-        except Exception as exc:  # -> wire error, connection closes
-            msg = str(exc).replace("\n", " ")[:500]
+                table = self._execute_admitted(spec, conf)
+        except Exception as exc:  # -> coded wire error, connection closes
+            code, raw = _classify_error(exc)
+            msg = str(raw).replace("\n", " ")[:500]
+            metrics.inc("serve.errors")
+            metrics.inc(f"serve.err.{code.lower()}")
+            if code == ERR_DEADLINE:
+                metrics.inc("serve.deadline.expired")
             try:
-                self.wfile.write(f"ERR {msg}\n".encode("utf-8"))
+                self.connection.settimeout(
+                    float(conf.serving_send_timeout_s))
+                self.wfile.write(f"ERR {code} {msg}\n".encode("utf-8"))
             except OSError:
                 pass
             return False
+        # The send side gets its OWN timeout: REQUEST_TIMEOUT_S historically
+        # guarded only the read, so a dead client that stopped READING
+        # mid-Arrow-stream pinned its thread on a full send buffer forever.
         try:
+            self.connection.settimeout(float(conf.serving_send_timeout_s))
             self.wfile.write(b"OK\n")
             with pa.ipc.new_stream(self.wfile, table.schema) as writer:
                 writer.write_table(table)
             self.wfile.flush()
+            metrics.inc("serve.ok")
             return True
+        except TimeoutError:
+            metrics.inc("serve.send_timeouts")
+            return False  # dead reader: free the thread, drop the socket
         except OSError:
             return False  # client hung up mid-response
 
+    def _parse(self, line: bytes) -> Dict[str, Any]:
+        if len(line) > MAX_REQUEST_BYTES or not line.endswith(b"\n"):
+            raise WireError(
+                ERR_BADREQ,
+                f"request exceeds {MAX_REQUEST_BYTES} bytes or is not "
+                f"newline-terminated")
+        try:
+            spec = json.loads(line.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError) as e:
+            raise WireError(ERR_BADREQ, f"request is not JSON: {e}")
+        if not isinstance(spec, dict):
+            # A bare JSON string/array is valid JSON — and `"sql" in
+            # spec` on a string would substring-match.
+            raise WireError(ERR_BADREQ, "request must be a JSON object")
+        return spec
 
-def _serve_verb(session, spec: Dict[str, Any]) -> pa.Table:
+    def _execute_admitted(self, spec: Dict[str, Any], conf) -> pa.Table:
+        from hyperspace_tpu.exceptions import DeadlineExceededError
+        from hyperspace_tpu.telemetry import metrics
+
+        deadline_ms = spec.pop("deadline_ms", None)
+        if deadline_ms is None:
+            default_ms = float(conf.serving_default_deadline_ms or 0.0)
+            deadline_ms = default_ms if default_ms > 0 else None
+        elif not isinstance(deadline_ms, (int, float)) or \
+                isinstance(deadline_ms, bool) or deadline_ms <= 0:
+            raise WireError(ERR_BADREQ,
+                            f'"deadline_ms" must be a positive number, '
+                            f'got {deadline_ms!r}')
+        deadline_at = None if deadline_ms is None \
+            else time.monotonic() + float(deadline_ms) / 1000.0
+        fn, kind = self._make_query_fn(spec)
+        job = _Job(fn, kind, deadline_at)
+        self.server.pool.submit(job, conf)  # raises WireError(BUSY) = shed
+        if deadline_at is None:
+            job.done.wait()
+        else:
+            left = max(0.0, deadline_at - time.monotonic())
+            if not job.done.wait(left):
+                # The deadline is a RESPONSE contract, enforced here even
+                # when the worker is mid-phase: answer DEADLINE the moment
+                # it passes — the deadline contextvar aborts the work at
+                # its next phase boundary, and the abandoned flag discards
+                # the orphan result (and skips the job entirely if it was
+                # still queued).
+                job.abandoned = True
+                raise DeadlineExceededError(
+                    "deadline exceeded before the result was ready (the "
+                    "query aborts at its next phase boundary)")
+        if job.error is not None:
+            raise job.error
+        if job.report is not None:
+            self._last_report = job.report
+        metrics.observe("serve.latency_ms",
+                        (time.monotonic() - job.enqueued_t) * 1000.0)
+        return job.result
+
+    def _make_query_fn(self, spec: Dict[str, Any]):
+        """Validate the request SHAPE on the connection thread (BADREQ
+        without consuming a queue slot), return the execute closure the
+        worker runs."""
+        session = self.server.session
+        plan_cache = self.server.plan_cache
+        if "sql" in spec:
+            # {"sql": "SELECT ...", "tables": {name: parquet_dir}} —
+            # SQL text over the wire, the reference corpus's native
+            # form (goldstandard/PlanStabilitySuite.scala:81-283).
+            if not isinstance(spec["sql"], str):
+                raise WireError(ERR_BADREQ, '"sql" must be a string')
+            tables = spec.get("tables", {})
+            if not isinstance(tables, dict) or not all(
+                    isinstance(v, str) for v in tables.values()):
+                raise WireError(
+                    ERR_BADREQ,
+                    '"tables" must map names to parquet directory paths '
+                    'over the wire')
+
+            def run() -> pa.Table:
+                from hyperspace_tpu.sql import sql as run_sql
+
+                ds = run_sql(session, spec["sql"], tables=tables)
+                return ds.collect(plan_cache=plan_cache)
+
+            return run, "sql"
+
+        def run_spec() -> pa.Table:
+            from hyperspace_tpu.interop.query import dataset_from_spec
+
+            return dataset_from_spec(session, spec).collect(
+                plan_cache=plan_cache)
+
+        return run_spec, "spec"
+
+
+def _serve_verb(session, spec: Dict[str, Any],
+                last_report=None) -> pa.Table:
     """Non-query verbs of the wire protocol:
 
       {"verb": "metrics"}          -> (name, value) rows: counters/gauges
                                       flat, histograms flattened to
                                       name.count/name.sum/name.mean
       {"verb": "last_run_report"}  -> one row, column ``report_json`` —
-                                      the serving session's most recent
-                                      query report ON ANY THREAD is not
-                                      knowable, so this returns the LAST
-                                      report of the CONNECTION's thread
-                                      (query then ask on one connection)
+                                      the most recent query report of
+                                      THIS CONNECTION (query then ask on
+                                      one connection; queries execute on
+                                      pool workers, so the handler keeps
+                                      the report per connection)
       {"verb": "workload"}         -> the captured advisor workload table
                                       (advisor/workload.py)
       {"verb": "perf_history"}     -> the persistent perf ledger
@@ -159,7 +552,8 @@ def _serve_verb(session, spec: Dict[str, Any]) -> pa.Table:
         return pa.table({"name": pa.array(names, type=pa.string()),
                          "value": pa.array(values, type=pa.float64())})
     if verb == "last_run_report":
-        report = session.last_run_report_value
+        report = last_report if last_report is not None \
+            else session.last_run_report_value
         payload = json.dumps(report.to_dict() if report is not None
                              else None)
         return pa.table({"report_json": pa.array([payload],
@@ -197,11 +591,24 @@ def _is_loopback(host: str) -> bool:
 
 
 class QueryServer:
-    """Threaded TCP server bound to ``session``.  ``port=0`` picks an
-    ephemeral port (read it back from ``.address``)."""
+    """Admission-controlled threaded TCP server bound to ``session``.
+    ``port=0`` picks an ephemeral port (read it back from ``.address``).
+
+    Sizing comes from the session conf at construction
+    (``hyperspace.serving.workers`` / ``.queueDepth`` /
+    ``.maxConnections`` — see docs/07-interop.md); timeouts, deadlines,
+    and shed watermarks are read live per request, so ``conf.set`` on a
+    running server takes effect immediately.
+
+    ``handle_sigterm=True`` installs a SIGTERM handler (main thread only)
+    that runs :meth:`drain` in the background: stop accepting, let
+    in-flight requests finish within ``hyperspace.serving.drainGraceS``,
+    then close — ``drained`` is set when the shutdown completes, so a
+    serving script can simply ``server.drained.wait()``."""
 
     def __init__(self, session, host: str = "127.0.0.1",
-                 port: int = 0, allow_remote: bool = False) -> None:
+                 port: int = 0, allow_remote: bool = False,
+                 handle_sigterm: bool = False) -> None:
         # The server is UNAUTHENTICATED and reads any path the process can
         # access; binding a non-loopback interface exposes that to the
         # network.  Require the caller to say so explicitly.
@@ -212,31 +619,162 @@ class QueryServer:
                 f"reach the port can read any file this process can.  Pass "
                 f"allow_remote=True only behind a trusted network boundary.")
 
+        outer = self
+
         class _Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
             daemon_threads = True
 
+            def process_request(self, request, client_address):
+                if not outer._acquire_conn():
+                    # Reject IN the accept loop — no handler thread is
+                    # spawned, so a connection storm cannot grow the
+                    # thread count past maxConnections + workers.
+                    from hyperspace_tpu.telemetry import metrics
+
+                    metrics.inc("serve.shed")
+                    metrics.inc("serve.shed.connections")
+                    try:
+                        request.settimeout(1.0)
+                        request.sendall(
+                            f"ERR {ERR_BUSY} connection capacity reached; "
+                            f"retry later\n".encode("utf-8"))
+                    except OSError:
+                        pass
+                    self.shutdown_request(request)
+                    return
+                super().process_request(request, client_address)
+
+            def process_request_thread(self, request, client_address):
+                try:
+                    super().process_request_thread(request, client_address)
+                finally:
+                    outer._release_conn()
+
         self._server = _Server((host, port), _Handler)
         self._server.session = session
+        conf = session.conf
+        self._server.pool = _WorkerPool(
+            session,
+            workers=int(getattr(conf, "serving_workers", 4)),
+            queue_depth=int(getattr(conf, "serving_queue_depth", 16)))
+        if getattr(conf, "serving_plan_cache_enabled", True):
+            from hyperspace_tpu.execution.plan_cache import PlanCache
+
+            self._server.plan_cache = PlanCache(
+                budget_bytes=int(getattr(conf, "serving_plan_cache_bytes",
+                                         64 << 20)),
+                ttl_s=float(conf.cache_expiry_seconds))
+        else:
+            self._server.plan_cache = None
+        self._max_connections = int(getattr(conf,
+                                            "serving_max_connections", 64))
+        self._conn_lock = threading.Lock()
+        self._conn_count = 0
         self._thread: Optional[threading.Thread] = None
+        self._draining = False
+        self.drained = threading.Event()
+        if handle_sigterm:
+            self._install_sigterm()
+
+    # -- connection accounting ---------------------------------------------
+    def _acquire_conn(self) -> bool:
+        if self._draining:
+            return False
+        with self._conn_lock:
+            if self._max_connections > 0 and \
+                    self._conn_count >= self._max_connections:
+                return False
+            self._conn_count += 1
+        from hyperspace_tpu.telemetry import metrics
+
+        metrics.set_gauge("serve.connections", self._conn_count)
+        return True
+
+    def _release_conn(self) -> None:
+        with self._conn_lock:
+            self._conn_count = max(0, self._conn_count - 1)
+        from hyperspace_tpu.telemetry import metrics
+
+        metrics.set_gauge("serve.connections", self._conn_count)
+
+    # -- surface -------------------------------------------------------------
+    @property
+    def session(self):
+        return self._server.session
+
+    @property
+    def pool(self) -> _WorkerPool:
+        return self._server.pool
+
+    @property
+    def plan_cache(self):
+        return self._server.plan_cache
 
     @property
     def address(self) -> Tuple[str, int]:
         return self._server.server_address
 
     def start(self) -> "QueryServer":
+        self._server.pool.start()
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         name="hs-query-server", daemon=True)
         self._thread.start()
         return self
+
+    def drain(self, grace_s: Optional[float] = None) -> bool:
+        """Graceful shutdown: stop accepting new connections AND new
+        requests (both shed ``ERR BUSY``), let in-flight requests finish
+        within ``grace_s`` (default conf
+        ``hyperspace.serving.drainGraceS``), then stop the workers and
+        close the listener.  Returns True when everything in flight
+        completed inside the grace window.  Idempotent."""
+        from hyperspace_tpu.telemetry import metrics
+
+        if self.drained.is_set():
+            return True
+        if grace_s is None:
+            grace_s = float(getattr(self.session.conf,
+                                    "serving_drain_grace_s", 10.0))
+        self._draining = True
+        self._server.pool.draining = True
+        metrics.inc("serve.drains")
+        if self._thread is not None:
+            self._server.shutdown()  # stop the accept loop
+        clean = self._server.pool.wait_idle(grace_s)
+        self._server.pool.stop()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.drained.set()
+        return clean
+
+    def _install_sigterm(self) -> None:
+        import signal
+
+        def _on_term(signum, frame) -> None:
+            threading.Thread(target=self.drain, name="hs-serve-drain",
+                             daemon=True).start()
+
+        try:
+            signal.signal(signal.SIGTERM, _on_term)
+        except ValueError:
+            raise ValueError(
+                "handle_sigterm=True requires constructing the "
+                "QueryServer on the main thread (signal handlers are "
+                "main-thread-only); call drain() from your own handler "
+                "instead")
 
     def stop(self) -> None:
         # shutdown() blocks on serve_forever's exit handshake — calling it
         # on a never-started server would hang forever, so only do the
         # handshake when start() actually ran; server_close() alone
         # releases the socket either way.
+        if self.drained.is_set():
+            return
         if self._thread is not None:
             self._server.shutdown()
+        self._server.pool.stop()
         self._server.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5)
@@ -252,7 +790,7 @@ class MetricsScrapeServer:
     """Long-lived Prometheus scrape endpoint: ``GET /metrics`` serves the
     process metrics registry's text exposition
     (``telemetry/metrics.render_prometheus`` — the ``build.phase.*``,
-    ``exec.*``, ``io.*`` catalog of docs/16-observability.md).
+    ``exec.*``, ``io.*``, ``serve.*`` catalog of docs/16-observability.md).
 
     This is the pull-based counterpart of the ``metrics`` verb: the verb
     answers an Arrow client once; this endpoint stays up for a scraper to
@@ -339,21 +877,29 @@ class QueryClient:
     """Persistent pipelined connection: successful ``query()`` calls ride
     one socket (the server answers each in order).  After an error
     response, a transport failure, or the server's idle timeout
-    (REQUEST_TIMEOUT_S between requests) the server closes the connection
-    — the client marks itself broken and subsequent calls raise
-    ``ConnectionError`` asking for a fresh client, rather than failing
-    with a confusing empty-status error on the dead socket."""
+    (``hyperspace.serving.requestTimeoutS`` between requests) the server
+    closes the connection — the client marks itself broken and subsequent
+    calls raise ``ConnectionError`` asking for a fresh client, rather
+    than failing with a confusing empty-status error on the dead socket.
+
+    Wire errors raise :class:`QueryFailedError` (a ``RuntimeError``)
+    carrying ``.code`` and ``.retryable`` — ``BUSY``/``DEADLINE`` mean
+    "back off and retry on a new connection", the overload contract of
+    docs/07-interop.md."""
 
     def __init__(self, address: Tuple[str, int]) -> None:
         self._sock = socket.create_connection(address)
         self._f = self._sock.makefile("rb")
         self._broken = False
 
-    def query(self, spec: Dict[str, Any]) -> pa.Table:
+    def query(self, spec: Dict[str, Any],
+              deadline_ms: Optional[float] = None) -> pa.Table:
         if self._broken:
             raise ConnectionError(
                 "connection closed by an earlier error or timeout; open a "
                 "new QueryClient")
+        if deadline_ms is not None:
+            spec = {**spec, "deadline_ms": deadline_ms}
         try:
             self._sock.sendall(json.dumps(spec).encode("utf-8") + b"\n")
             status = self._f.readline().decode("utf-8").rstrip("\n")
@@ -367,7 +913,7 @@ class QueryClient:
                 raise ConnectionError(
                     "server closed the connection (idle timeout or "
                     "shutdown); open a new QueryClient")
-            raise RuntimeError(f"Query failed: {status}")
+            raise parse_wire_error(status)
         with pa.ipc.open_stream(self._f) as reader:
             return reader.read_all()
 

@@ -1,0 +1,86 @@
+"""Per-request deadline propagation: a contextvar the serving layer sets
+and the executor checks at phase boundaries.
+
+The serving layer (interop/server.py) admits a request with a deadline
+derived from the request spec's ``deadline_ms`` or the conf default
+(``hyperspace.serving.defaultDeadlineMs``).  The worker thread executing
+the query enters :func:`scope`, and every ``check()`` site past the
+deadline raises :class:`DeadlineExceededError` — so a query that has
+already blown its budget stops burning CPU/IO at the NEXT phase boundary
+instead of running to completion for an answer nobody is waiting for.
+
+Check sites are deliberately coarse (executor node dispatch, collect's
+plan/execute seams — never per row): a check is one contextvar read plus
+one clock read, and only when a deadline is actually set does the clock
+read happen at all.
+
+Contextvar semantics mean worker threads spawned INSIDE the executor
+(``utils/parallel_map``) do not inherit the deadline — their per-file
+work finishes and the abort lands at the next boundary on the query's
+own thread.  That is the contract: abort cleanly BETWEEN phases, never
+tear a phase mid-flight.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import time
+from typing import Iterator, Optional
+
+from hyperspace_tpu.exceptions import DeadlineExceededError
+
+__all__ = ["DeadlineExceededError", "scope", "remaining", "check",
+           "active"]
+
+_deadline: "contextvars.ContextVar[Optional[float]]" = \
+    contextvars.ContextVar("hyperspace_deadline", default=None)
+
+
+@contextlib.contextmanager
+def scope(seconds: Optional[float]) -> Iterator[None]:
+    """Run the with-block under a deadline ``seconds`` from now.
+    ``None`` (or a non-positive value) is a no-op scope, so callers can
+    pass an optional deadline through unconditionally.  Scopes nest: the
+    inner scope wins inside the block and the outer one is restored on
+    exit (an inner scope cannot EXTEND an outer deadline — the tighter
+    of the two applies)."""
+    if seconds is None or seconds <= 0:
+        yield
+        return
+    now = time.monotonic()
+    target = now + seconds
+    outer = _deadline.get()
+    if outer is not None:
+        target = min(target, outer)
+    token = _deadline.set(target)
+    try:
+        yield
+    finally:
+        _deadline.reset(token)
+
+
+def active() -> bool:
+    return _deadline.get() is not None
+
+
+def remaining() -> Optional[float]:
+    """Seconds until the current deadline (negative once past it), or
+    None when no deadline is set."""
+    dl = _deadline.get()
+    if dl is None:
+        return None
+    return dl - time.monotonic()
+
+
+def check(phase: str = "") -> None:
+    """Raise :class:`DeadlineExceededError` if the current deadline has
+    passed.  No deadline set = one contextvar read, nothing else."""
+    dl = _deadline.get()
+    if dl is None:
+        return
+    over = time.monotonic() - dl
+    if over > 0:
+        where = f" at {phase}" if phase else ""
+        raise DeadlineExceededError(
+            f"deadline exceeded{where} ({over * 1000.0:.0f} ms past)")

@@ -1,0 +1,571 @@
+"""Production serving layer (interop/server.py): admission control,
+deadlines, backpressure, plan cache, and graceful overload degradation.
+
+The robustness contract under test (ROADMAP item 2): under saturation the
+server sheds fast with retryable ``BUSY`` wire errors and bounded thread
+growth — it never hangs, leaks threads, or interleaves responses — and a
+SIGTERM drain finishes in-flight queries before closing."""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig
+from hyperspace_tpu.exceptions import DeadlineExceededError
+from hyperspace_tpu.interop import (
+    QueryClient,
+    QueryFailedError,
+    QueryServer,
+    ServerBusyError,
+    parse_wire_error,
+    request_query,
+)
+from hyperspace_tpu.telemetry import metrics
+
+
+@pytest.fixture(scope="module")
+def big_dir(tmp_path_factory):
+    """A table big enough that a group-by over it takes real wall time —
+    the 'slow query' every overload/deadline test leans on."""
+    d = str(tmp_path_factory.mktemp("serving") / "big")
+    os.makedirs(d)
+    rng = np.random.default_rng(7)
+    n = 8_000_000
+    pq.write_table(pa.table({
+        "g": pa.array(rng.integers(0, 2_000_000, n), type=pa.int64()),
+        "x": pa.array(rng.random(n)),
+        "y": pa.array(rng.random(n)),
+    }), os.path.join(d, "p.parquet"))
+    return d
+
+
+@pytest.fixture()
+def env(tmp_path):
+    data = str(tmp_path / "data")
+    os.makedirs(data)
+    rng = np.random.default_rng(11)
+    n = 1000
+    pq.write_table(pa.table({
+        "k": pa.array(np.arange(n, dtype=np.int64)),
+        "v": pa.array(rng.integers(0, 100, n), type=pa.int64()),
+        "w": pa.array((np.arange(n) % 5).astype(np.int64)),
+    }), os.path.join(data, "f.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    s.conf.num_buckets = 4
+    return s, data
+
+
+def _slow_spec(big_dir):
+    # ~1s warm on a laptop-class CPU (8M rows, 2M groups, three
+    # aggregates): long enough to hold a worker while other clients storm.
+    return {"source": {"format": "parquet", "path": big_dir},
+            "group_by": ["g"],
+            "aggs": {"t": ["x", "sum"], "m": ["x", "mean"],
+                     "y2": ["y", "sum"]},
+            "sort": [["t", False]], "limit": 5}
+
+
+def _point_spec(data, k):
+    return {"source": {"format": "parquet", "path": data},
+            "filter": {"op": "==", "col": "k", "value": int(k)},
+            "select": ["k", "v"]}
+
+
+def _counter(name):
+    return metrics.registry().counter(name)
+
+
+# ---------------------------------------------------------------------------
+# Wire-error taxonomy
+# ---------------------------------------------------------------------------
+class TestTaxonomy:
+    def test_parse_coded_and_bare_forms(self):
+        e = parse_wire_error("ERR BUSY admission queue full (depth 4)")
+        assert isinstance(e, ServerBusyError)
+        assert e.code == "BUSY" and e.retryable
+        assert "queue full" in e.message
+        e = parse_wire_error("ERR DEADLINE deadline exceeded at Join")
+        assert e.code == "DEADLINE" and e.retryable
+        e = parse_wire_error("ERR BADREQ request must be a JSON object")
+        assert e.code == "BADREQ" and not e.retryable
+        # Pre-taxonomy servers sent bare messages: still parse, FAILED.
+        e = parse_wire_error("ERR something broke badly")
+        assert e.code == "FAILED" and not e.retryable
+        assert e.message == "something broke badly"
+        assert "Query failed: something broke badly" in str(e)
+
+    def test_badreq_on_wire(self, env):
+        s, data = env
+        with QueryServer(s) as server:
+            with pytest.raises(QueryFailedError, match="must be a string") \
+                    as ei:
+                request_query(server.address, {"sql": 123, "tables": {}})
+        assert ei.value.code == "BADREQ"
+        assert not ei.value.retryable
+
+    def test_failed_on_engine_error(self, env):
+        s, data = env
+        spec = {"source": {"format": "parquet", "path": data},
+                "filter": {"op": "==", "col": "no_such_col", "value": 1}}
+        with QueryServer(s) as server:
+            with pytest.raises(QueryFailedError) as ei:
+                request_query(server.address, spec)
+        assert ei.value.code == "FAILED"
+
+    def test_bad_deadline_is_badreq(self, env):
+        s, data = env
+        with QueryServer(s) as server:
+            with pytest.raises(QueryFailedError, match="deadline_ms") as ei:
+                request_query(server.address,
+                              {**_point_spec(data, 1), "deadline_ms": -5})
+        assert ei.value.code == "BADREQ"
+
+
+# ---------------------------------------------------------------------------
+# Admission control + load shedding
+# ---------------------------------------------------------------------------
+class TestAdmission:
+    def test_queue_full_sheds_busy_and_counters_match(self, env, big_dir):
+        s, _data = env
+        s.conf.serving_workers = 1
+        s.conf.serving_queue_depth = 1
+        shed0 = _counter("serve.shed.queue_full")
+        results, errors = [], []
+        lock = threading.Lock()
+
+        def client():
+            try:
+                out = request_query(server.address, _slow_spec(big_dir))
+                with lock:
+                    results.append(out)
+            except QueryFailedError as e:
+                with lock:
+                    errors.append(e)
+
+        with QueryServer(s) as server:
+            threads = [threading.Thread(target=client) for _ in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert not any(t.is_alive() for t in threads), "a client hung"
+        # 1 running + 1 queued can be admitted; everyone else sheds FAST
+        # with the retryable code — never a hang, never a torn frame.
+        assert len(results) + len(errors) == 8
+        assert len(errors) >= 6
+        assert all(isinstance(e, ServerBusyError) for e in errors)
+        assert all(e.retryable for e in errors)
+        # Accepted requests answered correctly despite the storm.
+        for out in results:
+            assert out.num_rows == 5
+        # The shed counter tells the same story the clients saw.
+        assert _counter("serve.shed.queue_full") - shed0 == len(errors)
+
+    def test_connection_capacity_rejected_in_accept_loop(self, env,
+                                                         big_dir):
+        s, _data = env
+        s.conf.serving_workers = 2
+        s.conf.serving_max_connections = 2
+        done = []
+
+        def slow_client():
+            done.append(request_query(server.address, _slow_spec(big_dir)))
+
+        with QueryServer(s) as server:
+            holders = [threading.Thread(target=slow_client)
+                       for _ in range(2)]
+            for t in holders:
+                t.start()
+            time.sleep(0.3)  # both connections established and serving
+            with pytest.raises(ServerBusyError, match="connection capacity"):
+                request_query(server.address, {"verb": "metrics"})
+            for t in holders:
+                t.join(timeout=120)
+        assert len(done) == 2
+
+    def test_thread_count_bounded_under_connection_storm(self, env,
+                                                         big_dir):
+        """clients ≫ maxConnections + workers: handler threads never
+        exceed maxConnections (rejects happen IN the accept loop, no
+        thread spawned) and the storm leaves no threads behind."""
+        s, data = env
+        s.conf.serving_workers = 2
+        s.conf.serving_max_connections = 4
+        s.conf.serving_queue_depth = 2
+
+        def handler_threads():
+            return [t for t in threading.enumerate()
+                    if "process_request_thread" in t.name]
+
+        peak = [0]
+        stop = threading.Event()
+
+        def sampler():
+            while not stop.is_set():
+                peak[0] = max(peak[0], len(handler_threads()))
+                time.sleep(0.002)
+
+        outcomes = []
+        lock = threading.Lock()
+
+        def client(i):
+            try:
+                out = request_query(server.address,
+                                    _point_spec(data, i % 1000))
+                with lock:
+                    outcomes.append(("ok", out.column("k").to_pylist()))
+            except (QueryFailedError, ConnectionError) as e:
+                with lock:
+                    outcomes.append(("err", getattr(e, "code", "conn")))
+
+        with QueryServer(s) as server:
+            smp = threading.Thread(target=sampler, daemon=True)
+            smp.start()
+            for _wave in range(3):
+                threads = [threading.Thread(target=client, args=(i,))
+                           for i in range(20)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=60)
+                assert not any(t.is_alive() for t in threads)
+            stop.set()
+            smp.join(timeout=5)
+            # 60 clients over 3 waves against 4 connection slots: the
+            # handler thread count stayed bounded the whole time.
+            assert peak[0] <= 4, peak[0]
+            # No response was lost or interleaved: every outcome is a
+            # correct single-row answer or an explicit BUSY.
+            assert len(outcomes) == 60
+            for kind, val in outcomes:
+                if kind == "ok":
+                    assert len(val) == 1
+                else:
+                    assert val in ("BUSY", "conn")
+            assert any(kind == "ok" for kind, _ in outcomes)
+        time.sleep(0.5)
+        assert len(handler_threads()) == 0  # nothing leaked
+
+    def test_rss_watermark_sheds(self, env):
+        s, data = env
+        s.conf.serving_shed_rss_watermark_mb = 1.0  # any real process > 1MB
+        try:
+            with QueryServer(s) as server:
+                with pytest.raises(ServerBusyError,
+                                   match="memory watermark"):
+                    request_query(server.address, _point_spec(data, 1))
+        finally:
+            s.conf.serving_shed_rss_watermark_mb = 0.0
+
+
+# ---------------------------------------------------------------------------
+# Deadlines
+# ---------------------------------------------------------------------------
+class TestDeadline:
+    def test_expiry_surfaces_deadline_code(self, env, big_dir):
+        s, _data = env
+        exp0 = _counter("serve.deadline.expired")
+        with QueryServer(s) as server:
+            with pytest.raises(QueryFailedError, match="deadline") as ei:
+                request_query(server.address,
+                              {**_slow_spec(big_dir), "deadline_ms": 30})
+        assert ei.value.code == "DEADLINE"
+        assert ei.value.retryable
+        assert _counter("serve.deadline.expired") - exp0 >= 1
+
+    def test_conf_default_deadline_applies(self, env, big_dir):
+        s, _data = env
+        s.conf.serving_default_deadline_ms = 30.0
+        try:
+            with QueryServer(s) as server:
+                with pytest.raises(QueryFailedError) as ei:
+                    request_query(server.address, _slow_spec(big_dir))
+            assert ei.value.code == "DEADLINE"
+        finally:
+            s.conf.serving_default_deadline_ms = 0.0
+
+    def test_within_deadline_succeeds(self, env):
+        s, data = env
+        with QueryServer(s) as server:
+            with QueryClient(server.address) as client:
+                out = client.query(_point_spec(data, 7), deadline_ms=30_000)
+        assert out.column("k").to_pylist() == [7]
+
+    def test_deadline_never_triggers_degraded_fallback(self, env):
+        """An expired deadline must propagate, not re-plan from source —
+        re-planning spends MORE time past a deadline that already passed
+        (the dataset.collect guard)."""
+        from hyperspace_tpu.utils import deadline
+
+        s, data = env
+        hs = Hyperspace(s)
+        hs.create_index(s.read.parquet(data),
+                        IndexConfig("dl_ix", ["k"], ["v"]))
+        s.enable_hyperspace()
+        ds = s.read.parquet(data)
+        with deadline.scope(1e-9):
+            with pytest.raises(DeadlineExceededError):
+                ds.collect()
+        rep = ds.last_run_report()
+        assert rep.outcome == "error"
+        assert not [d for d in rep.decisions if d["kind"] == "replan"]
+
+
+# ---------------------------------------------------------------------------
+# Plan cache
+# ---------------------------------------------------------------------------
+class TestPlanCache:
+    def test_repeat_query_hits_cache(self, env):
+        s, data = env
+        with QueryServer(s) as server:
+            hits0 = _counter("serve.plan_cache.hits")
+            with QueryClient(server.address) as client:
+                a = client.query(_point_spec(data, 5))
+                b = client.query(_point_spec(data, 5))
+        assert a.equals(b)
+        assert a.column("k").to_pylist() == [5]
+        assert _counter("serve.plan_cache.hits") - hits0 >= 1
+
+    def test_different_literals_never_conflated(self, env):
+        """Same structural shape, different pinned values: the literal
+        digest in the key keeps bucket-pruned plans apart."""
+        s, data = env
+        hs = Hyperspace(s)
+        hs.create_index(s.read.parquet(data),
+                        IndexConfig("pc_ix", ["k"], ["v"]))
+        s.enable_hyperspace()
+        with QueryServer(s) as server:
+            with QueryClient(server.address) as client:
+                for k in (5, 7, 5, 7, 11):
+                    out = client.query(_point_spec(data, k))
+                    assert out.column("k").to_pylist() == [k]
+
+    def test_index_build_invalidates_cached_plans(self, env):
+        """create_index while the server runs bumps the plan-cache
+        generation: the very next served request re-plans and uses the
+        new index — no stale cached source scan."""
+        s, data = env
+        s.enable_hyperspace()
+        with QueryServer(s) as server:
+            with QueryClient(server.address) as client:
+                out = client.query(_point_spec(data, 9))
+                assert out.column("k").to_pylist() == [9]
+                hs = Hyperspace(s)
+                hs.create_index(s.read.parquet(data),
+                                IndexConfig("inv_ix", ["k"], ["v"]))
+                out2 = client.query(_point_spec(data, 9))
+                assert out2.column("k").to_pylist() == [9]
+                table = client.query({"verb": "last_run_report"})
+        report = json.loads(table.column("report_json").to_pylist()[0])
+        assert report["indexes_used"] == ["inv_ix"]
+
+    def test_ttl_and_generation_staleness(self, env):
+        from hyperspace_tpu.execution import plan_cache as pc
+
+        s, data = env
+        cache = pc.PlanCache(budget_bytes=1 << 20, ttl_s=1e9)
+        ds = s.read.parquet(data).filter(
+            __import__("hyperspace_tpu").col("k") == 3)
+        key = cache.key_for(s, ds.plan)
+        assert key is not None
+        plan = ds.optimized_plan()
+        cache.put(key, plan)
+        assert cache.get(key) is plan
+        pc.bump_generation()
+        assert cache.get(key) is None  # generation-stale
+        cache.put(key, plan)
+        cache.ttl_s = 0.0
+        time.sleep(0.01)
+        assert cache.get(key) is None  # TTL-stale
+
+
+# ---------------------------------------------------------------------------
+# Send-side timeout (the dead-reader fix)
+# ---------------------------------------------------------------------------
+class TestSendTimeout:
+    def test_dead_reader_frees_the_connection_thread(self, env, big_dir):
+        """A client that sends a query returning ~30MB and then stops
+        READING used to pin its thread forever (REQUEST_TIMEOUT_S only
+        guarded reads).  With the send timeout the handler aborts and the
+        server keeps serving."""
+        s, data = env
+        s.conf.serving_send_timeout_s = 1.0
+        st0 = _counter("serve.send_timeouts")
+        try:
+            with QueryServer(s) as server:
+                sock = socket.create_connection(server.address)
+                # A tiny receive buffer so the server's send side fills
+                # fast and reliably blocks.
+                sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+                sock.sendall(json.dumps({
+                    "source": {"format": "parquet", "path": big_dir},
+                }).encode() + b"\n")
+                time.sleep(0.1)  # let the result start streaming... then
+                # never read a byte: the dead-reader scenario.
+                deadline_at = time.monotonic() + 30
+                while time.monotonic() < deadline_at:
+                    if _counter("serve.send_timeouts") - st0 >= 1:
+                        break
+                    time.sleep(0.2)
+                assert _counter("serve.send_timeouts") - st0 >= 1
+                # The server is alive and unstarved.
+                out = request_query(server.address, _point_spec(data, 3))
+                assert out.column("k").to_pylist() == [3]
+                sock.close()
+        finally:
+            s.conf.serving_send_timeout_s = 30.0
+
+
+# ---------------------------------------------------------------------------
+# Mixed-workload stress: correctness under concurrency
+# ---------------------------------------------------------------------------
+class TestStress:
+    def test_mixed_filter_join_agg_no_lost_or_interleaved(self, env,
+                                                          tmp_path):
+        s, data = env
+        dim = str(tmp_path / "dim")
+        os.makedirs(dim)
+        pq.write_table(pa.table({
+            "k2": pa.array(np.arange(1000, dtype=np.int64)),
+            "z": pa.array((np.arange(1000) % 3).astype(np.int64)),
+        }), os.path.join(dim, "f.parquet"))
+        join_spec = {
+            "source": {"format": "parquet", "path": data},
+            "join": {"source": {"format": "parquet", "path": dim},
+                     "on": {"op": "==", "col": "k", "right_col": "k2"}},
+            "group_by": ["z"], "aggs": {"n": ["v", "count"]}}
+        agg_spec = {"source": {"format": "parquet", "path": data},
+                    "group_by": ["w"], "aggs": {"t": ["v", "sum"]}}
+        failures = []
+        lock = threading.Lock()
+
+        def worker(i):
+            try:
+                with QueryClient(server.address) as client:
+                    for r in range(5):
+                        kind = (i + r) % 3
+                        if kind == 0:
+                            out = client.query(_point_spec(data, i * 7 + r))
+                            assert out.column("k").to_pylist() == \
+                                [i * 7 + r]
+                        elif kind == 1:
+                            out = client.query(join_spec)
+                            assert out.num_rows == 3
+                            assert sum(
+                                out.column("n").to_pylist()) == 1000
+                        else:
+                            out = client.query(agg_spec)
+                            assert out.num_rows == 5
+            except Exception as e:  # noqa: BLE001 — collected for report
+                with lock:
+                    failures.append((i, repr(e)))
+
+        with QueryServer(s) as server:
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(12)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert not any(t.is_alive() for t in threads), "stress hung"
+        assert not failures, failures
+
+
+# ---------------------------------------------------------------------------
+# Graceful drain
+# ---------------------------------------------------------------------------
+class TestDrain:
+    def test_drain_completes_inflight_then_closes(self, env, big_dir):
+        s, _data = env
+        s.conf.serving_workers = 2
+        result = {}
+
+        def slow():
+            result["out"] = request_query(server.address,
+                                          _slow_spec(big_dir))
+
+        server = QueryServer(s).start()
+        t = threading.Thread(target=slow)
+        t.start()
+        time.sleep(0.3)  # admitted and executing
+        clean = server.drain(grace_s=60)
+        t.join(timeout=60)
+        assert not t.is_alive()
+        assert clean is True
+        assert result["out"].num_rows == 5  # the in-flight query FINISHED
+        with pytest.raises(OSError):
+            socket.create_connection(server.address, timeout=2)
+        server.stop()  # idempotent after drain
+
+    def test_drain_sheds_new_requests_busy(self, env, big_dir):
+        s, data = env
+        s.conf.serving_workers = 1
+        server = QueryServer(s).start()
+        client = QueryClient(server.address)
+        assert client.query(_point_spec(data, 1)).num_rows == 1
+        slow_done = {}
+
+        def slow():
+            slow_done["out"] = request_query(server.address,
+                                             _slow_spec(big_dir))
+
+        t = threading.Thread(target=slow)
+        t.start()
+        time.sleep(0.3)
+        drainer = threading.Thread(target=server.drain,
+                                   kwargs={"grace_s": 60})
+        drainer.start()
+        time.sleep(0.2)  # draining now, slow query still in flight
+        with pytest.raises(ServerBusyError, match="draining"):
+            client.query(_point_spec(data, 2))
+        t.join(timeout=60)
+        drainer.join(timeout=60)
+        assert slow_done["out"].num_rows == 5
+        client.close()
+
+    def test_sigterm_drains_inflight_in_subprocess(self, env, big_dir,
+                                                   tmp_path):
+        """The real signal path: SIGTERM mid-query → the response still
+        arrives complete, then the process exits 0."""
+        _s, _data = env
+        script = (
+            "import json, sys\n"
+            "from hyperspace_tpu import HyperspaceSession\n"
+            "from hyperspace_tpu.interop import QueryServer\n"
+            "s = HyperspaceSession(system_path=sys.argv[1])\n"
+            "server = QueryServer(s, handle_sigterm=True).start()\n"
+            "print(json.dumps({'port': server.address[1]}), flush=True)\n"
+            "server.drained.wait()\n"
+            "sys.exit(0)\n")
+        env_vars = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-c", script, str(tmp_path / "ix2")],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env_vars)
+        try:
+            port = json.loads(proc.stdout.readline())["port"]
+            sock = socket.create_connection(("127.0.0.1", port),
+                                            timeout=120)
+            sock.sendall(json.dumps(_slow_spec(big_dir)).encode() + b"\n")
+            time.sleep(0.4)  # the query is admitted and running
+            proc.send_signal(__import__("signal").SIGTERM)
+            f = sock.makefile("rb")
+            assert f.readline() == b"OK\n"  # in-flight query COMPLETED
+            table = pa.ipc.open_stream(f).read_all()
+            assert table.num_rows == 5
+            sock.close()
+            assert proc.wait(timeout=60) == 0
+        finally:
+            proc.kill()
+            proc.wait(timeout=30)
