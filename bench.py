@@ -1416,8 +1416,6 @@ def _break_even_repeats(cold_s: float, host_s: float, warm_s: float):
     saving = host_s - warm_s
     if saving <= 0:
         return None
-    import math
-
     return max(0, math.ceil((cold_s - host_s) / saving))
 
 

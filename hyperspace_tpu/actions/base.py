@@ -45,7 +45,7 @@ from hyperspace_tpu.exceptions import ConcurrentWriteError, HyperspaceError, NoC
 from hyperspace_tpu.index.log_entry import IndexLogEntry, States
 from hyperspace_tpu.index.log_manager import IndexLogManager
 from hyperspace_tpu.io import faults
-from hyperspace_tpu.telemetry.events import HyperspaceEvent, _IndexActionEvent, emit_event
+from hyperspace_tpu.telemetry.events import _IndexActionEvent, emit_event
 from hyperspace_tpu.utils.retry import RetryPolicy
 
 
@@ -233,8 +233,12 @@ class Action:
                     **{k: v for k, v in report.to_dict().items()
                        if k not in ("started_at",)},
                     "fingerprint": perf_ledger.fingerprint(conf)})
-        except Exception:  # noqa: BLE001 — see docstring
-            pass
+        except Exception:  # noqa: BLE001 — diagnostics must never fail
+            # the action; count the swallowed failure so a broken report/
+            # ledger path is at least visible in the registry.
+            from hyperspace_tpu.telemetry import metrics
+
+            metrics.inc("build.report.errors")
 
     def _attempt(self, emit) -> str:
         """One turn of the transaction loop; returns the outcome

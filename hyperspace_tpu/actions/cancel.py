@@ -14,7 +14,7 @@ import copy
 
 from hyperspace_tpu.actions.base import Action
 from hyperspace_tpu.exceptions import HyperspaceError
-from hyperspace_tpu.index.log_entry import IndexLogEntry, States
+from hyperspace_tpu.index.log_entry import States
 from hyperspace_tpu.telemetry.events import CancelActionEvent
 
 

@@ -43,7 +43,7 @@ from hyperspace_tpu.index.log_manager import IndexLogManager
 from hyperspace_tpu.index.signatures import get_provider
 from hyperspace_tpu.io import columnar
 from hyperspace_tpu.io.parquet import read_table, write_bucketed
-from hyperspace_tpu.plan.nodes import LogicalPlan, Scan
+from hyperspace_tpu.plan.nodes import LogicalPlan
 from hyperspace_tpu.telemetry.events import CreateActionEvent
 from hyperspace_tpu.utils.resolver import resolve_or_raise
 
@@ -293,13 +293,13 @@ class CreateActionBase(Action):
         min/max spanned whole dimensions and second-dimension pruning
         collapsed at scale (measured 50/108 files kept at SF1 for a 5%
         range vs ~1/8 expected)."""
-        import shutil
         import tempfile
         import time as _time
 
         import pyarrow.parquet as pq
 
         from hyperspace_tpu.io import columnar as _columnar
+        from hyperspace_tpu.io.files import remove_tree
         from hyperspace_tpu.io.parquet import (
             write_bucket_run,
             zorder_codes_from_order_words,
@@ -432,7 +432,7 @@ class CreateActionBase(Action):
                 self.build_report.add_bytes(
                     written=sum(os.path.getsize(p) for p in written),
                     files=len(written))
-                shutil.rmtree(d, ignore_errors=True)  # runs consumed
+                remove_tree(d, ignore_errors=True)  # runs consumed
 
             from hyperspace_tpu.utils.parallel_map import parallel_map_ordered
 
@@ -440,7 +440,7 @@ class CreateActionBase(Action):
                                  max_workers=4)
             self._phase("spill_finish_s", _time.perf_counter() - t0)
         finally:
-            shutil.rmtree(run_dir, ignore_errors=True)
+            remove_tree(run_dir, ignore_errors=True)
         t0 = _time.perf_counter()
         self._write_index_file_sketch(out_dir, resolved)
         self._phase("sketch_s", _time.perf_counter() - t0)
@@ -677,15 +677,19 @@ class _BucketSpill:
     def cleanup(self) -> None:
         try:
             self._drain()
+        # cleanup() runs only on the failure path (the original error
+        # re-raises right after), so a secondary drain failure is
+        # deliberately discarded.
+        # hslint: allow[exception-discipline] secondary failure in cleanup
         except BaseException:
-            pass  # cleanup path: the original error is already in flight
+            pass
         if self._pool is not None:
             self._pool.shutdown(wait=True)
             self._pool = None
         if self._dir is not None:
-            import shutil
+            from hyperspace_tpu.io.files import remove_tree
 
-            shutil.rmtree(self._dir, ignore_errors=True)
+            remove_tree(self._dir, ignore_errors=True)
             self._dir = None
 
     def add_chunk(self, table: pa.Table) -> None:
@@ -757,8 +761,9 @@ class _BucketSpill:
         self.action._phase("spill_route_s", _time.perf_counter() - _t0)
 
     def finish(self) -> None:
-        import shutil
         import time as _time
+
+        from hyperspace_tpu.io.files import remove_tree
 
         _t0 = _time.perf_counter()
         self._drain()  # all route jobs must land before buckets close
@@ -792,7 +797,7 @@ class _BucketSpill:
             # This bucket's runs are consumed: delete them NOW so peak
             # disk is source + runs + a few finished buckets, not
             # source + runs + the whole final index (matters at SF100).
-            shutil.rmtree(bdir, ignore_errors=True)
+            remove_tree(bdir, ignore_errors=True)
 
         from hyperspace_tpu.utils.parallel_map import parallel_map_ordered
 
@@ -801,7 +806,7 @@ class _BucketSpill:
             parallel_map_ordered(finish_bucket, sorted(os.listdir(self._dir)),
                                  max_workers=4)
         finally:
-            shutil.rmtree(self._dir, ignore_errors=True)
+            remove_tree(self._dir, ignore_errors=True)
             self._dir = None
         action._phase("spill_finish_s", _time.perf_counter() - _t0)
         _t0 = _time.perf_counter()

@@ -20,7 +20,6 @@ import os
 from collections import defaultdict
 from typing import Dict, List
 
-import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as pq
 
@@ -35,9 +34,7 @@ from hyperspace_tpu.index.log_entry import (
     States,
 )
 from hyperspace_tpu.index.log_manager import IndexLogManager
-from hyperspace_tpu.io import columnar
 from hyperspace_tpu.io.parquet import (
-    bucket_file_name,
     bucket_id_of_file,
     read_parquet_file,
     sort_permutation_host,

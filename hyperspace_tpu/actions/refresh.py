@@ -29,7 +29,6 @@ from hyperspace_tpu.exceptions import HyperspaceError, NoChangesError
 from hyperspace_tpu.index.data_manager import IndexDataManager
 from hyperspace_tpu.index.index_config import IndexConfig
 from hyperspace_tpu.index.log_entry import (
-    Content,
     FileIdTracker,
     FileInfo,
     IndexLogEntry,

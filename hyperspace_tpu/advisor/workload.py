@@ -39,7 +39,7 @@ import urllib.parse
 from typing import Any, Dict, List, Optional, Tuple
 
 from hyperspace_tpu.plan.expr import BinOp, Col, IsIn, Lit, split_conjuncts
-from hyperspace_tpu.plan.nodes import Aggregate, Filter, Join, LogicalPlan, Scan
+from hyperspace_tpu.plan.nodes import Aggregate, Filter, Join, LogicalPlan
 
 WORKLOAD_DIR = "_hyperspace_workload"
 RECORD_VERSION = 1

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Optional
 
 # Canonical string keys (kept spark-compatible in spirit so reference users
 # can map their configs 1:1; see docs/_docs/02-ug-configuration.md:9-23).

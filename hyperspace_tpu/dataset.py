@@ -7,7 +7,7 @@ package.scala:47-79) and then the executor.
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+from typing import List, Sequence
 
 import numpy as np
 import pyarrow as pa
@@ -482,8 +482,13 @@ class Dataset:
                             try:
                                 self.session.index_collection_manager \
                                     .refresh(name, "repair")
-                            except Exception:  # noqa: BLE001
-                                pass
+                            except Exception as repair_exc:  # noqa: BLE001
+                                # Best-effort self-heal; the failure must
+                                # still be visible in the run report.
+                                run_report.record(
+                                    "replan", mode="auto-repair-failed",
+                                    stage="execution",
+                                    error=repr(repair_exc))
             if out is None:
                 # Degraded mode, execution stage — the LAST resort: re-plan
                 # WITHOUT index rewrites and run the source scan; a failure

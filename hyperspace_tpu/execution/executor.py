@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import os
 
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 import pyarrow as pa

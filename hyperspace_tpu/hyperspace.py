@@ -8,13 +8,11 @@ the with/without-index plan comparison (PlanAnalyzer).
 
 from __future__ import annotations
 
-from typing import Optional
 
 import pyarrow as pa
 
 from hyperspace_tpu.dataset import Dataset
 from hyperspace_tpu.index.index_config import IndexConfig
-from hyperspace_tpu.index.manager import IndexCollectionManager
 from hyperspace_tpu.session import HyperspaceSession
 
 

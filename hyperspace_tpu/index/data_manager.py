@@ -15,7 +15,6 @@ version directory (used by VacuumAction, actions/VacuumAction.scala:46-52).
 from __future__ import annotations
 
 import os
-import shutil
 from typing import List, Optional
 
 INDEX_VERSION_DIR_PREFIX = "v__="  # IndexConstants.scala:67
@@ -56,9 +55,11 @@ class IndexDataManager:
         return 0 if latest is None else latest + 1
 
     def delete(self, version: int) -> None:
+        from hyperspace_tpu.io.files import remove_tree
+
         path = self.version_path(version)
         if os.path.isdir(path):
-            shutil.rmtree(path)
+            remove_tree(path)
         if self.quarantine is not None:
             # A vacuumed version must not leave orphaned quarantine keys:
             # the files are gone, the records would read as eternally

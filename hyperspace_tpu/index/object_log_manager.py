@@ -44,7 +44,7 @@ from hyperspace_tpu.index.log_manager import (
     LATEST_STABLE,
     IndexLogManager,
 )
-from hyperspace_tpu.io.log_store import EmulatedObjectStore, LogStore
+from hyperspace_tpu.io.log_store import LogStore
 
 # Bound on CAS re-read loops: each iteration means a concurrent pointer
 # writer won an update in the read-CAS window; with monotonic-id yielding

@@ -8,7 +8,6 @@ case-insensitive against existing directory names (:39-63).
 from __future__ import annotations
 
 import os
-from typing import Optional
 
 from hyperspace_tpu.config import HyperspaceConf
 

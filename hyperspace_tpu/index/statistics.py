@@ -15,7 +15,7 @@ rendering empty.
 from __future__ import annotations
 
 import os
-from typing import List, Optional
+from typing import List
 
 import pyarrow as pa
 

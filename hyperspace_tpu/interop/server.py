@@ -198,6 +198,11 @@ class _WorkerPool:
         self._idle = threading.Condition(self._lock)
         self._active = 0  # jobs executing right now
         self._queued_or_active = 0  # admitted and not yet finished
+        # Requests whose RESPONSE is not yet fully written.  Workers only
+        # compute; the connection handler streams the result afterwards —
+        # drain() must wait for that write too, or a SIGTERM between
+        # "worker done" and "stream flushed" tears the frame mid-send.
+        self._open_requests = 0
         self._queue_wait_ewma_ms = 0.0
         self._rss_at = 0.0
         self._rss_mb = 0.0
@@ -275,11 +280,14 @@ class _WorkerPool:
             job: _Job = item
             now = time.monotonic()
             wait_ms = (now - job.enqueued_t) * 1000.0
-            self._queue_wait_ewma_ms += self._EWMA_ALPHA * (
-                wait_ms - self._queue_wait_ewma_ms)
             metrics.observe("serve.queue_wait_ms", wait_ms)
             metrics.set_gauge("serve.queue_depth", self._queue.qsize())
             with self._lock:
+                # The EWMA is a read-modify-write shared across workers;
+                # unlocked, two workers interleaving lose updates and the
+                # latency watermark sheds on stale numbers.
+                self._queue_wait_ewma_ms += self._EWMA_ALPHA * (
+                    wait_ms - self._queue_wait_ewma_ms)
                 self._active += 1
                 metrics.set_gauge("serve.inflight", self._active)
             try:
@@ -314,13 +322,24 @@ class _WorkerPool:
                     metrics.set_gauge("serve.inflight", self._active)
                     self._idle.notify_all()
 
+    # -- request accounting (handler threads) -------------------------------
+    def request_started(self) -> None:
+        with self._idle:
+            self._open_requests += 1
+
+    def request_finished(self) -> None:
+        with self._idle:
+            self._open_requests -= 1
+            self._idle.notify_all()
+
     # -- lifecycle ---------------------------------------------------------
     def wait_idle(self, grace_s: float) -> bool:
-        """Block until every admitted job finished, or ``grace_s`` passed.
-        Returns True when the pool drained clean."""
+        """Block until every admitted job finished AND every in-flight
+        response is fully written, or ``grace_s`` passed.  Returns True
+        when the pool drained clean."""
         deadline_at = time.monotonic() + max(0.0, grace_s)
         with self._idle:
-            while self._queued_or_active > 0:
+            while self._queued_or_active > 0 or self._open_requests > 0:
                 left = deadline_at - time.monotonic()
                 if left <= 0:
                     return False
@@ -366,6 +385,20 @@ class _Handler(socketserver.StreamRequestHandler):
         if not line:
             return False  # clean EOF between requests
         metrics.inc("serve.requests")
+        # The request is in flight from here until its response is fully
+        # written: drain()'s wait_idle blocks on this accounting, so a
+        # SIGTERM mid-stream cannot exit the process between the worker
+        # finishing a result and this thread flushing it (torn frame).
+        pool = self.server.pool
+        pool.request_started()
+        try:
+            return self._respond_one(line, conf)
+        finally:
+            pool.request_finished()
+
+    def _respond_one(self, line: bytes, conf) -> bool:
+        from hyperspace_tpu.telemetry import metrics
+
         try:
             spec = self._parse(line)
             if "verb" in spec:

@@ -21,7 +21,7 @@ different buckets.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Optional
 
 import numpy as np
 import pyarrow as pa

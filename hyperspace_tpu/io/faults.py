@@ -28,6 +28,8 @@ Sites (grep for ``faults.check`` / ``faults.write_payload``):
                           final entry not yet committed (actions/base.run)
 ``io.list``               a directory/prefix listing (io/files.list_data_files,
                           list_dir — log discovery routes through the latter)
+``io.delete``             a recursive index-data delete (io/files.remove_tree
+                          — vacuumed versions, spill run directories)
 ``data.read``             a single source/index data-file read
                           (io/parquet.read_parquet_file and friends)
 ``store.put``             a LogStore conditional put (io/log_store.py;
@@ -91,6 +93,26 @@ _KNOWN_KINDS = ("enospc", "eio", "torn", "crash", "crash-before-rename",
 # only through corrupt_file().
 _CORRUPT_KINDS = ("bitrot", "truncate")
 
+# The machine-readable site registry (the docstring table above is the
+# prose version).  Every ``check``/``fire``/``write_payload``/
+# ``corrupt_file``/``atomic_replace`` call site and every test's
+# ``FaultPlan(site=...)`` must name one of these — enforced statically by
+# ``hyperspace_tpu.lint`` (rule ``fault-site-registry``) and at runtime
+# by :class:`FaultPlan`, because a typo'd site silently never fires.
+SITES = (
+    "log.write",
+    "log.rename",
+    "data.write",
+    "data.read",
+    "action.commit",
+    "io.list",
+    "io.delete",
+    "store.put",
+    "store.read",
+    "store.list",
+    "store.delete",
+)
+
 
 class InjectedCrash(BaseException):
     """Simulated process death at a fault site.
@@ -117,6 +139,10 @@ class FaultPlan:
             raise ValueError(
                 f"Unknown fault kind {self.kind!r}; expected one of "
                 f"{_KNOWN_KINDS}")
+        if self.site not in SITES:
+            raise ValueError(
+                f"Unknown fault site {self.site!r}; expected one of "
+                f"{SITES} (a typo'd site would silently never fire)")
         self._calls = 0
         self._fired = 0
         self._lock = threading.Lock()

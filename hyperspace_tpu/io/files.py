@@ -40,6 +40,34 @@ def list_dir(path: str, retry=None) -> List[str]:
     return policy.call(attempt)
 
 
+def remove_tree(path: str, ignore_errors: bool = False) -> None:
+    """``shutil.rmtree`` behind the ``io.delete`` fault site — the single
+    recursive-delete primitive for index data (vacuumed versions, spill
+    run directories).  Routing deletes through here keeps the IO seam
+    airtight: the fault matrix can model a delete that dies half way,
+    and the static io-seam lint rule can prove no action deletes index
+    state behind the injector's back."""
+    import shutil
+
+    from hyperspace_tpu.io import faults
+
+    faults.check("io.delete")
+    shutil.rmtree(path, ignore_errors=ignore_errors)
+
+
+def remove_file(path: str, missing_ok: bool = False) -> None:
+    """``os.unlink`` behind the ``io.delete`` fault site (see
+    :func:`remove_tree`)."""
+    from hyperspace_tpu.io import faults
+
+    faults.check("io.delete")
+    try:
+        os.unlink(path)
+    except FileNotFoundError:
+        if not missing_ok:
+            raise
+
+
 def expand_globs(root_paths: Sequence[str]) -> List[str]:
     """Expand glob patterns among ``root_paths`` (sorted matches); plain
     paths pass through.  Globbing patterns let an index cover directories

@@ -1,0 +1,26 @@
+"""hslint: AST-based invariant checker for this repository's contracts.
+
+Seven PRs of growth left the system's load-bearing contracts encoded as
+string conventions — conf-key literals that must agree with ``config.py``
+and docs/02, a metric/span catalog in docs/16, fault-injection site names
+that silently no-op when typo'd, a LogStore/fault-injection IO seam any
+stray ``open()`` bypasses, and a serving layer whose thread safety rests
+on lock discipline.  This package makes those invariants machine-checked:
+
+    python -m hyperspace_tpu.lint            # human output, exit 1 on new
+    python -m hyperspace_tpu.lint --json     # machine output
+    python -m hyperspace_tpu.lint --check-catalog --trace t.jsonl
+
+Pure stdlib (``ast`` + text parsing) — the linter never imports the
+package it checks, so it runs in any environment, including CI images
+without jax.  See docs/18-static-analysis.md for the rule catalog, the
+baseline workflow, the allowlist pragma syntax, and how to add a rule.
+"""
+
+from hyperspace_tpu.lint.engine import (  # noqa: F401 — public surface
+    Finding,
+    LintContext,
+    load_baseline,
+    run_lint,
+    write_baseline,
+)

@@ -1,0 +1,320 @@
+"""The hslint rule engine: file loading, pragmas, baseline, reporting.
+
+Design constraints:
+
+  - **stdlib only** — the linter must run where the engine cannot (a CI
+    step before dependencies install, a pre-commit hook); it parses the
+    package with ``ast`` and never imports it.
+  - **stable fingerprints** — a finding's identity is
+    ``rule:path:ident`` where ``ident`` is a rule-chosen salient token
+    (the conf key, the metric name, the function holding the bare
+    except), NOT the line number, so a checked-in baseline survives
+    unrelated edits above the finding.
+  - **inline allowlist** — ``# hslint: allow[rule-a,rule-b] reason`` on
+    the finding's line (or the line above) suppresses it; on a ``def``
+    line it suppresses the whole function body for those rules.  The
+    free-text reason is required by convention, not parsing.
+  - **baseline** — ``.hslint-baseline.json`` at the repo root records
+    grandfathered fingerprints.  A run fails only on NEW findings;
+    entries that stopped firing are reported as expired so the file
+    shrinks over time (``--update-baseline`` rewrites it).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+_PRAGMA_RE = re.compile(r"#\s*hslint:\s*allow\[([A-Za-z0-9_,\s-]+)\]")
+
+# Directories never scanned (generated / VCS / caches).
+_SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", "node_modules",
+              ".hypothesis"}
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation at one site."""
+
+    rule: str
+    path: str  # repo-relative, posix separators
+    line: int
+    message: str
+    ident: str  # stable salient token; fingerprint = rule:path:ident
+    baselined: bool = False
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.rule}:{self.path}:{self.ident}"
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "fingerprint": self.fingerprint,
+            "baselined": self.baselined,
+        }
+
+
+class SourceFile:
+    """One parsed python file: text, AST, and pragma index."""
+
+    def __init__(self, root: str, relpath: str) -> None:
+        self.relpath = relpath.replace(os.sep, "/")
+        self.abspath = os.path.join(root, relpath)
+        with open(self.abspath, "r", encoding="utf-8") as f:
+            self.text = f.read()
+        self.lines = self.text.splitlines()
+        self.parse_error: Optional[str] = None
+        try:
+            self.tree: Optional[ast.Module] = ast.parse(
+                self.text, filename=self.relpath)
+        except SyntaxError as e:  # surfaced as a finding by the runner
+            self.tree = None
+            self.parse_error = f"syntax error: {e.msg} (line {e.lineno})"
+        # line (1-based) -> set of rule names allowed there ("*" = all)
+        self.pragmas: Dict[int, Set[str]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _PRAGMA_RE.search(line)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+                self.pragmas[i] = rules or {"*"}
+        # (start, end, rules) spans for pragmas sitting on a def/class line:
+        # the allowance covers the whole body.
+        self.pragma_spans: List[Tuple[int, int, Set[str]]] = []
+        if self.tree is not None and self.pragmas:
+            for node in ast.walk(self.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    rules = self.pragmas.get(node.lineno)
+                    if rules:
+                        end = getattr(node, "end_lineno", node.lineno)
+                        self.pragma_spans.append((node.lineno, end, rules))
+
+    def allows(self, rule: str, line: int) -> bool:
+        for probe in (line, line - 1):
+            rules = self.pragmas.get(probe)
+            if rules and (rule in rules or "*" in rules):
+                return True
+        for start, end, rules in self.pragma_spans:
+            if start <= line <= end and (rule in rules or "*" in rules):
+                return True
+        return False
+
+
+class LintContext:
+    """Everything a rule needs: the parsed file set plus doc loading."""
+
+    def __init__(self, root: str, files: Sequence[SourceFile]) -> None:
+        self.root = root
+        self.files = list(files)
+        self._by_path = {f.relpath: f for f in self.files}
+        self._doc_cache: Dict[str, Optional[str]] = {}
+
+    def file(self, relpath: str) -> Optional[SourceFile]:
+        return self._by_path.get(relpath)
+
+    def read_doc(self, relpath: str) -> Optional[str]:
+        if relpath not in self._doc_cache:
+            path = os.path.join(self.root, relpath)
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    self._doc_cache[relpath] = f.read()
+            except OSError:
+                self._doc_cache[relpath] = None
+        return self._doc_cache[relpath]
+
+    def py_files(self, include=None, exclude=None) -> List[SourceFile]:
+        """Files filtered by repo-relative prefix (or exact path).  A
+        prefix ending in "/" matches the subtree; otherwise exact."""
+        def matches(path: str, pats) -> bool:
+            return any(path == p or (p.endswith("/") and path.startswith(p))
+                       for p in pats)
+
+        out = []
+        for f in self.files:
+            if include is not None and not matches(f.relpath, include):
+                continue
+            if exclude is not None and matches(f.relpath, exclude):
+                continue
+            out.append(f)
+        return out
+
+
+def discover_files(root: str) -> List[str]:
+    """Repo-relative paths of every .py file under ``root``."""
+    out: List[str] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                out.append(os.path.relpath(os.path.join(dirpath, fn), root))
+    return out
+
+
+def build_context(root: str,
+                  relpaths: Optional[Iterable[str]] = None) -> LintContext:
+    paths = list(relpaths) if relpaths is not None else discover_files(root)
+    return LintContext(root, [SourceFile(root, p) for p in paths])
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+BASELINE_NAME = ".hslint-baseline.json"
+
+
+def load_baseline(path: str) -> Set[str]:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return set()
+    return set(data.get("entries", []))
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    entries = sorted({f.fingerprint for f in findings})
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "entries": entries}, f, indent=2)
+        f.write("\n")
+
+
+# ---------------------------------------------------------------------------
+# Running
+# ---------------------------------------------------------------------------
+def run_lint(root: str, rule_names: Optional[Sequence[str]] = None,
+             baseline: Optional[Set[str]] = None,
+             ctx: Optional[LintContext] = None):
+    """Run the selected rules over ``root``.
+
+    Returns ``(findings, expired)``: findings sorted by path/line with
+    ``baselined`` set on grandfathered ones, and the baseline
+    fingerprints that no longer fire."""
+    from hyperspace_tpu.lint.rules import all_rules
+
+    rules = all_rules()
+    if rule_names:
+        unknown = set(rule_names) - {r.name for r in rules}
+        if unknown:
+            raise ValueError(
+                f"unknown rule(s): {', '.join(sorted(unknown))}; "
+                f"known: {', '.join(r.name for r in rules)}")
+        rules = [r for r in rules if r.name in set(rule_names)]
+    if ctx is None:
+        ctx = build_context(root)
+
+    findings: List[Finding] = []
+    for f in ctx.files:
+        if f.parse_error:
+            findings.append(Finding("parse", f.relpath, 1, f.parse_error,
+                                    ident="syntax"))
+    for rule in rules:
+        for finding in rule.run(ctx):
+            src = ctx.file(finding.path)
+            if src is not None and src.allows(finding.rule, finding.line):
+                continue
+            findings.append(finding)
+
+    baseline = baseline or set()
+    seen = set()
+    for f in findings:
+        if f.fingerprint in baseline:
+            f.baselined = True
+        seen.add(f.fingerprint)
+    expired = sorted(baseline - seen)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.ident))
+    return findings, expired
+
+
+def render_human(findings: Sequence[Finding], expired: Sequence[str],
+                 rule_names: Sequence[str]) -> str:
+    lines: List[str] = []
+    new = [f for f in findings if not f.baselined]
+    old = [f for f in findings if f.baselined]
+    for f in new:
+        lines.append(f"{f.path}:{f.line}: [{f.rule}] {f.message}")
+    if old:
+        lines.append(f"({len(old)} baselined finding(s) suppressed; "
+                     f"run with --show-baselined to list)")
+    for fp in expired:
+        lines.append(f"baseline entry no longer fires (remove it or run "
+                     f"--update-baseline): {fp}")
+    lines.append(
+        f"hslint: {len(new)} new finding(s), {len(old)} baselined, "
+        f"{len(expired)} expired baseline entr{'y' if len(expired) == 1 else 'ies'} "
+        f"[rules: {', '.join(rule_names)}]")
+    return "\n".join(lines)
+
+
+def render_json(findings: Sequence[Finding], expired: Sequence[str],
+                rule_names: Sequence[str], root: str) -> str:
+    new = [f for f in findings if not f.baselined]
+    return json.dumps({
+        "version": 1,
+        "root": root,
+        "rules": list(rule_names),
+        "findings": [f.to_dict() for f in findings],
+        "new_count": len(new),
+        "baselined_count": len(findings) - len(new),
+        "expired_baseline": list(expired),
+    }, indent=2)
+
+
+# ---------------------------------------------------------------------------
+# Shared AST helpers (used by several rules)
+# ---------------------------------------------------------------------------
+def call_name(node: ast.Call) -> str:
+    """Dotted name of a call target: ``os.path.join`` -> "os.path.join",
+    ``open`` -> "open"; "" when the callee is not a plain name chain."""
+    parts: List[str] = []
+    cur = node.func
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def joined_pattern(node: ast.AST) -> Optional[str]:
+    """An f-string as a dotted pattern: each interpolated piece becomes a
+    ``\\x00`` marker (segment-level wildcard after splitting on ".").
+    Returns None for non-JoinedStr nodes."""
+    if not isinstance(node, ast.JoinedStr):
+        return None
+    parts: List[str] = []
+    for v in node.values:
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            parts.append(v.value)
+        else:
+            parts.append("\x00")
+    return "".join(parts)
+
+
+def enclosing_function_name(tree: ast.Module, lineno: int) -> str:
+    """Name of the innermost def containing ``lineno`` ("<module>" when
+    none) — a line-stable ident component for baselining."""
+    best = "<module>"
+    best_span = None
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            end = getattr(node, "end_lineno", node.lineno)
+            if node.lineno <= lineno <= end:
+                span = end - node.lineno
+                if best_span is None or span < best_span:
+                    best, best_span = node.name, span
+    return best

@@ -1,0 +1,29 @@
+"""Rule registry.  A rule is an object with ``name``, ``description``,
+and ``run(ctx) -> list[Finding]``; adding one = writing the module and
+listing it here (docs/18-static-analysis.md, "Writing a new rule")."""
+
+from __future__ import annotations
+
+from typing import List
+
+
+def all_rules() -> List[object]:
+    from hyperspace_tpu.lint.rules import (
+        conf_registry,
+        exception_discipline,
+        fault_site_registry,
+        hygiene,
+        io_seam,
+        lock_discipline,
+        telemetry_catalog,
+    )
+
+    return [
+        conf_registry.Rule(),
+        telemetry_catalog.Rule(),
+        io_seam.Rule(),
+        fault_site_registry.Rule(),
+        exception_discipline.Rule(),
+        lock_discipline.Rule(),
+        hygiene.Rule(),
+    ]

@@ -1,0 +1,101 @@
+"""io-seam: file-mutating primitives must route through ``io/``.
+
+PR 2's LogStore seam and PR 1's fault injector give the engine its
+crash-consistency story — but only for IO that goes THROUGH them.  A
+stray ``open(path, "w")`` / ``os.replace`` / ``shutil.rmtree`` in the
+action or index layers mutates index/log state invisibly to the fault
+matrix: the tests keep passing while the failure envelope silently
+shrinks.  This rule flags write-side primitives outside the sanctioned
+modules:
+
+  - ``hyperspace_tpu/io/`` — the seam itself;
+  - ``hyperspace_tpu/index/log_manager.py`` — the POSIX log backend,
+    whose primitives are fault-wrapped in place;
+  - ``hyperspace_tpu/sources/`` — lake-format writers for EXTERNAL
+    metadata (Delta/Iceberg test fixtures), not index data;
+  - ``hyperspace_tpu/native/`` — the compiler cache, not index data.
+
+Read-only ``open(path)`` is allowed everywhere (reads cannot corrupt,
+and the data-read fault sites live in the parquet readers).  A genuine
+exception (a telemetry sink appending to a user-chosen path) carries an
+inline ``# hslint: allow[io-seam] <reason>`` pragma.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from hyperspace_tpu.lint.engine import (
+    Finding,
+    LintContext,
+    call_name,
+    const_str,
+    enclosing_function_name,
+)
+
+_SCAN_INCLUDE = ("hyperspace_tpu/",)
+_SCAN_EXCLUDE = (
+    "hyperspace_tpu/io/",
+    "hyperspace_tpu/index/log_manager.py",
+    "hyperspace_tpu/sources/",
+    "hyperspace_tpu/native/",
+    "hyperspace_tpu/lint/",
+)
+
+_BANNED_CALLS = {
+    "os.rename", "os.replace", "os.remove", "os.unlink", "os.rmdir",
+    "os.truncate", "os.open",
+    "shutil.rmtree", "shutil.move", "shutil.copy", "shutil.copy2",
+    "shutil.copyfile", "shutil.copytree",
+}
+_WRITE_MODE_CHARS = set("wxa+")
+
+
+def _open_write_mode(node: ast.Call) -> str:
+    """The write-ish mode string of an ``open()`` call, or ""."""
+    mode = None
+    if len(node.args) >= 2:
+        mode = const_str(node.args[1])
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = const_str(kw.value)
+    if mode and set(mode) & _WRITE_MODE_CHARS:
+        return mode
+    return ""
+
+
+class Rule:
+    name = "io-seam"
+    description = ("no direct file-mutation primitives outside io/ (the "
+                   "LogStore seam and fault injector must see every write)")
+
+    def run(self, ctx: LintContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for src in ctx.py_files(include=_SCAN_INCLUDE,
+                                exclude=_SCAN_EXCLUDE):
+            if src.tree is None:
+                continue
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                cname = call_name(node)
+                fn = None
+                if cname in _BANNED_CALLS:
+                    fn = enclosing_function_name(src.tree, node.lineno)
+                    findings.append(Finding(
+                        self.name, src.relpath, node.lineno,
+                        f"direct {cname}() in {fn}() bypasses the io/ seam "
+                        f"(fault sites, retries, digests) — route through "
+                        f"io/files.py or io/parquet.py",
+                        ident=f"{cname}:{fn}"))
+                elif cname == "open":
+                    mode = _open_write_mode(node)
+                    if mode:
+                        fn = enclosing_function_name(src.tree, node.lineno)
+                        findings.append(Finding(
+                            self.name, src.relpath, node.lineno,
+                            f"direct open(..., {mode!r}) in {fn}() bypasses "
+                            f"the io/ seam — route writes through io/",
+                            ident=f"open-write:{fn}"))
+        return findings

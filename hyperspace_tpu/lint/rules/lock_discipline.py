@@ -1,0 +1,305 @@
+"""lock-discipline: shared mutable state in thread-spawning modules is
+mutated only under its lock; the ``with lock:`` nesting graph is acyclic.
+
+Two analyses:
+
+  1. **Guarded-state consistency** (per configured module).  Locks are
+     discovered structurally (``threading.Lock/RLock/Condition``
+     assigned to ``self._x`` or a module global).  Any state a function
+     mutates inside a ``with <lock>:`` block becomes *lock-associated*;
+     a mutation of the same state OUTSIDE any lock (and outside
+     ``__init__``) is a violation.  Additionally, any read-modify-write
+     (``+=`` and friends) of shared state in a lock-owning class that
+     happens outside every lock is flagged even if the attribute was
+     never seen under a lock — the lost-update shape needs no
+     associative evidence.  A helper whose caller holds the lock
+     carries ``# hslint: allow[lock-discipline] caller holds <lock>``
+     on its ``def`` line.
+
+  2. **Lock-ordering** (package-wide).  Every lexically nested
+     ``with A: ... with B:`` contributes an A→B edge keyed by
+     file-qualified lock identity; a cycle in that graph is the
+     deadlock-by-design shape and is reported on one participating
+     site.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from hyperspace_tpu.lint.engine import Finding, LintContext
+
+# The thread-spawning modules whose state the guarded-state analysis
+# covers (ISSUE 8; extend as new concurrent modules appear).
+GUARDED_MODULES = (
+    "hyperspace_tpu/interop/server.py",
+    "hyperspace_tpu/telemetry/metrics.py",
+    "hyperspace_tpu/execution/plan_cache.py",
+    "hyperspace_tpu/execution/device_cache.py",
+    "hyperspace_tpu/io/integrity.py",
+)
+
+_ORDER_SCAN = ("hyperspace_tpu/",)
+_ORDER_EXCLUDE = ("hyperspace_tpu/lint/",)
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+_MUTATOR_METHODS = {"append", "extend", "insert", "add", "remove",
+                    "discard", "pop", "popitem", "clear", "update",
+                    "setdefault", "move_to_end", "appendleft"}
+_INIT_NAMES = {"__init__", "__post_init__", "__new__"}
+
+
+def _is_lock_ctor(value: ast.AST) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    f = value.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else "")
+    return name in _LOCK_CTORS
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _state_of_target(node: ast.AST,
+                     global_names: Set[str]) -> Optional[str]:
+    """The state identity mutated by an assignment target: ``self.x``
+    (including ``self.x[...]``) or a declared-global module name."""
+    if isinstance(node, ast.Subscript):
+        return _state_of_target(node.value, global_names)
+    attr = _self_attr(node)
+    if attr is not None:
+        return f"self.{attr}"
+    if isinstance(node, ast.Name) and node.id in global_names:
+        return node.id
+    return None
+
+
+class _FuncScanner(ast.NodeVisitor):
+    """Walk one function, tracking the with-lock stack; record mutation
+    events and lock-nesting edges."""
+
+    def __init__(self, lock_names: Set[str], lock_prefix: str) -> None:
+        self.lock_names = lock_names  # "self.X" / module-global names
+        self.lock_prefix = lock_prefix  # file:Class qualifier for edges
+        self.stack: List[str] = []
+        self.global_names: Set[str] = set()
+        # (state, guarded, lineno, is_rmw)
+        self.events: List[Tuple[str, bool, int, bool]] = []
+        self.edges: List[Tuple[str, str, int]] = []
+
+    def _lock_id(self, expr: ast.AST) -> Optional[str]:
+        attr = _self_attr(expr)
+        if attr is not None and f"self.{attr}" in self.lock_names:
+            return f"self.{attr}"
+        if isinstance(expr, ast.Name) and expr.id in self.lock_names:
+            return expr.id
+        return None
+
+    # Nested defs start their own lexical lock context.
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Global(self, node: ast.Global) -> None:
+        self.global_names.update(node.names)
+
+    def visit_With(self, node: ast.With) -> None:
+        held = []
+        for item in node.items:
+            lock = self._lock_id(item.context_expr)
+            if lock is not None:
+                if self.stack:
+                    self.edges.append((self.stack[-1], lock, node.lineno))
+                self.stack.append(lock)
+                held.append(lock)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in held:
+            self.stack.pop()
+
+    visit_AsyncWith = visit_With
+
+    def _record(self, target: ast.AST, lineno: int, rmw: bool) -> None:
+        state = _state_of_target(target, self.global_names)
+        if state is None or state in self.lock_names:
+            return
+        self.events.append((state, bool(self.stack), lineno, rmw))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._record(t, node.lineno, rmw=False)
+        self.visit(node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._record(node.target, node.lineno, rmw=True)
+        self.visit(node.value)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MUTATOR_METHODS:
+            self._record(node.func.value, node.lineno, rmw=False)
+        self.generic_visit(node)
+
+
+def _module_locks(tree: ast.Module) -> Set[str]:
+    out: Set[str] = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _class_locks(cls: ast.ClassDef) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+            for t in node.targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    out.add(f"self.{attr}")
+    return out
+
+
+def _functions(body) -> List[ast.FunctionDef]:
+    return [n for n in body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+class Rule:
+    name = "lock-discipline"
+    description = ("lock-associated state mutated only under its lock; "
+                   "with-lock nesting graph is acyclic")
+
+    def run(self, ctx: LintContext) -> List[Finding]:
+        findings: List[Finding] = []
+        edges: Dict[Tuple[str, str], int] = {}
+        edge_site: Dict[Tuple[str, str], Tuple[str, int]] = {}
+
+        for src in ctx.py_files(include=_ORDER_SCAN,
+                                exclude=_ORDER_EXCLUDE):
+            if src.tree is None:
+                continue
+            guarded_module = src.relpath in GUARDED_MODULES
+            mod_locks = _module_locks(src.tree)
+
+            # Module-level functions mutate module globals.
+            self._scan_scope(
+                src, _functions(src.tree.body), mod_locks,
+                lock_prefix=f"{src.relpath}:<module>",
+                guarded=guarded_module, findings=findings,
+                edges=edges, edge_site=edge_site)
+
+            for cls in [n for n in src.tree.body
+                        if isinstance(n, ast.ClassDef)]:
+                locks = mod_locks | _class_locks(cls)
+                self._scan_scope(
+                    src, _functions(cls.body), locks,
+                    lock_prefix=f"{src.relpath}:{cls.name}",
+                    guarded=guarded_module, findings=findings,
+                    edges=edges, edge_site=edge_site)
+
+        findings.extend(self._cycles(edges, edge_site))
+        return findings
+
+    def _scan_scope(self, src, funcs, locks, lock_prefix, guarded,
+                    findings, edges, edge_site) -> None:
+        owns_lock = bool(locks)
+        events = []  # (state, guarded, lineno, rmw, fname)
+        for fn in funcs:
+            scanner = _FuncScanner(locks, lock_prefix)
+            for stmt in fn.body:
+                scanner.visit(stmt)
+            for outer, inner, line in scanner.edges:
+                key = (f"{lock_prefix}.{outer}", f"{lock_prefix}.{inner}")
+                edges.setdefault(key, 0)
+                edges[key] += 1
+                edge_site.setdefault(key, (src.relpath, line))
+            if fn.name in _INIT_NAMES:
+                continue
+            for state, under, line, rmw in scanner.events:
+                events.append((state, under, line, rmw, fn.name))
+        if not guarded or not owns_lock:
+            return
+        associated = {s for s, under, _l, _r, _f in events if under}
+        for state, under, line, rmw, fname in events:
+            if under:
+                continue
+            if state in associated:
+                findings.append(Finding(
+                    self.name, src.relpath, line,
+                    f"{state} is mutated under a lock elsewhere but "
+                    f"written without one in {fname}()",
+                    ident=f"unlocked:{lock_prefix.split(':')[1]}."
+                          f"{state}:{fname}"))
+            elif rmw:
+                findings.append(Finding(
+                    self.name, src.relpath, line,
+                    f"read-modify-write of shared {state} in {fname}() "
+                    f"outside any lock (lost-update race in a "
+                    f"lock-owning scope)",
+                    ident=f"rmw:{lock_prefix.split(':')[1]}."
+                          f"{state}:{fname}"))
+
+    def _cycles(self, edges, edge_site) -> List[Finding]:
+        graph: Dict[str, Set[str]] = {}
+        for a, b in edges:
+            if a != b:
+                graph.setdefault(a, set()).add(b)
+        findings: List[Finding] = []
+        # Iterative DFS cycle detection with path recovery.
+        WHITE, GRAY, BLACK = 0, 1, 2
+        color = {n: WHITE for n in
+                 set(graph) | {b for bs in graph.values() for b in bs}}
+        reported: Set[frozenset] = set()
+
+        def dfs(start: str) -> None:
+            stack = [(start, iter(sorted(graph.get(start, ()))))]
+            path = [start]
+            color[start] = GRAY
+            while stack:
+                node, it = stack[-1]
+                advanced = False
+                for nxt in it:
+                    if color[nxt] == GRAY:
+                        cyc = path[path.index(nxt):] + [nxt]
+                        key = frozenset(cyc)
+                        if key not in reported:
+                            reported.add(key)
+                            site = edge_site.get((node, nxt)) or \
+                                edge_site.get((cyc[0], cyc[1]))
+                            path_s = " -> ".join(
+                                c.split(":")[-1] for c in cyc)
+                            findings.append(Finding(
+                                self.name, site[0] if site else "",
+                                site[1] if site else 1,
+                                f"lock-ordering cycle: {path_s} — two "
+                                f"threads taking these locks in opposite "
+                                f"orders deadlock",
+                                ident=f"cycle:{'|'.join(sorted(key))}"))
+                    elif color[nxt] == WHITE:
+                        color[nxt] = GRAY
+                        path.append(nxt)
+                        stack.append(
+                            (nxt, iter(sorted(graph.get(nxt, ())))))
+                        advanced = True
+                        break
+                if not advanced:
+                    color[node] = BLACK
+                    stack.pop()
+                    if path and path[-1] == node:
+                        path.pop()
+
+        for n in sorted(color):
+            if color[n] == WHITE:
+                dfs(n)
+        return findings

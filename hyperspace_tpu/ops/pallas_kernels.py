@@ -30,7 +30,7 @@ slices off.
 from __future__ import annotations
 
 from functools import partial
-from typing import Sequence, Tuple
+from typing import Sequence
 
 import jax
 import jax.numpy as jnp

@@ -13,7 +13,7 @@ agnostic to how many chips did the work.
 
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+from typing import Sequence, Tuple
 
 import numpy as np
 import pyarrow as pa

@@ -13,7 +13,7 @@ fallback path; the TPU executor compiles them to a JAX predicate instead
 
 from __future__ import annotations
 
-from typing import Any, FrozenSet, Iterable, List, Sequence, Set, Union
+from typing import Any, Iterable, List, Sequence, Set, Union
 
 
 class Expr:

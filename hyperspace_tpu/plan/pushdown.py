@@ -26,7 +26,7 @@ Rules, applied to fixpoint:
 
 from __future__ import annotations
 
-from typing import Callable, List, Optional
+from typing import Callable, List
 
 from hyperspace_tpu.plan.expr import And, Expr, conjoin, split_conjuncts
 from hyperspace_tpu.plan.nodes import (

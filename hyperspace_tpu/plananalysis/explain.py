@@ -14,7 +14,7 @@ from __future__ import annotations
 
 from typing import List, Optional, Tuple
 
-from hyperspace_tpu.plan.nodes import LogicalPlan, Scan
+from hyperspace_tpu.plan.nodes import LogicalPlan
 from hyperspace_tpu.plananalysis.display import BufferStream, get_display_mode
 
 # (text, highlighted) per rendered plan line.

@@ -45,7 +45,7 @@ from hyperspace_tpu.plan.expr import (
     Not,
     Or,
 )
-from hyperspace_tpu.plan.nodes import Filter, LogicalPlan, Project, Scan, ScanRelation
+from hyperspace_tpu.plan.nodes import LogicalPlan, Scan
 from hyperspace_tpu.rules import rule_utils
 from hyperspace_tpu.rules.filter_rule import _extract_filter_nodes
 from hyperspace_tpu.telemetry.events import HyperspaceIndexUsageEvent, emit_event

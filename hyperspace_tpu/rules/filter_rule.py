@@ -17,13 +17,13 @@ parity-tested against the device kernel) and prune the index files read
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Set, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 import pyarrow as pa
 
 from hyperspace_tpu.index.log_entry import IndexLogEntry, States
-from hyperspace_tpu.plan.expr import And, BinOp, Col, Expr, IsIn, Lit, Not, Or, split_conjuncts
+from hyperspace_tpu.plan.expr import BinOp, Col, Expr, IsIn, Lit, Or, split_conjuncts
 from hyperspace_tpu.plan.nodes import Filter, LogicalPlan, Project, Scan
 from hyperspace_tpu.rules import rule_utils
 from hyperspace_tpu.rules.rankers import rank_filter_indexes

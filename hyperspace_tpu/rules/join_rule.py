@@ -19,7 +19,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from hyperspace_tpu.index.log_entry import IndexLogEntry, States
 from hyperspace_tpu.plan.expr import as_equi_join_pairs
-from hyperspace_tpu.plan.nodes import Join, LogicalPlan, Scan
+from hyperspace_tpu.plan.nodes import Join, LogicalPlan
 from hyperspace_tpu.rules import rule_utils
 from hyperspace_tpu.rules.rankers import rank_join_index_pairs
 from hyperspace_tpu.telemetry.events import HyperspaceIndexUsageEvent, emit_event

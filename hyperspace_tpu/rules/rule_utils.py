@@ -16,9 +16,9 @@ Reference contract: index/rules/RuleUtils.scala —
 from __future__ import annotations
 
 import os
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
-from hyperspace_tpu.index.log_entry import IndexLogEntry, IndexLogEntryTags, States
+from hyperspace_tpu.index.log_entry import IndexLogEntry, IndexLogEntryTags
 from hyperspace_tpu.index.signatures import get_provider
 from hyperspace_tpu.plan.nodes import LogicalPlan, Scan, ScanRelation
 

@@ -10,7 +10,7 @@ rewrite rules run.
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Optional
+from typing import Dict, List, Optional
 
 from hyperspace_tpu.config import HyperspaceConf
 from hyperspace_tpu.plan.nodes import LogicalPlan, Scan, ScanRelation

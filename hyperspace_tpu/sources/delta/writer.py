@@ -13,7 +13,7 @@ from __future__ import annotations
 import json
 import time
 import uuid
-from typing import Dict, List, Optional
+from typing import List
 
 import pyarrow as pa
 import pyarrow.parquet as pq

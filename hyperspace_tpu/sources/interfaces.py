@@ -17,7 +17,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Tuple
 
-from hyperspace_tpu.index.log_entry import Content, FileIdTracker, FileInfo, IndexLogEntry, Relation
+from hyperspace_tpu.index.log_entry import FileIdTracker, FileInfo, IndexLogEntry, Relation
 from hyperspace_tpu.plan.nodes import Scan
 
 

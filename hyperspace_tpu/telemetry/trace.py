@@ -211,6 +211,7 @@ class JsonlTraceSink(TraceSink):
     def emit(self, root: Span) -> None:
         line = json.dumps(root.to_dict(), default=str)
         try:
+            # hslint: allow[io-seam] user-chosen trace sink, not index data
             with self._lock, open(self.path, "a", encoding="utf-8") as f:
                 f.write(line + "\n")
         except OSError:

@@ -6,7 +6,11 @@ suite — including the plan-stability golden-file tests — on the virtual
 ``--quick`` swaps in the fast development tier (``pytest -m quick``): the
 TPC corpora, fuzz nets, and other heavy suites listed in tests/conftest.py
 are excluded so the loop stays under ~3 minutes.  CI and judge runs use
-the default full mode."""
+the default full mode.
+
+``--lint`` runs the static invariant checker instead of the tests
+(``python -m hyperspace_tpu.lint`` — docs/18-static-analysis.md) and
+exits with its status: 0 clean, 1 new findings."""
 
 from __future__ import annotations
 
@@ -16,6 +20,10 @@ import sys
 
 def main() -> int:
     args = sys.argv[1:]
+    if "--lint" in args:
+        rest = [a for a in args if a != "--lint"]
+        return subprocess.call(
+            [sys.executable, "-m", "hyperspace_tpu.lint"] + rest)
     if "--quick" in args:
         args = [a for a in args if a != "--quick"] + ["-m", "quick"]
     return subprocess.call(

@@ -1,0 +1,36 @@
+#!/usr/bin/env python
+"""Zero-dependency hslint launcher.
+
+``python -m hyperspace_tpu.lint`` is the canonical invocation, but it
+executes ``hyperspace_tpu/__init__.py`` on the way in — which imports
+the engine (numpy, pyarrow, jax).  The linter itself is pure stdlib and
+parses rather than imports, so CI's lint lane (and any environment
+without the engine's dependencies) launches it through this shim: a
+stub package object with the real ``__path__`` is registered first, so
+Python resolves ``hyperspace_tpu.lint.*`` without ever running the
+package ``__init__``.
+"""
+
+import os
+import sys
+import types
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    if "hyperspace_tpu" not in sys.modules:
+        stub = types.ModuleType("hyperspace_tpu")
+        stub.__path__ = [os.path.join(_ROOT, "hyperspace_tpu")]
+        sys.modules["hyperspace_tpu"] = stub
+    sys.path.insert(0, _ROOT)
+    from hyperspace_tpu.lint.__main__ import main as lint_main
+
+    argv = sys.argv[1:]
+    if not any(a.startswith("--root") for a in argv):
+        argv = ["--root", _ROOT] + argv
+    return lint_main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
