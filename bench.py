@@ -961,8 +961,8 @@ SECTION_NAMES = ("setup", "sf1_queries", "device_agg_probe",
                  "resident_agg", "warm_resident_join", "warm_q3",
                  "warm_q10", "window_bench", "kernel_bench",
                  "calibration", "telemetry_overhead", "advisor",
-                 "integrity", "build_profile", "serving", "sf10",
-                 "sf100")
+                 "integrity", "build_profile", "serving",
+                 "flight_recorder", "sf10", "sf100")
 
 
 def main() -> int:
@@ -1013,6 +1013,8 @@ def main() -> int:
             harness.section("build_profile",
                             lambda: _sec_build_profile(root))
             harness.section("serving", lambda: _sec_serving(ctx))
+            harness.section("flight_recorder",
+                            lambda: _sec_flight_recorder(ctx))
             harness.section("sf10", lambda: _sec_sf10(ctx, root, harness))
             harness.section("sf100", lambda: _sec_sf100(ctx, root, harness))
         except _Finalize:
@@ -2160,6 +2162,100 @@ def _sec_serving(ctx: dict) -> dict:
         "overload_served": len(ok_rows),
         "shed_rate": round(len(busy) / 12.0, 4),
     }}
+
+
+def _sec_flight_recorder(ctx: dict) -> dict:
+    """Flight-recorder cost contract (docs/16-observability.md): the
+    tail-sampled request recorder must be invisible on the serving hot
+    path — the offer decision is a few conf reads and a counter, with
+    serialization paid only for retained records.  Measured on the
+    serving workload and CORRECTNESS-GATED at < 3% median overhead
+    (same 2ms absolute noise floor as the advisor capture gate), then
+    the retention + diagnostics loop is proven: a slow request lands in
+    ``slow_queries()`` addressable by its echoed trace id, and
+    ``dump_diagnostics`` leaves a bundle readable back."""
+    import json as _json
+
+    from hyperspace_tpu.interop.server import QueryClient, QueryServer
+    from hyperspace_tpu.telemetry import flight_recorder
+    from hyperspace_tpu.telemetry import metrics as _metrics
+
+    _require(ctx, "session", "lineitem_dir")
+    session = ctx["session"]
+    session.enable_hyperspace()
+    li = ctx["lineitem_dir"]
+    keys = [N_ORDERS // 11, N_ORDERS // 5, N_ORDERS // 2]
+    templates = [
+        {"source": {"format": "parquet", "path": li},
+         "filter": {"op": "==", "col": "l_orderkey", "value": k},
+         "select": ["l_orderkey", "l_quantity"]} for k in keys]
+    reqs = 24
+    reps = max(3, REPEATS)
+    out: dict = {}
+    saved = (session.conf.flight_recorder_enabled,
+             session.conf.flight_recorder_slow_ms)
+    try:
+        with QueryServer(session) as server:
+            def batch() -> None:
+                with QueryClient(server.address) as qc:
+                    for r in range(reqs):
+                        qc.query(dict(templates[r % len(templates)]))
+
+            batch()  # warm: plan cache, readers, sockets
+            session.conf.flight_recorder_enabled = False
+            t_off = _time(batch, repeats=reps)
+            session.conf.flight_recorder_enabled = True
+            t_on = _time(batch, repeats=reps)
+            overhead_pct = ((t_on["median"] - t_off["median"])
+                            / t_off["median"] * 100.0)
+            abs_ms = ((t_on["median"] - t_off["median"])
+                      * 1000.0 / reqs)
+            out["recorder_off_s"] = _stat(t_off)
+            out["recorder_on_s"] = _stat(t_on)
+            out["requests_per_batch"] = reqs
+            out["overhead_pct"] = round(overhead_pct, 2)
+            out["overhead_ms_per_request"] = round(abs_ms, 3)
+            if overhead_pct > 3.0 and abs_ms > 2.0:
+                raise SystemExit(
+                    f"flight_recorder bench: recorder overhead "
+                    f"{overhead_pct:.1f}% (> 3% and {abs_ms:.2f} "
+                    f"ms/request) on the serving workload")
+
+            # Retention + surfacing: force one request into the slow
+            # tail, fetch it back by its echoed trace id.
+            retained0 = _metrics.registry().counter("flight.retained")
+            session.conf.flight_recorder_slow_ms = 0.0001
+            with QueryClient(server.address) as qc:
+                qc.query(dict(templates[0]))
+                tid = qc.last_trace_id
+            deadline_at = time.monotonic() + 10
+            rec = None
+            while rec is None and time.monotonic() < deadline_at:
+                rec = flight_recorder.recorder().find(tid)
+            if rec is None or rec["outcome"] != "OK":
+                raise SystemExit(
+                    "flight_recorder bench: slow request was not "
+                    "retained under its echoed trace id")
+            out["retained_delta"] = int(
+                _metrics.registry().counter("flight.retained")
+                - retained0)
+            with QueryClient(server.address) as qc:
+                verb = qc.query({"verb": "trace", "id": tid})
+            if _json.loads(
+                    verb.column("record_json")[0].as_py()
+                    )["trace_id"] != tid:
+                raise SystemExit("flight_recorder bench: trace verb "
+                                 "returned the wrong record")
+        key = flight_recorder.dump_diagnostics(session.conf)
+        got = flight_recorder.bundles(session.conf)
+        if key is None or not any(b.get("key") == key for b in got):
+            raise SystemExit("flight_recorder bench: diagnostics bundle "
+                             "did not round-trip through the LogStore")
+        out["bundle_records"] = len(got[-1].get("records", []))
+    finally:
+        (session.conf.flight_recorder_enabled,
+         session.conf.flight_recorder_slow_ms) = saved
+    return {"flight_recorder": out}
 
 
 def _sec_sf10(ctx: dict, root: str, harness: "_Harness") -> dict:

@@ -62,6 +62,7 @@ IO_RETRY_INITIAL_BACKOFF_MS = "hyperspace.system.io.retry.initialBackoffMs"
 IO_RETRY_MAX_BACKOFF_MS = "hyperspace.system.io.retry.maxBackoffMs"
 TELEMETRY_TRACING_ENABLED = "hyperspace.system.telemetry.tracing.enabled"
 TELEMETRY_TRACE_SINK = "hyperspace.system.telemetry.trace.sink"
+TELEMETRY_TRACE_MAX_BYTES = "hyperspace.system.telemetry.trace.maxBytes"
 BUILD_PROFILING_ENABLED = "hyperspace.system.buildProfiling.enabled"
 PERF_LEDGER_ENABLED = "hyperspace.system.perf.ledger.enabled"
 PERF_LEDGER_MAX_ENTRIES = "hyperspace.system.perf.ledger.maxEntries"
@@ -79,6 +80,12 @@ SERVING_SHED_RSS_MB = "hyperspace.serving.shed.rssWatermarkMb"
 SERVING_SHED_QUEUE_WAIT_MS = "hyperspace.serving.shed.queueWaitWatermarkMs"
 SERVING_PLAN_CACHE_ENABLED = "hyperspace.serving.planCache.enabled"
 SERVING_PLAN_CACHE_BYTES = "hyperspace.serving.planCacheBytes"
+FLIGHT_RECORDER_ENABLED = "hyperspace.serving.flightRecorder.enabled"
+FLIGHT_RECORDER_MAX_RECORDS = "hyperspace.serving.flightRecorder.maxRecords"
+FLIGHT_RECORDER_SLOW_MS = "hyperspace.serving.flightRecorder.slowMs"
+FLIGHT_RECORDER_HEALTHY_SAMPLE_N = \
+    "hyperspace.serving.flightRecorder.healthySampleN"
+FLIGHT_RECORDER_MAX_BUNDLES = "hyperspace.serving.flightRecorder.maxBundles"
 FAULT_INJECTION_ENABLED = "hyperspace.system.faultInjection.enabled"
 FAULT_INJECTION_SITE = "hyperspace.system.faultInjection.site"
 FAULT_INJECTION_KIND = "hyperspace.system.faultInjection.kind"
@@ -276,6 +283,10 @@ class HyperspaceConf:
     # a contextvar read / a dict increment at file/action granularity).
     telemetry_tracing_enabled: bool = False
     telemetry_trace_sink: str = ""
+    # Size bound for the JSONL trace sink: past it the sink file rotates
+    # to <path>.1 (replacing the previous rotation), so a long-lived
+    # traced server keeps at most ~2x this on disk.  0 = unbounded.
+    telemetry_trace_max_bytes: int = 256 << 20
     # Build-pipeline profiler (telemetry/build_report.py): every action
     # run records per-phase wall time, bytes moved, spill counts, and
     # memory gauges into a BuildReport (Hyperspace.last_build_report()),
@@ -344,6 +355,20 @@ class HyperspaceConf:
     serving_shed_queue_wait_watermark_ms: float = 0.0
     serving_plan_cache_enabled: bool = True
     serving_plan_cache_bytes: int = 64 << 20
+    # Request flight recorder (telemetry/flight_recorder.py;
+    # docs/16-observability.md): a bounded ring of completed request
+    # records with tail-based retention — slow (>= slowMs), error,
+    # deadline-expired, and shed requests always kept, healthy ones
+    # sampled 1-in-healthySampleN (0 = none).  Read by
+    # Hyperspace.slow_queries()/diagnostics() and the slow_queries /
+    # trace interop verbs; drain()/dump_diagnostics() persist the ring
+    # (+ metrics snapshot + perf-ledger tail) as a diagnostics bundle
+    # through the LogStore seam, bounded by maxBundles.
+    flight_recorder_enabled: bool = True
+    flight_recorder_max_records: int = 256
+    flight_recorder_slow_ms: float = 1000.0
+    flight_recorder_healthy_sample_n: int = 16
+    flight_recorder_max_bundles: int = 8
     # Deterministic fault injection (io/faults.py): fire ``kind`` at the
     # ``at``-th call of ``site``, ``count`` times.  Test-only machinery;
     # disabled costs one None check per file-level IO op.
@@ -404,6 +429,7 @@ class HyperspaceConf:
         IO_RETRY_MAX_BACKOFF_MS: "io_retry_max_backoff_ms",
         TELEMETRY_TRACING_ENABLED: "telemetry_tracing_enabled",
         TELEMETRY_TRACE_SINK: "telemetry_trace_sink",
+        TELEMETRY_TRACE_MAX_BYTES: "telemetry_trace_max_bytes",
         BUILD_PROFILING_ENABLED: "build_profiling_enabled",
         PERF_LEDGER_ENABLED: "perf_ledger_enabled",
         PERF_LEDGER_MAX_ENTRIES: "perf_ledger_max_entries",
@@ -421,6 +447,11 @@ class HyperspaceConf:
         SERVING_SHED_QUEUE_WAIT_MS: "serving_shed_queue_wait_watermark_ms",
         SERVING_PLAN_CACHE_ENABLED: "serving_plan_cache_enabled",
         SERVING_PLAN_CACHE_BYTES: "serving_plan_cache_bytes",
+        FLIGHT_RECORDER_ENABLED: "flight_recorder_enabled",
+        FLIGHT_RECORDER_MAX_RECORDS: "flight_recorder_max_records",
+        FLIGHT_RECORDER_SLOW_MS: "flight_recorder_slow_ms",
+        FLIGHT_RECORDER_HEALTHY_SAMPLE_N: "flight_recorder_healthy_sample_n",
+        FLIGHT_RECORDER_MAX_BUNDLES: "flight_recorder_max_bundles",
         FAULT_INJECTION_ENABLED: "fault_injection_enabled",
         FAULT_INJECTION_SITE: "fault_injection_site",
         FAULT_INJECTION_KIND: "fault_injection_kind",

@@ -320,6 +320,15 @@ class Dataset:
             if isinstance(query_span, trace.Span):
                 rep.root_span = query_span
             self.session.last_run_report_value = rep
+            if trace.current_request_context() is None:
+                # A LOCAL query: feed the flight recorder here so
+                # slow_queries() works without a server.  Served queries
+                # are recorded by their worker/handler (with wire trace
+                # context and queue timings), which sets the request
+                # scope this checks.  record_local never raises.
+                from hyperspace_tpu.telemetry import flight_recorder
+
+                flight_recorder.record_local(self.session.conf, rep)
         if self.session.conf.advisor_capture_enabled:
             # Workload capture (advisor/workload.py): the run report just
             # finished is the feed — fingerprint + measured bytes, folded
@@ -377,8 +386,10 @@ class Dataset:
                     # The optimizer pass (whose rules feed indexes_used)
                     # is skipped on a hit: attribute the cached plan's
                     # index scans so "which index answered this query"
-                    # survives caching.
-                    run_report.record("plan_cache", hit=True)
+                    # survives caching.  The fingerprint rides the
+                    # report so the flight record can name the plan.
+                    run_report.record("plan_cache", hit=True,
+                                      fingerprint=cache_key)
                     for name in _index_scans_of(plan):
                         run_report.record("index.used", index=name,
                                           message="served from plan cache")
@@ -389,6 +400,8 @@ class Dataset:
                 plan = self.optimized_plan()
                 if cache_key is not None:
                     plan_cache.put(cache_key, plan)
+                    run_report.record("plan_cache", hit=False,
+                                      fingerprint=cache_key)
             except Exception as e:  # noqa: BLE001 — InjectedCrash propagates.
                 # PLANNING died with index rewrites on (e.g. every file of
                 # an index unreadable, so even its schema cannot be

@@ -244,10 +244,19 @@ class Executor:
             self.stats["memory"] = mem
 
     def execute(self, plan: LogicalPlan) -> pa.Table:
-        # Per-request deadline (utils/deadline.py): every operator entry
-        # is a phase boundary — a served query past its deadline aborts
-        # here instead of completing an answer nobody waits for.  One
-        # contextvar read when no deadline is set.
+        # Per-request deadline (utils/deadline.py): operator ENTRY and
+        # EXIT are both phase boundaries.  Entry alone is not enough —
+        # the recursion checks every node on the way DOWN (all within
+        # microseconds of each other), so a deadline that expires inside
+        # a long scan would never abort the aggregation/sort/join work
+        # stacked above it.  The exit check fires right after the child
+        # work that consumed the budget, before the parent spends more.
+        # One contextvar read each when no deadline is set.
+        out = self._execute_node(plan)
+        _deadline.check(type(plan).__name__)
+        return out
+
+    def _execute_node(self, plan: LogicalPlan) -> pa.Table:
         _deadline.check(type(plan).__name__)
         if isinstance(plan, InMemory):
             return plan.table

@@ -145,6 +145,59 @@ class Hyperspace:
 
         return history_table(self.session.conf)
 
+    # -- flight recorder / diagnostics (docs/16-observability.md) -----------
+    def slow_queries(self) -> pa.Table:
+        """The flight recorder's retained ring as an arrow table, oldest
+        first: slow (>= ``hyperspace.serving.flightRecorder.slowMs``),
+        error, deadline-expired, and shed requests are always kept,
+        healthy ones sampled 1-in-N.  Columns: ts, traceId, requestId,
+        kind, outcome, latencyMs, queueWaitMs, slow, reason, error,
+        recordJson (the full record: span tree + run report).  The same
+        table the interop ``slow_queries`` verb serves."""
+        from hyperspace_tpu.telemetry.flight_recorder import (
+            slow_queries_table,
+        )
+
+        return slow_queries_table(self.session.conf)
+
+    def trace(self, trace_id: str):
+        """The full retained flight record (dict) for ``trace_id`` — the
+        id every wire response echoes and every ``QueryFailedError``
+        carries — or None when no record for it is retained."""
+        from hyperspace_tpu.telemetry import flight_recorder
+
+        return flight_recorder.recorder().find(trace_id.lower())
+
+    def diagnostics(self) -> dict:
+        """The live diagnostics bundle: the flight recorder's retained
+        ring, a metrics snapshot, and the recent perf-ledger tail — the
+        exact payload :meth:`dump_diagnostics` persists."""
+        from hyperspace_tpu.telemetry.flight_recorder import (
+            diagnostics_bundle,
+        )
+
+        return diagnostics_bundle(self.session.conf)
+
+    def dump_diagnostics(self):
+        """Persist :meth:`diagnostics` as a bundle through the LogStore
+        seam under ``<systemPath>/_hyperspace_diagnostics`` (both
+        backends, restart-proof, bounded by
+        ``hyperspace.serving.flightRecorder.maxBundles``); returns the
+        bundle key, or None when disabled/failed.  ``QueryServer``'s
+        drain (SIGTERM) does this automatically."""
+        from hyperspace_tpu.telemetry.flight_recorder import (
+            dump_diagnostics,
+        )
+
+        return dump_diagnostics(self.session.conf)
+
+    def diagnostics_bundles(self) -> list:
+        """Every persisted diagnostics bundle, oldest first — how "what
+        happened yesterday" survives a restart (docs/10-faq.md)."""
+        from hyperspace_tpu.telemetry.flight_recorder import bundles
+
+        return bundles(self.session.conf)
+
     def metrics(self) -> dict:
         """Point-in-time snapshot of the process-wide metrics registry
         (telemetry/metrics.py): counters like ``io.retry.attempts``,

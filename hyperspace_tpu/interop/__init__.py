@@ -9,7 +9,13 @@ stream (interop/server.py) — consumable from Java/C#/Go/Rust/JS through
 any Arrow implementation, no Python required on the client.
 """
 
-from hyperspace_tpu.interop.query import dataset_from_spec, expr_from_json
+from hyperspace_tpu.interop.query import (
+    dataset_from_spec,
+    expr_from_json,
+    mint_trace_id,
+    pop_trace_context,
+    valid_trace_id,
+)
 from hyperspace_tpu.interop.server import (
     QueryClient,
     QueryFailedError,
@@ -19,6 +25,7 @@ from hyperspace_tpu.interop.server import (
     request_query,
 )
 
-__all__ = ["dataset_from_spec", "expr_from_json", "QueryClient",
+__all__ = ["dataset_from_spec", "expr_from_json", "mint_trace_id",
+           "pop_trace_context", "valid_trace_id", "QueryClient",
            "QueryFailedError", "QueryServer", "ServerBusyError",
            "parse_wire_error", "request_query"]

@@ -44,9 +44,47 @@ from hyperspace_tpu.plan.expr import (
 # Session in scope while a spec decodes — subquery specs need it to build
 # their Dataset trees (thread-local: the interop server decodes
 # concurrently on worker threads).
+import os
+import re
 import threading
 
 _SPEC_TLS = threading.local()
+
+# -- wire trace context ------------------------------------------------------
+# A request spec may carry ``trace_id`` / ``request_id``: 16 lowercase hex
+# chars (8 random bytes), minted by the client so a failure is
+# correlatable from EITHER side of the wire.  The server adopts a valid
+# id and MINTS its own for a missing/malformed one — a bad trace id must
+# never reject a request (observability is advisory, the query is not).
+TRACE_ID_HEX_CHARS = 16
+_TRACE_ID_RE = re.compile(r"^[0-9a-f]{16}$")
+
+
+def mint_trace_id() -> str:
+    """A fresh 16-hex-char trace/request id (8 random bytes)."""
+    return os.urandom(TRACE_ID_HEX_CHARS // 2).hex()
+
+
+def valid_trace_id(value) -> bool:
+    """Exactly 16 lowercase hex chars (uppercase normalizes on adopt)."""
+    return isinstance(value, str) and \
+        _TRACE_ID_RE.match(value.lower()) is not None
+
+
+def pop_trace_context(spec):
+    """Extract (and remove) the trace context from a decoded request
+    spec: ``(trace_id, request_id, adopted)``.  ``adopted`` is True when
+    the client's trace_id was usable; malformed/missing ids — wrong
+    length, non-hex, non-string — fall back to server-minted ones.
+    Never raises: the spec keys are popped even when unusable, so they
+    cannot leak into query decoding."""
+    raw_trace = spec.pop("trace_id", None)
+    raw_request = spec.pop("request_id", None)
+    adopted = valid_trace_id(raw_trace)
+    trace_id = raw_trace.lower() if adopted else mint_trace_id()
+    request_id = raw_request.lower() if valid_trace_id(raw_request) \
+        else mint_trace_id()
+    return trace_id, request_id, adopted
 
 
 def _subquery_plan(spec: Dict[str, Any]):

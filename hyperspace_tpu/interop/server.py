@@ -4,11 +4,20 @@ Wire protocol (deliberately minimal so any language can speak it with a
 socket plus an Arrow library — no HTTP/gRPC dependency):
 
   client -> server   one JSON object (the interop/query.py spec),
-                     UTF-8, terminated by a newline
-  server -> client   the status line ``OK\\n`` followed by an Arrow IPC
-                     STREAM of the result (self-delimiting), or
-                     ``ERR <CODE> <message>\\n`` and the connection
-                     closes
+                     UTF-8, terminated by a newline; may carry a
+                     client-minted trace context (``trace_id`` /
+                     ``request_id``, 16 hex chars each) the server
+                     adopts — malformed ids are replaced by
+                     server-minted ones, never rejected
+  server -> client   the status line ``OK trace=<trace_id>\\n`` followed
+                     by an Arrow IPC STREAM of the result
+                     (self-delimiting), or
+                     ``ERR <CODE> <message> trace=<trace_id>\\n`` and
+                     the connection closes — every response echoes the
+                     adopted/minted trace id, so a failure is
+                     correlatable from either side (the flight
+                     recorder's ``slow_queries``/``trace`` verbs answer
+                     for it afterwards)
 
 Error codes split RETRYABLE conditions from permanent ones:
 
@@ -97,12 +106,16 @@ class QueryFailedError(RuntimeError):
     ``BUSY``/``DEADLINE``/``BADREQ``/``FAILED`` (bare pre-taxonomy errors
     map to ``FAILED``); ``retryable`` is True for overload/deadline sheds
     — back off and retry on a FRESH connection (errors close the one they
-    arrived on)."""
+    arrived on).  ``trace_id`` is the server-echoed trace context (None
+    against a pre-trace server): quote it to ``slow_queries()`` / the
+    ``trace`` verb to pull the request's full flight record."""
 
-    def __init__(self, code: str, message: str, payload: str) -> None:
+    def __init__(self, code: str, message: str, payload: str,
+                 trace_id: Optional[str] = None) -> None:
         super().__init__(f"Query failed: {payload}")
         self.code = code
         self.message = message
+        self.trace_id = trace_id
 
     @property
     def retryable(self) -> bool:
@@ -114,17 +127,36 @@ class ServerBusyError(QueryFailedError):
     Retry with backoff on a new connection."""
 
 
+_TRACE_ECHO_RE = None  # compiled lazily; interop/query.py owns the format
+
+
+def _split_trace_echo(text: str) -> Tuple[str, Optional[str]]:
+    """Strip a trailing ``trace=<16 hex>`` token (the server's trace-id
+    echo) off a status line, returning ``(rest, trace_id-or-None)``."""
+    global _TRACE_ECHO_RE
+    if _TRACE_ECHO_RE is None:
+        import re
+
+        _TRACE_ECHO_RE = re.compile(r"^(.*?)\s*\btrace=([0-9a-f]{16})\s*$")
+    m = _TRACE_ECHO_RE.match(text)
+    if m is None:
+        return text, None
+    return m.group(1), m.group(2)
+
+
 def parse_wire_error(line: str) -> QueryFailedError:
     """An ``ERR ...`` status line → the typed client error.  Accepts both
     the coded form (``ERR BUSY queue full``) and the pre-taxonomy bare
     form (``ERR something broke`` → code FAILED), so a new client keeps
-    working against an old server."""
+    working against an old server; a trailing ``trace=<id>`` echo (this
+    PR's trace context) is lifted into ``.trace_id`` either way."""
     payload = line[4:] if line.startswith("ERR ") else line
-    code, _, rest = payload.partition(" ")
+    stripped, trace_id = _split_trace_echo(payload)
+    code, _, rest = stripped.partition(" ")
     if code in KNOWN_WIRE_CODES and rest:
         cls = ServerBusyError if code == ERR_BUSY else QueryFailedError
-        return cls(code, rest, payload)
-    return QueryFailedError(ERR_FAILED, payload, payload)
+        return cls(code, rest, payload, trace_id)
+    return QueryFailedError(ERR_FAILED, stripped, payload, trace_id)
 
 
 def _classify_error(exc: BaseException) -> Tuple[str, str]:
@@ -167,10 +199,12 @@ class _Job:
     that single-writer discipline is what makes torn frames impossible."""
 
     __slots__ = ("fn", "kind", "deadline_at", "enqueued_t", "done",
-                 "result", "error", "report", "abandoned")
+                 "result", "error", "report", "abandoned",
+                 "trace_id", "request_id", "root_span", "queue_wait_ms")
 
     def __init__(self, fn: Callable[[], pa.Table], kind: str,
-                 deadline_at: Optional[float]) -> None:
+                 deadline_at: Optional[float], trace_id: str = "",
+                 request_id: str = "") -> None:
         self.fn = fn
         self.kind = kind
         self.deadline_at = deadline_at  # absolute time.monotonic(), or None
@@ -180,6 +214,10 @@ class _Job:
         self.error: Optional[BaseException] = None
         self.report = None  # the query's run report, for the verb surface
         self.abandoned = False  # handler gave up waiting; discard result
+        self.trace_id = trace_id      # wire trace context (adopted or
+        self.request_id = request_id  # minted by the handler)
+        self.root_span = None    # the serve.request Span when tracing on
+        self.queue_wait_ms: Optional[float] = None
 
 
 class _WorkerPool:
@@ -280,6 +318,7 @@ class _WorkerPool:
             job: _Job = item
             now = time.monotonic()
             wait_ms = (now - job.enqueued_t) * 1000.0
+            job.queue_wait_ms = wait_ms
             metrics.observe("serve.queue_wait_ms", wait_ms)
             metrics.set_gauge("serve.queue_depth", self._queue.qsize())
             with self._lock:
@@ -301,26 +340,80 @@ class _WorkerPool:
                 else:
                     budget = None if job.deadline_at is None \
                         else job.deadline_at - time.monotonic()
-                    with trace.span("serve.request", kind=job.kind) as sp:
-                        with _deadline.scope(budget):
-                            job.result = job.fn()
-                        sp.set(queue_wait_ms=round(wait_ms, 1))
-                    # The run report lands in this WORKER's thread-local;
-                    # hand it to the connection so the last_run_report
-                    # verb keeps its query-then-ask-same-connection
-                    # contract.
-                    job.report = self._session.last_run_report_value
+                    # This worker's report thread-local could still hold
+                    # a PREVIOUS request's report; clear it so a query
+                    # that dies before collect() cannot be flight-
+                    # recorded against a stale report.
+                    self._session.last_run_report_value = None
+                    try:
+                        # The wire trace context rides the worker's
+                        # context: collect() sees a served request, and
+                        # the root span carries the ids to the sinks.
+                        with trace.request_scope(job.trace_id,
+                                                 job.request_id):
+                            with trace.span(
+                                    "serve.request", kind=job.kind,
+                                    trace_id=job.trace_id,
+                                    request_id=job.request_id) as sp:
+                                if isinstance(sp, trace.Span):
+                                    job.root_span = sp
+                                with _deadline.scope(budget):
+                                    job.result = job.fn()
+                                sp.set(queue_wait_ms=round(wait_ms, 1))
+                    finally:
+                        # The run report lands in this WORKER's
+                        # thread-local (success OR failure — the flight
+                        # recorder wants the failed query's report too);
+                        # hand it to the connection so the
+                        # last_run_report verb keeps its
+                        # query-then-ask-same-connection contract.
+                        job.report = self._session.last_run_report_value
             except BaseException as e:  # noqa: BLE001 — a worker must
                 # survive anything a query can throw; the error crosses
                 # the wire instead (the handler classifies it).
                 job.error = e
             finally:
+                # Flight-record BEFORE done.set(): the job's span tree /
+                # report are final here, and recording first means a
+                # record exists by the time the handler can answer — no
+                # live-Span serialization race, no torn record.  The
+                # worker owns every ADMITTED job's record (including
+                # abandoned ones, whose handler answered DEADLINE long
+                # before this abort landed); the handler records only
+                # requests that never reached a worker (sheds, BADREQ).
+                self._record_flight(job)
                 job.done.set()
                 with self._idle:
                     self._active -= 1
                     self._queued_or_active -= 1
                     metrics.set_gauge("serve.inflight", self._active)
                     self._idle.notify_all()
+
+    def _record_flight(self, job: _Job) -> None:
+        """One completed job → one flight-recorder offer (+ the latency
+        histogram's exemplar link when the record was retained)."""
+        from hyperspace_tpu.telemetry import flight_recorder, metrics
+
+        if job.abandoned:
+            # The CLIENT saw ERR DEADLINE regardless of what the aborted
+            # execution eventually produced — record what was answered.
+            outcome = ERR_DEADLINE
+            error = ("abandoned: deadline passed before the result was "
+                     "ready")
+        elif job.error is not None:
+            outcome, raw = _classify_error(job.error)
+            error = str(raw).replace("\n", " ")[:500]
+        else:
+            outcome, error = "OK", ""
+        latency_ms = (time.monotonic() - job.enqueued_t) * 1000.0
+        retained = flight_recorder.record(
+            self._session.conf, kind=job.kind, outcome=outcome,
+            latency_ms=latency_ms, trace_id=job.trace_id,
+            request_id=job.request_id, queue_wait_ms=job.queue_wait_ms,
+            error=error, span=job.root_span, report=job.report)
+        if not job.abandoned and job.error is None:
+            metrics.observe("serve.latency_ms", latency_ms,
+                            exemplar=job.trace_id if retained else None)
 
     # -- request accounting (handler threads) -------------------------------
     def request_started(self) -> None:
@@ -364,6 +457,10 @@ class _Handler(socketserver.StreamRequestHandler):
         # (queries execute on pool workers, so the session's thread-local
         # cannot answer the last_run_report verb anymore).
         self._last_report = None
+        # The currently admitted job (None between requests / before
+        # admission): the error path uses it to tell "a worker owns this
+        # request's flight record" from "record it here".
+        self._cur_job = None
 
     def handle(self) -> None:
         # Pipelined: serve requests until EOF, idle timeout, or an error
@@ -397,11 +494,31 @@ class _Handler(socketserver.StreamRequestHandler):
             pool.request_finished()
 
     def _respond_one(self, line: bytes, conf) -> bool:
-        from hyperspace_tpu.telemetry import metrics
+        from hyperspace_tpu.interop.query import (
+            mint_trace_id,
+            pop_trace_context,
+        )
+        from hyperspace_tpu.telemetry import flight_recorder, metrics
 
+        t0 = time.monotonic()
+        trace_id: Optional[str] = None
+        request_id: Optional[str] = None
+        kind = "unknown"
+        is_verb = False
+        self._cur_job = None  # the admitted job, for the error path
         try:
             spec = self._parse(line)
-            if "verb" in spec:
+            # Adopt the client's trace context — or mint one for a
+            # missing/malformed id (a bad trace id must never reject the
+            # request).  Every response echoes the id, so the client can
+            # quote it to slow_queries()/the trace verb afterwards.
+            trace_id, request_id, adopted = pop_trace_context(spec)
+            if adopted:
+                metrics.inc("serve.trace.adopted")
+            else:
+                metrics.inc("serve.trace.minted")
+            is_verb = "verb" in spec
+            if is_verb:
                 # Observability verbs answer INLINE on the connection
                 # thread: they read process state, never the executor, and
                 # must keep working while the admission queue is slammed —
@@ -410,18 +527,33 @@ class _Handler(socketserver.StreamRequestHandler):
                 table = _serve_verb(self.server.session, spec,
                                     self._last_report)
             else:
-                table = self._execute_admitted(spec, conf)
+                kind = "sql" if "sql" in spec else "spec"
+                table = self._execute_admitted(spec, conf,
+                                               trace_id, request_id)
         except Exception as exc:  # -> coded wire error, connection closes
+            if trace_id is None:
+                trace_id, request_id = mint_trace_id(), mint_trace_id()
+                metrics.inc("serve.trace.minted")
             code, raw = _classify_error(exc)
             msg = str(raw).replace("\n", " ")[:500]
             metrics.inc("serve.errors")
             metrics.inc(f"serve.err.{code.lower()}")
             if code == ERR_DEADLINE:
                 metrics.inc("serve.deadline.expired")
+            if not is_verb and self._cur_job is None:
+                # Sheds and malformed requests never reach a worker, so
+                # the handler is the only place that can record them.
+                # Admitted jobs (incl. abandoned deadline expiries) are
+                # recorded by their worker, with the span tree/report.
+                flight_recorder.record(
+                    conf, kind=kind, outcome=code,
+                    latency_ms=(time.monotonic() - t0) * 1000.0,
+                    trace_id=trace_id, request_id=request_id, error=msg)
             try:
                 self.connection.settimeout(
                     float(conf.serving_send_timeout_s))
-                self.wfile.write(f"ERR {code} {msg}\n".encode("utf-8"))
+                self.wfile.write(
+                    f"ERR {code} {msg} trace={trace_id}\n".encode("utf-8"))
             except OSError:
                 pass
             return False
@@ -430,7 +562,7 @@ class _Handler(socketserver.StreamRequestHandler):
         # mid-Arrow-stream pinned its thread on a full send buffer forever.
         try:
             self.connection.settimeout(float(conf.serving_send_timeout_s))
-            self.wfile.write(b"OK\n")
+            self.wfile.write(f"OK trace={trace_id}\n".encode("utf-8"))
             with pa.ipc.new_stream(self.wfile, table.schema) as writer:
                 writer.write_table(table)
             self.wfile.flush()
@@ -458,9 +590,9 @@ class _Handler(socketserver.StreamRequestHandler):
             raise WireError(ERR_BADREQ, "request must be a JSON object")
         return spec
 
-    def _execute_admitted(self, spec: Dict[str, Any], conf) -> pa.Table:
+    def _execute_admitted(self, spec: Dict[str, Any], conf,
+                          trace_id: str, request_id: str) -> pa.Table:
         from hyperspace_tpu.exceptions import DeadlineExceededError
-        from hyperspace_tpu.telemetry import metrics
 
         deadline_ms = spec.pop("deadline_ms", None)
         if deadline_ms is None:
@@ -474,8 +606,10 @@ class _Handler(socketserver.StreamRequestHandler):
         deadline_at = None if deadline_ms is None \
             else time.monotonic() + float(deadline_ms) / 1000.0
         fn, kind = self._make_query_fn(spec)
-        job = _Job(fn, kind, deadline_at)
+        job = _Job(fn, kind, deadline_at, trace_id=trace_id,
+                   request_id=request_id)
         self.server.pool.submit(job, conf)  # raises WireError(BUSY) = shed
+        self._cur_job = job  # admitted: its worker owns the flight record
         if deadline_at is None:
             job.done.wait()
         else:
@@ -495,8 +629,6 @@ class _Handler(socketserver.StreamRequestHandler):
             raise job.error
         if job.report is not None:
             self._last_report = job.report
-        metrics.observe("serve.latency_ms",
-                        (time.monotonic() - job.enqueued_t) * 1000.0)
         return job.result
 
     def _make_query_fn(self, spec: Dict[str, Any]):
@@ -560,6 +692,22 @@ def _serve_verb(session, spec: Dict[str, Any],
                                       the session's most recent action
                                       BuildReport (session-wide: builds
                                       are serialized by the log protocol)
+      {"verb": "slow_queries"}     -> the flight recorder's retained ring
+                                      (telemetry/flight_recorder.py):
+                                      slow/error/deadline/shed requests
+                                      plus sampled healthy ones, oldest
+                                      first
+      {"verb": "trace",
+       "id": "<trace_id>"}         -> one row, column ``record_json`` —
+                                      the full retained record (span
+                                      tree, run report, outcome) of that
+                                      trace id; the id every response
+                                      echoes (``trace=``) and every
+                                      client error carries
+
+    ``slow_queries`` and ``trace`` answer inline like ``metrics`` — an
+    operator debugging an overloaded server needs exactly them while the
+    admission queue is shedding.
     """
     verb = spec["verb"]
     if not isinstance(verb, str):
@@ -605,9 +753,31 @@ def _serve_verb(session, spec: Dict[str, Any],
                              else None)
         return pa.table({"report_json": pa.array([payload],
                                                  type=pa.string())})
+    if verb == "slow_queries":
+        from hyperspace_tpu.telemetry.flight_recorder import (
+            slow_queries_table,
+        )
+
+        return slow_queries_table(session.conf)
+    if verb == "trace":
+        from hyperspace_tpu.telemetry import flight_recorder
+
+        trace_id = spec.get("id")
+        if not isinstance(trace_id, str) or not trace_id:
+            raise ValueError(
+                'the trace verb needs {"id": "<trace_id>"} — the id a '
+                'response echoed as trace=... or an error carried')
+        rec = flight_recorder.recorder().find(trace_id.lower())
+        if rec is None:
+            raise ValueError(
+                f"no retained flight record for trace id {trace_id!r} "
+                f"(healthy requests are sampled; slow/error/shed ones "
+                f"are always kept while they fit the ring)")
+        return pa.table({"record_json": pa.array(
+            [json.dumps(rec, default=str)], type=pa.string())})
     raise ValueError(f"Unknown verb {verb!r}; expected metrics, "
-                     f"last_run_report, workload, perf_history, or "
-                     f"build_report")
+                     f"last_run_report, workload, perf_history, "
+                     f"build_report, slow_queries, or trace")
 
 
 def _is_loopback(host: str) -> bool:
@@ -663,10 +833,23 @@ class QueryServer:
                     # Reject IN the accept loop — no handler thread is
                     # spawned, so a connection storm cannot grow the
                     # thread count past maxConnections + workers.
-                    from hyperspace_tpu.telemetry import metrics
+                    from hyperspace_tpu.interop.query import mint_trace_id
+                    from hyperspace_tpu.telemetry import (
+                        flight_recorder,
+                        metrics,
+                    )
 
                     metrics.inc("serve.shed")
                     metrics.inc("serve.shed.connections")
+                    # No request line was read, so there is no client
+                    # trace context to adopt — record the shed under
+                    # minted ids so the tail still shows it happened.
+                    flight_recorder.record(
+                        self.session.conf, kind="unknown",
+                        outcome=ERR_BUSY, latency_ms=0.0,
+                        trace_id=mint_trace_id(),
+                        request_id=mint_trace_id(),
+                        error="connection capacity reached")
                     try:
                         request.settimeout(1.0)
                         request.sendall(
@@ -687,6 +870,13 @@ class QueryServer:
         self._server = _Server((host, port), _Handler)
         self._server.session = session
         conf = session.conf
+        # Telemetry conf set between session construction and server
+        # start must win before the FIRST request's serve.request span
+        # opens (collect re-applies per query, but that is too late for
+        # the worker's root span).
+        from hyperspace_tpu.telemetry import trace as _trace
+
+        _trace.configure_from_conf(conf)
         self._server.pool = _WorkerPool(
             session,
             workers=int(getattr(conf, "serving_workers", 4)),
@@ -775,6 +965,14 @@ class QueryServer:
         if self._thread is not None:
             self._server.shutdown()  # stop the accept loop
         clean = self._server.pool.wait_idle(grace_s)
+        # Persist the flight recorder's ring (+ metrics snapshot +
+        # perf-ledger tail) as a diagnostics bundle AFTER in-flight
+        # requests finished — so a SIGTERM'd server leaves "what
+        # happened" readable after restart.  dump_diagnostics never
+        # raises and runs fault-quiet.
+        from hyperspace_tpu.telemetry import flight_recorder
+
+        flight_recorder.dump_diagnostics(self.session.conf)
         self._server.pool.stop()
         self._server.server_close()
         if self._thread is not None:
@@ -918,21 +1116,44 @@ class QueryClient:
     Wire errors raise :class:`QueryFailedError` (a ``RuntimeError``)
     carrying ``.code`` and ``.retryable`` — ``BUSY``/``DEADLINE`` mean
     "back off and retry on a new connection", the overload contract of
-    docs/07-interop.md."""
+    docs/07-interop.md.
+
+    Every request carries a client-minted TRACE CONTEXT (``trace_id`` /
+    ``request_id`` spec keys, 16 hex chars each) that the server adopts
+    and echoes on the status line — so a failure is correlatable from
+    either side: ``.last_trace_id`` after a call (and
+    ``QueryFailedError.trace_id`` on errors) is the handle
+    ``slow_queries()`` / the ``trace`` verb answer for."""
 
     def __init__(self, address: Tuple[str, int]) -> None:
         self._sock = socket.create_connection(address)
         self._f = self._sock.makefile("rb")
         self._broken = False
+        #: trace id of the most recent query() — server-echoed when the
+        #: server speaks the trace protocol, else the client-minted one.
+        self.last_trace_id: Optional[str] = None
 
     def query(self, spec: Dict[str, Any],
               deadline_ms: Optional[float] = None) -> pa.Table:
+        from hyperspace_tpu.interop.query import mint_trace_id
+
         if self._broken:
             raise ConnectionError(
                 "connection closed by an earlier error or timeout; open a "
                 "new QueryClient")
         if deadline_ms is not None:
             spec = {**spec, "deadline_ms": deadline_ms}
+        if isinstance(spec, dict):
+            if "trace_id" not in spec:
+                spec = {**spec, "trace_id": mint_trace_id()}
+            if "request_id" not in spec:
+                spec = {**spec, "request_id": mint_trace_id()}
+            self.last_trace_id = spec["trace_id"]
+        else:
+            # A malformed (non-object) spec still goes to the server —
+            # whose BADREQ answer, not a client-side crash, is the
+            # contract under test for such requests.
+            self.last_trace_id = None
         try:
             self._sock.sendall(json.dumps(spec).encode("utf-8") + b"\n")
             status = self._f.readline().decode("utf-8").rstrip("\n")
@@ -946,7 +1167,17 @@ class QueryClient:
                 raise ConnectionError(
                     "server closed the connection (idle timeout or "
                     "shutdown); open a new QueryClient")
-            raise parse_wire_error(status)
+            err = parse_wire_error(status)
+            if err.trace_id is None:
+                # Pre-trace server: the minted id still names the
+                # request on THIS side of the wire.
+                err.trace_id = self.last_trace_id
+            else:
+                self.last_trace_id = err.trace_id
+            raise err
+        _, echoed = _split_trace_echo(status[2:].strip())
+        if echoed is not None:
+            self.last_trace_id = echoed
         with pa.ipc.open_stream(self._f) as reader:
             return reader.read_all()
 

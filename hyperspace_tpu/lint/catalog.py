@@ -19,6 +19,7 @@ sees, not what a particular interpreter resolved.
 from __future__ import annotations
 
 import ast
+import os
 import re
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
@@ -166,6 +167,38 @@ def telemetry_catalog(ctx: LintContext):
     return metrics, spans
 
 
+_MD_LINK_RE = re.compile(r"\[([^\]]+)\]\([^)]*\)")
+
+
+def metric_help_entries() -> List[Tuple[str, str]]:
+    """RUNTIME view of the docs/16 metric catalog for the Prometheus
+    ``# HELP`` lines (``telemetry/metrics.render_prometheus``):
+    ``(name-pattern, help-text)`` pairs, parsed from the same table the
+    telemetry-catalog lint rule enforces — one registry, two consumers.
+    Reads the repo-relative docs (no :class:`LintContext` needed); an
+    installed package without ``docs/`` simply yields no entries."""
+    root = __file__
+    for _ in range(3):  # lint/catalog.py -> lint -> hyperspace_tpu -> repo
+        root = os.path.dirname(root)
+    try:
+        with open(os.path.join(root, OBS_DOC_PATH),
+                  "r", encoding="utf-8") as f:
+            text = f.read()
+    except OSError:
+        return []
+    out: List[Tuple[str, str]] = []
+    lines = text.splitlines()
+    for cell, lineno in _table_first_cells(text, "| Metric "):
+        row = lines[lineno - 1]
+        cells = [c.strip() for c in row.split("|")]
+        doc = cells[-2] if len(cells) >= 4 else ""
+        doc = _MD_LINK_RE.sub(r"\1", doc).replace("`", "")
+        doc = " ".join(doc.split())
+        for tok in _expand_cell_tokens(cell):
+            out.append((tok, doc))
+    return out
+
+
 def _segs(name: str) -> List[str]:
     return name.split(".")
 
@@ -255,6 +288,7 @@ REQUIRED_BENCH_SPANS = (
     "build.phase.spill_finish",
     "bench.serving",
     "serve.request",
+    "bench.flight_recorder",
 )
 
 

@@ -48,7 +48,7 @@ _BUCKETS = (1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
 
 
 class _Histogram:
-    __slots__ = ("count", "sum", "min", "max", "buckets")
+    __slots__ = ("count", "sum", "min", "max", "buckets", "exemplars")
 
     def __init__(self) -> None:
         self.count = 0
@@ -56,8 +56,13 @@ class _Histogram:
         self.min: Optional[float] = None
         self.max: Optional[float] = None
         self.buckets = [0] * len(_BUCKETS)
+        # Per-bucket exemplar: (trace_id, value) of the most recent
+        # RETAINED observation landing in that bucket — the link from a
+        # p99 bucket to a flight-recorder trace id (docs/16).
+        self.exemplars: Dict[int, tuple] = {}
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float,
+                exemplar: Optional[str] = None) -> None:
         self.count += 1
         self.sum += value
         self.min = value if self.min is None else min(self.min, value)
@@ -65,6 +70,8 @@ class _Histogram:
         for i, bound in enumerate(_BUCKETS):
             if value <= bound:
                 self.buckets[i] += 1
+                if exemplar:
+                    self.exemplars[i] = (exemplar, value)
                 break
 
     def snapshot(self) -> Dict[str, object]:
@@ -103,15 +110,19 @@ class MetricsRegistry:
             if name in self._gauges or self._room():
                 self._gauges[name] = float(value)
 
-    def observe(self, name: str, value: float) -> None:
-        """Record one observation into histogram ``name``."""
+    def observe(self, name: str, value: float,
+                exemplar: Optional[str] = None) -> None:
+        """Record one observation into histogram ``name``.  ``exemplar``
+        (a flight-recorder trace id) is remembered per bucket and
+        rendered in the text exposition, linking a latency bucket to the
+        retained trace that landed there."""
         with self._lock:
             h = self._histograms.get(name)
             if h is None:
                 if not self._room():
                     return
                 h = self._histograms[name] = _Histogram()
-            h.observe(float(value))
+            h.observe(float(value), exemplar)
 
     def counter(self, name: str) -> float:
         with self._lock:
@@ -141,29 +152,79 @@ class MetricsRegistry:
 
     def render_prometheus(self) -> str:
         """Prometheus text exposition (names dotted→underscored, histograms
-        as ``_bucket``/``_sum``/``_count`` series with ``le`` labels)."""
+        as ``_bucket``/``_sum``/``_count`` series with ``le`` labels).
+        ``# HELP`` lines come from the docs/16 metric catalog — parsed by
+        the lint registry (the same single source the telemetry-catalog
+        rule enforces), so the exposition and the docs cannot drift.
+        Histogram buckets carry OpenMetrics-style exemplars linking them
+        to retained flight-recorder trace ids."""
         def prom(name: str) -> str:
             return "hyperspace_" + name.replace(".", "_").replace("-", "_")
 
+        help_for = _catalog_help()
         lines: List[str] = []
+
+        def head(name: str, kind: str) -> None:
+            doc = help_for(name)
+            if doc:
+                lines.append(f"# HELP {prom(name)} {doc}")
+            lines.append(f"# TYPE {prom(name)} {kind}")
+
         with self._lock:
             for name, v in sorted(self._counters.items()):
-                lines.append(f"# TYPE {prom(name)} counter")
+                head(name, "counter")
                 lines.append(f"{prom(name)} {v:g}")
             for name, v in sorted(self._gauges.items()):
-                lines.append(f"# TYPE {prom(name)} gauge")
+                head(name, "gauge")
                 lines.append(f"{prom(name)} {v:g}")
             for name, h in sorted(self._histograms.items()):
-                lines.append(f"# TYPE {prom(name)} histogram")
+                head(name, "histogram")
                 cumulative = 0
-                for bound, n in zip(_BUCKETS, h.buckets):
+                for i, (bound, n) in enumerate(zip(_BUCKETS, h.buckets)):
                     cumulative += n
                     le = "+Inf" if bound == float("inf") else f"{bound:g}"
-                    lines.append(
-                        f'{prom(name)}_bucket{{le="{le}"}} {cumulative}')
+                    line = f'{prom(name)}_bucket{{le="{le}"}} {cumulative}'
+                    ex = h.exemplars.get(i)
+                    if ex is not None:
+                        line += (f' # {{trace_id="{ex[0]}"}} '
+                                 f'{ex[1]:g}')
+                    lines.append(line)
                 lines.append(f"{prom(name)}_sum {h.sum:g}")
                 lines.append(f"{prom(name)}_count {h.count}")
         return "\n".join(lines) + ("\n" if lines else "")
+
+
+# Lazily loaded docs/16 catalog help (name-pattern -> text), shared by
+# every render.  The lint parser reads the checked-out docs; an installed
+# package without docs/ renders without HELP lines, never fails.
+_HELP_ENTRIES = None
+
+
+def _catalog_help():
+    """A ``name -> help-or-None`` lookup over the docs/16 metric catalog
+    (placeholder rows like ``rule.<slug>.applied`` match concrete
+    names)."""
+    global _HELP_ENTRIES
+    if _HELP_ENTRIES is None:
+        try:
+            from hyperspace_tpu.lint.catalog import metric_help_entries
+
+            _HELP_ENTRIES = metric_help_entries()
+        except Exception:  # noqa: BLE001 — docs absent: no HELP lines
+            _HELP_ENTRIES = []
+
+    def lookup(name: str) -> Optional[str]:
+        try:
+            from hyperspace_tpu.lint.catalog import name_matches_entry
+
+            for entry, doc in _HELP_ENTRIES:
+                if name_matches_entry(name, entry):
+                    return doc
+        except Exception:  # noqa: BLE001
+            pass
+        return None
+
+    return lookup
 
 
 # One registry per process: the subsystems it observes (device cache, IO
@@ -183,8 +244,8 @@ def set_gauge(name: str, value: float) -> None:
     _REGISTRY.set_gauge(name, value)
 
 
-def observe(name: str, value: float) -> None:
-    _REGISTRY.observe(name, value)
+def observe(name: str, value: float, exemplar: Optional[str] = None) -> None:
+    _REGISTRY.observe(name, value, exemplar)
 
 
 def snapshot() -> Dict[str, object]:

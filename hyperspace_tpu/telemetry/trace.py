@@ -32,9 +32,10 @@ from __future__ import annotations
 import contextlib
 import contextvars
 import json
+import os
 import threading
 import time
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 _enabled = False  # module-global: the whole disabled-path cost is this bool
 
@@ -157,6 +158,34 @@ def current_span():
     return cur if cur is not None else NOOP_SPAN
 
 
+# -- request (trace) context -------------------------------------------------
+# The wire-propagated trace identity of the request currently executing on
+# this context (interop/server.py sets it on the worker around the job):
+# a (trace_id, request_id) pair.  Orthogonal to span nesting — it exists
+# even when span tracing is disabled, so the flight recorder
+# (telemetry/flight_recorder.py) can correlate records to client-side ids
+# without paying the tracing cost, and so Dataset.collect can tell a
+# SERVED query (the handler records it) from a local one.
+_request_ctx: "contextvars.ContextVar[Optional[Tuple[str, str]]]" = \
+    contextvars.ContextVar("hyperspace_request_ctx", default=None)
+
+
+@contextlib.contextmanager
+def request_scope(trace_id: str, request_id: str) -> Iterator[None]:
+    """Run the with-block under the given wire trace context."""
+    token = _request_ctx.set((trace_id, request_id))
+    try:
+        yield
+    finally:
+        _request_ctx.reset(token)
+
+
+def current_request_context() -> Optional[Tuple[str, str]]:
+    """(trace_id, request_id) of the served request this context is
+    executing, or None outside the serving path."""
+    return _request_ctx.get()
+
+
 def tracing_enabled() -> bool:
     return _enabled
 
@@ -202,20 +231,45 @@ class CollectingTraceSink(TraceSink):
 class JsonlTraceSink(TraceSink):
     """One JSON object per finished root span, appended to ``path`` — the
     machine-readable artifact bench.py and production runs leave behind
-    (conf ``hyperspace.system.telemetry.trace.sink``)."""
+    (conf ``hyperspace.system.telemetry.trace.sink``).
 
-    def __init__(self, path: str) -> None:
+    Bounded by size-based rotation (conf
+    ``hyperspace.system.telemetry.trace.maxBytes``; 0 = unbounded): once
+    the sink file would grow past ``max_bytes`` it is rotated to
+    ``<path>.1`` (replacing the previous rotation) and a fresh file
+    starts — a long-lived traced server keeps at most ~2x ``max_bytes``
+    of trace on disk instead of growing without limit."""
+
+    def __init__(self, path: str, max_bytes: int = 0) -> None:
         self.path = path
+        self.max_bytes = int(max_bytes)
         self._lock = threading.Lock()
 
     def emit(self, root: Span) -> None:
         line = json.dumps(root.to_dict(), default=str)
         try:
-            # hslint: allow[io-seam] user-chosen trace sink, not index data
-            with self._lock, open(self.path, "a", encoding="utf-8") as f:
-                f.write(line + "\n")
+            with self._lock:
+                self._rotate_if_needed(len(line) + 1)
+                # hslint: allow[io-seam] user-chosen trace sink, not index data
+                with open(self.path, "a", encoding="utf-8") as f:
+                    f.write(line + "\n")
         except OSError:
             pass  # a full disk must never fail the traced query
+
+    def _rotate_if_needed(self, incoming: int) -> None:
+        if self.max_bytes <= 0:
+            return
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return  # no file yet
+        if size + incoming <= self.max_bytes:
+            return
+        try:
+            # hslint: allow[io-seam] trace-sink rotation, not index data
+            os.replace(self.path, self.path + ".1")
+        except OSError:
+            pass  # rotation is best-effort; appends keep working
 
 
 _sinks: List[TraceSink] = []
@@ -261,12 +315,16 @@ def configure_from_conf(conf) -> None:
         enable_tracing()
     path = getattr(conf, "telemetry_trace_sink", "")
     if path:
+        max_bytes = int(getattr(conf, "telemetry_trace_max_bytes", 0))
         with _sinks_lock:
             # Check+append under one lock hold: this runs per query, and
             # two concurrent first-queries must not double-install.
-            if not any(isinstance(s, JsonlTraceSink) and s.path == path
-                       for s in _sinks):
-                _sinks.append(JsonlTraceSink(path))
+            for s in _sinks:
+                if isinstance(s, JsonlTraceSink) and s.path == path:
+                    s.max_bytes = max_bytes  # conf.set after install wins
+                    break
+            else:
+                _sinks.append(JsonlTraceSink(path, max_bytes=max_bytes))
 
 
 # -- the XLA zoom level -----------------------------------------------------

@@ -3,10 +3,13 @@
 // the reference ships as a .NET sample
 // (/root/reference/examples/csharp/HyperspaceApp/Program.cs analog).
 //
-// Protocol: one JSON request line out, "OK\n" + Arrow IPC stream back
-// (or "ERR <message>\n").  The client half-closes its write side after
-// the request so it can read to EOF, then decodes the stream with the
-// Arrow C++ library and prints, for the harness to check:
+// Protocol: one JSON request line out, "OK trace=<id>\n" + Arrow IPC
+// stream back (or "ERR <CODE> <message> trace=<id>\n").  A minimal
+// client matches the status line on its OK/ERR prefix — the trailing
+// trace-id echo (docs/07-interop.md) is advisory, not framing.  The
+// client half-closes its write side after the request so it can read to
+// EOF, then decodes the stream with the Arrow C++ library and prints,
+// for the harness to check:
 //
 //   rows <n>
 //   cols <name> <name> ...
@@ -99,7 +102,8 @@ int main(int argc, char** argv) {
   if (nl == reply.end()) return Fail("no status line in reply");
   std::string status(reply.begin(), nl);
   if (status.rfind("ERR", 0) == 0) return Fail("server error: " + status);
-  if (status != "OK") return Fail("unexpected status: " + status);
+  if (status.rfind("OK", 0) != 0)
+    return Fail("unexpected status: " + status);
 
   size_t body_off = static_cast<size_t>(nl - reply.begin()) + 1;
   auto buffer = std::make_shared<arrow::Buffer>(

@@ -78,10 +78,11 @@ def _reset_telemetry():
     Metrics are NOT reset here — the registry is additive by design and
     tests assert deltas or reset explicitly."""
     yield
-    from hyperspace_tpu.telemetry import trace
+    from hyperspace_tpu.telemetry import flight_recorder, trace
 
     trace.disable_tracing()
     trace.clear_sinks()
+    flight_recorder.reset()  # the request ring is process-global too
 
 
 @pytest.fixture()

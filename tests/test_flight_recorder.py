@@ -1,0 +1,687 @@
+"""Distributed request tracing + the flight recorder
+(telemetry/flight_recorder.py, interop trace context; docs/16, docs/07).
+
+The contract under test: a trace id minted on the CLIENT names the
+request end to end — the server adopts it (malformed ids are replaced,
+never rejected), every response echoes it, the flight recorder keeps the
+interesting tail under it (slow/error/deadline/shed always, healthy
+sampled, ring bounded with healthy evicted first), and a drain persists
+the ring as a diagnostics bundle readable after restart over BOTH
+LogStore backends."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import Hyperspace, HyperspaceSession, col
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.interop.query import (
+    mint_trace_id,
+    pop_trace_context,
+    valid_trace_id,
+)
+from hyperspace_tpu.interop.server import (
+    QueryClient,
+    QueryFailedError,
+    QueryServer,
+    parse_wire_error,
+)
+from hyperspace_tpu.telemetry import flight_recorder, metrics, trace
+from hyperspace_tpu.telemetry.flight_recorder import FlightRecorder
+
+BOTH_STORES = ("hyperspace_tpu.io.log_store.PosixLogStore",
+               "hyperspace_tpu.io.log_store.EmulatedObjectStore")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ring():
+    flight_recorder.reset()
+    yield
+    flight_recorder.reset()
+
+
+@pytest.fixture(scope="module")
+def big_dir(tmp_path_factory):
+    """A table big enough that a group-by takes real wall time — the
+    deadline of the end-to-end demo must expire SERVER-SIDE, mid-query."""
+    d = str(tmp_path_factory.mktemp("flight") / "big")
+    os.makedirs(d)
+    rng = np.random.default_rng(13)
+    n = 4_000_000
+    pq.write_table(pa.table({
+        "g": pa.array(rng.integers(0, 1_000_000, n), type=pa.int64()),
+        "x": pa.array(rng.random(n)),
+    }), os.path.join(d, "p.parquet"))
+    return d
+
+
+@pytest.fixture()
+def env(tmp_path):
+    data = str(tmp_path / "data")
+    os.makedirs(data)
+    n = 1000
+    pq.write_table(pa.table({
+        "k": pa.array(np.arange(n, dtype=np.int64)),
+        "v": pa.array((np.arange(n) % 5).astype(np.int64)),
+    }), os.path.join(data, "f.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    s.conf.num_buckets = 4
+    return s, data
+
+
+def _point_spec(data, k):
+    return {"source": {"format": "parquet", "path": data},
+            "filter": {"op": "==", "col": "k", "value": int(k)},
+            "select": ["k", "v"]}
+
+
+def _slow_spec(big_dir):
+    return {"source": {"format": "parquet", "path": big_dir},
+            "group_by": ["g"], "aggs": {"t": ["x", "sum"]},
+            "sort": [["t", False]], "limit": 5}
+
+
+def _wait_for_record(trace_id, timeout_s=30.0):
+    deadline_at = time.monotonic() + timeout_s
+    while time.monotonic() < deadline_at:
+        rec = flight_recorder.recorder().find(trace_id)
+        if rec is not None:
+            return rec
+        time.sleep(0.02)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Trace-context parsing: malformed ids must never reject a request
+# ---------------------------------------------------------------------------
+class TestTraceContextParsing:
+    def test_mint_shape(self):
+        tid = mint_trace_id()
+        assert valid_trace_id(tid)
+        assert len(tid) == 16
+        assert mint_trace_id() != tid  # 8 random bytes, not a counter
+
+    def test_valid_ids_adopted_and_popped(self):
+        spec = {"trace_id": "00ff00ff00ff00ff",
+                "request_id": "1234567890abcdef", "sql": "x"}
+        tid, rid, adopted = pop_trace_context(spec)
+        assert adopted
+        assert tid == "00ff00ff00ff00ff" and rid == "1234567890abcdef"
+        assert "trace_id" not in spec and "request_id" not in spec
+
+    def test_uppercase_normalizes(self):
+        tid, _rid, adopted = pop_trace_context(
+            {"trace_id": "00FF00FF00FF00FF"})
+        assert adopted and tid == "00ff00ff00ff00ff"
+
+    @pytest.mark.parametrize("bad", [
+        "short",                      # wrong length (too short)
+        "00ff00ff00ff00ff00",         # wrong length (too long)
+        "zzzzzzzzzzzzzzzz",           # non-hex, right length
+        "00ff00ff00ff00f ",           # embedded space
+        "",                           # empty string
+        1234567890123456,             # not a string
+        12.5,
+        None,
+        True,
+        ["00ff00ff00ff00ff"],         # list-wrapped
+        {"id": "00ff00ff00ff00ff"},   # dict-wrapped
+    ])
+    def test_malformed_ids_fall_back_to_minted(self, bad):
+        spec = {"trace_id": bad, "request_id": bad, "source": {}}
+        tid, rid, adopted = pop_trace_context(spec)
+        assert not adopted
+        assert valid_trace_id(tid) and valid_trace_id(rid)
+        assert "trace_id" not in spec and "request_id" not in spec
+
+    def test_missing_ids_minted_independently(self):
+        tid, rid, adopted = pop_trace_context({})
+        assert not adopted and valid_trace_id(tid) and valid_trace_id(rid)
+        # valid trace_id + garbage request_id: trace adopted, request
+        # minted — the fields degrade independently.
+        tid2, rid2, adopted2 = pop_trace_context(
+            {"trace_id": "a" * 16, "request_id": "nope"})
+        assert adopted2 and tid2 == "a" * 16 and valid_trace_id(rid2)
+
+    def test_malformed_id_never_rejects_the_request(self, env):
+        """End to end: a garbage trace_id still answers OK, under a
+        server-minted id."""
+        s, data = env
+        with QueryServer(s) as server:
+            with QueryClient(server.address) as qc:
+                out = qc.query({**_point_spec(data, 3),
+                                "trace_id": "!!not-hex-at-all!!",
+                                "request_id": 42})
+                assert out.num_rows == 1
+                assert valid_trace_id(qc.last_trace_id)
+                assert qc.last_trace_id != "!!not-hex-at-all!!"
+
+
+# ---------------------------------------------------------------------------
+# Wire echo + parse_wire_error
+# ---------------------------------------------------------------------------
+class TestWireEcho:
+    def test_ok_echoes_adopted_id(self, env):
+        s, data = env
+        tid = mint_trace_id()
+        with QueryServer(s) as server:
+            with QueryClient(server.address) as qc:
+                qc.query({**_point_spec(data, 1), "trace_id": tid})
+                assert qc.last_trace_id == tid
+
+    def test_error_carries_trace_id(self, env):
+        s, data = env
+        spec = {"source": {"format": "parquet", "path": data},
+                "filter": {"op": "==", "col": "no_such", "value": 1}}
+        with QueryServer(s) as server:
+            with QueryClient(server.address) as qc:
+                with pytest.raises(QueryFailedError) as ei:
+                    qc.query(spec)
+        assert ei.value.code == "FAILED"
+        assert valid_trace_id(ei.value.trace_id)
+        assert ei.value.trace_id == qc.last_trace_id
+        # The echo is a trailing token, not part of the message.
+        assert "trace=" not in ei.value.message
+
+    def test_parse_wire_error_trace_forms(self):
+        e = parse_wire_error("ERR BUSY queue full trace=00ff00ff00ff00ff")
+        assert e.code == "BUSY" and e.trace_id == "00ff00ff00ff00ff"
+        assert e.message == "queue full"
+        # Pre-trace server: no token, trace_id None — old wire accepted.
+        e = parse_wire_error("ERR BUSY queue full")
+        assert e.code == "BUSY" and e.trace_id is None
+        # Bare pre-taxonomy form with an echo still parses.
+        e = parse_wire_error("ERR something broke trace=aaaaaaaaaaaaaaaa")
+        assert e.code == "FAILED" and e.trace_id == "a" * 16
+        assert e.message == "something broke"
+        # A message that merely CONTAINS trace= mid-sentence is left alone.
+        e = parse_wire_error("ERR FAILED trace=zz is not an id")
+        assert e.trace_id is None and "trace=zz" in e.message
+
+    def test_badreq_on_unparseable_line_still_echoes(self, env):
+        """Even a request that fails JSON parsing gets a (server-minted)
+        trace id on its ERR line."""
+        import socket as socketlib
+
+        s, _data = env
+        with QueryServer(s) as server:
+            with socketlib.create_connection(server.address) as sock:
+                sock.sendall(b"this is not json\n")
+                line = sock.makefile("rb").readline().decode()
+        assert line.startswith("ERR BADREQ")
+        err = parse_wire_error(line.rstrip("\n"))
+        assert valid_trace_id(err.trace_id)
+
+
+# ---------------------------------------------------------------------------
+# Retention policy
+# ---------------------------------------------------------------------------
+def _conf(**over):
+    c = HyperspaceConf()
+    for k, v in over.items():
+        setattr(c, k, v)
+    return c
+
+
+def _rec(recorder, conf, outcome, latency_ms=1.0, tid=None):
+    return recorder.record(
+        conf, kind="spec", outcome=outcome, latency_ms=latency_ms,
+        trace_id=tid or mint_trace_id(), request_id=mint_trace_id())
+
+
+class TestRetention:
+    def test_interesting_outcomes_always_retained(self):
+        r = FlightRecorder()
+        conf = _conf(flight_recorder_healthy_sample_n=0)
+        for outcome in ("FAILED", "DEADLINE", "BUSY", "BADREQ",
+                        "error", "degraded"):
+            assert _rec(r, conf, outcome)
+        assert not _rec(r, conf, "OK")  # healthy, sampling off
+        assert {x["outcome"] for x in r.records()} == {
+            "FAILED", "DEADLINE", "BUSY", "BADREQ", "error", "degraded"}
+        assert all(x["reason"] == "error" for x in r.records())
+
+    def test_slow_threshold_retains(self):
+        r = FlightRecorder()
+        conf = _conf(flight_recorder_slow_ms=50.0,
+                     flight_recorder_healthy_sample_n=0)
+        assert not _rec(r, conf, "OK", latency_ms=49.0)
+        assert _rec(r, conf, "OK", latency_ms=51.0)
+        (rec,) = r.records()
+        assert rec["slow"] and rec["reason"] == "slow"
+
+    def test_healthy_sampling_one_in_n(self):
+        r = FlightRecorder()
+        conf = _conf(flight_recorder_healthy_sample_n=4)
+        kept = sum(_rec(r, conf, "OK") for _ in range(16))
+        assert kept == 4
+
+    def test_disabled_keeps_nothing(self):
+        r = FlightRecorder()
+        conf = _conf(flight_recorder_enabled=False)
+        assert not _rec(r, conf, "FAILED")
+        assert r.records() == []
+
+    def test_healthy_evicted_before_interesting(self):
+        r = FlightRecorder()
+        conf = _conf(flight_recorder_max_records=16,
+                     flight_recorder_healthy_sample_n=1)
+        for _ in range(12):
+            assert _rec(r, conf, "OK")
+        error_ids = [mint_trace_id() for _ in range(8)]
+        for tid in error_ids:
+            assert _rec(r, conf, "DEADLINE", tid=tid)
+        recs = r.records()
+        assert len(recs) == 16
+        kept = {x["trace_id"] for x in recs}
+        assert set(error_ids) <= kept  # every DEADLINE survived
+        assert sum(1 for x in recs if x["outcome"] == "OK") == 8
+
+    def test_ring_bound_under_threaded_storm(self):
+        """8 threads hammer mixed outcomes: the bound holds at every
+        point, nothing raises, and the survivors are the interesting
+        tail (healthy evicted first)."""
+        r = FlightRecorder()
+        conf = _conf(flight_recorder_max_records=32,
+                     flight_recorder_healthy_sample_n=1)
+        errors: list = []
+
+        def storm(seed: int) -> None:
+            try:
+                for i in range(200):
+                    outcome = ("FAILED", "DEADLINE", "BUSY", "OK")[
+                        (seed + i) % 4]
+                    _rec(r, conf, outcome, latency_ms=float(i % 7))
+                    if i % 50 == 0:
+                        assert len(r.records()) <= 32
+            except Exception as e:  # noqa: BLE001 — reported below
+                errors.append(e)
+
+        threads = [threading.Thread(target=storm, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors
+        recs = r.records()
+        assert len(recs) == 32
+        # 1200 interesting offers vs 400 healthy: the ring's tail end
+        # state is all-interesting, healthy records were evicted first.
+        assert all(x["outcome"] != "OK" for x in recs)
+
+    def test_record_never_raises_on_broken_input(self):
+        """A report object whose to_dict() explodes must not fail the
+        request being recorded."""
+        r = FlightRecorder()
+
+        class Broken:
+            decisions = ()
+
+            def to_dict(self):
+                raise RuntimeError("boom")
+
+        assert not r.record(_conf(), kind="spec", outcome="FAILED",
+                            latency_ms=1.0, trace_id=mint_trace_id(),
+                            request_id=mint_trace_id(), report=Broken())
+        assert r.records() == []
+
+
+# ---------------------------------------------------------------------------
+# Local collect feed + slow_queries()
+# ---------------------------------------------------------------------------
+class TestLocalFeed:
+    def test_slow_local_query_lands_in_slow_queries(self, env):
+        s, data = env
+        s.conf.flight_recorder_slow_ms = 0.0001  # everything is "slow"
+        hs = Hyperspace(s)
+        s.read.parquet(data).filter(col("k") == 5).collect()
+        t = hs.slow_queries()
+        assert t.num_rows == 1
+        assert t.column("kind")[0].as_py() == "local"
+        assert t.column("outcome")[0].as_py() == "ok"
+        tid = t.column("traceId")[0].as_py()
+        assert valid_trace_id(tid)
+        assert hs.trace(tid)["trace_id"] == tid
+
+    def test_failed_local_query_retained_with_error_outcome(self, env):
+        s, data = env
+        hs = Hyperspace(s)
+        with pytest.raises(Exception):
+            s.read.parquet(data).filter(col("nope") == 1).collect()
+        t = hs.slow_queries()
+        assert t.num_rows == 1
+        assert t.column("outcome")[0].as_py() == "error"
+
+    def test_request_scope_suppresses_local_feed(self, env):
+        """Inside a serve request scope the HANDLER records; collect must
+        not double-record."""
+        s, data = env
+        s.conf.flight_recorder_slow_ms = 0.0001
+        with trace.request_scope(mint_trace_id(), mint_trace_id()):
+            s.read.parquet(data).filter(col("k") == 5).collect()
+        assert flight_recorder.recorder().records() == []
+
+
+# ---------------------------------------------------------------------------
+# Metrics surfacing: HELP lines + exemplars
+# ---------------------------------------------------------------------------
+class TestMetricsSurfacing:
+    def test_help_lines_from_docs16_catalog(self):
+        reg = metrics.MetricsRegistry()
+        reg.inc("serve.requests")
+        reg.inc("rule.filter.applied")  # placeholder row <slug>
+        text = reg.render_prometheus()
+        assert "# HELP hyperspace_serve_requests " in text
+        assert "# HELP hyperspace_rule_filter_applied " in text
+        assert "# TYPE hyperspace_serve_requests counter" in text
+        # An uncataloged name renders without HELP, never fails.
+        reg.inc("not.in.catalog")
+        assert "# HELP hyperspace_not_in_catalog" \
+            not in reg.render_prometheus()
+
+    def test_exemplar_links_bucket_to_trace_id(self):
+        reg = metrics.MetricsRegistry()
+        tid = mint_trace_id()
+        reg.observe("serve.latency_ms", 12.0, exemplar=tid)
+        reg.observe("serve.latency_ms", 700.0)  # no exemplar
+        text = reg.render_prometheus()
+        assert f'# {{trace_id="{tid}"}} 12' in text
+        # Only the bucket the exemplar landed in carries it.
+        assert text.count("trace_id=") == 1
+        # Snapshot shape is unchanged (no exemplar leakage).
+        snap = reg.snapshot()["serve.latency_ms"]
+        assert set(snap) == {"count", "sum", "min", "max", "mean",
+                             "buckets"}
+
+    def test_served_slow_request_exemplar_in_metrics_text(self, env):
+        s, data = env
+        s.conf.flight_recorder_slow_ms = 0.0001
+        metrics.reset()
+        hs = Hyperspace(s)
+        with QueryServer(s) as server:
+            with QueryClient(server.address) as qc:
+                qc.query(_point_spec(data, 2))
+                tid = qc.last_trace_id
+                rec = _wait_for_record(tid)
+        assert rec is not None
+        assert f'trace_id="{tid}"' in hs.metrics_text()
+
+
+# ---------------------------------------------------------------------------
+# Trace-sink rotation
+# ---------------------------------------------------------------------------
+class TestSinkRotation:
+    def test_rotation_bounds_the_sink_file(self, tmp_path):
+        path = str(tmp_path / "trace.jsonl")
+        sink = trace.JsonlTraceSink(path, max_bytes=400)
+        for i in range(50):
+            sp = trace.Span(f"span.{i:03d}.{'x' * 40}", {})
+            sink.emit(sp)
+        assert os.path.exists(path)
+        assert os.path.exists(path + ".1")
+        # Current file stays inside the bound (+ one line of slack).
+        assert os.path.getsize(path) <= 400 + 120
+        # Rotation replaced, not accumulated: no .2 and the total on
+        # disk is ~2x the bound, not 50 lines.
+        assert not os.path.exists(path + ".2")
+
+    def test_unbounded_never_rotates(self, tmp_path):
+        path = str(tmp_path / "trace.jsonl")
+        sink = trace.JsonlTraceSink(path, max_bytes=0)
+        for _ in range(20):
+            sink.emit(trace.Span("s" * 60, {}))
+        assert not os.path.exists(path + ".1")
+
+    def test_conf_installs_and_updates_max_bytes(self, tmp_path):
+        conf = _conf(telemetry_trace_sink=str(tmp_path / "t.jsonl"),
+                     telemetry_trace_max_bytes=123)
+        trace.configure_from_conf(conf)
+        try:
+            sinks = [x for x in trace._sinks
+                     if isinstance(x, trace.JsonlTraceSink)]
+            assert len(sinks) == 1 and sinks[0].max_bytes == 123
+            conf.telemetry_trace_max_bytes = 456
+            trace.configure_from_conf(conf)  # idempotent, updates bound
+            sinks2 = [x for x in trace._sinks
+                      if isinstance(x, trace.JsonlTraceSink)]
+            assert sinks2 == sinks and sinks[0].max_bytes == 456
+        finally:
+            trace.clear_sinks()
+
+
+# ---------------------------------------------------------------------------
+# Diagnostics bundles: both backends, restart, bounds, fault isolation
+# ---------------------------------------------------------------------------
+class TestBundles:
+    @pytest.mark.parametrize("store_cls", BOTH_STORES)
+    def test_bundle_survives_restart_over_backend(self, tmp_path,
+                                                  store_cls):
+        s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+        s.conf.log_store_class = store_cls
+        tid = mint_trace_id()
+        assert flight_recorder.record(
+            s.conf, kind="spec", outcome="DEADLINE", latency_ms=42.0,
+            trace_id=tid, request_id=mint_trace_id(),
+            error="deadline expired")
+        key = flight_recorder.dump_diagnostics(s.conf)
+        assert key is not None
+        # "Restart": a fresh session + conf over the same system path,
+        # and a wiped in-memory ring — only the store can answer now.
+        flight_recorder.reset()
+        s2 = HyperspaceSession(system_path=str(tmp_path / "ix"))
+        s2.conf.log_store_class = store_cls
+        got = Hyperspace(s2).diagnostics_bundles()
+        assert [b["key"] for b in got] == [key]
+        bundle = got[0]
+        assert bundle["v"] == flight_recorder.BUNDLE_VERSION
+        recs = [r for r in bundle["records"] if r["trace_id"] == tid]
+        assert recs and recs[0]["outcome"] == "DEADLINE"
+        assert "metrics" in bundle and "perf_tail" in bundle
+
+    @pytest.mark.parametrize("store_cls", BOTH_STORES)
+    def test_bundles_bounded_oldest_pruned(self, tmp_path, store_cls):
+        s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+        s.conf.log_store_class = store_cls
+        s.conf.flight_recorder_max_bundles = 2
+        keys = [flight_recorder.dump_diagnostics(s.conf)
+                for _ in range(4)]
+        assert all(keys)
+        got = flight_recorder.bundles(s.conf)
+        assert [b["key"] for b in got] == sorted(keys)[-2:]
+
+    def test_dump_never_consumes_fault_budget(self, tmp_path):
+        """Diagnostics IO must be invisible to an armed fault plan
+        (faults.quiet): the dump succeeds AND the counter stays."""
+        from hyperspace_tpu.io import faults
+
+        s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+        plan = faults.FaultPlan(site="store.put", kind="eio", at=1,
+                                count=1)
+        faults.install(plan)
+        try:
+            assert flight_recorder.dump_diagnostics(s.conf) is not None
+            assert plan._calls == 0
+        finally:
+            faults.clear()
+
+    def test_dump_failure_swallowed(self, tmp_path):
+        """An unwritable store must cost nothing but a counter."""
+        s = HyperspaceSession(system_path="/proc/definitely/not/writable")
+        err0 = metrics.registry().counter("flight.dump.errors")
+        assert flight_recorder.dump_diagnostics(s.conf) is None
+        assert metrics.registry().counter("flight.dump.errors") > err0
+
+    def test_disabled_recorder_skips_dump(self, tmp_path):
+        s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+        s.conf.flight_recorder_enabled = False
+        assert flight_recorder.dump_diagnostics(s.conf) is None
+
+    def test_index_listing_ignores_diagnostics_dir(self, env):
+        s, data = env
+        from hyperspace_tpu import IndexConfig
+
+        hs = Hyperspace(s)
+        hs.create_index(s.read.parquet(data),
+                        IndexConfig("ix", ["k"], ["v"]))
+        assert flight_recorder.dump_diagnostics(s.conf) is not None
+        assert os.path.isdir(os.path.join(s.conf.system_path,
+                                          flight_recorder.FLIGHT_DIR))
+        assert hs.indexes().num_rows == 1  # underscore dir skipped
+
+
+# ---------------------------------------------------------------------------
+# The new verbs
+# ---------------------------------------------------------------------------
+class TestVerbs:
+    def test_slow_queries_verb_matches_api(self, env):
+        s, data = env
+        s.conf.flight_recorder_slow_ms = 0.0001
+        with QueryServer(s) as server:
+            with QueryClient(server.address) as qc:
+                qc.query(_point_spec(data, 7))
+                tid = qc.last_trace_id
+                assert _wait_for_record(tid) is not None
+                t = qc.query({"verb": "slow_queries"})
+        assert tid in t.column("traceId").to_pylist()
+        assert "recordJson" in t.column_names
+
+    def test_trace_verb_unknown_id_is_badreq(self, env):
+        s, _data = env
+        with QueryServer(s) as server:
+            with QueryClient(server.address) as qc:
+                with pytest.raises(QueryFailedError,
+                                   match="no retained") as ei:
+                    qc.query({"verb": "trace", "id": "f" * 16})
+            assert ei.value.code == "BADREQ"
+            with QueryClient(server.address) as qc:
+                with pytest.raises(QueryFailedError, match="needs"):
+                    qc.query({"verb": "trace"})
+
+    def test_shed_request_recorded(self, env, big_dir):
+        """A queue-full shed never reaches a worker — the handler's
+        record still lands, outcome BUSY, under the client's trace id."""
+        from hyperspace_tpu.interop.server import ServerBusyError
+
+        s, _data = env
+        s.conf.serving_workers = 1
+        s.conf.serving_queue_depth = 1
+        with QueryServer(s) as server:
+            clients = [QueryClient(server.address) for _ in range(8)]
+            try:
+                busy_ids: list = []
+
+                def run(c):
+                    try:
+                        c.query(_slow_spec(big_dir))
+                    except ServerBusyError:
+                        busy_ids.append(c.last_trace_id)
+                    except Exception:  # noqa: BLE001 — not the point here
+                        pass
+
+                threads = [threading.Thread(target=run, args=(c,))
+                           for c in clients]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=120)
+                assert busy_ids, "8 clients vs 1 worker shed nothing"
+                rec = _wait_for_record(busy_ids[0])
+                assert rec is not None and rec["outcome"] == "BUSY"
+            finally:
+                for c in clients:
+                    c.close()
+
+
+# ---------------------------------------------------------------------------
+# The end-to-end demo (ISSUE 9 acceptance)
+# ---------------------------------------------------------------------------
+class TestEndToEndDemo:
+    def test_deadline_trace_record_survives_restart(self, tmp_path,
+                                                    big_dir):
+        """Client sends a query whose deadline expires server-side →
+        the client error carries the trace id → slow_queries()/the trace
+        verb return the full record (serve.request → query.collect span
+        tree, run report, DEADLINE outcome) → after drain (the SIGTERM
+        path) + restart the same record is readable from the persisted
+        diagnostics bundle."""
+        s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+        s.conf.telemetry_tracing_enabled = True
+        hs = Hyperspace(s)
+        server = QueryServer(s).start()
+        try:
+            with QueryClient(server.address) as qc:
+                with pytest.raises(QueryFailedError) as ei:
+                    qc.query(_slow_spec(big_dir), deadline_ms=40)
+            assert ei.value.code == "DEADLINE" and ei.value.retryable
+            tid = ei.value.trace_id
+            assert valid_trace_id(tid)
+            # The worker aborts at its next phase boundary and records
+            # the abandoned job with its span tree — poll for it.
+            rec = _wait_for_record(tid)
+            assert rec is not None, "DEADLINE record never retained"
+            assert rec["outcome"] == "DEADLINE"
+            assert rec["kind"] == "spec"
+            assert rec["queue_wait_ms"] is not None
+            # Span tree spans the serve boundary: serve.request roots
+            # query.collect.
+            assert rec["spans"]["name"] == "serve.request"
+            assert rec["spans"]["tags"]["trace_id"] == tid
+
+            def names(d):
+                yield d["name"]
+                for c in d.get("children", ()) or ():
+                    yield from names(c)
+
+            assert "query.collect" in set(names(rec["spans"]))
+            # The run report rode along (the query died mid-execution).
+            assert rec["report"] is not None
+            assert rec["report"]["outcome"] == "error"
+            # Surfacing: the API and the wire agree.
+            assert hs.trace(tid)["trace_id"] == tid
+            assert tid in hs.slow_queries().column("traceId").to_pylist()
+            with QueryClient(server.address) as qc2:
+                verb = qc2.query({"verb": "trace", "id": tid})
+            assert json.loads(
+                verb.column("record_json")[0].as_py())["trace_id"] == tid
+        finally:
+            # drain() is what the SIGTERM handler runs: it persists the
+            # diagnostics bundle after in-flight work settles.
+            assert server.drain(grace_s=60.0)
+        # "Restart": fresh session over the same system path, in-memory
+        # ring wiped — the persisted bundle must still answer.
+        flight_recorder.reset()
+        s2 = HyperspaceSession(system_path=str(tmp_path / "ix"))
+        got = Hyperspace(s2).diagnostics_bundles()
+        assert got, "drain did not persist a diagnostics bundle"
+        recs = [r for b in got for r in b["records"]
+                if r["trace_id"] == tid]
+        assert recs and recs[0]["outcome"] == "DEADLINE"
+        assert recs[0]["spans"]["name"] == "serve.request"
+
+    def test_plan_fingerprint_recorded_for_served_queries(self, env):
+        s, data = env
+        s.conf.flight_recorder_slow_ms = 0.0001
+        with QueryServer(s) as server:
+            with QueryClient(server.address) as qc:
+                qc.query(_point_spec(data, 1))
+                first = qc.last_trace_id
+                qc.query(_point_spec(data, 1))
+                second = qc.last_trace_id
+        rec1, rec2 = _wait_for_record(first), _wait_for_record(second)
+        assert rec1 is not None and rec2 is not None
+        # Same query shape + literals → same plan fingerprint, and the
+        # repeat was a plan-cache hit.
+        assert rec1["plan_fingerprint"]
+        assert rec1["plan_fingerprint"] == rec2["plan_fingerprint"]
+        hits = [d for d in rec2["report"]["decisions"]
+                if d["kind"] == "plan_cache"]
+        assert hits and hits[-1]["hit"] is True

@@ -132,7 +132,11 @@ class TestServer:
                     "select": ["k"],
                 }).encode() + b"\n")
                 f = sock.makefile("rb")
-                assert f.readline() == b"OK\n"
+                status = f.readline()
+                # "OK trace=<id>\n": the status line now echoes the
+                # adopted/minted trace context (docs/07-interop.md).
+                assert status.startswith(b"OK")
+                assert b"trace=" in status
                 table = pa.ipc.open_stream(f).read_all()
         assert table.num_rows == 1000
 
