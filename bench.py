@@ -962,7 +962,7 @@ SECTION_NAMES = ("setup", "sf1_queries", "device_agg_probe",
                  "warm_q10", "window_bench", "kernel_bench",
                  "calibration", "telemetry_overhead", "advisor",
                  "integrity", "build_profile", "serving",
-                 "flight_recorder", "sf10", "sf100")
+                 "flight_recorder", "ingest", "sf10", "sf100")
 
 
 def main() -> int:
@@ -1015,6 +1015,7 @@ def main() -> int:
             harness.section("serving", lambda: _sec_serving(ctx))
             harness.section("flight_recorder",
                             lambda: _sec_flight_recorder(ctx))
+            harness.section("ingest", lambda: _sec_ingest(root))
             harness.section("sf10", lambda: _sec_sf10(ctx, root, harness))
             harness.section("sf100", lambda: _sec_sf100(ctx, root, harness))
         except _Finalize:
@@ -2256,6 +2257,233 @@ def _sec_flight_recorder(ctx: dict) -> dict:
         (session.conf.flight_recorder_enabled,
          session.conf.flight_recorder_slow_ms) = saved
     return {"flight_recorder": out}
+
+
+def _sec_ingest(root: str) -> dict:
+    """Continuous ingest + autonomous lifecycle (docs/19-lifecycle.md):
+    the rolling-append workload.  Three proofs, all correctness-gated:
+
+      1. incremental-vs-full refresh — append one small file to a built
+         index's source and time ``refresh("incremental")`` vs
+         ``refresh("full")`` from the same logical state; gated >= 5x
+         (the subsystem's reason to exist).
+      2. mid-refresh correctness — an appender thread appends +
+         incrementally refreshes in a loop while this thread queries
+         (hybrid scan on) and, whenever the source listing is stable
+         across a collect, asserts BIT-EQUAL answers vs a direct
+         pyarrow read of exactly those files.
+      3. the daemon — capture on + byte budget, append one file, run
+         one maintenance cycle: the journal must show the incremental
+         refresh AND the advisor-recommended build, and read back from
+         a fresh session (restart-proof).
+
+    Self-contained (own sources, throwaway sessions), like integrity."""
+    import glob as _glob
+    import threading
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
+
+    # Big enough that a full rebuild is dominated by data volume, not
+    # by the fixed per-action protocol cost incremental also pays —
+    # the >= 5x gate measures the subsystem, not log-write latency.
+    n = max(600_000, N_LINEITEM // 10)
+    files = 8
+    n_append = max(600, n // 500)
+    src = os.path.join(root, "ingest_src")
+    os.makedirs(src, exist_ok=True)
+    rng = np.random.default_rng(43)
+    next_k = [0]
+
+    def append_file(count: int) -> str:
+        t = pa.table({
+            "k": pa.array(np.arange(next_k[0], next_k[0] + count,
+                                    dtype=np.int64)),
+            "v": rng.random(count),
+            "w": rng.random(count),
+        })
+        next_k[0] += count
+        path = os.path.join(src, f"part-{next_k[0]:010d}.parquet")
+        pq.write_table(t, path)
+        return path
+
+    for _ in range(files):
+        append_file(-(-n // files))
+
+    session = HyperspaceSession(system_path=os.path.join(root,
+                                                         "ingest_ix"))
+    session.conf.num_buckets = 8
+    session.conf.lineage_enabled = True
+    session.conf.hybrid_scan_enabled = True
+    # The refresh comparison measures DATA-VOLUME avoidance (index only
+    # what changed), not mesh dispatch: the distributed build's fixed
+    # shuffle/compile cost per action would dominate a 1200-row
+    # incremental at toy scale (and the test suite's 8 virtual CPU
+    # devices would route there), drowning the thing under test.
+    session.conf.parallel_build = "off"
+    hs = Hyperspace(session)
+    hs.create_index(session.read.parquet(src),
+                    IndexConfig("ingest_ix", ["k"], ["v"]))
+    session.enable_hyperspace()
+
+    def median(xs: list) -> float:
+        return sorted(xs)[len(xs) // 2]
+
+    # -- (1) incremental vs full, each from "fresh index + one appended
+    # file" so both modes face the same logical work ----------------------
+    reps = max(1, min(3, REPEATS))
+    inc_s: list = []
+    full_s: list = []
+    append_file(n_append)  # untimed warmup: JIT/import costs land here
+    hs.refresh_index("ingest_ix", "incremental")
+    for _ in range(reps):
+        append_file(n_append)
+        t0 = time.perf_counter()
+        summary = hs.refresh_index("ingest_ix", "incremental")
+        inc_s.append(time.perf_counter() - t0)
+        if summary.outcome != "ok" or summary.appended != 1:
+            raise SystemExit(f"ingest bench: incremental refresh saw "
+                             f"{summary!r}, expected 1 appended file")
+    for _ in range(reps):
+        append_file(n_append)
+        t0 = time.perf_counter()
+        hs.refresh_index("ingest_ix", "full")
+        full_s.append(time.perf_counter() - t0)
+    speedup = median(full_s) / max(1e-9, median(inc_s))
+    if speedup < 5.0:
+        raise SystemExit(
+            f"ingest bench: incremental refresh only {speedup:.1f}x "
+            f"faster than full rebuild on the rolling-append workload "
+            f"(inc {median(inc_s):.3f}s vs full {median(full_s):.3f}s); "
+            f"the acceptance bar is 5x")
+
+    # -- (2) mid-refresh reader: bit-equal vs a host reference ------------
+    stop = threading.Event()
+    failures: list = []
+
+    def appender() -> None:
+        try:
+            for _ in range(3):
+                append_file(n_append)
+                time.sleep(0.05)
+                hs.refresh_index("ingest_ix", "incremental")
+                time.sleep(0.05)
+        except Exception as e:  # noqa: BLE001 — surfaced as a gate below
+            failures.append(f"appender died: {e!r}")
+        finally:
+            stop.set()
+
+    def listing() -> list:
+        return sorted(_glob.glob(os.path.join(src, "*.parquet")))
+
+    def reference(paths: list) -> list:
+        t = pq.read_table(paths, columns=["k", "v"])
+        return sorted(zip(t.column("k").to_pylist(),
+                          t.column("v").to_pylist()))
+
+    def one_read(require_stable: bool) -> bool:
+        """One query; compares when the listing stayed stable across the
+        collect (appends are create-only, so equal listings mean the
+        collect saw exactly those files).  Returns True if compared."""
+        l1 = listing()
+        res = (session.read.parquet(src).filter(col("k") >= 0)
+               .select("k", "v").collect())
+        if listing() != l1:
+            if require_stable:
+                failures.append("final quiescent read saw an unstable "
+                                "listing")
+            return False
+        got = sorted(zip(res.column("k").to_pylist(),
+                         res.column("v").to_pylist()))
+        if got != reference(l1):
+            failures.append(
+                f"mid-refresh divergence over {len(l1)} files: "
+                f"{len(got)} rows vs host reference")
+        return True
+
+    reader_thread = threading.Thread(target=appender, daemon=True)
+    reader_thread.start()
+    reads = compares = 0
+    while not stop.is_set() and not failures and reads < 200:
+        compares += 1 if one_read(require_stable=False) else 0
+        reads += 1
+    reader_thread.join(timeout=120)
+    if not failures:
+        # Quiescent final read MUST compare (and pass): at least one
+        # bit-equality proof per run even on a slow machine.
+        compares += 1 if one_read(require_stable=True) else 0
+    if failures:
+        raise SystemExit(f"ingest bench: {failures[0]}")
+    if compares == 0:
+        raise SystemExit("ingest bench: no stable-window comparison "
+                         "completed")
+
+    # -- (3) the daemon: detect -> refresh -> advisor build, journaled ----
+    n2 = 20_000
+    src2 = os.path.join(root, "ingest_src2")
+    os.makedirs(src2, exist_ok=True)
+    t2 = pa.table({
+        "a": pa.array(np.arange(n2, dtype=np.int64)),
+        "d": pa.array(rng.integers(0, 50, n2), type=pa.int64()),
+        "b": rng.random(n2),
+    })
+    for i in range(4):
+        pq.write_table(t2.slice(i * (n2 // 4), n2 // 4),
+                       os.path.join(src2, f"part-{i:03d}.parquet"))
+    s2 = HyperspaceSession(system_path=os.path.join(root, "ingest_ix2"))
+    s2.conf.num_buckets = 4
+    s2.conf.lineage_enabled = True
+    s2.conf.advisor_capture_enabled = True
+    hs2 = Hyperspace(s2)
+    hs2.create_index(s2.read.parquet(src2),
+                     IndexConfig("ing2", ["a"], ["b"]))
+    s2.enable_hyperspace()
+    for _ in range(3):  # capture a workload the advisor can act on
+        s2.read.parquet(src2).filter(col("d") == 7).select("d", "b") \
+            .collect()
+    entry = s2.index_collection_manager.get_index("ing2")
+    index_bytes = sum(f.size for f in entry.content.file_infos())
+    src_bytes = sum(os.path.getsize(p) for p in
+                    _glob.glob(os.path.join(src2, "*.parquet")))
+    s2.conf.lifecycle_byte_budget = index_bytes + 4 * src_bytes
+    pq.write_table(t2.slice(0, 500),
+                   os.path.join(src2, "part-appended.parquet"))
+    t_append = time.time()
+    recs = hs2.maintenance_cycle()
+    staleness_s = time.time() - t_append
+    decisions: dict = {}
+    for r in recs:
+        key = f"{r['decision']}:{r['outcome']}"
+        decisions[key] = decisions.get(key, 0) + 1
+    if not any(r["decision"] == "refresh" and r["outcome"] == "done"
+               and r["mode"] == "incremental" for r in recs):
+        raise SystemExit(f"ingest bench: daemon cycle journaled no "
+                         f"incremental refresh: {decisions}")
+    if not any(r["decision"] == "create" and r["outcome"] == "done"
+               for r in recs):
+        raise SystemExit(f"ingest bench: daemon cycle built no "
+                         f"advisor-recommended index within the "
+                         f"budget: {decisions}")
+    fresh = HyperspaceSession(system_path=os.path.join(root,
+                                                       "ingest_ix2"))
+    if Hyperspace(fresh).lifecycle_history().num_rows < len(recs):
+        raise SystemExit("ingest bench: lifecycle journal not readable "
+                         "after restart")
+    return {"ingest": {
+        "rows": n,
+        "append_rows": n_append,
+        "incremental_refresh_s": round(median(inc_s), 4),
+        "full_rebuild_s": round(median(full_s), 4),
+        "incremental_vs_full_speedup": round(speedup, 2),
+        "midrefresh_reads": reads,
+        "midrefresh_compares": compares,
+        "staleness_s": round(staleness_s, 3),
+        "daemon_decisions": dict(sorted(decisions.items())),
+        "journal_records": len(recs),
+    }}
 
 
 def _sec_sf10(ctx: dict, root: str, harness: "_Harness") -> dict:

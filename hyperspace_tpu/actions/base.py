@@ -137,10 +137,14 @@ class Action:
         self._base_id = 0 if latest is None else latest
         self.previous_log_entry = self.log_manager.get_latest_log()
 
-    def run(self) -> None:
+    def run(self) -> str:
         """Action.scala:84-105, wrapped in the conflict-retrying
         transaction loop (concurrency_max_retries=0 ⇒ reference
-        behavior: first conflict aborts).
+        behavior: first conflict aborts).  Returns the outcome —
+        ``"ok"`` for a committed run, ``"noop"`` for a benign
+        NoChangesError no-op — so dispatchers (the refresh summary, the
+        maintenance daemon) can tell the two apart without re-reading
+        the log.
 
         Every turn of the loop is telemetry-visible: a ``CONFLICT_RETRY
         n/max`` ActionEvent per absorbed conflict (attempt number +
@@ -178,7 +182,7 @@ class Action:
                             plan_cache.bump_generation()
                         sp.set(conflict_retries=self.conflict_retries)
                         self._finish_report(outcome, "", sp)
-                        return
+                        return outcome
                     except ConcurrentWriteError as e:
                         if self.conflict_retries >= \
                                 self.concurrency_max_retries:

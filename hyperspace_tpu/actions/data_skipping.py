@@ -421,14 +421,12 @@ class RefreshDataSkippingAction(CreateDataSkippingAction):
             self._file_id_tracker = FileIdTracker.from_log_entry(stable)
 
     def _changed_files(self):
-        recorded = {(f.name, f.size, f.mtime)
-                    for f in self._previous_entry.source_file_infos()}
+        from hyperspace_tpu.lifecycle.change_detector import diff_file_sets
+
         current = self._relation().all_files(self._file_id_tracker)
-        current_keys = {(f.name, f.size, f.mtime) for f in current}
-        appended = [f for f in current
-                    if (f.name, f.size, f.mtime) not in recorded]
-        deleted_keys = recorded - current_keys
-        return appended, deleted_keys
+        appended, deleted, _ = diff_file_sets(
+            current, self._previous_entry.source_file_infos())
+        return appended, {(f.name, f.size, f.mtime) for f in deleted}
 
     def validate(self) -> None:
         from hyperspace_tpu.exceptions import NoChangesError

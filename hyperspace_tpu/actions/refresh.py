@@ -19,6 +19,7 @@ Reference contract:
 
 from __future__ import annotations
 
+import dataclasses
 from typing import List, Optional
 
 import numpy as np
@@ -41,10 +42,34 @@ from hyperspace_tpu.plan.nodes import Scan, ScanRelation
 from hyperspace_tpu.telemetry.events import RefreshActionEvent
 
 
+@dataclasses.dataclass(frozen=True)
+class RefreshSummary:
+    """What a refresh actually did — the return value of
+    ``Hyperspace.refresh_index`` (it used to return None, leaving the
+    caller to re-read the log to learn anything).  ``outcome`` is
+    ``"ok"`` for a committed refresh and ``"noop"`` when the source was
+    unchanged (a benign no-op, NOT an exception: the maintenance daemon
+    journals it and moves on); ``version`` is the committed log id, or
+    None for a no-op."""
+
+    index: str
+    mode: str              # full | incremental | quick | repair
+    outcome: str           # "ok" | "noop"
+    appended: int = 0      # source files the diff saw appended
+    deleted: int = 0       # source files the diff saw deleted
+    version: Optional[int] = None
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
 class RefreshActionBase(CreateActionBase):
     transient_state = States.REFRESHING
     final_state = States.ACTIVE
     event_class = RefreshActionEvent
+    # Human name of the refresh mode, for RefreshSummary / the build
+    # report's properties (subclasses override).
+    mode_name = "full"
 
     def __init__(self, log_manager: IndexLogManager, data_manager: IndexDataManager,
                  session, previous: Optional[IndexLogEntry] = None) -> None:
@@ -87,28 +112,54 @@ class RefreshActionBase(CreateActionBase):
     def lineage_enabled(self) -> bool:
         return self._previous_entry.has_lineage_column()
 
-    # -- the diff (RefreshActionBase.scala:115-144) -------------------------
+    # -- the diff (RefreshActionBase.scala:115-144), factored so change
+    # detection can run it without constructing an action
+    # (lifecycle/change_detector.diff_file_sets) ----------------------------
     def current_files(self) -> List[FileInfo]:
         return self._relation().all_files(self._file_id_tracker)
 
     def appended_files(self) -> List[FileInfo]:
-        recorded = {(f.name, f.size, f.mtime) for f in
-                    self._previous_entry.source_file_infos()}
-        return [f for f in self.current_files()
-                if (f.name, f.size, f.mtime) not in recorded]
+        from hyperspace_tpu.lifecycle.change_detector import diff_file_sets
+
+        appended, _, _ = diff_file_sets(
+            self.current_files(), self._previous_entry.source_file_infos())
+        return appended
 
     def deleted_files(self) -> List[FileInfo]:
-        current = {(f.name, f.size, f.mtime) for f in self.current_files()}
-        return [f for f in self._previous_entry.source_file_infos()
-                if (f.name, f.size, f.mtime) not in current]
+        from hyperspace_tpu.lifecycle.change_detector import diff_file_sets
+
+        _, deleted, _ = diff_file_sets(
+            self.current_files(), self._previous_entry.source_file_infos())
+        return deleted
 
     def validate(self) -> None:
         if self.previous_log_entry is None or \
                 self.previous_log_entry.state != States.ACTIVE:
             raise HyperspaceError(
                 f"Refresh is only supported in {States.ACTIVE} state")
-        if not self.appended_files() and not self.deleted_files():
+        appended, deleted = self.appended_files(), self.deleted_files()
+        self._record_diff(len(appended), len(deleted))
+        if not appended and not deleted:
             raise NoChangesError("Source data is unchanged; refresh is a no-op")
+
+    def _record_diff(self, appended: int, deleted: int) -> None:
+        """The diff counts, for RefreshSummary and the build report's
+        properties (re-recorded per conflict-retry attempt: the summary
+        must describe the diff the WINNING attempt validated)."""
+        self._diff_counts = (appended, deleted)
+        self.build_report.properties.update(
+            refresh_mode=self.mode_name, refresh_appended=appended,
+            refresh_deleted=deleted)
+
+    def summary(self, outcome: str) -> RefreshSummary:
+        """The user-facing summary of a completed run (``outcome`` is
+        what ``Action.run()`` returned)."""
+        appended, deleted = getattr(self, "_diff_counts", (0, 0))
+        return RefreshSummary(
+            index=self.index_name, mode=self.mode_name,
+            outcome="ok" if outcome == "ok" else "noop",
+            appended=appended, deleted=deleted,
+            version=self.base_id + 2 if outcome == "ok" else None)
 
     def log_entry_for_begin(self) -> IndexLogEntry:
         import copy
@@ -140,6 +191,8 @@ class RefreshAction(RefreshActionBase):
 
 class RefreshIncrementalAction(RefreshActionBase):
     """Index only what changed (RefreshIncrementalAction.scala:54-145)."""
+
+    mode_name = "incremental"
 
     def validate(self) -> None:
         super().validate()
@@ -196,6 +249,8 @@ class RefreshIncrementalAction(RefreshActionBase):
 
 class RefreshQuickAction(RefreshActionBase):
     """Metadata-only refresh (RefreshQuickAction.scala:37-80)."""
+
+    mode_name = "quick"
 
     def op(self) -> None:
         pass  # log-only

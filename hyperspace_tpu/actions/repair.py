@@ -53,6 +53,7 @@ class RepairAction(RefreshActionBase):
     transient_state = States.REFRESHING
     final_state = States.ACTIVE
     event_class = RefreshActionEvent
+    mode_name = "repair"
 
     def __init__(self, log_manager: IndexLogManager,
                  data_manager: IndexDataManager, session,

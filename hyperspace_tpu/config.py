@@ -86,6 +86,14 @@ FLIGHT_RECORDER_SLOW_MS = "hyperspace.serving.flightRecorder.slowMs"
 FLIGHT_RECORDER_HEALTHY_SAMPLE_N = \
     "hyperspace.serving.flightRecorder.healthySampleN"
 FLIGHT_RECORDER_MAX_BUNDLES = "hyperspace.serving.flightRecorder.maxBundles"
+LIFECYCLE_ENABLED = "hyperspace.lifecycle.enabled"
+LIFECYCLE_INTERVAL_S = "hyperspace.lifecycle.intervalS"
+LIFECYCLE_BYTE_BUDGET = "hyperspace.lifecycle.byteBudget"
+LIFECYCLE_QUICK_APPEND_RATIO = "hyperspace.lifecycle.quickAppendRatio"
+LIFECYCLE_FULL_CHURN_RATIO = "hyperspace.lifecycle.fullChurnRatio"
+LIFECYCLE_JOURNAL_MAX_ENTRIES = "hyperspace.lifecycle.journal.maxEntries"
+LIFECYCLE_BACKOFF_INITIAL_S = "hyperspace.lifecycle.backoff.initialS"
+LIFECYCLE_BACKOFF_MAX_S = "hyperspace.lifecycle.backoff.maxS"
 FAULT_INJECTION_ENABLED = "hyperspace.system.faultInjection.enabled"
 FAULT_INJECTION_SITE = "hyperspace.system.faultInjection.site"
 FAULT_INJECTION_KIND = "hyperspace.system.faultInjection.kind"
@@ -369,6 +377,36 @@ class HyperspaceConf:
     flight_recorder_slow_ms: float = 1000.0
     flight_recorder_healthy_sample_n: int = 16
     flight_recorder_max_bundles: int = 8
+    # Autonomous index lifecycle (hyperspace_tpu/lifecycle/;
+    # docs/19-lifecycle.md):
+    #   - enabled: the opt-in maintenance daemon thread — detect source
+    #     change, pick the cheapest refresh mode, close the advisor loop
+    #     under the byte budget, journal every decision.  Off by
+    #     default: autonomous builds re-read source data, an operator
+    #     decision on metered storage.  ``maintenance_cycle()`` drives
+    #     one step at a time regardless of this flag.
+    #   - intervalS: seconds between daemon cycles.
+    #   - byteBudget: total on-disk index bytes the advisor pass may
+    #     grow the fleet to; 0 disables autonomous create/delete
+    #     entirely (refresh/repair decisions are unaffected).
+    #   - quickAppendRatio: appended-bytes fraction (new + pending
+    #     hybrid-scan debt, over recorded source bytes) below which an
+    #     append-only change takes the metadata-only quick refresh
+    #     (hybrid scan must be on); above it, incremental.
+    #   - fullChurnRatio: changed-file fraction of the recorded set at
+    #     or past which a full rebuild beats an incremental pass.
+    #   - journal.maxEntries: decision-journal bound under
+    #     ``<systemPath>/_hyperspace_lifecycle`` (oldest pruned).
+    #   - backoff.initialS/.maxS: per-index exponential backoff after a
+    #     failed maintenance action (doubles per consecutive failure).
+    lifecycle_enabled: bool = False
+    lifecycle_interval_s: float = 30.0
+    lifecycle_byte_budget: int = 0
+    lifecycle_quick_append_ratio: float = 0.1
+    lifecycle_full_churn_ratio: float = 0.5
+    lifecycle_journal_max_entries: int = 1024
+    lifecycle_backoff_initial_s: float = 1.0
+    lifecycle_backoff_max_s: float = 300.0
     # Deterministic fault injection (io/faults.py): fire ``kind`` at the
     # ``at``-th call of ``site``, ``count`` times.  Test-only machinery;
     # disabled costs one None check per file-level IO op.
@@ -452,6 +490,14 @@ class HyperspaceConf:
         FLIGHT_RECORDER_SLOW_MS: "flight_recorder_slow_ms",
         FLIGHT_RECORDER_HEALTHY_SAMPLE_N: "flight_recorder_healthy_sample_n",
         FLIGHT_RECORDER_MAX_BUNDLES: "flight_recorder_max_bundles",
+        LIFECYCLE_ENABLED: "lifecycle_enabled",
+        LIFECYCLE_INTERVAL_S: "lifecycle_interval_s",
+        LIFECYCLE_BYTE_BUDGET: "lifecycle_byte_budget",
+        LIFECYCLE_QUICK_APPEND_RATIO: "lifecycle_quick_append_ratio",
+        LIFECYCLE_FULL_CHURN_RATIO: "lifecycle_full_churn_ratio",
+        LIFECYCLE_JOURNAL_MAX_ENTRIES: "lifecycle_journal_max_entries",
+        LIFECYCLE_BACKOFF_INITIAL_S: "lifecycle_backoff_initial_s",
+        LIFECYCLE_BACKOFF_MAX_S: "lifecycle_backoff_max_s",
         FAULT_INJECTION_ENABLED: "fault_injection_enabled",
         FAULT_INJECTION_SITE: "fault_injection_site",
         FAULT_INJECTION_KIND: "fault_injection_kind",

@@ -33,12 +33,16 @@ class Hyperspace:
     def vacuum_index(self, name: str) -> None:
         self.index_manager.vacuum(name)
 
-    def refresh_index(self, name: str, mode: str = "full") -> None:
+    def refresh_index(self, name: str, mode: str = "full"):
         """Modes: ``full`` (rebuild), ``incremental``, ``quick``
         (metadata-only), and ``repair`` — rebuild only the buckets whose
         files are quarantined, then clear their quarantine records
-        (docs/15-integrity.md)."""
-        self.index_manager.refresh(name, mode)
+        (docs/15-integrity.md).  Returns a
+        :class:`~hyperspace_tpu.actions.refresh.RefreshSummary`:
+        appended/deleted file counts the diff saw, the mode that ran,
+        the committed log version — or ``outcome="noop"`` when the
+        source was unchanged (a benign no-op, not an exception)."""
+        return self.index_manager.refresh(name, mode)
 
     def verify_index(self, name: str, mode: str = "quick") -> pa.Table:
         """Scrub ``name``'s index data files against its log entry and
@@ -197,6 +201,45 @@ class Hyperspace:
         from hyperspace_tpu.telemetry.flight_recorder import bundles
 
         return bundles(self.session.conf)
+
+    # -- autonomous lifecycle (docs/19-lifecycle.md) ------------------------
+    def maintenance_cycle(self) -> list:
+        """Run ONE maintenance cycle synchronously — the daemon's
+        detect → decide → act → journal step, drivable without the
+        daemon thread (tests, serving integration, cron).  Returns the
+        journal records written this cycle (one per decision, including
+        ``kind=none`` "did nothing" records)."""
+        from hyperspace_tpu.lifecycle.daemon import daemon_for
+
+        return daemon_for(self.session).run_once()
+
+    def start_maintenance(self):
+        """Start the opt-in maintenance daemon thread
+        (``hyperspace.lifecycle.enabled`` must be true; it polls every
+        ``hyperspace.lifecycle.intervalS`` seconds).  Returns the
+        :class:`~hyperspace_tpu.lifecycle.daemon.MaintenanceDaemon`."""
+        from hyperspace_tpu.lifecycle.daemon import daemon_for
+
+        return daemon_for(self.session).start()
+
+    def stop_maintenance(self) -> None:
+        """Stop the maintenance daemon thread (idempotent; the session's
+        daemon object survives for later restarts)."""
+        from hyperspace_tpu.lifecycle.daemon import daemon_for
+
+        daemon_for(self.session).stop()
+
+    def lifecycle_history(self) -> pa.Table:
+        """The lifecycle decision journal as an arrow table, oldest
+        first — every daemon/maintenance-cycle decision (refresh mode
+        chosen, advisor build/drop, backoff skip, or "did nothing, "
+        "here's why"), persisted under
+        ``<systemPath>/_hyperspace_lifecycle`` through the LogStore
+        seam, restart-proof.  The same table the interop ``lifecycle``
+        verb serves (docs/19-lifecycle.md has the schema)."""
+        from hyperspace_tpu.lifecycle.journal import history_table
+
+        return history_table(self.session.conf)
 
     def metrics(self) -> dict:
         """Point-in-time snapshot of the process-wide metrics registry

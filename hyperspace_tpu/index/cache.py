@@ -90,10 +90,12 @@ class CachingIndexCollectionManager(IndexCollectionManager):
         super().cancel(name)
         self.clear_cache()
 
-    def refresh(self, name: str, mode: str = "full") -> None:
+    def refresh(self, name: str, mode: str = "full"):
         self.clear_cache()
-        super().refresh(name, mode)
-        self.clear_cache()
+        try:
+            return super().refresh(name, mode)
+        finally:
+            self.clear_cache()
 
     def optimize(self, name: str, mode: str = "quick") -> None:
         self.clear_cache()

@@ -62,18 +62,19 @@ class IndexCollectionManager:
         mgr.configure(self.session.conf)
         return mgr
 
-    def _dispatch(self, action) -> None:
+    def _dispatch(self, action) -> str:
         """Arm the optimistic transaction loop from session conf, then
         run: a ConcurrentWriteError rebases + re-validates + retries with
         jittered backoff up to ``hyperspace.index.concurrency.maxRetries``
         times, composing with _maybe_recover's rollback (which already
-        ran before the action was built)."""
+        ran before the action was built).  Returns the run outcome
+        (``"ok"``/``"noop"``, actions/base.py)."""
         from hyperspace_tpu.utils.retry import policy_from_conf
 
         action.concurrency_max_retries = int(
             self.session.conf.concurrency_max_retries)
         action.conflict_backoff = policy_from_conf(self.session.conf)
-        action.run()
+        return action.run()
 
     def _maybe_recover(self, name: str) -> None:
         """With ``hyperspace.index.autoRecovery.enabled``, roll a
@@ -152,12 +153,17 @@ class IndexCollectionManager:
 
         self._dispatch(CancelAction(self._log_manager(name)))
 
-    def refresh(self, name: str, mode: str = "full") -> None:
+    def refresh(self, name: str, mode: str = "full"):
+        """Dispatch one refresh; returns a
+        :class:`~hyperspace_tpu.actions.refresh.RefreshSummary` — what
+        the diff saw and what was committed (``outcome="noop"`` for an
+        unchanged source, not an exception)."""
         from hyperspace_tpu.actions.data_skipping import RefreshDataSkippingAction
         from hyperspace_tpu.actions.refresh import (
             RefreshAction,
             RefreshIncrementalAction,
             RefreshQuickAction,
+            RefreshSummary,
         )
 
         if mode == "repair":
@@ -166,12 +172,12 @@ class IndexCollectionManager:
             from hyperspace_tpu.actions.repair import RepairAction
 
             self._maybe_recover(name)
-            self._dispatch(RepairAction(
+            action = RepairAction(
                 self._log_manager(name), self._data_manager(name),
                 self.session,
                 previous=self._log_manager(name).get_latest_stable_log(),
-                quarantine=self.quarantine_manager(name)))
-            return
+                quarantine=self.quarantine_manager(name))
+            return action.summary(self._dispatch(action))
         cls = {"full": RefreshAction,
                "incremental": RefreshIncrementalAction,
                "quick": RefreshQuickAction}.get(mode)
@@ -184,8 +190,17 @@ class IndexCollectionManager:
         stable = self._log_manager(name).get_latest_stable_log()
         if stable is not None and not stable.is_covering and mode != "quick":
             cls = RefreshDataSkippingAction
-        self._dispatch(cls(self._log_manager(name), self._data_manager(name),
-                           self.session, previous=stable))
+        action = cls(self._log_manager(name), self._data_manager(name),
+                     self.session, previous=stable)
+        outcome = self._dispatch(action)
+        if hasattr(action, "summary"):
+            return action.summary(outcome)
+        # The data-skipping refresh predates RefreshSummary; synthesize
+        # one from the requested mode and the committed id.
+        return RefreshSummary(
+            index=name, mode=mode,
+            outcome="ok" if outcome == "ok" else "noop",
+            version=action.base_id + 2 if outcome == "ok" else None)
 
     def optimize(self, name: str, mode: str = "quick") -> None:
         from hyperspace_tpu.actions.optimize import OptimizeAction

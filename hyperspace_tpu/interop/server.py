@@ -93,12 +93,17 @@ RETRYABLE_WIRE_CODES = frozenset({ERR_BUSY, ERR_DEADLINE})
 
 class WireError(Exception):
     """Server-side: an error with an explicit wire code (the handler maps
-    everything else through :func:`_classify_error`)."""
+    everything else through :func:`_classify_error`).  ``retry_after_ms``
+    rides BUSY sheds as a ``retry-after-ms=<n>`` token on the status
+    line — the server's backoff hint, derived from the queue-wait
+    EWMA."""
 
-    def __init__(self, code: str, message: str) -> None:
+    def __init__(self, code: str, message: str,
+                 retry_after_ms: Optional[int] = None) -> None:
         super().__init__(message)
         self.code = code
         self.message = message
+        self.retry_after_ms = retry_after_ms
 
 
 class QueryFailedError(RuntimeError):
@@ -111,11 +116,15 @@ class QueryFailedError(RuntimeError):
     ``trace`` verb to pull the request's full flight record."""
 
     def __init__(self, code: str, message: str, payload: str,
-                 trace_id: Optional[str] = None) -> None:
+                 trace_id: Optional[str] = None,
+                 retry_after_ms: Optional[int] = None) -> None:
         super().__init__(f"Query failed: {payload}")
         self.code = code
         self.message = message
         self.trace_id = trace_id
+        #: Server backoff hint from a ``retry-after-ms=<n>`` status-line
+        #: token (BUSY sheds; None against a pre-hint server).
+        self.retry_after_ms = retry_after_ms
 
     @property
     def retryable(self) -> bool:
@@ -124,7 +133,9 @@ class QueryFailedError(RuntimeError):
 
 class ServerBusyError(QueryFailedError):
     """The server shed this request (``ERR BUSY``): overload, not a bug.
-    Retry with backoff on a new connection."""
+    Retry with backoff on a new connection — ``retry_after_ms`` is the
+    server's suggested wait, derived from its recent queue-wait EWMA
+    (None when the server predates the hint)."""
 
 
 _TRACE_ECHO_RE = None  # compiled lazily; interop/query.py owns the format
@@ -144,19 +155,41 @@ def _split_trace_echo(text: str) -> Tuple[str, Optional[str]]:
     return m.group(1), m.group(2)
 
 
+_RETRY_AFTER_RE = None  # compiled lazily, like the trace echo
+
+
+def _split_retry_after(text: str) -> Tuple[str, Optional[int]]:
+    """Strip a trailing ``retry-after-ms=<n>`` token (the BUSY backoff
+    hint) off a status line, returning ``(rest, ms-or-None)``."""
+    global _RETRY_AFTER_RE
+    if _RETRY_AFTER_RE is None:
+        import re
+
+        _RETRY_AFTER_RE = re.compile(
+            r"^(.*?)\s*\bretry-after-ms=(\d+)\s*$")
+    m = _RETRY_AFTER_RE.match(text)
+    if m is None:
+        return text, None
+    return m.group(1), int(m.group(2))
+
+
 def parse_wire_error(line: str) -> QueryFailedError:
     """An ``ERR ...`` status line → the typed client error.  Accepts both
     the coded form (``ERR BUSY queue full``) and the pre-taxonomy bare
     form (``ERR something broke`` → code FAILED), so a new client keeps
-    working against an old server; a trailing ``trace=<id>`` echo (this
-    PR's trace context) is lifted into ``.trace_id`` either way."""
+    working against an old server; a trailing ``trace=<id>`` echo and a
+    ``retry-after-ms=<n>`` hint are lifted into ``.trace_id`` /
+    ``.retry_after_ms`` either way (old bare ``ERR BUSY`` lines still
+    parse, with both None)."""
     payload = line[4:] if line.startswith("ERR ") else line
     stripped, trace_id = _split_trace_echo(payload)
+    stripped, retry_after_ms = _split_retry_after(stripped)
     code, _, rest = stripped.partition(" ")
     if code in KNOWN_WIRE_CODES and rest:
         cls = ServerBusyError if code == ERR_BUSY else QueryFailedError
-        return cls(code, rest, payload, trace_id)
-    return QueryFailedError(ERR_FAILED, stripped, payload, trace_id)
+        return cls(code, rest, payload, trace_id, retry_after_ms)
+    return QueryFailedError(ERR_FAILED, stripped, payload, trace_id,
+                            retry_after_ms)
 
 
 def _classify_error(exc: BaseException) -> Tuple[str, str]:
@@ -255,12 +288,22 @@ class _WorkerPool:
             self._threads.append(t)
 
     # -- admission ---------------------------------------------------------
+    def retry_after_hint_ms(self) -> int:
+        """The backoff a shed client should take before retrying: about
+        one recent queue wait (the EWMA the latency watermark also
+        reads), floored at 100 ms so an idle-queue shed (drain,
+        connection cap) still suggests a real pause, capped at 30 s."""
+        with self._lock:
+            ewma = self._queue_wait_ewma_ms
+        return int(max(100.0, min(30_000.0, ewma * 2.0)))
+
     def _shed(self, reason: str, message: str) -> None:
         from hyperspace_tpu.telemetry import metrics
 
         metrics.inc("serve.shed")
         metrics.inc(f"serve.shed.{reason}")
-        raise WireError(ERR_BUSY, message)
+        raise WireError(ERR_BUSY, message,
+                        retry_after_ms=self.retry_after_hint_ms())
 
     def submit(self, job: _Job, conf) -> None:
         """Admit ``job`` or shed it with a retryable ``ERR BUSY``."""
@@ -549,11 +592,15 @@ class _Handler(socketserver.StreamRequestHandler):
                     conf, kind=kind, outcome=code,
                     latency_ms=(time.monotonic() - t0) * 1000.0,
                     trace_id=trace_id, request_id=request_id, error=msg)
+            retry_ms = getattr(exc, "retry_after_ms", None)
+            hint = f" retry-after-ms={int(retry_ms)}" \
+                if retry_ms is not None else ""
             try:
                 self.connection.settimeout(
                     float(conf.serving_send_timeout_s))
                 self.wfile.write(
-                    f"ERR {code} {msg} trace={trace_id}\n".encode("utf-8"))
+                    f"ERR {code} {msg}{hint} trace={trace_id}\n"
+                    .encode("utf-8"))
             except OSError:
                 pass
             return False
@@ -704,6 +751,13 @@ def _serve_verb(session, spec: Dict[str, Any],
                                       trace id; the id every response
                                       echoes (``trace=``) and every
                                       client error carries
+      {"verb": "lifecycle"}        -> the lifecycle decision journal
+                                      (lifecycle/journal.py): every
+                                      maintenance-daemon decision —
+                                      refresh mode chosen, advisor
+                                      build/drop, backoff skip, or "did
+                                      nothing, here's why" — oldest
+                                      first (docs/19-lifecycle.md)
 
     ``slow_queries`` and ``trace`` answer inline like ``metrics`` — an
     operator debugging an overloaded server needs exactly them while the
@@ -775,9 +829,13 @@ def _serve_verb(session, spec: Dict[str, Any],
                 f"are always kept while they fit the ring)")
         return pa.table({"record_json": pa.array(
             [json.dumps(rec, default=str)], type=pa.string())})
+    if verb == "lifecycle":
+        from hyperspace_tpu.lifecycle.journal import history_table
+
+        return history_table(session.conf)
     raise ValueError(f"Unknown verb {verb!r}; expected metrics, "
                      f"last_run_report, workload, perf_history, "
-                     f"build_report, slow_queries, or trace")
+                     f"build_report, slow_queries, trace, or lifecycle")
 
 
 def _is_loopback(host: str) -> bool:
@@ -850,11 +908,13 @@ class QueryServer:
                         trace_id=mint_trace_id(),
                         request_id=mint_trace_id(),
                         error="connection capacity reached")
+                    hint = self.pool.retry_after_hint_ms()
                     try:
                         request.settimeout(1.0)
                         request.sendall(
                             f"ERR {ERR_BUSY} connection capacity reached; "
-                            f"retry later\n".encode("utf-8"))
+                            f"retry later retry-after-ms={hint}\n"
+                            .encode("utf-8"))
                     except OSError:
                         pass
                     self.shutdown_request(request)
@@ -962,6 +1022,12 @@ class QueryServer:
         self._draining = True
         self._server.pool.draining = True
         metrics.inc("serve.drains")
+        # Park the maintenance daemon too: a refresh racing this drain
+        # would keep the process alive past its grace window
+        # (lifecycle/daemon.py; the latch is process-global).
+        from hyperspace_tpu.lifecycle import daemon as _lifecycle_daemon
+
+        _lifecycle_daemon.notify_drain()
         if self._thread is not None:
             self._server.shutdown()  # stop the accept loop
         clean = self._server.pool.wait_idle(grace_s)

@@ -1,0 +1,33 @@
+"""Autonomous index lifecycle: change detection, maintenance policy,
+the opt-in daemon, and the decision journal (docs/19-lifecycle.md).
+
+The reference design assumes a human calls ``refreshIndex`` /
+``optimizeIndex`` by hand; this package closes the loop for sources
+that mutate continuously with nobody watching (ROADMAP item 4):
+
+  - :mod:`~hyperspace_tpu.lifecycle.change_detector` — cheap
+    source-fingerprint polling over the source seams, no data read
+  - :mod:`~hyperspace_tpu.lifecycle.policy` — the pure decision
+    function: change summary + index state -> maintenance action
+  - :mod:`~hyperspace_tpu.lifecycle.daemon` — executes decisions
+    through the normal action dispatch, bounded backoff, drain-aware
+  - :mod:`~hyperspace_tpu.lifecycle.journal` — every decision
+    (including "did nothing, here's why") persisted through the
+    LogStore seam under ``<systemPath>/_hyperspace_lifecycle``
+"""
+
+from hyperspace_tpu.lifecycle.change_detector import (
+    ChangeSummary,
+    detect_changes,
+    diff_file_sets,
+)
+from hyperspace_tpu.lifecycle.daemon import MaintenanceDaemon
+from hyperspace_tpu.lifecycle.policy import MaintenanceDecision
+
+__all__ = [
+    "ChangeSummary",
+    "MaintenanceDaemon",
+    "MaintenanceDecision",
+    "detect_changes",
+    "diff_file_sets",
+]

@@ -1,0 +1,180 @@
+"""Change detection: cheap source-fingerprint polling, no data read.
+
+The refresh actions already know how to diff a source against an
+entry's recorded file set (actions/refresh.py, the
+RefreshActionBase.scala:115-144 contract) — but only from inside a
+constructed action, which re-reads the log, re-lists the source, and
+builds a FileIdTracker before it can say "nothing changed".  The
+maintenance daemon polls every ACTIVE index every cycle, so detection
+must be exactly one source listing plus set arithmetic:
+:func:`diff_file_sets` is that contract factored out (the refresh
+actions now delegate to it), and :func:`detect_changes` applies it to
+an entry without constructing an action.
+
+The diff is keyed the way the actions key it — the ``(name, size,
+mtime)`` triple — so a MUTATED file (same name, different size/mtime)
+appears in both triple sets, which is how the incremental refresh
+rewrites it (delete old rows by lineage, index the new content).  The
+summary additionally counts mutations by name so the policy can tell
+"rolling append" from "rewritten in place".
+
+Detection diffs against the entry's EFFECTIVE recorded set: content
+files plus a quick refresh's pending appends minus its pending deletes
+(``IndexLogEntry.appended_files``/``deleted_files``) — otherwise a
+quick (metadata-only) refresh would leave the same files "appended"
+forever and the daemon would re-quick every cycle.  The hybrid-scan
+debt those pending lists represent is carried separately
+(``hybrid_debt_bytes``) so the policy can escalate to a real
+incremental refresh once the debt outgrows its budget.
+
+Works over every source seam the refresh actions support: plain
+parquet/csv dirs, and the Delta/Iceberg snapshot providers (docs/03,
+docs/04) — ``refresh_relation_metadata`` drops their snapshot pins so
+detection sees the latest table state, and ``all_files`` plans from
+manifests, not directory walks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Tuple
+
+from hyperspace_tpu.index.log_entry import FileInfo, IndexLogEntry
+from hyperspace_tpu.plan.nodes import Scan, ScanRelation
+
+
+def diff_file_sets(current: List[FileInfo], recorded: List[FileInfo],
+                   ) -> Tuple[List[FileInfo], List[FileInfo], List[str]]:
+    """``(appended, deleted, mutated_names)`` — the refresh actions' diff
+    contract, action-free.  ``appended``/``deleted`` are keyed by the
+    ``(name, size, mtime)`` triple exactly as
+    ``RefreshActionBase.appended_files``/``deleted_files`` key them (a
+    mutated file is a member of BOTH); ``mutated_names`` is the
+    name-keyed intersection whose size/mtime drifted."""
+    recorded_triples = {(f.name, f.size, f.mtime) for f in recorded}
+    current_triples = {(f.name, f.size, f.mtime) for f in current}
+    appended = [f for f in current
+                if (f.name, f.size, f.mtime) not in recorded_triples]
+    deleted = [f for f in recorded
+               if (f.name, f.size, f.mtime) not in current_triples]
+    current_names = {f.name for f in current}
+    recorded_names = {f.name for f in recorded}
+    mutated = sorted({f.name for f in appended
+                      if f.name in recorded_names}
+                     | {f.name for f in deleted
+                        if f.name in current_names})
+    return appended, deleted, mutated
+
+
+@dataclasses.dataclass(frozen=True)
+class ChangeSummary:
+    """What one detection pass saw for one ACTIVE index — counts only,
+    no data was read (one source listing + stat-level metadata)."""
+
+    index: str
+    appended: int            # files present now, absent from the record
+    deleted: int             # files recorded, gone (or replaced) now
+    mutated: int             # names in both with drifted size/mtime
+    appended_bytes: int      # bytes of the appended files
+    recorded_files: int      # size of the effective recorded set
+    recorded_bytes: int
+    hybrid_debt_bytes: int = 0  # quick-refresh appends awaiting indexing
+    newest_change_ms: int = 0   # max mtime over appended files (epoch ms)
+
+    @property
+    def changed(self) -> bool:
+        return (self.appended + self.deleted + self.mutated) > 0
+
+    @property
+    def churn_ratio(self) -> float:
+        """Changed-file fraction of the recorded set (mutations count
+        once, not as append+delete)."""
+        mutated = self.mutated
+        return (max(0, self.appended - mutated)
+                + max(0, self.deleted - mutated)
+                + mutated) / max(1, self.recorded_files)
+
+    @property
+    def append_ratio(self) -> float:
+        """New-plus-pending bytes over recorded bytes: the hybrid-scan
+        debt a quick refresh would leave behind."""
+        return (self.appended_bytes + self.hybrid_debt_bytes) \
+            / max(1, self.recorded_bytes)
+
+    def to_dict(self) -> dict:
+        return {"index": self.index, "appended": self.appended,
+                "deleted": self.deleted, "mutated": self.mutated,
+                "appended_bytes": self.appended_bytes,
+                "recorded_files": self.recorded_files,
+                "recorded_bytes": self.recorded_bytes,
+                "hybrid_debt_bytes": self.hybrid_debt_bytes}
+
+
+def _mtime_epoch_ms(mtime) -> int:
+    """FileInfo.mtime in EPOCH MILLISECONDS regardless of the source
+    provider's native unit (the default lister records nanoseconds,
+    the lake providers milliseconds): scale by magnitude — epoch
+    seconds are ~2e9, so anything past 1e11 is a finer unit."""
+    m = float(mtime)
+    while m > 1e11:
+        m /= 1000.0
+    return int(m * 1000.0)
+
+
+def _effective_recorded(entry: IndexLogEntry) -> List[FileInfo]:
+    """Content files + pending quick-refresh appends − pending deletes:
+    the source state the entry already ACCOUNTS for (indexed, or handed
+    to hybrid scan)."""
+    pending_deleted = {(f.name, f.size, f.mtime)
+                       for f in entry.deleted_files()}
+    out = [f for f in entry.source_file_infos()
+           if (f.name, f.size, f.mtime) not in pending_deleted]
+    out.extend(entry.appended_files())
+    return out
+
+
+def current_source_files(session, entry: IndexLogEntry) -> List[FileInfo]:
+    """The index's source as it looks RIGHT NOW: reconstruct the scan
+    from stored relation metadata with snapshot pins dropped (the
+    RefreshActionBase.scala:71-89 path) and list it — stat-level only."""
+    if len(entry.relations) != 1:
+        from hyperspace_tpu.exceptions import HyperspaceError
+
+        raise HyperspaceError(
+            "Change detection supports single-relation indexes")
+    rel_meta = session.source_provider_manager.refresh_relation_metadata(
+        entry.relations[0])
+    plan = Scan(ScanRelation(
+        root_paths=tuple(rel_meta.root_paths),
+        file_format=rel_meta.file_format,
+        options=tuple(sorted(rel_meta.options.items())),
+    ))
+    relation = session.source_provider_manager.get_relation(plan)
+    return relation.all_files()
+
+
+def detect_changes(session, entry: IndexLogEntry) -> ChangeSummary:
+    """One cheap detection pass for one ACTIVE entry.  Lists the source
+    (never reads data), diffs against the entry's effective recorded
+    set, and returns counts the policy can act on."""
+    from hyperspace_tpu.telemetry.trace import span
+
+    with span("lifecycle.detect", index=entry.name) as sp:
+        current = current_source_files(session, entry)
+        recorded = _effective_recorded(entry)
+        appended, deleted, mutated = diff_file_sets(current, recorded)
+        summary = ChangeSummary(
+            index=entry.name,
+            appended=len(appended),
+            deleted=len(deleted),
+            mutated=len(mutated),
+            appended_bytes=sum(f.size for f in appended),
+            recorded_files=len(recorded),
+            recorded_bytes=sum(f.size for f in recorded),
+            hybrid_debt_bytes=sum(f.size for f in entry.appended_files()),
+            newest_change_ms=max((_mtime_epoch_ms(f.mtime)
+                                  for f in appended), default=0),
+        )
+        sp.set(appended=summary.appended, deleted=summary.deleted,
+               mutated=summary.mutated)
+        return summary

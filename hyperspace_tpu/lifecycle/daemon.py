@@ -1,0 +1,391 @@
+"""The maintenance daemon: detect → decide → act → journal, repeat.
+
+Opt-in (``hyperspace.lifecycle.enabled``, default off) and deliberately
+boring: one background thread per session that, every
+``hyperspace.lifecycle.intervalS`` seconds, runs ONE maintenance cycle
+— the same :func:`MaintenanceDaemon.run_once` a test or a serving
+script can drive one step at a time via
+``Hyperspace.maintenance_cycle()``.  A cycle:
+
+  1. Sheds when the process is busy dying or busy serving: a draining
+     server (``notify_drain`` — ``QueryServer.drain`` calls it) or a
+     process past the PR 7 RSS watermark
+     (``hyperspace.serving.shed.rssWatermarkMb``) journals one
+     ``skipped`` decision and does nothing.
+  2. For every ACTIVE index: cheap change detection
+     (lifecycle/change_detector.py), quarantine check, then the pure
+     policy (lifecycle/policy.py) — and EXECUTES refresh/repair
+     decisions through the normal collection-manager dispatch, so
+     optimistic concurrency, BuildReport, the perf ledger, and the
+     plan-cache generation bump all apply unchanged.
+  3. With ``hyperspace.lifecycle.byteBudget`` set: the advisor pass —
+     drop cold indexes when over budget, build recommended ones that
+     fit (PR 5's capture → recommend loop, closed autonomously).
+  4. Journals EVERY decision — including "did nothing" — through
+     lifecycle/journal.py, and feeds executed actions to the flight
+     recorder (kind ``maintenance``) so daemon-initiated builds show
+     up in ``slow_queries()`` next to served requests.
+
+Failures never kill the daemon: an action that raises is journaled
+``error`` and its index backs off exponentially
+(``hyperspace.lifecycle.backoff.initialS`` doubling to ``.maxS``) so a
+persistently failing source cannot hot-loop the build path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from hyperspace_tpu.exceptions import HyperspaceError, NoChangesError
+from hyperspace_tpu.lifecycle import journal, policy
+from hyperspace_tpu.lifecycle.change_detector import detect_changes
+
+# Process-global drain latch: a draining server must also park the
+# daemon (a refresh racing a SIGTERM drain would keep the process
+# alive past its grace).  QueryServer.drain() sets it.
+_drain = threading.Event()
+
+
+def notify_drain() -> None:
+    _drain.set()
+
+
+def clear_drain() -> None:
+    """Re-arm after a drain (tests; a process that drains exits)."""
+    _drain.clear()
+
+
+def draining() -> bool:
+    return _drain.is_set()
+
+
+def daemon_for(session) -> "MaintenanceDaemon":
+    """The session's daemon, created lazily (one per session; the
+    thread starts only via :meth:`MaintenanceDaemon.start`)."""
+    d = getattr(session, "_lifecycle_daemon", None)
+    if d is None:
+        d = MaintenanceDaemon(session)
+        session._lifecycle_daemon = d
+    return d
+
+
+class MaintenanceDaemon:
+    def __init__(self, session) -> None:
+        self.session = session
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._cycle = 0
+        # index name -> (consecutive failures, monotonic not-before)
+        self._backoff: Dict[str, Tuple[int, float]] = {}
+        # candidate name -> advisor Candidate, for executing CREATE
+        # decisions ranked earlier in the same cycle.
+        self._pending_candidates: Dict[str, object] = {}
+
+    # -- the daemon thread ---------------------------------------------------
+    def start(self) -> "MaintenanceDaemon":
+        if not bool(getattr(self.session.conf, "lifecycle_enabled", False)):
+            raise HyperspaceError(
+                "The maintenance daemon is opt-in: set "
+                "hyperspace.lifecycle.enabled=true (or drive cycles "
+                "yourself via Hyperspace.maintenance_cycle())")
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="hs-lifecycle-daemon", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout_s: float = 10.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.run_once()
+            except Exception:  # noqa: BLE001 — a cycle must never kill
+                # the daemon; per-decision failures are journaled, this
+                # catches only gather-phase surprises.
+                from hyperspace_tpu.telemetry import metrics
+
+                metrics.inc("lifecycle.actions.errors")
+            self._stop.wait(float(getattr(self.session.conf,
+                                          "lifecycle_interval_s", 30.0)))
+
+    # -- one cycle (Hyperspace.maintenance_cycle) ----------------------------
+    def run_once(self) -> List[dict]:
+        """One full maintenance cycle; returns the journal records it
+        wrote (decision + outcome each)."""
+        from hyperspace_tpu.index.log_entry import States
+        from hyperspace_tpu.telemetry import metrics
+        from hyperspace_tpu.telemetry.trace import span
+
+        conf = self.session.conf
+        self._cycle += 1
+        out: List[dict] = []
+        with span("lifecycle.cycle", cycle=self._cycle) as sp:
+            metrics.inc("lifecycle.cycles")
+            shed = self._shed_reason(conf)
+            if shed is not None:
+                metrics.inc("lifecycle.skipped")
+                out.append(self._journal(
+                    policy.MaintenanceDecision(policy.KIND_NONE,
+                                               reason=shed),
+                    outcome="skipped"))
+                sp.set(skipped=shed)
+                return out
+            try:
+                entries = self.session.index_collection_manager \
+                    .get_indexes([States.ACTIVE])
+            except Exception as e:  # noqa: BLE001 — an unreadable system
+                # path is a journaled no-op, not a daemon death.
+                out.append(self._journal(
+                    policy.MaintenanceDecision(
+                        policy.KIND_NONE,
+                        reason=f"index listing failed: {e}"),
+                    outcome="error", error=str(e)))
+                return out
+            for entry in entries:
+                out.append(self._maintain_index(entry))
+            out.extend(self._advisor_pass(entries))
+            sp.set(decisions=len(out))
+        return out
+
+    def _shed_reason(self, conf) -> Optional[str]:
+        if draining():
+            return "server draining: maintenance parked"
+        rss_mark = float(getattr(conf, "serving_shed_rss_watermark_mb",
+                                 0.0))
+        if rss_mark > 0:
+            from hyperspace_tpu.interop.server import _current_rss_mb
+
+            rss = _current_rss_mb()
+            if rss > rss_mark:
+                return (f"memory watermark: rss {rss:.0f} MB > "
+                        f"{rss_mark:.0f} MB")
+        return None
+
+    # -- per-index maintenance ----------------------------------------------
+    def _maintain_index(self, entry) -> dict:
+        from hyperspace_tpu.telemetry import metrics
+
+        conf = self.session.conf
+        name = entry.name
+        failures, not_before = self._backoff.get(name, (0, 0.0))
+        if time.monotonic() < not_before:
+            metrics.inc("lifecycle.backoff.skips")
+            return self._journal(
+                policy.MaintenanceDecision(
+                    policy.KIND_NONE, name,
+                    reason=f"backing off after {failures} failure(s); "
+                           f"{not_before - time.monotonic():.1f}s left"),
+                outcome="skipped")
+        try:
+            change = detect_changes(self.session, entry)
+            quarantined = len(self.session.index_collection_manager
+                              .quarantine_manager(name).records())
+        except Exception as e:  # noqa: BLE001 — a source that cannot be
+            # listed is journaled + backed off like a failed action.
+            self._note_failure(name, failures)
+            metrics.inc("lifecycle.actions.errors")
+            return self._journal(
+                policy.MaintenanceDecision(
+                    policy.KIND_NONE, name,
+                    reason="change detection failed"),
+                outcome="error", error=str(e))
+        decision = policy.decide_refresh(
+            change,
+            quarantined=quarantined,
+            lineage=entry.has_lineage_column(),
+            hybrid_scan=bool(conf.hybrid_scan_enabled),
+            quick_append_ratio=float(getattr(
+                conf, "lifecycle_quick_append_ratio", 0.1)),
+            full_churn_ratio=float(getattr(
+                conf, "lifecycle_full_churn_ratio", 0.5)))
+        if decision.kind == policy.KIND_NONE:
+            self._backoff.pop(name, None)
+            return self._journal(decision, outcome="noop", change=change)
+        if change.newest_change_ms > 0:
+            metrics.set_gauge(
+                "lifecycle.staleness_s",
+                max(0.0, time.time() - change.newest_change_ms / 1000.0))
+        return self._execute(decision, change=change)
+
+    def _execute(self, decision: policy.MaintenanceDecision,
+                 change=None) -> dict:
+        """Run one decision through the NORMAL dispatch path and journal
+        the outcome; failures back off, never propagate."""
+        from hyperspace_tpu.telemetry import metrics
+        from hyperspace_tpu.telemetry.trace import span
+
+        name = decision.index
+        failures, _ = self._backoff.get(name, (0, 0.0))
+        t0 = time.perf_counter()
+        manager = self.session.index_collection_manager
+        outcome, error = "done", ""
+        try:
+            with span("lifecycle.action", index=name,
+                      kind=decision.kind, mode=decision.mode):
+                metrics.inc("lifecycle.actions")
+                if decision.kind in (policy.KIND_REFRESH,
+                                     policy.KIND_REPAIR):
+                    summary = manager.refresh(name, decision.mode)
+                    if summary is not None and summary.outcome == "noop":
+                        outcome = "noop"
+                elif decision.kind == policy.KIND_DELETE:
+                    manager.delete(name)
+                elif decision.kind == policy.KIND_CREATE:
+                    self._build_candidate(decision)
+                else:
+                    raise HyperspaceError(
+                        f"Unknown decision kind {decision.kind!r}")
+            self._backoff.pop(name, None)
+        except NoChangesError:
+            # A racing writer did our work between detection and
+            # dispatch: a journaled no-op, never an escaping exception.
+            outcome = "noop"
+            self._backoff.pop(name, None)
+        except Exception as e:  # noqa: BLE001 — a failed action is a
+            # journaled error + backoff; the daemon survives.
+            outcome, error = "error", str(e)
+            metrics.inc("lifecycle.actions.errors")
+            self._note_failure(name, failures)
+        wall_s = time.perf_counter() - t0
+        self._record_flight(decision, outcome, error, wall_s)
+        return self._journal(decision, outcome=outcome, error=error,
+                             wall_s=wall_s, change=change)
+
+    def _note_failure(self, name: str, prior_failures: int) -> None:
+        conf = self.session.conf
+        failures = prior_failures + 1
+        initial = float(getattr(conf, "lifecycle_backoff_initial_s", 1.0))
+        cap = float(getattr(conf, "lifecycle_backoff_max_s", 300.0))
+        delay = min(cap, initial * (2.0 ** (failures - 1)))
+        self._backoff[name] = (failures, time.monotonic() + delay)
+
+    def _record_flight(self, decision: policy.MaintenanceDecision,
+                       outcome: str, error: str, wall_s: float) -> None:
+        """Daemon-initiated builds show up in the flight recorder next
+        to served requests (kind ``maintenance``); never raises."""
+        from hyperspace_tpu.interop.query import mint_trace_id
+        from hyperspace_tpu.telemetry import flight_recorder
+
+        flight_recorder.record(
+            self.session.conf, kind="maintenance",
+            outcome="OK" if outcome in ("done", "noop") else "FAILED",
+            latency_ms=wall_s * 1000.0,
+            trace_id=mint_trace_id(), request_id=mint_trace_id(),
+            error=error or f"{decision.kind} {decision.index} "
+                           f"{decision.mode}".strip())
+
+    # -- the advisor pass ----------------------------------------------------
+    def _advisor_pass(self, entries) -> List[dict]:
+        """Close PR 5's loop under the byte budget: gather the impure
+        inputs, let the pure policy rank, execute create/delete through
+        the normal paths."""
+        conf = self.session.conf
+        budget = int(getattr(conf, "lifecycle_byte_budget", 0))
+        if budget <= 0:
+            return []
+        try:
+            inputs, cand_by_name = self._advisor_inputs(entries, budget)
+        except Exception as e:  # noqa: BLE001 — an unreadable workload
+            # or candidate pass is a journaled no-op for this cycle.
+            return [self._journal(
+                policy.MaintenanceDecision(
+                    policy.KIND_NONE, reason="advisor pass failed"),
+                outcome="error", error=str(e))]
+        decisions = policy.decide_advisor(inputs)
+        if not decisions:
+            return [self._journal(
+                policy.MaintenanceDecision(
+                    policy.KIND_NONE,
+                    reason=f"advisor: within the {budget}-byte budget, "
+                           f"no affordable candidates"),
+                outcome="noop")]
+        self._pending_candidates = cand_by_name
+        return [self._execute(d) for d in decisions]
+
+    def _advisor_inputs(self, entries, budget: int):
+        from hyperspace_tpu.advisor import recommend
+        from hyperspace_tpu.advisor import workload as _workload
+
+        recs = _workload.records(self.session.conf)
+        index_bytes = {
+            e.name: sum(f.size for f in e.content.file_infos())
+            for e in entries}
+        # COLD = no captured fingerprint touches any of the index's
+        # indexed columns over its relation roots.  With NO captured
+        # workload at all, nothing is classified cold — an empty
+        # capture log must never justify dropping every index.
+        cold: List[str] = []
+        if recs:
+            hot = set()
+            for rec in recs:
+                for t in rec.get("tables", []):
+                    roots = tuple(sorted(t.get("roots", [])))
+                    for c in (list(t.get("eq", []))
+                              + list(t.get("range", []))
+                              + list(t.get("join", []))):
+                        hot.add((roots, c.lower()))
+            for e in entries:
+                if not e.is_covering:
+                    continue
+                roots = tuple(sorted(
+                    r for rel in e.relations for r in rel.root_paths))
+                if not any((roots, c.lower()) in hot
+                           for c in e.indexed_columns):
+                    cold.append(e.name)
+        cands = [c for c in recommend.scored_candidates(self.session)
+                 if c.score > 0
+                 and not recommend._already_covered(self.session, c)]
+        inputs = policy.AdvisorInputs(
+            byte_budget=budget,
+            index_bytes=index_bytes,
+            cold_indexes=cold,
+            candidates=[(c.name, c.est_build_cost_bytes) for c in cands])
+        return inputs, {c.name: c for c in cands}
+
+    def _build_candidate(self, decision: policy.MaintenanceDecision) -> None:
+        from hyperspace_tpu.advisor.recommend import _unique_name
+        from hyperspace_tpu.dataset import Dataset
+        from hyperspace_tpu.index.index_config import IndexConfig
+
+        cand = self._pending_candidates.get(decision.index)
+        if cand is None:
+            raise HyperspaceError(
+                f"advisor candidate {decision.index!r} vanished between "
+                f"ranking and build")
+        name = _unique_name(self.session, cand.name)
+        ds = Dataset(cand.source_scan(), self.session)
+        self.session.index_collection_manager.create(
+            ds, IndexConfig(name, cand.indexed, cand.included))
+
+    # -- journaling ----------------------------------------------------------
+    def _journal(self, decision: policy.MaintenanceDecision, *,
+                 outcome: str, error: str = "", wall_s: float = 0.0,
+                 change=None) -> dict:
+        from hyperspace_tpu.telemetry import metrics
+
+        metrics.inc("lifecycle.decisions")
+        rec = {
+            "cycle": self._cycle,
+            "decision": decision.kind,
+            "index": decision.index,
+            "mode": decision.mode,
+            "reason": decision.reason,
+            "outcome": outcome,
+            "wall_s": round(wall_s, 4),
+        }
+        if error:
+            rec["error"] = error[:500]
+        if change is not None:
+            rec.update(appended=change.appended, deleted=change.deleted,
+                       mutated=change.mutated)
+        journal.append(self.session.conf, rec)
+        return rec

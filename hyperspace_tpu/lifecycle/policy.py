@@ -1,0 +1,156 @@
+"""The maintenance policy: change summary + index state -> decision.
+
+Pure functions over plain values — no session, no IO, no clock — so
+every branch is unit-testable in microseconds and the daemon is just
+"gather inputs, call policy, execute, journal".  The refresh-mode
+ladder mirrors the cost ladder the actions expose
+(docs/19-lifecycle.md has the full table):
+
+  - ``repair``       quarantine records exist — damaged buckets rebuild
+                     from the recorded snapshot before anything else
+  - ``full``         churn past ``hyperspace.lifecycle.fullChurnRatio``
+                     (an incremental pass would rewrite most of the
+                     index anyway), or deletes/mutations without
+                     lineage (incremental cannot exclude rows)
+  - ``incremental``  deletes/mutations with lineage, or appends too
+                     big for the quick budget
+  - ``quick``        small appends with hybrid scan on: metadata-only,
+                     the appended files served from source at query
+                     time until the debt outgrows
+                     ``hyperspace.lifecycle.quickAppendRatio``
+  - ``none``         nothing changed (journaled anyway — "did nothing,
+                     here's why" is a decision)
+
+The advisor half (:func:`decide_advisor`) closes PR 5's loop under
+``hyperspace.lifecycle.byteBudget``: build recommended indexes whose
+estimated cost fits the remaining budget, and drop COLD indexes (no
+captured-workload support) when the fleet is over budget.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Sequence, Set
+
+from hyperspace_tpu.lifecycle.change_detector import ChangeSummary
+
+# Decision kinds the daemon knows how to execute.
+KIND_NONE = "none"
+KIND_REFRESH = "refresh"
+KIND_REPAIR = "repair"
+KIND_CREATE = "create"
+KIND_DELETE = "delete"
+
+
+@dataclasses.dataclass(frozen=True)
+class MaintenanceDecision:
+    """One policy outcome; ``kind=none`` decisions are journaled too."""
+
+    kind: str
+    index: str = ""
+    mode: str = ""    # refresh mode for kind=refresh/repair
+    reason: str = ""
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "index": self.index,
+                "mode": self.mode, "reason": self.reason}
+
+
+def decide_refresh(change: ChangeSummary, *, quarantined: int,
+                   lineage: bool, hybrid_scan: bool,
+                   quick_append_ratio: float,
+                   full_churn_ratio: float) -> MaintenanceDecision:
+    """The per-index decision for one detection pass."""
+    name = change.index
+    if quarantined > 0:
+        return MaintenanceDecision(
+            KIND_REPAIR, name, mode="repair",
+            reason=f"{quarantined} quarantined index file(s); rebuilding "
+                   f"damaged buckets from the recorded snapshot")
+    over_debt = change.append_ratio > quick_append_ratio
+    if not change.changed and not over_debt:
+        if change.hybrid_debt_bytes > 0:
+            return MaintenanceDecision(
+                KIND_NONE, name,
+                reason=f"no new source changes; "
+                       f"{change.hybrid_debt_bytes} pending bytes within "
+                       f"the hybrid-scan debt budget")
+        return MaintenanceDecision(KIND_NONE, name,
+                                   reason="source unchanged")
+    if change.churn_ratio >= full_churn_ratio:
+        return MaintenanceDecision(
+            KIND_REFRESH, name, mode="full",
+            reason=f"churn ratio {change.churn_ratio:.2f} >= "
+                   f"{full_churn_ratio:.2f}: full rebuild is cheaper "
+                   f"than an incremental pass over most of the index")
+    if change.deleted or change.mutated:
+        if not lineage:
+            return MaintenanceDecision(
+                KIND_REFRESH, name, mode="full",
+                reason=f"{change.deleted} deleted / {change.mutated} "
+                       f"mutated file(s) without lineage: incremental "
+                       f"refresh cannot exclude their rows")
+        return MaintenanceDecision(
+            KIND_REFRESH, name, mode="incremental",
+            reason=f"{change.appended} appended / {change.deleted} "
+                   f"deleted / {change.mutated} mutated file(s)")
+    # Appends only from here.
+    if hybrid_scan and not over_debt:
+        return MaintenanceDecision(
+            KIND_REFRESH, name, mode="quick",
+            reason=f"{change.appended} small appended file(s) "
+                   f"(append ratio {change.append_ratio:.3f} <= "
+                   f"{quick_append_ratio:.3f}): metadata-only, hybrid "
+                   f"scan serves them from source")
+    return MaintenanceDecision(
+        KIND_REFRESH, name, mode="incremental",
+        reason=f"{change.appended} appended file(s) "
+               f"({change.appended_bytes + change.hybrid_debt_bytes} "
+               f"bytes beyond the quick budget)"
+        if over_debt or not hybrid_scan else "appended files")
+
+
+@dataclasses.dataclass(frozen=True)
+class AdvisorInputs:
+    """The impure-world snapshot :func:`decide_advisor` ranks over —
+    the daemon gathers it, tests fabricate it."""
+
+    byte_budget: int
+    index_bytes: Dict[str, int]          # ACTIVE index -> on-disk bytes
+    cold_indexes: Sequence[str]          # no captured-workload support
+    # (name, est_build_cost_bytes) of advisor candidates, best first,
+    # already filtered for "not covered by an existing index".
+    candidates: Sequence[tuple] = ()
+
+
+def decide_advisor(inputs: AdvisorInputs) -> List[MaintenanceDecision]:
+    """Create/delete decisions under the byte budget.  Deterministic:
+    drop the LARGEST cold index first (fastest route back under
+    budget), then admit candidates best-score-first while their
+    estimated build size fits."""
+    if inputs.byte_budget <= 0:
+        return []
+    out: List[MaintenanceDecision] = []
+    total = sum(inputs.index_bytes.values())
+    cold: Set[str] = set(inputs.cold_indexes)
+    for name in sorted(cold & set(inputs.index_bytes),
+                       key=lambda n: -inputs.index_bytes[n]):
+        if total <= inputs.byte_budget:
+            break
+        size = inputs.index_bytes[name]
+        total -= size
+        out.append(MaintenanceDecision(
+            KIND_DELETE, name,
+            reason=f"cold index ({size} bytes) over the "
+                   f"{inputs.byte_budget}-byte budget; no captured "
+                   f"workload supports it"))
+    for name, est_bytes in inputs.candidates:
+        est = max(0, int(est_bytes))
+        if total + est > inputs.byte_budget:
+            continue
+        total += est
+        out.append(MaintenanceDecision(
+            KIND_CREATE, name,
+            reason=f"advisor-recommended; est {est} bytes fits the "
+                   f"remaining budget"))
+    return out

@@ -289,6 +289,8 @@ REQUIRED_BENCH_SPANS = (
     "bench.serving",
     "serve.request",
     "bench.flight_recorder",
+    "bench.ingest",
+    "lifecycle.cycle",
 )
 
 

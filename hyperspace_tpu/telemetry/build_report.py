@@ -77,6 +77,10 @@ class BuildReport:
         self.spill_runs = 0
         self.peak_rss_mb: Optional[float] = None
         self.device_live_bytes: Optional[int] = None
+        # Action-specific annotations (a refresh records its mode and
+        # diff counts here — the RefreshSummary surfaced through
+        # ``last_build_report()``); flat scalars only.
+        self.properties: Dict[str, Any] = {}
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
 
@@ -200,6 +204,8 @@ class BuildReport:
             "spill_runs": self.spill_runs,
             "peak_rss_mb": self.peak_rss_mb,
             "device_live_bytes": self.device_live_bytes,
+            **({"properties": dict(sorted(self.properties.items()))}
+               if self.properties else {}),
         }
 
     def render(self) -> str:

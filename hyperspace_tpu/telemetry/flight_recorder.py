@@ -18,7 +18,8 @@ One record is a flat dict:
 
   - ``trace_id`` / ``request_id``: the wire-propagated trace context
     (interop/query.py mints/adopts; the same id the client error echoed)
-  - ``kind``: ``sql`` / ``spec`` / ``local`` / ``unknown``
+  - ``kind``: ``sql`` / ``spec`` / ``local`` / ``maintenance`` (a
+    lifecycle-daemon action) / ``unknown``
   - ``outcome``: ``OK`` or a wire error code (``BUSY`` / ``DEADLINE`` /
     ``BADREQ`` / ``FAILED``); local queries use the run report's
     ``ok`` / ``degraded`` / ``error``

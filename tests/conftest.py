@@ -78,11 +78,13 @@ def _reset_telemetry():
     Metrics are NOT reset here — the registry is additive by design and
     tests assert deltas or reset explicitly."""
     yield
+    from hyperspace_tpu.lifecycle import daemon as lifecycle_daemon
     from hyperspace_tpu.telemetry import flight_recorder, trace
 
     trace.disable_tracing()
     trace.clear_sinks()
     flight_recorder.reset()  # the request ring is process-global too
+    lifecycle_daemon.clear_drain()  # so is the drain latch a server sets
 
 
 @pytest.fixture()

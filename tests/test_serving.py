@@ -132,6 +132,51 @@ class TestTaxonomy:
         assert ei.value.code == "BADREQ"
 
 
+class TestRetryAfter:
+    """``ERR BUSY`` responses carry a ``retry-after-ms`` hint derived
+    from the queue-wait EWMA (docs/07-interop.md); old bare lines still
+    parse with the hint absent."""
+
+    def test_parse_hint_and_compat(self):
+        e = parse_wire_error("ERR BUSY queue full retry-after-ms=240 "
+                             "trace=0123456789abcdef")
+        assert isinstance(e, ServerBusyError)
+        assert e.retry_after_ms == 240
+        assert e.trace_id == "0123456789abcdef"
+        assert "queue full" in e.message
+        e = parse_wire_error("ERR BUSY queue full retry-after-ms=100")
+        assert e.retry_after_ms == 100 and e.trace_id is None
+        # Old servers: no hint, both forms still parse.
+        e = parse_wire_error("ERR BUSY queue full")
+        assert e.retry_after_ms is None and e.retryable
+        e = parse_wire_error("ERR something broke badly")
+        assert e.code == "FAILED" and e.retry_after_ms is None
+
+    def test_busy_shed_carries_hint_on_wire(self, env):
+        s, data = env
+        with QueryServer(s) as server:
+            server.pool.draining = True  # cheapest deterministic shed
+            with pytest.raises(ServerBusyError) as ei:
+                request_query(server.address, _point_spec(data, 1))
+        assert ei.value.retry_after_ms is not None
+        assert ei.value.retry_after_ms >= 100  # the idle-queue floor
+        assert ei.value.trace_id is not None   # hint composes with echo
+
+    def test_hint_tracks_queue_wait_ewma(self, env):
+        s, _data = env
+        with QueryServer(s) as server:
+            pool = server.pool
+            with pool._lock:
+                pool._queue_wait_ewma_ms = 5000.0
+            assert pool.retry_after_hint_ms() == 10_000  # ~2x the wait
+            with pool._lock:
+                pool._queue_wait_ewma_ms = 10_000_000.0
+            assert pool.retry_after_hint_ms() == 30_000  # capped
+            with pool._lock:
+                pool._queue_wait_ewma_ms = 0.0
+            assert pool.retry_after_hint_ms() == 100     # floored
+
+
 # ---------------------------------------------------------------------------
 # Admission control + load shedding
 # ---------------------------------------------------------------------------
