@@ -96,10 +96,91 @@ N_FILES = int(os.environ.get("HS_BENCH_FILES", 64))
 NUM_BUCKETS = 16
 REPEATS = int(os.environ.get("HS_BENCH_REPS", 5))
 
+def _enclosing_timeout_s() -> Optional[float]:
+    """The wall-clock limit of the environment this bench runs UNDER,
+    when discoverable: an explicit env hint
+    (``HS_BENCH_TIMEOUT_S``), else a coreutils ``timeout`` ancestor's
+    duration argument parsed from /proc.  BENCH_r05 died rc=124 with
+    ``parsed: null`` because the default budget (6300 s) sat ABOVE the
+    driver's wall — the external SIGKILL landed before the alarm-driven
+    finalize ever armed.  Deriving the default from the enclosing
+    timeout makes the in-process finalize fire first, whatever the
+    driver chose."""
+    raw = os.environ.get("HS_BENCH_TIMEOUT_S", "")
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    pid = os.getppid()
+    for _ in range(6):  # a few wrapper layers (sh -c, tee, env) at most
+        if pid <= 1:
+            return None
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                argv = [a.decode("utf-8", "replace")
+                        for a in f.read().split(b"\0") if a]
+            with open(f"/proc/{pid}/stat", "r", encoding="ascii",
+                      errors="replace") as f:
+                stat = f.read()
+        except OSError:
+            return None
+        d = _timeout_duration_from_argv(argv)
+        if d is not None:
+            return d
+        # ppid is the 4th field, counted after the parenthesized comm
+        # (which may itself contain spaces).
+        try:
+            pid = int(stat.rpartition(")")[2].split()[1])
+        except (ValueError, IndexError):
+            return None
+    return None
+
+
+def _timeout_duration_from_argv(argv) -> Optional[float]:
+    """The DURATION argument of a coreutils ``timeout`` command line, in
+    seconds (suffixes s/m/h/d honored), or None."""
+    if not argv or os.path.basename(argv[0]) != "timeout":
+        return None
+    takes_value = {"-k", "--kill-after", "-s", "--signal"}
+    i = 1
+    while i < len(argv):
+        arg = argv[i]
+        if arg in takes_value:
+            i += 2
+            continue
+        if arg.startswith("--") or (arg.startswith("-") and len(arg) > 1
+                                    and not arg[1].isdigit()):
+            i += 1
+            continue
+        scale = {"s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}.get(
+            arg[-1:], None)
+        num = arg[:-1] if scale is not None else arg
+        try:
+            return float(num) * (scale if scale is not None else 1.0)
+        except ValueError:
+            return None
+    return None
+
+
+def _default_budget_s() -> float:
+    """HS_BENCH_BUDGET when set; else derived from the enclosing timeout
+    (minus finalize headroom: 10% of the limit, at least 30 s); else the
+    historical 6300 s default.  0 disables."""
+    raw = os.environ.get("HS_BENCH_BUDGET")
+    if raw is not None:
+        return float(raw)
+    limit = _enclosing_timeout_s()
+    if limit is not None and limit > 0:
+        return max(30.0, limit - max(30.0, 0.1 * limit))
+    return 6300.0
+
+
 # Global wall-clock budget: comfortably under the driver's timeout so the
 # bench finalizes ITSELF (r04's full run fit well inside this; r05 died at
-# the driver's wall instead and lost everything).  0 disables.
-BUDGET_S = float(os.environ.get("HS_BENCH_BUDGET", "6300"))
+# the driver's wall instead and lost everything — the default now derives
+# from the enclosing `timeout` when HS_BENCH_BUDGET is unset).  0 disables.
+BUDGET_S = _default_budget_s()
 SECTION_CAP_S = float(os.environ.get("HS_BENCH_SECTION_CAP", "0"))
 RESULTS_PATH = os.environ.get("HS_BENCH_RESULTS", "bench_results.jsonl")
 TRACE_PATH = os.environ.get(
@@ -941,6 +1022,10 @@ class _Harness:
                 self._mark(name, "skipped", 0.0,
                            self.stop_reason or "not reached")
         self.detail["platform"] = _platform()
+        # The budget the run ACTUALLY ran under — when HS_BENCH_BUDGET
+        # was unset this is the value derived from the enclosing
+        # timeout, so a post-mortem can see why sections were cut.
+        self.detail["budget_s"] = round(BUDGET_S, 1)
         self.detail["bench_elapsed_s"] = round(self.elapsed(), 1)
         self.detail["sections_run"] = self.sections
         if self.results_path and not self._results_broken:
@@ -961,7 +1046,7 @@ SECTION_NAMES = ("setup", "sf1_queries", "device_agg_probe",
                  "resident_agg", "warm_resident_join", "warm_q3",
                  "warm_q10", "window_bench", "kernel_bench",
                  "calibration", "telemetry_overhead", "advisor",
-                 "integrity", "build_profile", "serving",
+                 "integrity", "build_profile", "timeline", "serving",
                  "flight_recorder", "ingest", "sf10", "sf100")
 
 
@@ -1012,6 +1097,7 @@ def main() -> int:
             harness.section("integrity", lambda: _sec_integrity(root))
             harness.section("build_profile",
                             lambda: _sec_build_profile(root))
+            harness.section("timeline", lambda: _sec_timeline(root))
             harness.section("serving", lambda: _sec_serving(ctx))
             harness.section("flight_recorder",
                             lambda: _sec_flight_recorder(ctx))
@@ -2001,6 +2087,127 @@ def _sec_build_profile(root: str) -> dict:
         "report": report.to_dict(),
         "spill_report": spill_report.to_dict(),
         "perf_ledger_rows": ledger_rows,
+    }}
+
+
+def _sec_timeline(root: str) -> dict:
+    """Timeline profiler cost contract + gap-analysis proof
+    (docs/16-observability.md): the SAME covering-index build runs with
+    ``hyperspace.system.timeline.enabled`` off then on (recorder +
+    background memory sampler + kernel seams), and the delta is
+    CORRECTNESS-GATED at < 3% (50 ms absolute floor, like
+    build_profile).  A spill-forced build must then yield a busy-
+    fraction matrix with the read and spill lanes present — the
+    "read idle while spill busy" number ROADMAP item 2's prefetch
+    rewrite is accepted against — and the Perfetto export + doctor()
+    must produce a loadable trace and a graded health status.
+    Self-contained (own source, throwaway sessions)."""
+    import json as _json
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig
+    from hyperspace_tpu.telemetry import timeline as _timeline
+
+    n = max(50_000, N_LINEITEM // 10)
+    files = 8
+    src = os.path.join(root, "timeline_src")
+    os.makedirs(src, exist_ok=True)
+    rng = np.random.default_rng(31)
+    table = pa.table({
+        "k": pa.array(rng.integers(0, max(1, n // 4), size=n),
+                      type=pa.int64()),
+        "v1": rng.random(n),
+        "v2": rng.random(n),
+    })
+    step = -(-n // files)
+    for f in range(files):
+        pq.write_table(table.slice(f * step, step),
+                       os.path.join(src, f"part-{f:05d}.parquet"))
+
+    seq = iter(range(1 << 20))
+    last: dict = {}
+
+    def build(timeline_on: bool, batch_rows: Optional[int] = None) -> None:
+        if not timeline_on:
+            # The enable flag is module-global and sticky (conf never
+            # force-disables, same contract as tracing).
+            _timeline.disable_timeline()
+        s = HyperspaceSession(system_path=os.path.join(
+            root, f"timeline_ix_{next(seq)}"))
+        s.conf.num_buckets = NUM_BUCKETS
+        s.conf.timeline_enabled = timeline_on
+        if batch_rows is not None:
+            s.conf.device_batch_rows = batch_rows
+            s.conf.parallel_build = "off"  # the spill path is single-chip
+        hs = Hyperspace(s)
+        hs.create_index(s.read.parquet(src),
+                        IndexConfig("tlix", ["k"], ["v1", "v2"]))
+        last["session"], last["hs"] = s, hs
+
+    reps = min(3, REPEATS)
+    try:
+        build(True)  # untimed warmup: JIT/import costs land here
+        t_off = _time(lambda: build(False), repeats=reps)
+        t_on = _time(lambda: build(True), repeats=reps)
+        overhead_pct = ((t_on["median"] - t_off["median"])
+                        / t_off["median"] * 100.0)
+        abs_ms = (t_on["median"] - t_off["median"]) * 1000.0
+        if overhead_pct > 3.0 and abs_ms > 50.0:
+            raise SystemExit(
+                f"timeline bench: recorder+sampler overhead "
+                f"{overhead_pct:.1f}% (> 3% and {abs_ms:.1f} ms) on the "
+                f"covering-index build")
+
+        # Spill-forced build: the gap analysis must see the read and
+        # spill lanes and produce the pairwise idle-while-busy matrix.
+        build(True, batch_rows=max(1024, n // 8))
+        hs = last["hs"]
+        report = hs.last_build_report()
+        lanes = report.lane_report()
+        matrix = lanes.get("idle_while_busy", {})
+        for lane_name in ("read", "spill_route"):
+            if lane_name not in lanes.get("lanes", {}):
+                raise SystemExit(
+                    f"timeline bench: spill-forced build recorded no "
+                    f"{lane_name!r} lane; lanes={sorted(lanes.get('lanes', {}))}")
+        read_idle_while_spill = matrix["read"]["spill_route"]
+
+        # Perfetto export must be loadable trace-event JSON.
+        trace_path = os.path.join(root, "timeline_export.json")
+        hs.export_timeline(trace_path)
+        with open(trace_path, "r", encoding="utf-8") as f:
+            payload = _json.load(f)
+        events = payload.get("traceEvents", [])
+        if not events:
+            raise SystemExit("timeline bench: Perfetto export produced "
+                             "no trace events")
+
+        # Doctor: graded status over the section's own (healthy) tree.
+        health = hs.doctor()
+        if health.status not in ("ok", "warn", "crit"):
+            raise SystemExit(
+                f"timeline bench: doctor returned {health.status!r}")
+    finally:
+        # Later sections (serving, sf10) must not pay the recorder.
+        _timeline.disable_timeline()
+        _timeline.reset()
+
+    return {"timeline": {
+        "rows": n,
+        "timeline_off_s": _stat(t_off),
+        "timeline_on_s": _stat(t_on),
+        "overhead_pct": round(overhead_pct, 2),
+        "overhead_ms": round(abs_ms, 2),
+        "busy_fractions": {lane: stats["busy_fraction"]
+                           for lane, stats in lanes["lanes"].items()},
+        "read_idle_while_spill": read_idle_while_spill,
+        "memory_samples": len(report.memory_samples),
+        "phase_peak_rss_mb": report.phase_memory_mb(),
+        "trace_events": len(events),
+        "doctor_status": health.status,
     }}
 
 

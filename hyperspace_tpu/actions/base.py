@@ -151,7 +151,6 @@ class Action:
         conflict reason in ``state``/``message``) and the
         ``action.conflict.retries`` counter, so PR 2's silent rebases can
         be audited per action after the fact."""
-        from hyperspace_tpu.telemetry.trace import span
 
         def emit(state: str, message: str = "") -> None:
             if self.event_class is not None:
@@ -165,6 +164,31 @@ class Action:
         report._t0 = time.perf_counter()
         report.started_at = time.time()
         report.index = self.index_name
+        # Timeline profiler (telemetry/timeline.py): apply this session's
+        # conf and, when enabled, sample memory in the background for the
+        # run's duration — per-phase high-water marks instead of one
+        # end-of-action peak.  The finally covers InjectedCrash too: a
+        # leaked sampler thread would outlive the simulated kill.
+        from hyperspace_tpu.telemetry import timeline
+        from hyperspace_tpu.telemetry import build_report as _br
+
+        sampler = None
+        session = getattr(self, "session", None)
+        if session is not None:
+            timeline.configure_from_conf(session.conf)
+            if _br.profiling_enabled(session.conf):
+                sampler = timeline.start_sampler(session.conf, report)
+        try:
+            return self._run_transaction(emit, rng)
+        finally:
+            if sampler is not None:
+                sampler.stop()
+
+    def _run_transaction(self, emit, rng) -> str:
+        """The conflict-retrying transaction loop proper (split from
+        ``run()`` so the sampler's try/finally wraps the whole thing)."""
+        from hyperspace_tpu.telemetry.trace import span
+
         with span(f"action.{type(self).__name__}",
                   index=self.index_name) as sp:
             try:

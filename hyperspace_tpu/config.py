@@ -63,6 +63,11 @@ IO_RETRY_MAX_BACKOFF_MS = "hyperspace.system.io.retry.maxBackoffMs"
 TELEMETRY_TRACING_ENABLED = "hyperspace.system.telemetry.tracing.enabled"
 TELEMETRY_TRACE_SINK = "hyperspace.system.telemetry.trace.sink"
 TELEMETRY_TRACE_MAX_BYTES = "hyperspace.system.telemetry.trace.maxBytes"
+TIMELINE_ENABLED = "hyperspace.system.timeline.enabled"
+TIMELINE_MAX_INTERVALS = "hyperspace.system.timeline.maxIntervals"
+TIMELINE_MEMORY_SAMPLE_MS = "hyperspace.system.timeline.memorySampleMs"
+DOCTOR_LATENCY_SLO_MS = "hyperspace.doctor.latencySloMs"
+DOCTOR_SHED_WARN_RATIO = "hyperspace.doctor.shedWarnRatio"
 BUILD_PROFILING_ENABLED = "hyperspace.system.buildProfiling.enabled"
 PERF_LEDGER_ENABLED = "hyperspace.system.perf.ledger.enabled"
 PERF_LEDGER_MAX_ENTRIES = "hyperspace.system.perf.ledger.maxEntries"
@@ -295,6 +300,25 @@ class HyperspaceConf:
     # to <path>.1 (replacing the previous rotation), so a long-lived
     # traced server keeps at most ~2x this on disk.  0 = unbounded.
     telemetry_trace_max_bytes: int = 256 << 20
+    # Pipeline timeline profiler (telemetry/timeline.py;
+    # docs/16-observability.md): interval-level recording — every
+    # BuildReport phase (incl. spill worker threads), executor operator,
+    # and block_until_ready-timed device kernel lands as a
+    # (lane, kind, start, end) interval in a bounded process ring, plus
+    # a background memory sampler during profiled actions.  Off by
+    # default; the disabled cost is one module-global bool check (the
+    # device-kernel seams never force a sync while off).  maxIntervals
+    # bounds the ring (oldest dropped, counted in timeline.dropped);
+    # memorySampleMs is the sampler cadence (0 disables the sampler).
+    timeline_enabled: bool = False
+    timeline_max_intervals: int = 8192
+    timeline_memory_sample_ms: float = 25.0
+    # Hyperspace.doctor() thresholds (telemetry/doctor.py): the serving
+    # check warns past shed/requests >= shedWarnRatio (crit at 5x) and
+    # grades latency-SLO burn as the fraction of serve.latency_ms
+    # observations above latencySloMs.
+    doctor_latency_slo_ms: float = 1000.0
+    doctor_shed_warn_ratio: float = 0.05
     # Build-pipeline profiler (telemetry/build_report.py): every action
     # run records per-phase wall time, bytes moved, spill counts, and
     # memory gauges into a BuildReport (Hyperspace.last_build_report()),
@@ -468,6 +492,11 @@ class HyperspaceConf:
         TELEMETRY_TRACING_ENABLED: "telemetry_tracing_enabled",
         TELEMETRY_TRACE_SINK: "telemetry_trace_sink",
         TELEMETRY_TRACE_MAX_BYTES: "telemetry_trace_max_bytes",
+        TIMELINE_ENABLED: "timeline_enabled",
+        TIMELINE_MAX_INTERVALS: "timeline_max_intervals",
+        TIMELINE_MEMORY_SAMPLE_MS: "timeline_memory_sample_ms",
+        DOCTOR_LATENCY_SLO_MS: "doctor_latency_slo_ms",
+        DOCTOR_SHED_WARN_RATIO: "doctor_shed_warn_ratio",
         BUILD_PROFILING_ENABLED: "build_profiling_enabled",
         PERF_LEDGER_ENABLED: "perf_ledger_enabled",
         PERF_LEDGER_MAX_ENTRIES: "perf_ledger_max_entries",

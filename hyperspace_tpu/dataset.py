@@ -304,6 +304,9 @@ class Dataset:
         # Conf set after session construction still wins (same contract as
         # the fault injector / integrity conf re-application).
         trace.configure_from_conf(self.session.conf)
+        from hyperspace_tpu.telemetry import timeline
+
+        timeline.configure_from_conf(self.session.conf)
         token = run_report.start()
         query_span = None
         try:

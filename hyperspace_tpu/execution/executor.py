@@ -27,6 +27,7 @@ import pyarrow as pa
 import pyarrow.compute as pc
 
 from hyperspace_tpu.io import columnar
+from hyperspace_tpu.telemetry import timeline
 from hyperspace_tpu.utils import deadline as _deadline
 from hyperspace_tpu.utils.compat import enable_x64 as _enable_x64
 from hyperspace_tpu.io.files import list_data_files
@@ -173,6 +174,7 @@ class Executor:
         host = convert(table.column(column))
         with _enable_x64():  # int64 columns must keep full width
             dev = jax.device_put(np.asarray(host))
+        timeline.record_transfer("h2d", int(getattr(dev, "nbytes", 0)))
         cache.put(key, dev, self.session.conf.device_cache_bytes)
         counters["misses"] += 1
         return dev
@@ -252,7 +254,13 @@ class Executor:
         # stacked above it.  The exit check fires right after the child
         # work that consumed the budget, before the parent spends more.
         # One contextvar read each when no deadline is set.
+        # Timeline (telemetry/timeline.py, conf-gated): each operator
+        # dispatch lands as one interval on the "exec" lane, so the
+        # Perfetto export shows operator time against the device and
+        # build lanes.  Disabled cost: one bool check.
+        t0 = timeline.op_begin()
         out = self._execute_node(plan)
+        timeline.op_end("exec", type(plan).__name__, t0)
         _deadline.check(type(plan).__name__)
         return out
 
@@ -1266,9 +1274,13 @@ class Executor:
             return eval_predicate_on_mesh(fn, device_cols, literals)
         device_cols = [self._device_column(table, c, identity, "num")
                        for c in order]
+        t0 = timeline.kernel_begin()
         with _enable_x64():
             mask = fn(device_cols, literals)
-        return np.asarray(mask)
+        timeline.kernel_end("filter", t0, mask)
+        out = np.asarray(mask)
+        timeline.record_transfer("d2h", int(out.nbytes))
+        return out
 
     def _normalize_literals(self, expr: Expr, table: pa.Table) -> Expr:
         """Rewrite temporal/bool literals to their int64 device domain."""

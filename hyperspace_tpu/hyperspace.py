@@ -138,16 +138,90 @@ class Hyperspace:
 
         return last_report()
 
-    def perf_history(self) -> pa.Table:
+    def perf_history(self, index: str = None, section: str = None,
+                     limit: int = None) -> pa.Table:
         """The persistent perf ledger (telemetry/perf_ledger.py) as an
         arrow table — one row per recorded action/bench-section run under
         ``<systemPath>/_hyperspace_perf``, oldest first, readable over
         both LogStore backends.  Columns: key, kind, name, ts,
         wallSeconds, outcome, phasesJson, bytesWritten, spillBytes,
-        recordJson (the full record)."""
+        recordJson (the full record).
+
+        Filters (also on the interop ``perf_history`` verb): ``index``
+        keeps action records for that index, ``section`` keeps bench
+        records for that section, ``limit`` keeps the most recent N
+        after filtering — callers used to re-filter raw records by
+        hand."""
         from hyperspace_tpu.telemetry.perf_ledger import history_table
 
-        return history_table(self.session.conf)
+        return history_table(self.session.conf, index=index,
+                             section=section, limit=limit)
+
+    # -- timeline profiler + health doctor (docs/16-observability.md) -------
+    def export_timeline(self, path: str, trace_id: str = None,
+                        ledger_key: str = None) -> str:
+        """Write a Perfetto/Chrome trace-event JSON file to ``path``
+        (load it in ui.perfetto.dev or chrome://tracing).
+
+        Default: the live timeline ring — build-phase / executor /
+        device-kernel lanes plus the memory counter track
+        (``hyperspace.system.timeline.enabled`` must be on to have
+        recorded anything) and the most recent query's span tree when
+        one is attached.  ``trace_id`` instead reconstructs from that
+        flight-recorder retained record's span tree; ``ledger_key``
+        reconstructs from that perf-ledger record's phase seconds —
+        both work after the fact, without the ring."""
+        from hyperspace_tpu.telemetry import timeline
+
+        if trace_id is not None:
+            from hyperspace_tpu.telemetry import flight_recorder
+
+            rec = flight_recorder.recorder().find(trace_id.lower())
+            if rec is None:
+                raise ValueError(
+                    f"no retained flight record for trace id {trace_id!r}")
+            timeline.export_chrome_trace(
+                path, intervals=(), memory_samples=(),
+                span_roots=[rec["spans"]] if rec.get("spans") else ())
+            return path
+        if ledger_key is not None:
+            import json as _json
+
+            from hyperspace_tpu.telemetry import perf_ledger
+
+            for rec in perf_ledger.records(self.session.conf):
+                if rec.get("key") == ledger_key:
+                    events = timeline.ledger_to_trace_events(rec)
+                    from hyperspace_tpu.telemetry.trace import span
+
+                    with span("timeline.export", path=path) as sp:
+                        # hslint: allow[io-seam] user-chosen export path
+                        with open(path, "w", encoding="utf-8") as f:
+                            _json.dump({"traceEvents": events,
+                                        "displayTimeUnit": "ms"}, f)
+                        sp.set(events=len(events))
+                    return path
+            raise ValueError(f"no perf-ledger record {ledger_key!r}")
+        roots = []
+        rep = self.session.last_run_report_value
+        if rep is not None and rep.root_span is not None:
+            roots.append(rep.root_span)
+        timeline.export_chrome_trace(path, span_roots=roots)
+        return path
+
+    def doctor(self):
+        """One aggregated health report over everything the telemetry
+        stack knows (telemetry/doctor.py): quarantine/containment state,
+        per-index staleness via the lifecycle change detector, daemon
+        failure backoffs, the perf-ledger trend, serving shed rate and
+        latency-SLO burn, degraded events — graded ok/warn/crit, worst
+        check wins, published as the ``health.status`` gauge.  Cheap
+        (stat-level listings and process counters only), also served by
+        the inline interop ``doctor`` verb so it works during
+        overload."""
+        from hyperspace_tpu.telemetry.doctor import doctor
+
+        return doctor(self.session)
 
     # -- flight recorder / diagnostics (docs/16-observability.md) -----------
     def slow_queries(self) -> pa.Table:

@@ -730,11 +730,17 @@ def _serve_verb(session, spec: Dict[str, Any],
                                       the report per connection)
       {"verb": "workload"}         -> the captured advisor workload table
                                       (advisor/workload.py)
-      {"verb": "perf_history"}     -> the persistent perf ledger
+      {"verb": "perf_history",
+       "index"?, "section"?,
+       "limit"?}                    -> the persistent perf ledger
                                       (telemetry/perf_ledger.py): one row
                                       per recorded action/bench-section
                                       run under the serving session's
-                                      systemPath
+                                      systemPath; optional filters —
+                                      ``index`` keeps action records for
+                                      that index, ``section`` keeps
+                                      bench records for that section,
+                                      ``limit`` the most recent N
       {"verb": "build_report"}     -> one row, column ``report_json`` —
                                       the session's most recent action
                                       BuildReport (session-wide: builds
@@ -751,6 +757,12 @@ def _serve_verb(session, spec: Dict[str, Any],
                                       trace id; the id every response
                                       echoes (``trace=``) and every
                                       client error carries
+      {"verb": "doctor"}           -> the aggregated health report
+                                      (telemetry/doctor.py): one row per
+                                      check (columns check, status,
+                                      summary, dataJson) plus the
+                                      ``overall`` row — ok/warn/crit,
+                                      worst check wins
       {"verb": "lifecycle"}        -> the lifecycle decision journal
                                       (lifecycle/journal.py): every
                                       maintenance-daemon decision —
@@ -800,7 +812,18 @@ def _serve_verb(session, spec: Dict[str, Any],
     if verb == "perf_history":
         from hyperspace_tpu.telemetry.perf_ledger import history_table
 
-        return history_table(session.conf)
+        index = spec.get("index")
+        section = spec.get("section")
+        limit = spec.get("limit")
+        if index is not None and not isinstance(index, str):
+            raise ValueError('"index" must be a string')
+        if section is not None and not isinstance(section, str):
+            raise ValueError('"section" must be a string')
+        if limit is not None and (not isinstance(limit, int)
+                                  or isinstance(limit, bool) or limit < 0):
+            raise ValueError('"limit" must be a non-negative integer')
+        return history_table(session.conf, index=index, section=section,
+                             limit=limit)
     if verb == "build_report":
         report = session.last_build_report_value
         payload = json.dumps(report.to_dict() if report is not None
@@ -829,13 +852,18 @@ def _serve_verb(session, spec: Dict[str, Any],
                 f"are always kept while they fit the ring)")
         return pa.table({"record_json": pa.array(
             [json.dumps(rec, default=str)], type=pa.string())})
+    if verb == "doctor":
+        from hyperspace_tpu.telemetry.doctor import doctor
+
+        return doctor(session).table()
     if verb == "lifecycle":
         from hyperspace_tpu.lifecycle.journal import history_table
 
         return history_table(session.conf)
     raise ValueError(f"Unknown verb {verb!r}; expected metrics, "
                      f"last_run_report, workload, perf_history, "
-                     f"build_report, slow_queries, trace, or lifecycle")
+                     f"build_report, slow_queries, trace, doctor, or "
+                     f"lifecycle")
 
 
 def _is_loopback(host: str) -> bool:

@@ -104,6 +104,17 @@ class MaintenanceDaemon:
             self._thread.join(timeout=timeout_s)
             self._thread = None
 
+    def backoff_snapshot(self) -> Dict[str, dict]:
+        """Indexes currently in failure backoff (for ``doctor()``):
+        name -> {failures, retry_in_s}.  Expired entries (their
+        not-before already passed) are omitted — they will be retried
+        on the next cycle, not skipped."""
+        now = time.monotonic()
+        return {name: {"failures": failures,
+                       "retry_in_s": round(not_before - now, 1)}
+                for name, (failures, not_before) in self._backoff.items()
+                if not_before > now}
+
     def _run(self) -> None:
         while not self._stop.is_set():
             try:

@@ -291,6 +291,9 @@ REQUIRED_BENCH_SPANS = (
     "bench.flight_recorder",
     "bench.ingest",
     "lifecycle.cycle",
+    "bench.timeline",
+    "timeline.export",
+    "doctor.run",
 )
 
 

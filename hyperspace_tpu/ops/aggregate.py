@@ -139,6 +139,14 @@ def grouped_aggregate(
     capacity_rows = n
     if pad_to and pad_to > 0:
         capacity_rows = -(-max(n, 1) // pad_to) * pad_to
+    from hyperspace_tpu.telemetry import timeline
+
+    t0 = timeline.kernel_begin()
+    if t0 is not None:
+        timeline.record_transfer("h2d", sum(
+            int(getattr(a, "nbytes", 0))
+            for a in (*key_words, *value_cols)
+            if not isinstance(a, jax.Array)))
     with _enable_x64():
         # Device-resident inputs (jax arrays from the HBM cache) pass
         # through _pad_rows untouched — it pads them on device instead of
@@ -150,11 +158,13 @@ def grouped_aggregate(
         perm, boundaries, n_groups = _group_sort(kw, n)
         g = int(n_groups)
         if g == 0:
+            timeline.kernel_end("aggregate", t0, perm)
             return (np.empty(0, np.int32), np.empty(0, np.int32),
                     [np.empty(0) for _ in ops])
         capacity = round_up_pow2(g)
         out = _segment_reduce(perm, boundaries, n, vc,
                               ops=tuple(ops), capacity=capacity)
+    timeline.kernel_end("aggregate", t0, out)
     first_rows = np.asarray(out[0])[:g]
     counts = np.asarray(out[1])[:g]
     results = [np.asarray(r)[:g] for r in out[2:]]

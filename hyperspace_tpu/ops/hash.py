@@ -120,4 +120,9 @@ def bucket_ids(word_cols: Sequence[jnp.ndarray], num_buckets: int) -> jnp.ndarra
     The pallas/XLA choice is part of the jit cache key (static arg): env
     flips between calls retrace instead of silently reusing the old path.
     """
-    return _bucket_ids_impl(tuple(word_cols), num_buckets, use_pallas())
+    from hyperspace_tpu.telemetry import timeline
+
+    t0 = timeline.kernel_begin()
+    out = _bucket_ids_impl(tuple(word_cols), num_buckets, use_pallas())
+    timeline.kernel_end("bucket_ids", t0, out)
+    return out

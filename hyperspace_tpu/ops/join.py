@@ -208,6 +208,13 @@ def sorted_equi_join(left_keys: np.ndarray, right_keys: np.ndarray
         if fits32(left_keys) and fits32(right_keys):
             left_keys = left_keys.astype(np.int32, copy=False)
             right_keys = right_keys.astype(np.int32, copy=False)
+    from hyperspace_tpu.telemetry import timeline
+
+    t0 = timeline.kernel_begin()
+    if t0 is not None and not resident:
+        # Attribution seam (conf-gated): host inputs are about to ship.
+        timeline.record_transfer(
+            "h2d", int(left_keys.nbytes) + int(right_keys.nbytes))
     with _enable_x64():
         lk = jnp.asarray(left_keys)
         rk = jnp.asarray(right_keys)
@@ -216,8 +223,14 @@ def sorted_equi_join(left_keys: np.ndarray, right_keys: np.ndarray
         lo, hi = _match_ranges(lk, rk_sorted)
         total = int(jnp.sum(hi - lo))  # host sync: the one dynamic-shape point
         if total == 0:
+            timeline.kernel_end("join", t0, (lo, hi))
             return np.empty(0, dtype=np.int64), np.empty(0, dtype=np.int64)
         capacity = round_up_pow2(total)
         left_idx, right_pos = _expand(lo, hi, capacity)
         right_idx = r_perm[jnp.clip(right_pos, 0, rk.shape[0] - 1)]
-        return np.asarray(left_idx)[:total], np.asarray(right_idx)[:total]
+        timeline.kernel_end("join", t0, (left_idx, right_idx))
+        out_l = np.asarray(left_idx)[:total]
+        out_r = np.asarray(right_idx)[:total]
+        timeline.record_transfer("d2h",
+                                 int(out_l.nbytes) + int(out_r.nbytes))
+        return out_l, out_r

@@ -81,14 +81,43 @@ class BuildReport:
         # diff counts here — the RefreshSummary surfaced through
         # ``last_build_report()``); flat scalars only.
         self.properties: Dict[str, Any] = {}
+        # Timeline intervals (telemetry/timeline.py, when enabled): one
+        # (lane, start_ns, end_ns) per add_phase call — lane = phase
+        # name — so the gap/overlap analysis can say "read idle while
+        # spill_route busy", which summed seconds cannot.  Memory
+        # samples are fed by the background sampler; per-phase
+        # high-water marks come from intersecting the two.
+        self.intervals: list = []
+        self.memory_samples: list = []
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
 
     # -- recording (thread-safe: spill route/finish pools call in) ----------
     def add_phase(self, name: str, seconds: float) -> None:
+        from hyperspace_tpu.telemetry import timeline
+
         name = _phase_key(name)
         with self._lock:
             self.phases[name] = self.phases.get(name, 0.0) + float(seconds)
+        if timeline.timeline_enabled():
+            # The caller timed [now - seconds, now]: reconstruct the
+            # interval without touching any call site.
+            end_ns = time.monotonic_ns()
+            start_ns = end_ns - int(float(seconds) * 1e9)
+            with self._lock:
+                if len(self.intervals) < 8192:  # a runaway phase loop
+                    self.intervals.append((name, start_ns, end_ns))
+            timeline.record_interval(name, "build.phase", start_ns,
+                                     end_ns)
+
+    def add_memory_sample(self, ts_ns: int, rss_mb: float,
+                          device_bytes: int) -> None:
+        """One background-sampler observation (timeline.MemorySampler
+        sink contract)."""
+        with self._lock:
+            if len(self.memory_samples) < 8192:
+                self.memory_samples.append(
+                    (int(ts_ns), float(rss_mb), int(device_bytes)))
 
     def add_bytes(self, *, read: int = 0, written: int = 0, files: int = 0,
                   spill: int = 0, spill_runs: int = 0) -> None:
@@ -125,6 +154,33 @@ class BuildReport:
     # -- derived -------------------------------------------------------------
     def phase_total_s(self) -> float:
         return sum(self.phases.values())
+
+    def lane_report(self) -> Dict[str, Any]:
+        """Gap/overlap analysis over this build's recorded intervals
+        (``hyperspace.system.timeline.enabled`` must have been on):
+        per-lane busy fractions plus the pairwise "X idle while Y busy"
+        matrix — ``idle_while_busy["read"]["spill_route"]`` is ROADMAP
+        item 2's serialization claim as a measured number."""
+        from hyperspace_tpu.telemetry import timeline
+
+        with self._lock:
+            intervals = [(lane, s, e) for lane, s, e in self.intervals]
+        return timeline.busy_report(intervals)
+
+    def phase_memory_mb(self) -> Dict[str, float]:
+        """Per-phase high-water host RSS (MB): the max sampled RSS whose
+        timestamp falls inside any of that phase's intervals — what
+        "the spill phase peaks at X" means, instead of one end-of-action
+        peak that cannot name its phase."""
+        with self._lock:
+            intervals = list(self.intervals)
+            samples = list(self.memory_samples)
+        out: Dict[str, float] = {}
+        for lane, s, e in intervals:
+            for ts, rss_mb, _dev in samples:
+                if s <= ts <= e and rss_mb > out.get(lane, 0.0):
+                    out[lane] = rss_mb
+        return {k: round(v, 1) for k, v in sorted(out.items())}
 
     @property
     def device_s(self) -> float:
@@ -206,6 +262,12 @@ class BuildReport:
             "device_live_bytes": self.device_live_bytes,
             **({"properties": dict(sorted(self.properties.items()))}
                if self.properties else {}),
+            # Timeline extras (present only when the interval recorder
+            # was on for this build): the busy-fraction matrix and the
+            # per-phase memory high-water marks.
+            **({"lanes": self.lane_report()} if self.intervals else {}),
+            **({"phase_peak_rss_mb": self.phase_memory_mb()}
+               if self.memory_samples and self.intervals else {}),
         }
 
     def render(self) -> str:

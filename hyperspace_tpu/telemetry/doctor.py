@@ -1,0 +1,327 @@
+"""``Hyperspace.doctor()``: one aggregated ok/warn/crit health report.
+
+Six PRs of telemetry (quarantine records, the lifecycle change detector,
+daemon backoffs, the perf ledger, the serving counters, degraded-event
+metrics) each answer their own question; an operator paged at 3am needs
+ONE.  The doctor runs every check, grades each ``ok`` / ``warn`` /
+``crit``, and reports the worst as the overall status — also published
+as the ``health.status`` gauge (0/1/2) so a scrape alert fires without
+parsing anything.
+
+Checks (each never raises — a check that cannot run reports itself
+``warn`` with the error, because "the doctor is blind here" is itself a
+finding):
+
+  ================  =========================================================
+  ``integrity``     per-index quarantine records (index/quarantine.py):
+                    any quarantined file is ``crit`` — queries still
+                    answer (containment), but data is damaged and a
+                    ``refresh_index(mode="repair")`` is pending.  A
+                    degraded index LISTING is ``crit`` too.
+  ``staleness``     per-ACTIVE-index stat-level change detection
+                    (lifecycle/change_detector.py): source drifted from
+                    the recorded set → ``warn`` with the per-index
+                    appended/deleted/mutated counts and staleness
+                    seconds.
+  ``maintenance``   lifecycle-daemon failure backoffs in force → ``warn``
+                    (an index the daemon cannot maintain is quietly
+                    going stale).
+  ``perf``          perf-ledger trend: for each action name with enough
+                    history, the latest ``wall_s`` against the median of
+                    its predecessors, judged by the bench_compare
+                    direction rules — a ≥ 25% AND ≥ 0.5 s regression is
+                    ``warn``.
+  ``serving``       shed rate (``serve.shed`` / ``serve.requests``)
+                    above ``hyperspace.doctor.shedWarnRatio`` → ``warn``
+                    (``crit`` past 5× the ratio); latency SLO burn — the
+                    fraction of ``serve.latency_ms`` observations above
+                    ``hyperspace.doctor.latencySloMs`` — past 10% →
+                    ``warn``, past 50% → ``crit``.
+  ``degraded``      ``degraded.fallbacks`` / ``quarantine.files``
+                    counters nonzero this process → ``warn``.
+  ================  =========================================================
+
+The report is cheap (stat-level listings, process counters, one ledger
+read — no data reads, no query execution), which is why the interop
+``doctor`` verb answers INLINE like ``metrics``: it keeps working while
+the admission queue is shedding, exactly when an operator needs it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import statistics
+import time
+from typing import Any, Dict, List, Optional
+
+SEVERITY = {"ok": 0, "warn": 1, "crit": 2}
+_STATUS = {v: k for k, v in SEVERITY.items()}
+
+
+@dataclasses.dataclass
+class DoctorCheck:
+    name: str
+    status: str            # "ok" | "warn" | "crit"
+    summary: str
+    data: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "status": self.status,
+                "summary": self.summary, "data": dict(self.data)}
+
+
+class DoctorReport:
+    def __init__(self, checks: List[DoctorCheck]) -> None:
+        self.ts = time.time()
+        self.checks = checks
+
+    @property
+    def status(self) -> str:
+        worst = max((SEVERITY[c.status] for c in self.checks), default=0)
+        return _STATUS[worst]
+
+    def check(self, name: str) -> Optional[DoctorCheck]:
+        for c in self.checks:
+            if c.name == name:
+                return c
+        return None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"ts": self.ts, "status": self.status,
+                "checks": [c.to_dict() for c in self.checks]}
+
+    def render(self) -> str:
+        lines = [f"Doctor: {self.status.upper()}"]
+        for c in self.checks:
+            lines.append(f"  [{c.status:<4}] {c.name:<12} {c.summary}")
+        return "\n".join(lines)
+
+    def table(self):
+        """Arrow shape the interop ``doctor`` verb serves: one row per
+        check plus the ``overall`` row."""
+        import json
+
+        import pyarrow as pa
+
+        names = ["overall"] + [c.name for c in self.checks]
+        statuses = [self.status] + [c.status for c in self.checks]
+        summaries = [f"{len(self.checks)} checks"] \
+            + [c.summary for c in self.checks]
+        data = [json.dumps({})] + [json.dumps(c.data, default=str)
+                                   for c in self.checks]
+        return pa.table({
+            "check": pa.array(names, type=pa.string()),
+            "status": pa.array(statuses, type=pa.string()),
+            "summary": pa.array(summaries, type=pa.string()),
+            "dataJson": pa.array(data, type=pa.string()),
+        })
+
+
+def _guarded(name: str, fn) -> DoctorCheck:
+    try:
+        return fn()
+    except Exception as e:  # noqa: BLE001 — a blind check is a finding,
+        return DoctorCheck(  # never a crash
+            name, "warn", f"check failed: {type(e).__name__}: {e}")
+
+
+def doctor(session) -> DoctorReport:
+    """Run every health check against ``session``'s index tree and this
+    process's telemetry; publish ``health.status``."""
+    from hyperspace_tpu.telemetry import metrics
+    from hyperspace_tpu.telemetry.trace import span
+
+    with span("doctor.run") as sp:
+        checks = [
+            _guarded("integrity", lambda: _check_integrity(session)),
+            _guarded("staleness", lambda: _check_staleness(session)),
+            _guarded("maintenance", lambda: _check_maintenance(session)),
+            _guarded("perf", lambda: _check_perf(session)),
+            _guarded("serving", lambda: _check_serving(session)),
+            _guarded("degraded", lambda: _check_degraded(session)),
+        ]
+        report = DoctorReport(checks)
+        metrics.inc("doctor.runs")
+        metrics.set_gauge("health.status", SEVERITY[report.status])
+        sp.set(status=report.status, checks=len(checks))
+        return report
+
+
+# ---------------------------------------------------------------------------
+# Checks
+# ---------------------------------------------------------------------------
+def _check_integrity(session) -> DoctorCheck:
+    manager = session.index_collection_manager
+    entries = manager.get_indexes()
+    quarantined: Dict[str, int] = {}
+    for entry in entries:
+        count = len(manager.quarantine_manager(entry.name).records())
+        if count:
+            quarantined[entry.name] = count
+    if getattr(manager, "last_listing_degraded", False):
+        return DoctorCheck(
+            "integrity", "crit",
+            "index listing degraded: at least one index log is unreadable",
+            {"indexes": len(entries)})
+    if quarantined:
+        total = sum(quarantined.values())
+        return DoctorCheck(
+            "integrity", "crit",
+            f"{total} quarantined file(s) across "
+            f"{len(quarantined)} index(es) — queries answer via "
+            f"containment; run refresh_index(mode=\"repair\")",
+            {"quarantined": quarantined})
+    return DoctorCheck("integrity", "ok",
+                       f"{len(entries)} index(es), no quarantine records",
+                       {"indexes": len(entries)})
+
+
+def _check_staleness(session) -> DoctorCheck:
+    from hyperspace_tpu.index.log_entry import States
+    from hyperspace_tpu.lifecycle.change_detector import detect_changes
+
+    manager = session.index_collection_manager
+    entries = [e for e in manager.get_indexes()
+               if e.state == States.ACTIVE]
+    stale: Dict[str, Dict[str, Any]] = {}
+    now = time.time()
+    for entry in entries:
+        try:
+            change = detect_changes(session, entry)
+        except Exception as e:  # noqa: BLE001 — an unlistable source is
+            stale[entry.name] = {"error": str(e)}  # itself staleness risk
+            continue
+        if change.changed:
+            staleness_s = (max(0.0, now - change.newest_change_ms / 1000.0)
+                           if change.newest_change_ms > 0 else 0.0)
+            stale[entry.name] = {"appended": change.appended,
+                                 "deleted": change.deleted,
+                                 "mutated": change.mutated,
+                                 "staleness_s": round(staleness_s, 1)}
+    if stale:
+        return DoctorCheck(
+            "staleness", "warn",
+            f"{len(stale)}/{len(entries)} ACTIVE index(es) behind their "
+            f"source — refresh (or enable the lifecycle daemon)",
+            {"stale": stale})
+    return DoctorCheck("staleness", "ok",
+                       f"{len(entries)} ACTIVE index(es) current",
+                       {"indexes": len(entries)})
+
+
+def _check_maintenance(session) -> DoctorCheck:
+    from hyperspace_tpu.lifecycle.daemon import daemon_for
+
+    backoffs = daemon_for(session).backoff_snapshot()
+    if backoffs:
+        return DoctorCheck(
+            "maintenance", "warn",
+            f"{len(backoffs)} index(es) in failure backoff — the daemon "
+            f"cannot maintain them right now",
+            {"backoffs": backoffs})
+    return DoctorCheck("maintenance", "ok", "no failure backoffs", {})
+
+
+def _check_perf(session, min_history: int = 4,
+                threshold_pct: float = 25.0,
+                min_abs_s: float = 0.5) -> DoctorCheck:
+    """Latest-vs-history trend per recorded action name, judged by the
+    bench_compare direction rules (``wall_s`` → lower is better)."""
+    from hyperspace_tpu.telemetry import bench_compare, perf_ledger
+
+    direction = bench_compare._direction("wall_s")
+    by_name: Dict[str, List[float]] = {}
+    for rec in perf_ledger.records(session.conf):
+        if rec.get("kind") != "action" or rec.get("outcome") != "ok":
+            continue
+        try:
+            by_name.setdefault(str(rec.get("name", "")), []).append(
+                float(rec.get("wall_s", 0.0)))
+        except (TypeError, ValueError):
+            continue
+    regressions: Dict[str, Dict[str, float]] = {}
+    for name, walls in by_name.items():
+        if len(walls) < min_history:
+            continue
+        latest = walls[-1]
+        baseline = statistics.median(walls[-9:-1])
+        if baseline <= 0:
+            continue
+        worse = latest - baseline if direction == "lower" \
+            else baseline - latest
+        if worse > min_abs_s and worse / baseline * 100.0 > threshold_pct:
+            regressions[name] = {"latest_s": round(latest, 3),
+                                 "baseline_s": round(baseline, 3)}
+    if regressions:
+        return DoctorCheck(
+            "perf", "warn",
+            f"{len(regressions)} action(s) trending slower than their "
+            f"ledger history",
+            {"regressions": regressions})
+    return DoctorCheck("perf", "ok",
+                       f"{len(by_name)} action name(s) in the ledger, "
+                       f"no regression trend", {})
+
+
+def _check_serving(session) -> DoctorCheck:
+    from hyperspace_tpu.telemetry import metrics
+
+    conf = session.conf
+    snap = metrics.snapshot()
+    requests = float(snap.get("serve.requests", 0.0) or 0.0)
+    shed = float(snap.get("serve.shed", 0.0) or 0.0)
+    if requests <= 0:
+        return DoctorCheck("serving", "ok", "no served traffic", {})
+    shed_ratio = shed / requests
+    warn_ratio = float(getattr(conf, "doctor_shed_warn_ratio", 0.05))
+    slo_ms = float(getattr(conf, "doctor_latency_slo_ms", 1000.0))
+    burn = _slo_burn(snap.get("serve.latency_ms"), slo_ms)
+    data = {"requests": int(requests), "shed_ratio": round(shed_ratio, 4),
+            "slo_ms": slo_ms, "slo_burn": round(burn, 4)}
+    if (warn_ratio > 0 and shed_ratio >= 5 * warn_ratio) or burn >= 0.5:
+        return DoctorCheck(
+            "serving", "crit",
+            f"overloaded: shed ratio {shed_ratio:.2f}, SLO burn "
+            f"{burn:.2f}", data)
+    if (warn_ratio > 0 and shed_ratio >= warn_ratio) or burn >= 0.1:
+        return DoctorCheck(
+            "serving", "warn",
+            f"shed ratio {shed_ratio:.2f}, SLO burn {burn:.2f}", data)
+    return DoctorCheck(
+        "serving", "ok",
+        f"{int(requests)} requests, shed ratio {shed_ratio:.2f}, "
+        f"SLO burn {burn:.2f}", data)
+
+
+def _slo_burn(hist_snapshot, slo_ms: float) -> float:
+    """Fraction of latency observations ABOVE the SLO, from a histogram
+    snapshot's cumulative-by-construction fixed buckets (the first
+    bucket bound ≥ the SLO splits under/over conservatively)."""
+    if not isinstance(hist_snapshot, dict) or slo_ms <= 0:
+        return 0.0
+    count = float(hist_snapshot.get("count", 0) or 0)
+    buckets = hist_snapshot.get("buckets")
+    if count <= 0 or not isinstance(buckets, dict):
+        return 0.0
+    under = 0.0
+    for bound, n in buckets.items():
+        b = float("inf") if bound == "+Inf" else float(bound)
+        if b <= slo_ms:
+            under += float(n)
+    return max(0.0, (count - under) / count)
+
+
+def _check_degraded(session) -> DoctorCheck:
+    from hyperspace_tpu.telemetry import metrics
+
+    snap = metrics.snapshot()
+    fallbacks = float(snap.get("degraded.fallbacks", 0.0) or 0.0)
+    contained = float(snap.get("quarantine.files", 0.0) or 0.0)
+    if fallbacks or contained:
+        return DoctorCheck(
+            "degraded", "warn",
+            f"{int(fallbacks)} degraded fallback(s), "
+            f"{int(contained)} execution-time quarantine(s) this process",
+            {"fallbacks": int(fallbacks), "quarantines": int(contained)})
+    return DoctorCheck("degraded", "ok",
+                       "no degraded events this process", {})

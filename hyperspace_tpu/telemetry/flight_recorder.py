@@ -130,6 +130,11 @@ class FlightRecorder:
                 "slow": bool(slow_ms > 0 and latency_ms >= slow_ms),
                 "reason": reason,
                 "plan_fingerprint": _plan_fingerprint(report),
+                # Attributed device-kernel ms (timeline seams; 0.0 when
+                # the timeline was off or nothing ran on device): the
+                # device-bound vs queue-bound discriminator for tails —
+                # compare against queue_wait_ms and latency_ms.
+                "device_ms": _device_ms(report),
                 "spans": span.to_dict() if span is not None else None,
                 "report": report.to_dict() if report is not None else None,
             }
@@ -194,6 +199,16 @@ def reset() -> None:
     _RECORDER.reset()
 
 
+def _device_ms(report) -> float:
+    """Attributed device-kernel milliseconds of the run (the timeline
+    seams record ``kernel`` decisions into the report)."""
+    if report is None:
+        return 0.0
+    from hyperspace_tpu.telemetry.timeline import device_ms_summary
+
+    return device_ms_summary(report)
+
+
 def _plan_fingerprint(report) -> str:
     """The plan-cache key recorded into the run report (dataset.collect),
     if one was computed for this query."""
@@ -253,6 +268,8 @@ def slow_queries_table(conf=None):
                                for r in recs], type=pa.float64()),
         "queueWaitMs": pa.array([r.get("queue_wait_ms") for r in recs],
                                 type=pa.float64()),
+        "deviceMs": pa.array([float(r.get("device_ms", 0.0) or 0.0)
+                              for r in recs], type=pa.float64()),
         "slow": pa.array([bool(r.get("slow")) for r in recs],
                          type=pa.bool_()),
         "reason": pa.array([str(r.get("reason", "")) for r in recs],

@@ -168,17 +168,46 @@ def records(conf, root: Optional[str] = None) -> List[Dict[str, Any]]:
     return out
 
 
-def history_table(conf, root: Optional[str] = None):
+def filtered_records(conf, root: Optional[str] = None,
+                     index: Optional[str] = None,
+                     section: Optional[str] = None,
+                     limit: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Ledger records with the ``perf_history`` ergonomics filters
+    applied: ``index`` keeps action records for that index (the
+    ``Action(index)`` naming or the serialized ``index`` field),
+    ``section`` keeps bench records for that section name, ``limit``
+    keeps the most recent N after filtering."""
+    out = records(conf, root)
+    if index:
+        out = [r for r in out
+               if r.get("index") == index
+               or str(r.get("name", "")).endswith(f"({index})")]
+    if section:
+        out = [r for r in out
+               if r.get("kind") == "bench"
+               and r.get("name") == section]
+    if limit is not None and limit >= 0:
+        out = out[-int(limit):] if limit else []
+    return out
+
+
+def history_table(conf, root: Optional[str] = None,
+                  index: Optional[str] = None,
+                  section: Optional[str] = None,
+                  limit: Optional[int] = None):
     """The ledger as an arrow table (one row per record) — the shape
     ``Hyperspace.perf_history()`` and the interop ``perf_history`` verb
-    return.  Structured sub-objects ride as JSON strings so the schema
+    return, both of which pass the ``index``/``section``/``limit``
+    filters straight through (callers used to re-filter raw records by
+    hand).  Structured sub-objects ride as JSON strings so the schema
     stays flat and stable."""
     import pyarrow as pa
 
     rows = {"key": [], "kind": [], "name": [], "ts": [], "wallSeconds": [],
             "outcome": [], "phasesJson": [], "bytesWritten": [],
             "spillBytes": [], "recordJson": []}
-    for rec in records(conf, root):
+    for rec in filtered_records(conf, root, index=index, section=section,
+                                limit=limit):
         rows["key"].append(rec.get("key", ""))
         rows["kind"].append(str(rec.get("kind", "")))
         rows["name"].append(str(rec.get("name", "")))

@@ -22,8 +22,8 @@ BENCH = os.path.join(os.path.dirname(os.path.dirname(
 SECTIONS = ("setup", "sf1_queries", "device_agg_probe", "resident_agg",
             "warm_resident_join", "warm_q3", "warm_q10", "window_bench",
             "kernel_bench", "calibration", "telemetry_overhead",
-            "advisor", "integrity", "build_profile", "serving",
-            "flight_recorder", "ingest", "sf10", "sf100")
+            "advisor", "integrity", "build_profile", "timeline",
+            "serving", "flight_recorder", "ingest", "sf10", "sf100")
 
 
 def _env(tmp_path, budget: str) -> dict:
@@ -134,6 +134,48 @@ def test_sigterm_mid_run_keeps_completed_sections(tmp_path):
     skipped = [s for s in detail["sections_run"] if s["status"] != "ok"]
     assert skipped, "SIGTERM mid-run left nothing skipped?"
     assert any("SIGTERM" in s.get("reason", "") for s in skipped), skipped
+
+
+def test_budget_derives_from_enclosing_timeout(tmp_path):
+    """HS_BENCH_BUDGET unset + an enclosing coreutils `timeout`: the
+    default budget derives from the timeout's duration (minus finalize
+    headroom), so the in-process finalize fires BEFORE the external
+    kill — the r05 blackout (rc=124, parsed: null) cannot recur.  The
+    headline must parse from stdout whatever exit code the timeout
+    wrapper reports."""
+    env = _env(tmp_path, budget="0")
+    env.pop("HS_BENCH_BUDGET")
+    env.pop("HS_BENCH_TIMEOUT_S", None)
+    proc = subprocess.run(
+        ["timeout", "-k", "10", "45", sys.executable, BENCH],
+        env=env, capture_output=True, text=True, timeout=600)
+    _lines, headline = _parse_lines(proc.stdout)
+    detail = headline["detail"]
+    # The derived budget sits under the enclosing 45 s limit.
+    assert 0 < detail["budget_s"] < 45, detail["budget_s"]
+    # Every section is accounted for even though most were skipped.
+    statuses = {s["section"] for s in detail["sections_run"]}
+    assert statuses == set(SECTIONS)
+
+
+def test_timeout_duration_parser():
+    """The coreutils-timeout argv parser behind the derived budget:
+    options with values are skipped, the first positional is the
+    duration, suffixes scale."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("hs_bench", BENCH)
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    parse = bench._timeout_duration_from_argv
+    assert parse(["timeout", "-k", "10", "870", "python"]) == 870.0
+    assert parse(["/usr/bin/timeout", "2m", "sleep", "999"]) == 120.0
+    assert parse(["timeout", "--kill-after=10", "-s", "TERM",
+                  "1.5h", "x"]) == 5400.0
+    assert parse(["timeout", "--foreground", "30s", "x"]) == 30.0
+    assert parse(["python", "bench.py"]) is None
+    assert parse(["timeout", "-k", "10"]) is None
+    assert parse(["timeout", "notanumber", "x"]) is None
 
 
 def test_headline_shape_matches_prior_rounds(tmp_path):
