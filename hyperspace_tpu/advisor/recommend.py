@@ -21,6 +21,11 @@ from hyperspace_tpu.index.log_entry import States
 
 
 def scored_candidates(session) -> List[_cand.Candidate]:
+    # Persist pending write-behind counters before scoring: records()
+    # overlays them in memory either way, but a recommendation is a
+    # natural durability point (the verb path reads WITHOUT flushing —
+    # blocking-discipline keeps store writes off the inline surface).
+    _workload.flush_pending(session.conf)
     recs = _workload.records(session.conf)
     cands = _cand.generate_candidates(
         recs, session.conf.advisor_max_candidates)

@@ -345,11 +345,19 @@ def reset_cache() -> None:
 # Reads
 # ---------------------------------------------------------------------------
 def records(conf) -> List[Dict[str, Any]]:
-    """Every persisted workload record (pending counters flushed first).
-    Unparseable records are skipped — capture is advisory data."""
-    flush_pending(conf)
+    """Every persisted workload record, with this process's pending
+    write-behind counters overlaid IN MEMORY — a pure read.  The overlay
+    applies the same merge the flush would, so callers see current
+    numbers without this path ever touching the store write side: the
+    interop ``workload`` verb answers inline during overload
+    (blocking-discipline, docs/18), where a store put could stall it.
+    Durability still comes from the pow2-boundary flushes (and
+    :func:`flush_pending`, which the recommend/daemon paths call before
+    scoring).  Unparseable records are skipped — capture is advisory
+    data."""
     store = store_for(conf)
     out: List[Dict[str, Any]] = []
+    by_key: Dict[str, Dict[str, Any]] = {}
     for key in store.list_keys():
         try:
             rec = json.loads(store.read(key).decode("utf-8"))
@@ -359,6 +367,27 @@ def records(conf) -> List[Dict[str, Any]]:
             continue
         rec["key"] = key
         out.append(rec)
+        by_key[key] = rec
+    root = workload_root(conf)
+    with _lock:
+        for (r, key), p in _pending.items():
+            if r != root or p.hits <= 0 or p.dropped:
+                continue
+            rec = by_key.get(key)
+            if rec is None:
+                rec = {"v": RECORD_VERSION, "tables": p.fp["tables"],
+                       "hits": 0, "bytes_scanned_total": 0,
+                       "duration_ms_total": 0.0, "key": key}
+                out.append(rec)
+                by_key[key] = rec
+            rec["hits"] = int(rec.get("hits", 0)) + p.hits
+            rec["bytes_scanned_total"] = \
+                int(rec.get("bytes_scanned_total", 0)) + p.bytes_total
+            rec["duration_ms_total"] = round(
+                float(rec.get("duration_ms_total", 0.0))
+                + p.duration_ms_total, 3)
+            for k, v in p.last.items():
+                rec[f"last_{k}"] = v
     return sorted(out, key=lambda r: (-int(r.get("hits", 0)), r["key"]))
 
 

@@ -63,6 +63,7 @@ IO_RETRY_MAX_BACKOFF_MS = "hyperspace.system.io.retry.maxBackoffMs"
 TELEMETRY_TRACING_ENABLED = "hyperspace.system.telemetry.tracing.enabled"
 TELEMETRY_TRACE_SINK = "hyperspace.system.telemetry.trace.sink"
 TELEMETRY_TRACE_MAX_BYTES = "hyperspace.system.telemetry.trace.maxBytes"
+DEVICE_GUARD_ENABLED = "hyperspace.system.deviceGuard.enabled"
 TIMELINE_ENABLED = "hyperspace.system.timeline.enabled"
 TIMELINE_MAX_INTERVALS = "hyperspace.system.timeline.maxIntervals"
 TIMELINE_MEMORY_SAMPLE_MS = "hyperspace.system.timeline.memorySampleMs"
@@ -311,6 +312,11 @@ class HyperspaceConf:
     # bounds the ring (oldest dropped, counted in timeline.dropped);
     # memorySampleMs is the sampler cadence (0 disables the sampler).
     timeline_enabled: bool = False
+    # Strict-mode runtime sync guard (execution/sync_guard.py): armed per
+    # collect; a device→host conversion outside the attributed
+    # sync_guard.pull/scalar seams raises DeviceSyncError and counts
+    # guard.sync.violations.  Off (the default) leaves jax untouched.
+    device_guard_enabled: bool = False
     timeline_max_intervals: int = 8192
     timeline_memory_sample_ms: float = 25.0
     # Hyperspace.doctor() thresholds (telemetry/doctor.py): the serving
@@ -493,6 +499,7 @@ class HyperspaceConf:
         TELEMETRY_TRACE_SINK: "telemetry_trace_sink",
         TELEMETRY_TRACE_MAX_BYTES: "telemetry_trace_max_bytes",
         TIMELINE_ENABLED: "timeline_enabled",
+        DEVICE_GUARD_ENABLED: "device_guard_enabled",
         TIMELINE_MAX_INTERVALS: "timeline_max_intervals",
         TIMELINE_MEMORY_SAMPLE_MS: "timeline_memory_sample_ms",
         DOCTOR_LATENCY_SLO_MS: "doctor_latency_slo_ms",

@@ -307,6 +307,9 @@ class Dataset:
         from hyperspace_tpu.telemetry import timeline
 
         timeline.configure_from_conf(self.session.conf)
+        from hyperspace_tpu.execution import sync_guard
+
+        sync_guard.arm(self.session.conf)
         token = run_report.start()
         query_span = None
         try:
@@ -368,7 +371,10 @@ class Dataset:
         return self.session.last_run_report_value
 
     def _collect_traced(self, plan_cache=None) -> pa.Table:
-        from hyperspace_tpu.exceptions import DeadlineExceededError
+        from hyperspace_tpu.exceptions import (
+            DeadlineExceededError,
+            DeviceSyncError,
+        )
         from hyperspace_tpu.execution.executor import Executor
         from hyperspace_tpu.telemetry import report as run_report
         from hyperspace_tpu.telemetry import metrics
@@ -413,8 +419,10 @@ class Dataset:
                 # query error and propagates from a planning pass indexes
                 # never touched.  A deadline expiry is NOT a degraded
                 # condition: re-planning would spend more time past a
-                # deadline that already passed — propagate it.
-                if isinstance(e, DeadlineExceededError):
+                # deadline that already passed — propagate it.  A strict-
+                # mode sync-guard violation likewise: re-planning would
+                # just repeat the unattributed sync.
+                if isinstance(e, (DeadlineExceededError, DeviceSyncError)):
                     raise
                 if not self.session.is_hyperspace_enabled() or \
                         not self.session.conf.degraded_fallback_to_source:
@@ -436,9 +444,11 @@ class Dataset:
                 out = executor.execute(plan)
         except Exception as e:  # noqa: BLE001 — InjectedCrash is a
             # BaseException and still dies like a real crash.
-            if isinstance(e, DeadlineExceededError):
+            if isinstance(e, (DeadlineExceededError, DeviceSyncError)):
                 # Past-deadline work is the one thing the fallback
                 # machinery must NOT do more of — propagate immediately.
+                # Same for a strict-mode sync-guard violation: the
+                # fallback would re-execute the unattributed sync.
                 raise
             if cache_key is not None:
                 # The cached plan (or the plan just cached) failed at

@@ -37,6 +37,14 @@ class DegradedIndexError(HyperspaceError):
     (``hyperspace.system.degraded.fallbackToSource``) is disabled."""
 
 
+class DeviceSyncError(HyperspaceError):
+    """Strict-mode device guard (execution/sync_guard.py,
+    ``hyperspace.system.deviceGuard.enabled``): a device→host sync ran
+    outside the attributed seams (``sync_guard.pull``/``scalar``, the
+    timeline kernel seams).  Like a deadline expiry, this must propagate
+    — re-planning would just repeat the unattributed sync."""
+
+
 class DeadlineExceededError(HyperspaceError):
     """The per-request deadline (utils/deadline.py) expired: the query was
     aborted at a phase boundary.  Deliberately NOT a degraded-mode

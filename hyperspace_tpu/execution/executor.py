@@ -26,6 +26,7 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.compute as pc
 
+from hyperspace_tpu.execution import sync_guard
 from hyperspace_tpu.io import columnar
 from hyperspace_tpu.telemetry import timeline
 from hyperspace_tpu.utils import deadline as _deadline
@@ -1278,9 +1279,7 @@ class Executor:
         with _enable_x64():
             mask = fn(device_cols, literals)
         timeline.kernel_end("filter", t0, mask)
-        out = np.asarray(mask)
-        timeline.record_transfer("d2h", int(out.nbytes))
-        return out
+        return sync_guard.pull(mask, "filter.mask")
 
     def _normalize_literals(self, expr: Expr, table: pa.Table) -> Expr:
         """Rewrite temporal/bool literals to their int64 device domain."""

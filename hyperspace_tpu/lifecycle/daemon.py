@@ -326,6 +326,7 @@ class MaintenanceDaemon:
         from hyperspace_tpu.advisor import recommend
         from hyperspace_tpu.advisor import workload as _workload
 
+        _workload.flush_pending(self.session.conf)  # durability point
         recs = _workload.records(self.session.conf)
         index_bytes = {
             e.name: sum(f.size for f in e.content.file_infos())

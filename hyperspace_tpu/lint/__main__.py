@@ -1,7 +1,10 @@
 """CLI: ``python -m hyperspace_tpu.lint``.
 
 Exit codes: 0 clean (new findings all absent), 1 new violations (or a
-failed --trace check), 2 usage/internal error.
+failed --trace check), 2 usage/internal error.  ``--sarif`` adds a
+side-channel artifact and changes no exit code; ``--fix`` applies the
+mechanical hygiene autofixes (``--fix --dry-run`` previews the diff)
+and exits by the POST-fix finding count.
 """
 
 from __future__ import annotations
@@ -44,6 +47,16 @@ def main(argv=None) -> int:
                    help="rewrite the baseline to the current findings "
                         "and exit 0")
     p.add_argument("--show-baselined", action="store_true")
+    p.add_argument("--fix", action="store_true",
+                   help="apply the mechanical hygiene autofixes (dead/"
+                        "duplicate/redundant imports, mutable default "
+                        "args), then relint")
+    p.add_argument("--dry-run", action="store_true",
+                   help="with --fix: print the unified diff, write "
+                        "nothing")
+    p.add_argument("--sarif", default=None, metavar="PATH",
+                   help="also write findings as SARIF 2.1.0 (CI PR "
+                        "annotation); exit codes unchanged")
     p.add_argument("--check-catalog", action="store_true",
                    help="run only the telemetry-catalog rule (the docs/16 "
                         "contract); combine with --trace")
@@ -72,10 +85,32 @@ def main(argv=None) -> int:
         else engine.load_baseline(baseline_path)
 
     try:
-        findings, expired = engine.run_lint(root, rule_names, baseline)
+        ctx = engine.build_context(root)
+        findings, expired = engine.run_lint(root, rule_names, baseline,
+                                            ctx=ctx)
     except ValueError as e:
         print(f"hslint: {e}", file=sys.stderr)
         return 2
+
+    if args.fix:
+        from hyperspace_tpu.lint import fix as fixer
+
+        fixes = fixer.plan_fixes(ctx, findings)
+        if args.dry_run:
+            for fx in fixes:
+                sys.stdout.write(fx.diff())
+            print(f"hslint --fix --dry-run: {sum(len(f.applied) for f in fixes)} "
+                  f"finding(s) fixable across {len(fixes)} file(s); "
+                  f"nothing written")
+            return 0
+        fixer.apply_fixes(root, fixes)
+        for fx in fixes:
+            print(f"fixed {len(fx.applied)} finding(s) in {fx.relpath}")
+        # Relint from disk: the exit code reflects the post-fix state,
+        # and a fix that broke a file (syntax) surfaces immediately.
+        ctx = engine.build_context(root)
+        findings, expired = engine.run_lint(root, rule_names, baseline,
+                                            ctx=ctx)
 
     if args.update_baseline:
         engine.write_baseline(baseline_path, findings)
@@ -85,12 +120,20 @@ def main(argv=None) -> int:
         return 0
 
     active = [r.name for r in rules] if rule_names is None else rule_names
+    if args.sarif:
+        from hyperspace_tpu.lint import sarif
+
+        # A CI artifact at a user-chosen path, like the trace sink.
+        # hslint: allow[io-seam] SARIF artifact, not index data
+        with open(args.sarif, "w", encoding="utf-8") as f:
+            f.write(sarif.render_sarif(
+                findings, [r for r in rules if r.name in set(active)],
+                root))
     trace_problems = []
     if args.trace:
         from hyperspace_tpu.lint import catalog
 
-        _metrics, spans = catalog.telemetry_catalog(
-            engine.build_context(root))
+        _metrics, spans = catalog.telemetry_catalog(ctx)
         trace_problems = catalog.check_trace(args.trace, list(spans))
 
     if args.json:

@@ -1,0 +1,508 @@
+"""Module-level interprocedural call graph for hslint rules.
+
+PR 8's rules are all single-module pattern checks; the device-discipline
+and blocking-discipline families (docs/18-static-analysis.md) need to
+reason PAST function boundaries: "is a blocking store put reachable from
+this with-lock block?", "does every executor dispatch path reach a
+deadline check?", "does this helper return a device array?".  This
+module builds, once per lint run, the package call graph those queries
+run over:
+
+  - **function table** — every ``def`` in the package, keyed by a stable
+    function id ``<relpath>::<qualname>`` (``Class.method`` qualnames,
+    nested defs as ``outer.<locals>.inner``);
+  - **import-aware call edges** — each :class:`CallSite` records the raw
+    dotted callee name plus the in-package function ids it resolves to.
+    Resolution understands ``import a.b as c``, ``from a.b import f``
+    (including relative forms), same-file calls, ``self.method()``
+    against the enclosing class and same-file bases, and
+    ``ClassName(...)`` as ``ClassName.__init__``;
+  - **lock-held context** — every call site carries the set of lock ids
+    (``<relpath>:<scope>.<attr>``, discovered structurally like the
+    lock-discipline rule) lexically held at the call, so rules can
+    propagate "holding lock L" across call edges;
+  - **cycle-tolerant reachability** — :meth:`CallGraph.find_path` does a
+    BFS with a visited set, returning a witness chain of call sites so a
+    finding can show the whole ``a -> b -> c`` path.
+
+Pure stdlib, AST-only, never imports the checked package — the same
+constraints as the rest of ``lint/`` (engine.py docstring).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from hyperspace_tpu.lint.engine import LintContext, call_name
+
+PACKAGE = "hyperspace_tpu"
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+
+
+def module_of(relpath: str) -> str:
+    """Dotted module name of a repo-relative path
+    (``hyperspace_tpu/io/faults.py`` -> ``hyperspace_tpu.io.faults``)."""
+    p = relpath[:-3] if relpath.endswith(".py") else relpath
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    return p.replace("/", ".")
+
+
+class CallSite:
+    """One call expression inside one function."""
+
+    __slots__ = ("caller", "line", "name", "targets", "locks")
+
+    def __init__(self, caller: str, line: int, name: str,
+                 targets: Tuple[str, ...], locks: Tuple[str, ...]) -> None:
+        self.caller = caller      # function id of the enclosing def
+        self.line = line
+        self.name = name          # raw dotted callee ("store.put", "f")
+        self.targets = targets    # resolved in-package function ids
+        self.locks = locks        # lock ids lexically held at the call
+
+    def __repr__(self) -> str:  # debugging aid only
+        return f"<CallSite {self.caller}:{self.line} {self.name}>"
+
+
+class FunctionInfo:
+    __slots__ = ("fid", "path", "qualname", "name", "lineno", "end_lineno",
+                 "node", "class_name", "decorators")
+
+    def __init__(self, fid: str, path: str, qualname: str, node) -> None:
+        self.fid = fid
+        self.path = path
+        self.qualname = qualname
+        self.name = node.name
+        self.lineno = node.lineno
+        self.end_lineno = getattr(node, "end_lineno", node.lineno)
+        self.node = node
+        parts = qualname.split(".")
+        self.class_name = parts[-2] \
+            if len(parts) >= 2 and parts[-2] != "<locals>" else ""
+        self.decorators = [_decorator_name(d) for d in node.decorator_list]
+
+
+def _decorator_name(dec: ast.AST) -> str:
+    """``@jax.jit`` -> "jax.jit"; ``@partial(jax.jit, ...)`` ->
+    "partial(jax.jit)"; anything else best-effort dotted text."""
+    if isinstance(dec, ast.Call):
+        inner = call_name(dec)
+        if inner == "partial" or inner.endswith(".partial"):
+            if dec.args:
+                arg = dec.args[0]
+                parts: List[str] = []
+                cur = arg
+                while isinstance(cur, ast.Attribute):
+                    parts.append(cur.attr)
+                    cur = cur.value
+                if isinstance(cur, ast.Name):
+                    parts.append(cur.id)
+                    return f"partial({'.'.join(reversed(parts))})"
+            return "partial(?)"
+        return inner
+    parts = []
+    cur = dec
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def is_jit_decorated(info: FunctionInfo) -> bool:
+    """Is the function wrapped by ``jax.jit`` (directly or via
+    ``partial(jax.jit, ...)``)?"""
+    for d in info.decorators:
+        if d in ("jax.jit", "jit", "partial(jax.jit)", "partial(jit)"):
+            return True
+    return False
+
+
+class _FileIndex:
+    """Per-file name environment: imports, module-level functions,
+    classes (methods + same-file bases), module-level locks."""
+
+    def __init__(self, src, modules: Dict[str, str]) -> None:
+        self.src = src
+        self.mod_alias: Dict[str, str] = {}     # local name -> module
+        self.from_names: Dict[str, Tuple[str, str]] = {}  # name -> (mod, attr)
+        self.classes: Dict[str, ast.ClassDef] = {}
+        self.class_bases: Dict[str, List[str]] = {}
+        self.module_funcs: Set[str] = set()
+        self.module_locks: Set[str] = set()
+        self._collect(src, modules)
+
+    def _collect(self, src, modules: Dict[str, str]) -> None:
+        pkg_parts = module_of(src.relpath).split(".")
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    top = a.name.split(".")[0]
+                    if a.asname:
+                        if a.name in modules:
+                            self.mod_alias[a.asname] = a.name
+                    elif top == PACKAGE:
+                        # ``import hyperspace_tpu.io.faults`` binds the
+                        # root; dotted call names are resolved directly.
+                        self.mod_alias.setdefault(PACKAGE, PACKAGE)
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    # Relative import: resolve against this file's package.
+                    anchor = pkg_parts[:-1]  # drop the module's own name
+                    if src.relpath.endswith("__init__.py"):
+                        anchor = pkg_parts
+                    if node.level > 1:
+                        anchor = anchor[: -(node.level - 1)] \
+                            if node.level - 1 <= len(anchor) else []
+                    base = ".".join(anchor + ([base] if base else []))
+                if not base.startswith(PACKAGE):
+                    continue
+                for a in node.names:
+                    local = a.asname or a.name
+                    sub = f"{base}.{a.name}"
+                    if sub in modules:
+                        self.mod_alias[local] = sub
+                    elif base in modules:
+                        self.from_names[local] = (base, a.name)
+        for node in src.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.module_funcs.add(node.name)
+            elif isinstance(node, ast.ClassDef):
+                self.classes[node.name] = node
+                self.class_bases[node.name] = [
+                    b.id for b in node.bases if isinstance(b, ast.Name)]
+            elif isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.module_locks.add(t.id)
+
+
+def _is_lock_ctor(value: ast.AST) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    f = value.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else "")
+    return name in _LOCK_CTORS
+
+
+def _class_lock_attrs(cls: ast.ClassDef) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    out.add(t.attr)
+    return out
+
+
+class _SiteCollector(ast.NodeVisitor):
+    """Walk ONE function body (nested defs excluded — they are their own
+    graph nodes), tracking the lexical with-lock stack and recording
+    every call expression."""
+
+    def __init__(self, graph: "CallGraph", index: _FileIndex,
+                 info: FunctionInfo, lock_names: Set[str],
+                 lock_scope: str) -> None:
+        self.graph = graph
+        self.index = index
+        self.info = info
+        self.lock_names = lock_names  # "self.X" / module-global names
+        self.lock_scope = lock_scope  # "<relpath>:<Class|<module>>"
+        self.stack: List[str] = []
+        self.sites: List[CallSite] = []
+
+    def _lock_id(self, expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id == "self" and f"self.{expr.attr}" \
+                in self.lock_names:
+            return f"{self.lock_scope}.{expr.attr}"
+        if isinstance(expr, ast.Name) and expr.id in self.lock_names:
+            return f"{self.lock_scope}.{expr.id}"
+        return None
+
+    def visit_FunctionDef(self, node) -> None:  # nested def: own node
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_With(self, node: ast.With) -> None:
+        held = []
+        for item in node.items:
+            if isinstance(item.context_expr, ast.Call):
+                self.visit(item.context_expr)
+            lock = self._lock_id(item.context_expr)
+            if lock is not None:
+                self.stack.append(lock)
+                held.append(lock)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in held:
+            self.stack.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_Call(self, node: ast.Call) -> None:
+        raw = call_name(node)
+        if raw:
+            targets = self.graph._resolve(self.index, self.info, raw)
+            self.sites.append(CallSite(
+                self.info.fid, node.lineno, raw, tuple(targets),
+                tuple(self.stack)))
+        self.generic_visit(node)
+
+
+class CallGraph:
+    """The package call graph.  Build once per run with
+    :meth:`CallGraph.build`; rules share the instance through
+    :func:`for_context` (keyed on the :class:`LintContext` identity)."""
+
+    def __init__(self, ctx: LintContext) -> None:
+        self.ctx = ctx
+        self.modules: Dict[str, str] = {}        # module name -> relpath
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.sites: Dict[str, List[CallSite]] = {}  # caller fid -> sites
+        self._by_file: Dict[str, List[str]] = {}    # relpath -> fids
+        self._indexes: Dict[str, _FileIndex] = {}
+        self._build()
+
+    # -- construction --------------------------------------------------------
+    def _build(self) -> None:
+        files = [f for f in self.ctx.py_files(include=(f"{PACKAGE}/",))
+                 if f.tree is not None]
+        for src in files:
+            self.modules[module_of(src.relpath)] = src.relpath
+        for src in files:
+            self._indexes[src.relpath] = _FileIndex(src, self.modules)
+            self._collect_functions(src)
+        for src in files:
+            self._collect_sites(src)
+
+    def _collect_functions(self, src) -> None:
+        fids = self._by_file.setdefault(src.relpath, [])
+
+        def walk(body, prefix: str) -> None:
+            for node in body:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    qual = f"{prefix}{node.name}"
+                    fid = f"{src.relpath}::{qual}"
+                    info = FunctionInfo(fid, src.relpath, qual, node)
+                    self.functions[fid] = info
+                    fids.append(fid)
+                    walk(node.body, f"{qual}.<locals>.")
+                elif isinstance(node, ast.ClassDef):
+                    walk(node.body, f"{prefix}{node.name}.")
+
+        walk(src.tree.body, "")
+
+    def _collect_sites(self, src) -> None:
+        index = self._indexes[src.relpath]
+        for fid in self._by_file.get(src.relpath, ()):
+            info = self.functions[fid]
+            cls = self._enclosing_class(info)
+            lock_names: Set[str] = set(index.module_locks)
+            scope = f"{src.relpath}:<module>"
+            if cls is not None:
+                scope = f"{src.relpath}:{cls.name}"
+                for attr in self._all_lock_attrs(index, cls.name):
+                    lock_names.add(f"self.{attr}")
+            coll = _SiteCollector(self, index, info, lock_names, scope)
+            for stmt in info.node.body:
+                coll.visit(stmt)
+            self.sites[fid] = coll.sites
+
+    def _enclosing_class(self, info: FunctionInfo) -> Optional[ast.ClassDef]:
+        parts = info.qualname.split(".")
+        if len(parts) >= 2 and parts[-2] != "<locals>":
+            return self._indexes[info.path].classes.get(parts[0]) \
+                if len(parts) == 2 else \
+                self._indexes[info.path].classes.get(parts[-2])
+        return None
+
+    def _all_lock_attrs(self, index: _FileIndex, cls_name: str,
+                        _seen: Optional[Set[str]] = None) -> Set[str]:
+        """Lock attrs of a class plus its same-file bases."""
+        seen = _seen or set()
+        if cls_name in seen or cls_name not in index.classes:
+            return set()
+        seen.add(cls_name)
+        out = _class_lock_attrs(index.classes[cls_name])
+        for base in index.class_bases.get(cls_name, ()):
+            out |= self._all_lock_attrs(index, base, seen)
+        return out
+
+    # -- resolution ----------------------------------------------------------
+    def _module_func(self, module: str, attr: str) -> List[str]:
+        relpath = self.modules.get(module)
+        if relpath is None:
+            return []
+        out = []
+        fid = f"{relpath}::{attr}"
+        if fid in self.functions:
+            out.append(fid)
+        init = f"{relpath}::{attr}.__init__"
+        if init in self.functions:
+            out.append(init)
+        return out
+
+    def _class_method(self, path: str, cls_name: str, method: str,
+                      _seen: Optional[Set[str]] = None) -> List[str]:
+        """Resolve ``self.method`` against a class and its same-file
+        bases (nearest definition wins)."""
+        seen = _seen or set()
+        if cls_name in seen:
+            return []
+        seen.add(cls_name)
+        index = self._indexes.get(path)
+        if index is None or cls_name not in index.classes:
+            return []
+        fid = f"{path}::{cls_name}.{method}"
+        if fid in self.functions:
+            return [fid]
+        for base in index.class_bases.get(cls_name, ()):
+            found = self._class_method(path, base, method, seen)
+            if found:
+                return found
+        return []
+
+    def _resolve(self, index: _FileIndex, info: FunctionInfo,
+                 raw: str) -> List[str]:
+        parts = raw.split(".")
+        path = info.path
+        if len(parts) == 1:
+            name = parts[0]
+            if name in index.module_funcs:
+                return [f"{path}::{name}"]
+            if name in index.classes:
+                return self._class_method(path, name, "__init__")
+            if name in index.from_names:
+                mod, attr = index.from_names[name]
+                return self._module_func(mod, attr)
+            if name in index.mod_alias:  # callable module alias — not a call
+                return []
+            # A nested def of this function, or a sibling nested def of
+            # the same enclosing function.
+            own = f"{path}::{info.qualname}.<locals>.{name}"
+            if own in self.functions:
+                return [own]
+            if "." in info.qualname:
+                outer = info.qualname.rsplit(".", 1)[0]
+                fid = f"{path}::{outer}.{name}" \
+                    if outer.endswith("<locals>") else \
+                    f"{path}::{outer}.<locals>.{name}"
+                if fid in self.functions:
+                    return [fid]
+            return []
+        if parts[0] == "self" and len(parts) == 2:
+            qparts = info.qualname.split(".")
+            if len(qparts) >= 2 and qparts[-2] != "<locals>":
+                cls_name = qparts[-2]
+                return self._class_method(path, cls_name, parts[1])
+            return []
+        if parts[0] == "cls" and len(parts) == 2:
+            qparts = info.qualname.split(".")
+            if len(qparts) >= 2 and qparts[-2] != "<locals>":
+                return self._class_method(path, qparts[-2], parts[1])
+            return []
+        # ClassName.method within the same file.
+        if parts[0] in index.classes and len(parts) == 2:
+            return self._class_method(path, parts[0], parts[1])
+        # module alias chains: faults.check / np.asarray / a.b.f
+        head = parts[0]
+        if head in index.mod_alias:
+            base = index.mod_alias[head]
+            mod = ".".join([base] + parts[1:-1])
+            return self._module_func(mod, parts[-1])
+        if head == PACKAGE:
+            mod = ".".join(parts[:-1])
+            return self._module_func(mod, parts[-1])
+        # imported-class method: ``from x import C`` then C.build(...)
+        if head in index.from_names and len(parts) == 2:
+            mod, attr = index.from_names[head]
+            relpath = self.modules.get(mod)
+            if relpath is not None:
+                return self._class_method(relpath, attr, parts[1])
+        return []
+
+    # -- queries -------------------------------------------------------------
+    def function(self, path: str, qualname: str) -> Optional[FunctionInfo]:
+        return self.functions.get(f"{path}::{qualname}")
+
+    def functions_in(self, path: str) -> List[FunctionInfo]:
+        return [self.functions[f] for f in self._by_file.get(path, ())]
+
+    def sites_of(self, fid: str) -> List[CallSite]:
+        return self.sites.get(fid, [])
+
+    def callers_of(self, fid: str) -> List[CallSite]:
+        out = []
+        for sites in self.sites.values():
+            for s in sites:
+                if fid in s.targets:
+                    out.append(s)
+        return out
+
+    def find_path(
+        self,
+        start: str,
+        site_pred: Callable[[CallSite], bool],
+        max_nodes: int = 4000,
+    ) -> Optional[Tuple[List[str], CallSite]]:
+        """Cycle-tolerant BFS from function ``start``: the first call
+        site (in BFS order) matching ``site_pred``, plus the chain of
+        function ids walked to reach it (``[start, ..., site.caller]``).
+        Returns None when nothing matches within ``max_nodes``."""
+        if start not in self.functions:
+            return None
+        seen: Set[str] = {start}
+        queue: List[Tuple[str, List[str]]] = [(start, [start])]
+        while queue and len(seen) <= max_nodes:
+            fid, chain = queue.pop(0)
+            for site in self.sites.get(fid, ()):
+                if site_pred(site):
+                    return chain, site
+                for target in site.targets:
+                    if target not in seen:
+                        seen.add(target)
+                        queue.append((target, chain + [target]))
+        return None
+
+    def reaches(self, start: str,
+                site_pred: Callable[[CallSite], bool]) -> bool:
+        return self.find_path(start, site_pred) is not None
+
+
+# ---------------------------------------------------------------------------
+# One graph per lint run, shared by every rule
+# ---------------------------------------------------------------------------
+_CACHE: List[Tuple[int, CallGraph]] = []
+
+
+def for_context(ctx: LintContext) -> CallGraph:
+    for key, graph in _CACHE:
+        if key == id(ctx):
+            return graph
+    graph = CallGraph(ctx)
+    del _CACHE[:]
+    _CACHE.append((id(ctx), graph))
+    return graph
+
+
+def describe_chain(graph: CallGraph, chain: Sequence[str],
+                   site: CallSite) -> str:
+    """Human-readable ``a -> b -> c -> prim() (file:line)`` witness."""
+    names = [graph.functions[f].qualname for f in chain
+             if f in graph.functions]
+    hop = " -> ".join(names + [f"{site.name}()"])
+    return f"{hop} ({site.caller.split('::')[0]}:{site.line})"

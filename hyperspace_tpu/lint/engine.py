@@ -180,9 +180,12 @@ def load_baseline(path: str) -> Set[str]:
 
 
 def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    from hyperspace_tpu.lint.rules import CATALOG_VERSION
+
     entries = sorted({f.fingerprint for f in findings})
     with open(path, "w", encoding="utf-8") as f:
-        json.dump({"version": 1, "entries": entries}, f, indent=2)
+        json.dump({"version": 1, "catalog_version": CATALOG_VERSION,
+                   "entries": entries}, f, indent=2)
         f.write("\n")
 
 

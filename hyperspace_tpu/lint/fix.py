@@ -1,0 +1,231 @@
+"""``hslint --fix``: mechanical autofixes for the hygiene findings.
+
+Scope is deliberately the MECHANICAL subset — edits whose correctness is
+decidable from the AST alone:
+
+  - ``dup-import`` / ``redundant-import`` / ``dead-import`` — remove the
+    binding (the whole statement when it binds nothing else, just the
+    alias otherwise);
+  - ``mutable-default`` — rewrite ``def f(x=[])`` to ``x=None`` and
+    insert the ``if x is None: x = []`` guard after the docstring.
+
+Everything else (a lock-held store put, an unattributed device sync) is
+a DESIGN decision and stays a human's job — the fixer refuses by
+construction because it only consumes hygiene fingerprints.
+
+``--fix --dry-run`` prints the unified diff and writes nothing; ``--fix``
+applies and reports per-file edit counts.  Fix → relint is clean by
+contract (tested in tests/test_lint.py): every fixed finding stops
+firing and no new finding appears.
+"""
+
+from __future__ import annotations
+
+import ast
+import difflib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from hyperspace_tpu.lint.engine import Finding, LintContext
+
+FIXABLE_PREFIXES = ("dup-import:", "redundant-import:", "dead-import:",
+                    "mutable-default:")
+
+
+def fixable(findings: Sequence[Finding]) -> List[Finding]:
+    return [f for f in findings
+            if f.rule == "hygiene" and not f.baselined
+            and any(f.ident.startswith(p) for p in FIXABLE_PREFIXES)]
+
+
+class FileFix:
+    def __init__(self, relpath: str, before: str, after: str,
+                 applied: List[Finding]) -> None:
+        self.relpath = relpath
+        self.before = before
+        self.after = after
+        self.applied = applied
+
+    def diff(self) -> str:
+        return "".join(difflib.unified_diff(
+            self.before.splitlines(keepends=True),
+            self.after.splitlines(keepends=True),
+            fromfile=f"a/{self.relpath}", tofile=f"b/{self.relpath}"))
+
+
+def plan_fixes(ctx: LintContext,
+               findings: Sequence[Finding]) -> List[FileFix]:
+    """Compute the edits for every fixable finding, one FileFix per
+    touched file.  Pure: nothing is written."""
+    by_path: Dict[str, List[Finding]] = {}
+    for f in fixable(findings):
+        by_path.setdefault(f.path, []).append(f)
+    fixes: List[FileFix] = []
+    for path, fs in sorted(by_path.items()):
+        src = ctx.file(path)
+        if src is None or src.tree is None:
+            continue
+        after, applied = _fix_file(src, fs)
+        if applied and after != src.text:
+            fixes.append(FileFix(path, src.text, after, applied))
+    return fixes
+
+
+def apply_fixes(root: str, fixes: Sequence[FileFix]) -> None:
+    import os
+
+    for fix in fixes:
+        # The fixer rewrites SOURCE files in the working tree, not index
+        # data — the LogStore seam has no business here.
+        # hslint: allow[io-seam] source autofix, not index data
+        with open(os.path.join(root, fix.relpath), "w",
+                  encoding="utf-8") as f:
+            f.write(fix.after)
+
+
+# ---------------------------------------------------------------------------
+# Per-file editing
+# ---------------------------------------------------------------------------
+def _fix_file(src, findings: List[Finding]) -> Tuple[str, List[Finding]]:
+    lines = src.text.splitlines(keepends=True)
+    # Line edits: lineno -> None (delete) | str (replace).  Applied
+    # bottom-up so earlier linenos stay valid.
+    edits: Dict[int, Optional[str]] = {}
+    inserts: List[Tuple[int, str]] = []  # (after-lineno, text)
+    applied: List[Finding] = []
+    for f in findings:
+        ok = False
+        if f.ident.startswith(("dup-import:", "redundant-import:",
+                               "dead-import:")):
+            ok = _drop_import_binding(src, f, edits)
+        elif f.ident.startswith("mutable-default:"):
+            ok = _fix_mutable_default(src, f, edits, inserts)
+        if ok:
+            applied.append(f)
+    if not applied:
+        return src.text, []
+    for lineno, ins in sorted(inserts, reverse=True):
+        lines.insert(lineno, ins)
+    for lineno in sorted(edits, reverse=True):
+        repl = edits[lineno]
+        if lineno - 1 >= len(lines):
+            continue
+        if repl is None:
+            del lines[lineno - 1]
+        else:
+            lines[lineno - 1] = repl
+    return "".join(lines), applied
+
+
+def _drop_import_binding(src, f: Finding, edits) -> bool:
+    """Remove the named alias from the import statement at the finding's
+    line — the whole line when it binds nothing else."""
+    name = f.ident.rsplit(":", 1)[-1]
+    node = _import_at(src.tree, f.line)
+    if node is None:
+        return False
+    keep = []
+    for a in node.names:
+        bound = a.asname or (a.name.split(".")[0]
+                             if isinstance(node, ast.Import) else a.name)
+        if bound != name:
+            keep.append(a)
+    if len(keep) == len(node.names):
+        return False
+    if not keep:
+        # Multi-line imports (parenthesized from-imports) delete every
+        # line of the statement.
+        end = getattr(node, "end_lineno", node.lineno)
+        for ln in range(node.lineno, end + 1):
+            edits[ln] = None
+        return True
+    if getattr(node, "end_lineno", node.lineno) != node.lineno:
+        # Parenthesized multi-name import: drop just the alias's line
+        # when it sits alone on one (the repo style); otherwise skip.
+        for ln in range(node.lineno,
+                        getattr(node, "end_lineno", node.lineno) + 1):
+            stripped = src.lines[ln - 1].strip().rstrip(",")
+            cand = {name}
+            for a in node.names:
+                if (a.asname or a.name) == name and a.asname:
+                    cand.add(f"{a.name} as {a.asname}")
+            if stripped in cand:
+                edits[ln] = None
+                return True
+        return False
+    indent = src.lines[node.lineno - 1][
+        :len(src.lines[node.lineno - 1])
+        - len(src.lines[node.lineno - 1].lstrip())]
+    rendered = ", ".join(
+        a.name + (f" as {a.asname}" if a.asname else "") for a in keep)
+    if isinstance(node, ast.Import):
+        edits[node.lineno] = f"{indent}import {rendered}\n"
+    else:
+        dots = "." * node.level
+        edits[node.lineno] = \
+            f"{indent}from {dots}{node.module or ''} import {rendered}\n"
+    return True
+
+
+def _import_at(tree, lineno: int):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Import, ast.ImportFrom)) and \
+                node.lineno <= lineno <= getattr(node, "end_lineno",
+                                                 node.lineno):
+            return node
+    return None
+
+
+def _fix_mutable_default(src, f: Finding, edits, inserts) -> bool:
+    """``def g(x=[])`` -> ``x=None`` + ``if x is None: x = []`` after the
+    docstring.  Only single-line defaults whose source text is exactly
+    reproducible are rewritten; anything fancier is left to a human."""
+    fn = None
+    for node in ast.walk(src.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in list(node.args.defaults) + \
+                    [d for d in node.args.kw_defaults if d is not None]:
+                if d.lineno == f.line and \
+                        f.ident == f"mutable-default:{node.name}":
+                    fn = (node, d)
+                    break
+        if fn:
+            break
+    if fn is None:
+        return False
+    node, d = fn
+    if d.lineno != getattr(d, "end_lineno", d.lineno):
+        return False
+    line = src.lines[d.lineno - 1]
+    default_src = line[d.col_offset:d.end_col_offset]
+    # The parameter name owning this default.
+    arg_name = None
+    pos = node.args.args[len(node.args.args) - len(node.args.defaults):]
+    for a, dd in zip(pos, node.args.defaults):
+        if dd is d:
+            arg_name = a.arg
+    for a, dd in zip(node.args.kwonlyargs, node.args.kw_defaults):
+        if dd is not None and dd is d:
+            arg_name = a.arg
+    if arg_name is None:
+        return False
+    edits[d.lineno] = line[:d.col_offset] + "None" + \
+        line[d.end_col_offset:] + ("" if line.endswith("\n") else "\n")
+    # Insert the guard after a leading docstring (if any).
+    body_start = node.body[0]
+    insert_after = node.body[0].lineno - 1  # line BEFORE first stmt
+    if isinstance(body_start, ast.Expr) and \
+            isinstance(body_start.value, ast.Constant) and \
+            isinstance(body_start.value.value, str):
+        insert_after = getattr(body_start, "end_lineno",
+                               body_start.lineno)
+        if len(node.body) > 1:
+            pass  # guard goes between docstring and next stmt
+    first_code = node.body[1] if (len(node.body) > 1 and
+                                  insert_after >= node.body[0].lineno) \
+        else node.body[0]
+    indent = " " * first_code.col_offset
+    inserts.append((
+        insert_after,
+        f"{indent}if {arg_name} is None:\n"
+        f"{indent}    {arg_name} = {default_src}\n"))
+    return True

@@ -1,15 +1,24 @@
 """Rule registry.  A rule is an object with ``name``, ``description``,
-and ``run(ctx) -> list[Finding]``; adding one = writing the module and
-listing it here (docs/18-static-analysis.md, "Writing a new rule")."""
+and ``run(ctx) -> list[Finding]``; adding one = writing the module,
+listing it here, and bumping ``CATALOG_VERSION`` (docs/18, "Writing a
+new rule")."""
 
 from __future__ import annotations
 
 from typing import List
 
+# Bumped whenever the rule set (or a rule's checks) changes shape: the
+# baseline file records the version it was written against, and
+# Hyperspace.doctor()'s lint check grades a mismatch as stale — old
+# grandfathered fingerprints may hide findings the new rules would raise.
+CATALOG_VERSION = 2
+
 
 def all_rules() -> List[object]:
     from hyperspace_tpu.lint.rules import (
+        blocking_discipline,
         conf_registry,
+        device_discipline,
         exception_discipline,
         fault_site_registry,
         hygiene,
@@ -25,5 +34,7 @@ def all_rules() -> List[object]:
         fault_site_registry.Rule(),
         exception_discipline.Rule(),
         lock_discipline.Rule(),
+        device_discipline.Rule(),
+        blocking_discipline.Rule(),
         hygiene.Rule(),
     ]

@@ -1,0 +1,71 @@
+"""SARIF 2.1.0 rendering for hslint findings.
+
+SARIF is the interchange format CI code-scanning UIs ingest (GitHub's
+``upload-sarif`` action annotates PR diffs with per-line findings from
+it).  One run object, one driver (``hslint``), one rule entry per lint
+rule, one result per NEW finding — baselined findings are suppressed
+(`suppressions`, kind "external") so the annotations match the CLI's
+exit-code contract exactly.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Sequence
+
+from hyperspace_tpu.lint.engine import Finding
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def render_sarif(findings: Sequence[Finding], rules,
+                 root: str) -> str:
+    rule_index: Dict[str, int] = {}
+    rule_objs: List[dict] = []
+    for r in rules:
+        rule_index[r.name] = len(rule_objs)
+        rule_objs.append({
+            "id": r.name,
+            "shortDescription": {"text": r.description},
+            "helpUri": "docs/18-static-analysis.md",
+        })
+    results = []
+    for f in findings:
+        if f.rule not in rule_index:  # parse errors et al.
+            rule_index[f.rule] = len(rule_objs)
+            rule_objs.append({"id": f.rule,
+                              "shortDescription": {"text": f.rule}})
+        res = {
+            "ruleId": f.rule,
+            "ruleIndex": rule_index[f.rule],
+            "level": "error",
+            "message": {"text": f.message},
+            "partialFingerprints": {"hslint/v1": f.fingerprint},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": max(1, f.line)},
+                },
+            }],
+        }
+        if f.baselined:
+            res["suppressions"] = [{"kind": "external",
+                                    "justification": "hslint baseline"}]
+        results.append(res)
+    doc = {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "hslint",
+                "informationUri": "docs/18-static-analysis.md",
+                "rules": rule_objs,
+            }},
+            "originalUriBaseIds": {"SRCROOT": {"uri": f"file://{root}/"}},
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=2)

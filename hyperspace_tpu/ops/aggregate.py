@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from hyperspace_tpu.execution import sync_guard
 from hyperspace_tpu.utils.compat import enable_x64 as _enable_x64
 from hyperspace_tpu.utils.shapes import round_up_pow2
 
@@ -156,7 +157,8 @@ def grouped_aggregate(
         kw = tuple(_pad_rows(w, capacity_rows) for w in key_words)
         vc = tuple(_pad_rows(v, capacity_rows) for v in value_cols)
         perm, boundaries, n_groups = _group_sort(kw, n)
-        g = int(n_groups)
+        # The one dynamic-shape sync point: only the group COUNT crosses.
+        g = int(sync_guard.scalar(n_groups, "aggregate.groups"))
         if g == 0:
             timeline.kernel_end("aggregate", t0, perm)
             return (np.empty(0, np.int32), np.empty(0, np.int32),
@@ -165,7 +167,8 @@ def grouped_aggregate(
         out = _segment_reduce(perm, boundaries, n, vc,
                               ops=tuple(ops), capacity=capacity)
     timeline.kernel_end("aggregate", t0, out)
-    first_rows = np.asarray(out[0])[:g]
-    counts = np.asarray(out[1])[:g]
-    results = [np.asarray(r)[:g] for r in out[2:]]
+    first_rows = sync_guard.pull(out[0], "aggregate.first_rows")[:g]
+    counts = sync_guard.pull(out[1], "aggregate.counts")[:g]
+    results = [sync_guard.pull(r, "aggregate.results")[:g]
+               for r in out[2:]]
     return first_rows, counts, results

@@ -23,6 +23,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from hyperspace_tpu.execution import sync_guard
 from hyperspace_tpu.utils.compat import enable_x64 as _enable_x64
 from hyperspace_tpu.utils.shapes import round_up_pow2
 
@@ -221,7 +222,8 @@ def sorted_equi_join(left_keys: np.ndarray, right_keys: np.ndarray
         r_perm = jnp.argsort(rk)
         rk_sorted = rk[r_perm]
         lo, hi = _match_ranges(lk, rk_sorted)
-        total = int(jnp.sum(hi - lo))  # host sync: the one dynamic-shape point
+        # The one dynamic-shape sync point: only the match count crosses.
+        total = int(sync_guard.scalar(jnp.sum(hi - lo), "join.matches"))
         if total == 0:
             timeline.kernel_end("join", t0, (lo, hi))
             return np.empty(0, dtype=np.int64), np.empty(0, dtype=np.int64)
@@ -229,8 +231,7 @@ def sorted_equi_join(left_keys: np.ndarray, right_keys: np.ndarray
         left_idx, right_pos = _expand(lo, hi, capacity)
         right_idx = r_perm[jnp.clip(right_pos, 0, rk.shape[0] - 1)]
         timeline.kernel_end("join", t0, (left_idx, right_idx))
-        out_l = np.asarray(left_idx)[:total]
-        out_r = np.asarray(right_idx)[:total]
-        timeline.record_transfer("d2h",
-                                 int(out_l.nbytes) + int(out_r.nbytes))
+        # Attributed pulls (exec.transfer.d2h counted inside the seam).
+        out_l = sync_guard.pull(left_idx, "join.left_idx")[:total]
+        out_r = sync_guard.pull(right_idx, "join.right_idx")[:total]
         return out_l, out_r

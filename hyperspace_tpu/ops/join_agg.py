@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from hyperspace_tpu.execution import sync_guard
 from hyperspace_tpu.ops.aggregate import _group_sort, _segment_reduce
 from hyperspace_tpu.utils.compat import enable_x64 as _enable_x64
 from hyperspace_tpu.ops.join import _expand, _match_ranges
@@ -118,9 +119,17 @@ def join_group_aggregate(
       takes group-key values from the host tables with these — plus row
       counts and one result array per aggregate.
     """
+    from hyperspace_tpu.telemetry import timeline
     from hyperspace_tpu.utils.xla_cache import ensure_persistent_xla_cache
 
     ensure_persistent_xla_cache()
+    t0 = timeline.kernel_begin()
+    if t0 is not None:
+        # Attribution seam (conf-gated): host inputs are about to ship.
+        timeline.record_transfer("h2d", sum(
+            int(getattr(a, "nbytes", 0))
+            for a in (l_key, r_key, *columns)
+            if not isinstance(a, jax.Array)))
     with _enable_x64():
         lk = jnp.asarray(l_key)
         rk = jnp.asarray(r_key)
@@ -129,8 +138,10 @@ def join_group_aggregate(
                     np.empty(0, np.int32), [np.empty(0) for _ in agg_ops])
         r_perm = jnp.argsort(rk)
         lo, hi = _match_ranges(lk, rk[r_perm])
-        total = int(jnp.sum(hi - lo))  # sync 1: match count
+        # sync 1: match count (the standard XLA dynamic-shape point)
+        total = int(sync_guard.scalar(jnp.sum(hi - lo), "join_agg.matches"))
         if total == 0:
+            timeline.kernel_end("join_agg", t0, (lo, hi))
             return (np.empty(0, np.int64), np.empty(0, np.int64),
                     np.empty(0, np.int32), [np.empty(0) for _ in agg_ops])
         capacity = round_up_pow2(total)
@@ -149,8 +160,10 @@ def join_group_aggregate(
                if lits else jnp.zeros(0))
             for fn, lits in zip(value_fns, literals))
         perm, boundaries, n_groups = _group_sort(key_words, total)
-        g = int(n_groups)  # sync 2: group count
+        # sync 2: group count
+        g = int(sync_guard.scalar(n_groups, "join_agg.groups"))
         if g == 0:
+            timeline.kernel_end("join_agg", t0, perm)
             return (np.empty(0, np.int64), np.empty(0, np.int64),
                     np.empty(0, np.int32), [np.empty(0) for _ in agg_ops])
         gcap = round_up_pow2(g)
@@ -161,15 +174,23 @@ def join_group_aggregate(
             k_eff = min(int(k), g)
             sel = _topk_groups(out[2 + agg_i], g, k=k_eff,
                                ascending=bool(ascending), capacity=gcap)
+            timeline.kernel_end("join_agg", t0, (out, sel))
             first_rows = out[0][sel]
-            li_first = np.asarray(li[first_rows], dtype=np.int64)
-            ri_first = np.asarray(ri[first_rows], dtype=np.int64)
-            counts = np.asarray(out[1][sel])
-            results = [np.asarray(r[sel]) for r in out[2:]]
+            li_first = sync_guard.pull(
+                li[first_rows], "join_agg.li_first").astype(np.int64)
+            ri_first = sync_guard.pull(
+                ri[first_rows], "join_agg.ri_first").astype(np.int64)
+            counts = sync_guard.pull(out[1][sel], "join_agg.counts")
+            results = [sync_guard.pull(r[sel], "join_agg.results")
+                       for r in out[2:]]
             return li_first, ri_first, counts, results
+        timeline.kernel_end("join_agg", t0, out)
         first_rows = out[0][:g]
-        li_first = np.asarray(li[first_rows], dtype=np.int64)
-        ri_first = np.asarray(ri[first_rows], dtype=np.int64)
-        counts = np.asarray(out[1])[:g]
-        results = [np.asarray(r)[:g] for r in out[2:]]
+        li_first = sync_guard.pull(
+            li[first_rows], "join_agg.li_first").astype(np.int64)
+        ri_first = sync_guard.pull(
+            ri[first_rows], "join_agg.ri_first").astype(np.int64)
+        counts = sync_guard.pull(out[1], "join_agg.counts")[:g]
+        results = [sync_guard.pull(r, "join_agg.results")[:g]
+                   for r in out[2:]]
     return li_first, ri_first, counts, results

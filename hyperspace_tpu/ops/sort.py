@@ -103,6 +103,8 @@ def bucket_sort_permutation(
     On TPU the hash stage runs as the fused pallas kernel; the choice is a
     static jit arg so env flips retrace (see ``ops.hash.use_pallas``).
     """
+    from hyperspace_tpu.execution import sync_guard
+    from hyperspace_tpu.telemetry import timeline
     from hyperspace_tpu.utils.xla_cache import ensure_persistent_xla_cache
 
     ensure_persistent_xla_cache()
@@ -111,10 +113,16 @@ def bucket_sort_permutation(
         capacity = -(-max(n, 1) // pad_to) * pad_to
         word_cols = [_pad_rows(w, capacity) for w in word_cols]
         order_words = [_pad_rows(w, capacity) for w in order_words]
-    import numpy as np
-
-    stacked = np.asarray(_bucket_sort_impl(
-        tuple(word_cols), tuple(order_words), n, num_buckets, use_pallas()))
+    t0 = timeline.kernel_begin()
+    if t0 is not None:
+        timeline.record_transfer("h2d", sum(
+            int(getattr(a, "nbytes", 0))
+            for a in (*word_cols, *order_words)
+            if not isinstance(a, jax.Array)))
+    out = _bucket_sort_impl(
+        tuple(word_cols), tuple(order_words), n, num_buckets, use_pallas())
+    timeline.kernel_end("bucket_sort", t0, out)
+    stacked = sync_guard.pull(out, "sort.permutation")
     return stacked[0, :n], stacked[1, :n]
 
 

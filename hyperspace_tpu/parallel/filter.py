@@ -20,6 +20,7 @@ from typing import Callable, List, Sequence
 
 import numpy as np
 
+from hyperspace_tpu.execution import sync_guard
 from hyperspace_tpu.parallel.mesh import SHARD_AXIS, build_mesh
 from hyperspace_tpu.utils.compat import enable_x64 as _enable_x64
 
@@ -58,4 +59,4 @@ def eval_predicate_on_mesh(fn: Callable, columns: Sequence[np.ndarray],
             sharded.append(jax.make_array_from_single_device_arrays(
                 (shard_rows * n_dev,), sharding, parts))
         mask = fn(sharded, literals)
-        return np.asarray(mask)[:n]
+        return sync_guard.pull(mask, "mesh_filter.mask")[:n]

@@ -28,6 +28,7 @@ try:
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map as _shard_map
 
+from hyperspace_tpu.execution import sync_guard
 from hyperspace_tpu.parallel.mesh import SHARD_AXIS
 from hyperspace_tpu.utils.compat import enable_x64 as _enable_x64
 from hyperspace_tpu.utils.shapes import round_up_pow2
@@ -136,16 +137,18 @@ def copartitioned_join_ragged(
 def _copartitioned_join_padded(lk, lvalid, rk, rvalid, D, L, R, mesh):
     # Scoped x64: int64 join keys keep full width (see ops/join.py).
     with _enable_x64():
-        counts = np.asarray(_count_program(lk, lvalid, rk, rvalid, mesh=mesh))
+        counts = sync_guard.pull(
+            _count_program(lk, lvalid, rk, rvalid, mesh=mesh),
+            "mesh_join.counts")
         capacity = int(counts.max()) if counts.size else 0
         if capacity == 0:
             return np.empty(0, np.int64), np.empty(0, np.int64)
         capacity = round_up_pow2(capacity)
         li, ri, totals = _materialize_program(
             lk, lvalid, rk, rvalid, capacity=capacity, mesh=mesh)
-    li = np.asarray(li).reshape(D, capacity)
-    ri = np.asarray(ri).reshape(D, capacity)
-    totals = np.asarray(totals).reshape(D)
+    li = sync_guard.pull(li, "mesh_join.li").reshape(D, capacity)
+    ri = sync_guard.pull(ri, "mesh_join.ri").reshape(D, capacity)
+    totals = sync_guard.pull(totals, "mesh_join.totals").reshape(D)
     out_l, out_r = [], []
     for d in range(D):
         t = int(totals[d])

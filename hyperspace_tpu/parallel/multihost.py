@@ -53,6 +53,7 @@ try:
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map as _shard_map
 
+from hyperspace_tpu.execution import sync_guard
 from hyperspace_tpu.ops.hash import _bucket_ids_impl, use_pallas
 from hyperspace_tpu.parallel.shuffle import (
     ShuffleResult,
@@ -195,7 +196,8 @@ def hierarchical_bucket_shuffle(
             num_buckets=num_buckets, n_slices=S, per_slice=Pn,
             cap_dcn=cap_dcn, cap_ici=cap_ici, n_key_cols=n_key_cols,
             mesh=mesh, pallas=use_pallas())
-        over = np.asarray(overflows).reshape(n_devices, 2).sum(axis=0)
+        over = sync_guard.pull(
+            overflows, "shuffle.overflows").reshape(n_devices, 2).sum(axis=0)
         if over[0] == 0 and over[1] == 0:
             break
         grew = False
@@ -210,10 +212,10 @@ def hierarchical_bucket_shuffle(
             raise RuntimeError(
                 "hierarchical_bucket_shuffle: capacity overflow at maximum")
 
-    counts = np.asarray(counts).reshape(-1)
+    counts = sync_guard.pull(counts, "shuffle.counts").reshape(-1)
     perm, buckets_sorted, routed_payload = unpack_shuffle_output(
-        np.asarray(out), counts, n_devices, Pn * cap_ici, n_key_cols,
-        payload_words is not None)
+        sync_guard.pull(out, "shuffle.routed"), counts, n_devices,
+        Pn * cap_ici, n_key_cols, payload_words is not None)
     return ShuffleResult(perm=perm, buckets_sorted=buckets_sorted,
                          device_row_counts=counts,
                          capacity=cap_ici), routed_payload

@@ -39,6 +39,7 @@ try:  # jax >= 0.4.35 exports shard_map at top level
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map as _shard_map
 
+from hyperspace_tpu.execution import sync_guard
 from hyperspace_tpu.io.columnar import join_words64, split_words64
 from hyperspace_tpu.ops.hash import _bucket_ids_impl, use_pallas
 from hyperspace_tpu.parallel.mesh import SHARD_AXIS
@@ -205,16 +206,18 @@ def bucket_shuffle(
             hw, ow, rw, pl, valid,
             num_buckets=num_buckets, num_devices=n_devices, capacity=capacity,
             n_key_cols=n_key_cols, mesh=mesh, pallas=use_pallas())
-        overflow_total = int(np.sum(np.asarray(overflow)))
+        overflow_total = int(sync_guard.scalar(
+            jnp.sum(overflow), "shuffle.overflow"))
         if overflow_total == 0:
             break
         if capacity >= local:  # cannot grow further; should be unreachable
             raise RuntimeError("bucket_shuffle: capacity overflow at maximum")
         capacity = min(local, capacity * 2)
 
-    counts = np.asarray(counts).reshape(-1)
+    counts = sync_guard.pull(counts, "shuffle.counts").reshape(-1)
     perm, buckets_sorted, routed_payload = unpack_shuffle_output(
-        np.asarray(out), counts, n_devices, n_devices * capacity,
+        sync_guard.pull(out, "shuffle.routed"), counts,
+        n_devices, n_devices * capacity,
         n_key_cols, payload_words is not None)
     result = ShuffleResult(perm=perm, buckets_sorted=buckets_sorted,
                            device_row_counts=counts, capacity=capacity)

@@ -39,6 +39,13 @@ finding):
                     ``warn``, past 50% → ``crit``.
   ``degraded``      ``degraded.fallbacks`` / ``quarantine.files``
                     counters nonzero this process → ``warn``.
+  ``lint``          lint freshness (docs/18): a NON-EMPTY checked-in
+                    ``.hslint-baseline.json`` (grandfathered findings
+                    nobody burned down) or a baseline written against an
+                    older rule-catalog version than the installed
+                    ``lint.rules.CATALOG_VERSION`` (its fingerprints may
+                    hide what the new rules would raise) → ``warn``;
+                    also publishes the ``lint.baseline.entries`` gauge.
   ================  =========================================================
 
 The report is cheap (stat-level listings, process counters, one ledger
@@ -139,6 +146,7 @@ def doctor(session) -> DoctorReport:
             _guarded("perf", lambda: _check_perf(session)),
             _guarded("serving", lambda: _check_serving(session)),
             _guarded("degraded", lambda: _check_degraded(session)),
+            _guarded("lint", lambda: _check_lint(session)),
         ]
         report = DoctorReport(checks)
         metrics.inc("doctor.runs")
@@ -309,6 +317,56 @@ def _slo_burn(hist_snapshot, slo_ms: float) -> float:
         if b <= slo_ms:
             under += float(n)
     return max(0.0, (count - under) / count)
+
+
+def _check_lint(session, path: Optional[str] = None) -> DoctorCheck:
+    """Lint freshness (docs/18-static-analysis.md): the repo contract is
+    an EMPTY baseline, re-validated against the current rule-catalog
+    version.  Graded ``warn`` — stale static guarantees are a risk, not
+    an outage — and ``ok`` when no baseline file exists at all (an
+    installed package without the repo checkout has nothing to grade).
+    ``path`` overrides the repo-root default (tests)."""
+    import json
+    import os
+
+    from hyperspace_tpu.lint.engine import BASELINE_NAME
+    from hyperspace_tpu.lint.rules import CATALOG_VERSION
+    from hyperspace_tpu.telemetry import metrics
+
+    if path is None:
+        root = __file__
+        for _ in range(3):  # telemetry/doctor.py -> telemetry -> pkg -> repo
+            root = os.path.dirname(root)
+        path = os.path.join(root, BASELINE_NAME)
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except OSError:
+        return DoctorCheck("lint", "ok", "no baseline file (nothing "
+                           "grandfathered)", {})
+    except ValueError:
+        return DoctorCheck("lint", "warn",
+                           f"{BASELINE_NAME} is unparseable", {"path": path})
+    entries = data.get("entries", []) if isinstance(data, dict) else []
+    written_version = data.get("catalog_version") \
+        if isinstance(data, dict) else None
+    metrics.set_gauge("lint.baseline.entries", len(entries))
+    if entries:
+        return DoctorCheck(
+            "lint", "warn",
+            f"{len(entries)} grandfathered lint finding(s) in the "
+            f"baseline — the contract is EMPTY; burn them down "
+            f"(docs/18-static-analysis.md)",
+            {"entries": len(entries), "path": path})
+    if written_version is not None and written_version != CATALOG_VERSION:
+        return DoctorCheck(
+            "lint", "warn",
+            f"baseline written against rule catalog v{written_version}, "
+            f"installed rules are v{CATALOG_VERSION} — rerun "
+            f"`python -m hyperspace_tpu.lint --update-baseline` (it "
+            f"should stay empty)",
+            {"written": written_version, "current": CATALOG_VERSION})
+    return DoctorCheck("lint", "ok", "baseline empty and current", {})
 
 
 def _check_degraded(session) -> DoctorCheck:

@@ -622,3 +622,611 @@ class TestSelfClean:
 
         with pytest.raises(ValueError, match="Unknown fault site"):
             faults.FaultPlan(site="stoer.put", kind="eio")
+
+
+# ---------------------------------------------------------------------------
+# Call graph (lint/callgraph.py)
+# ---------------------------------------------------------------------------
+CG_A = '''\
+from hyperspace_tpu.b import middle
+
+
+def entry():
+    return middle()
+'''
+
+CG_B = '''\
+from hyperspace_tpu import c
+
+
+def middle():
+    return c.leaf()
+'''
+
+CG_C = '''\
+import time
+
+from hyperspace_tpu import a
+
+
+def leaf():
+    time.sleep(0.1)
+    return a.entry()  # cycle back to the entry point
+'''
+
+CG_LOCKED = '''\
+import threading
+
+from hyperspace_tpu.b import middle
+
+_lock = threading.Lock()
+
+
+def locked_entry():
+    with _lock:
+        return middle()
+
+
+def unlocked_entry():
+    return middle()
+'''
+
+
+@pytest.mark.quick
+class TestCallGraph:
+    def _graph(self, tmp_path, extra=None):
+        from hyperspace_tpu.lint import callgraph
+
+        files = {"hyperspace_tpu/a.py": CG_A,
+                 "hyperspace_tpu/b.py": CG_B,
+                 "hyperspace_tpu/c.py": CG_C}
+        files.update(extra or {})
+        root = make_repo(tmp_path, files)
+        ctx = lint_engine.build_context(root)
+        return callgraph.CallGraph(ctx), ctx
+
+    def test_cross_module_resolution(self, tmp_path):
+        g, _ = self._graph(tmp_path)
+        entry = g.function("hyperspace_tpu/a.py", "entry")
+        assert entry is not None
+        sites = g.sites_of(entry.fid)
+        assert any(s.targets == ("hyperspace_tpu/b.py::middle",)
+                   for s in sites)
+        mid_sites = g.sites_of("hyperspace_tpu/b.py::middle")
+        assert any("hyperspace_tpu/c.py::leaf" in s.targets
+                   for s in mid_sites)
+
+    def test_cycle_tolerant_reachability_with_witness(self, tmp_path):
+        from hyperspace_tpu.lint import callgraph
+
+        g, _ = self._graph(tmp_path)
+        hit = g.find_path("hyperspace_tpu/a.py::entry",
+                          lambda s: s.name == "time.sleep")
+        assert hit is not None
+        chain, site = hit
+        assert site.caller == "hyperspace_tpu/c.py::leaf"
+        text = callgraph.describe_chain(g, chain, site)
+        assert "entry" in text and "time.sleep()" in text
+        # The a -> b -> c -> a cycle must not hang an unsatisfiable scan.
+        assert g.find_path("hyperspace_tpu/a.py::entry",
+                           lambda s: s.name == "never.matches") is None
+
+    def test_lock_held_context_propagates_to_call_sites(self, tmp_path):
+        g, _ = self._graph(
+            tmp_path, {"hyperspace_tpu/locked.py": CG_LOCKED})
+        locked = [s for s in g.sites_of("hyperspace_tpu/locked.py::"
+                                        "locked_entry")
+                  if s.name == "middle"]
+        unlocked = [s for s in g.sites_of("hyperspace_tpu/locked.py::"
+                                          "unlocked_entry")
+                    if s.name == "middle"]
+        assert locked and locked[0].locks \
+            == ("hyperspace_tpu/locked.py:<module>._lock",)
+        assert unlocked and unlocked[0].locks == ()
+
+    def test_deadline_scope_propagation(self, tmp_path):
+        dl = ("def check(phase=\"\"):\n    pass\n\n\n"
+              "def scope(seconds):\n    pass\n")
+        caller = ("from hyperspace_tpu.utils import deadline as _dl\n\n\n"
+                  "def dispatch():\n    _dl.check(\"node\")\n\n\n"
+                  "def outer():\n    return dispatch()\n")
+        g, _ = self._graph(tmp_path, {
+            "hyperspace_tpu/utils/deadline.py": dl,
+            "hyperspace_tpu/exec2.py": caller})
+        assert g.reaches(
+            "hyperspace_tpu/exec2.py::outer",
+            lambda s: s.name.endswith(".check")
+            and any("utils/deadline.py" in t for t in s.targets))
+
+    def test_self_method_and_base_class_resolution(self, tmp_path):
+        src = ("class Base:\n"
+               "    def helper(self):\n        pass\n\n\n"
+               "class Impl(Base):\n"
+               "    def run(self):\n        self.helper()\n")
+        g, _ = self._graph(tmp_path, {"hyperspace_tpu/cls.py": src})
+        sites = g.sites_of("hyperspace_tpu/cls.py::Impl.run")
+        assert any(s.targets == ("hyperspace_tpu/cls.py::Base.helper",)
+                   for s in sites)
+
+
+# ---------------------------------------------------------------------------
+# device-discipline
+# ---------------------------------------------------------------------------
+DEVICE_OK = '''\
+import jax.numpy as jnp
+
+from hyperspace_tpu.execution import sync_guard
+
+
+def kernel(x):
+    y = jnp.cumsum(x)
+    total = int(sync_guard.scalar(jnp.sum(y), "t.total"))
+    host = sync_guard.pull(y, "t.pull")
+    return host, total
+
+
+def host_only(arr):
+    import numpy as np
+
+    return np.asarray(arr)  # parameter: no device taint
+'''
+
+
+@pytest.mark.quick
+class TestDeviceDiscipline:
+    def _run(self, tmp_path, files):
+        root = make_repo(tmp_path, files)
+        return new_of(run(root)[0], "device-discipline")
+
+    def test_sanctioned_seams_and_host_params_are_quiet(self, tmp_path):
+        assert self._run(
+            tmp_path, {"hyperspace_tpu/ops/k.py": DEVICE_OK}) == []
+
+    def test_implicit_scalar_sync_fires(self, tmp_path):
+        got = self._run(tmp_path, {"hyperspace_tpu/ops/k.py":
+                                   "import jax.numpy as jnp\n\n\n"
+                                   "def bad(x):\n"
+                                   "    return float(jnp.sum(x))\n"})
+        assert any("implicit-sync" in f.ident and "float()" in f.message
+                   for f in got)
+
+    def test_asarray_pull_fires(self, tmp_path):
+        got = self._run(tmp_path, {"hyperspace_tpu/ops/k.py":
+                                   "import jax.numpy as jnp\n"
+                                   "import numpy as np\n\n\n"
+                                   "def bad(x):\n"
+                                   "    y = jnp.sort(x)\n"
+                                   "    return np.asarray(y)\n"})
+        assert any("sync_guard.pull" in f.message for f in got)
+
+    def test_interprocedural_taint_through_helper(self, tmp_path):
+        src = ("import jax.numpy as jnp\n\n\n"
+               "def make(x):\n"
+               "    return jnp.cumsum(x)\n\n\n"
+               "def bad(x):\n"
+               "    y = make(x)\n"
+               "    return y.item()\n")
+        got = self._run(tmp_path, {"hyperspace_tpu/ops/k.py": src})
+        assert any(".item()" in f.message for f in got)
+
+    def test_branching_on_device_value_fires(self, tmp_path):
+        src = ("import jax.numpy as jnp\n\n\n"
+               "def bad(x):\n"
+               "    m = jnp.any(x)\n"
+               "    if m:\n"
+               "        return 1\n"
+               "    return 0\n")
+        got = self._run(tmp_path, {"hyperspace_tpu/ops/k.py": src})
+        assert any("branching on a device value" in f.message for f in got)
+
+    def test_device_loop_fires(self, tmp_path):
+        src = ("import jax.numpy as jnp\n\n\n"
+               "def bad(x):\n"
+               "    y = jnp.sort(x)\n"
+               "    out = 0\n"
+               "    for v in y:\n"
+               "        out = out + 1\n"
+               "    return out\n")
+        got = self._run(tmp_path, {"hyperspace_tpu/ops/k.py": src})
+        assert any("device-loop" in f.ident for f in got)
+
+    def test_untimed_block_until_ready_fires(self, tmp_path):
+        src = ("import jax\n\n\n"
+               "def bad(x):\n"
+               "    jax.block_until_ready(x)\n"
+               "    return x\n")
+        got = self._run(tmp_path, {"hyperspace_tpu/ops/k.py": src})
+        assert any("untimed-sync" in f.ident for f in got)
+
+    def test_float64_outside_x64_fires_and_inside_is_quiet(self, tmp_path):
+        bad = ("import jax.numpy as jnp\n\n\n"
+               "def bad(x):\n"
+               "    return x.astype(jnp.float64)\n")
+        ok = ("import jax.numpy as jnp\n\n"
+              "from hyperspace_tpu.utils.compat import enable_x64 as "
+              "_enable_x64\n\n\n"
+              "def good(x):\n"
+              "    with _enable_x64():\n"
+              "        return x.astype(jnp.float64)\n")
+        got = self._run(tmp_path, {"hyperspace_tpu/ops/k.py": bad})
+        assert any("float64-literal" in f.ident for f in got)
+        assert self._run(tmp_path, {"hyperspace_tpu/ops/k.py": ok}) == []
+
+    def test_jit_conf_read_and_mutable_default_fire(self, tmp_path):
+        src = ("import os\n\n"
+               "import jax\n\n\n"
+               "@jax.jit\n"
+               "def bad(x, opts=[]):\n"
+               "    flag = os.environ.get(\"HS_FLAG\")\n"
+               "    return x\n")
+        got = self._run(tmp_path, {"hyperspace_tpu/ops/k.py": src})
+        idents = {f.ident.split(":")[0] for f in got}
+        assert "jit-unsafe" in idents
+        msgs = " ".join(f.message for f in got)
+        assert "trace time" in msgs and "mutable default" in msgs
+
+    def test_static_arg_literal_list_fires(self, tmp_path):
+        src = ("from functools import partial\n\n"
+               "import jax\n\n\n"
+               "@partial(jax.jit, static_argnames=(\"ops\",))\n"
+               "def kern(x, ops):\n"
+               "    return x\n\n\n"
+               "def caller(x):\n"
+               "    return kern(x, ops=[\"sum\"])\n")
+        got = self._run(tmp_path, {"hyperspace_tpu/ops/k.py": src})
+        assert any("static arg" in f.message for f in got)
+
+    def test_pragma_suppresses(self, tmp_path):
+        src = ("import jax.numpy as jnp\n\n\n"
+               "def boundary(x):\n"
+               "    # hslint: allow[device-discipline] calibration probe\n"
+               "    return float(jnp.sum(x))\n")
+        assert self._run(
+            tmp_path, {"hyperspace_tpu/ops/k.py": src}) == []
+
+    def test_jitted_bodies_are_exempt_from_sync_checks(self, tmp_path):
+        src = ("import jax\n"
+               "import jax.numpy as jnp\n\n\n"
+               "@jax.jit\n"
+               "def kern(x):\n"
+               "    if jnp.issubdtype(x.dtype, jnp.floating):\n"
+               "        return x\n"
+               "    return x * 2\n")
+        assert self._run(
+            tmp_path, {"hyperspace_tpu/ops/k.py": src}) == []
+
+
+# ---------------------------------------------------------------------------
+# blocking-discipline
+# ---------------------------------------------------------------------------
+BLOCK_SERVER_OK = SERVER_PY + '''
+
+    def also_good(self):
+        with self._lock:
+            self._n -= 1
+'''
+
+
+@pytest.mark.quick
+class TestBlockingDiscipline:
+    def _run(self, tmp_path, files):
+        root = make_repo(tmp_path, files)
+        return new_of(run(root)[0], "blocking-discipline")
+
+    def test_clean_server_fixture_is_quiet(self, tmp_path):
+        assert self._run(tmp_path, {
+            "hyperspace_tpu/interop/server.py": BLOCK_SERVER_OK}) == []
+
+    def test_direct_sleep_under_lock_fires(self, tmp_path):
+        src = (SERVER_PY +
+               "\n    def bad(self):\n"
+               "        import time\n\n"
+               "        with self._lock:\n"
+               "            time.sleep(1)\n")
+        got = self._run(tmp_path,
+                        {"hyperspace_tpu/interop/server.py": src})
+        assert any("lock-held-blocking" in f.ident and
+                   "time.sleep" in f.message for f in got)
+
+    def test_transitive_store_put_under_lock_fires_with_chain(
+            self, tmp_path):
+        helper = ("def persist(store, payload):\n"
+                  "    store.put(\"k\", payload)\n")
+        src = (SERVER_PY +
+               "\n    def bad(self, store):\n"
+               "        from hyperspace_tpu.telemetry.sink import persist\n\n"
+               "        with self._lock:\n"
+               "            persist(store, b\"x\")\n")
+        got = self._run(tmp_path, {
+            "hyperspace_tpu/interop/server.py": src,
+            "hyperspace_tpu/telemetry/sink.py": helper})
+        assert any("store .put()" in f.message and
+                   "persist" in f.message for f in got)
+
+    def test_same_call_outside_lock_is_quiet(self, tmp_path):
+        helper = ("def persist(store, payload):\n"
+                  "    store.put(\"k\", payload)\n")
+        src = (SERVER_PY +
+               "\n    def fine(self, store):\n"
+               "        from hyperspace_tpu.telemetry.sink import persist\n\n"
+               "        persist(store, b\"x\")\n")
+        assert self._run(tmp_path, {
+            "hyperspace_tpu/interop/server.py": src,
+            "hyperspace_tpu/telemetry/sink.py": helper}) == []
+
+    def test_missing_entry_check_in_execute_node_fires(self, tmp_path):
+        dl = ("def check(phase=\"\"):\n    pass\n\n\n"
+              "def scope(seconds):\n    pass\n")
+        ex = ("from hyperspace_tpu.utils import deadline as _deadline\n\n\n"
+              "class Executor:\n"
+              "    def execute(self, plan):\n"
+              "        out = self._execute_node(plan)\n"
+              "        _deadline.check(\"exit\")\n"
+              "        return out\n\n"
+              "    def _execute_node(self, plan):\n"
+              "        return plan\n")
+        got = self._run(tmp_path, {
+            "hyperspace_tpu/utils/deadline.py": dl,
+            "hyperspace_tpu/execution/executor.py": ex})
+        assert any(f.ident == "deadline:Executor._execute_node:entry"
+                   for f in got)
+
+    def test_missing_exit_check_in_execute_fires(self, tmp_path):
+        dl = ("def check(phase=\"\"):\n    pass\n\n\n"
+              "def scope(seconds):\n    pass\n")
+        ex = ("from hyperspace_tpu.utils import deadline as _deadline\n\n\n"
+              "class Executor:\n"
+              "    def execute(self, plan):\n"
+              "        return self._execute_node(plan)\n\n"
+              "    def _execute_node(self, plan):\n"
+              "        _deadline.check(\"entry\")\n"
+              "        return plan\n")
+        got = self._run(tmp_path, {
+            "hyperspace_tpu/utils/deadline.py": dl,
+            "hyperspace_tpu/execution/executor.py": ex})
+        assert any(f.ident == "deadline:Executor.execute:exit"
+                   for f in got)
+
+    def test_checked_executor_is_quiet(self, tmp_path):
+        dl = ("def check(phase=\"\"):\n    pass\n\n\n"
+              "def scope(seconds):\n    pass\n")
+        ex = ("from hyperspace_tpu.utils import deadline as _deadline\n\n\n"
+              "class Executor:\n"
+              "    def execute(self, plan):\n"
+              "        out = self._execute_node(plan)\n"
+              "        _deadline.check(\"exit\")\n"
+              "        return out\n\n"
+              "    def _execute_node(self, plan):\n"
+              "        _deadline.check(\"entry\")\n"
+              "        return plan\n")
+        assert self._run(tmp_path, {
+            "hyperspace_tpu/utils/deadline.py": dl,
+            "hyperspace_tpu/execution/executor.py": ex}) == []
+
+    def test_external_operator_dispatch_fires(self, tmp_path):
+        dl = ("def check(phase=\"\"):\n    pass\n\n\n"
+              "def scope(seconds):\n    pass\n")
+        ex = ("from hyperspace_tpu.utils import deadline as _deadline\n\n\n"
+              "class Executor:\n"
+              "    def execute(self, plan):\n"
+              "        out = self._execute_node(plan)\n"
+              "        _deadline.check(\"exit\")\n"
+              "        return out\n\n"
+              "    def _execute_node(self, plan):\n"
+              "        _deadline.check(\"entry\")\n"
+              "        return self._execute_scan(plan)\n\n"
+              "    def _execute_scan(self, plan):\n"
+              "        return plan\n")
+        rogue = ("from hyperspace_tpu.execution.executor import Executor\n"
+                 "\n\ndef shortcut(plan):\n"
+                 "    return Executor._execute_scan(None, plan)\n")
+        got = self._run(tmp_path, {
+            "hyperspace_tpu/utils/deadline.py": dl,
+            "hyperspace_tpu/execution/executor.py": ex,
+            "hyperspace_tpu/rogue.py": rogue})
+        assert any("bypassing the deadline-checked dispatcher"
+                   in f.message for f in got)
+
+
+# ---------------------------------------------------------------------------
+# --fix autofix
+# ---------------------------------------------------------------------------
+FIXABLE = '''\
+import os
+import os
+import json
+import sys
+
+
+def f(x=[], y=2):
+    """Doc."""
+    return os.path.join(str(x), str(y), sys.prefix)
+'''
+
+
+@pytest.mark.quick
+class TestAutofix:
+    def _main(self, argv):
+        from hyperspace_tpu.lint.__main__ import main
+
+        return main(argv)
+
+    def test_dry_run_prints_diff_and_writes_nothing(self, tmp_path,
+                                                    capsys):
+        root = make_repo(tmp_path, {"hyperspace_tpu/mod.py": FIXABLE})
+        rc = self._main(["--root", root, "--no-baseline", "--fix",
+                         "--dry-run"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "-import os" in out and "+def f(x=None, y=2):" in out
+        assert (tmp_path / "hyperspace_tpu/mod.py").read_text() == FIXABLE
+
+    def test_fix_then_relint_is_clean(self, tmp_path, capsys):
+        root = make_repo(tmp_path, {"hyperspace_tpu/mod.py": FIXABLE})
+        self._main(["--root", root, "--no-baseline", "--fix"])
+        capsys.readouterr()
+        findings, _ = run(root)
+        assert new_of(findings, "hygiene") == []
+        fixed = (tmp_path / "hyperspace_tpu/mod.py").read_text()
+        assert fixed.count("import os") == 1
+        assert "import json" not in fixed
+        assert "if x is None:" in fixed and "x = []" in fixed
+        # The rewritten module still parses and behaves.
+        import ast as _ast
+
+        _ast.parse(fixed)
+
+    def test_fix_refuses_design_findings(self, tmp_path):
+        from hyperspace_tpu.lint import fix as fixer
+
+        root = make_repo(tmp_path, {
+            "hyperspace_tpu/ops/k.py":
+                "import jax.numpy as jnp\n\n\n"
+                "def bad(x):\n"
+                "    return float(jnp.sum(x))\n"})
+        ctx = lint_engine.build_context(root)
+        findings, _ = lint_engine.run_lint(root, None, set(), ctx=ctx)
+        assert any(f.rule == "device-discipline" for f in findings)
+        assert fixer.plan_fixes(ctx, findings) == []
+
+    def test_multi_alias_import_keeps_other_bindings(self, tmp_path):
+        src = ("import json, sys\n\n\n"
+               "def g():\n"
+               "    return sys.prefix\n")
+        root = make_repo(tmp_path, {"hyperspace_tpu/mod.py": src})
+        self._main(["--root", root, "--no-baseline", "--fix"])
+        fixed = (tmp_path / "hyperspace_tpu/mod.py").read_text()
+        assert "import sys" in fixed and "json" not in fixed
+
+
+# ---------------------------------------------------------------------------
+# SARIF
+# ---------------------------------------------------------------------------
+@pytest.mark.quick
+class TestSarif:
+    def test_sarif_schema_and_exit_codes_unchanged(self, tmp_path,
+                                                   capsys):
+        from hyperspace_tpu.lint.__main__ import main
+
+        root = make_repo(tmp_path, {
+            "hyperspace_tpu/ops/k.py":
+                "import jax.numpy as jnp\n\n\n"
+                "def bad(x):\n"
+                "    return float(jnp.sum(x))\n"})
+        out_path = str(tmp_path / "out.sarif")
+        rc = main(["--root", root, "--no-baseline", "--sarif", out_path])
+        capsys.readouterr()
+        assert rc == 1  # exit code contract unchanged by --sarif
+        doc = json.loads((tmp_path / "out.sarif").read_text())
+        assert doc["version"] == "2.1.0"
+        run_obj = doc["runs"][0]
+        assert run_obj["tool"]["driver"]["name"] == "hslint"
+        results = run_obj["results"]
+        assert any(r["ruleId"] == "device-discipline" for r in results)
+        loc = results[0]["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith(".py")
+        assert loc["region"]["startLine"] >= 1
+
+    def test_clean_repo_writes_empty_results(self, tmp_path, capsys):
+        from hyperspace_tpu.lint.__main__ import main
+
+        root = make_repo(tmp_path)
+        out_path = str(tmp_path / "out.sarif")
+        rc = main(["--root", root, "--no-baseline", "--sarif", out_path])
+        capsys.readouterr()
+        assert rc == 0
+        doc = json.loads((tmp_path / "out.sarif").read_text())
+        assert doc["runs"][0]["results"] == []
+
+
+# ---------------------------------------------------------------------------
+# Seeded-violation must-fail (the CI lint lane's bark check, in-proc)
+# ---------------------------------------------------------------------------
+@pytest.mark.quick
+class TestSeededViolationsMustFail:
+    def _rc(self, root, capsys):
+        from hyperspace_tpu.lint.__main__ import main
+
+        rc = main(["--root", root, "--no-baseline"])
+        capsys.readouterr()
+        return rc
+
+    def test_planted_host_sync_fails(self, tmp_path, capsys):
+        root = make_repo(tmp_path, {
+            "hyperspace_tpu/ops/_seed.py":
+                "import jax.numpy as jnp\n\n\n"
+                "def seed(x):\n"
+                "    return float(jnp.sum(x))\n"})
+        assert self._rc(root, capsys) == 1
+
+    def test_planted_lock_held_blocking_call_fails(self, tmp_path,
+                                                   capsys):
+        root = make_repo(tmp_path, {
+            "hyperspace_tpu/telemetry/_seed.py":
+                "import threading\n"
+                "import time\n\n"
+                "_lock = threading.Lock()\n\n\n"
+                "def seed():\n"
+                "    with _lock:\n"
+                "        time.sleep(1.0)\n"})
+        assert self._rc(root, capsys) == 1
+
+
+# ---------------------------------------------------------------------------
+# Doctor lint-freshness check
+# ---------------------------------------------------------------------------
+@pytest.mark.quick
+class TestDoctorLintCheck:
+    def test_missing_baseline_is_ok(self, tmp_path):
+        from hyperspace_tpu.telemetry.doctor import _check_lint
+
+        check = _check_lint(None, path=str(tmp_path / "nope.json"))
+        assert check.status == "ok"
+
+    def test_empty_current_baseline_is_ok(self, tmp_path):
+        from hyperspace_tpu.lint.rules import CATALOG_VERSION
+        from hyperspace_tpu.telemetry.doctor import _check_lint
+
+        p = tmp_path / ".hslint-baseline.json"
+        p.write_text(json.dumps({"version": 1,
+                                 "catalog_version": CATALOG_VERSION,
+                                 "entries": []}))
+        assert _check_lint(None, path=str(p)).status == "ok"
+
+    def test_nonempty_baseline_warns_and_publishes_gauge(self, tmp_path):
+        from hyperspace_tpu.telemetry import metrics
+        from hyperspace_tpu.telemetry.doctor import _check_lint
+
+        p = tmp_path / ".hslint-baseline.json"
+        p.write_text(json.dumps({
+            "version": 1, "catalog_version": 999,
+            "entries": ["hygiene:x.py:dead-import:os"]}))
+        check = _check_lint(None, path=str(p))
+        assert check.status == "warn"
+        assert "grandfathered" in check.summary
+        assert float(metrics.snapshot().get("lint.baseline.entries",
+                                            0)) == 1.0
+
+    def test_stale_catalog_version_warns(self, tmp_path):
+        from hyperspace_tpu.lint.rules import CATALOG_VERSION
+        from hyperspace_tpu.telemetry.doctor import _check_lint
+
+        p = tmp_path / ".hslint-baseline.json"
+        p.write_text(json.dumps({"version": 1,
+                                 "catalog_version": CATALOG_VERSION - 1,
+                                 "entries": []}))
+        check = _check_lint(None, path=str(p))
+        assert check.status == "warn"
+        assert "catalog" in check.summary
+
+    def test_doctor_runs_the_lint_check_never_raising(self, tmp_path):
+        """The real doctor() includes the lint check, graded like the
+        other seven (the real repo's baseline is empty -> ok)."""
+        from hyperspace_tpu import HyperspaceSession
+        from hyperspace_tpu.telemetry.doctor import doctor
+
+        session = HyperspaceSession(system_path=str(tmp_path / "ix"))
+        report = doctor(session)
+        lint_check = report.check("lint")
+        assert lint_check is not None
+        assert lint_check.status == "ok"

@@ -1,0 +1,186 @@
+"""Strict-mode runtime device→host sync guard (execution/sync_guard.py,
+``hyperspace.system.deviceGuard.enabled``).
+
+The acceptance case the static pass alone cannot see: a DELIBERATE
+``.item()`` smuggled into an ops kernel at runtime (monkeypatched — so
+hslint's device-discipline rule never saw it) is caught mid-collect,
+raises :class:`DeviceSyncError` without any degraded-mode replan, and
+counts ``guard.sync.violations``; the sanctioned ``sync_guard.pull`` /
+``scalar`` seams stay legal while armed.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import HyperspaceSession, col
+from hyperspace_tpu.exceptions import DeviceSyncError
+from hyperspace_tpu.execution import sync_guard
+from hyperspace_tpu.telemetry import metrics
+
+
+class _Conf:
+    def __init__(self, enabled: bool) -> None:
+        self.device_guard_enabled = enabled
+
+
+@pytest.fixture(autouse=True)
+def _disarm_after():
+    yield
+    sync_guard.arm(_Conf(False))
+
+
+def _snap(name: str) -> float:
+    return float(metrics.snapshot().get(name, 0.0) or 0.0)
+
+
+class TestGuardUnit:
+    def test_off_by_default_leaves_conversions_alone(self):
+        sync_guard.arm(_Conf(False))
+        x = jnp.arange(4)
+        assert x[1].item() == 1
+        assert float(x[2]) == 2.0
+
+    def test_armed_catches_item_float_bool_int(self):
+        sync_guard.arm(_Conf(True))
+        x = jnp.arange(4)
+        before = _snap("guard.sync.violations")
+        with pytest.raises(DeviceSyncError):
+            x[0].item()
+        with pytest.raises(DeviceSyncError):
+            float(x[1])
+        with pytest.raises(DeviceSyncError):
+            bool(x[2])
+        with pytest.raises(DeviceSyncError):
+            int(x[3])
+        assert _snap("guard.sync.violations") >= before + 4
+
+    def test_attributed_seams_stay_legal_and_counted(self):
+        sync_guard.arm(_Conf(True))
+        x = jnp.arange(8)
+        before = _snap("guard.sync.attributed")
+        assert sync_guard.scalar(jnp.sum(x), "t.sum") == 28
+        np.testing.assert_array_equal(sync_guard.pull(x, "t.pull"),
+                                      np.arange(8))
+        assert _snap("guard.sync.attributed") >= before + 2
+
+    def test_host_values_pass_through_both_seams(self):
+        sync_guard.arm(_Conf(True))
+        assert sync_guard.scalar(7, "t") == 7
+        np.testing.assert_array_equal(
+            sync_guard.pull(np.arange(3), "t"), np.arange(3))
+
+    def test_disarm_restores_normal_conversions(self):
+        sync_guard.arm(_Conf(True))
+        sync_guard.arm(_Conf(False))
+        assert jnp.arange(3)[2].item() == 2
+
+    def test_error_names_the_seams_and_the_conf_key(self):
+        sync_guard.arm(_Conf(True))
+        with pytest.raises(DeviceSyncError, match="sync_guard"):
+            jnp.arange(2)[0].item()
+
+
+@pytest.fixture()
+def device_session(tmp_path):
+    path = str(tmp_path / "data")
+    os.makedirs(path, exist_ok=True)
+    pq.write_table(pa.table({
+        "k": pa.array(list(range(64)), type=pa.int64()),
+        "v": pa.array([i * 10 for i in range(64)], type=pa.int64()),
+    }), os.path.join(path, "part.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    s.conf.device_filter_min_rows = 1  # force the device filter path
+    return s, path
+
+
+class TestGuardEndToEnd:
+    def test_deliberate_item_in_ops_kernel_is_caught(
+            self, device_session, monkeypatch):
+        """The acceptance loop: a monkeypatched predicate kernel sneaks
+        an unattributed ``.item()`` — statically invisible — and strict
+        mode kills the query at the exact conversion."""
+        from hyperspace_tpu.ops import filter as ops_filter
+
+        s, path = device_session
+        orig = ops_filter.compile_predicate
+
+        def sneaky(expr, order):
+            fn, lits = orig(expr, order)
+
+            def bad_fn(cols, literals):
+                cols[0][0].item()  # the unattributed sync
+                return fn(cols, literals)
+
+            return bad_fn, lits
+
+        monkeypatch.setattr(ops_filter, "compile_predicate", sneaky)
+        s.conf.device_guard_enabled = True
+        before = _snap("guard.sync.violations")
+        with pytest.raises(DeviceSyncError):
+            s.read.parquet(path).filter(col("k") > 5).collect()
+        assert _snap("guard.sync.violations") >= before + 1
+        # The failure is a CONTRACT violation, not a degraded condition:
+        # no source-fallback replan may have swallowed it.
+        rep = s.last_run_report_value
+        if rep is not None:
+            assert not [d for d in rep.decisions
+                        if d.get("kind") == "replan"]
+
+    def test_same_kernel_passes_with_guard_off(
+            self, device_session, monkeypatch):
+        from hyperspace_tpu.ops import filter as ops_filter
+
+        s, path = device_session
+        orig = ops_filter.compile_predicate
+
+        def sneaky(expr, order):
+            fn, lits = orig(expr, order)
+
+            def bad_fn(cols, literals):
+                cols[0][0].item()
+                return fn(cols, literals)
+
+            return bad_fn, lits
+
+        monkeypatch.setattr(ops_filter, "compile_predicate", sneaky)
+        s.conf.device_guard_enabled = False
+        out = s.read.parquet(path).filter(col("k") > 5).collect()
+        assert out.num_rows == 58
+
+    def test_clean_device_query_is_legal_under_strict_mode(
+            self, device_session):
+        """The shipped kernels pull only through the attributed seams,
+        so a real device query survives strict mode bit-identically."""
+        s, path = device_session
+        s.conf.device_guard_enabled = True
+        strict = s.read.parquet(path).filter(col("k") >= 32).collect()
+        s.conf.device_guard_enabled = False
+        s.conf.device_filter_min_rows = 1 << 60  # host path reference
+        host = s.read.parquet(path).filter(col("k") >= 32).collect()
+        assert sorted(strict.column("k").to_pylist()) \
+            == sorted(host.column("k").to_pylist())
+
+    def test_build_and_join_survive_strict_mode(self, device_session,
+                                                tmp_path):
+        """The build kernel (bucket_sort) and the join/aggregate kernels
+        all pull through sync_guard — an index build plus a grouped
+        aggregate under strict mode completes."""
+        from hyperspace_tpu import Hyperspace
+        from hyperspace_tpu.index.index_config import IndexConfig
+
+        s, path = device_session
+        s.conf.num_buckets = 4
+        s.conf.device_guard_enabled = True
+        hs = Hyperspace(s)
+        ds = s.read.parquet(path)
+        hs.create_index(ds, IndexConfig("ix_guard", ["k"], ["v"]))
+        out = (s.read.parquet(path).filter(col("k") >= 8)
+               .select("k", "v").collect())
+        assert out.num_rows == 56
