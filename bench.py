@@ -1046,8 +1046,9 @@ SECTION_NAMES = ("setup", "sf1_queries", "device_agg_probe",
                  "resident_agg", "warm_resident_join", "warm_q3",
                  "warm_q10", "window_bench", "kernel_bench",
                  "calibration", "telemetry_overhead", "advisor",
-                 "integrity", "build_profile", "timeline", "serving",
-                 "flight_recorder", "ingest", "sf10", "sf100")
+                 "integrity", "build_profile", "timeline",
+                 "build_pipeline", "serving", "flight_recorder",
+                 "ingest", "sf10", "sf100")
 
 
 def main() -> int:
@@ -1098,6 +1099,8 @@ def main() -> int:
             harness.section("build_profile",
                             lambda: _sec_build_profile(root))
             harness.section("timeline", lambda: _sec_timeline(root))
+            harness.section("build_pipeline",
+                            lambda: _sec_build_pipeline(root))
             harness.section("serving", lambda: _sec_serving(ctx))
             harness.section("flight_recorder",
                             lambda: _sec_flight_recorder(ctx))
@@ -2208,6 +2211,130 @@ def _sec_timeline(root: str) -> dict:
         "phase_peak_rss_mb": report.phase_memory_mb(),
         "trace_events": len(events),
         "doctor_status": health.status,
+    }}
+
+
+def _sec_build_pipeline(root: str) -> dict:
+    """Overlapped-builder acceptance (docs/13-benchmarking.md): the
+    SAME spill-forced covering-index build runs with
+    ``hyperspace.index.build.pipeline.enabled`` off (the forced-serial
+    reference: inline reads, inline routing, sequential finalize) then
+    on (prefetch + route workers + streaming bucket-group finalize).
+    The two index trees must be BIT-equal — the pipeline may change
+    scheduling, never layout; a divergence aborts the bench like a
+    wrong answer.  On multi-core hosts the overlapped build is
+    correctness-gated >= 1.5x the serial one; single-core hosts record
+    the ratio without gating (thread overlap has nothing to overlap ON
+    there — the fused-kernel and spill-format wins land in BOTH
+    timings).  A final timeline-enabled pipelined run records the busy
+    matrix (``read_idle_while_spill``) and the two stall phases, so
+    ``--compare`` can watch the overlap itself regress, not just wall
+    clock.  Self-contained (own source, throwaway sessions)."""
+    import hashlib
+    from collections import defaultdict
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig
+    from hyperspace_tpu.io.parquet import bucket_id_of_file
+    from hyperspace_tpu.telemetry import timeline as _timeline
+
+    n = max(50_000, N_LINEITEM // 10)
+    files = 8
+    src = os.path.join(root, "buildpipe_src")
+    os.makedirs(src, exist_ok=True)
+    rng = np.random.default_rng(37)
+    table = pa.table({
+        "k": pa.array(rng.integers(0, max(1, n // 4), size=n),
+                      type=pa.int64()),
+        "v1": rng.random(n),
+        "v2": rng.random(n),
+    })
+    step = -(-n // files)
+    for f in range(files):
+        pq.write_table(table.slice(f * step, step),
+                       os.path.join(src, f"part-{f:05d}.parquet"))
+
+    seq = iter(range(1 << 20))
+    last: dict = {}
+
+    def build(pipelined: bool, timeline_on: bool = False) -> None:
+        s = HyperspaceSession(system_path=os.path.join(
+            root, f"buildpipe_ix_{next(seq)}"))
+        s.conf.num_buckets = NUM_BUCKETS
+        s.conf.device_batch_rows = max(1024, n // 8)  # force the spill
+        s.conf.parallel_build = "off"  # the spill path is single-chip
+        s.conf.build_pipeline_enabled = pipelined
+        if timeline_on:
+            s.conf.timeline_enabled = True
+        hs = Hyperspace(s)
+        hs.create_index(s.read.parquet(src),
+                        IndexConfig("bpx", ["k"], ["v1", "v2"]))
+        last["session"], last["hs"] = s, hs
+
+    def digests() -> dict:
+        entry = last["session"].index_collection_manager.get_index("bpx")
+        out = defaultdict(list)
+        for f in entry.content.file_infos():
+            with open(f.name, "rb") as fh:
+                out[bucket_id_of_file(f.name)].append(
+                    hashlib.sha256(fh.read()).hexdigest())
+        return {b: sorted(d) for b, d in out.items()}
+
+    reps = min(3, REPEATS)
+    build(True)  # untimed warmup: JIT/import costs land here
+    t_serial = _time(lambda: build(False), repeats=reps)
+    serial_digests = digests()
+    t_piped = _time(lambda: build(True), repeats=reps)
+    piped_digests = digests()
+    if serial_digests != piped_digests:
+        raise SystemExit(
+            "build_pipeline bench: the overlapped builder's index tree "
+            "diverged from the forced-serial reference — the pipeline "
+            "may change scheduling, never layout")
+    speedup = t_serial["median"] / max(t_piped["median"], 1e-9)
+    cores = os.cpu_count() or 1
+    gated = cores >= 2
+    if gated and speedup < 1.5:
+        raise SystemExit(
+            f"build_pipeline bench: overlapped build only {speedup:.2f}x "
+            f"the serial reference on a {cores}-core host "
+            f"(correctness gate: >= 1.5x)")
+
+    # Busy matrix + stall phases off one timeline-enabled pipelined run.
+    try:
+        build(True, timeline_on=True)
+        report = last["hs"].last_build_report()
+        lanes = report.lane_report()
+        matrix = lanes.get("idle_while_busy", {})
+        read_idle_while_spill = \
+            matrix.get("read", {}).get("spill_route", None)
+    finally:
+        # Later sections (serving, sf10) must not pay the recorder.
+        _timeline.disable_timeline()
+        _timeline.reset()
+
+    return {"build_pipeline": {
+        "rows": n,
+        "cores": cores,
+        "serial_build_s": _stat(t_serial),
+        "pipelined_build_s": _stat(t_piped),
+        "pipeline_speedup_x": round(speedup, 3),
+        "speedup_gated": gated,
+        "bit_equal": True,
+        "read_idle_while_spill": read_idle_while_spill,
+        "busy_fractions": {lane: stats["busy_fraction"]
+                           for lane, stats in
+                           lanes.get("lanes", {}).items()},
+        "prefetch_stall_s": round(
+            report.phases.get("prefetch", 0.0), 4),
+        "finalize_tail_s": round(
+            report.phases.get("finalize", 0.0), 4),
+        "spill_route_s": round(report.phases.get("spill_route", 0.0), 4),
+        "spill_finish_s": round(
+            report.phases.get("spill_finish", 0.0), 4),
     }}
 
 

@@ -19,6 +19,7 @@ actions/CreateActionBase.scala:56-222 —
 from __future__ import annotations
 
 import os
+import threading
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -49,6 +50,157 @@ from hyperspace_tpu.utils.resolver import resolve_or_raise
 
 DATA_FILE_ID_COLUMN = "_data_file_id"  # IndexConstants.scala lineage column
 
+# Spill temp-dir prefixes (hash spill / zorder two-pass).  Dirs are
+# pid-stamped so a later build can prove an orphan's owner is dead before
+# reaping it — a SIGKILLed build runs no cleanup handler, and these dirs
+# hold a routed copy of the whole source.
+_SPILL_DIR_KINDS = ("hs_build_spill_", "hs_zbuild_")
+
+
+def _spill_dir_prefix(kind: str) -> str:
+    return f"{kind}{os.getpid()}_"
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except OSError:
+        pass  # EPERM etc.: the pid exists, someone else owns it
+    return True
+
+
+def reap_orphan_spill_dirs(tmp_root: Optional[str] = None) -> int:
+    """Best-effort removal of spill dirs leaked by DEAD processes
+    (SIGKILL or an injected crash mid-build), run at build start.  Only
+    pid-stamped dirs whose owning pid provably no longer exists are
+    touched; deletion goes through ``io/files.remove_tree`` so the
+    ``io.delete`` fault site applies.  Returns the number reaped."""
+    import tempfile
+
+    from hyperspace_tpu.io.files import remove_tree
+
+    root = tmp_root or tempfile.gettempdir()
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return 0
+    reaped = 0
+    for name in names:
+        kind = next((k for k in _SPILL_DIR_KINDS if name.startswith(k)),
+                    None)
+        if kind is None:
+            continue
+        pid_part = name[len(kind):].split("_", 1)[0]
+        if not pid_part.isdigit():
+            continue  # pre-pid-stamp dir: ownership unprovable, leave it
+        pid = int(pid_part)
+        if pid == os.getpid() or _pid_alive(pid):
+            continue
+        try:
+            remove_tree(os.path.join(root, name), ignore_errors=True)
+            reaped += 1
+        except OSError:
+            pass  # best-effort: a flaky mount must not fail THIS build
+    return reaped
+
+
+class _PrefetchReader:
+    """Bounded decode-ahead over a source file list.
+
+    ONE reader thread decodes file N+1 while the consumer routes file N
+    (double-buffered at ``depth=2``, the conf default); ``depth`` bounds
+    decoded-but-unconsumed chunks — the backpressure that keeps peak RSS
+    at ~depth device batches instead of the dataset.  ``depth=0`` reads
+    inline on the consumer thread: the forced-serial reference path
+    (``hyperspace.index.build.pipeline.enabled=false``) and the
+    no-thread degrade.  Deadline-aware (each handoff re-checks the
+    request deadline) and drain-aware: ``close()`` cancels queued decode
+    work and joins the reader, so a failed build never races its own
+    prefetcher — the action's cleanup ``finally`` covers it."""
+
+    def __init__(self, action: "CreateActionBase", files, columns,
+                 relation, lineage, depth: int, spill=None) -> None:
+        self.action = action
+        self.files = list(files)
+        self.columns = columns
+        self.relation = relation
+        self.lineage = lineage
+        self.depth = max(0, int(depth))
+        self.spill = spill
+        self.peak_chunks = 0  # max decoded-unconsumed chunks observed
+        self._stall_buffer_s = 0.0
+        self._pool = None
+        self._pending: List = []
+
+    def _record_stall(self, seconds: float) -> None:
+        """Attribute consumer stall (the ``prefetch`` phase/lane) — but
+        only once the build is known to SPILL.  On a monolithic build
+        the consumer has nothing to overlap, so its wait and the reader
+        thread's ``read`` cover the same wall time; counting both would
+        break the phase-sum-within-10%-of-wall audit.  Pre-spill stalls
+        buffer and flush with the first post-spill one."""
+        if self.spill is None or not self.spill.spilled:
+            self._stall_buffer_s += seconds
+            return
+        self.action._phase("prefetch_s", self._stall_buffer_s + seconds)
+        self._stall_buffer_s = 0.0
+
+    def __iter__(self):
+        import time as _time
+
+        from hyperspace_tpu.utils import deadline
+
+        if self.depth == 0:
+            for f in self.files:
+                deadline.check()
+                yield self.action._read_chunk(f, self.columns,
+                                              self.relation, self.lineage)
+            return
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._pool = ThreadPoolExecutor(max_workers=1,
+                                        thread_name_prefix="hs-prefetch")
+        queue = list(self.files)
+        try:
+            while queue and len(self._pending) < self.depth:
+                self._pending.append(self._pool.submit(
+                    self.action._read_chunk, queue.pop(0), self.columns,
+                    self.relation, self.lineage))
+            while self._pending:
+                deadline.check()
+                ready = sum(1 for f in self._pending if f.done())
+                if ready > self.peak_chunks:
+                    self.peak_chunks = ready
+                fut = self._pending.pop(0)
+                # Stall attribution: time the CONSUMER spends waiting on
+                # decode is the pipeline bubble prefetch exists to close
+                # (the ``prefetch`` phase/lane; near zero when it wins).
+                t0 = _time.perf_counter()
+                t = fut.result()
+                self._record_stall(_time.perf_counter() - t0)
+                if queue:
+                    self._pending.append(self._pool.submit(
+                        self.action._read_chunk, queue.pop(0),
+                        self.columns, self.relation, self.lineage))
+                yield t
+            # A build that spilled only late in the stream still owns
+            # its earlier (buffered) stalls.
+            if self.spill is not None and self.spill.spilled \
+                    and self._stall_buffer_s:
+                self._record_stall(0.0)
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        futures, self._pending = self._pending, []
+        for fut in futures:
+            fut.cancel()
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+
 
 class CreateActionBase(Action):
     """Shared by Create and the data-rebuilding Refresh actions."""
@@ -71,8 +223,6 @@ class CreateActionBase(Action):
         # are CPU-attributed time and can exceed wall-clock once routing
         # overlaps reads).
         self.build_phases: Dict[str, float] = {}
-        import threading
-
         self._phase_lock = threading.Lock()
 
     def _phase(self, name: str, seconds: float) -> None:
@@ -159,6 +309,9 @@ class CreateActionBase(Action):
         # first jax.devices() call initializes the backend — a one-off
         # cost that must not hide between phases).
         _t0 = _time.perf_counter()
+        # Spill dirs a SIGKILLed prior process leaked are reaped here —
+        # the one moment a build provably needs the temp space back.
+        reap_orphan_spill_dirs()
         # Digest-on-write follows THIS session's conf (the recorder is
         # process-global, like the fault injector).
         integrity.configure_from_conf(self.conf)
@@ -192,9 +345,15 @@ class CreateActionBase(Action):
             self._stream_build(files, columns, relation, lineage, resolved,
                                batch_rows, streaming, spill)
             self._publish_build_stats()
-        except BaseException:
+        finally:
+            # A FINALLY, not an except: it must join + shut down the
+            # route/finalize worker pools and remove the spill dir on
+            # every exit — InjectedCrash (a BaseException) included,
+            # since a leaked pool thread would outlive the simulated
+            # kill.  After a clean finish() this is a no-op.  Only a
+            # real SIGKILL escapes it, which is what the orphan reap
+            # above exists for.
             spill.cleanup()
-            raise
 
     def _read_chunk(self, f, columns, relation, lineage) -> pa.Table:
         """One source file's rows with schema-evolution normalization (a
@@ -228,25 +387,24 @@ class CreateActionBase(Action):
 
     def _stream_build(self, files, columns, relation, lineage, resolved,
                       batch_rows, streaming, spill) -> None:
-        # Source decode is prefetched one file ahead on a reader thread
-        # (decode overlaps the routing work); chunk ROUTING itself runs on
-        # the spill's worker pool when cores allow, so the stream loop is
-        # never serialized behind hash+write of the previous chunk.
-        from concurrent.futures import ThreadPoolExecutor
-
+        # The overlapped build pipeline: source decode is prefetched
+        # ahead on the reader thread (bounded by
+        # hyperspace.index.build.prefetchDepth — the backpressure that
+        # keeps peak RSS at ~depth device batches), chunk ROUTING runs
+        # on the spill's worker pool when cores allow, and closed bucket
+        # groups finalize on their own pool while the tail of the input
+        # still routes.  pipeline.enabled=false degrades to the
+        # bit-equal forced-serial loop: inline reads, inline routing,
+        # sequential finalize (layout NEVER depends on the flag — the
+        # pipeline changes scheduling only).
+        depth = max(1, int(self.conf.build_prefetch_depth)) \
+            if spill.pipelined else 0
+        reader = _PrefetchReader(self, files, columns, relation, lineage,
+                                 depth, spill=spill)
         buffer: List[pa.Table] = []
         buffered = 0
-        with ThreadPoolExecutor(max_workers=1) as reader:
-            pending = None
-            queue = list(files)
-            if queue:
-                pending = reader.submit(self._read_chunk, queue.pop(0),
-                                        columns, relation, lineage)
-            while pending is not None:
-                t = pending.result()
-                pending = reader.submit(
-                    self._read_chunk, queue.pop(0), columns, relation,
-                    lineage) if queue else None
+        try:
+            for t in reader:
                 buffer.append(t)
                 buffered += t.num_rows
                 while streaming and buffered > batch_rows:
@@ -256,6 +414,12 @@ class CreateActionBase(Action):
                     rest = combined.slice(batch_rows)
                     buffer = [rest] if rest.num_rows else []
                     buffered = rest.num_rows
+        finally:
+            reader.close()
+        if depth:
+            self.build_report.properties.update(
+                prefetch_depth=depth,
+                prefetch_peak_chunks=reader.peak_chunks)
         remainder = pa.concat_tables(buffer, promote_options="default") \
             if buffer else None
         if not spill.spilled:
@@ -373,7 +537,7 @@ class CreateActionBase(Action):
         taken_names = set(columns) | {DATA_FILE_ID_COLUMN}
         while z_col in taken_names:
             z_col += "_"
-        run_dir = tempfile.mkdtemp(prefix="hs_zbuild_")
+        run_dir = tempfile.mkdtemp(prefix=_spill_dir_prefix("hs_zbuild_"))
         schema = None
         try:
             offset = 0
@@ -595,6 +759,21 @@ class CreateActionBase(Action):
         )
 
 
+def _write_chunk_file(routed: pa.Table, path: str, slices) -> int:
+    """One (chunk, bucket group) spill file as raw Arrow IPC: one record
+    batch per ``(offset, rows)`` slice — bucket-aligned, so finalize
+    reads any bucket's run by batch index from a memory map without
+    touching the rest.  ``combine_chunks`` pins each slice to ONE chunk
+    = ONE batch, keeping batch index == slice position.  Returns the
+    bytes landed (the build report's spill accounting)."""
+    with pa.OSFile(path, "wb") as sink:
+        with pa.ipc.new_file(sink, routed.schema) as writer:
+            for off, rows in slices:
+                writer.write_table(
+                    routed.slice(off, rows).combine_chunks())
+    return os.path.getsize(path)
+
+
 def _write_run(table: pa.Table, path: str) -> int:
     """Temporary spill run file as RAW Arrow IPC: no parquet
     encode/decode for data that is read back exactly once and deleted —
@@ -628,44 +807,96 @@ def _footer_row_count(files, relation) -> Optional[int]:
 
 
 class _BucketSpill:
-    """External-build spill state: per-chunk bucket routing to run files,
-    then a per-bucket sort into the final layout.
+    """External-build spill state: per-chunk fused route+partition into
+    bucket-aligned Arrow runs, then streaming per-bucket-group finalize.
 
-    Phase 1 runs the SAME device hash kernel as the monolithic build on
-    fixed-capacity batches (one compiled program, every chunk), so bucket
-    assignment can never diverge between build sizes.  Phase 2 sorts each
-    bucket on host (run sizes are dynamic; per-bucket device compiles would
-    storm the cache) — runs are concatenated in chunk order, so the stable
-    sort reproduces the monolithic build's tie order exactly."""
+    Phase 1 (route) runs the SAME fused hash+lexsort program as the
+    monolithic build (ops/hash._route_sort_impl — one compiled program,
+    every chunk), so bucket assignment and tie order can never diverge
+    between build sizes.  The chunk's rows land GROUPED BY BUCKET — and,
+    for value-mapped key types, already SORTED within bucket, with the
+    monotone uint64 sort codes riding along as temp columns — in ONE
+    Arrow IPC file per (chunk, bucket group), one record batch per
+    non-empty bucket.  That file layout is the sf10 lever: the old
+    per-(chunk, bucket) run files meant chunks × buckets tiny-file
+    creates/opens/unlinks (11,400 at sf10), all syscall overhead.
 
-    # Route workers: chunk routing (hash + stable sort + run-file write)
-    # is independent per chunk once its number is assigned, so on
+    Phase 2 (finalize) closes bucket GROUPS the moment routing drains
+    and merges + parquet-encodes them on a dedicated worker pool,
+    CONCURRENT with the tail of routing and with each other.  Pre-sorted
+    runs make the merge a lexsort over the ride-along codes instead of
+    re-deriving order words for every row; batches read back zero-copy
+    from a memory map, and each group's chunk files are deleted the
+    moment the group is consumed, so peak disk stays source + runs + a
+    few in-flight groups (matters at SF100).  Runs concatenate in chunk
+    order, so the stable merge reproduces the monolithic tie order
+    exactly.
+
+    ``hyperspace.index.build.pipeline.enabled=false`` forces the serial
+    reference: inline reads, inline routing, sequential group finalize —
+    the same functions in the same order, so the flag changes SCHEDULING
+    only and the output stays bit-equal (tests/test_build_pipeline.py
+    holds it to that)."""
+
+    # Route workers: chunk routing (fused kernel + run write) is
+    # independent per chunk once its number is assigned, so on
     # multi-core hosts chunks route concurrently while the stream loop
     # keeps decoding.  Single-core hosts degrade to inline routing (a
     # pool of GIL-sharing workers would only add overhead there).
     _MAX_ROUTE_WORKERS = 4
     _MAX_IN_FLIGHT = 3  # each in-flight chunk pins one device batch in RAM
+    _MAX_GROUPS = 8     # bucket groups = spill-file + finalize granularity
 
     def __init__(self, action: "CreateActionBase", resolved: IndexConfig) -> None:
         self.action = action
         self.resolved = resolved
         self.spilled = False
+        self.pipelined = bool(getattr(action.conf,
+                                      "build_pipeline_enabled", True))
+        self._num_buckets = action.num_buckets
+        self._groups = min(self._MAX_GROUPS, self._num_buckets)
+        # Contiguous bucket ranges per group: group of bucket b is the
+        # gid with _bounds[gid] <= b < _bounds[gid + 1] — contiguous in
+        # the chunk's sorted order, so a group's rows are one slice.
+        self._bounds = [-(-gid * self._num_buckets // self._groups)
+                        for gid in range(self._groups + 1)]
         self._chunk_no = 0
         self._schema = None
+        self._code_cols: tuple = ()
         self._dir = None  # created on first spill; non-spilling builds
         # never touch disk
         self._pool = None
         self._futures: List = []
+        # Run manifest: bucket -> [(chunk_no, path, batch_index)], plus
+        # per-group chunk-file lists for consumed-group deletion.  Route
+        # workers append concurrently.
+        self._manifest_lock = threading.Lock()
+        self._runs: Dict[int, List] = {}
+        self._group_files: Dict[int, List[str]] = {}
+        # Streaming-finalize state: groups close when the LAST route job
+        # lands after end-of-input — possibly on a route worker thread,
+        # while finish() is still joining earlier futures.
+        self._close_lock = threading.Lock()
+        self._routes_pending = 0
+        self._input_done = False
+        self._closed = False
+        self._route_failed = False
+        self._finalize_pool = None
+        self._finalize_futures: List = []
+        self._out_dir: Optional[str] = None
 
     def _route_pool(self):
         import os as _os
 
+        if not self.pipelined:
+            return None  # forced-serial reference: inline routing
         if self._pool is None and (_os.cpu_count() or 1) > 1:
             from concurrent.futures import ThreadPoolExecutor
 
             self._pool = ThreadPoolExecutor(
                 max_workers=min(self._MAX_ROUTE_WORKERS,
-                                _os.cpu_count() or 1))
+                                _os.cpu_count() or 1),
+                thread_name_prefix="hs-route")
         return self._pool
 
     def _drain(self) -> None:
@@ -674,32 +905,69 @@ class _BucketSpill:
         for fut in futures:
             fut.result()
 
+    def _drain_finalize(self) -> None:
+        """Wait for in-flight group-finalize jobs; re-raise the first
+        failure."""
+        futures, self._finalize_futures = self._finalize_futures, []
+        for fut in futures:
+            fut.result()
+
     def cleanup(self) -> None:
         try:
             self._drain()
-        # cleanup() runs only on the failure path (the original error
-        # re-raises right after), so a secondary drain failure is
-        # deliberately discarded.
+        # cleanup() on the failure path re-raises the ORIGINAL error
+        # right after, so a secondary drain failure is discarded.
+        # hslint: allow[exception-discipline] secondary failure in cleanup
+        except BaseException:
+            pass
+        try:
+            self._drain_finalize()
         # hslint: allow[exception-discipline] secondary failure in cleanup
         except BaseException:
             pass
         if self._pool is not None:
             self._pool.shutdown(wait=True)
             self._pool = None
+        if self._finalize_pool is not None:
+            self._finalize_pool.shutdown(wait=True)
+            self._finalize_pool = None
         if self._dir is not None:
             from hyperspace_tpu.io.files import remove_tree
 
             remove_tree(self._dir, ignore_errors=True)
             self._dir = None
 
+    def _plan_code_columns(self, table: pa.Table) -> tuple:
+        """Ride-along sort-code column names (one uint64 per indexed
+        column), or () when any key type is rank-mapped (strings/binary/
+        decimal): chunk-local dense ranks are not comparable across
+        chunks, so those builds keep the route grouped-only and
+        re-derive order words per bucket at finalize, exactly like the
+        pre-pipeline builder."""
+        key_cols = list(self.resolved.indexed_columns)
+        for c in key_cols:
+            if not columnar.is_numeric_type(table.schema.field(c).type):
+                return ()
+        taken = set(table.column_names)
+        names = []
+        for i in range(len(key_cols)):
+            name = f"__hs_sort{i}"
+            while name in taken:
+                name += "_"
+            taken.add(name)
+            names.append(name)
+        return tuple(names)
+
     def add_chunk(self, table: pa.Table) -> None:
         if self._dir is None:
             import tempfile
 
-            self._dir = tempfile.mkdtemp(prefix="hs_build_spill_")
+            self._dir = tempfile.mkdtemp(
+                prefix=_spill_dir_prefix("hs_build_spill_"))
         self.spilled = True
         if self._schema is None:
             self._schema = table.schema
+            self._code_cols = self._plan_code_columns(table)
         chunk_no = self._chunk_no
         self._chunk_no += 1
         pool = self._route_pool()
@@ -708,107 +976,247 @@ class _BucketSpill:
             return
         while len(self._futures) >= self._MAX_IN_FLIGHT:
             self._futures.pop(0).result()
+        with self._close_lock:
+            self._routes_pending += 1
         self._futures.append(
-            pool.submit(self._route_chunk, table, chunk_no))
+            pool.submit(self._route_traced, table, chunk_no))
+
+    def _route_traced(self, table: pa.Table, chunk_no: int) -> None:
+        """Route one chunk on a worker thread and fire the streaming
+        close when this was the LAST route job after end-of-input —
+        finalize then starts while finish() is still joining futures."""
+        ok = False
+        try:
+            self._route_chunk(table, chunk_no)
+            ok = True
+        finally:
+            fire = False
+            with self._close_lock:
+                self._routes_pending -= 1
+                if not ok:
+                    self._route_failed = True
+                elif self._input_done and self._routes_pending == 0 \
+                        and not self._closed and not self._route_failed:
+                    self._closed = True
+                    fire = True
+            if fire:
+                self._close_groups()
 
     def _route_chunk(self, table: pa.Table, chunk_no: int) -> None:
         import time as _time
 
-        from hyperspace_tpu.ops.hash import bucket_ids, bucket_ids_np
-        from hyperspace_tpu.ops.sort import _pad_rows
+        from hyperspace_tpu.ops.hash import (
+            route_partition,
+            route_partition_np,
+        )
 
         _t0 = _time.perf_counter()
         n = table.num_rows
         # Z-order builds never spill here (they take the dedicated
         # two-pass path that preserves the global curve), so partitions
         # are always real index buckets.
-        num_buckets = self.action.num_buckets
+        num_buckets = self._num_buckets
+        key_cols = list(self.resolved.indexed_columns)
+        word_cols = [np.asarray(columnar.to_hash_words(table.column(c)))
+                     for c in key_cols]
+        # Value-mapped keys: monotone sort codes come along, so the ONE
+        # fused pass both buckets the rows and sorts them within bucket
+        # — and the writer's sort codes are THIS pass's byproduct riding
+        # the runs as temp uint64 columns, not a finalize-time recompute
+        # over every row.  The host mirror keys on the uint64 codes
+        # directly; only the device kernel needs the 32-bit word split.
+        codes64 = [columnar.to_order_codes64(table.column(c))
+                   for c in key_cols] if self._code_cols else []
         if n < self.action.conf.device_min_rows("build"):
-            # Same routing as the monolithic build: the per-chunk device
-            # round trip (transfer + possible compile, per chunk!) over a
-            # remote tunnel dwarfs a host hash pass; bucket_ids_np is the
-            # bit-identical mirror, so layout cannot depend on the route.
-            word_cols = [np.asarray(columnar.to_hash_words(table.column(c)))
-                         for c in self.resolved.indexed_columns]
-            buckets = bucket_ids_np(word_cols, num_buckets)
+            # Host mirror below the threshold, same cost model as the
+            # monolithic build: a per-chunk device round trip (transfer
+            # + possible compile, per chunk!) over a remote tunnel
+            # dwarfs a host pass — and the mirror is bit-identical, so
+            # layout cannot depend on the route.
+            buckets, perm = route_partition_np(word_cols, codes64,
+                                               num_buckets)
         else:
-            capacity = max(1, int(self.action.conf.device_batch_rows))
-            capacity = -(-max(n, 1) // capacity) * capacity
-            word_cols = [
-                _pad_rows(np.asarray(columnar.to_hash_words(table.column(c))),
-                          capacity)
-                for c in self.resolved.indexed_columns
-            ]
-            buckets = np.asarray(bucket_ids(word_cols, num_buckets))[:n]
-        order = np.argsort(buckets, kind="stable")
-        sorted_buckets = buckets[order]
-        routed = table.take(pa.array(order))
-        starts = np.searchsorted(sorted_buckets, np.arange(num_buckets), "left")
-        ends = np.searchsorted(sorted_buckets, np.arange(num_buckets), "right")
-        for b in range(num_buckets):
-            rows = int(ends[b] - starts[b])
-            if rows == 0:
-                continue
-            bdir = os.path.join(self._dir, f"bucket={b:05d}")
-            os.makedirs(bdir, exist_ok=True)
-            # Run files are TEMPORARY (read back once, deleted): raw Arrow
-            # IPC skips the parquet encode/decode entirely — on the
-            # single-core bench host this was most of the spill cost.
-            self.action.build_report.add_bytes(spill=_write_run(
-                routed.slice(int(starts[b]), rows),
-                os.path.join(bdir, f"run-{chunk_no:05d}.arrow")),
-                spill_runs=1)
+            buckets, perm = route_partition(
+                word_cols,
+                [columnar.split_words64(k) for k in codes64],
+                num_buckets,
+                pad_to=max(1, int(self.action.conf.device_batch_rows)))
+        buckets = np.asarray(buckets)
+        perm = np.asarray(perm)
+        sorted_buckets = buckets[perm]
+        routed = table.take(pa.array(perm))
+        for i, name in enumerate(self._code_cols):
+            routed = routed.append_column(name,
+                                          pa.array(codes64[i][perm]))
+        starts = np.searchsorted(sorted_buckets, np.arange(num_buckets),
+                                 "left")
+        ends = np.searchsorted(sorted_buckets, np.arange(num_buckets),
+                               "right")
+        self._write_chunk_runs(routed, chunk_no, starts, ends)
         self.action._phase("spill_route_s", _time.perf_counter() - _t0)
+
+    def _write_chunk_runs(self, routed: pa.Table, chunk_no: int,
+                          starts, ends) -> None:
+        """One Arrow IPC file per (chunk, bucket group), one record
+        batch per non-empty bucket: per-bucket random access at finalize
+        with _groups file ops per chunk instead of num_buckets.  Run
+        files are TEMPORARY (read back once, deleted): raw IPC skips the
+        parquet encode/decode entirely, and batches read back zero-copy
+        from a memory map."""
+        for gid in range(self._groups):
+            b0, b1 = self._bounds[gid], self._bounds[gid + 1]
+            present = [b for b in range(b0, b1) if ends[b] > starts[b]]
+            if not present:
+                continue
+            path = os.path.join(
+                self._dir, f"chunk-{chunk_no:05d}-g{gid:03d}.arrow")
+            nbytes = _write_chunk_file(
+                routed, path,
+                [(int(starts[b]), int(ends[b] - starts[b]))
+                 for b in present])
+            with self._manifest_lock:
+                for bi, b in enumerate(present):
+                    self._runs.setdefault(b, []).append(
+                        (chunk_no, path, bi))
+                self._group_files.setdefault(gid, []).append(path)
+            self.action.build_report.add_bytes(
+                spill=nbytes, spill_runs=len(present))
+
+    def _finalize_pool_get(self):
+        import os as _os
+
+        if self._finalize_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            # Capped at the core count: group finalize is CPU-bound
+            # (merge + parquet encode), so extra threads on a small host
+            # only buy GIL/scheduler contention — measured ~25% slower
+            # with 4 workers on 1 core.  One worker still STREAMS
+            # (groups start the moment routing drains).
+            workers = max(1, min(
+                int(getattr(self.action.conf, "build_finalize_workers",
+                            4)),
+                _os.cpu_count() or 1))
+            self._finalize_pool = ThreadPoolExecutor(
+                max_workers=workers, thread_name_prefix="hs-finalize")
+        return self._finalize_pool
+
+    def _close_groups(self) -> None:
+        """Every routed bucket group is now closed: enqueue each on the
+        finalize pool (streaming mode) or finish them in order (serial
+        reference).  May run on a route worker thread — the finalize
+        pool starts draining groups while finish() is still joining the
+        earlier route futures."""
+        with self._manifest_lock:
+            gids = sorted(self._group_files)
+        if self.pipelined:
+            pool = self._finalize_pool_get()
+            self._finalize_futures.extend(
+                pool.submit(self._finish_group, gid) for gid in gids)
+        else:
+            for gid in gids:
+                self._finish_group(gid)
+
+    def _finish_group(self, gid: int) -> None:
+        """Merge + parquet-encode every bucket of one closed group, then
+        delete the group's chunk files — consumed spill space is
+        returned while OTHER groups still hold theirs, so peak disk is
+        source + runs + in-flight groups, not source + runs + the whole
+        final index."""
+        import time as _time
+
+        from hyperspace_tpu.io.files import remove_file
+        from hyperspace_tpu.io.parquet import (
+            sort_permutation_from_codes,
+            write_bucket_run,
+        )
+
+        _t0 = _time.perf_counter()
+        action = self.action
+        max_rows = action.conf.index_max_rows_per_file
+        b0, b1 = self._bounds[gid], self._bounds[gid + 1]
+        with self._manifest_lock:
+            paths = list(self._group_files.get(gid, ()))
+            buckets = sorted(b for b in self._runs if b0 <= b < b1)
+        readers = {}
+        handles = []
+        try:
+            for p in paths:
+                mm = pa.memory_map(p, "rb")
+                handles.append(mm)
+                readers[p] = pa.ipc.open_file(mm)
+            for b in buckets:
+                with self._manifest_lock:
+                    runs = sorted(self._runs[b])  # chunk order = ties
+                batches = [readers[p].get_batch(bi) for _, p, bi in runs]
+                btable = pa.Table.from_batches(batches)
+                if self._code_cols:
+                    perm = sort_permutation_from_codes(btable,
+                                                       self._code_cols)
+                    btable = btable.take(pa.array(perm)).drop_columns(
+                        list(self._code_cols))
+                else:
+                    perm = self._sort_permutation(btable)
+                    btable = btable.take(pa.array(perm))
+                written = write_bucket_run(
+                    btable, b, self._out_dir, max_rows,
+                    compression=action.conf.index_file_compression)
+                action.build_report.add_bytes(
+                    written=sum(os.path.getsize(p) for p in written),
+                    files=len(written))
+        finally:
+            for mm in handles:
+                try:
+                    mm.close()
+                except OSError:
+                    pass
+        for p in paths:
+            remove_file(p, missing_ok=True)
+        action._phase("spill_finish_s", _time.perf_counter() - _t0)
 
     def finish(self) -> None:
         import time as _time
 
         from hyperspace_tpu.io.files import remove_tree
 
-        _t0 = _time.perf_counter()
-        self._drain()  # all route jobs must land before buckets close
-        if self._pool is not None:
-            self._pool.shutdown(wait=True)
-            self._pool = None
         action = self.action
         resolved = self.resolved
+        # The version dir must exist BEFORE end-of-input is announced:
+        # the first finalize worker may start while route futures are
+        # still draining.
         version = action.data_manager.get_next_version()
         out_dir = action.data_manager.version_path(version)
         os.makedirs(out_dir, exist_ok=True)
-        max_rows = action.conf.index_max_rows_per_file
-
-        def finish_bucket(bname: str) -> None:
-            from hyperspace_tpu.io.parquet import write_bucket_run
-
-            bdir = os.path.join(self._dir, bname)
-            bucket = int(bname.split("=")[1])
-            runs = sorted(os.listdir(bdir))  # chunk order = stable ties
-            btable = pa.concat_tables(
-                [_read_run(os.path.join(bdir, r)) for r in runs],
-                promote_options="default")
-            perm = self._sort_permutation(btable)
-            btable = btable.take(pa.array(perm))
-            written = write_bucket_run(
-                btable, bucket, out_dir, max_rows,
-                compression=action.conf.index_file_compression)
-            action.build_report.add_bytes(
-                written=sum(os.path.getsize(p) for p in written),
-                files=len(written))
-            # This bucket's runs are consumed: delete them NOW so peak
-            # disk is source + runs + a few finished buckets, not
-            # source + runs + the whole final index (matters at SF100).
-            remove_tree(bdir, ignore_errors=True)
-
-        from hyperspace_tpu.utils.parallel_map import parallel_map_ordered
-
+        self._out_dir = out_dir
+        fire = False
+        with self._close_lock:
+            self._input_done = True
+            if self._routes_pending == 0 and not self._closed \
+                    and not self._route_failed:
+                self._closed = True
+                fire = True
+        if fire:
+            self._close_groups()
+        self._drain()  # re-raise the first route failure
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+        # The EXPOSED finalize tail: how long the build still waits on
+        # bucket-group encode after routing fully drained — the number
+        # the streaming overlap is spent against (``finalize`` phase and
+        # timeline lane; the per-group work itself lands in
+        # ``spill_finish`` on the pool workers).
+        _t0 = _time.perf_counter()
         try:
-            # Low cap: each in-flight bucket holds its full table in memory.
-            parallel_map_ordered(finish_bucket, sorted(os.listdir(self._dir)),
-                                 max_workers=4)
+            self._drain_finalize()
         finally:
-            remove_tree(self._dir, ignore_errors=True)
-            self._dir = None
-        action._phase("spill_finish_s", _time.perf_counter() - _t0)
+            if self.pipelined:
+                action._phase("finalize_s", _time.perf_counter() - _t0)
+        if self._finalize_pool is not None:
+            self._finalize_pool.shutdown(wait=True)
+            self._finalize_pool = None
+        remove_tree(self._dir, ignore_errors=True)
+        self._dir = None
         _t0 = _time.perf_counter()
         action._write_index_file_sketch(out_dir, resolved)
         action._phase("sketch_s", _time.perf_counter() - _t0)

@@ -22,10 +22,13 @@ from __future__ import annotations
 import dataclasses
 from typing import List, Optional
 
-import numpy as np
 import pyarrow as pa
 
-from hyperspace_tpu.actions.create import DATA_FILE_ID_COLUMN, CreateActionBase
+from hyperspace_tpu.actions.create import (
+    DATA_FILE_ID_COLUMN,
+    CreateActionBase,
+    _PrefetchReader,
+)
 from hyperspace_tpu.exceptions import HyperspaceError, NoChangesError
 from hyperspace_tpu.index.data_manager import IndexDataManager
 from hyperspace_tpu.index.index_config import IndexConfig
@@ -222,16 +225,20 @@ class RefreshIncrementalAction(RefreshActionBase):
                                       value_set=deleted_ids))
             parts.append(old.filter(keep))
         if appended:
+            # Appended-file decode rides the same bounded prefetch as
+            # the create pipeline (decode of file N+1 overlaps the
+            # concat/normalize of file N; depth bounds peak RSS), and
+            # _read_chunk also applies the schema-evolution null fill
+            # and lineage stamping the full build gets.
             relation = self._relation()
-            for f in appended:
-                t = read_table([f.name], relation.read_format,
-                               resolved.all_columns, relation.options,
-                               partition_roots=relation.root_paths)
-                if self.lineage_enabled:
-                    t = t.append_column(
-                        DATA_FILE_ID_COLUMN,
-                        pa.array(np.full(t.num_rows, f.id, dtype=np.int64)))
-                parts.append(t)
+            depth = max(1, int(self.conf.build_prefetch_depth)) \
+                if getattr(self.conf, "build_pipeline_enabled", True) else 0
+            reader = _PrefetchReader(self, appended, resolved.all_columns,
+                                     relation, self.lineage_enabled, depth)
+            try:
+                parts.extend(reader)
+            finally:
+                reader.close()
         if not parts:
             raise NoChangesError("Nothing to refresh")
         combined = pa.concat_tables(parts, promote_options="default")

@@ -48,6 +48,9 @@ DEVICE_CACHE_BYTES = "hyperspace.tpu.deviceCacheBytes"
 DEVICE_CACHE_POLICY = "hyperspace.tpu.deviceCachePolicy"
 PARALLEL_BUILD = "hyperspace.tpu.parallelBuild"
 SHUFFLE_CAPACITY_SLACK = "hyperspace.tpu.shuffleCapacitySlack"
+BUILD_PIPELINE_ENABLED = "hyperspace.index.build.pipeline.enabled"
+BUILD_PREFETCH_DEPTH = "hyperspace.index.build.prefetchDepth"
+BUILD_FINALIZE_WORKERS = "hyperspace.index.build.finalizeWorkers"
 GLOBBING_PATTERN = "hyperspace.source.globbingPattern"
 DISPLAY_MODE = "hyperspace.explain.displayMode"
 HIGHLIGHT_BEGIN_TAG = "hyperspace.explain.displayMode.highlight.beginTag"
@@ -245,6 +248,24 @@ class HyperspaceConf:
     # the perfectly-balanced per-destination row count (doubled on overflow).
     parallel_build: str = "auto"
     shuffle_capacity_slack: float = 1.5
+    # Overlapped build pipeline (actions/create.py; docs/13, docs/16):
+    #   - pipeline.enabled: the external (spill) build runs as overlapped
+    #     stages — async prefetch of source decode, concurrent chunk
+    #     routing, and streaming per-bucket-group finalize — instead of
+    #     the forced-serial read → route → finalize loop.  Off is the
+    #     bit-equal serial reference (layout NEVER depends on this flag;
+    #     tests/test_build_pipeline.py proves it) and the sane setting
+    #     for debugging or strictly single-threaded environments.
+    #   - prefetchDepth: decoded-but-unconsumed source chunks the
+    #     prefetcher may hold (its ONE reader thread decodes file N+1
+    #     while file N routes; the bound is the backpressure that keeps
+    #     peak RSS at ~depth device batches, not the dataset).
+    #   - finalizeWorkers: worker threads merging + parquet-encoding
+    #     closed bucket groups, concurrent with routing of remaining
+    #     input.  Each in-flight group pins one bucket's rows in memory.
+    build_pipeline_enabled: bool = True
+    build_prefetch_depth: int = 2
+    build_finalize_workers: int = 4
     # Comma-separated glob pattern(s); when set, createIndex records the
     # pattern as the indexed root paths so later-appearing directories that
     # match are picked up by refresh (IndexConstants.scala:108-114).
@@ -485,6 +506,9 @@ class HyperspaceConf:
         DEVICE_CACHE_POLICY: "device_cache_policy",
         PARALLEL_BUILD: "parallel_build",
         SHUFFLE_CAPACITY_SLACK: "shuffle_capacity_slack",
+        BUILD_PIPELINE_ENABLED: "build_pipeline_enabled",
+        BUILD_PREFETCH_DEPTH: "build_prefetch_depth",
+        BUILD_FINALIZE_WORKERS: "build_finalize_workers",
         DISPLAY_MODE: "display_mode",
         HIGHLIGHT_BEGIN_TAG: "highlight_begin_tag",
         HIGHLIGHT_END_TAG: "highlight_end_tag",

@@ -160,6 +160,15 @@ def to_order_words(column: "pa.ChunkedArray | pa.Array") -> np.ndarray:
     return split_words64(_monotone_uint64(to_order_key(column)))
 
 
+def to_order_codes64(column: "pa.ChunkedArray | pa.Array") -> np.ndarray:
+    """(n,) uint64 monotone codes — ``to_order_words`` without the
+    split into 32-bit words.  The HOST-side sort-key form (numpy is
+    64-bit native; the word split serves the TPU lanes): the external
+    build's route pass sorts on these and rides them along the spill
+    runs as the writer's sort codes."""
+    return _monotone_uint64(to_order_key(column))
+
+
 def to_device_numeric(column: "pa.ChunkedArray | pa.Array") -> Optional[np.ndarray]:
     """Numeric host array suitable for jnp.asarray, or None if non-numeric
     OR nullable — SQL null semantics (null != null, three-valued predicates)

@@ -394,8 +394,30 @@ def sort_permutation_host(table: pa.Table, indexed_columns, layout: str):
     keys: List[np.ndarray] = []
     for c in reversed(list(indexed_columns)):
         w = np.asarray(columnar.to_order_words(table.column(c)))
-        keys.append(w[:, 1])
-        keys.append(w[:, 0])
+        # One uint64 key per column: the same total order as the (hi,
+        # lo) uint32 pair in half the stable-sort passes (the 32-bit
+        # split serves the TPU lanes, not numpy).
+        keys.append(columnar.join_words64(w[:, 0], w[:, 1]))
+    return np.lexsort(tuple(keys))
+
+
+def sort_permutation_from_codes(btable: pa.Table, code_columns) -> np.ndarray:
+    """Within-bucket sort permutation from PRECOMPUTED ride-along sort
+    codes — one monotone uint64 column per indexed column, attached by
+    the external build's route pass (actions/create._BucketSpill), in
+    indexed-column order.  The stable lexsort over them reproduces
+    ``sort_permutation_host`` bit-exactly for value-mapped key types
+    (numeric/temporal/bool: their order words are chunk-independent)
+    without re-deriving order words from the raw values — the codes were
+    already computed once for the fused route+partition kernel.  Code
+    columns are zero-copy uint64, so this is the cheap half of the old
+    sort."""
+    keys: List[np.ndarray] = []
+    # np.lexsort: LAST key is primary — append in reversed column order
+    # so the first indexed column sorts first (sort_permutation_host's
+    # key order exactly).
+    for name in reversed(list(code_columns)):
+        keys.append(btable.column(name).to_numpy(zero_copy_only=False))
     return np.lexsort(tuple(keys))
 
 

@@ -126,3 +126,125 @@ def bucket_ids(word_cols: Sequence[jnp.ndarray], num_buckets: int) -> jnp.ndarra
     out = _bucket_ids_impl(tuple(word_cols), num_buckets, use_pallas())
     timeline.kernel_end("bucket_ids", t0, out)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Fused route+partition kernel (the external build's per-chunk pass)
+# ---------------------------------------------------------------------------
+@partial(jax.jit, static_argnames=("num_buckets", "pallas"))
+def _route_sort_impl(
+    word_cols,
+    order_words,
+    n_valid,
+    num_buckets: int,
+    pallas: bool,
+) -> jnp.ndarray:  # (2, n) stacked [buckets, perm] — one host transfer
+    """Hash → (bucket, *keys) stable lexsort → stacked (buckets, perm).
+
+    THE bucket/sort program: ``ops.sort.bucket_sort_permutation`` (the
+    monolithic build) and :func:`route_partition` (the external build's
+    per-chunk pass) both trace exactly this function, so the two paths
+    share one compiled program per capacity and can never diverge in
+    bucket assignment or tie order.  ``order_words`` may be EMPTY: the
+    lexsort then groups rows by bucket only, original order preserved
+    within each bucket (the partition-only mode for rank-mapped key
+    types whose chunk-local order words are not globally comparable).
+    """
+    buckets = _bucket_ids_impl(word_cols, num_buckets, pallas)
+    # Capacity padding: rows at positions >= n_valid get bucket id
+    # ``num_buckets`` — past every real bucket, so the stable lexsort
+    # parks them after all real rows and ``perm[:n]`` is real.
+    n = word_cols[0].shape[0]
+    buckets = jnp.where(jnp.arange(n) < n_valid, buckets,
+                        jnp.int32(num_buckets))
+    # jnp.lexsort: LAST key is the primary.  Order: bucket first, then
+    # key columns in config order, each (hi, lo) word pair hi-major.
+    keys = []
+    for w in reversed(order_words):
+        keys.append(w[:, 1])
+        keys.append(w[:, 0])
+    keys.append(buckets)
+    perm = jnp.lexsort(tuple(keys)).astype(jnp.int32)
+    return jnp.stack([buckets, perm])
+
+
+def _pad_host_rows(arr: np.ndarray, capacity: int) -> np.ndarray:
+    arr = np.asarray(arr)
+    if arr.shape[0] == capacity:
+        return arr
+    pad = np.zeros((capacity - arr.shape[0],) + arr.shape[1:],
+                   dtype=arr.dtype)
+    return np.concatenate([arr, pad], axis=0)
+
+
+def route_partition(
+    word_cols: Sequence[np.ndarray],
+    order_words: Sequence[np.ndarray],
+    num_buckets: int,
+    pad_to: int = 0,
+):
+    """Fused route+partition device pass for one spill chunk.
+
+    One kernel computes bucket ids AND the permutation that groups the
+    chunk's rows into per-bucket runs (sorted within bucket when
+    ``order_words`` is non-empty; original order otherwise) — replacing
+    the old two-step of a device ``bucket_ids`` pull followed by a host
+    argsort.  Returns ``(bucket_ids, perm)`` as host int32 arrays,
+    pulled in ONE stacked device→host transfer through the attributed
+    ``sync_guard.pull`` seam.
+
+    ``pad_to`` follows ``bucket_sort_permutation``'s capacity-padding
+    contract (one compiled program per capacity/key-count).
+    """
+    from hyperspace_tpu.execution import sync_guard
+    from hyperspace_tpu.telemetry import timeline
+    from hyperspace_tpu.utils.xla_cache import ensure_persistent_xla_cache
+
+    ensure_persistent_xla_cache()
+    n = int(word_cols[0].shape[0])
+    if pad_to and pad_to > 0:
+        capacity = -(-max(n, 1) // pad_to) * pad_to
+        word_cols = [_pad_host_rows(w, capacity) for w in word_cols]
+        order_words = [_pad_host_rows(w, capacity) for w in order_words]
+    t0 = timeline.kernel_begin()
+    if t0 is not None:
+        timeline.record_transfer("h2d", sum(
+            int(getattr(a, "nbytes", 0))
+            for a in (*word_cols, *order_words)
+            if not isinstance(a, jax.Array)))
+    out = _route_sort_impl(
+        tuple(word_cols), tuple(order_words), n, num_buckets, use_pallas())
+    timeline.kernel_end("route_partition", t0, out)
+    stacked = sync_guard.pull(out, "route.partition")
+    return stacked[0, :n], stacked[1, :n]
+
+
+def route_partition_np(
+    word_cols: Sequence[np.ndarray],
+    order_words: Sequence[np.ndarray],
+    num_buckets: int,
+):
+    """Bit-identical HOST mirror of :func:`route_partition` (the same
+    cost model as ``bucket_sort_permutation_np``: below the calibrated
+    build threshold a per-chunk device round trip costs pure latency).
+    Shares ``bucket_ids_np`` and the identical stable-lexsort ordering,
+    so chunk layout can never depend on where it was computed.
+
+    The host lexsort keys on ONE uint64 per column — the same total
+    order as the (hi, lo) uint32 pair in half the stable-sort passes
+    (numpy is 64-bit native; the 32-bit split exists for the TPU's VPU
+    lanes).  ``order_words`` items may be either (n, 2) uint32 word
+    pairs or (n,) uint64 codes (``columnar.to_order_codes64``) —
+    callers that already hold the joined form skip the round trip."""
+    with np.errstate(over="ignore"):
+        buckets = bucket_ids_np([np.asarray(w) for w in word_cols],
+                                num_buckets)
+    keys = []
+    for w in reversed(list(order_words)):
+        w = np.asarray(w)
+        keys.append(w if w.ndim == 1
+                    else (w[:, 0].astype(np.uint64) << np.uint64(32))
+                    | w[:, 1].astype(np.uint64))
+    keys.append(buckets)
+    perm = np.lexsort(tuple(keys)).astype(np.int32)
+    return buckets.astype(np.int32), perm

@@ -22,42 +22,20 @@ from typing import Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-from hyperspace_tpu.ops.hash import _bucket_ids_impl, use_pallas
+from hyperspace_tpu.ops.hash import _route_sort_impl, use_pallas
 
-
-@partial(jax.jit, static_argnames=("num_buckets", "pallas"))
-def _bucket_sort_impl(
-    word_cols,
-    order_words,
-    n_valid,
-    num_buckets: int,
-    pallas: bool,
-) -> jnp.ndarray:  # (2, n) stacked [buckets, perm] — one host transfer
-    # One bucket-assignment implementation for build and query paths —
-    # duplicating it risks the two silently diverging, which corrupts the
-    # durable on-disk bucket layout.
-    buckets = _bucket_ids_impl(word_cols, num_buckets, pallas)
-    # Capacity padding: rows at positions >= n_valid get bucket id
-    # ``num_buckets`` — past every real bucket, so the stable lexsort parks
-    # them after all real rows and ``perm[:n]`` is the real permutation.
-    # ``n_valid`` is a TRACED scalar: row count changes don't retrace.
-    n = word_cols[0].shape[0]
-    buckets = jnp.where(jnp.arange(n) < n_valid, buckets,
-                        jnp.int32(num_buckets))
-    # jnp.lexsort: LAST key is the primary.  Order: bucket first, then key
-    # columns in config order, each (hi, lo) word pair hi-major.  A Z-order
-    # build passes ONE precomputed Morton-word column here (the host ranks
-    # in io/parquet.zorder_codes_host define the layout AND the file-split
-    # keys, so the device never re-ranks).
-    keys = []
-    for w in reversed(order_words):
-        keys.append(w[:, 1])
-        keys.append(w[:, 0])
-    keys.append(buckets)
-    perm = jnp.lexsort(tuple(keys)).astype(jnp.int32)
-    # One stacked output = ONE device->host transfer for both arrays (the
-    # pull dominates build latency on a remote-tunnel chip).
-    return jnp.stack([buckets, perm])
+# One bucket-assignment-and-sort implementation for the monolithic build,
+# the external build's per-chunk route pass, and the query paths —
+# duplicating it risks the programs silently diverging, which corrupts
+# the durable on-disk bucket layout.  The shared impl lives with the
+# hash kernel (ops/hash._route_sort_impl); ``n_valid`` is a TRACED
+# scalar there, so row-count changes never retrace, and a Z-order build
+# passes ONE precomputed Morton-word column (the host ranks in
+# io/parquet.zorder_codes_host define the layout AND the file-split
+# keys, so the device never re-ranks).  One stacked (2, n) output = ONE
+# device->host transfer for both arrays (the pull dominates build
+# latency on a remote-tunnel chip).
+_bucket_sort_impl = _route_sort_impl
 
 
 def _pad_rows(arr, capacity: int):
@@ -138,20 +116,12 @@ def bucket_sort_permutation_np(
     because bucket assignment shares ``bucket_ids_np`` (parity-tested
     against the device kernel) and both sorts are stable lexsorts over the
     SAME (bucket, order-word) key sequence — padding in the device path
-    parks only pad rows at the tail, never reordering real ties."""
-    import numpy as np
+    parks only pad rows at the tail, never reordering real ties.  The
+    host mirror IS the external build's route mirror
+    (``ops.hash.route_partition_np``): one implementation, one ordering."""
+    from hyperspace_tpu.ops.hash import route_partition_np
 
-    from hyperspace_tpu.ops.hash import bucket_ids_np
-
-    buckets = bucket_ids_np([np.asarray(w) for w in word_cols], num_buckets)
-    keys = []
-    for w in reversed(order_words):
-        w = np.asarray(w)
-        keys.append(w[:, 1])
-        keys.append(w[:, 0])
-    keys.append(buckets)
-    perm = np.lexsort(tuple(keys)).astype(np.int32)
-    return buckets.astype(np.int32), perm
+    return route_partition_np(word_cols, order_words, num_buckets)
 
 
 @partial(jax.jit, static_argnames=("num_buckets",))

@@ -10,10 +10,14 @@ action run through ``actions/base.Action.run()`` owns one
 
   - **phases**: wall seconds per named phase (``read`` → ``spill_route``
     → ``kernel`` → ``spill_finish`` → ``write`` → ``sketch``, plus the
-    protocol's ``validate``/``commit``), accumulated across conflict
-    retries and across the spill pool's worker threads (the report is
-    lock-protected and owned by the ACTION, not a contextvar — worker
-    threads do not inherit context).  Phases are classified device vs
+    protocol's ``validate``/``commit`` and the pipelined builder's two
+    STALL phases ``prefetch``/``finalize`` — consumer time blocked on
+    decode, and the exposed finalize tail after routing drains),
+    accumulated across conflict retries and across the prefetch/route/
+    finalize pools' worker threads (the report is lock-protected and
+    owned by the ACTION, not a contextvar — worker threads do not
+    inherit context; overlapped phases are CPU-attributed seconds and
+    may sum past wall clock on a pipelined spill build).  Phases are classified device vs
     host (``kernel`` is device compute; everything else is host/IO) so
     ``device_s``/``host_s`` fall out.
   - **bytes**: decoded source bytes in (``bytes_read``), index data

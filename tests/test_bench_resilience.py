@@ -23,7 +23,8 @@ SECTIONS = ("setup", "sf1_queries", "device_agg_probe", "resident_agg",
             "warm_resident_join", "warm_q3", "warm_q10", "window_bench",
             "kernel_bench", "calibration", "telemetry_overhead",
             "advisor", "integrity", "build_profile", "timeline",
-            "serving", "flight_recorder", "ingest", "sf10", "sf100")
+            "build_pipeline", "serving", "flight_recorder", "ingest",
+            "sf10", "sf100")
 
 
 def _env(tmp_path, budget: str) -> dict:

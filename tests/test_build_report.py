@@ -97,21 +97,22 @@ class TestBuildReport:
         # build (which never spills); pin the single-chip streaming path.
         session.conf.parallel_build = "off"
         seen: list = []
-        real = create_mod._write_run
+        real = create_mod._write_chunk_file
 
-        def teeing_write_run(table, path):
-            n = real(table, path)
-            seen.append(n)
+        def teeing_write_chunk(table, path, slices):
+            n = real(table, path, slices)
+            seen.append((n, len(slices)))
             return n
 
-        monkeypatch.setattr(create_mod, "_write_run", teeing_write_run)
+        monkeypatch.setattr(create_mod, "_write_chunk_file",
+                            teeing_write_chunk)
         hs = Hyperspace(session)
         hs.create_index(session.read.parquet(src),
                         IndexConfig("si", ["k"], ["v"]))
         report = hs.last_build_report()
         assert seen, "the small batch size should have forced a spill"
-        assert report.spill_bytes == sum(seen)
-        assert report.spill_runs == len(seen)
+        assert report.spill_bytes == sum(n for n, _ in seen)
+        assert report.spill_runs == sum(r for _, r in seen)
         assert report.phases.get("spill_route", 0) > 0
         assert report.phases.get("spill_finish", 0) > 0
 
