@@ -1047,8 +1047,8 @@ SECTION_NAMES = ("setup", "sf1_queries", "device_agg_probe",
                  "warm_q10", "window_bench", "kernel_bench",
                  "calibration", "telemetry_overhead", "advisor",
                  "integrity", "build_profile", "timeline",
-                 "build_pipeline", "serving", "flight_recorder",
-                 "ingest", "sf10", "sf100")
+                 "build_pipeline", "multichip", "serving",
+                 "flight_recorder", "ingest", "sf10", "sf100")
 
 
 def main() -> int:
@@ -1101,6 +1101,7 @@ def main() -> int:
             harness.section("timeline", lambda: _sec_timeline(root))
             harness.section("build_pipeline",
                             lambda: _sec_build_pipeline(root))
+            harness.section("multichip", lambda: _sec_multichip(root))
             harness.section("serving", lambda: _sec_serving(ctx))
             harness.section("flight_recorder",
                             lambda: _sec_flight_recorder(ctx))
@@ -2335,6 +2336,221 @@ def _sec_build_pipeline(root: str) -> dict:
         "spill_route_s": round(report.phases.get("spill_route", 0.0), 4),
         "spill_finish_s": round(
             report.phases.get("spill_finish", 0.0), 4),
+    }}
+
+
+def _multichip_worker() -> None:
+    """Subprocess body of the ``multichip`` section: one device count per
+    process (jax locks the virtual device count at backend init, so 1-
+    vs 8-device legs cannot share an interpreter).  Reads the spec from
+    ``HS_MULTICHIP_SPEC``, builds the sf-scaled index (spill-forced, so
+    the mesh-sharded route is what scales), runs the hybrid-join+agg
+    query, and writes timings + per-bucket sha256 digests + the
+    canonicalized answer digest to the spec's ``out`` file."""
+    import hashlib
+    import json as _json
+    from collections import defaultdict
+
+    spec = _json.loads(os.environ["HS_MULTICHIP_SPEC"])
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
+    from hyperspace_tpu.io.parquet import bucket_id_of_file
+
+    assert len(jax.devices()) == int(spec["devices"]), \
+        (len(jax.devices()), spec["devices"])
+
+    def make_session(tag: str):
+        s = HyperspaceSession(system_path=os.path.join(
+            spec["root"], f"ix_{spec['devices']}_{tag}"))
+        s.conf.num_buckets = int(spec["num_buckets"])
+        s.conf.device_batch_rows = int(spec["batch_rows"])
+        s.conf.device_build_min_rows = 0   # force the device/mesh route
+        s.conf.mesh_enabled = "auto"       # 1 device => no mesh (gate)
+        s.conf.mesh_join_min_rows = 1
+        s.conf.mesh_agg_min_rows = 1
+        return s
+
+    build_s = []
+    last = {}
+    for rep in range(int(spec["reps"])):
+        s = make_session(str(rep))
+        hs = Hyperspace(s)
+        t0 = time.perf_counter()
+        hs.create_index(s.read.parquet(spec["fact"]),
+                        IndexConfig("mf", ["k"], ["g", "v"]))
+        build_s.append(time.perf_counter() - t0)
+        last["session"], last["hs"] = s, hs
+    s, hs = last["session"], last["hs"]
+    report = hs.last_build_report()
+    digests = defaultdict(list)
+    entry = s.index_collection_manager.get_index("mf")
+    for f in entry.content.file_infos():
+        with open(f.name, "rb") as fh:
+            digests[str(bucket_id_of_file(f.name))].append(
+                hashlib.sha256(fh.read()).hexdigest())
+
+    hs.create_index(s.read.parquet(spec["dims"]),
+                    IndexConfig("md", ["k"], ["w"]))
+    s.enable_hyperspace()
+    fact = s.read.parquet(spec["fact"])
+    dims = s.read.parquet(spec["dims"])
+
+    def query():
+        return (fact.join(dims, col("k") == col("k"))
+                .group_by("g")
+                .agg(sv=("v", "sum"), sw=("w", "sum"), c=("", "count_all"))
+                .collect())
+
+    out_table = query()  # warm (plan cache, jit)
+    query_s = []
+    for _ in range(int(spec["reps"])):
+        t0 = time.perf_counter()
+        out_table = query()
+        query_s.append(time.perf_counter() - t0)
+    strategies = [j["strategy"] for j in s.last_execution_stats["joins"]]
+    rows = out_table.sort_by([("g", "ascending")]).to_pydict()
+    answer_sha = hashlib.sha256(_json.dumps(
+        rows, sort_keys=True, default=str).encode()).hexdigest()
+    payload = {
+        "devices": int(spec["devices"]),
+        "build_s": build_s,
+        "query_s": query_s,
+        "bucket_digests": {b: sorted(d) for b, d in digests.items()},
+        "answer_sha": answer_sha,
+        "join_strategies": strategies,
+        "mesh_devices": report.mesh_devices,
+        "spill_bytes": report.spill_bytes,
+    }
+    # hslint: allow[io-seam] worker->parent result handoff, not index data
+    with open(spec["out"], "w", encoding="utf-8") as f:
+        _json.dump(payload, f)
+
+
+def _sec_multichip(root: str) -> dict:
+    """Mesh scale-out acceptance (ROADMAP item 1): the SAME sf-scaled
+    spill build and hybrid-join+aggregate query run at 1 device and at 8
+    virtual CPU devices (``--xla_force_host_platform_device_count`` in a
+    fresh subprocess per leg — jax locks the device count at backend
+    init), recording per-device-count medians and the speedup ratios for
+    ``--compare``.  Correctness-gated: the 8-device index tree must be
+    BYTE-identical to the 1-device one (per-bucket sha256) and the query
+    answers must match exactly — a divergence aborts the bench like a
+    wrong answer.  The speedup itself is gated only on hosts with >= 8
+    cores (virtual devices share cores below that; the ratio is still
+    recorded for trend watching)."""
+    import json as _json
+    import statistics
+    import subprocess
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    n = max(20_000, N_LINEITEM // 10)
+    files = 4
+    src_root = os.path.join(root, "multichip")
+    fact_dir = os.path.join(src_root, "fact")
+    dims_dir = os.path.join(src_root, "dims")
+    os.makedirs(fact_dir, exist_ok=True)
+    os.makedirs(dims_dir, exist_ok=True)
+    rng = np.random.default_rng(41)
+    n_keys = max(64, n // 16)
+    fact = pa.table({
+        "k": pa.array(rng.integers(0, n_keys, size=n), type=pa.int64()),
+        "g": pa.array(rng.integers(0, 11, size=n), type=pa.int64()),
+        "v": pa.array(rng.integers(0, 1000, size=n), type=pa.int64()),
+    })
+    step = -(-n // files)
+    for f in range(files):
+        pq.write_table(fact.slice(f * step, step),
+                       os.path.join(fact_dir, f"part-{f:05d}.parquet"))
+    pq.write_table(pa.table({
+        "k": pa.array(np.arange(n_keys), type=pa.int64()),
+        "w": pa.array(rng.integers(0, 100, size=n_keys),
+                      type=pa.int64()),
+    }), os.path.join(dims_dir, "dims.parquet"))
+
+    bench_dir = os.path.dirname(os.path.abspath(__file__))
+    reps = min(3, REPEATS)
+    legs = {}
+    for ndev in (1, 8):
+        out_path = os.path.join(src_root, f"result_{ndev}.json")
+        env = dict(os.environ)
+        env.pop("HS_BENCH_BUDGET", None)  # the child is not a bench run
+        flags = env.get("XLA_FLAGS", "")
+        flag = f"--xla_force_host_platform_device_count={ndev}"
+        if "xla_force_host_platform_device_count" in flags:
+            import re as _re
+
+            flags = _re.sub(
+                r"--xla_force_host_platform_device_count=\d+", flag, flags)
+        else:
+            flags = f"{flags} {flag}".strip()
+        env.update(
+            JAX_PLATFORMS="cpu", HS_XLA_CACHE="0", HS_CALIBRATE="0",
+            XLA_FLAGS=flags,
+            HS_MULTICHIP_SPEC=_json.dumps({
+                "devices": ndev, "root": src_root, "fact": fact_dir,
+                "dims": dims_dir, "reps": reps, "num_buckets": NUM_BUCKETS,
+                "batch_rows": max(4096, n // 8), "out": out_path,
+            }))
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import bench; bench._multichip_worker()"],
+            cwd=bench_dir, env=env, capture_output=True, text=True,
+            timeout=1800)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"multichip worker ({ndev} devices) failed:\n"
+                + proc.stderr[-3000:])
+        with open(out_path, "r", encoding="utf-8") as f:
+            legs[ndev] = _json.load(f)
+
+    if legs[1]["bucket_digests"] != legs[8]["bucket_digests"]:
+        raise SystemExit(
+            "multichip bench: the 8-device index tree diverged from the "
+            "1-device one — the mesh may change where work runs, never "
+            "the layout")
+    if legs[1]["answer_sha"] != legs[8]["answer_sha"]:
+        raise SystemExit(
+            "multichip bench: the 8-device query answer diverged from "
+            "the 1-device one")
+    if "bucketed-mesh" not in legs[8]["join_strategies"] \
+            and not any("mesh" in st for st in legs[8]["join_strategies"]):
+        raise SystemExit(
+            f"multichip bench: the 8-device leg never dispatched a mesh "
+            f"join (strategies: {legs[8]['join_strategies']})")
+
+    med = statistics.median
+    build_speedup = med(legs[1]["build_s"]) / max(
+        med(legs[8]["build_s"]), 1e-9)
+    query_speedup = med(legs[1]["query_s"]) / max(
+        med(legs[8]["query_s"]), 1e-9)
+    cores = os.cpu_count() or 1
+    gated = cores >= 8
+    if gated and build_speedup < 1.5:
+        raise SystemExit(
+            f"multichip bench: 8-device build only {build_speedup:.2f}x "
+            f"the 1-device build on a {cores}-core host "
+            f"(correctness gate: >= 1.5x)")
+    return {"multichip": {
+        "rows": n,
+        "cores": cores,
+        "reps": reps,
+        "build_s_1dev": round(med(legs[1]["build_s"]), 4),
+        "build_s_8dev": round(med(legs[8]["build_s"]), 4),
+        "query_s_1dev": round(med(legs[1]["query_s"]), 4),
+        "query_s_8dev": round(med(legs[8]["query_s"]), 4),
+        "build_speedup_x": round(build_speedup, 3),
+        "query_speedup_x": round(query_speedup, 3),
+        "speedup_gated": gated,
+        "bit_equal": True,
+        "mesh_devices_8dev": legs[8]["mesh_devices"],
+        "join_strategies_8dev": legs[8]["join_strategies"],
     }}
 
 

@@ -327,9 +327,16 @@ class CreateActionBase(Action):
 
         columns = resolved.all_columns
         batch_rows = max(1, int(self.conf.device_batch_rows))
-        # The mesh build shards rows across devices itself — streaming spill
-        # is the SINGLE-chip answer to datasets beyond one batch.
-        streaming = not self._use_distributed_build()
+        # Datasets beyond one batch stream through the spill builder —
+        # whose per-chunk route shards over the mesh when one is active
+        # (bounded memory AND horizontal scale; parallel/sharded_build).
+        # Only an EXPLICIT parallel_build="on" keeps the legacy
+        # monolithic all_to_all build, which holds the whole dataset in
+        # memory (bit-equal either way — layout never depends on the
+        # route).
+        streaming = not (
+            str(self.conf.parallel_build).lower() in ("on", "true")
+            and self._use_distributed_build())
         self._phase("plan_s", _time.perf_counter() - _t0)
         if streaming and resolved.layout == "zorder":
             # Z-order builds beyond one batch take a dedicated two-pass
@@ -863,6 +870,8 @@ class _BucketSpill:
         self._chunk_no = 0
         self._schema = None
         self._code_cols: tuple = ()
+        self._mesh = None       # resolved lazily at first route
+        self._mesh_probed = False
         self._dir = None  # created on first spill; non-spilling builds
         # never touch disk
         self._pool = None
@@ -1002,11 +1011,22 @@ class _BucketSpill:
             if fire:
                 self._close_groups()
 
+    def _active_mesh(self):
+        """The engine mesh for this build's chunk routes, resolved once
+        (``hyperspace.parallel.mesh.enabled``; None = single-device)."""
+        if not self._mesh_probed:
+            from hyperspace_tpu.parallel.mesh import active_mesh
+
+            self._mesh = active_mesh(self.action.conf)
+            self._mesh_probed = True
+        return self._mesh
+
     def _route_chunk(self, table: pa.Table, chunk_no: int) -> None:
         import time as _time
 
         from hyperspace_tpu.ops.hash import (
             route_partition,
+            route_partition_mesh,
             route_partition_np,
         )
 
@@ -1035,6 +1055,24 @@ class _BucketSpill:
             # layout cannot depend on the route.
             buckets, perm = route_partition_np(word_cols, codes64,
                                                num_buckets)
+        elif (mesh := self._active_mesh()) is not None:
+            # Sharded route: rows data-parallel over the mesh, each
+            # device owning buckets ``b % n_devices``, per-device runs
+            # gathered through the attributed host seam (one pull per
+            # device per chunk) — bit-identical layout, proven by
+            # tests/test_parallel_mesh.py's per-bucket digests.
+            devices = list(mesh.devices.flat)
+            buckets, perm = route_partition_mesh(
+                word_cols,
+                [columnar.split_words64(k) for k in codes64],
+                num_buckets, mesh,
+                pad_to=max(1, int(self.action.conf.device_batch_rows)))
+            ms = (_time.perf_counter() - _t0) * 1000.0
+            report = self.action.build_report
+            report.properties["mesh_devices"] = len(devices)
+            for dev in devices:
+                report.add_device_kernel_ms(
+                    int(getattr(dev, "id", -1)), ms)
         else:
             buckets, perm = route_partition(
                 word_cols,

@@ -48,6 +48,9 @@ DEVICE_CACHE_BYTES = "hyperspace.tpu.deviceCacheBytes"
 DEVICE_CACHE_POLICY = "hyperspace.tpu.deviceCachePolicy"
 PARALLEL_BUILD = "hyperspace.tpu.parallelBuild"
 SHUFFLE_CAPACITY_SLACK = "hyperspace.tpu.shuffleCapacitySlack"
+MESH_ENABLED = "hyperspace.parallel.mesh.enabled"
+MESH_MAX_DEVICES = "hyperspace.parallel.mesh.maxDevices"
+MESH_AGG_MIN_ROWS = "hyperspace.tpu.meshAggMinRows"
 BUILD_PIPELINE_ENABLED = "hyperspace.index.build.pipeline.enabled"
 BUILD_PREFETCH_DEPTH = "hyperspace.index.build.prefetchDepth"
 BUILD_FINALIZE_WORKERS = "hyperspace.index.build.finalizeWorkers"
@@ -248,6 +251,23 @@ class HyperspaceConf:
     # the perfectly-balanced per-destination row count (doubled on overflow).
     parallel_build: str = "auto"
     shuffle_capacity_slack: float = 1.5
+    # The engine-wide device mesh (parallel/mesh.py): "auto" activates the
+    # mesh-sharded kernel paths (sharded build route, bucket-owned join/
+    # aggregate dispatch) whenever more than one local device is visible;
+    # "on" insists (still a no-op below 2 devices — there is nothing to
+    # shard); "off" pins every kernel to the single-device path, which is
+    # bit-equal by construction (the mesh changes WHERE work runs, never
+    # the layout or the answer).  maxDevices caps how many local devices
+    # the mesh spans (0 = all) — useful to leave a device free for
+    # serving while builds shard over the rest.
+    mesh_enabled: str = "auto"
+    mesh_max_devices: int = 0
+    # Same cost model as meshJoinMinRows for GROUP BY: at or above this
+    # row count an eligible device aggregation shards its rows over the
+    # mesh by group-key bucket ownership (parallel/aggregate.py — each
+    # group lives wholly on one device, so no partial-merge pass exists);
+    # below it, the single-device segment kernel.
+    mesh_agg_min_rows: int = 1 << 24
     # Overlapped build pipeline (actions/create.py; docs/13, docs/16):
     #   - pipeline.enabled: the external (spill) build runs as overlapped
     #     stages — async prefetch of source decode, concurrent chunk
@@ -506,6 +526,9 @@ class HyperspaceConf:
         DEVICE_CACHE_POLICY: "device_cache_policy",
         PARALLEL_BUILD: "parallel_build",
         SHUFFLE_CAPACITY_SLACK: "shuffle_capacity_slack",
+        MESH_ENABLED: "mesh_enabled",
+        MESH_MAX_DEVICES: "mesh_max_devices",
+        MESH_AGG_MIN_ROWS: "mesh_agg_min_rows",
         BUILD_PIPELINE_ENABLED: "build_pipeline_enabled",
         BUILD_PREFETCH_DEPTH: "build_prefetch_depth",
         BUILD_FINALIZE_WORKERS: "build_finalize_workers",

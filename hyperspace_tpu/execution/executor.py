@@ -87,6 +87,19 @@ class Executor:
         # id(table) -> (fingerprint, cacheable column names, table ref —
         # kept so the id can't be recycled mid-query).
         self._scan_fp: Dict[int, Tuple[str, frozenset, pa.Table]] = {}
+        # Engine mesh, resolved once per executor (= per collect):
+        # ``hyperspace.parallel.mesh.enabled`` gates every sharded
+        # dispatch below; None keeps the bit-equal single-device paths.
+        self._mesh_cache: Tuple[bool, object] = (False, None)
+
+    def _active_mesh(self):
+        probed, mesh = self._mesh_cache
+        if not probed:
+            from hyperspace_tpu.parallel.mesh import active_mesh
+
+            mesh = active_mesh(self.session.conf)
+            self._mesh_cache = (True, mesh)
+        return mesh
 
     # -- HBM-resident column cache ------------------------------------------
     def _register_scan_identity(self, table: pa.Table, paths) -> None:
@@ -501,8 +514,13 @@ class Executor:
             (agg_inputs[i], "num")
             for i, (func, _in, _out) in enumerate(plan.aggs)
             if func not in ("count", "count_all")]
-        if table.num_rows < self._cache_aware_min_rows(identity, pairs,
-                                                       "agg"):
+        min_rows = self._cache_aware_min_rows(identity, pairs, "agg")
+        # An active mesh opens the sharded aggregate at its own
+        # threshold, like the filter/join dispatches.
+        mesh = self._active_mesh()
+        if mesh is not None:
+            min_rows = min(min_rows, conf.mesh_agg_min_rows)
+        if table.num_rows < min_rows:
             return None
         if any(func not in AGG_OPS for func, _i, _o in plan.aggs):
             return None
@@ -536,22 +554,45 @@ class Executor:
                     or table.column(agg_inputs[i]).null_count > 0:
                 return None
 
-        from hyperspace_tpu.ops.aggregate import grouped_aggregate
+        from hyperspace_tpu.ops.aggregate import (
+            grouped_aggregate,
+            grouped_aggregate_mesh,
+        )
 
-        resident = self._all_resident(identity, pairs)
-        key_words = [self._device_column(table, k, identity, "order")
-                     for k in plan.group_by]
-        # One array per NON-count aggregate; counts ship nothing (a dummy
-        # column would be ~8 B/row of pointless tunnel transfer).
-        value_cols = [
-            self._device_column(table, agg_inputs[i], identity, "num")
-            for i, (func, _in, _out) in enumerate(plan.aggs)
-            if func not in ("count", "count_all")]
-        first_rows, counts, results = grouped_aggregate(
-            key_words, value_cols, [f for f, _i, _o in plan.aggs],
-            pad_to=conf.device_batch_rows)
+        use_mesh = (mesh is not None
+                    and table.num_rows >= conf.mesh_agg_min_rows)
+        if use_mesh:
+            # Sharded path: rows partitioned by group-key bucket
+            # ownership — a group is reduced whole on one device, so
+            # every op is exact and no merge pass exists.  Host arrays
+            # only (sharded placement is its own layout — the
+            # single-device resident cache is bypassed).
+            key_words = [np.asarray(columnar.to_order_words(
+                table.column(k))) for k in plan.group_by]
+            value_cols = [
+                np.asarray(columnar.to_device_numeric(
+                    table.column(agg_inputs[i])))
+                for i, (func, _in, _out) in enumerate(plan.aggs)
+                if func not in ("count", "count_all")]
+            first_rows, counts, results = grouped_aggregate_mesh(
+                key_words, value_cols, [f for f, _i, _o in plan.aggs],
+                mesh, pad_to=conf.device_batch_rows)
+            resident = False
+        else:
+            resident = self._all_resident(identity, pairs)
+            key_words = [self._device_column(table, k, identity, "order")
+                         for k in plan.group_by]
+            # One array per NON-count aggregate; counts ship nothing (a
+            # dummy column would be ~8 B/row of pointless transfer).
+            value_cols = [
+                self._device_column(table, agg_inputs[i], identity, "num")
+                for i, (func, _in, _out) in enumerate(plan.aggs)
+                if func not in ("count", "count_all")]
+            first_rows, counts, results = grouped_aggregate(
+                key_words, value_cols, [f for f, _i, _o in plan.aggs],
+                pad_to=conf.device_batch_rows)
         self.stats.setdefault("aggregates", []).append({
-            "strategy": "device-segment",
+            "strategy": "mesh-segment" if use_mesh else "device-segment",
             "groups": int(len(first_rows)),
             "rows": table.num_rows,
             "resident": resident,
@@ -953,23 +994,37 @@ class Executor:
         pr = [(c, "num") for c in need_r]
         max_rows = max(lv.num_rows, rv.num_rows)
         cold = conf.device_min_rows("join_agg")
+        # The sharded pipeline opens at its own threshold (topn fusion
+        # and HBM residency keep the single-device kernel — the mesh
+        # path re-partitions between stages, which only pays off when
+        # the data is big enough to scale with the devices).
+        mesh = self._active_mesh()
+        use_mesh = (mesh is not None and topn is None
+                    and max_rows >= conf.mesh_join_min_rows)
         use_device = max_rows >= cold
         if not use_device:
             eff = max(self._cache_aware_min_rows(id_l, pl, "join_agg"),
                       self._cache_aware_min_rows(id_r, pr, "join_agg"))
             use_device = eff < cold and max_rows >= eff
-        if not use_device:
+        if not use_device and not use_mesh:
             return fallback()
         resident = self._all_resident(id_l, pl) \
             and self._all_resident(id_r, pr)
+        use_mesh = use_mesh and not resident
 
-        # Device arrays for every referenced column (cache-aware).
+        # Device arrays for every referenced column (cache-aware); the
+        # mesh path takes HOST arrays instead — sharded placement is its
+        # own layout, so the single-device resident cache is bypassed.
         ref_order: List[Tuple[str, str]] = \
             [("l", c) for c in need_l] + [("r", c) for c in need_r]
         col_ix = {c: i for i, (_s, c) in enumerate(ref_order)}
-        columns = [self._device_column(
-            table_of(s), c, id_l if s == "l" else id_r, "num")
-            for s, c in ref_order]
+        if use_mesh:
+            columns = [np.asarray(columnar.to_device_numeric(
+                table_of(s).column(c))) for s, c in ref_order]
+        else:
+            columns = [self._device_column(
+                table_of(s), c, id_l if s == "l" else id_r, "num")
+                for s, c in ref_order]
         sides = [s for s, _c in ref_order]
         group_ix = [col_ix[k] for k in plan.group_by]
         value_fns, lits_list, agg_ops = [], [], []
@@ -986,17 +1041,29 @@ class Executor:
             value_fns.append(fn)
             lits_list.append(lits)
 
-        from hyperspace_tpu.ops.join_agg import join_group_aggregate
+        from hyperspace_tpu.ops.join_agg import (
+            join_group_aggregate,
+            join_group_aggregate_mesh,
+        )
 
-        li_first, ri_first, counts, results = join_group_aggregate(
-            columns[col_ix[lk_name]], columns[col_ix[rk_name]],
-            columns, sides, group_ix, agg_ops, value_fns, lits_list,
-            topn=topn)
+        if use_mesh:
+            li_first, ri_first, counts, results = \
+                join_group_aggregate_mesh(
+                    columns[col_ix[lk_name]], columns[col_ix[rk_name]],
+                    columns, sides, group_ix, agg_ops, value_fns,
+                    lits_list, mesh, pad_to=conf.device_batch_rows)
+        else:
+            li_first, ri_first, counts, results = join_group_aggregate(
+                columns[col_ix[lk_name]], columns[col_ix[rk_name]],
+                columns, sides, group_ix, agg_ops, value_fns, lits_list,
+                topn=topn)
         self.stats["joins"].append({
-            "strategy": "device-fused-agg", "how": "inner",
+            "strategy": "mesh-fused-agg" if use_mesh
+            else "device-fused-agg", "how": "inner",
             "resident": resident})
         self.stats.setdefault("aggregates", []).append({
-            "strategy": "device-join-agg", "groups": int(len(counts)),
+            "strategy": "mesh-join-agg" if use_mesh
+            else "device-join-agg", "groups": int(len(counts)),
             "rows": int(max_rows), "resident": resident,
             "topn": None if topn is None else int(topn[2])})
         data = {}
@@ -1139,15 +1206,16 @@ class Executor:
         # three-valued-logic semantics.
         # Small batches stay on host: the device round trip's fixed latency
         # dwarfs a vectorized arrow pass (conf device_filter_min_rows).
-        # With >1 device the MESH threshold also opens the device path —
-        # otherwise raising device_filter_min_rows above mesh_filter_min_rows
-        # would make the sharded path unreachable in between.
-        import jax
-
+        # With an active mesh the MESH threshold also opens the device
+        # path — otherwise raising device_filter_min_rows above
+        # mesh_filter_min_rows would make the sharded path unreachable
+        # in between.  ``hyperspace.parallel.mesh.enabled=off`` pins
+        # every dispatch below to the bit-equal single-device path.
         identity = self._scan_identity(table)
         pairs = [(c, "num") for c in cols]
         min_rows = self._cache_aware_min_rows(identity, pairs, "filter")
-        if len(jax.local_devices()) > 1:
+        mesh = self._active_mesh()
+        if mesh is not None:
             min_rows = min(min_rows, self.session.conf.mesh_filter_min_rows)
         numeric = bool(cols) \
             and table.num_rows >= min_rows \
@@ -1160,10 +1228,11 @@ class Executor:
             # The mesh branch bypasses the single-device resident cache
             # (sharded placement is its own layout) — its stats must not
             # claim a zero-transfer resident run.
-            use_mesh = (len(jax.local_devices()) > 1 and table.num_rows
+            use_mesh = (mesh is not None and table.num_rows
                         >= self.session.conf.mesh_filter_min_rows)
             resident = not use_mesh and self._all_resident(identity, pairs)
-            mask = self._eval_device(expr, table, identity)
+            mask = self._eval_device(expr, table, identity,
+                                     mesh=mesh if use_mesh else None)
             self.stats.setdefault("filters", []).append({
                 "strategy": "device-mesh" if use_mesh else "device",
                 "rows": table.num_rows, "resident": resident})
@@ -1250,9 +1319,7 @@ class Executor:
         return False
 
     def _eval_device(self, expr: Expr, table: pa.Table,
-                     identity=None) -> np.ndarray:
-        import jax
-
+                     identity=None, mesh=None) -> np.ndarray:
         from hyperspace_tpu.ops.filter import compile_predicate
 
         order = sorted(expr.referenced_columns())
@@ -1260,10 +1327,9 @@ class Executor:
         fn, literals = compile_predicate(norm, order)
         # Scoped x64 so int64 columns keep full width on device (global x64
         # would leak dtype defaults into the embedding application's JAX).
-        if (len(jax.local_devices()) > 1 and table.num_rows
-                >= self.session.conf.mesh_filter_min_rows):
+        if mesh is not None:
             # Large scan + a mesh: shard the columns row-wise over every
-            # LOCAL device (the batch is host-resident; other hosts'
+            # mesh device (the batch is host-resident; other hosts'
             # devices are not addressable from here); the elementwise
             # program partitions with zero collectives (parallel/filter.py,
             # which scopes x64 itself).  The single-device resident cache
@@ -1272,7 +1338,8 @@ class Executor:
 
             device_cols = [columnar.to_device_numeric(table.column(c))
                            for c in order]
-            return eval_predicate_on_mesh(fn, device_cols, literals)
+            return eval_predicate_on_mesh(fn, device_cols, literals,
+                                          mesh=mesh)
         device_cols = [self._device_column(table, c, identity, "num")
                        for c in order]
         t0 = timeline.kernel_begin()
@@ -1434,7 +1501,11 @@ class Executor:
             and columnar.is_numeric_type(left.schema.field(l_keys[0]).type)
             and columnar.is_numeric_type(right.schema.field(r_keys[0]).type))
         if single_numeric:
-            from hyperspace_tpu.ops.join import sorted_equi_join, sorted_equi_join_np
+            from hyperspace_tpu.ops.join import (
+                sorted_equi_join,
+                sorted_equi_join_mesh,
+                sorted_equi_join_np,
+            )
 
             # Routing: the cold-transfer break-even normally; when BOTH
             # sides' key columns are HBM-resident for their (possibly
@@ -1456,7 +1527,20 @@ class Executor:
                 use_device = eff < cold and max_rows >= eff
             resident = use_device and self._all_resident(id_l, pl) \
                 and self._all_resident(id_r, pr)
-            if use_device:
+            # An active mesh shards the key space over the devices at
+            # its own threshold (host inputs only: resident arrays keep
+            # the single-device kernel, whose HBM placement is its own
+            # layout).  Same match set either way — the mesh changes
+            # where the searchsorted runs, not what it finds.
+            mesh = self._active_mesh()
+            use_mesh = (mesh is not None and not resident
+                        and max_rows
+                        >= self.session.conf.mesh_join_min_rows)
+            if use_mesh:
+                lk = columnar.to_device_numeric(left.column(l_keys[0]))
+                rk = columnar.to_device_numeric(right.column(r_keys[0]))
+                li, ri = sorted_equi_join_mesh(lk, rk, mesh)
+            elif use_device:
                 lk = self._device_column(left, l_keys[0], id_l, "num")
                 rk = self._device_column(right, r_keys[0], id_r, "num")
                 li, ri = sorted_equi_join(lk, rk)
@@ -1465,7 +1549,8 @@ class Executor:
                 rk = columnar.to_device_numeric(right.column(r_keys[0]))
                 li, ri = sorted_equi_join_np(lk, rk)
             self.stats.setdefault("join_kernels", []).append({
-                "strategy": "device" if use_device else "host",
+                "strategy": "mesh" if use_mesh
+                else ("device" if use_device else "host"),
                 "rows": int(max_rows), "resident": resident})
             return np.asarray(li, dtype=np.int64), np.asarray(ri, dtype=np.int64)
         # Composite/string keys: digest join on device (or its host
@@ -1611,20 +1696,21 @@ class Executor:
         reference's distributed exchange-free SMJ
         (BucketUnionExec.scala:52-81 + Spark SMJ over executors).
 
-        Applies to INNER joins with a single numeric key when >1 device is
-        visible and the data is large enough to amortize the transfer
-        (conf mesh_join_min_rows — estimated from parquet FOOTERS before
-        anything is materialized, so a below-threshold join never loses the
-        host pool's 8-concurrent-bucket memory bound); everything else
-        keeps the host pool.  The mesh path itself holds all buckets
-        resident by construction — that is what the threshold gates."""
-        import jax
-
+        Applies to INNER joins with a single numeric key when the engine
+        mesh is active (``hyperspace.parallel.mesh.enabled``; off or
+        1 device keeps the bit-equal host pool) and the data is large
+        enough to amortize the transfer (conf mesh_join_min_rows —
+        estimated from parquet FOOTERS before anything is materialized,
+        so a below-threshold join never loses the host pool's
+        8-concurrent-bucket memory bound); everything else keeps the
+        host pool.  The mesh path itself holds all buckets resident by
+        construction — that is what the threshold gates."""
         if plan.how != "inner" or extra_left or extra_right:
             return None
-        devices = jax.devices()
-        if len(devices) < 2:
+        mesh = self._active_mesh()
+        if mesh is None:
             return None
+        devices = list(mesh.devices.flat)
         from hyperspace_tpu.plan.expr import as_equi_join_pairs
 
         pairs = as_equi_join_pairs(plan.condition)
@@ -1690,14 +1776,16 @@ class Executor:
 
         l_tabs = drop_nulls(l_tabs, lk_name)
         r_tabs = drop_nulls(r_tabs, rk_name)
-        # Contiguous bucket ranges per device (range partitioning over the
-        # shard axis, matching parallel/shuffle.py's ownership layout);
+        # MOD bucket ownership over the shard axis (device d owns bucket
+        # b iff b % D == d — the same ownership the sharded build route
+        # writes with, so index shards and query shards stay aligned);
         # one concatenated table + key shard per device.
         from hyperspace_tpu.parallel.join import copartitioned_join_ragged
-        from hyperspace_tpu.parallel.mesh import build_mesh
+        from hyperspace_tpu.telemetry import metrics
 
         D = len(devices)
-        groups = np.array_split(np.arange(len(shared)), D)
+        groups = [[i for i, b in enumerate(shared) if b % D == d]
+                  for d in range(D)]
         l_dev_tabs, r_dev_tabs, l_shards, r_shards = [], [], [], []
         for g in groups:
             lt = pa.concat_tables([l_tabs[i] for i in g]) if len(g) \
@@ -1711,7 +1799,8 @@ class Executor:
             r_shards.append(np.asarray(
                 columnar.to_device_numeric(rt.column(rk_name))))
         dev_ids, l_local, r_local = copartitioned_join_ragged(
-            l_shards, r_shards, build_mesh())
+            l_shards, r_shards, mesh)
+        metrics.set_gauge("exec.mesh.devices", D)
         self.stats["joins"].append({
             "strategy": "bucketed-mesh",
             "how": plan.how,

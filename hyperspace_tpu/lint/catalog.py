@@ -286,6 +286,7 @@ REQUIRED_BENCH_SPANS = (
     "build.phase.write",
     "build.phase.spill_route",
     "build.phase.spill_finish",
+    "bench.multichip",
     "bench.serving",
     "serve.request",
     "bench.flight_recorder",

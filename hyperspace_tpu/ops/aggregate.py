@@ -106,6 +106,23 @@ def _segment_reduce(perm, boundaries, n_valid, value_cols, *, ops, capacity):
     return (first_rows, counts) + tuple(outs)
 
 
+def grouped_aggregate_mesh(
+    key_words: Sequence[np.ndarray],
+    value_cols: Sequence[np.ndarray],
+    ops: Sequence[str],
+    mesh,
+    pad_to: int = 0,
+) -> Tuple[np.ndarray, np.ndarray, List[np.ndarray]]:
+    """Sharding-aware entry of the grouped aggregation: same contract
+    and group order as :func:`grouped_aggregate`, computed over ``mesh``
+    with group-key bucket ownership (a group's rows all land on one
+    device, so every reduction is exact — parallel/aggregate.py)."""
+    from hyperspace_tpu.parallel.aggregate import mesh_grouped_aggregate
+
+    return mesh_grouped_aggregate(key_words, value_cols, ops, mesh,
+                                  pad_to=pad_to)
+
+
 def grouped_aggregate(
     key_words: Sequence[np.ndarray],
     value_cols: Sequence[np.ndarray],

@@ -219,6 +219,24 @@ def route_partition(
     return stacked[0, :n], stacked[1, :n]
 
 
+def route_partition_mesh(
+    word_cols: Sequence[np.ndarray],
+    order_words: Sequence[np.ndarray],
+    num_buckets: int,
+    mesh,
+    pad_to: int = 0,
+):
+    """Sharding-aware entry of the fused route+partition: the SAME
+    ``(bucket_ids, perm)`` contract (bit-identical output — layout can
+    never depend on the route), computed over ``mesh`` with per-device
+    bucket ownership ``bucket_id % n_devices`` and a host gather seam of
+    one attributed pull per device (parallel/sharded_build.py)."""
+    from hyperspace_tpu.parallel.sharded_build import mesh_route_partition
+
+    return mesh_route_partition(word_cols, order_words, num_buckets,
+                                mesh, pad_to=pad_to)
+
+
 def route_partition_np(
     word_cols: Sequence[np.ndarray],
     order_words: Sequence[np.ndarray],

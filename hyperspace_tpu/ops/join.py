@@ -171,6 +171,63 @@ def hashed_equi_join(left, right, l_keys, r_keys,
     return li[keep], ri[keep]
 
 
+def _key_owner_shards(keys: np.ndarray, n_devices: int):
+    """(shards, originals): per mesh device, the key values it owns and
+    their original indices.  Ownership is the key's hash bucket mod the
+    device count — the same mod ownership as the sharded build route,
+    through the bit-identical host hash mirror, so EQUAL keys always
+    share an owner and the per-device joins are exhaustive."""
+    import pyarrow as pa
+
+    from hyperspace_tpu.io import columnar
+    from hyperspace_tpu.ops.hash import bucket_ids_np
+
+    words = np.asarray(columnar.to_hash_words(
+        pa.chunked_array([pa.array(keys)])))
+    owner = bucket_ids_np([words], n_devices)
+    order = np.argsort(owner, kind="stable")
+    owner_sorted = owner[order]
+    starts = np.searchsorted(owner_sorted, np.arange(n_devices), "left")
+    ends = np.searchsorted(owner_sorted, np.arange(n_devices), "right")
+    shards = [keys[order[starts[d]:ends[d]]] for d in range(n_devices)]
+    originals = [order[starts[d]:ends[d]] for d in range(n_devices)]
+    return shards, originals
+
+
+def sorted_equi_join_mesh(left_keys: np.ndarray, right_keys: np.ndarray,
+                          mesh) -> Tuple[np.ndarray, np.ndarray]:
+    """Sharding-aware entry of the inner equi-join: the same MATCH SET
+    as :func:`sorted_equi_join` (pair order is not contractual), with
+    both sides co-partitioned by key-hash bucket ownership and every
+    device joining only its owned keys under ``shard_map``
+    (parallel/join.copartitioned_join_ragged — zero collectives; the
+    only host traffic is the final gather of match indices).  Host
+    inputs only: resident arrays keep the single-device kernel, whose
+    HBM placement is its own layout."""
+    from hyperspace_tpu.parallel.join import copartitioned_join_ragged
+
+    left_keys = np.asarray(left_keys)
+    right_keys = np.asarray(right_keys)
+    if left_keys.size == 0 or right_keys.size == 0:
+        return np.empty(0, dtype=np.int64), np.empty(0, dtype=np.int64)
+    n_devices = int(mesh.devices.size)
+    l_shards, l_orig = _key_owner_shards(left_keys, n_devices)
+    r_shards, r_orig = _key_owner_shards(right_keys, n_devices)
+    dev_ids, l_local, r_local = copartitioned_join_ragged(
+        l_shards, r_shards, mesh)
+    li_parts, ri_parts = [], []
+    for d in range(n_devices):
+        sel = dev_ids == d
+        if not sel.any():
+            continue
+        li_parts.append(l_orig[d][l_local[sel]])
+        ri_parts.append(r_orig[d][r_local[sel]])
+    if not li_parts:
+        return np.empty(0, dtype=np.int64), np.empty(0, dtype=np.int64)
+    return (np.concatenate(li_parts).astype(np.int64),
+            np.concatenate(ri_parts).astype(np.int64))
+
+
 def sorted_equi_join(left_keys: np.ndarray, right_keys: np.ndarray
                      ) -> Tuple[np.ndarray, np.ndarray]:
     """Inner equi-join on single numeric keys.

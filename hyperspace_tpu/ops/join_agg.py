@@ -83,6 +83,83 @@ def _int_order_words(x: jnp.ndarray) -> jnp.ndarray:
     return jnp.stack([hi, lo], axis=-1)
 
 
+def _int_order_words_np(x: np.ndarray) -> np.ndarray:
+    """Host mirror of :func:`_int_order_words` — the same (n, 2) uint32
+    monotone encoding, bit-identical, for the sharded wrapper's
+    group-key partitioning."""
+    with np.errstate(over="ignore"):
+        ux = (x.astype(np.int64) ^ np.int64(-(2 ** 63))).astype(np.uint64)
+        hi = (ux >> np.uint64(32)).astype(np.uint32)
+        lo = (ux & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    return np.stack([hi, lo], axis=-1)
+
+
+def join_group_aggregate_mesh(
+    l_key,
+    r_key,
+    columns: Sequence,
+    column_sides: Sequence[str],
+    group_col_ix: Sequence[int],
+    agg_ops: Sequence[str],
+    value_fns: Sequence[Callable],
+    literals: Sequence[Sequence[float]],
+    mesh,
+    pad_to: int = 0,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, List[np.ndarray]]:
+    """Sharding-aware entry of the join→aggregate pipeline: the same
+    result contract as :func:`join_group_aggregate` (groups ascending by
+    key), computed as three mesh stages —
+
+      1. co-partitioned inner join by join-key bucket ownership
+         (``ops.join.sorted_equi_join_mesh``: zero cross-device shuffle,
+         only the match-index gather),
+      2. elementwise aggregate-input evaluation sharded row-wise over
+         the mesh (GSPMD partitions the expression with zero
+         collectives, ``parallel/filter.eval_predicate_on_mesh``),
+      3. grouped aggregation with GROUP-key bucket ownership
+         (``parallel/aggregate.mesh_grouped_aggregate`` — each group is
+         reduced whole on one device, no partial-merge pass).
+
+    Unlike the fused single-device kernel the joined intermediate
+    transits host between stages (O(matches) traffic — the price of
+    re-partitioning from join-key to group-key ownership); the win is
+    that every stage scales with the mesh.  ``topn`` fusion is not
+    supported — callers wanting it keep the single-device kernel.
+    Host inputs only (resident arrays keep the fused kernel)."""
+    from hyperspace_tpu.ops.join import sorted_equi_join_mesh
+    from hyperspace_tpu.parallel.aggregate import mesh_grouped_aggregate
+    from hyperspace_tpu.parallel.filter import eval_predicate_on_mesh
+
+    l_key = np.asarray(l_key)
+    r_key = np.asarray(r_key)
+    host_cols = [np.asarray(c) for c in columns]
+
+    def _empty():
+        return (np.empty(0, np.int64), np.empty(0, np.int64),
+                np.empty(0, np.int32), [np.empty(0) for _ in agg_ops])
+
+    if l_key.shape[0] == 0 or r_key.shape[0] == 0:
+        return _empty()
+    li, ri = sorted_equi_join_mesh(l_key, r_key, mesh)
+    if li.size == 0:
+        return _empty()
+    gathered = [c[li if side == "l" else ri]
+                for c, side in zip(host_cols, column_sides)]
+    key_words = [_int_order_words_np(gathered[i]) for i in group_col_ix]
+    # Literal dtype follows numpy inference (all-int vectors stay
+    # integral), exactly like the fused kernel's literal handling.
+    value_cols = [
+        np.asarray(eval_predicate_on_mesh(
+            fn, gathered,
+            np.asarray(lits) if lits else np.zeros(0), mesh))
+        for fn, lits in zip(value_fns, literals)]
+    first_rows, counts, results = mesh_grouped_aggregate(
+        key_words, value_cols, agg_ops, mesh, pad_to=pad_to)
+    li_first = li[first_rows.astype(np.int64)]
+    ri_first = ri[first_rows.astype(np.int64)]
+    return li_first, ri_first, counts, results
+
+
 def join_group_aggregate(
     l_key,
     r_key,

@@ -6,13 +6,21 @@ This package replaces the reference's Spark-cluster distribution substrate
 ``jax.sharding.Mesh`` + ``shard_map`` + XLA collectives riding ICI/DCN.
 """
 
+from hyperspace_tpu.parallel.aggregate import mesh_grouped_aggregate
 from hyperspace_tpu.parallel.build import distributed_bucket_sort_permutation
 from hyperspace_tpu.parallel.filter import eval_predicate_on_mesh
 from hyperspace_tpu.parallel.join import (
     copartitioned_join,
     copartitioned_join_ragged,
 )
-from hyperspace_tpu.parallel.mesh import SHARD_AXIS, build_mesh
+from hyperspace_tpu.parallel.mesh import (
+    SHARD_AXIS,
+    active_mesh,
+    build_mesh,
+    make_shard_and_gather_fns,
+    match_partition_rules,
+)
+from hyperspace_tpu.parallel.sharded_build import mesh_route_partition
 from hyperspace_tpu.parallel.multihost import (
     DCN_AXIS,
     ICI_AXIS,
@@ -26,11 +34,16 @@ __all__ = [
     "SHARD_AXIS",
     "DCN_AXIS",
     "ICI_AXIS",
+    "active_mesh",
     "build_mesh",
     "build_mesh_2d",
     "bucket_shuffle",
     "hierarchical_bucket_shuffle",
     "initialize_distributed",
+    "match_partition_rules",
+    "make_shard_and_gather_fns",
+    "mesh_grouped_aggregate",
+    "mesh_route_partition",
     "ShuffleResult",
     "distributed_bucket_sort_permutation",
     "eval_predicate_on_mesh",

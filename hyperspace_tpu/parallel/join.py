@@ -135,17 +135,24 @@ def copartitioned_join_ragged(
 
 
 def _copartitioned_join_padded(lk, lvalid, rk, rvalid, D, L, R, mesh):
+    from hyperspace_tpu.telemetry import timeline
+
     # Scoped x64: int64 join keys keep full width (see ops/join.py).
+    t0 = timeline.kernel_begin()
     with _enable_x64():
         counts = sync_guard.pull(
             _count_program(lk, lvalid, rk, rvalid, mesh=mesh),
             "mesh_join.counts")
         capacity = int(counts.max()) if counts.size else 0
         if capacity == 0:
+            timeline.kernel_end("mesh_join", t0, None,
+                                devices=list(mesh.devices.flat))
             return np.empty(0, np.int64), np.empty(0, np.int64)
         capacity = round_up_pow2(capacity)
         li, ri, totals = _materialize_program(
             lk, lvalid, rk, rvalid, capacity=capacity, mesh=mesh)
+    timeline.kernel_end("mesh_join", t0, (li, ri, totals),
+                        devices=list(mesh.devices.flat))
     li = sync_guard.pull(li, "mesh_join.li").reshape(D, capacity)
     ri = sync_guard.pull(ri, "mesh_join.ri").reshape(D, capacity)
     totals = sync_guard.pull(totals, "mesh_join.totals").reshape(D)

@@ -85,6 +85,12 @@ class BuildReport:
         # diff counts here — the RefreshSummary surfaced through
         # ``last_build_report()``); flat scalars only.
         self.properties: Dict[str, Any] = {}
+        # Per-jax-device attributed kernel milliseconds (mesh-sharded
+        # route/kernel passes: the SPMD program occupies every mesh
+        # device for its duration).  Lands in the perf-ledger record so
+        # ``doctor()``'s ledger-trend check and ``--compare``
+        # attribution can see per-device skew across builds.
+        self.device_kernel_ms: Dict[int, float] = {}
         # Timeline intervals (telemetry/timeline.py, when enabled): one
         # (lane, start_ns, end_ns) per add_phase call — lane = phase
         # name — so the gap/overlap analysis can say "read idle while
@@ -113,6 +119,13 @@ class BuildReport:
                     self.intervals.append((name, start_ns, end_ns))
             timeline.record_interval(name, "build.phase", start_ns,
                                      end_ns)
+
+    def add_device_kernel_ms(self, device_id: int, ms: float) -> None:
+        """Attribute ``ms`` of kernel time to one jax device (mesh route
+        workers call in concurrently)."""
+        with self._lock:
+            self.device_kernel_ms[int(device_id)] = \
+                self.device_kernel_ms.get(int(device_id), 0.0) + float(ms)
 
     def add_memory_sample(self, ts_ns: int, rss_mb: float,
                           device_bytes: int) -> None:
@@ -185,6 +198,12 @@ class BuildReport:
                 if s <= ts <= e and rss_mb > out.get(lane, 0.0):
                     out[lane] = rss_mb
         return {k: round(v, 1) for k, v in sorted(out.items())}
+
+    @property
+    def mesh_devices(self) -> int:
+        """How many mesh devices this build's sharded kernels spanned
+        (0 = the single-device path ran throughout)."""
+        return int(self.properties.get("mesh_devices", 0) or 0)
 
     @property
     def device_s(self) -> float:
@@ -266,6 +285,10 @@ class BuildReport:
             "device_live_bytes": self.device_live_bytes,
             **({"properties": dict(sorted(self.properties.items()))}
                if self.properties else {}),
+            **({"device_kernel_ms": {
+                str(k): round(v, 3)
+                for k, v in sorted(self.device_kernel_ms.items())}}
+               if self.device_kernel_ms else {}),
             # Timeline extras (present only when the interval recorder
             # was on for this build): the busy-fraction matrix and the
             # per-phase memory high-water marks.

@@ -212,13 +212,18 @@ def _device_id_of(out: Any) -> int:
     return -1
 
 
-def kernel_end(name: str, t0_ns: Optional[int], out: Any = None) -> None:
+def kernel_end(name: str, t0_ns: Optional[int], out: Any = None,
+               devices: Optional[Sequence] = None) -> None:
     """Close one device-kernel dispatch: block until ``out`` (a jax array
     or pytree of them) is ready, then attribute the elapsed time —
     ``exec.kernel.<name>.device_ms`` histogram, per-device
     ``exec.device.<id>.kernel_ms`` counter, a ``device:<id>`` timeline
     lane interval, and a ``kernel`` decision on the active run report
     (the flight recorder's device-bound/queue-bound discriminator).
+    ``devices`` names the mesh an SPMD program ran over: the program
+    occupies EVERY mesh device for its duration, so the elapsed ms is
+    attributed to each (one counter bump and one lane interval per
+    device — the per-device skew view the multichip bench reads).
     No-op (and no sync!) when the timeline is disabled."""
     if t0_ns is None:
         return
@@ -234,8 +239,17 @@ def kernel_end(name: str, t0_ns: Optional[int], out: Any = None) -> None:
         pass           # problem at ITS use site, not attribution's
     end_ns = time.monotonic_ns()
     ms = (end_ns - t0_ns) / 1e6
-    dev = _device_id_of(out)
     metrics.observe(f"exec.kernel.{name}.device_ms", ms)
+    if devices:
+        ids = sorted(int(getattr(d, "id", -1)) for d in devices)
+        for dev in ids:
+            metrics.inc(f"exec.device.{dev}.kernel_ms", ms)
+            _RECORDER.record(f"device:{dev}", f"kernel.{name}",
+                             t0_ns, end_ns)
+        run_report.record("kernel", name=name, device_ms=round(ms, 3),
+                          device=ids[0], devices=ids)
+        return
+    dev = _device_id_of(out)
     metrics.inc(f"exec.device.{dev}.kernel_ms", ms)
     _RECORDER.record(f"device:{dev}", f"kernel.{name}", t0_ns, end_ns)
     run_report.record("kernel", name=name, device_ms=round(ms, 3),

@@ -43,7 +43,12 @@ def _write_source(path: str, n: int = 40_000, files: int = 4) -> None:
 @pytest.fixture()
 def built(tmp_path):
     src = str(tmp_path / "src")
-    _write_source(src)
+    # One-batch scale (<= the conftest's 4096-row device batch): these
+    # tests assert the MONOLITHIC build's phase taxonomy (kernel/write,
+    # no spill).  Multi-batch datasets now stream through the spill
+    # builder even under parallel_build=auto — the mesh shards the
+    # per-chunk route — which is test_parallel_mesh.py's territory.
+    _write_source(src, n=4_000)
     session = HyperspaceSession(system_path=str(tmp_path / "ix"))
     session.conf.num_buckets = 4
     hs = Hyperspace(session)

@@ -799,6 +799,33 @@ class TestDeviceDiscipline:
                                    "    return np.asarray(y)\n"})
         assert any("sync_guard.pull" in f.message for f in got)
 
+    def test_unattributed_mesh_gather_fires_in_parallel(self, tmp_path):
+        """A sharded wrapper in parallel/ whose cross-device gather
+        bypasses the attributed seam (raw np.asarray of the sharded
+        program's output) must fire; the sync_guard.pull form with a
+        site name must stay quiet — the contract the mesh kernels'
+        host-gather seam is held to."""
+        seeded = ("import functools\n\n"
+                  "import jax\n"
+                  "import numpy as np\n\n\n"
+                  "@functools.partial(jax.jit, static_argnames=('mesh',))\n"
+                  "def _program(x, *, mesh):\n"
+                  "    return x + 1\n\n\n"
+                  "def mesh_gather_bad(x, mesh):\n"
+                  "    out = _program(x, mesh=mesh)\n"
+                  "    return np.asarray(out)  # unattributed gather\n")
+        got = self._run(
+            tmp_path, {"hyperspace_tpu/parallel/sharded.py": seeded})
+        assert any("implicit-sync" in f.ident
+                   and "sync_guard.pull" in f.message for f in got), got
+        sanctioned = seeded.replace(
+            "    return np.asarray(out)  # unattributed gather\n",
+            "    from hyperspace_tpu.execution import sync_guard\n\n"
+            "    return sync_guard.pull(out, 'mesh.gather.d0')\n")
+        assert self._run(
+            tmp_path, {"hyperspace_tpu/parallel/sharded.py":
+                       sanctioned}) == []
+
     def test_interprocedural_taint_through_helper(self, tmp_path):
         src = ("import jax.numpy as jnp\n\n\n"
                "def make(x):\n"
