@@ -75,6 +75,11 @@ TIMELINE_MAX_INTERVALS = "hyperspace.system.timeline.maxIntervals"
 TIMELINE_MEMORY_SAMPLE_MS = "hyperspace.system.timeline.memorySampleMs"
 DOCTOR_LATENCY_SLO_MS = "hyperspace.doctor.latencySloMs"
 DOCTOR_SHED_WARN_RATIO = "hyperspace.doctor.shedWarnRatio"
+DOCTOR_DEVICE_SKEW_WARN = "hyperspace.doctor.deviceSkewWarn"
+FLEET_TELEMETRY_ENABLED = "hyperspace.fleet.telemetry.enabled"
+FLEET_PUBLISH_INTERVAL_S = "hyperspace.fleet.telemetry.publishIntervalS"
+FLEET_STALE_AFTER_S = "hyperspace.fleet.telemetry.staleAfterS"
+FLEET_PRUNE_AFTER_S = "hyperspace.fleet.telemetry.pruneAfterS"
 BUILD_PROFILING_ENABLED = "hyperspace.system.buildProfiling.enabled"
 PERF_LEDGER_ENABLED = "hyperspace.system.perf.ledger.enabled"
 PERF_LEDGER_MAX_ENTRIES = "hyperspace.system.perf.ledger.maxEntries"
@@ -366,6 +371,30 @@ class HyperspaceConf:
     # observations above latencySloMs.
     doctor_latency_slo_ms: float = 1000.0
     doctor_shed_warn_ratio: float = 0.05
+    # doctor() device-skew grading (single-process ``device_skew`` check
+    # and the fleet-level ``fleet.skew`` check): warn when the
+    # max/median ratio over attributed per-device (or per-process)
+    # kernel milliseconds reaches this; 0 disables the grading.
+    doctor_device_skew_warn: float = 4.0
+    # Fleet telemetry federation (telemetry/fleet.py;
+    # docs/16-observability.md):
+    #   - enabled: each process publishes a bounded heartbeat snapshot
+    #     (identity/role, typed metrics, health grade, per-device
+    #     kernel ms, interesting flight-recorder tail) under
+    #     <systemPath>/_hyperspace_fleet through the LogStore seam —
+    #     the substrate of fleet_status()/fleet_metrics()/
+    #     doctor(fleet=True).  Off by default: publishing writes small
+    #     files on a cadence, an operator decision on metered storage.
+    #   - publishIntervalS: heartbeat cadence.
+    #   - staleAfterS: age past which a heartbeat counts as a
+    #     dead/hung process (fleet doctor crit); 0 derives 2x the
+    #     publish interval.
+    #   - pruneAfterS: age past which publishers garbage-collect a
+    #     dead process's heartbeat entirely.
+    fleet_telemetry_enabled: bool = False
+    fleet_publish_interval_s: float = 5.0
+    fleet_stale_after_s: float = 0.0
+    fleet_prune_after_s: float = 600.0
     # Build-pipeline profiler (telemetry/build_report.py): every action
     # run records per-phase wall time, bytes moved, spill counts, and
     # memory gauges into a BuildReport (Hyperspace.last_build_report()),
@@ -551,6 +580,11 @@ class HyperspaceConf:
         TIMELINE_MEMORY_SAMPLE_MS: "timeline_memory_sample_ms",
         DOCTOR_LATENCY_SLO_MS: "doctor_latency_slo_ms",
         DOCTOR_SHED_WARN_RATIO: "doctor_shed_warn_ratio",
+        DOCTOR_DEVICE_SKEW_WARN: "doctor_device_skew_warn",
+        FLEET_TELEMETRY_ENABLED: "fleet_telemetry_enabled",
+        FLEET_PUBLISH_INTERVAL_S: "fleet_publish_interval_s",
+        FLEET_STALE_AFTER_S: "fleet_stale_after_s",
+        FLEET_PRUNE_AFTER_S: "fleet_prune_after_s",
         BUILD_PROFILING_ENABLED: "build_profiling_enabled",
         PERF_LEDGER_ENABLED: "perf_ledger_enabled",
         PERF_LEDGER_MAX_ENTRIES: "perf_ledger_max_entries",

@@ -209,42 +209,111 @@ class Hyperspace:
         timeline.export_chrome_trace(path, span_roots=roots)
         return path
 
-    def doctor(self):
+    def doctor(self, fleet: bool = False):
         """One aggregated health report over everything the telemetry
         stack knows (telemetry/doctor.py): quarantine/containment state,
         per-index staleness via the lifecycle change detector, daemon
         failure backoffs, the perf-ledger trend, serving shed rate and
-        latency-SLO burn, degraded events — graded ok/warn/crit, worst
-        check wins, published as the ``health.status`` gauge.  Cheap
-        (stat-level listings and process counters only), also served by
-        the inline interop ``doctor`` verb so it works during
-        overload."""
+        latency-SLO burn, degraded events, per-device kernel-ms skew —
+        graded ok/warn/crit, worst check wins, published as the
+        ``health.status`` gauge.  Cheap (stat-level listings and
+        process counters only), also served by the inline interop
+        ``doctor`` verb so it works during overload.
+
+        ``fleet=True`` adds the CLUSTER checks over the published
+        heartbeats (telemetry/fleet.py): a stale heartbeat — a dead or
+        hung process — is crit, more than one lifecycle daemon warns,
+        the aggregate shed-ratio/SLO burn and cross-process/cross-device
+        kernel-ms skew grade over the MERGED counters; their worst grade
+        is published as ``health.fleet.status``."""
         from hyperspace_tpu.telemetry.doctor import doctor
 
-        return doctor(self.session)
+        return doctor(self.session, fleet=fleet)
 
     # -- flight recorder / diagnostics (docs/16-observability.md) -----------
-    def slow_queries(self) -> pa.Table:
+    def slow_queries(self, fleet: bool = False) -> pa.Table:
         """The flight recorder's retained ring as an arrow table, oldest
         first: slow (>= ``hyperspace.serving.flightRecorder.slowMs``),
         error, deadline-expired, and shed requests are always kept,
         healthy ones sampled 1-in-N.  Columns: ts, traceId, requestId,
         kind, outcome, latencyMs, queueWaitMs, slow, reason, error,
         recordJson (the full record: span tree + run report).  The same
-        table the interop ``slow_queries`` verb serves."""
+        table the interop ``slow_queries`` verb serves.
+
+        ``fleet=True`` federates across the fleet (telemetry/fleet.py):
+        the union of this process's ring, every published heartbeat's
+        interesting tail (live processes), and the persisted diagnostics
+        bundles (drained ones), deduplicated, with a ``process`` column
+        naming where each request ran."""
+        if fleet:
+            from hyperspace_tpu.telemetry.fleet import (
+                fleet_slow_queries_table,
+            )
+
+            return fleet_slow_queries_table(self.session.conf)
         from hyperspace_tpu.telemetry.flight_recorder import (
             slow_queries_table,
         )
 
         return slow_queries_table(self.session.conf)
 
-    def trace(self, trace_id: str):
+    def trace(self, trace_id: str, fleet: bool = False):
         """The full retained flight record (dict) for ``trace_id`` — the
         id every wire response echoes and every ``QueryFailedError``
-        carries — or None when no record for it is retained."""
+        carries — or None when no record for it is retained.
+        ``fleet=True`` resolves across the fleet too: the local ring
+        first, then every published heartbeat's interesting tail, then
+        the persisted diagnostics bundles — so a slow query served by
+        ANOTHER process is found from here by its echoed id."""
+        if fleet:
+            from hyperspace_tpu.telemetry.fleet import find_trace
+
+            return find_trace(self.session.conf, trace_id)
         from hyperspace_tpu.telemetry import flight_recorder
 
         return flight_recorder.recorder().find(trace_id.lower())
+
+    # -- fleet telemetry federation (docs/16-observability.md) ---------------
+    def fleet_status(self) -> pa.Table:
+        """Every published fleet heartbeat as an arrow table
+        (telemetry/fleet.py): process identity, host, pid, role
+        (``server``/``daemon``/``client``), last published health grade,
+        heartbeat age, freshness, and the carried snapshot.  The same
+        table the inline interop ``fleet_status`` verb serves — it works
+        during overload, exactly when an operator asks "which of my
+        servers is sick"."""
+        from hyperspace_tpu.telemetry.fleet import fleet_status_table
+
+        return fleet_status_table(self.session.conf)
+
+    def fleet_metrics(self) -> dict:
+        """The fleet-merged metrics view over every fresh heartbeat plus
+        this process's live registry: counters summed, gauges kept
+        per-process (``name -> {process: value}``), fixed-bucket
+        histograms merged by bucket-sum with exemplar carry.  Keys:
+        ``processes``, ``counters``, ``gauges``, ``histograms``
+        (docs/16-observability.md has the merge semantics)."""
+        from hyperspace_tpu.telemetry.fleet import fleet_metrics
+
+        return fleet_metrics(self.session.conf)
+
+    def start_fleet_telemetry(self):
+        """Start this session's heartbeat publisher thread
+        (``hyperspace.fleet.telemetry.enabled`` must be true; it
+        publishes every ``hyperspace.fleet.telemetry.publishIntervalS``
+        seconds).  Sessions, ``QueryServer``, and the lifecycle daemon
+        auto-start it when the conf gate is on — this is the explicit
+        handle for conf set after construction.  Returns the
+        :class:`~hyperspace_tpu.telemetry.fleet.FleetPublisher`."""
+        from hyperspace_tpu.telemetry.fleet import publisher_for
+
+        return publisher_for(self.session).start()
+
+    def stop_fleet_telemetry(self) -> None:
+        """Stop the heartbeat publisher thread (idempotent)."""
+        from hyperspace_tpu.telemetry.fleet import publisher_for
+
+        publisher_for(self.session).stop()
 
     def diagnostics(self) -> dict:
         """The live diagnostics bundle: the flight recorder's retained

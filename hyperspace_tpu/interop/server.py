@@ -757,12 +757,22 @@ def _serve_verb(session, spec: Dict[str, Any],
                                       trace id; the id every response
                                       echoes (``trace=``) and every
                                       client error carries
-      {"verb": "doctor"}           -> the aggregated health report
+      {"verb": "doctor",
+       "fleet"?: true}             -> the aggregated health report
                                       (telemetry/doctor.py): one row per
                                       check (columns check, status,
                                       summary, dataJson) plus the
                                       ``overall`` row — ok/warn/crit,
-                                      worst check wins
+                                      worst check wins; ``fleet`` adds
+                                      the cluster checks over the
+                                      published heartbeats
+      {"verb": "fleet_status"}     -> every published fleet heartbeat
+                                      (telemetry/fleet.py): process
+                                      identity, role, health grade,
+                                      heartbeat age, freshness — the
+                                      "which of my servers is sick"
+                                      surface, answering inline so it
+                                      works during overload
       {"verb": "lifecycle"}        -> the lifecycle decision journal
                                       (lifecycle/journal.py): every
                                       maintenance-daemon decision —
@@ -855,15 +865,22 @@ def _serve_verb(session, spec: Dict[str, Any],
     if verb == "doctor":
         from hyperspace_tpu.telemetry.doctor import doctor
 
-        return doctor(session).table()
+        fleet = spec.get("fleet", False)
+        if not isinstance(fleet, bool):
+            raise ValueError('"fleet" must be a boolean')
+        return doctor(session, fleet=fleet).table()
+    if verb == "fleet_status":
+        from hyperspace_tpu.telemetry.fleet import fleet_status_table
+
+        return fleet_status_table(session.conf)
     if verb == "lifecycle":
         from hyperspace_tpu.lifecycle.journal import history_table
 
         return history_table(session.conf)
     raise ValueError(f"Unknown verb {verb!r}; expected metrics, "
                      f"last_run_report, workload, perf_history, "
-                     f"build_report, slow_queries, trace, doctor, or "
-                     f"lifecycle")
+                     f"build_report, slow_queries, trace, doctor, "
+                     f"fleet_status, or lifecycle")
 
 
 def _is_loopback(host: str) -> bool:
@@ -1027,6 +1044,13 @@ class QueryServer:
         return self._server.server_address
 
     def start(self) -> "QueryServer":
+        # A serving process publishes role "server" in its fleet
+        # heartbeat (telemetry/fleet.py; conf-gated — maybe_start is a
+        # no-op with fleet telemetry off, and never raises).
+        from hyperspace_tpu.telemetry import fleet
+
+        fleet.set_process_role("server")
+        fleet.maybe_start(self.session)
         self._server.pool.start()
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         name="hs-query-server", daemon=True)
@@ -1067,6 +1091,14 @@ class QueryServer:
         from hyperspace_tpu.telemetry import flight_recorder
 
         flight_recorder.dump_diagnostics(self.session.conf)
+        # Deregister the fleet heartbeat: a drained server is a PLANNED
+        # exit, not a dead process — without this the fleet doctor would
+        # page crit on every rolling restart.  The diagnostics bundle
+        # above keeps the tail readable; SIGKILL skips this path, which
+        # is exactly how a genuinely dead process IS flagged.
+        from hyperspace_tpu.telemetry import fleet as _fleet
+
+        _fleet.publisher_for(self.session).stop()
         self._server.pool.stop()
         self._server.server_close()
         if self._thread is not None:
@@ -1125,17 +1157,30 @@ class MetricsScrapeServer:
     (metrics leak workload shape, file counts, index names via series
     values).
 
+    ``fleet=True`` (requires ``session``) serves the FLEET-merged
+    exposition instead (telemetry/fleet.py): every fresh published
+    heartbeat's series plus this process's live registry, each labeled
+    ``process="<id>"`` — one scrape target answers for the whole fleet,
+    and the label answers "which server is slow".
+
     >>> with MetricsScrapeServer(port=9109) as ms:
     ...     ...  # curl http://127.0.0.1:9109/metrics
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 allow_remote: bool = False) -> None:
+                 allow_remote: bool = False, session=None,
+                 fleet: bool = False) -> None:
         if not _is_loopback(host) and not allow_remote:
             raise ValueError(
                 f"MetricsScrapeServer binds {host!r}, a non-loopback "
                 f"interface, without authentication.  Pass "
                 f"allow_remote=True only behind a trusted boundary.")
+        if fleet and session is None:
+            raise ValueError(
+                "MetricsScrapeServer(fleet=True) needs session=... — the "
+                "merged exposition reads the fleet heartbeats under that "
+                "session's systemPath")
+        scrape_conf = session.conf if session is not None else None
         import http.server
 
         class _MetricsHandler(http.server.BaseHTTPRequestHandler):
@@ -1145,7 +1190,16 @@ class MetricsScrapeServer:
                     return
                 from hyperspace_tpu.telemetry import metrics as m
 
-                body = m.registry().render_prometheus().encode("utf-8")
+                if fleet:
+                    from hyperspace_tpu.telemetry.fleet import (
+                        render_fleet_prometheus,
+                    )
+
+                    body = render_fleet_prometheus(
+                        scrape_conf).encode("utf-8")
+                else:
+                    body = m.registry().render_prometheus() \
+                        .encode("utf-8")
                 self.send_response(200)
                 self.send_header("Content-Type",
                                  "text/plain; version=0.0.4; "

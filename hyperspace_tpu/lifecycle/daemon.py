@@ -92,6 +92,14 @@ class MaintenanceDaemon:
                 "yourself via Hyperspace.maintenance_cycle())")
         if self._thread is not None and self._thread.is_alive():
             return self
+        # A maintainer process publishes role "daemon" in its fleet
+        # heartbeat (telemetry/fleet.py) — the fleet doctor warns when
+        # MORE than one daemon runs over the same tree.  Conf-gated;
+        # maybe_start never raises.
+        from hyperspace_tpu.telemetry import fleet
+
+        fleet.set_process_role("daemon")
+        fleet.maybe_start(self.session)
         self._stop.clear()
         self._thread = threading.Thread(
             target=self._run, name="hs-lifecycle-daemon", daemon=True)

@@ -290,6 +290,8 @@ REQUIRED_BENCH_SPANS = (
     "bench.serving",
     "serve.request",
     "bench.flight_recorder",
+    "bench.fleet_obs",
+    "fleet.publish",
     "bench.ingest",
     "lifecycle.cycle",
     "bench.timeline",

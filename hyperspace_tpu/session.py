@@ -136,6 +136,14 @@ class HyperspaceSession:
         # whole-session question (the interop build_report verb reads it
         # from a server thread).
         self.last_build_report_value = None
+        # Fleet heartbeat publisher (telemetry/fleet.py): conf-gated off
+        # by default; when hyperspace.fleet.telemetry.enabled is set at
+        # construction the daemon thread starts here so every process
+        # of a fleet shows up in fleet_status() without extra wiring
+        # (conf set later goes through Hyperspace.start_fleet_telemetry).
+        from hyperspace_tpu.telemetry import fleet
+
+        fleet.maybe_start(self)
 
     @property
     def _lake_schema_memo(self) -> Optional[Dict[object, Dict[str, str]]]:

@@ -132,9 +132,13 @@ def _guarded(name: str, fn) -> DoctorCheck:
             name, "warn", f"check failed: {type(e).__name__}: {e}")
 
 
-def doctor(session) -> DoctorReport:
+def doctor(session, fleet: bool = False) -> DoctorReport:
     """Run every health check against ``session``'s index tree and this
-    process's telemetry; publish ``health.status``."""
+    process's telemetry; publish ``health.status``.  ``fleet=True``
+    additionally runs the CLUSTER checks over the published heartbeats
+    (telemetry/fleet.py: stale processes, duplicate lifecycle daemons,
+    aggregate shed/SLO burn, kernel-ms skew) and publishes their worst
+    grade as the ``health.fleet.status`` gauge."""
     from hyperspace_tpu.telemetry import metrics
     from hyperspace_tpu.telemetry.trace import span
 
@@ -147,10 +151,24 @@ def doctor(session) -> DoctorReport:
             _guarded("serving", lambda: _check_serving(session)),
             _guarded("degraded", lambda: _check_degraded(session)),
             _guarded("lint", lambda: _check_lint(session)),
+            _guarded("device_skew",
+                     lambda: _check_device_skew(session)),
         ]
-        report = DoctorReport(checks)
+        # health.status keeps grading the LOCAL process regardless of
+        # the fleet flag — a fleet-wide crit must not mask (or fake)
+        # this process's own state on the single-process gauge.
+        local = DoctorReport(checks)
         metrics.inc("doctor.runs")
-        metrics.set_gauge("health.status", SEVERITY[report.status])
+        metrics.set_gauge("health.status", SEVERITY[local.status])
+        if fleet:
+            from hyperspace_tpu.telemetry import fleet as _fleet
+
+            fleet_part = _fleet.fleet_checks(session)
+            worst = max((SEVERITY[c.status] for c in fleet_part),
+                        default=0)
+            metrics.set_gauge("health.fleet.status", worst)
+            checks = checks + fleet_part
+        report = DoctorReport(checks)
         sp.set(status=report.status, checks=len(checks))
         return report
 
@@ -367,6 +385,35 @@ def _check_lint(session, path: Optional[str] = None) -> DoctorCheck:
             f"should stay empty)",
             {"written": written_version, "current": CATALOG_VERSION})
     return DoctorCheck("lint", "ok", "baseline empty and current", {})
+
+
+def _check_device_skew(session) -> DoctorCheck:
+    """Single-process mesh-straggler check: max/median ratio over the
+    per-device attributed kernel-ms counters
+    (``exec.device.<id>.kernel_ms``, PR 14) graded against
+    ``hyperspace.doctor.deviceSkewWarn`` — a straggler device is
+    visible without a fleet (the fleet.skew check extends the same
+    grading across processes)."""
+    from hyperspace_tpu.telemetry import fleet, metrics
+
+    warn_at = float(getattr(session.conf, "doctor_device_skew_warn",
+                            4.0))
+    typed = metrics.registry().typed_snapshot()
+    per_device = fleet.device_kernel_ms_map(typed["counters"])
+    ratio = fleet.skew_ratio(list(per_device.values()))
+    data = {"per_device_ms": {k: round(v, 1)
+                              for k, v in sorted(per_device.items())},
+            "ratio": round(ratio, 2)}
+    if warn_at > 0 and ratio >= warn_at:
+        return DoctorCheck(
+            "device_skew", "warn",
+            f"per-device kernel-ms skew: max/median {ratio:.1f} >= "
+            f"{warn_at:g} — one device is a straggler (check the mesh "
+            f"busy matrix, docs/16-observability.md)", data)
+    return DoctorCheck(
+        "device_skew", "ok",
+        f"{len(per_device)} device(s) attributed, no kernel-ms skew",
+        data)
 
 
 def _check_degraded(session) -> DoctorCheck:

@@ -134,6 +134,27 @@ class MetricsRegistry:
             self._gauges.clear()
             self._histograms.clear()
 
+    def typed_snapshot(self) -> Dict[str, Dict[str, object]]:
+        """Point-in-time snapshot SPLIT by series kind — the shape fleet
+        federation needs: merging counters by sum and gauges per-process
+        (telemetry/fleet.py) is only possible when the reader can tell
+        them apart, which the flat :meth:`snapshot` cannot.  Histogram
+        dicts additionally carry ``exemplars`` (bucket index →
+        ``(trace_id, value)``) so the merged fleet exposition keeps its
+        p99→trace links."""
+        with self._lock:
+            out: Dict[str, Dict[str, object]] = {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "histograms": {},
+            }
+            for name, h in self._histograms.items():
+                snap = h.snapshot()
+                snap["exemplars"] = {str(i): list(ex)
+                                     for i, ex in h.exemplars.items()}
+                out["histograms"][name] = snap
+            return out
+
     def snapshot(self) -> Dict[str, object]:
         """Point-in-time dict of every series, plus the derived ratios the
         catalog promises (``cache.device.hit_ratio``)."""
@@ -225,6 +246,12 @@ def _catalog_help():
         return None
 
     return lookup
+
+
+def help_lookup():
+    """Public handle on the docs/16 HELP lookup (the fleet exposition in
+    telemetry/fleet.py renders the same catalog text per process)."""
+    return _catalog_help()
 
 
 # One registry per process: the subsystems it observes (device cache, IO
