@@ -1048,8 +1048,8 @@ SECTION_NAMES = ("setup", "sf1_queries", "device_agg_probe",
                  "calibration", "telemetry_overhead", "advisor",
                  "integrity", "build_profile", "timeline",
                  "build_pipeline", "multichip", "serving",
-                 "flight_recorder", "fleet_obs", "ingest", "sf10",
-                 "sf100")
+                 "flight_recorder", "fleet_obs", "fleet", "ingest",
+                 "sf10", "sf100")
 
 
 def main() -> int:
@@ -1107,6 +1107,7 @@ def main() -> int:
             harness.section("flight_recorder",
                             lambda: _sec_flight_recorder(ctx))
             harness.section("fleet_obs", lambda: _sec_fleet_obs(ctx))
+            harness.section("fleet", lambda: _sec_fleet(ctx))
             harness.section("ingest", lambda: _sec_ingest(root))
             harness.section("sf10", lambda: _sec_sf10(ctx, root, harness))
             harness.section("sf100", lambda: _sec_sf100(ctx, root, harness))
@@ -2828,6 +2829,7 @@ def _sec_fleet_obs(ctx: dict) -> dict:
 
     from hyperspace_tpu.interop.server import QueryClient, QueryServer
     from hyperspace_tpu.telemetry import fleet
+    from hyperspace_tpu.telemetry import metrics as _metrics
 
     _require(ctx, "session", "lineitem_dir")
     session = ctx["session"]
@@ -2885,20 +2887,40 @@ def _sec_fleet_obs(ctx: dict) -> dict:
             session.conf.fleet_telemetry_enabled = True
             session.conf.fleet_publish_interval_s = 0.2
             fleet.publisher_for(session).start()
+            pub0 = _metrics.registry().counter("fleet.publishes")
+            wall0 = time.perf_counter()
             t_on = _time(batch, repeats=reps)
+            on_wall = time.perf_counter() - wall0
+            n_pub = _metrics.registry().counter("fleet.publishes") - pub0
         overhead_pct = ((t_on["median"] - t_off["median"])
                         / t_off["median"] * 100.0)
         abs_ms = (t_on["median"] - t_off["median"]) * 1000.0 / reqs
+        # The A/B runs the publisher at a 25x-accelerated cadence (0.2s
+        # vs the 5s default) so several publishes land inside the
+        # measured window; the GATE is the steady-state cost at the
+        # DEFAULT cadence — per-publish cost derived from the measured
+        # delta and the observed publish count, amortized over the
+        # default interval.
+        frac = max(0.0, (t_on["median"] - t_off["median"])
+                   / t_on["median"])
+        rate = max(n_pub, 1) / max(on_wall, 1e-9)
+        ms_per_publish = frac * 1000.0 / rate
+        default_interval = 5.0
+        steady_pct = ms_per_publish / (default_interval * 1000.0) * 100.0
         out["publisher_off_s"] = _stat(t_off)
         out["publisher_on_s"] = _stat(t_on)
         out["requests_per_batch"] = reqs
         out["publisher_overhead_pct"] = round(overhead_pct, 2)
         out["publisher_overhead_ms_per_request"] = round(abs_ms, 3)
-        if overhead_pct > 3.0 and abs_ms > 2.0:
+        out["publisher_publishes_measured"] = int(n_pub)
+        out["publisher_ms_per_publish"] = round(ms_per_publish, 3)
+        out["publisher_steady_state_pct"] = round(steady_pct, 3)
+        if steady_pct > 3.0 and abs_ms > 2.0:
             raise SystemExit(
-                f"fleet_obs bench: publisher overhead "
-                f"{overhead_pct:.1f}% (> 3% and {abs_ms:.2f} "
-                f"ms/request) on the serving workload")
+                f"fleet_obs bench: publisher steady-state overhead "
+                f"{steady_pct:.2f}% at the default cadence (> 3%, "
+                f"{ms_per_publish:.1f} ms/publish) on the serving "
+                f"workload")
 
         # Federation: every subprocess publisher fresh in fleet_status,
         # merged counters carrying the per-process sums, and the
@@ -2941,6 +2963,190 @@ def _sec_fleet_obs(ctx: dict) -> dict:
         for p in procs:
             p.wait(timeout=30)
     return {"fleet_obs": out}
+
+
+def _sec_fleet(ctx: dict) -> dict:
+    """Serving fleet behind the front door (docs/20-fleet-serving.md):
+    THREE subprocess servers over the shared index tree behind
+    ``FleetQueryClient`` versus ONE server on the same concurrent
+    workload — aggregate QPS and p99 through the same client machinery
+    — then the failover drill: SIGKILL one server mid-burst.
+    Correctness-gated three ways: the fleet must beat the single
+    server's QPS at equal-or-better p99 (25% noise slack), the merged
+    fleet counters must account for the served requests, and the drill
+    must lose ZERO retryable requests — every post-kill answer
+    bit-equal, the retries visible as ``client.retry.*`` /
+    ``client.failover``."""
+    import subprocess as _subprocess
+    import threading
+
+    from hyperspace_tpu.interop import dataset_from_spec
+    from hyperspace_tpu.interop.server import FleetQueryClient
+    from hyperspace_tpu.telemetry import fleet
+    from hyperspace_tpu.telemetry import metrics as _metrics
+
+    _require(ctx, "session", "lineitem_dir")
+    session = ctx["session"]
+    session.enable_hyperspace()
+    li = ctx["lineitem_dir"]
+    # A medium-weight template: enough server-side compute that the
+    # fleet's extra processes matter, same answer every time so every
+    # response is bit-equal-checkable.
+    template = {"source": {"format": "parquet", "path": li},
+                "group_by": ["l_status"],
+                "aggs": {"q": ["l_quantity", "sum"],
+                         "p": ["l_extendedprice", "mean"]}}
+    expected = dataset_from_spec(session, dict(template)).collect()
+    expected = expected.sort_by("l_status")
+    n_clients = int(os.environ.get("HS_BENCH_FLEET_CLIENTS", 6))
+    reqs_per_client = int(os.environ.get("HS_BENCH_FLEET_REQS", 8))
+    system_path = session.conf.system_path
+    child_script = (
+        "import json, os, sys\n"
+        "from hyperspace_tpu import HyperspaceSession\n"
+        "from hyperspace_tpu.interop import QueryServer\n"
+        "s = HyperspaceSession(system_path=sys.argv[1])\n"
+        "s.conf.set('hyperspace.fleet.telemetry.enabled', True)\n"
+        "s.conf.set('hyperspace.fleet.telemetry.publishIntervalS', 0.2)\n"
+        "server = QueryServer(s).start()\n"
+        "print(json.dumps({'port': server.address[1],\n"
+        "                  'pid': os.getpid()}), flush=True)\n"
+        "server.drained.wait()\n")
+    saved_stale = session.conf.fleet_stale_after_s
+    out: dict = {}
+    procs: list = []
+    try:
+        env_vars = dict(os.environ, JAX_PLATFORMS="cpu")
+        for _ in range(3):
+            procs.append(_subprocess.Popen(
+                [sys.executable, "-c", child_script, system_path],
+                stdout=_subprocess.PIPE, stderr=_subprocess.DEVNULL,
+                text=True, env=env_vars))
+        children = [json.loads(p.stdout.readline()) for p in procs]
+        endpoints = [("127.0.0.1", c["port"]) for c in children]
+        # Warm every server before EITHER mode is timed: first contact
+        # pays dataset-open + compile per process, and the comparison is
+        # steady-state serving, not cold start.
+        from hyperspace_tpu.interop import request_query
+        for ep in endpoints:
+            request_query(ep, dict(template))
+            request_query(ep, dict(template))
+
+        def run_mode(eps) -> dict:
+            latencies: list = []
+            errors: list = []
+            lock = threading.Lock()
+            with FleetQueryClient(eps, conf=session.conf) as fc:
+                fc.query(dict(template))  # warm readers + routing
+
+                def client(ci: int) -> None:
+                    try:
+                        for _ in range(reqs_per_client):
+                            t0 = time.perf_counter()
+                            got = fc.query(dict(template))
+                            dt = time.perf_counter() - t0
+                            ok = got.sort_by("l_status").equals(expected)
+                            with lock:
+                                latencies.append(dt)
+                                if not ok:
+                                    errors.append(f"client {ci}: diverged")
+                    except Exception as e:  # noqa: BLE001 — gated below
+                        with lock:
+                            errors.append(f"client {ci}: "
+                                          f"{type(e).__name__}: {e}")
+
+                wall0 = time.perf_counter()
+                threads = [threading.Thread(target=client, args=(i,))
+                           for i in range(n_clients)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=max(120.0, SECTION_CAP_S or 120.0))
+                wall = time.perf_counter() - wall0
+                if any(t.is_alive() for t in threads):
+                    raise SystemExit("fleet bench: client threads hung")
+            if errors:
+                raise SystemExit(f"fleet bench: diverged answers/errors: "
+                                 f"{errors[:5]}")
+            lat = sorted(latencies)
+            return {"qps": len(lat) / wall,
+                    "p50_ms": lat[len(lat) // 2] * 1000.0,
+                    "p99_ms": lat[min(len(lat) - 1,
+                                      int(0.99 * len(lat)))] * 1000.0,
+                    "requests": len(lat)}
+
+        single = run_mode(endpoints[:1])
+        fleet_run = run_mode(endpoints)
+        out["single"] = {k: round(v, 2) for k, v in single.items()}
+        out["fleet"] = {k: round(v, 2) for k, v in fleet_run.items()}
+        out["qps_ratio"] = round(fleet_run["qps"] / single["qps"], 3)
+        out["p99_ratio"] = round(fleet_run["p99_ms"] / single["p99_ms"], 3)
+        # The scale-out gates only bind when server-side compute
+        # dominates the request (the real bench scale) AND the host has
+        # cores for 3 server processes to spread over — at toy scale
+        # (resilience tests) a request is connection-overhead-bound,
+        # and on a 1-core host 3 CPU-bound processes cannot beat 1
+        # (same convention as the multichip / build_pipeline gates);
+        # either way the ratios are recorded for --compare.
+        gated = N_LINEITEM >= 1_000_000 and (os.cpu_count() or 1) >= 4
+        out["scale_gated"] = gated
+        if gated and fleet_run["qps"] <= single["qps"]:
+            raise SystemExit(
+                f"fleet bench: 3 servers behind the front door did not "
+                f"beat one server's QPS ({fleet_run['qps']:.1f} vs "
+                f"{single['qps']:.1f})")
+        if gated and fleet_run["p99_ms"] > single["p99_ms"] * 1.25:
+            raise SystemExit(
+                f"fleet bench: fleet p99 {fleet_run['p99_ms']:.0f} ms "
+                f"regressed past the single server's "
+                f"{single['p99_ms']:.0f} ms (+25% slack)")
+        # The merged fleet counters must account for the served load —
+        # the aggregate-QPS claim is backed by fleet_metrics(), not just
+        # client-side stopwatches.
+        session.conf.fleet_stale_after_s = 10.0
+        time.sleep(0.6)  # let the final 0.2s-interval heartbeats land
+        merged = fleet.fleet_metrics(session.conf)
+        served = merged["counters"].get("serve.ok", 0.0)
+        expected_min = single["requests"] + fleet_run["requests"]
+        if served < expected_min:
+            raise SystemExit(
+                f"fleet bench: merged serve.ok {served:.0f} below the "
+                f"{expected_min} requests the client drove")
+        out["merged_serve_ok"] = int(served)
+
+        # Failover drill: SIGKILL one server, then a burst through the
+        # front door — zero retryable requests lost, bit-equal answers.
+        retry0 = _metrics.registry().counter("client.retry")
+        fail0 = _metrics.registry().counter("client.failover")
+        drill_reqs = 30
+        with FleetQueryClient(endpoints, conf=session.conf) as fc:
+            fc.query(dict(template))
+            os.kill(children[0]["pid"], signal.SIGKILL)
+            procs[0].wait(timeout=30)
+            t0 = time.perf_counter()
+            for _ in range(drill_reqs):
+                got = fc.query(dict(template))
+                if not got.sort_by("l_status").equals(expected):
+                    raise SystemExit("fleet bench: failover drill "
+                                     "returned a diverged answer")
+            drill_wall = time.perf_counter() - t0
+        retries = _metrics.registry().counter("client.retry") - retry0
+        failovers = _metrics.registry().counter("client.failover") - fail0
+        if failovers < 1:
+            raise SystemExit(
+                "fleet bench: SIGKILL mid-burst drove zero failovers — "
+                "the drill never exercised the retry path")
+        out["drill"] = {"requests": drill_reqs, "lost": 0,
+                        "retries": int(retries),
+                        "failovers": int(failovers),
+                        "qps": round(drill_reqs / drill_wall, 2)}
+    finally:
+        session.conf.fleet_stale_after_s = saved_stale
+        for p in procs:
+            p.kill()
+        for p in procs:
+            p.wait(timeout=30)
+    return {"fleet": out}
 
 
 def _sec_ingest(root: str) -> dict:

@@ -97,6 +97,8 @@ SERVING_SHED_RSS_MB = "hyperspace.serving.shed.rssWatermarkMb"
 SERVING_SHED_QUEUE_WAIT_MS = "hyperspace.serving.shed.queueWaitWatermarkMs"
 SERVING_PLAN_CACHE_ENABLED = "hyperspace.serving.planCache.enabled"
 SERVING_PLAN_CACHE_BYTES = "hyperspace.serving.planCacheBytes"
+SERVING_IO_MODE = "hyperspace.serving.ioMode"
+SERVING_TENANT_MAX_QUEUED = "hyperspace.serving.tenant.maxQueued"
 FLIGHT_RECORDER_ENABLED = "hyperspace.serving.flightRecorder.enabled"
 FLIGHT_RECORDER_MAX_RECORDS = "hyperspace.serving.flightRecorder.maxRecords"
 FLIGHT_RECORDER_SLOW_MS = "hyperspace.serving.flightRecorder.slowMs"
@@ -111,6 +113,8 @@ LIFECYCLE_FULL_CHURN_RATIO = "hyperspace.lifecycle.fullChurnRatio"
 LIFECYCLE_JOURNAL_MAX_ENTRIES = "hyperspace.lifecycle.journal.maxEntries"
 LIFECYCLE_BACKOFF_INITIAL_S = "hyperspace.lifecycle.backoff.initialS"
 LIFECYCLE_BACKOFF_MAX_S = "hyperspace.lifecycle.backoff.maxS"
+LIFECYCLE_LEASE_ENABLED = "hyperspace.lifecycle.lease.enabled"
+LIFECYCLE_LEASE_TTL_S = "hyperspace.lifecycle.lease.ttlS"
 FAULT_INJECTION_ENABLED = "hyperspace.system.faultInjection.enabled"
 FAULT_INJECTION_SITE = "hyperspace.system.faultInjection.site"
 FAULT_INJECTION_KIND = "hyperspace.system.faultInjection.kind"
@@ -452,6 +456,15 @@ class HyperspaceConf:
     #   - planCache.*: the optimize-result cache keyed by the advisor's
     #     structural plan fingerprint (execution/plan_cache.py), byte-
     #     budget LRU shared mechanism with the device column cache.
+    #   - ioMode: "threaded" (default — one handler thread per
+    #     connection) or "async" (one selector thread watches every
+    #     socket; workers still execute queries).  Bit-equal wire
+    #     behavior either way; async keeps the thread count flat under
+    #     thousands of mostly-idle connections.
+    #   - tenant.maxQueued: per-tenant cap on queued-or-running requests
+    #     (0 = off).  A hot tenant past its cap sheds retryable BUSY
+    #     (serve.shed.tenant) without consuming global queue depth, so
+    #     it degrades itself, not the fleet.
     serving_workers: int = 4
     serving_queue_depth: int = 16
     serving_max_connections: int = 64
@@ -463,6 +476,8 @@ class HyperspaceConf:
     serving_shed_queue_wait_watermark_ms: float = 0.0
     serving_plan_cache_enabled: bool = True
     serving_plan_cache_bytes: int = 64 << 20
+    serving_io_mode: str = "threaded"
+    serving_tenant_max_queued: int = 0
     # Request flight recorder (telemetry/flight_recorder.py;
     # docs/16-observability.md): a bounded ring of completed request
     # records with tail-based retention — slow (>= slowMs), error,
@@ -499,6 +514,11 @@ class HyperspaceConf:
     #     ``<systemPath>/_hyperspace_lifecycle`` (oldest pruned).
     #   - backoff.initialS/.maxS: per-index exponential backoff after a
     #     failed maintenance action (doubles per consecutive failure).
+    #   - lease.enabled/.ttlS: cross-process maintenance lease
+    #     (lifecycle/lease.py) through the LogStore CAS seam — exactly
+    #     one daemon per index tree executes maintenance; losers
+    #     idle-poll, a dead holder's lease expires after ttlS and is
+    #     taken over with an epoch bump that fences the zombie.
     lifecycle_enabled: bool = False
     lifecycle_interval_s: float = 30.0
     lifecycle_byte_budget: int = 0
@@ -507,6 +527,8 @@ class HyperspaceConf:
     lifecycle_journal_max_entries: int = 1024
     lifecycle_backoff_initial_s: float = 1.0
     lifecycle_backoff_max_s: float = 300.0
+    lifecycle_lease_enabled: bool = False
+    lifecycle_lease_ttl_s: float = 30.0
     # Deterministic fault injection (io/faults.py): fire ``kind`` at the
     # ``at``-th call of ``site``, ``count`` times.  Test-only machinery;
     # disabled costs one None check per file-level IO op.
@@ -602,6 +624,8 @@ class HyperspaceConf:
         SERVING_SHED_QUEUE_WAIT_MS: "serving_shed_queue_wait_watermark_ms",
         SERVING_PLAN_CACHE_ENABLED: "serving_plan_cache_enabled",
         SERVING_PLAN_CACHE_BYTES: "serving_plan_cache_bytes",
+        SERVING_IO_MODE: "serving_io_mode",
+        SERVING_TENANT_MAX_QUEUED: "serving_tenant_max_queued",
         FLIGHT_RECORDER_ENABLED: "flight_recorder_enabled",
         FLIGHT_RECORDER_MAX_RECORDS: "flight_recorder_max_records",
         FLIGHT_RECORDER_SLOW_MS: "flight_recorder_slow_ms",
@@ -615,6 +639,8 @@ class HyperspaceConf:
         LIFECYCLE_JOURNAL_MAX_ENTRIES: "lifecycle_journal_max_entries",
         LIFECYCLE_BACKOFF_INITIAL_S: "lifecycle_backoff_initial_s",
         LIFECYCLE_BACKOFF_MAX_S: "lifecycle_backoff_max_s",
+        LIFECYCLE_LEASE_ENABLED: "lifecycle_lease_enabled",
+        LIFECYCLE_LEASE_TTL_S: "lifecycle_lease_ttl_s",
         FAULT_INJECTION_ENABLED: "fault_injection_enabled",
         FAULT_INJECTION_SITE: "fault_injection_site",
         FAULT_INJECTION_KIND: "fault_injection_kind",

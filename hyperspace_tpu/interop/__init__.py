@@ -17,6 +17,7 @@ from hyperspace_tpu.interop.query import (
     valid_trace_id,
 )
 from hyperspace_tpu.interop.server import (
+    FleetQueryClient,
     QueryClient,
     QueryFailedError,
     QueryServer,
@@ -26,6 +27,6 @@ from hyperspace_tpu.interop.server import (
 )
 
 __all__ = ["dataset_from_spec", "expr_from_json", "mint_trace_id",
-           "pop_trace_context", "valid_trace_id", "QueryClient",
-           "QueryFailedError", "QueryServer", "ServerBusyError",
-           "parse_wire_error", "request_query"]
+           "pop_trace_context", "valid_trace_id", "FleetQueryClient",
+           "QueryClient", "QueryFailedError", "QueryServer",
+           "ServerBusyError", "parse_wire_error", "request_query"]

@@ -69,11 +69,12 @@ from __future__ import annotations
 import json
 import os
 import queue
+import random
 import socket
 import socketserver
 import threading
 import time
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import pyarrow as pa
 
@@ -198,6 +199,11 @@ def _classify_error(exc: BaseException) -> Tuple[str, str]:
 
     if isinstance(exc, WireError):
         return exc.code, exc.message
+    if isinstance(exc, QueryFailedError):
+        # A proxied upstream error keeps its code across this hop —
+        # BUSY stays retryable (and keeps its retry-after hint) through
+        # the front door instead of degrading to permanent FAILED.
+        return exc.code, exc.message
     if isinstance(exc, DeadlineExceededError):
         return ERR_DEADLINE, str(exc)
     if isinstance(exc, ValueError):
@@ -233,13 +239,15 @@ class _Job:
 
     __slots__ = ("fn", "kind", "deadline_at", "enqueued_t", "done",
                  "result", "error", "report", "abandoned",
-                 "trace_id", "request_id", "root_span", "queue_wait_ms")
+                 "trace_id", "request_id", "root_span", "queue_wait_ms",
+                 "tenant")
 
     def __init__(self, fn: Callable[[], pa.Table], kind: str,
                  deadline_at: Optional[float], trace_id: str = "",
-                 request_id: str = "") -> None:
+                 request_id: str = "", tenant: str = "") -> None:
         self.fn = fn
         self.kind = kind
+        self.tenant = tenant  # wire tenant id ("" = untagged)
         self.deadline_at = deadline_at  # absolute time.monotonic(), or None
         self.enqueued_t = time.monotonic()
         self.done = threading.Event()
@@ -277,6 +285,10 @@ class _WorkerPool:
         self._queue_wait_ewma_ms = 0.0
         self._rss_at = 0.0
         self._rss_mb = 0.0
+        # tenant id -> queued-or-active count, for the per-tenant quota
+        # (``hyperspace.serving.tenant.maxQueued``): a hot tenant sheds
+        # against ITS count while everyone else keeps being admitted.
+        self._tenant_queued: Dict[str, int] = {}
         self.draining = False
         self.workers = max(1, int(workers))
 
@@ -332,20 +344,58 @@ class _WorkerPool:
                        f"{wait_mark:.0f} ms; retry later")
         # Count BEFORE enqueueing: a worker can finish the job before this
         # thread resumes, and wait_idle must never observe a transient
-        # zero while work is genuinely in flight.
+        # zero while work is genuinely in flight.  The per-tenant quota
+        # rides the same critical section so a tenant's count and the
+        # global count can never disagree.
+        quota = int(getattr(conf, "serving_tenant_max_queued", 0))
+        tenant_over = False
         with self._lock:
-            self._queued_or_active += 1
+            if quota > 0 and job.tenant and \
+                    self._tenant_queued.get(job.tenant, 0) >= quota:
+                tenant_over = True
+            else:
+                self._queued_or_active += 1
+                if job.tenant:
+                    self._tenant_queued[job.tenant] = \
+                        self._tenant_queued.get(job.tenant, 0) + 1
+        if tenant_over:
+            metrics.inc(f"serve.tenant.{job.tenant}.shed")
+            self._shed("tenant",
+                       f"tenant {job.tenant!r} is at its queued quota "
+                       f"({quota}); retry later")
+        if job.tenant:
+            metrics.set_gauge(f"serve.tenant.{job.tenant}.queued",
+                              self._tenant_queued.get(job.tenant, 0))
         try:
             self._queue.put_nowait(job)
         except queue.Full:
             with self._idle:
                 self._queued_or_active -= 1
+                self._release_tenant(job)
                 self._idle.notify_all()
             self._shed("queue_full",
                        f"admission queue full "
                        f"(depth {self._queue.maxsize}); retry later")
         metrics.inc("serve.admitted")
         metrics.set_gauge("serve.queue_depth", self._queue.qsize())
+
+    def _release_tenant(self, job: _Job) -> None:
+        """Drop one from the job's tenant count (caller holds the lock)."""
+        if not job.tenant:
+            return
+        n = self._tenant_queued.get(job.tenant, 1) - 1
+        if n <= 0:
+            # hslint: allow[lock-discipline] caller holds self._idle/_lock
+            self._tenant_queued.pop(job.tenant, None)
+        else:
+            # hslint: allow[lock-discipline] caller holds self._idle/_lock
+            self._tenant_queued[job.tenant] = n
+
+    def tenant_snapshot(self) -> Dict[str, int]:
+        """tenant id -> queued-or-active right now (the ``tenants``
+        verb's live column)."""
+        with self._lock:
+            return dict(self._tenant_queued)
 
     # -- workers -----------------------------------------------------------
     def _run(self) -> None:
@@ -429,8 +479,14 @@ class _WorkerPool:
                 with self._idle:
                     self._active -= 1
                     self._queued_or_active -= 1
+                    self._release_tenant(job)
+                    tenant_left = self._tenant_queued.get(job.tenant, 0) \
+                        if job.tenant else 0
                     metrics.set_gauge("serve.inflight", self._active)
                     self._idle.notify_all()
+                if job.tenant:
+                    metrics.set_gauge(f"serve.tenant.{job.tenant}.queued",
+                                      tenant_left)
 
     def _record_flight(self, job: _Job) -> None:
         """One completed job → one flight-recorder offer (+ the latency
@@ -491,11 +547,22 @@ class _WorkerPool:
 
 
 # -- the connection handler ---------------------------------------------------
-class _Handler(socketserver.StreamRequestHandler):
-    timeout = REQUEST_TIMEOUT_S  # initial value; per-phase settimeout below
+class _Responder:
+    """The request→response engine shared by BOTH accept paths — the
+    threaded per-connection handler and the async event loop's
+    dispatchers: parse, verb-or-admit, stream the answer, classify
+    errors.  Subclasses provide ``server`` (the inner server state:
+    session / pool / plan_cache / proxy_client), ``connection`` (the
+    socket) and ``wfile`` (a buffered binary writer); everything else —
+    including the per-connection ``last_run_report`` contract — lives
+    here, which is what keeps the two io modes bit-equal on the
+    wire."""
 
-    def setup(self) -> None:
-        super().setup()
+    server: Any = None
+    connection: Any = None
+    wfile: Any = None
+
+    def _init_responder(self) -> None:
         # The most recent run report of a query served on THIS connection
         # (queries execute on pool workers, so the session's thread-local
         # cannot answer the last_run_report verb anymore).
@@ -504,37 +571,6 @@ class _Handler(socketserver.StreamRequestHandler):
         # admission): the error path uses it to tell "a worker owns this
         # request's flight record" from "record it here".
         self._cur_job = None
-
-    def handle(self) -> None:
-        # Pipelined: serve requests until EOF, idle timeout, or an error
-        # response (errors close the connection so framing stays
-        # unambiguous for simple clients).
-        while self._serve_one():
-            pass
-
-    def _serve_one(self) -> bool:
-        from hyperspace_tpu.telemetry import metrics
-
-        conf = self.server.session.conf
-        try:
-            self.connection.settimeout(
-                float(conf.serving_request_timeout_s))
-            line = self.rfile.readline(MAX_REQUEST_BYTES + 1)
-        except (TimeoutError, OSError):
-            return False
-        if not line:
-            return False  # clean EOF between requests
-        metrics.inc("serve.requests")
-        # The request is in flight from here until its response is fully
-        # written: drain()'s wait_idle blocks on this accounting, so a
-        # SIGTERM mid-stream cannot exit the process between the worker
-        # finishing a result and this thread flushing it (torn frame).
-        pool = self.server.pool
-        pool.request_started()
-        try:
-            return self._respond_one(line, conf)
-        finally:
-            pool.request_finished()
 
     def _respond_one(self, line: bytes, conf) -> bool:
         from hyperspace_tpu.interop.query import (
@@ -560,6 +596,14 @@ class _Handler(socketserver.StreamRequestHandler):
                 metrics.inc("serve.trace.adopted")
             else:
                 metrics.inc("serve.trace.minted")
+            # The session-scoped tenant id rides every request as a spec
+            # key; popped here so neither verbs nor the query decoders
+            # ever see it.  Quota enforcement happens at admission.
+            tenant = spec.pop("tenant", "")
+            if tenant is None:
+                tenant = ""
+            if not isinstance(tenant, str):
+                raise WireError(ERR_BADREQ, '"tenant" must be a string')
             is_verb = "verb" in spec
             if is_verb:
                 # Observability verbs answer INLINE on the connection
@@ -568,11 +612,12 @@ class _Handler(socketserver.StreamRequestHandler):
                 # an operator debugging an overload needs `metrics` most
                 # exactly then.
                 table = _serve_verb(self.server.session, spec,
-                                    self._last_report)
+                                    self._last_report,
+                                    pool=self.server.pool)
             else:
                 kind = "sql" if "sql" in spec else "spec"
-                table = self._execute_admitted(spec, conf,
-                                               trace_id, request_id)
+                table = self._execute_admitted(spec, conf, trace_id,
+                                               request_id, tenant)
         except Exception as exc:  # -> coded wire error, connection closes
             if trace_id is None:
                 trace_id, request_id = mint_trace_id(), mint_trace_id()
@@ -638,7 +683,8 @@ class _Handler(socketserver.StreamRequestHandler):
         return spec
 
     def _execute_admitted(self, spec: Dict[str, Any], conf,
-                          trace_id: str, request_id: str) -> pa.Table:
+                          trace_id: str, request_id: str,
+                          tenant: str = "") -> pa.Table:
         from hyperspace_tpu.exceptions import DeadlineExceededError
 
         deadline_ms = spec.pop("deadline_ms", None)
@@ -654,7 +700,7 @@ class _Handler(socketserver.StreamRequestHandler):
             else time.monotonic() + float(deadline_ms) / 1000.0
         fn, kind = self._make_query_fn(spec)
         job = _Job(fn, kind, deadline_at, trace_id=trace_id,
-                   request_id=request_id)
+                   request_id=request_id, tenant=tenant)
         self.server.pool.submit(job, conf)  # raises WireError(BUSY) = shed
         self._cur_job = job  # admitted: its worker owns the flight record
         if deadline_at is None:
@@ -684,6 +730,20 @@ class _Handler(socketserver.StreamRequestHandler):
         worker runs."""
         session = self.server.session
         plan_cache = self.server.plan_cache
+        proxy = getattr(self.server, "proxy_client", None)
+        if proxy is not None:
+            # Proxy mode: this server is a FRONT DOOR for non-Python
+            # clients — queries forward through the fleet client (load
+            # routing, failover, retry-after backoff) while verbs keep
+            # answering locally.  Shape validation is the backend's job;
+            # its coded errors come back as-is (_classify_error keeps
+            # the upstream code, so BUSY stays retryable end-to-end).
+            forward = dict(spec)
+
+            def run_proxy() -> pa.Table:
+                return proxy.query(forward)
+
+            return run_proxy, ("sql" if "sql" in spec else "spec")
         if "sql" in spec:
             # {"sql": "SELECT ...", "tables": {name: parquet_dir}} —
             # SQL text over the wire, the reference corpus's native
@@ -715,8 +775,323 @@ class _Handler(socketserver.StreamRequestHandler):
         return run_spec, "spec"
 
 
+class _Handler(_Responder, socketserver.StreamRequestHandler):
+    """The THREADED accept path's per-connection shell: blocking reads
+    with the idle timeout, one handler thread per connection."""
+
+    timeout = REQUEST_TIMEOUT_S  # initial value; per-phase settimeout below
+
+    def setup(self) -> None:
+        super().setup()
+        self._init_responder()
+
+    def handle(self) -> None:
+        # Pipelined: serve requests until EOF, idle timeout, or an error
+        # response (errors close the connection so framing stays
+        # unambiguous for simple clients).
+        while self._serve_one():
+            pass
+
+    def _serve_one(self) -> bool:
+        from hyperspace_tpu.telemetry import metrics
+
+        conf = self.server.session.conf
+        try:
+            self.connection.settimeout(
+                float(conf.serving_request_timeout_s))
+            line = self.rfile.readline(MAX_REQUEST_BYTES + 1)
+        except (TimeoutError, OSError):
+            return False
+        if not line:
+            return False  # clean EOF between requests
+        metrics.inc("serve.requests")
+        # The request is in flight from here until its response is fully
+        # written: drain()'s wait_idle blocks on this accounting, so a
+        # SIGTERM mid-stream cannot exit the process between the worker
+        # finishing a result and this thread flushing it (torn frame).
+        pool = self.server.pool
+        pool.request_started()
+        try:
+            return self._respond_one(line, conf)
+        finally:
+            pool.request_finished()
+
+
+class _AsyncResponder(_Responder):
+    """One async connection's responder: same engine, socket-backed
+    writer, reused across the connection's pipelined requests (the
+    ``last_run_report`` contract is per connection)."""
+
+    def __init__(self, server, sock: socket.socket) -> None:
+        self.server = server
+        self.connection = sock
+        self.wfile = sock.makefile("wb")
+        self._init_responder()
+
+
+class _AsyncConn:
+    """Selector-side state of one async connection."""
+
+    __slots__ = ("sock", "buf", "responder")
+
+    def __init__(self, server, sock: socket.socket) -> None:
+        self.sock = sock
+        self.buf = b""
+        self.responder = _AsyncResponder(server, sock)
+
+
+def _reject_connection(server, request: socket.socket) -> None:
+    """Answer ``ERR BUSY`` to a connection past the cap and close it —
+    shared by both accept paths, always bounded (1 s send timeout)."""
+    from hyperspace_tpu.interop.query import mint_trace_id
+    from hyperspace_tpu.telemetry import flight_recorder, metrics
+
+    metrics.inc("serve.shed")
+    metrics.inc("serve.shed.connections")
+    # No request line was read, so there is no client trace context to
+    # adopt — record the shed under minted ids so the tail still shows
+    # it happened.
+    flight_recorder.record(
+        server.session.conf, kind="unknown", outcome=ERR_BUSY,
+        latency_ms=0.0, trace_id=mint_trace_id(),
+        request_id=mint_trace_id(), error="connection capacity reached")
+    hint = server.pool.retry_after_hint_ms()
+    try:
+        request.settimeout(1.0)
+        request.sendall(
+            f"ERR {ERR_BUSY} connection capacity reached; "
+            f"retry later retry-after-ms={hint}\n".encode("utf-8"))
+    except OSError:
+        pass
+
+
+class _AsyncIOLoop:
+    """The selector accept path (``hyperspace.serving.ioMode=async``):
+    ONE event-loop thread owns accept plus request reads for EVERY
+    connection, so thousands of mostly-idle sockets cost one thread
+    instead of one each.  Complete request lines hand off to a small
+    dispatcher pool that runs the SAME :class:`_Responder` engine as the
+    threaded path — admission, verbs, deadlines, and error taxonomy are
+    shared code, which is what makes the two io modes bit-equal on the
+    wire.
+
+    Single-writer discipline, async flavor: while a response is in
+    flight its socket is UNREGISTERED from the selector — the
+    dispatcher is the connection's only writer, and the loop never
+    reads ahead of an unfinished response, so pipelining stays ordered
+    and frames cannot tear.  Finished connections return through the
+    requeue + wakeup pipe (the loop thread owns all selector state).
+
+    The event loop itself must never block: hslint's
+    blocking-discipline rule covers ``_event_loop`` / ``_on_accept`` /
+    ``_on_readable`` / ``_on_wakeup`` exactly like the threaded accept
+    loop, so a store read or a sleep slipping in fails the lint, not
+    production."""
+
+    def __init__(self, outer: "QueryServer", server) -> None:
+        import selectors
+
+        self._outer = outer
+        self._server = server
+        self._sel = selectors.DefaultSelector()
+        self._listener: socket.socket = server.socket
+        self._ready: "queue.Queue" = queue.Queue()
+        self._requeue: "queue.Queue" = queue.Queue()
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._stop = threading.Event()
+        self._loop_thread: Optional[threading.Thread] = None
+        self._dispatchers: list = []
+        self._conns: set = set()  # loop-thread-owned
+
+    def start(self) -> None:
+        self._listener.setblocking(False)
+        self._wake_r.setblocking(False)
+        self._sel.register(self._listener, _read_event(), "accept")
+        self._sel.register(self._wake_r, _read_event(), "wakeup")
+        self._loop_thread = threading.Thread(
+            target=self._event_loop, name="hs-serve-io", daemon=True)
+        self._loop_thread.start()
+        # Concurrent responses are bounded by the dispatcher count: the
+        # pool's workers plus headroom so inline verbs keep answering
+        # while every worker slot is executing.
+        n = self._server.pool.workers + 4
+        for i in range(n):
+            t = threading.Thread(target=self._dispatch,
+                                 name=f"hs-serve-dispatch-{i}",
+                                 daemon=True)
+            t.start()
+            self._dispatchers.append(t)
+
+    # -- the event loop (block-free; see hslint blocking-discipline) --------
+    def _event_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                events = self._sel.select(timeout=0.2)
+            except OSError:
+                continue
+            for key, _mask in events:
+                tag = key.data
+                if tag == "accept":
+                    self._on_accept()
+                elif tag == "wakeup":
+                    self._on_wakeup()
+                else:
+                    self._on_readable(tag)
+
+    def _on_accept(self) -> None:
+        try:
+            sock, _addr = self._listener.accept()
+        except OSError:
+            return
+        if not self._outer._acquire_conn():
+            # Reject IN the loop, bounded send — same contract as the
+            # threaded accept loop's early ERR BUSY.
+            _reject_connection(self._server, sock)
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return
+        sock.setblocking(False)
+        conn = _AsyncConn(self._server, sock)
+        self._conns.add(conn)
+        self._sel.register(sock, _read_event(), conn)
+
+    def _on_readable(self, conn: _AsyncConn) -> None:
+        try:
+            data = conn.sock.recv(65536)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            self._drop(conn, registered=True)
+            return
+        if not data:
+            self._drop(conn, registered=True)  # clean EOF
+            return
+        conn.buf += data
+        if b"\n" in conn.buf or len(conn.buf) > MAX_REQUEST_BYTES:
+            self._sel.unregister(conn.sock)
+            self._hand_off(conn)
+
+    def _on_wakeup(self) -> None:
+        try:
+            while self._wake_r.recv(4096):
+                pass
+        except (BlockingIOError, InterruptedError):
+            pass
+        except OSError:
+            return
+        while True:
+            try:
+                conn, keep = self._requeue.get_nowait()
+            except queue.Empty:
+                break
+            if not keep or self._stop.is_set():
+                self._drop(conn, registered=False)
+            elif b"\n" in conn.buf:
+                # The client pipelined ahead: the next request is already
+                # buffered, so no readiness event will ever fire for it.
+                self._hand_off(conn)
+            else:
+                try:
+                    conn.sock.setblocking(False)
+                    self._sel.register(conn.sock, _read_event(), conn)
+                except (OSError, ValueError):
+                    self._drop(conn, registered=False)
+
+    def _hand_off(self, conn: _AsyncConn) -> None:
+        line, sep, rest = conn.buf.partition(b"\n")
+        conn.buf = rest
+        self._ready.put_nowait((conn, line + sep))
+
+    def _drop(self, conn: _AsyncConn, registered: bool) -> None:
+        if conn not in self._conns:
+            return
+        self._conns.discard(conn)
+        if registered:
+            try:
+                self._sel.unregister(conn.sock)
+            except (KeyError, ValueError):
+                pass
+        try:
+            conn.responder.wfile.close()
+        except OSError:
+            pass
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+        self._outer._release_conn()
+
+    # -- dispatchers (one response at a time per connection) -----------------
+    def _dispatch(self) -> None:
+        from hyperspace_tpu.telemetry import metrics
+
+        while True:
+            item = self._ready.get()
+            if item is None:
+                return
+            conn, line = item
+            pool = self._server.pool
+            metrics.inc("serve.requests")
+            pool.request_started()
+            keep = False
+            try:
+                keep = conn.responder._respond_one(
+                    line, self._server.session.conf)
+            except Exception:  # noqa: BLE001 — a dispatcher must survive
+                keep = False   # anything a response path can throw
+            finally:
+                pool.request_finished()
+            self._requeue.put((conn, keep))
+            try:
+                self._wake_w.send(b"x")
+            except OSError:
+                pass
+
+    # -- lifecycle -----------------------------------------------------------
+    def stop_accepting(self) -> None:
+        """Phase one of drain/stop: end the event loop (no new accepts,
+        no new request reads).  In-flight dispatcher responses keep
+        running — wait_idle covers them."""
+        self._stop.set()
+        try:
+            self._wake_w.send(b"x")
+        except OSError:
+            pass
+        if self._loop_thread is not None:
+            self._loop_thread.join(timeout=5)
+            self._loop_thread = None
+
+    def close(self) -> None:
+        """Phase two: stop dispatchers and close every connection."""
+        self.stop_accepting()
+        for _ in self._dispatchers:
+            self._ready.put(None)
+        for t in self._dispatchers:
+            t.join(timeout=5)
+        self._dispatchers.clear()
+        for conn in list(self._conns):
+            self._drop(conn, registered=True)
+        try:
+            self._sel.close()
+        except OSError:
+            pass
+        for s in (self._wake_r, self._wake_w):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+def _read_event() -> int:
+    import selectors
+
+    return selectors.EVENT_READ
+
+
 def _serve_verb(session, spec: Dict[str, Any],
-                last_report=None) -> pa.Table:
+                last_report=None, pool=None) -> pa.Table:
     """Non-query verbs of the wire protocol:
 
       {"verb": "metrics"}          -> (name, value) rows: counters/gauges
@@ -780,6 +1155,17 @@ def _serve_verb(session, spec: Dict[str, Any],
                                       build/drop, backoff skip, or "did
                                       nothing, here's why" — oldest
                                       first (docs/19-lifecycle.md)
+      {"verb": "tenants"}          -> per-tenant admission state: one
+                                      row per tenant id seen (columns
+                                      tenant, queued, shed) — ``queued``
+                                      is the live queued-or-active
+                                      count the quota
+                                      (``hyperspace.serving.tenant
+                                      .maxQueued``) grades, ``shed`` the
+                                      tenant's lifetime quota sheds;
+                                      answers inline, so a hot tenant's
+                                      operator can see themselves
+                                      shedding while it happens
 
     ``slow_queries`` and ``trace`` answer inline like ``metrics`` — an
     operator debugging an overloaded server needs exactly them while the
@@ -877,10 +1263,28 @@ def _serve_verb(session, spec: Dict[str, Any],
         from hyperspace_tpu.lifecycle.journal import history_table
 
         return history_table(session.conf)
+    if verb == "tenants":
+        from hyperspace_tpu.telemetry import metrics as m
+
+        queued = pool.tenant_snapshot() if pool is not None else {}
+        shed: Dict[str, float] = {}
+        prefix, suffix = "serve.tenant.", ".shed"
+        for name, value in m.snapshot().items():
+            if name.startswith(prefix) and name.endswith(suffix) \
+                    and not isinstance(value, dict):
+                shed[name[len(prefix):-len(suffix)]] = float(value)
+        tenants = sorted(set(queued) | set(shed))
+        return pa.table({
+            "tenant": pa.array(tenants, type=pa.string()),
+            "queued": pa.array([int(queued.get(t, 0)) for t in tenants],
+                               type=pa.int64()),
+            "shed": pa.array([int(shed.get(t, 0)) for t in tenants],
+                             type=pa.int64()),
+        })
     raise ValueError(f"Unknown verb {verb!r}; expected metrics, "
                      f"last_run_report, workload, perf_history, "
                      f"build_report, slow_queries, trace, doctor, "
-                     f"fleet_status, or lifecycle")
+                     f"fleet_status, lifecycle, or tenants")
 
 
 def _is_loopback(host: str) -> bool:
@@ -910,11 +1314,23 @@ class QueryServer:
     that runs :meth:`drain` in the background: stop accepting, let
     in-flight requests finish within ``hyperspace.serving.drainGraceS``,
     then close — ``drained`` is set when the shutdown completes, so a
-    serving script can simply ``server.drained.wait()``."""
+    serving script can simply ``server.drained.wait()``.
+
+    ``hyperspace.serving.ioMode=async`` swaps the threaded accept path
+    for the selector event loop (:class:`_AsyncIOLoop`) — same wire
+    behavior, one io thread for every connection.
+
+    ``proxy_endpoints=[...]`` turns this server into a thin FRONT DOOR:
+    queries forward through a :class:`FleetQueryClient` over those
+    backends (least-loaded routing, failover, retry-after backoff), so
+    a non-Python client pointed at the proxy gets fleet fault tolerance
+    without reimplementing it; observability verbs still answer from
+    THIS process."""
 
     def __init__(self, session, host: str = "127.0.0.1",
                  port: int = 0, allow_remote: bool = False,
-                 handle_sigterm: bool = False) -> None:
+                 handle_sigterm: bool = False,
+                 proxy_endpoints: Optional[list] = None) -> None:
         # The server is UNAUTHENTICATED and reads any path the process can
         # access; binding a non-loopback interface exposes that to the
         # network.  Require the caller to say so explicitly.
@@ -936,32 +1352,7 @@ class QueryServer:
                     # Reject IN the accept loop — no handler thread is
                     # spawned, so a connection storm cannot grow the
                     # thread count past maxConnections + workers.
-                    from hyperspace_tpu.interop.query import mint_trace_id
-                    from hyperspace_tpu.telemetry import (
-                        flight_recorder,
-                        metrics,
-                    )
-
-                    metrics.inc("serve.shed")
-                    metrics.inc("serve.shed.connections")
-                    # No request line was read, so there is no client
-                    # trace context to adopt — record the shed under
-                    # minted ids so the tail still shows it happened.
-                    flight_recorder.record(
-                        self.session.conf, kind="unknown",
-                        outcome=ERR_BUSY, latency_ms=0.0,
-                        trace_id=mint_trace_id(),
-                        request_id=mint_trace_id(),
-                        error="connection capacity reached")
-                    hint = self.pool.retry_after_hint_ms()
-                    try:
-                        request.settimeout(1.0)
-                        request.sendall(
-                            f"ERR {ERR_BUSY} connection capacity reached; "
-                            f"retry later retry-after-ms={hint}\n"
-                            .encode("utf-8"))
-                    except OSError:
-                        pass
+                    _reject_connection(self, request)
                     self.shutdown_request(request)
                     return
                 super().process_request(request, client_address)
@@ -995,6 +1386,16 @@ class QueryServer:
                 ttl_s=float(conf.cache_expiry_seconds))
         else:
             self._server.plan_cache = None
+        self._server.proxy_client = (
+            FleetQueryClient(proxy_endpoints, conf=conf)
+            if proxy_endpoints else None)
+        self._io_mode = str(getattr(conf, "serving_io_mode",
+                                    "threaded")).strip().lower()
+        if self._io_mode not in ("threaded", "async"):
+            raise ValueError(
+                f"hyperspace.serving.ioMode must be 'threaded' or "
+                f"'async', got {self._io_mode!r}")
+        self._async: Optional[_AsyncIOLoop] = None
         self._max_connections = int(getattr(conf,
                                             "serving_max_connections", 64))
         self._conn_lock = threading.Lock()
@@ -1046,15 +1447,26 @@ class QueryServer:
     def start(self) -> "QueryServer":
         # A serving process publishes role "server" in its fleet
         # heartbeat (telemetry/fleet.py; conf-gated — maybe_start is a
-        # no-op with fleet telemetry off, and never raises).
+        # no-op with fleet telemetry off, and never raises).  The
+        # heartbeat carries this server's address so the front door can
+        # match fleet rows to endpoints, and a fresh start clears any
+        # draining flag a previous in-process server left behind.
         from hyperspace_tpu.telemetry import fleet
 
         fleet.set_process_role("server")
+        host, port = self.address[0], self.address[1]
+        fleet.set_serving_address(f"{host}:{port}")
+        fleet.set_serving_draining(False)
         fleet.maybe_start(self.session)
         self._server.pool.start()
-        self._thread = threading.Thread(target=self._server.serve_forever,
-                                        name="hs-query-server", daemon=True)
-        self._thread.start()
+        if self._io_mode == "async":
+            self._async = _AsyncIOLoop(self, self._server)
+            self._async.start()
+        else:
+            self._thread = threading.Thread(
+                target=self._server.serve_forever,
+                name="hs-query-server", daemon=True)
+            self._thread.start()
         return self
 
     def drain(self, grace_s: Optional[float] = None) -> bool:
@@ -1080,7 +1492,17 @@ class QueryServer:
         from hyperspace_tpu.lifecycle import daemon as _lifecycle_daemon
 
         _lifecycle_daemon.notify_drain()
-        if self._thread is not None:
+        # Flag the fleet heartbeat as draining and publish immediately:
+        # the front door skips draining rows, so new requests stop
+        # routing here DURING the grace window instead of shedding BUSY
+        # at the door (publish_once is fault-quiet / conf-gated).
+        from hyperspace_tpu.telemetry import fleet as _fleet
+
+        _fleet.set_serving_draining(True)
+        _fleet.publish_once(self.session.conf)
+        if self._async is not None:
+            self._async.stop_accepting()
+        elif self._thread is not None:
             self._server.shutdown()  # stop the accept loop
         clean = self._server.pool.wait_idle(grace_s)
         # Persist the flight recorder's ring (+ metrics snapshot +
@@ -1096,10 +1518,12 @@ class QueryServer:
         # page crit on every rolling restart.  The diagnostics bundle
         # above keeps the tail readable; SIGKILL skips this path, which
         # is exactly how a genuinely dead process IS flagged.
-        from hyperspace_tpu.telemetry import fleet as _fleet
-
         _fleet.publisher_for(self.session).stop()
         self._server.pool.stop()
+        if self._async is not None:
+            self._async.close()
+        if self._server.proxy_client is not None:
+            self._server.proxy_client.close()
         self._server.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5)
@@ -1132,6 +1556,10 @@ class QueryServer:
         if self._thread is not None:
             self._server.shutdown()
         self._server.pool.stop()
+        if self._async is not None:
+            self._async.close()
+        if self._server.proxy_client is not None:
+            self._server.proxy_client.close()
         self._server.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5)
@@ -1271,12 +1699,19 @@ class QueryClient:
     and echoes on the status line — so a failure is correlatable from
     either side: ``.last_trace_id`` after a call (and
     ``QueryFailedError.trace_id`` on errors) is the handle
-    ``slow_queries()`` / the ``trace`` verb answer for."""
+    ``slow_queries()`` / the ``trace`` verb answer for.
 
-    def __init__(self, address: Tuple[str, int]) -> None:
+    ``tenant`` stamps every spec sent on this connection with a tenant
+    id (the per-tenant admission key of
+    ``hyperspace.serving.tenant.maxQueued``); an explicit ``"tenant"``
+    key in a spec wins."""
+
+    def __init__(self, address: Tuple[str, int],
+                 tenant: Optional[str] = None) -> None:
         self._sock = socket.create_connection(address)
         self._f = self._sock.makefile("rb")
         self._broken = False
+        self.tenant = tenant
         #: trace id of the most recent query() — server-echoed when the
         #: server speaks the trace protocol, else the client-minted one.
         self.last_trace_id: Optional[str] = None
@@ -1292,6 +1727,8 @@ class QueryClient:
         if deadline_ms is not None:
             spec = {**spec, "deadline_ms": deadline_ms}
         if isinstance(spec, dict):
+            if self.tenant is not None and "tenant" not in spec:
+                spec = {**spec, "tenant": self.tenant}
             if "trace_id" not in spec:
                 spec = {**spec, "trace_id": mint_trace_id()}
             if "request_id" not in spec:
@@ -1334,6 +1771,273 @@ class QueryClient:
         self._sock.close()
 
     def __enter__(self) -> "QueryClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _as_address(endpoint) -> Tuple[str, int]:
+    """``"host:port"`` or ``(host, port)`` → ``(host, port)``."""
+    if isinstance(endpoint, str):
+        host, _, port = endpoint.rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(
+                f"endpoint {endpoint!r} is not 'host:port'")
+        return host, int(port)
+    host, port = endpoint
+    return str(host), int(port)
+
+
+class _Endpoint:
+    """One server behind the front door: its address, a small pool of
+    idle pipelined connections, and the router's view of it (in-flight
+    count, fleet-reported load, draining flag, penalty clock)."""
+
+    __slots__ = ("address", "label", "idle", "inflight", "penalized_until",
+                 "load", "draining", "fresh", "lock")
+
+    MAX_IDLE = 4  # idle pipelined connections kept per endpoint
+
+    def __init__(self, endpoint) -> None:
+        self.address = _as_address(endpoint)
+        self.label = f"{self.address[0]}:{self.address[1]}"
+        self.idle: List[QueryClient] = []
+        self.inflight = 0
+        self.penalized_until = 0.0   # monotonic; routing skips until then
+        self.load: Optional[float] = None  # fleet-reported queue+inflight
+        self.draining = False
+        self.fresh = True  # no fleet row ⇒ assume routable (fleet is opt-in)
+        self.lock = threading.Lock()
+
+    def acquire(self, tenant: Optional[str]) -> QueryClient:
+        """Pop an idle connection or dial a new one.  The connect happens
+        OUTSIDE the lock (it blocks); in-flight is rolled back when the
+        dial fails so a dead endpoint doesn't look busy forever."""
+        with self.lock:
+            self.inflight += 1
+            client = self.idle.pop() if self.idle else None
+        if client is not None:
+            client.tenant = tenant
+            return client
+        try:
+            return QueryClient(self.address, tenant=tenant)
+        except OSError:
+            with self.lock:
+                self.inflight -= 1
+            raise
+
+    def release(self, client: QueryClient) -> None:
+        with self.lock:
+            self.inflight -= 1
+            if len(self.idle) < self.MAX_IDLE:
+                self.idle.append(client)
+                return
+        client.close()
+
+    def discard(self, client: QueryClient) -> None:
+        with self.lock:
+            self.inflight -= 1
+        try:
+            client.close()
+        except OSError:
+            pass
+
+    def close_idle(self) -> None:
+        with self.lock:
+            idle, self.idle = self.idle, []
+        for client in idle:
+            try:
+                client.close()
+            except OSError:
+                pass
+
+
+class FleetQueryClient:
+    """Fault-tolerant front door over N :class:`QueryServer` endpoints.
+
+    Routing is LEAST-LOADED: when fleet telemetry is on
+    (``hyperspace.telemetry.fleet.enabled``), each server's heartbeat
+    carries its address plus ``serve.inflight``/``serve.queue_depth``
+    gauges and a ``draining`` flag; the router matches rows to endpoints
+    by address, skips draining/stale rows, and sends each request to the
+    least-loaded survivor (in-flight count breaks ties, round-robin
+    breaks the rest).  Without fleet rows every endpoint is assumed
+    routable and local in-flight counts carry the policy.
+
+    Failure policy (the docs/07-interop.md retry contract):
+
+      - RETRYABLE failures — ``BUSY``/``DEADLINE`` wire errors, plus
+        transport faults (connection refused / reset / EOF) — retry on a
+        DIFFERENT endpoint when one is available, with bounded jittered
+        exponential backoff; a ``retry-after-ms`` hint from the server
+        overrides the backoff step AND penalizes that endpoint for the
+        hinted window so the next pick avoids it.
+      - PERMANENT failures — ``BADREQ``/``FAILED`` — raise immediately;
+        re-running a malformed or failing request elsewhere just fails
+        N times.
+
+    Retries increment ``client.retry`` (+ ``client.retry.<kind>``);
+    a retry that lands on a different endpoint than the failed attempt
+    increments ``client.failover``.  ``tenant`` stamps every spec for
+    per-tenant admission on the servers.
+
+    >>> with FleetQueryClient(["127.0.0.1:9001", "127.0.0.1:9002"],
+    ...                       conf=session.conf) as fleet:
+    ...     fleet.query({"index": "idx", "point": {"id": 7}})
+    """
+
+    def __init__(self, endpoints: Sequence[Union[str, Tuple[str, int]]],
+                 conf=None, tenant: Optional[str] = None,
+                 max_attempts: Optional[int] = None,
+                 backoff_cap_ms: float = 2000.0,
+                 status_refresh_s: float = 1.0) -> None:
+        if not endpoints:
+            raise ValueError("FleetQueryClient needs at least one endpoint")
+        self._endpoints = [_Endpoint(e) for e in endpoints]
+        self._conf = conf
+        self._tenant = tenant
+        self._max_attempts = int(max_attempts if max_attempts is not None
+                                 else max(3, len(self._endpoints)))
+        self._backoff_cap_ms = float(backoff_cap_ms)
+        self._status_refresh_s = float(status_refresh_s)
+        self._status_stamp = 0.0  # monotonic; 0 forces a first refresh
+        self._rr = 0
+        self._lock = threading.Lock()  # guards _rr/_status_stamp ONLY —
+        # never held across connect/send/sleep (lint: lock-held-blocking)
+        #: trace id of the most recent query() — same contract as
+        #: :class:`QueryClient`.
+        self.last_trace_id: Optional[str] = None
+
+    # -- routing --------------------------------------------------------------
+    def _refresh_status(self) -> None:
+        """Fold fresh fleet heartbeats into the endpoint table (by the
+        ``address`` snapshot field).  Cheap-throttled; fault-quiet —
+        routing falls back to local in-flight counts on any failure."""
+        if self._conf is None:
+            return
+        now = time.monotonic()
+        with self._lock:
+            if now - self._status_stamp < self._status_refresh_s:
+                return
+            self._status_stamp = now
+        try:
+            from hyperspace_tpu.telemetry import fleet
+
+            rows = {}
+            for snap in fleet.fresh_snapshots(self._conf):
+                addr = str(snap.get("address", "") or "")
+                if addr:
+                    rows[addr] = snap
+        except Exception:  # noqa: BLE001 — telemetry must not break routing
+            return
+        for ep in self._endpoints:
+            snap = rows.get(ep.label)
+            if snap is None:
+                # No fresh row: leave it routable on local signals only
+                # (fleet telemetry may simply be off on that server).
+                ep.load = None
+                ep.draining = False
+                ep.fresh = True
+                continue
+            gauges = snap.get("metrics", {}).get("gauges", {})
+            ep.load = (float(gauges.get("serve.inflight", 0.0)) +
+                       float(gauges.get("serve.queue_depth", 0.0)))
+            ep.draining = bool(snap.get("draining", False))
+            ep.fresh = True
+
+    def _pick(self, tried: set) -> _Endpoint:
+        """Least-loaded routable endpoint not yet tried this request;
+        progressively relax (allow penalized, then tried) rather than
+        fail a pick while any endpoint exists."""
+        self._refresh_status()
+        now = time.monotonic()
+        healthy = [ep for ep in self._endpoints
+                   if ep.label not in tried and not ep.draining
+                   and now >= ep.penalized_until]
+        pool = (healthy
+                or [ep for ep in self._endpoints
+                    if ep.label not in tried and not ep.draining]
+                or [ep for ep in self._endpoints if ep.label not in tried]
+                or self._endpoints)
+
+        def _load(ep: _Endpoint) -> float:
+            base = ep.load if ep.load is not None else 0.0
+            return base + ep.inflight
+
+        low = min(_load(ep) for ep in pool)
+        ties = [ep for ep in pool if _load(ep) <= low]
+        with self._lock:
+            self._rr += 1
+            return ties[self._rr % len(ties)]
+
+    # -- request path ---------------------------------------------------------
+    def query(self, spec: Dict[str, Any],
+              deadline_ms: Optional[float] = None) -> pa.Table:
+        from hyperspace_tpu.telemetry import metrics
+
+        last_exc: Optional[Exception] = None
+        last_label: Optional[str] = None
+        tried: set = set()
+        for attempt in range(1, self._max_attempts + 1):
+            if len(tried) >= len(self._endpoints):
+                tried.clear()  # every endpoint failed once: start over
+            ep = self._pick(tried)
+            tried.add(ep.label)
+            if last_label is not None and last_label != ep.label:
+                # A retry routed AWAY from the endpoint that failed —
+                # the failover event the drill test counts.
+                metrics.inc("client.failover")
+            retry_after_ms: Optional[float] = None
+            kind = "connection"
+            try:
+                client = ep.acquire(self._tenant)
+            except OSError as exc:
+                last_exc = ConnectionError(
+                    f"connect to {ep.label} failed: {exc}")
+            else:
+                try:
+                    table = client.query(spec, deadline_ms=deadline_ms)
+                except QueryFailedError as exc:
+                    # The server closes the connection after an ERR.
+                    ep.discard(client)
+                    self.last_trace_id = exc.trace_id
+                    if not exc.retryable:
+                        raise  # BADREQ/FAILED: same answer everywhere
+                    kind = exc.code.lower()
+                    retry_after_ms = exc.retry_after_ms
+                    last_exc = exc
+                except (ConnectionError, OSError) as exc:
+                    ep.discard(client)
+                    last_exc = exc
+                else:
+                    ep.release(client)
+                    self.last_trace_id = client.last_trace_id
+                    return table
+            metrics.inc("client.retry")
+            metrics.inc(f"client.retry.{kind}")
+            last_label = ep.label
+            # Penalize the failed endpoint for the server's hinted
+            # window (or a nominal beat) so the next pick avoids it.
+            ep.penalized_until = time.monotonic() + \
+                (retry_after_ms or 100.0) / 1000.0
+            if attempt < self._max_attempts:
+                self._backoff(attempt, retry_after_ms)
+        raise last_exc  # type: ignore[misc]  # loop ran ≥ 1 attempt
+
+    def _backoff(self, attempt: int, retry_after_ms: Optional[float]) -> None:
+        """Jittered exponential backoff, honoring the server's
+        ``retry-after-ms`` hint as the step when present."""
+        step = retry_after_ms if retry_after_ms is not None \
+            else 50.0 * (2.0 ** (attempt - 1))
+        delay_ms = min(self._backoff_cap_ms, step) * (0.5 + random.random())
+        time.sleep(delay_ms / 1000.0)
+
+    def close(self) -> None:
+        for ep in self._endpoints:
+            ep.close_idle()
+
+    def __enter__(self) -> "FleetQueryClient":
         return self
 
     def __exit__(self, *exc) -> None:

@@ -39,7 +39,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from hyperspace_tpu.exceptions import HyperspaceError, NoChangesError
-from hyperspace_tpu.lifecycle import journal, policy
+from hyperspace_tpu.lifecycle import journal, lease as _lease, policy
 from hyperspace_tpu.lifecycle.change_detector import detect_changes
 
 # Process-global drain latch: a draining server must also park the
@@ -82,6 +82,9 @@ class MaintenanceDaemon:
         # candidate name -> advisor Candidate, for executing CREATE
         # decisions ranked earlier in the same cycle.
         self._pending_candidates: Dict[str, object] = {}
+        # Cross-process maintenance lease (lifecycle/lease.py),
+        # created lazily on the first lease-enabled cycle.
+        self._lease: Optional[_lease.MaintenanceLease] = None
 
     # -- the daemon thread ---------------------------------------------------
     def start(self) -> "MaintenanceDaemon":
@@ -111,6 +114,15 @@ class MaintenanceDaemon:
         if self._thread is not None:
             self._thread.join(timeout=timeout_s)
             self._thread = None
+        if self._lease is not None:
+            # Clean handoff: the next candidate takes over on its next
+            # poll instead of waiting out the TTL.
+            self._lease.release()
+
+    def lease(self) -> Optional[_lease.MaintenanceLease]:
+        """This daemon's lease handle, or None before the first
+        lease-enabled cycle (tests, fleet doctor)."""
+        return self._lease
 
     def backoff_snapshot(self) -> Dict[str, dict]:
         """Indexes currently in failure backoff (for ``doctor()``):
@@ -158,6 +170,21 @@ class MaintenanceDaemon:
                     outcome="skipped"))
                 sp.set(skipped=shed)
                 return out
+            if _lease.enabled(conf):
+                if self._lease is None:
+                    self._lease = _lease.MaintenanceLease(conf)
+                if not self._lease.ensure():
+                    # Standby: another daemon holds the maintenance
+                    # lease over this tree — idle-poll, never act.
+                    metrics.inc("lifecycle.skipped")
+                    holder = (_lease.status(conf) or {}).get("holder", "?")
+                    out.append(self._journal(
+                        policy.MaintenanceDecision(
+                            policy.KIND_NONE,
+                            reason=f"lease standby: held by {holder}"),
+                        outcome="skipped"))
+                    sp.set(skipped="lease standby")
+                    return out
             try:
                 entries = self.session.index_collection_manager \
                     .get_indexes([States.ACTIVE])

@@ -1,0 +1,240 @@
+"""Cross-process maintenance lease: exactly one daemon per index tree.
+
+N serving processes over one shared index tree each run a
+:class:`~hyperspace_tpu.lifecycle.daemon.MaintenanceDaemon`; without
+coordination they race the same refresh (wasted builds at best,
+optimistic-concurrency churn at worst).  The lease elects ONE executor
+through the PR 2 :class:`~hyperspace_tpu.io.log_store.LogStore` CAS
+seam — no new infrastructure, the same ``put_if_generation_match``
+primitive that arbitrates index-log ids, over both backends.
+
+One JSON record at ``<systemPath>/_hyperspace_lease/maintenance``:
+
+  ``{"v": 1, "holder": "<host>-<pid>-<start_ms>", "epoch": N,
+     "acquired_at": ts, "expires_at": ts}``
+
+Protocol (conf ``hyperspace.lifecycle.lease.enabled`` / ``.ttlS``):
+
+  - **Acquire**: read the record with its generation; if absent,
+    unparseable (a torn put burned the key), or expired past its
+    ``expires_at``, CAS a fresh record at the observed generation with
+    ``epoch + 1``.  CAS loss means another candidate won — idle-poll.
+  - **Renew**: the holder CASes a new ``expires_at`` against the
+    generation of its OWN last commit.  A renew that loses the CAS
+    means the lease was taken over while this process was paused,
+    swapped out, or partitioned: the holder is **fenced** — it must
+    treat itself as a loser immediately, never acting on the stale
+    epoch.  Wall-clock expiry is also checked locally, so a holder
+    that cannot reach the store stops acting after TTL even though
+    nobody fenced it yet.
+  - **Epoch fencing**: every takeover bumps ``epoch``; a zombie's
+    renew can never succeed (its generation is stale) and anything it
+    might stamp with its old epoch is distinguishable after the fact.
+  - **Release**: a stopping holder commits the record back with
+    ``expires_at = 0`` so the next candidate takes over on its next
+    poll instead of waiting out the TTL.
+
+Every acquire / takeover / renew / fence / release event lands in the
+lifecycle journal (decision kind ``lease``) — the same durable,
+cross-process record every other maintenance decision gets, and what
+the churn test asserts double-execution freedom against.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+LEASE_DIR = "_hyperspace_lease"
+LEASE_KEY = "maintenance"
+RECORD_VERSION = 1
+
+
+def enabled(conf) -> bool:
+    return bool(getattr(conf, "lifecycle_lease_enabled", False))
+
+
+def ttl_s(conf) -> float:
+    return max(0.1, float(getattr(conf, "lifecycle_lease_ttl_s", 30.0)))
+
+
+def lease_root(conf) -> str:
+    from hyperspace_tpu.index.path_resolver import PathResolver
+
+    return os.path.join(PathResolver(conf).system_path, LEASE_DIR)
+
+
+def _store(conf):
+    from hyperspace_tpu.telemetry.perf_ledger import store_for
+
+    return store_for(conf, lease_root(conf))
+
+
+def _parse(payload: Optional[bytes]) -> Optional[Dict[str, Any]]:
+    if not payload:
+        return None
+    try:
+        rec = json.loads(payload.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError):
+        return None  # torn put burned the key; treat as up for grabs
+    return rec if isinstance(rec, dict) else None
+
+
+def status(conf) -> Optional[Dict[str, Any]]:
+    """The current lease record (plus ``fresh``: not yet expired), or
+    None when absent/unreadable — what ``fleet.daemons`` grades
+    against.  Never raises."""
+    try:
+        payload, _gen = _store(conf).read_with_generation(LEASE_KEY)
+    except Exception:  # noqa: BLE001 — an unreadable lease reads absent
+        return None
+    rec = _parse(payload)
+    if rec is None:
+        return None
+    rec = dict(rec)
+    rec["fresh"] = float(rec.get("expires_at", 0.0)) > time.time()
+    return rec
+
+
+class MaintenanceLease:
+    """One process's handle on the maintenance lease.  All methods are
+    exception-safe towards "not holding": a store failure never
+    crashes the daemon, it just parks it this cycle."""
+
+    def __init__(self, conf, owner: Optional[str] = None) -> None:
+        from hyperspace_tpu.telemetry import fleet
+
+        self.conf = conf
+        self.owner = owner or fleet.process_identity()
+        self.epoch = 0
+        self._held = False
+        self._gen = 0            # generation of OUR last committed record
+        self._expires_at = 0.0   # local wall-clock view of our expiry
+
+    # -- state ---------------------------------------------------------------
+    def holds(self) -> bool:
+        """Held AND not past our own wall-clock expiry — a holder that
+        lost contact with the store must stop acting after TTL even
+        before anyone fences it."""
+        return self._held and time.time() < self._expires_at
+
+    # -- protocol ------------------------------------------------------------
+    def ensure(self) -> bool:
+        """The per-cycle entry point: renew when holding, otherwise try
+        to acquire.  True iff this process may execute maintenance."""
+        try:
+            if self._held:
+                return self.renew()
+            return self.try_acquire()
+        except Exception as e:  # noqa: BLE001 — a store failure parks the
+            # daemon for a cycle; it must never kill it.
+            self._note("error", outcome="error", error=str(e))
+            self._held = False
+            return False
+
+    def try_acquire(self) -> bool:
+        from hyperspace_tpu.telemetry import metrics
+
+        store = _store(self.conf)
+        payload, gen = store.read_with_generation(LEASE_KEY)
+        rec = _parse(payload)
+        now = time.time()
+        if rec is not None and float(rec.get("expires_at", 0.0)) > now:
+            return False  # live holder; idle-poll
+        prior_epoch = int(rec.get("epoch", 0)) if rec is not None else 0
+        takeover = rec is not None
+        if not store.put_if_generation_match(
+                LEASE_KEY, self._record(prior_epoch + 1, now), gen):
+            metrics.inc("lease.conflicts")
+            return False  # another candidate won this round
+        self.epoch = prior_epoch + 1
+        self._held = True
+        self._gen = gen + 1
+        self._expires_at = now + ttl_s(self.conf)
+        metrics.inc("lease.acquires")
+        if takeover:
+            metrics.inc("lease.takeovers")
+            self._note("takeover",
+                       reason=f"expired lease epoch {prior_epoch} "
+                              f"(holder {rec.get('holder', '?')}) taken "
+                              f"over as epoch {self.epoch}")
+        else:
+            self._note("acquire", reason=f"fresh lease, epoch {self.epoch}")
+        return True
+
+    def renew(self) -> bool:
+        from hyperspace_tpu.telemetry import metrics
+
+        if not self._held:
+            return False
+        store = _store(self.conf)
+        now = time.time()
+        if store.put_if_generation_match(
+                LEASE_KEY, self._record(self.epoch, now), self._gen):
+            self._gen += 1
+            self._expires_at = now + ttl_s(self.conf)
+            metrics.inc("lease.renews")
+            self._note("renew", reason=f"epoch {self.epoch}")
+            return True
+        # CAS lost: the lease moved under us while this process was
+        # paused/partitioned — we are FENCED.  Stop acting immediately;
+        # the next cycle competes as an ordinary candidate.
+        self._held = False
+        self._gen = 0
+        metrics.inc("lease.fenced")
+        self._note("fence", outcome="error",
+                   reason=f"renew lost the CAS at epoch {self.epoch}; "
+                          f"lease taken over — standing down")
+        return False
+
+    def release(self) -> None:
+        """Hand off cleanly: commit the record back expired so the next
+        candidate takes over on its next poll, not after a full TTL."""
+        from hyperspace_tpu.telemetry import metrics
+
+        if not self._held:
+            return
+        try:
+            store = _store(self.conf)
+            rec = self._record(self.epoch, time.time())
+            rec_d = json.loads(rec.decode("utf-8"))
+            rec_d["expires_at"] = 0.0
+            store.put_if_generation_match(
+                LEASE_KEY, json.dumps(rec_d).encode("utf-8"), self._gen)
+            metrics.inc("lease.releases")
+            self._note("release", reason=f"epoch {self.epoch} released")
+        except Exception as e:  # noqa: BLE001 — best-effort; TTL expiry
+            # is the backstop when release IO fails.
+            self._note("error", outcome="error", error=str(e))
+        finally:
+            self._held = False
+            self._gen = 0
+
+    # -- internals -----------------------------------------------------------
+    def _record(self, epoch: int, now: float) -> bytes:
+        return json.dumps({
+            "v": RECORD_VERSION,
+            "holder": self.owner,
+            "epoch": epoch,
+            "acquired_at": now,
+            "expires_at": now + ttl_s(self.conf),
+        }).encode("utf-8")
+
+    def _note(self, event: str, reason: str = "", outcome: str = "done",
+              error: str = "") -> None:
+        from hyperspace_tpu.lifecycle import journal
+
+        rec = {
+            "decision": "lease",
+            "index": "",
+            "mode": event,
+            "reason": reason,
+            "outcome": outcome,
+            "holder": self.owner,
+            "epoch": self.epoch,
+        }
+        if error:
+            rec["error"] = error[:500]
+        journal.append(self.conf, rec)

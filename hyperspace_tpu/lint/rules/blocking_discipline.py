@@ -175,6 +175,15 @@ class Rule:
             elif info.name == "_serve_verb":
                 contracts.append((info, True, True,
                                   "the inline-verb surface"))
+            elif info.name in ("_event_loop", "_on_accept",
+                               "_on_readable", "_on_wakeup"):
+                # The async accept path (serving.ioMode=async): ONE
+                # thread owns every connection's reads, so anything
+                # blocking here stalls the whole listener, not one
+                # connection.  Socket ops are allowed (non-blocking fds
+                # + the bounded reject send); stores and sleeps are not.
+                contracts.append((info, False, True,
+                                  "the async event loop"))
         for info, store_reads, bounded_send, label in contracts:
             hit = graph.find_path(
                 info.fid,

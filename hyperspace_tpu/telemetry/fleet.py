@@ -72,6 +72,12 @@ _ROLE_RANK = {"client": 0, "daemon": 1, "server": 2}
 _role = "client"
 _identity: Optional[str] = None
 _identity_lock = threading.Lock()
+# Serving-process state carried in the heartbeat so the front door
+# (interop/server.py FleetQueryClient) can map endpoints to rows and
+# skip draining servers during their grace window.  QueryServer
+# start()/drain() set these.
+_serving_address = ""
+_serving_draining = False
 
 
 def process_identity() -> str:
@@ -99,6 +105,22 @@ def set_process_role(role: str) -> None:
     global _role
     if _ROLE_RANK.get(role, -1) > _ROLE_RANK.get(_role, 0):
         _role = role
+
+
+def set_serving_address(address: str) -> None:
+    """The ``host:port`` this process serves on, carried in its
+    heartbeat so the front door can match fleet rows to endpoints."""
+    global _serving_address
+    _serving_address = str(address or "")
+
+
+def set_serving_draining(draining: bool) -> None:
+    """Flip the heartbeat's ``draining`` flag — ``QueryServer.drain``
+    sets it (and publishes immediately) so the front door stops
+    routing here DURING the grace window, not only after the final
+    deregister."""
+    global _serving_draining
+    _serving_draining = bool(draining)
 
 
 # -- conf accessors -----------------------------------------------------------
@@ -169,6 +191,8 @@ def build_snapshot(conf) -> Dict[str, Any]:
         "pid": os.getpid(),
         "role": process_role(),
         "health": typed["gauges"].get("health.status"),
+        "address": _serving_address,
+        "draining": _serving_draining,
         "metrics": typed,
         "device_kernel_ms": device_kernel_ms_map(typed["counters"]),
         "records": interesting[-FLEET_RECORDS_MAX:],
@@ -349,10 +373,12 @@ _HEALTH_NAMES = {0: "ok", 1: "warn", 2: "crit"}
 def fleet_status_table(conf):
     """One row per published heartbeat — the shape
     ``Hyperspace.fleet_status()`` and the inline ``fleet_status`` interop
-    verb serve.  Columns: process, host, pid, role, status (the
+    verb serve.  Columns: process, host, pid, role, address (the
+    serving ``host:port``, empty for non-servers), status (the
     process's last published ``health.status`` grade, empty before its
-    first ``doctor()``), ageSeconds, fresh, records (interesting
-    flight records carried), snapshotJson."""
+    first ``doctor()``), ageSeconds, fresh, draining (the server is in
+    its drain grace window — the front door skips it), records
+    (interesting flight records carried), snapshotJson."""
     import pyarrow as pa
 
     snaps = live_snapshots(conf)
@@ -374,12 +400,16 @@ def fleet_status_table(conf):
                         type=pa.int64()),
         "role": pa.array([str(s.get("role", "")) for s in snaps],
                          type=pa.string()),
+        "address": pa.array([str(s.get("address", "") or "")
+                             for s in snaps], type=pa.string()),
         "status": pa.array([health_name(s) for s in snaps],
                            type=pa.string()),
         "ageSeconds": pa.array([round(float(s.get("age_s", 0.0)), 3)
                                 for s in snaps], type=pa.float64()),
         "fresh": pa.array([float(s.get("age_s", 0.0)) <= cutoff
                            for s in snaps], type=pa.bool_()),
+        "draining": pa.array([bool(s.get("draining", False))
+                              for s in snaps], type=pa.bool_()),
         "records": pa.array([len(s.get("records") or [])
                              for s in snaps], type=pa.int64()),
         "snapshotJson": pa.array([json.dumps(s, default=str)
@@ -692,20 +722,63 @@ def _check_heartbeats(conf):
 
 
 def _check_daemons(conf):
+    from hyperspace_tpu.lifecycle import lease as _lease
     from hyperspace_tpu.telemetry.doctor import DoctorCheck
 
-    daemons = [str(s.get("process", "")) for s in fresh_snapshots(conf)
+    fresh = fresh_snapshots(conf)
+    daemons = [str(s.get("process", "")) for s in fresh
                if s.get("role") == "daemon"]
-    if len(daemons) > 1:
+    rec = _lease.status(conf)
+    if rec is None:
+        # No lease record: pre-lease behavior — concurrent maintainers
+        # are uncoordinated, flag them.
+        if len(daemons) > 1:
+            return DoctorCheck(
+                "fleet.daemons", "warn",
+                f"{len(daemons)} processes report the lifecycle-daemon "
+                f"role with no maintenance lease — concurrent "
+                f"maintainers waste work rebasing on each other (set "
+                f"hyperspace.lifecycle.lease.enabled=true to elect "
+                f"one)", {"daemons": daemons})
+        return DoctorCheck("fleet.daemons", "ok",
+                           f"{len(daemons)} lifecycle daemon(s) in the "
+                           f"fleet", {"daemons": daemons})
+    holder = str(rec.get("holder", ""))
+    epoch = int(rec.get("epoch", 0) or 0)
+    live = {str(s.get("process", "")) for s in fresh}
+    data = {"holder": holder, "epoch": epoch,
+            "lease_fresh": bool(rec.get("fresh")), "daemons": daemons}
+    if rec.get("fresh"):
+        if not live:
+            # Nobody heartbeats (fleet telemetry off or all clients):
+            # the lease alone proves single-execution; nothing to
+            # cross-check against.
+            return DoctorCheck(
+                "fleet.daemons", "ok",
+                f"maintenance lease epoch {epoch} held by {holder}; no "
+                f"fleet heartbeats to cross-check", data)
+        if holder in live:
+            standbys = max(0, len(daemons) - 1)
+            return DoctorCheck(
+                "fleet.daemons", "ok",
+                f"maintenance lease epoch {epoch} held by live process "
+                f"{holder} ({standbys} standby daemon(s))", data)
+        return DoctorCheck(
+            "fleet.daemons", "crit",
+            f"maintenance lease epoch {epoch} held by {holder}, which "
+            f"publishes no live heartbeat — the holder died holding "
+            f"the lease; takeover happens when it expires "
+            f"(ttl {_lease.ttl_s(conf):.0f}s)", data)
+    if daemons:
         return DoctorCheck(
             "fleet.daemons", "warn",
-            f"{len(daemons)} processes report the lifecycle-daemon "
-            f"role — concurrent maintainers waste work rebasing on "
-            f"each other (ROADMAP item 3's lease fixes this)",
-            {"daemons": daemons})
-    return DoctorCheck("fleet.daemons", "ok",
-                       f"{len(daemons)} lifecycle daemon(s) in the "
-                       f"fleet", {"daemons": daemons})
+            f"maintenance lease epoch {epoch} expired with "
+            f"{len(daemons)} candidate daemon(s) — takeover pending "
+            f"next poll", data)
+    return DoctorCheck(
+        "fleet.daemons", "ok",
+        f"maintenance lease epoch {epoch} expired and no daemons "
+        f"running", data)
 
 
 def _check_fleet_serving(conf):
