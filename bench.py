@@ -1048,8 +1048,8 @@ SECTION_NAMES = ("setup", "sf1_queries", "device_agg_probe",
                  "calibration", "telemetry_overhead", "advisor",
                  "integrity", "build_profile", "timeline",
                  "build_pipeline", "multichip", "serving",
-                 "flight_recorder", "fleet_obs", "fleet", "ingest",
-                 "sf10", "sf100")
+                 "flight_recorder", "fleet_obs", "fleet", "chaos",
+                 "ingest", "sf10", "sf100")
 
 
 def main() -> int:
@@ -1108,6 +1108,7 @@ def main() -> int:
                             lambda: _sec_flight_recorder(ctx))
             harness.section("fleet_obs", lambda: _sec_fleet_obs(ctx))
             harness.section("fleet", lambda: _sec_fleet(ctx))
+            harness.section("chaos", lambda: _sec_chaos())
             harness.section("ingest", lambda: _sec_ingest(root))
             harness.section("sf10", lambda: _sec_sf10(ctx, root, harness))
             harness.section("sf100", lambda: _sec_sf100(ctx, root, harness))
@@ -3147,6 +3148,79 @@ def _sec_fleet(ctx: dict) -> dict:
         for p in procs:
             p.wait(timeout=30)
     return {"fleet": out}
+
+
+def _sec_chaos() -> dict:
+    """Seeded wire-level chaos drill (interop/chaos.py): a reproducible
+    randomized schedule of SIGKILL + restart, SIGSTOP/SIGCONT gray
+    failures, and armed net faults against a 3-server fleet under
+    sustained mixed load.  Gated on the drill's own invariants — zero
+    lost requests, bit-equal answers, exactly-once maintenance,
+    consistent client.* accounting — plus the feature tax: with hedging,
+    breakers, and fault arming all DISABLED (the defaults), the client
+    path's per-request overhead from this machinery must stay under 3%
+    of the drill's clean p50."""
+    from hyperspace_tpu.interop import chaos as _chaos
+    from hyperspace_tpu.io import faults as _faults
+
+    seed = int(os.environ.get("HS_BENCH_CHAOS_SEED", 6))
+    duration = float(os.environ.get("HS_BENCH_CHAOS_DURATION_S", 6.0))
+    # The schedule is a pure function of the seed: assert that here so
+    # a chaos failure in CI reproduces from the printed seed alone.
+    if _chaos.build_schedule(seed, duration, 3) != \
+            _chaos.build_schedule(seed, duration, 3):
+        raise SystemExit("chaos bench: schedule is not deterministic")
+    report = _chaos.run_chaos(seed=seed, duration_s=duration, servers=3)
+    if not report["ok"]:
+        raise SystemExit(
+            f"chaos bench (seed {seed}): invariants violated: "
+            f"{report['violations']}")
+    if report["hedge_sent"] < 1:
+        raise SystemExit(
+            f"chaos bench (seed {seed}): the drill never hedged — the "
+            f"gray-failure path went unexercised")
+    if report["breaker_opens"] < 1:
+        raise SystemExit(
+            f"chaos bench (seed {seed}): no breaker ever opened — the "
+            f"schedule never drove consecutive failures")
+
+    # Feature tax with everything off (the shipped defaults): the new
+    # client-path work per request is the disarmed net.* checkpoints —
+    # one plan-is-None check per seam crossing, ~5 crossings per request
+    # (client connect amortized by pooling, then send + recv on the
+    # client and accept + send on the server).
+    reps = 200_000
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        _faults.net("net.send")
+    per_seam_ms = (time.perf_counter() - t0) * 1000.0 / reps
+    disabled_overhead_ms = per_seam_ms * 5
+    clean_p50 = max(0.001, report["clean_p50_ms"])
+    overhead_pct = 100.0 * disabled_overhead_ms / clean_p50
+    if overhead_pct >= 3.0:
+        raise SystemExit(
+            f"chaos bench: disarmed seam checkpoints cost "
+            f"{disabled_overhead_ms:.4f} ms/request, {overhead_pct:.2f}% "
+            f"of the clean p50 ({clean_p50:.2f} ms) — over the 3% gate")
+    return {"chaos": {
+        "seed": seed,
+        "events": len(report["schedule"]),
+        "sent": report["sent"],
+        "lost": report["lost"],
+        "mismatch": report["mismatch"],
+        "maintenance_refresh_done": report["maintenance_refresh_done"],
+        "hedge_sent": int(report["hedge_sent"]),
+        "hedge_wins": int(report["hedge_wins"]),
+        "hedge_win_rate": report["hedge_win_rate"],
+        "breaker_opens": int(report["breaker_opens"]),
+        "breaker_closes": int(report["breaker_closes"]),
+        "pool_evicted": int(report["pool_evicted"]),
+        "clean_p50_ms": report["clean_p50_ms"],
+        "clean_p99_ms": report["clean_p99_ms"],
+        "fault_p99_ms": report["fault_p99_ms"],
+        "disabled_overhead_ms": round(disabled_overhead_ms, 5),
+        "disabled_overhead_pct": round(overhead_pct, 3),
+    }}
 
 
 def _sec_ingest(root: str) -> dict:

@@ -120,6 +120,13 @@ FAULT_INJECTION_SITE = "hyperspace.system.faultInjection.site"
 FAULT_INJECTION_KIND = "hyperspace.system.faultInjection.kind"
 FAULT_INJECTION_AT = "hyperspace.system.faultInjection.at"
 FAULT_INJECTION_COUNT = "hyperspace.system.faultInjection.count"
+FAULT_INJECTION_LATENCY_MS = "hyperspace.system.faultInjection.latencyMs"
+FAULT_INJECTION_HANG_S = "hyperspace.system.faultInjection.hangS"
+CLIENT_HEDGE_ENABLED = "hyperspace.client.hedge.enabled"
+CLIENT_HEDGE_DELAY_MS = "hyperspace.client.hedge.delayMs"
+CLIENT_BREAKER_ENABLED = "hyperspace.client.breaker.enabled"
+CLIENT_BREAKER_FAILURES = "hyperspace.client.breaker.failures"
+CLIENT_BREAKER_COOLDOWN_MS = "hyperspace.client.breaker.cooldownMs"
 
 _DEFAULT_NUM_BUCKETS = 200  # IndexConstants.scala:31-32 (spark.sql.shuffle.partitions default)
 
@@ -537,6 +544,26 @@ class HyperspaceConf:
     fault_injection_kind: str = ""
     fault_injection_at: int = 1
     fault_injection_count: int = 1
+    # Wire-fault shaping (io/faults.py net kinds): added delay for
+    # ``slow``, hang duration for ``black-hole``.
+    fault_injection_latency_ms: float = 25.0
+    fault_injection_hang_s: float = 0.25
+    # Front-door resilience features (interop/server.FleetQueryClient).
+    # Both default OFF: the plain request path stays byte-for-byte the
+    # PR 16 behavior with zero added work beyond a bool check.
+    #   - hedge.enabled/.delayMs: fire a second attempt on a different
+    #     survivor when the first is slower than the hedge delay
+    #     (delayMs 0 = derive from the client's latency EWMA); first
+    #     response wins, the loser is discarded by request_id.
+    #   - breaker.enabled/.failures/.cooldownMs: per-endpoint circuit
+    #     breaker — ``failures`` consecutive errors open it (routing
+    #     avoids it), after ``cooldownMs`` one half-open probe may
+    #     close it again.
+    client_hedge_enabled: bool = False
+    client_hedge_delay_ms: float = 0.0
+    client_breaker_enabled: bool = False
+    client_breaker_failures: int = 5
+    client_breaker_cooldown_ms: float = 2000.0
     # Keys explicitly applied through set(); drives canonical-vs-legacy key
     # precedence.
     _set_keys: set = dataclasses.field(default_factory=set, repr=False,
@@ -646,6 +673,13 @@ class HyperspaceConf:
         FAULT_INJECTION_KIND: "fault_injection_kind",
         FAULT_INJECTION_AT: "fault_injection_at",
         FAULT_INJECTION_COUNT: "fault_injection_count",
+        FAULT_INJECTION_LATENCY_MS: "fault_injection_latency_ms",
+        FAULT_INJECTION_HANG_S: "fault_injection_hang_s",
+        CLIENT_HEDGE_ENABLED: "client_hedge_enabled",
+        CLIENT_HEDGE_DELAY_MS: "client_hedge_delay_ms",
+        CLIENT_BREAKER_ENABLED: "client_breaker_enabled",
+        CLIENT_BREAKER_FAILURES: "client_breaker_failures",
+        CLIENT_BREAKER_COOLDOWN_MS: "client_breaker_cooldown_ms",
     }
 
     # Auto-calibrated routing thresholds: None = derive from measured

@@ -1,0 +1,180 @@
+"""Deterministic wire-fault injection at the interop socket seams.
+
+The PR 1 fault injector (:mod:`hyperspace_tpu.io.faults`) covers every
+storage seam; this module extends the same philosophy to the network
+between :class:`~hyperspace_tpu.interop.server.FleetQueryClient`,
+:class:`~hyperspace_tpu.interop.server.QueryServer`, and the proxy hop —
+the one layer SIGKILL drills structurally cannot exercise, because a
+killed process fails *cleanly* (RST on every socket) while real networks
+fail *gray*: connections hang, frames tear mid-stream, latency balloons.
+
+Four sites, armed exactly like store faults (``faults.install`` or the
+``hyperspace.system.faultInjection.*`` conf keys, so subprocess fleets
+arm them through a child's session conf):
+
+``net.connect``
+    :func:`connect` — the client dial.  ``refused`` raises
+    ``ConnectionRefusedError``; ``reset`` raises
+    ``ConnectionResetError``; ``black-hole`` hangs ``hang_s`` then
+    raises ``TimeoutError`` (the SYN went nowhere); ``slow`` adds
+    ``latency_ms`` before the real dial.
+``net.send``
+    :func:`send_all` — a framed send (the client's request line, or the
+    server's status line + Arrow stream when a wire plan is armed).
+    ``torn-frame`` delivers HALF the frame, then forces an RST — the
+    peer sees a truncated stream, never a clean EOF; ``reset`` RSTs
+    before any byte; ``black-hole`` hangs then times out; ``slow``
+    delays then sends.
+``net.recv``
+    :func:`before_recv` — fired just before the client blocks on the
+    response.  Same kinds as send (a recv-side ``torn-frame`` behaves
+    as ``reset``: the torn bytes are the send side's job).
+``net.accept``
+    :func:`on_accept` — the server accept seam, shared by the threaded
+    and async io modes.  ``reset`` RSTs the fresh connection;
+    ``black-hole`` parks the socket open-but-silent (the client's own
+    deadline must save it — the gray-failure case); other kinds pass
+    through.  Never blocks: the async event loop calls this, and
+    hslint's blocking-discipline rule covers that path.
+
+Faults here raise ordinary ``OSError`` subclasses (never
+``InjectedCrash``): a wire fault is survivable by design, and the whole
+point is proving the retry/hedge/breaker machinery turns it into a
+bit-equal answer from a survivor.
+
+Disarmed cost is one ``is None`` check per seam call — and the server's
+response path doesn't even reach that unless a wire plan is armed
+(:func:`armed` gates the buffered-send detour).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import time
+from typing import List, Optional, Tuple
+
+from hyperspace_tpu.io import faults
+
+# Sockets parked by an armed ``net.accept`` black-hole: held here so the
+# peer sees neither data nor FIN (a dropped reference would close the
+# socket and helpfully RST the client — the opposite of a partition).
+_PARKED: List[socket.socket] = []
+
+
+def armed() -> bool:
+    """True when the active fault plan targets a net.* site — the gate
+    for the server's buffered-send detour (so the zero-fault hot path
+    never pays the extra frame copy)."""
+    plan = faults.active()
+    return plan is not None and plan.site.startswith("net.")
+
+
+def rst_close(sock: socket.socket) -> None:
+    """Close with an RST instead of a FIN (SO_LINGER zero): the peer
+    gets ``ECONNRESET`` mid-operation, exactly what a crashed kernel or
+    a stateful middlebox timing out produces."""
+    try:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                        struct.pack("ii", 1, 0))
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+def clear_parked() -> None:
+    """Release every black-holed socket (test/drill teardown)."""
+    while _PARKED:
+        try:
+            _PARKED.pop().close()
+        except OSError:
+            pass
+
+
+def connect(address: Tuple[str, int],
+            timeout: Optional[float] = None) -> socket.socket:
+    """``socket.create_connection`` with the ``net.connect`` seam."""
+    plan = faults.net("net.connect")
+    if plan is not None:
+        if plan.kind == "refused":
+            raise ConnectionRefusedError(
+                f"injected: connection refused dialing {address}")
+        if plan.kind in ("reset", "torn-frame"):
+            raise ConnectionResetError(
+                f"injected: connection reset dialing {address}")
+        if plan.kind == "black-hole":
+            time.sleep(max(0.0, plan.hang_s))
+            raise TimeoutError(
+                f"injected: black-hole dialing {address} (hung "
+                f"{plan.hang_s:.3f}s)")
+        # slow: the dial works, late.
+        time.sleep(max(0.0, plan.latency_ms) / 1000.0)
+    if timeout is not None:
+        return socket.create_connection(address, timeout=timeout)
+    return socket.create_connection(address)
+
+
+def send_all(sock: socket.socket, data: bytes) -> None:
+    """``sock.sendall(data)`` with the ``net.send`` seam.  ``torn-frame``
+    lands exactly half the frame and then RSTs — the peer's decoder must
+    see a truncated stream, never a short-but-valid one."""
+    site = "net.send"
+    plan = faults.net("net.send")
+    if plan is None:
+        sock.sendall(data)
+        return
+    if plan.kind == "slow":
+        time.sleep(max(0.0, plan.latency_ms) / 1000.0)
+        sock.sendall(data)
+        return
+    if plan.kind == "black-hole":
+        time.sleep(max(0.0, plan.hang_s))
+        raise TimeoutError(
+            f"injected: black-hole at {site} (hung {plan.hang_s:.3f}s)")
+    if plan.kind == "torn-frame":
+        sock.sendall(data[:max(1, len(data) // 2)])
+        rst_close(sock)
+        raise ConnectionResetError(
+            f"injected: torn frame at {site} — "
+            f"{max(1, len(data) // 2)}/{len(data)} bytes landed, then RST")
+    # reset / refused: the connection dies before any byte lands.
+    rst_close(sock)
+    raise ConnectionResetError(f"injected: connection reset at {site}")
+
+
+def before_recv() -> None:
+    """Client-side read seam, fired just before blocking on a response.
+    ``slow`` delays the read; every failing kind surfaces as the
+    exception a real dead/partitioned peer would produce."""
+    site = "net.recv"
+    plan = faults.net("net.recv")
+    if plan is None:
+        return
+    if plan.kind == "slow":
+        time.sleep(max(0.0, plan.latency_ms) / 1000.0)
+        return
+    if plan.kind == "black-hole":
+        time.sleep(max(0.0, plan.hang_s))
+        raise TimeoutError(
+            f"injected: black-hole at {site} (hung {plan.hang_s:.3f}s)")
+    raise ConnectionResetError(f"injected: connection reset at {site}")
+
+
+def on_accept(sock: socket.socket) -> bool:
+    """Server accept seam (both io modes).  Returns False when the
+    connection was consumed by the fault (RST or parked) — the caller
+    must not handle it further.  Block-free by contract: the async
+    event loop calls this (hslint blocking-discipline)."""
+    plan = faults.net("net.accept")
+    if plan is None:
+        return True
+    if plan.kind in ("reset", "refused", "torn-frame"):
+        rst_close(sock)
+        return False
+    if plan.kind == "black-hole":
+        _PARKED.append(sock)  # open but silent: a partitioned server
+        return False
+    return True  # slow shapes the data path, not the accept

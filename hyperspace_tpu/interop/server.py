@@ -78,6 +78,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import pyarrow as pa
 
+from hyperspace_tpu.interop import netfaults
+
 MAX_REQUEST_BYTES = 1 << 20  # a query spec, not a data upload
 
 
@@ -654,10 +656,23 @@ class _Responder:
         # mid-Arrow-stream pinned its thread on a full send buffer forever.
         try:
             self.connection.settimeout(float(conf.serving_send_timeout_s))
-            self.wfile.write(f"OK trace={trace_id}\n".encode("utf-8"))
-            with pa.ipc.new_stream(self.wfile, table.schema) as writer:
-                writer.write_table(table)
-            self.wfile.flush()
+            if netfaults.armed():
+                # Wire-fault detour: materialize the whole frame so the
+                # net.send seam can tear it at an exact byte boundary.
+                # Gated on an armed net plan — the zero-fault hot path
+                # never pays the extra copy.
+                import io as _io
+
+                buf = _io.BytesIO()
+                buf.write(f"OK trace={trace_id}\n".encode("utf-8"))
+                with pa.ipc.new_stream(buf, table.schema) as writer:
+                    writer.write_table(table)
+                netfaults.send_all(self.connection, buf.getvalue())
+            else:
+                self.wfile.write(f"OK trace={trace_id}\n".encode("utf-8"))
+                with pa.ipc.new_stream(self.wfile, table.schema) as writer:
+                    writer.write_table(table)
+                self.wfile.flush()
             metrics.inc("serve.ok")
             return True
         except TimeoutError:
@@ -943,6 +958,8 @@ class _AsyncIOLoop:
             sock, _addr = self._listener.accept()
         except OSError:
             return
+        if not netfaults.on_accept(sock):
+            return  # consumed by an armed net.accept fault (block-free)
         if not self._outer._acquire_conn():
             # Reject IN the loop, bounded send — same contract as the
             # threaded accept loop's early ERR BUSY.
@@ -1348,6 +1365,8 @@ class QueryServer:
             daemon_threads = True
 
             def process_request(self, request, client_address):
+                if not netfaults.on_accept(request):
+                    return  # consumed by an armed net.accept fault
                 if not outer._acquire_conn():
                     # Reject IN the accept loop — no handler thread is
                     # spawned, so a connection storm cannot grow the
@@ -1707,8 +1726,9 @@ class QueryClient:
     key in a spec wins."""
 
     def __init__(self, address: Tuple[str, int],
-                 tenant: Optional[str] = None) -> None:
-        self._sock = socket.create_connection(address)
+                 tenant: Optional[str] = None,
+                 timeout_s: Optional[float] = None) -> None:
+        self._sock = netfaults.connect(address, timeout=timeout_s)
         self._f = self._sock.makefile("rb")
         self._broken = False
         self.tenant = tenant
@@ -1716,8 +1736,31 @@ class QueryClient:
         #: server speaks the trace protocol, else the client-minted one.
         self.last_trace_id: Optional[str] = None
 
+    def is_stale(self) -> bool:
+        """True when the pooled socket is no longer usable: the server
+        hung up (half-open TCP after a bounce — a nonblocking peek sees
+        EOF or an error), or bytes are pending between requests (a
+        protocol violation on a pipelined connection — e.g. a hedged
+        loser's late response; reading a fresh request's answer from it
+        would cross-wire responses)."""
+        if self._broken:
+            return True
+        try:
+            self._sock.setblocking(False)
+            try:
+                chunk = self._sock.recv(1, socket.MSG_PEEK)
+            finally:
+                self._sock.setblocking(True)
+        except (BlockingIOError, InterruptedError):
+            return False  # no pending data: the healthy idle state
+        except OSError:
+            return True  # reset/refused already latched on the socket
+        # EOF (b"") or unexpected pending bytes: either way, not safe.
+        return True
+
     def query(self, spec: Dict[str, Any],
-              deadline_ms: Optional[float] = None) -> pa.Table:
+              deadline_ms: Optional[float] = None,
+              timeout_s: Optional[float] = None) -> pa.Table:
         from hyperspace_tpu.interop.query import mint_trace_id
 
         if self._broken:
@@ -1740,7 +1783,15 @@ class QueryClient:
             # contract under test for such requests.
             self.last_trace_id = None
         try:
-            self._sock.sendall(json.dumps(spec).encode("utf-8") + b"\n")
+            if timeout_s is not None:
+                # The whole exchange — send, status line, Arrow stream —
+                # rides one socket timeout: a SIGSTOPped or partitioned
+                # server surfaces as ConnectionError within the budget
+                # instead of pinning the caller forever.
+                self._sock.settimeout(timeout_s)
+            netfaults.send_all(
+                self._sock, json.dumps(spec).encode("utf-8") + b"\n")
+            netfaults.before_recv()
             status = self._f.readline().decode("utf-8").rstrip("\n")
         except OSError as exc:
             self._broken = True
@@ -1763,8 +1814,21 @@ class QueryClient:
         _, echoed = _split_trace_echo(status[2:].strip())
         if echoed is not None:
             self.last_trace_id = echoed
-        with pa.ipc.open_stream(self._f) as reader:
-            return reader.read_all()
+        try:
+            with pa.ipc.open_stream(self._f) as reader:
+                return reader.read_all()
+        except OSError as exc:
+            self._broken = True
+            raise ConnectionError(f"connection lost: {exc}") from exc
+        except pa.ArrowInvalid as exc:
+            # A truncated/garbled IPC stream after a clean OK line: the
+            # connection died mid-frame (torn frame, reset, server
+            # crash).  That is a TRANSPORT fault, not a query failure —
+            # surface it retryable so the front door fails over instead
+            # of raising a decoder error at the caller.
+            self._broken = True
+            raise ConnectionError(
+                f"response stream torn mid-frame: {exc}") from exc
 
     def close(self) -> None:
         self._f.close()
@@ -1791,11 +1855,14 @@ def _as_address(endpoint) -> Tuple[str, int]:
 
 class _Endpoint:
     """One server behind the front door: its address, a small pool of
-    idle pipelined connections, and the router's view of it (in-flight
-    count, fleet-reported load, draining flag, penalty clock)."""
+    idle pipelined connections, the router's view of it (in-flight
+    count, fleet-reported load, draining flag, penalty clock), and its
+    circuit-breaker state (closed → open on consecutive failures →
+    half-open probe after the cooldown)."""
 
     __slots__ = ("address", "label", "idle", "inflight", "penalized_until",
-                 "load", "draining", "fresh", "lock")
+                 "load", "draining", "fresh", "lock",
+                 "breaker_state", "breaker_fails", "breaker_until")
 
     MAX_IDLE = 4  # idle pipelined connections kept per endpoint
 
@@ -1809,23 +1876,91 @@ class _Endpoint:
         self.draining = False
         self.fresh = True  # no fleet row ⇒ assume routable (fleet is opt-in)
         self.lock = threading.Lock()
+        self.breaker_state = "closed"   # closed | open | half-open
+        self.breaker_fails = 0          # consecutive failures while closed
+        self.breaker_until = 0.0        # monotonic; open until then
 
-    def acquire(self, tenant: Optional[str]) -> QueryClient:
-        """Pop an idle connection or dial a new one.  The connect happens
-        OUTSIDE the lock (it blocks); in-flight is rolled back when the
-        dial fails so a dead endpoint doesn't look busy forever."""
+    def acquire(self, tenant: Optional[str],
+                timeout_s: Optional[float] = None) -> QueryClient:
+        """Pop a VALIDATED idle connection or dial a new one.  Pooled
+        sockets are peeked on checkout: a restarted server leaves
+        half-open TCP behind, and handing that to a caller turns a
+        routine bounce into a spurious reset charged to retry
+        accounting — evict it silently instead
+        (``client.pool.evicted``).  The connect happens OUTSIDE the
+        lock (it blocks); in-flight is rolled back when the dial fails
+        so a dead endpoint doesn't look busy forever."""
+        from hyperspace_tpu.telemetry import metrics
+
         with self.lock:
             self.inflight += 1
-            client = self.idle.pop() if self.idle else None
-        if client is not None:
+        while True:
+            with self.lock:
+                client = self.idle.pop() if self.idle else None
+            if client is None:
+                break
+            if client.is_stale():
+                metrics.inc("client.pool.evicted")
+                try:
+                    client.close()
+                except OSError:
+                    pass
+                continue
             client.tenant = tenant
             return client
         try:
-            return QueryClient(self.address, tenant=tenant)
+            return QueryClient(self.address, tenant=tenant,
+                               timeout_s=timeout_s)
         except OSError:
             with self.lock:
                 self.inflight -= 1
             raise
+
+    # -- circuit breaker -----------------------------------------------------
+    def breaker_blocked(self, now: float) -> bool:
+        """True when routing should avoid this endpoint: breaker open
+        inside its cooldown, or a half-open probe already in flight."""
+        with self.lock:
+            if self.breaker_state == "open":
+                return now < self.breaker_until
+            return self.breaker_state == "half-open"
+
+    def breaker_on_pick(self, now: float) -> bool:
+        """Transition open → half-open when the cooldown has expired and
+        this endpoint was actually PICKED (the probe request).  Returns
+        True on the transition so the caller can count it."""
+        with self.lock:
+            if self.breaker_state == "open" and now >= self.breaker_until:
+                self.breaker_state = "half-open"
+                return True
+        return False
+
+    def breaker_failure(self, threshold: int, cooldown_s: float) -> bool:
+        """Record a retryable/transport failure.  Returns True when this
+        failure OPENED the breaker (threshold reached, or the half-open
+        probe failed)."""
+        now = time.monotonic()
+        with self.lock:
+            if self.breaker_state == "half-open":
+                self.breaker_state = "open"
+                self.breaker_until = now + cooldown_s
+                return True
+            self.breaker_fails += 1
+            if self.breaker_state == "closed" \
+                    and self.breaker_fails >= max(1, threshold):
+                self.breaker_state = "open"
+                self.breaker_until = now + cooldown_s
+                return True
+        return False
+
+    def breaker_success(self) -> bool:
+        """Record a served request.  Returns True when this success
+        CLOSED a non-closed breaker (the half-open probe came back)."""
+        with self.lock:
+            was = self.breaker_state
+            self.breaker_state = "closed"
+            self.breaker_fails = 0
+            return was != "closed"
 
     def release(self, client: QueryClient) -> None:
         with self.lock:
@@ -1882,6 +2017,32 @@ class FleetQueryClient:
     increments ``client.failover``.  ``tenant`` stamps every spec for
     per-tenant admission on the servers.
 
+    DEADLINE BUDGET: ``deadline_ms`` is ONE overall per-call budget —
+    connect timeouts, socket read timeouts, backoff sleeps, the hedge
+    delay, and the server-side deadline all spend from it, so the total
+    elapsed across every failover attempt respects the caller's bound
+    (per-attempt spending could overshoot it N-fold).
+
+    CIRCUIT BREAKERS (``hyperspace.client.breaker.*``, default off):
+    ``failures`` consecutive retryable/transport errors open an
+    endpoint's breaker — routing avoids it for ``cooldownMs``, then ONE
+    half-open probe request decides (success closes it, failure
+    re-opens).  Transitions land on ``client.breaker.open`` /
+    ``.half_open`` / ``.close`` counters and the
+    ``client.breaker.open_now`` gauge the doctor's ``client`` check
+    grades.
+
+    HEDGED REQUESTS (``hyperspace.client.hedge.enabled``, default off):
+    when the first attempt is slower than the hedge delay
+    (``hedge.delayMs``, or 2× the client's latency EWMA when 0), a
+    second attempt fires on a different survivor; the first response
+    wins and the loser's late response is discarded by request_id
+    (each attempt reads its own pipelined connection, so a late frame
+    can never cross-wire onto a winner).  ``client.hedge.sent`` /
+    ``client.hedge.wins`` count them.  Queries through this front door
+    are reads — verbs and specs alike — which is what makes firing the
+    same request twice safe.
+
     >>> with FleetQueryClient(["127.0.0.1:9001", "127.0.0.1:9002"],
     ...                       conf=session.conf) as fleet:
     ...     fleet.query({"index": "idx", "point": {"id": 7}})
@@ -1891,7 +2052,12 @@ class FleetQueryClient:
                  conf=None, tenant: Optional[str] = None,
                  max_attempts: Optional[int] = None,
                  backoff_cap_ms: float = 2000.0,
-                 status_refresh_s: float = 1.0) -> None:
+                 status_refresh_s: float = 1.0,
+                 hedge_enabled: Optional[bool] = None,
+                 hedge_delay_ms: Optional[float] = None,
+                 breaker_enabled: Optional[bool] = None,
+                 breaker_failures: Optional[int] = None,
+                 breaker_cooldown_ms: Optional[float] = None) -> None:
         if not endpoints:
             raise ValueError("FleetQueryClient needs at least one endpoint")
         self._endpoints = [_Endpoint(e) for e in endpoints]
@@ -1903,8 +2069,26 @@ class FleetQueryClient:
         self._status_refresh_s = float(status_refresh_s)
         self._status_stamp = 0.0  # monotonic; 0 forces a first refresh
         self._rr = 0
-        self._lock = threading.Lock()  # guards _rr/_status_stamp ONLY —
-        # never held across connect/send/sleep (lint: lock-held-blocking)
+        self._lock = threading.Lock()  # guards _rr/_status_stamp/_lat_ewma
+        # ONLY — never held across connect/send/sleep (lint:
+        # lock-held-blocking)
+
+        def _opt(value, key, default):
+            return value if value is not None \
+                else getattr(conf, key, default) if conf is not None \
+                else default
+
+        self._hedge_enabled = bool(
+            _opt(hedge_enabled, "client_hedge_enabled", False))
+        self._hedge_delay_ms = float(
+            _opt(hedge_delay_ms, "client_hedge_delay_ms", 0.0))
+        self._breaker_enabled = bool(
+            _opt(breaker_enabled, "client_breaker_enabled", False))
+        self._breaker_failures = int(
+            _opt(breaker_failures, "client_breaker_failures", 5))
+        self._breaker_cooldown_ms = float(
+            _opt(breaker_cooldown_ms, "client_breaker_cooldown_ms", 2000.0))
+        self._lat_ewma_ms = 0.0  # successful-request latency EWMA
         #: trace id of the most recent query() — same contract as
         #: :class:`QueryClient`.
         self.last_trace_id: Optional[str] = None
@@ -1946,19 +2130,35 @@ class FleetQueryClient:
             ep.draining = bool(snap.get("draining", False))
             ep.fresh = True
 
-    def _pick(self, tried: set) -> _Endpoint:
+    def _pick(self, tried: set,
+              exclude: Optional[set] = None) -> _Endpoint:
         """Least-loaded routable endpoint not yet tried this request;
-        progressively relax (allow penalized, then tried) rather than
-        fail a pick while any endpoint exists."""
+        progressively relax (allow breaker-open/penalized, then tried)
+        rather than fail a pick while any endpoint exists.  ``exclude``
+        labels (the hedge's other attempt) are avoided at every tier
+        but the last-resort one."""
+        from hyperspace_tpu.telemetry import metrics
+
         self._refresh_status()
         now = time.monotonic()
-        healthy = [ep for ep in self._endpoints
-                   if ep.label not in tried and not ep.draining
-                   and now >= ep.penalized_until]
-        pool = (healthy
-                or [ep for ep in self._endpoints
-                    if ep.label not in tried and not ep.draining]
-                or [ep for ep in self._endpoints if ep.label not in tried]
+        exclude = exclude or set()
+
+        def _tier(skip_tried: bool = True, skip_draining: bool = True,
+                  skip_penalized: bool = False,
+                  skip_broken: bool = False) -> List[_Endpoint]:
+            return [ep for ep in self._endpoints
+                    if ep.label not in exclude
+                    and (not skip_tried or ep.label not in tried)
+                    and (not skip_draining or not ep.draining)
+                    and (not skip_penalized or now >= ep.penalized_until)
+                    and (not skip_broken
+                         or not ep.breaker_blocked(now))]
+
+        pool = (_tier(skip_penalized=True,
+                      skip_broken=self._breaker_enabled)
+                or _tier()
+                or _tier(skip_draining=False)
+                or [ep for ep in self._endpoints if ep.label not in exclude]
                 or self._endpoints)
 
         def _load(ep: _Endpoint) -> float:
@@ -1969,35 +2169,97 @@ class FleetQueryClient:
         ties = [ep for ep in pool if _load(ep) <= low]
         with self._lock:
             self._rr += 1
-            return ties[self._rr % len(ties)]
+            ep = ties[self._rr % len(ties)]
+        if self._breaker_enabled and ep.breaker_on_pick(now):
+            metrics.inc("client.breaker.half_open")
+            self._breaker_gauge()
+        return ep
+
+    def _breaker_gauge(self) -> None:
+        from hyperspace_tpu.telemetry import metrics
+
+        metrics.set_gauge(
+            "client.breaker.open_now",
+            sum(1 for ep in self._endpoints
+                if ep.breaker_state != "closed"))
 
     # -- request path ---------------------------------------------------------
     def query(self, spec: Dict[str, Any],
               deadline_ms: Optional[float] = None) -> pa.Table:
+        deadline_at = (time.monotonic() + float(deadline_ms) / 1000.0
+                       if deadline_ms is not None else None)
+        if self._hedge_enabled and isinstance(spec, dict):
+            return self._query_hedged(spec, deadline_ms, deadline_at)
+        return self._query_attempts(spec, deadline_ms, deadline_at)
+
+    @staticmethod
+    def _remaining_ms(deadline_at: Optional[float]) -> Optional[float]:
+        if deadline_at is None:
+            return None
+        return (deadline_at - time.monotonic()) * 1000.0
+
+    def _observe_latency(self, elapsed_ms: float) -> None:
+        with self._lock:
+            self._lat_ewma_ms = elapsed_ms if self._lat_ewma_ms <= 0.0 \
+                else 0.8 * self._lat_ewma_ms + 0.2 * elapsed_ms
+
+    def _query_attempts(self, spec: Dict[str, Any],
+                        deadline_ms: Optional[float],
+                        deadline_at: Optional[float],
+                        exclude: Optional[set] = None,
+                        note: Optional[Dict[str, Any]] = None,
+                        max_attempts: Optional[int] = None) -> pa.Table:
+        """The retry/failover loop, spending from ONE deadline budget:
+        every attempt's socket timeout, server-side deadline, and
+        backoff sleep is bounded by what remains of ``deadline_ms`` —
+        the budget is the caller's, not per-attempt."""
         from hyperspace_tpu.telemetry import metrics
 
+        attempts_cap = int(max_attempts) if max_attempts is not None \
+            else self._max_attempts
         last_exc: Optional[Exception] = None
         last_label: Optional[str] = None
         tried: set = set()
-        for attempt in range(1, self._max_attempts + 1):
+        for attempt in range(1, attempts_cap + 1):
+            remaining = self._remaining_ms(deadline_at)
+            if remaining is not None and remaining <= 1.0:
+                break  # budget exhausted: surface the last failure
             if len(tried) >= len(self._endpoints):
                 tried.clear()  # every endpoint failed once: start over
-            ep = self._pick(tried)
+            ep = self._pick(tried, exclude=exclude)
             tried.add(ep.label)
+            if note is not None:
+                note.setdefault("labels", set()).add(ep.label)
             if last_label is not None and last_label != ep.label:
                 # A retry routed AWAY from the endpoint that failed —
                 # the failover event the drill test counts.
                 metrics.inc("client.failover")
+            # Spread the remaining budget over the attempts still
+            # available (bounded by distinct endpoints): a GRAY failure
+            # — server alive but serving nothing — otherwise eats the
+            # whole budget in one socket timeout, leaving nothing to
+            # fail over with.
+            if remaining is not None:
+                spread = max(1, min(attempts_cap - attempt + 1,
+                                    len(self._endpoints)))
+                timeout_s = remaining / 1000.0 / spread + 0.05
+            else:
+                timeout_s = None
             retry_after_ms: Optional[float] = None
             kind = "connection"
+            t0 = time.monotonic()
             try:
-                client = ep.acquire(self._tenant)
+                client = ep.acquire(self._tenant, timeout_s=timeout_s)
             except OSError as exc:
                 last_exc = ConnectionError(
                     f"connect to {ep.label} failed: {exc}")
             else:
                 try:
-                    table = client.query(spec, deadline_ms=deadline_ms)
+                    table = client.query(
+                        spec,
+                        deadline_ms=self._remaining_ms(deadline_at)
+                        if deadline_at is not None else deadline_ms,
+                        timeout_s=timeout_s)
                 except QueryFailedError as exc:
                     # The server closes the connection after an ERR.
                     ep.discard(client)
@@ -2013,29 +2275,169 @@ class FleetQueryClient:
                 else:
                     ep.release(client)
                     self.last_trace_id = client.last_trace_id
+                    self._observe_latency(
+                        (time.monotonic() - t0) * 1000.0)
+                    if self._breaker_enabled and ep.breaker_success():
+                        metrics.inc("client.breaker.close")
+                        self._breaker_gauge()
                     return table
             metrics.inc("client.retry")
             metrics.inc(f"client.retry.{kind}")
             last_label = ep.label
+            if self._breaker_enabled and ep.breaker_failure(
+                    self._breaker_failures,
+                    self._breaker_cooldown_ms / 1000.0):
+                metrics.inc("client.breaker.open")
+                self._breaker_gauge()
             # Penalize the failed endpoint for the server's hinted
             # window (or a nominal beat) so the next pick avoids it.
             ep.penalized_until = time.monotonic() + \
                 (retry_after_ms or 100.0) / 1000.0
-            if attempt < self._max_attempts:
-                self._backoff(attempt, retry_after_ms)
-        raise last_exc  # type: ignore[misc]  # loop ran ≥ 1 attempt
+            if attempt < attempts_cap:
+                if not self._backoff(attempt, retry_after_ms, deadline_at):
+                    break  # no budget left to sleep AND attempt again
+        if last_exc is None:
+            last_exc = TimeoutError(
+                f"deadline budget ({deadline_ms} ms) exhausted before "
+                f"any attempt completed")
+        raise last_exc
 
-    def _backoff(self, attempt: int, retry_after_ms: Optional[float]) -> None:
+    def _query_hedged(self, spec: Dict[str, Any],
+                      deadline_ms: Optional[float],
+                      deadline_at: Optional[float]) -> pa.Table:
+        """Run the attempts loop in a worker thread; when it is slower
+        than the hedge delay, fire ONE extra single-attempt on a
+        different survivor.  First response wins; the loser finishes
+        reading its own connection in the background and its response
+        is discarded by request_id."""
+        from hyperspace_tpu.interop.query import mint_trace_id
+        from hyperspace_tpu.telemetry import metrics
+
+        lock = threading.Lock()
+        done = threading.Event()
+        state: Dict[str, Any] = {"winner": None, "table": None,
+                                 "trace": None, "outstanding": 1}
+        errs: Dict[str, Exception] = {}
+        primary_note: Dict[str, Any] = {}
+
+        def _runner(tag: str, req_spec: Dict[str, Any],
+                    exclude: Optional[set], note: Optional[dict],
+                    max_attempts: Optional[int] = None) -> None:
+            try:
+                # The hedge branch runs a SINGLE attempt: its job is
+                # beating a slow primary, not re-running the whole retry
+                # ladder in parallel.
+                table = self._query_attempts(
+                    req_spec, deadline_ms, deadline_at,
+                    exclude=exclude, note=note, max_attempts=max_attempts)
+            except Exception as exc:  # noqa: BLE001 — reported to caller
+                with lock:
+                    errs[tag] = exc
+                    state["outstanding"] -= 1
+                    if state["outstanding"] <= 0 \
+                            and state["winner"] is None:
+                        done.set()
+            else:
+                with lock:
+                    state["outstanding"] -= 1
+                    if state["winner"] is None:
+                        state["winner"] = tag
+                        state["table"] = table
+                        state["trace"] = self.last_trace_id
+                        done.set()
+                    # else: the loser — its request_id lost the race and
+                    # its fully-read response is dropped here.
+
+        primary_spec = {**spec, "request_id": mint_trace_id()}
+        threading.Thread(
+            target=_runner, args=("primary", primary_spec, None,
+                                  primary_note),
+            name="hs-client-primary", daemon=True).start()
+
+        delay_s = self._hedge_delay_s()
+        remaining = self._remaining_ms(deadline_at)
+        if remaining is not None:
+            delay_s = min(delay_s, max(0.0, remaining / 1000.0))
+        fired = False
+        if not done.wait(delay_s) and len(self._endpoints) > 1:
+            with lock:
+                slow_primary = state["winner"] is None \
+                    and state["outstanding"] > 0
+                if slow_primary:
+                    state["outstanding"] += 1
+            if slow_primary:
+                remaining = self._remaining_ms(deadline_at)
+                if remaining is None or remaining > 5.0:
+                    metrics.inc("client.hedge.sent")
+                    fired = True
+                    hedge_spec = {**spec, "request_id": mint_trace_id()}
+                    threading.Thread(
+                        target=_runner,
+                        args=("hedge", hedge_spec,
+                              set(primary_note.get("labels", set())),
+                              None, 1),
+                        name="hs-client-hedge", daemon=True).start()
+                else:
+                    with lock:
+                        state["outstanding"] -= 1
+        remaining = self._remaining_ms(deadline_at)
+        # The attempts' socket timeouts are budget-bounded, so a small
+        # grace past the deadline is enough for the threads to settle.
+        done.wait(remaining / 1000.0 + 0.5 if remaining is not None
+                  else None)
+        with lock:
+            if state["winner"] is not None:
+                if fired and state["winner"] == "hedge":
+                    metrics.inc("client.hedge.wins")
+                self.last_trace_id = state["trace"]
+                return state["table"]
+            exc = errs.get("primary") or errs.get("hedge")
+        if exc is not None:
+            raise exc
+        raise TimeoutError(
+            f"deadline budget ({deadline_ms} ms) exhausted before any "
+            f"attempt completed")
+
+    def _hedge_delay_s(self) -> float:
+        """The wait before hedging: the configured delay, or — when 0 —
+        2× the latency EWMA clamped to [10 ms, 500 ms] (50 ms with no
+        history yet)."""
+        if self._hedge_delay_ms > 0.0:
+            return self._hedge_delay_ms / 1000.0
+        with self._lock:
+            ewma = self._lat_ewma_ms
+        if ewma <= 0.0:
+            return 0.050
+        return min(0.500, max(0.010, 2.0 * ewma / 1000.0))
+
+    def _backoff(self, attempt: int, retry_after_ms: Optional[float],
+                 deadline_at: Optional[float] = None) -> bool:
         """Jittered exponential backoff, honoring the server's
-        ``retry-after-ms`` hint as the step when present."""
+        ``retry-after-ms`` hint as the step when present — capped by
+        what remains of the deadline budget.  Returns False when the
+        budget cannot fund the sleep (the caller stops retrying)."""
         step = retry_after_ms if retry_after_ms is not None \
             else 50.0 * (2.0 ** (attempt - 1))
         delay_ms = min(self._backoff_cap_ms, step) * (0.5 + random.random())
+        remaining = self._remaining_ms(deadline_at)
+        if remaining is not None:
+            if remaining <= 2.0:
+                return False
+            delay_ms = min(delay_ms, remaining - 1.0)
         time.sleep(delay_ms / 1000.0)
+        return True
 
     def close(self) -> None:
         for ep in self._endpoints:
             ep.close_idle()
+        if self._breaker_enabled:
+            # The open-now gauge describes THIS client's live routing
+            # table; with the client gone nothing is "open now" — a
+            # stale nonzero would keep the doctor's client check
+            # warning forever.
+            from hyperspace_tpu.telemetry import metrics
+
+            metrics.set_gauge("client.breaker.open_now", 0.0)
 
     def __enter__(self) -> "FleetQueryClient":
         return self

@@ -37,6 +37,14 @@ Sites (grep for ``faults.check`` / ``faults.write_payload``):
 ``store.read``            a LogStore point read / generation probe
 ``store.list``            a LogStore key listing
 ``store.delete``          a LogStore delete
+``net.connect``           a client socket dial (interop/netfaults.connect)
+``net.send``              a framed wire send — client request line or the
+                          server's status+Arrow response
+                          (interop/netfaults.send_all)
+``net.recv``              a client read of the status line / Arrow stream
+                          (interop/netfaults.before_recv)
+``net.accept``            the server accept seam, BOTH io modes
+                          (interop/netfaults.on_accept)
 ========================  ====================================================
 
 Kinds:
@@ -58,7 +66,29 @@ Kinds:
 ``truncate``              cut the file to half its size — a torn put the
                           store accepted; size changes, so even a quick
                           (stat-only) scrub catches it
+``refused``               the peer answers RST to the dial
+                          (``ConnectionRefusedError``) — server down or
+                          port closed
+``reset``                 the established connection dies mid-operation
+                          (``ConnectionResetError``)
+``black-hole``            the peer goes silent: the call hangs ``hang_s``
+                          seconds, then times out — a partition or a
+                          SIGSTOPped process, NOT a clean death
+``slow``                  latency shaping: the call succeeds after an
+                          injected ``latency_ms`` delay — a gray,
+                          degraded-but-alive link
+``torn-frame``            half the frame lands on the wire, then the
+                          connection resets — the network edition of a
+                          torn write; the reader sees a truncated Arrow
+                          stream, never a parse success
 ========================  ====================================================
+
+The network kinds fire only at ``net.*`` sites and only through
+:func:`net` (the checkpoint :mod:`hyperspace_tpu.interop.netfaults`
+calls); file/store kinds never fire at net sites and vice versa —
+:class:`FaultPlan` rejects a mismatched pairing outright, because an
+armed plan that can never fire is the silent-miss bug this module
+exists to prevent.
 
 The corruption kinds never raise: the write/read call itself SUCCEEDS
 and the damage sits on disk for the integrity layer (io/integrity.py,
@@ -88,10 +118,15 @@ import threading
 from typing import Optional
 
 _KNOWN_KINDS = ("enospc", "eio", "torn", "crash", "crash-before-rename",
-                "crash-after-rename", "bitrot", "truncate")
+                "crash-after-rename", "bitrot", "truncate",
+                "refused", "reset", "black-hole", "slow", "torn-frame")
 # Kinds that damage file CONTENT instead of failing the call; they fire
 # only through corrupt_file().
 _CORRUPT_KINDS = ("bitrot", "truncate")
+# Wire kinds: they fire only through net(), at net.* sites, and are
+# INTERPRETED by interop/netfaults.py (this module just arbitrates
+# whether the Nth call fires).
+_NET_KINDS = ("refused", "reset", "black-hole", "slow", "torn-frame")
 
 # The machine-readable site registry (the docstring table above is the
 # prose version).  Every ``check``/``fire``/``write_payload``/
@@ -111,6 +146,10 @@ SITES = (
     "store.read",
     "store.list",
     "store.delete",
+    "net.connect",
+    "net.send",
+    "net.recv",
+    "net.accept",
 )
 
 
@@ -133,6 +172,11 @@ class FaultPlan:
     kind: str
     at: int = 1
     count: int = 1  # -1 = every matching call from ``at`` on
+    # Wire-shaping knobs, read by interop/netfaults.py when the armed
+    # kind is ``slow`` (added delay) / ``black-hole`` (hang duration
+    # before the injected timeout).  Ignored by every other kind.
+    latency_ms: float = 25.0
+    hang_s: float = 0.25
 
     def __post_init__(self) -> None:
         if self.kind not in _KNOWN_KINDS:
@@ -143,17 +187,28 @@ class FaultPlan:
             raise ValueError(
                 f"Unknown fault site {self.site!r}; expected one of "
                 f"{SITES} (a typo'd site would silently never fire)")
+        if (self.kind in _NET_KINDS) != self.site.startswith("net."):
+            raise ValueError(
+                f"Fault kind {self.kind!r} cannot fire at site "
+                f"{self.site!r}: wire kinds {_NET_KINDS} pair only with "
+                f"net.* sites (a mismatched plan would silently never "
+                f"fire)")
         self._calls = 0
         self._fired = 0
         self._lock = threading.Lock()
 
-    def _should_fire(self, site: str, corrupting: bool = False) -> bool:
+    def _should_fire(self, site: str, corrupting: bool = False,
+                     net: bool = False) -> bool:
         if site != self.site:
             return False
         if (self.kind in _CORRUPT_KINDS) != corrupting:
             # Mismatched call type (a corruption kind at a check() site or
             # vice versa): not merely "don't fire" — don't COUNT, so at=N
             # indexes only calls that could fire this kind.
+            return False
+        if (self.kind in _NET_KINDS) != net:
+            # Same contract for the wire channel: net kinds fire only
+            # through net(), and net() fires only net kinds.
             return False
         with self._lock:
             self._calls += 1
@@ -225,7 +280,11 @@ def install_from_conf(conf) -> None:
     install(FaultPlan(site=conf.fault_injection_site,
                       kind=conf.fault_injection_kind,
                       at=int(conf.fault_injection_at),
-                      count=int(conf.fault_injection_count)))
+                      count=int(conf.fault_injection_count),
+                      latency_ms=float(getattr(
+                          conf, "fault_injection_latency_ms", 25.0)),
+                      hang_s=float(getattr(
+                          conf, "fault_injection_hang_s", 0.25))))
 
 
 def check(site: str) -> None:
@@ -235,6 +294,19 @@ def check(site: str) -> None:
     if plan is None or _is_quiet() or not plan._should_fire(site):
         return
     plan._raise()
+
+
+def net(site: str) -> Optional[FaultPlan]:
+    """Wire-fault checkpoint: returns the armed plan when a net kind
+    fires at ``site`` (the caller — interop/netfaults.py — interprets
+    the kind and its shaping knobs), None otherwise.  Never raises:
+    socket seams decide HOW a wire fault manifests (which exception,
+    which half of the frame lands) and this module only arbitrates
+    WHETHER the Nth call fires."""
+    plan = _PLAN
+    if plan is None or _is_quiet() or not plan._should_fire(site, net=True):
+        return None
+    return plan
 
 
 def fire(site: str) -> Optional[str]:

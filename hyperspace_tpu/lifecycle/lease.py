@@ -27,6 +27,14 @@ Protocol (conf ``hyperspace.lifecycle.lease.enabled`` / ``.ttlS``):
     epoch.  Wall-clock expiry is also checked locally, so a holder
     that cannot reach the store stops acting after TTL even though
     nobody fenced it yet.
+  - **Store-latency margin**: the holder measures every lease store
+    round-trip (EWMA) and treats its own expiry as
+    ``expires_at - margin`` where the margin covers a couple of
+    slow store operations (clamped to at most TTL/3).  A renew whose
+    CAS black-holes on a degraded link therefore stops the holder
+    BEFORE the wall-clock TTL a successor acquires against — the
+    window where a zombie still believes it holds while the new
+    epoch is already executing is closed by measurement, not luck.
   - **Epoch fencing**: every takeover bumps ``epoch``; a zombie's
     renew can never succeed (its generation is stale) and anything it
     might stamp with its old epoch is distinguishable after the fact.
@@ -112,13 +120,30 @@ class MaintenanceLease:
         self._held = False
         self._gen = 0            # generation of OUR last committed record
         self._expires_at = 0.0   # local wall-clock view of our expiry
+        self._lat_ewma_s = 0.0   # measured lease store round-trip EWMA
 
     # -- state ---------------------------------------------------------------
+    def margin_s(self) -> float:
+        """How early (before wall-clock expiry) this holder stops
+        acting: two measured store round-trips of headroom — a renew
+        slower than that is already at risk of landing after a
+        takeover — clamped to [2% TTL, TTL/3] so a cold EWMA still
+        leaves a beat and a pathological one can't eat the lease."""
+        ttl = ttl_s(self.conf)
+        return min(ttl / 3.0, max(2.0 * self._lat_ewma_s, 0.02 * ttl))
+
     def holds(self) -> bool:
-        """Held AND not past our own wall-clock expiry — a holder that
-        lost contact with the store must stop acting after TTL even
-        before anyone fences it."""
-        return self._held and time.time() < self._expires_at
+        """Held AND not within ``margin_s`` of our own wall-clock
+        expiry — a holder that lost contact with the store must stop
+        acting BEFORE a successor can legitimately take over, with the
+        margin covering the store latency its own renews have been
+        measuring."""
+        return self._held and \
+            time.time() < self._expires_at - self.margin_s()
+
+    def _observe_latency(self, elapsed_s: float) -> None:
+        self._lat_ewma_s = elapsed_s if self._lat_ewma_s <= 0.0 \
+            else 0.7 * self._lat_ewma_s + 0.3 * elapsed_s
 
     # -- protocol ------------------------------------------------------------
     def ensure(self) -> bool:
@@ -138,15 +163,20 @@ class MaintenanceLease:
         from hyperspace_tpu.telemetry import metrics
 
         store = _store(self.conf)
+        t0 = time.monotonic()
         payload, gen = store.read_with_generation(LEASE_KEY)
+        self._observe_latency(time.monotonic() - t0)
         rec = _parse(payload)
         now = time.time()
         if rec is not None and float(rec.get("expires_at", 0.0)) > now:
             return False  # live holder; idle-poll
         prior_epoch = int(rec.get("epoch", 0)) if rec is not None else 0
         takeover = rec is not None
-        if not store.put_if_generation_match(
-                LEASE_KEY, self._record(prior_epoch + 1, now), gen):
+        t0 = time.monotonic()
+        committed = store.put_if_generation_match(
+            LEASE_KEY, self._record(prior_epoch + 1, now), gen)
+        self._observe_latency(time.monotonic() - t0)
+        if not committed:
             metrics.inc("lease.conflicts")
             return False  # another candidate won this round
         self.epoch = prior_epoch + 1
@@ -171,8 +201,11 @@ class MaintenanceLease:
             return False
         store = _store(self.conf)
         now = time.time()
-        if store.put_if_generation_match(
-                LEASE_KEY, self._record(self.epoch, now), self._gen):
+        t0 = time.monotonic()
+        renewed = store.put_if_generation_match(
+            LEASE_KEY, self._record(self.epoch, now), self._gen)
+        self._observe_latency(time.monotonic() - t0)
+        if renewed:
             self._gen += 1
             self._expires_at = now + ttl_s(self.conf)
             metrics.inc("lease.renews")

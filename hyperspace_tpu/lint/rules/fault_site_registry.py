@@ -22,7 +22,7 @@ _SCAN_INCLUDE = ("hyperspace_tpu/", "tests/", "bench.py")
 _SCAN_EXCLUDE = ("hyperspace_tpu/lint/", "tests/test_lint.py")
 
 # faults.<fn>(...) -> positional index of the site argument.
-_SITE_ARG = {"check": 0, "fire": 0, "corrupt_file": 0,
+_SITE_ARG = {"check": 0, "fire": 0, "net": 0, "corrupt_file": 0,
              "write_payload": 2, "atomic_replace": 2}
 
 

@@ -149,6 +149,7 @@ def doctor(session, fleet: bool = False) -> DoctorReport:
             _guarded("maintenance", lambda: _check_maintenance(session)),
             _guarded("perf", lambda: _check_perf(session)),
             _guarded("serving", lambda: _check_serving(session)),
+            _guarded("client", lambda: _check_client(session)),
             _guarded("degraded", lambda: _check_degraded(session)),
             _guarded("lint", lambda: _check_lint(session)),
             _guarded("device_skew",
@@ -317,6 +318,35 @@ def _check_serving(session) -> DoctorCheck:
         "serving", "ok",
         f"{int(requests)} requests, shed ratio {shed_ratio:.2f}, "
         f"SLO burn {burn:.2f}", data)
+
+
+def _check_client(session) -> DoctorCheck:
+    """Front-door health (FleetQueryClient in THIS process): open
+    circuit breakers mean whole endpoints are being routed around —
+    the fleet is effectively smaller than provisioned — and a high
+    hedge rate means the configured hedge delay no longer matches the
+    fleet's actual latency."""
+    from hyperspace_tpu.telemetry import metrics
+
+    snap = metrics.snapshot()
+    open_now = int(float(snap.get("client.breaker.open_now", 0.0) or 0.0))
+    opens = float(snap.get("client.breaker.open", 0.0) or 0.0)
+    hedged = float(snap.get("client.hedge.sent", 0.0) or 0.0)
+    wins = float(snap.get("client.hedge.wins", 0.0) or 0.0)
+    data = {"breaker_open_now": open_now, "breaker_opens": int(opens),
+            "hedges_sent": int(hedged), "hedge_wins": int(wins)}
+    if open_now > 0:
+        return DoctorCheck(
+            "client", "warn",
+            f"{open_now} endpoint breaker(s) OPEN — requests are being "
+            f"routed around them; check those servers (docs/20 FAQ: "
+            f"tuning hyperspace.client.breaker.*)", data)
+    if opens > 0 or hedged > 0:
+        return DoctorCheck(
+            "client", "ok",
+            f"breakers closed ({int(opens)} open event(s) so far), "
+            f"{int(hedged)} hedge(s) sent / {int(wins)} won", data)
+    return DoctorCheck("client", "ok", "no front-door traffic", data)
 
 
 def _slo_burn(hist_snapshot, slo_ms: float) -> float:

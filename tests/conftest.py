@@ -49,6 +49,8 @@ _HEAVY_MODULES = frozenset({
     "test_interop",             # Arrow-IPC server + C++ client build
     "test_external_build",      # streaming spill builds
     "test_bench_resilience",    # runs bench.py end-to-end in subprocesses
+    "test_chaos",               # seeded fleet chaos drill (3-server fleet)
+    "test_netfaults",           # wire-fault drills + SIGSTOP subprocesses
 })
 
 

@@ -1,0 +1,839 @@
+"""Wire-level chaos: deterministic net-fault injection at the interop
+socket seams (interop/netfaults.py), the front door's circuit breakers,
+hedged requests and single deadline budget, stale-pool eviction, the
+SIGSTOP gray-failure drill, and the lease's store-latency margin +
+epoch fencing (docs/20-fleet-serving.md)."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import Hyperspace, HyperspaceSession
+from hyperspace_tpu.interop import (
+    FleetQueryClient,
+    QueryClient,
+    QueryServer,
+)
+from hyperspace_tpu.interop import netfaults
+from hyperspace_tpu.interop.server import _Endpoint
+from hyperspace_tpu.io import faults
+from hyperspace_tpu.lifecycle import journal as lifecycle_journal
+from hyperspace_tpu.lifecycle import lease as lease_mod
+from hyperspace_tpu.telemetry import metrics
+
+
+def _counter(name):
+    return metrics.registry().counter(name)
+
+
+@pytest.fixture()
+def env(tmp_path):
+    data = str(tmp_path / "data")
+    os.makedirs(data)
+    n = 500
+    pq.write_table(pa.table({
+        "k": pa.array(np.arange(n, dtype=np.int64)),
+        "v": pa.array(np.arange(n, dtype=np.int64) * 3),
+    }), os.path.join(data, "f.parquet"))
+    s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+    s.conf.num_buckets = 4
+    return s, data
+
+
+def _point_spec(data, k):
+    return {"source": {"format": "parquet", "path": data},
+            "filter": {"op": "==", "col": "k", "value": int(k)},
+            "select": ["k", "v"]}
+
+
+@pytest.fixture(autouse=True)
+def _clear_net_state():
+    yield
+    faults.clear()
+    netfaults.clear_parked()
+
+
+# ---------------------------------------------------------------------------
+# The plan: net kinds, net sites, channel gating
+# ---------------------------------------------------------------------------
+class TestNetFaultPlan:
+    def test_net_sites_registered(self):
+        for site in ("net.connect", "net.send", "net.recv", "net.accept"):
+            assert site in faults.SITES
+
+    def test_net_kind_requires_net_site(self):
+        with pytest.raises(ValueError, match="net"):
+            faults.FaultPlan(site="store.put", kind="reset")
+
+    def test_storage_kind_rejected_at_net_site(self):
+        with pytest.raises(ValueError, match="net"):
+            faults.FaultPlan(site="net.send", kind="eio")
+
+    def test_net_checkpoint_fires_only_net_channel(self):
+        faults.install(faults.FaultPlan(site="net.send", kind="reset",
+                                        at=1, count=-1))
+        # The storage checkpoints never see a net plan...
+        assert not faults.FaultPlan(
+            site="net.send", kind="reset")._should_fire("net.send")
+        # ...and the net checkpoint arbitrates site + order as usual.
+        assert faults.net("net.recv") is None
+        assert faults.net("net.send") is not None
+
+    def test_quiet_suppresses_net_faults(self):
+        faults.install(faults.FaultPlan(site="net.send", kind="reset",
+                                        at=1, count=-1))
+        with faults.quiet():
+            assert faults.net("net.send") is None
+        assert faults.net("net.send") is not None
+
+    def test_at_count_window(self):
+        faults.install(faults.FaultPlan(site="net.connect", kind="refused",
+                                        at=2, count=1))
+        assert faults.net("net.connect") is None      # call 1: before at
+        assert faults.net("net.connect") is not None  # call 2: fires
+        assert faults.net("net.connect") is None      # call 3: spent
+
+    def test_conf_arming_carries_shaping(self, tmp_path):
+        s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+        s.conf.set("hyperspace.system.faultInjection.enabled", True)
+        s.conf.set("hyperspace.system.faultInjection.site", "net.recv")
+        s.conf.set("hyperspace.system.faultInjection.kind", "slow")
+        s.conf.set("hyperspace.system.faultInjection.latencyMs", 7.5)
+        s.conf.set("hyperspace.system.faultInjection.hangS", 0.125)
+        faults.install_from_conf(s.conf)
+        plan = faults.active()
+        assert plan is not None and plan.kind == "slow"
+        assert plan.latency_ms == 7.5 and plan.hang_s == 0.125
+
+
+# ---------------------------------------------------------------------------
+# The seams, against raw TCP sockets
+# ---------------------------------------------------------------------------
+def _tcp_pair():
+    listener = socket.create_server(("127.0.0.1", 0))
+    client = socket.create_connection(listener.getsockname())
+    server, _ = listener.accept()
+    listener.close()
+    return client, server
+
+
+class TestNetSeams:
+    def test_connect_refused(self):
+        faults.install(faults.FaultPlan(site="net.connect", kind="refused"))
+        with pytest.raises(ConnectionRefusedError, match="injected"):
+            netfaults.connect(("127.0.0.1", 1))
+
+    def test_connect_black_hole_hangs_then_times_out(self):
+        faults.install(faults.FaultPlan(site="net.connect",
+                                        kind="black-hole", hang_s=0.08))
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError, match="black-hole"):
+            netfaults.connect(("127.0.0.1", 1))
+        assert time.monotonic() - t0 >= 0.08
+
+    def test_connect_slow_still_dials(self):
+        listener = socket.create_server(("127.0.0.1", 0))
+        faults.install(faults.FaultPlan(site="net.connect", kind="slow",
+                                        latency_ms=60.0))
+        t0 = time.monotonic()
+        sock = netfaults.connect(listener.getsockname())
+        assert time.monotonic() - t0 >= 0.06
+        sock.close()
+        listener.close()
+
+    def test_send_torn_frame_delivers_half_then_reset(self):
+        client, server = _tcp_pair()
+        faults.install(faults.FaultPlan(site="net.send",
+                                        kind="torn-frame"))
+        payload = b"x" * 4096
+        with pytest.raises(ConnectionResetError, match="torn frame"):
+            netfaults.send_all(client, payload)
+        got = b""
+        server.settimeout(2.0)
+        try:
+            while True:
+                chunk = server.recv(65536)
+                if not chunk:
+                    break
+                got += chunk
+        except OSError:
+            pass  # RST close surfaces as ECONNRESET — equally torn
+        assert 0 < len(got) < len(payload)
+        server.close()
+
+    def test_send_disarmed_passes_through(self):
+        client, server = _tcp_pair()
+        netfaults.send_all(client, b"hello")
+        server.settimeout(2.0)
+        assert server.recv(64) == b"hello"
+        client.close()
+        server.close()
+
+    def test_before_recv_black_hole(self):
+        faults.install(faults.FaultPlan(site="net.recv", kind="black-hole",
+                                        hang_s=0.05))
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            netfaults.before_recv()
+        assert time.monotonic() - t0 >= 0.05
+
+    def test_on_accept_reset_consumes_connection(self):
+        client, server = _tcp_pair()
+        faults.install(faults.FaultPlan(site="net.accept", kind="reset"))
+        assert netfaults.on_accept(server) is False
+        client.settimeout(2.0)
+        with pytest.raises(OSError):
+            if client.recv(1) == b"":       # FIN still counts as dead
+                raise ConnectionResetError
+        client.close()
+
+    def test_on_accept_black_hole_parks_open(self):
+        client, server = _tcp_pair()
+        faults.install(faults.FaultPlan(site="net.accept",
+                                        kind="black-hole"))
+        assert netfaults.on_accept(server) is False
+        # Parked: the peer sees neither data nor FIN.
+        client.settimeout(0.2)
+        with pytest.raises(socket.timeout):
+            client.recv(1)
+        netfaults.clear_parked()
+        client.close()
+
+    def test_on_accept_disarmed_and_slow_pass_through(self):
+        client, server = _tcp_pair()
+        assert netfaults.on_accept(server) is True
+        faults.install(faults.FaultPlan(site="net.accept", kind="slow"))
+        assert netfaults.on_accept(server) is True
+        client.close()
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# The seams, through the real client/server wire path
+# ---------------------------------------------------------------------------
+class TestWirePathFaults:
+    def test_connect_refused_fails_over(self, env):
+        s, data = env
+        retry0 = _counter("client.retry")
+        with QueryServer(s) as server:
+            with FleetQueryClient([server.address, server.address]) as fc:
+                faults.install(faults.FaultPlan(
+                    site="net.connect", kind="refused", at=1, count=1))
+                assert fc.query(_point_spec(data, 7)) \
+                    .column("v").to_pylist() == [21]
+        assert _counter("client.retry") - retry0 >= 1
+
+    def test_torn_response_frame_is_retryable(self, env):
+        """An armed torn-frame on the server's response: the client
+        must surface a retryable ConnectionError (never a raw Arrow
+        decode error), and the front door must recover bit-equal."""
+        s, data = env
+        with QueryServer(s) as server:
+            # Seam order: client request send = 1, server response
+            # send = 2 — tear the response.
+            faults.install(faults.FaultPlan(
+                site="net.send", kind="torn-frame", at=2, count=1))
+            with QueryClient(server.address) as c:
+                with pytest.raises(ConnectionError):
+                    c.query(_point_spec(data, 3))
+            faults.install(faults.FaultPlan(
+                site="net.send", kind="torn-frame", at=2, count=1))
+            with FleetQueryClient([server.address, server.address]) as fc:
+                assert fc.query(_point_spec(data, 3)) \
+                    .column("v").to_pylist() == [9]
+
+    def test_recv_reset_fails_over(self, env):
+        s, data = env
+        with QueryServer(s) as server:
+            faults.install(faults.FaultPlan(
+                site="net.recv", kind="reset", at=1, count=1))
+            with FleetQueryClient([server.address, server.address]) as fc:
+                assert fc.query(_point_spec(data, 4)) \
+                    .column("v").to_pylist() == [12]
+
+    def test_accept_reset_fails_over(self, env):
+        s, data = env
+        with QueryServer(s) as server:
+            faults.install(faults.FaultPlan(
+                site="net.accept", kind="reset", at=1, count=1))
+            with FleetQueryClient([server.address, server.address]) as fc:
+                assert fc.query(_point_spec(data, 5)) \
+                    .column("v").to_pylist() == [15]
+
+    def test_slow_recv_shapes_latency_only(self, env):
+        s, data = env
+        with QueryServer(s) as server:
+            with QueryClient(server.address) as c:
+                c.query(_point_spec(data, 1))  # warm (dataset open)
+                faults.install(faults.FaultPlan(
+                    site="net.recv", kind="slow", at=1, count=1,
+                    latency_ms=120.0))
+                t0 = time.monotonic()
+                assert c.query(_point_spec(data, 6)) \
+                    .column("v").to_pylist() == [18]
+                assert time.monotonic() - t0 >= 0.12
+
+
+# ---------------------------------------------------------------------------
+# Satellite 1: pooled-connection validation / stale-socket eviction
+# ---------------------------------------------------------------------------
+class TestStalePoolEviction:
+    def test_bounced_server_socket_evicted_without_retry(self, tmp_path):
+        """SIGKILL + same-port restart leaves half-open TCP in the
+        client's pool; checkout validation must eat it silently — a
+        fresh dial, not a reset charged to retry accounting."""
+        data = str(tmp_path / "data")
+        os.makedirs(data)
+        pq.write_table(pa.table({
+            "k": pa.array(np.arange(100, dtype=np.int64)),
+            "v": pa.array(np.arange(100, dtype=np.int64) * 3),
+        }), os.path.join(data, "f.parquet"))
+        env_vars = dict(os.environ, JAX_PLATFORMS="cpu")
+
+        def _spawn(port=0):
+            p = subprocess.Popen(
+                [sys.executable, "-c", _SERVER_CHILD,
+                 str(tmp_path / "ix"), str(port)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, env=env_vars)
+            line = p.stdout.readline()
+            assert line, p.stderr.read()
+            return p, json.loads(line)["port"]
+
+        proc, port = _spawn()
+        fc = FleetQueryClient([("127.0.0.1", port)])
+        try:
+            assert fc.query(_point_spec(data, 2)) \
+                .column("v").to_pylist() == [6]
+            assert fc._endpoints[0].idle  # the connection was pooled
+            proc.kill()
+            proc.wait(timeout=30)
+            proc, _ = _spawn(port)       # bounce: same port, new pid
+            retry0 = _counter("client.retry")
+            evict0 = _counter("client.pool.evicted")
+            assert fc.query(_point_spec(data, 8)) \
+                .column("v").to_pylist() == [24]
+            # The stale socket was caught at CHECKOUT — a fresh dial,
+            # not a failed request turned into a retry.
+            assert _counter("client.pool.evicted") - evict0 >= 1
+            assert _counter("client.retry") - retry0 == 0
+        finally:
+            fc.close()
+            proc.kill()
+            proc.wait(timeout=30)
+
+    def test_healthy_pooled_socket_not_evicted(self, env):
+        s, data = env
+        with QueryServer(s) as server:
+            with FleetQueryClient([server.address]) as fc:
+                evict0 = _counter("client.pool.evicted")
+                for k in range(5):
+                    fc.query(_point_spec(data, k))
+                assert _counter("client.pool.evicted") - evict0 == 0
+
+
+# ---------------------------------------------------------------------------
+# Satellite 2: ONE deadline budget across every failover attempt
+# ---------------------------------------------------------------------------
+class _BusyEndpoint:
+    """Answers every request line with retryable ``ERR BUSY`` + a
+    retry-after hint, then closes (mirrors test_fleet_serving)."""
+
+    def __init__(self, retry_after_ms=300):
+        self._listener = socket.create_server(("127.0.0.1", 0))
+        self.address = self._listener.getsockname()
+        self._hint = retry_after_ms
+        self.hits = 0
+        self._stop = False
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            try:
+                f = conn.makefile("rb")
+                if f.readline():
+                    self.hits += 1
+                    conn.sendall(
+                        f"ERR BUSY admission queue full; retry later "
+                        f"retry-after-ms={self._hint}\n".encode())
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self):
+        self._stop = True
+        self._listener.close()
+
+
+class _SilentEndpoint:
+    """Accepts and reads, never answers — a gray server."""
+
+    def __init__(self):
+        self._listener = socket.create_server(("127.0.0.1", 0))
+        self.address = self._listener.getsockname()
+        self._stop = False
+        self._conns = []
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            self._conns.append(conn)  # hold open; never reply
+
+    def close(self):
+        self._stop = True
+        self._listener.close()
+        for conn in self._conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+class TestDeadlineBudget:
+    def test_busy_retries_spend_one_budget(self, env):
+        s, data = env
+        busy = [_BusyEndpoint(retry_after_ms=300) for _ in range(2)]
+        try:
+            with FleetQueryClient([b.address for b in busy],
+                                  max_attempts=10) as fc:
+                t0 = time.monotonic()
+                with pytest.raises(Exception):
+                    fc.query(_point_spec(data, 1), deadline_ms=700)
+                elapsed = time.monotonic() - t0
+            # 10 attempts x 300 ms hinted backoff would be ~3 s; ONE
+            # 700 ms budget caps the whole call.
+            assert elapsed < 1.8, elapsed
+            assert sum(b.hits for b in busy) >= 2  # it did retry
+        finally:
+            for b in busy:
+                b.close()
+
+    def test_gray_endpoint_timeout_leaves_failover_budget(self, env):
+        """The per-attempt socket timeout spreads the budget: a silent
+        endpoint costs a slice of the deadline, not all of it, so the
+        next attempt still has budget to succeed."""
+        s, data = env
+        silent = _SilentEndpoint()
+        try:
+            with QueryServer(s) as server:
+                with FleetQueryClient([silent.address, server.address],
+                                      max_attempts=4) as fc:
+                    answers = []
+                    t0 = time.monotonic()
+                    for k in range(4):
+                        answers.append(
+                            fc.query(_point_spec(data, k),
+                                     deadline_ms=4000)
+                            .column("v").to_pylist())
+                    elapsed = time.monotonic() - t0
+            assert answers == [[0], [3], [6], [9]]
+            assert elapsed < 16.0
+        finally:
+            silent.close()
+
+    def test_deadline_exhausted_raises_timeout(self, env):
+        s, data = env
+        silent = _SilentEndpoint()
+        try:
+            with FleetQueryClient([silent.address],
+                                  max_attempts=3) as fc:
+                t0 = time.monotonic()
+                with pytest.raises(OSError):
+                    fc.query(_point_spec(data, 1), deadline_ms=600)
+                elapsed = time.monotonic() - t0
+            assert elapsed < 2.5, elapsed
+        finally:
+            silent.close()
+
+
+# ---------------------------------------------------------------------------
+# Tentpole: per-endpoint circuit breakers
+# ---------------------------------------------------------------------------
+class TestCircuitBreaker:
+    def test_transitions(self):
+        ep = _Endpoint(("127.0.0.1", 1))
+        now = time.monotonic()
+        assert not ep.breaker_blocked(now)
+        assert not ep.breaker_failure(3, 10.0)
+        assert not ep.breaker_failure(3, 10.0)
+        assert ep.breaker_failure(3, 10.0)        # third opens
+        assert ep.breaker_state == "open"
+        assert ep.breaker_blocked(time.monotonic())
+        assert not ep.breaker_on_pick(time.monotonic())  # still cooling
+        ep.breaker_until = time.monotonic() - 0.01       # cooldown over
+        assert ep.breaker_on_pick(time.monotonic())      # -> half-open
+        assert ep.breaker_state == "half-open"
+        assert ep.breaker_blocked(time.monotonic())      # probe in flight
+        assert ep.breaker_failure(3, 10.0)        # probe failed: re-open
+        assert ep.breaker_state == "open"
+        ep.breaker_until = time.monotonic() - 0.01
+        assert ep.breaker_on_pick(time.monotonic())
+        assert ep.breaker_success()               # probe served: closed
+        assert ep.breaker_state == "closed"
+        assert not ep.breaker_blocked(time.monotonic())
+
+    def test_success_resets_failure_streak(self):
+        ep = _Endpoint(("127.0.0.1", 1))
+        ep.breaker_failure(3, 10.0)
+        ep.breaker_failure(3, 10.0)
+        assert not ep.breaker_success()  # closed stays closed
+        assert ep.breaker_fails == 0
+        assert not ep.breaker_failure(3, 10.0)  # streak restarted
+
+    def test_open_breaker_routes_away_until_probe(self, env):
+        s, data = env
+        busy = _BusyEndpoint(retry_after_ms=50)
+        open0 = _counter("client.breaker.open")
+        close0 = _counter("client.breaker.close")
+        try:
+            with QueryServer(s) as server:
+                with FleetQueryClient(
+                        [busy.address, server.address],
+                        breaker_enabled=True, breaker_failures=1,
+                        breaker_cooldown_ms=60_000.0) as fc:
+                    for k in range(8):
+                        assert fc.query(_point_spec(data, k)) \
+                            .column("v").to_pylist() == [3 * k]
+                    # The busy endpoint tripped its breaker on the
+                    # first failure and was never routed to again
+                    # (the cooldown outlives the test).
+                    assert busy.hits == 1
+                    assert fc._endpoints[0].breaker_state == "open"
+                    assert metrics.snapshot()[
+                        "client.breaker.open_now"] >= 1.0
+        finally:
+            busy.close()
+        assert _counter("client.breaker.open") - open0 >= 1
+        assert _counter("client.breaker.close") - close0 == 0
+
+    def test_half_open_probe_closes_on_recovery(self, env):
+        s, data = env
+        with QueryServer(s) as server:
+            with FleetQueryClient(
+                    [server.address, server.address],
+                    breaker_enabled=True, breaker_failures=1,
+                    breaker_cooldown_ms=50.0) as fc:
+                # Manufacture an open breaker on endpoint 0, as if it
+                # had failed — the server itself is healthy, so the
+                # probe after the cooldown succeeds and closes it.
+                fc._endpoints[0].breaker_failure(1, 0.05)
+                close0 = _counter("client.breaker.close")
+                time.sleep(0.08)  # cooldown elapses
+                for k in range(6):
+                    fc.query(_point_spec(data, k))
+                assert fc._endpoints[0].breaker_state == "closed"
+                assert _counter("client.breaker.close") - close0 >= 1
+                assert metrics.snapshot()[
+                    "client.breaker.open_now"] == 0.0
+
+    def test_all_breakers_open_still_serves(self, env):
+        """Breakers shape routing; they never refuse work outright."""
+        s, data = env
+        with QueryServer(s) as server:
+            with FleetQueryClient([server.address],
+                                  breaker_enabled=True,
+                                  breaker_failures=1) as fc:
+                fc._endpoints[0].breaker_failure(1, 60.0)
+                assert fc.query(_point_spec(data, 9)) \
+                    .column("v").to_pylist() == [27]
+
+
+# ---------------------------------------------------------------------------
+# Tentpole: hedged requests
+# ---------------------------------------------------------------------------
+class TestHedging:
+    def test_hedge_beats_slow_primary(self, env):
+        """Arm a one-shot slow ``net.recv`` per query: the PRIMARY's
+        read (first through the seam) stalls 400 ms, the hedge fires at
+        40 ms against the other endpoint, reads clean, and wins."""
+        s, data = env
+        with QueryServer(s) as s1, QueryServer(s) as s2:
+            with FleetQueryClient([s1.address, s2.address],
+                                  hedge_enabled=True, hedge_delay_ms=40.0,
+                                  max_attempts=2) as fc:
+                for k in range(4):  # warm both endpoints, no faults
+                    fc.query(_point_spec(data, k))
+                sent0 = _counter("client.hedge.sent")
+                wins0 = _counter("client.hedge.wins")
+                for k in range(3):
+                    faults.install(faults.FaultPlan(
+                        site="net.recv", kind="slow", at=1, count=1,
+                        latency_ms=400.0))
+                    assert fc.query(_point_spec(data, k),
+                                    deadline_ms=8000) \
+                        .column("v").to_pylist() == [3 * k]
+                    faults.clear()
+        sent = _counter("client.hedge.sent") - sent0
+        wins = _counter("client.hedge.wins") - wins0
+        assert sent == 3
+        assert 1 <= wins <= sent
+
+    def test_no_hedge_when_primary_fast(self, env):
+        s, data = env
+        with QueryServer(s) as server:
+            with FleetQueryClient(
+                    [server.address, server.address],
+                    hedge_enabled=True, hedge_delay_ms=2000.0) as fc:
+                fc.query(_point_spec(data, 0))  # warm
+                sent0 = _counter("client.hedge.sent")
+                for k in range(5):
+                    fc.query(_point_spec(data, k))
+                assert _counter("client.hedge.sent") - sent0 == 0
+
+    def test_loser_response_never_cross_wires(self, env):
+        """After a hedge wins, the slow primary still finishes reading
+        its OWN late response, which is discarded by request_id —
+        follow-up queries on the same pooled connections stay
+        bit-equal (no frame from the loser leaks into a later
+        answer)."""
+        s, data = env
+        with QueryServer(s) as s1, QueryServer(s) as s2:
+            with FleetQueryClient([s1.address, s2.address],
+                                  hedge_enabled=True, hedge_delay_ms=30.0,
+                                  max_attempts=2) as fc:
+                for k in range(4):
+                    fc.query(_point_spec(data, k))
+                faults.install(faults.FaultPlan(
+                    site="net.recv", kind="slow", at=1, count=1,
+                    latency_ms=300.0))
+                fc.query(_point_spec(data, 10), deadline_ms=6000)
+                faults.clear()
+                time.sleep(0.5)  # let the loser finish its late read
+                for k in range(20, 30):
+                    assert fc.query(_point_spec(data, k),
+                                    deadline_ms=6000) \
+                        .column("v").to_pylist() == [3 * k]
+
+    def test_single_endpoint_never_hedges(self, env):
+        s, data = env
+        with QueryServer(s) as server:
+            with FleetQueryClient([server.address],
+                                  hedge_enabled=True,
+                                  hedge_delay_ms=1.0) as fc:
+                sent0 = _counter("client.hedge.sent")
+                # Even a slow-looking first attempt has nowhere else
+                # to go with one endpoint.
+                for k in range(3):
+                    fc.query(_point_spec(data, k))
+                assert _counter("client.hedge.sent") - sent0 == 0
+
+    def test_adaptive_delay_tracks_ewma(self, env):
+        s, data = env
+        with QueryServer(s) as server:
+            with FleetQueryClient([server.address],
+                                  hedge_enabled=True) as fc:
+                assert fc._hedge_delay_s() == 0.050  # no history yet
+                for k in range(5):
+                    fc.query(_point_spec(data, k))
+                assert fc._lat_ewma_ms > 0.0
+                assert 0.010 <= fc._hedge_delay_s() <= 0.500
+
+
+# ---------------------------------------------------------------------------
+# Satellite 3: SIGSTOP gray failure through a real subprocess fleet
+# ---------------------------------------------------------------------------
+_SERVER_CHILD = r"""
+import json, os, sys
+from hyperspace_tpu import HyperspaceSession
+from hyperspace_tpu.interop import QueryServer
+s = HyperspaceSession(system_path=sys.argv[1])
+port = int(sys.argv[2]) if len(sys.argv) > 2 else 0
+server = QueryServer(s, port=port, handle_sigterm=True).start()
+print(json.dumps({"port": server.address[1], "pid": os.getpid()}),
+      flush=True)
+server.drained.wait()
+sys.exit(0)
+"""
+
+
+class TestSigstopGrayFailure:
+    def test_stopped_server_times_out_and_fails_over(self, tmp_path):
+        data = str(tmp_path / "data")
+        os.makedirs(data)
+        n = 200
+        pq.write_table(pa.table({
+            "k": pa.array(np.arange(n, dtype=np.int64)),
+            "v": pa.array(np.arange(n, dtype=np.int64) * 5),
+        }), os.path.join(data, "f.parquet"))
+        env_vars = dict(os.environ, JAX_PLATFORMS="cpu")
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", _SERVER_CHILD, str(tmp_path / "ix")],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env_vars) for _ in range(2)]
+        stopped_pid = None
+        try:
+            children = []
+            for p in procs:
+                line = p.stdout.readline()
+                assert line, p.stderr.read()
+                children.append(json.loads(line))
+            endpoints = [("127.0.0.1", c["port"]) for c in children]
+            spec = {"source": {"format": "parquet", "path": data},
+                    "filter": {"op": "==", "col": "k", "value": 0},
+                    "select": ["k", "v"]}
+            retry0 = _counter("client.retry")
+            fail0 = _counter("client.failover")
+            hedge0 = _counter("client.hedge.sent")
+            with FleetQueryClient(endpoints, max_attempts=4) as fc:
+                for k in range(4):  # warm both servers
+                    spec["filter"]["value"] = k
+                    assert fc.query(dict(spec)) \
+                        .column("v").to_pylist() == [5 * k]
+                stopped_pid = children[0]["pid"]
+                os.kill(stopped_pid, signal.SIGSTOP)  # alive, serves nothing
+                answered = []
+                for k in range(6):
+                    spec["filter"]["value"] = k
+                    answered.append(fc.query(dict(spec), deadline_ms=3000)
+                                    .column("v").to_pylist())
+                os.kill(stopped_pid, signal.SIGCONT)
+                stopped_pid = None
+                # Late responses from the woken server died with their
+                # discarded connections — follow-ups stay bit-equal.
+                for k in range(6):
+                    spec["filter"]["value"] = k
+                    assert fc.query(dict(spec), deadline_ms=3000) \
+                        .column("v").to_pylist() == [5 * k]
+            # ZERO lost: every request answered, bit-equal.
+            assert answered == [[5 * k] for k in range(6)]
+            retries = _counter("client.retry") - retry0
+            failovers = _counter("client.failover") - fail0
+            assert retries >= 1       # the gray timeouts surfaced
+            assert 1 <= failovers <= retries  # and routed away; no
+            # double-count: each retry is one failover at most, and
+            # hedging (off) never fired.
+            assert _counter("client.hedge.sent") - hedge0 == 0
+        finally:
+            if stopped_pid is not None:
+                try:
+                    os.kill(stopped_pid, signal.SIGCONT)
+                except OSError:
+                    pass
+            for p in procs:
+                p.kill()
+                p.wait(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# Tentpole: lease store-latency margin + epoch fencing
+# ---------------------------------------------------------------------------
+class TestLeaseMarginFencing:
+    def _conf(self, tmp_path, ttl=1.0):
+        s = HyperspaceSession(system_path=str(tmp_path / "ix"))
+        s.conf.set("hyperspace.lifecycle.lease.enabled", True)
+        s.conf.set("hyperspace.lifecycle.lease.ttlS", ttl)
+        return s.conf
+
+    def test_margin_scales_with_measured_latency(self, tmp_path):
+        conf = self._conf(tmp_path, ttl=1.0)
+        lease = lease_mod.MaintenanceLease(conf, owner="m")
+        assert lease.margin_s() == pytest.approx(0.02)  # cold floor
+        lease._lat_ewma_s = 0.05
+        assert lease.margin_s() == pytest.approx(0.10)  # 2 round-trips
+        lease._lat_ewma_s = 10.0
+        assert lease.margin_s() == pytest.approx(1.0 / 3.0)  # clamped
+
+    def test_acquire_measures_store_latency(self, tmp_path):
+        conf = self._conf(tmp_path)
+        lease = lease_mod.MaintenanceLease(conf, owner="a")
+        assert lease.try_acquire()
+        assert lease._lat_ewma_s > 0.0
+
+    def test_holder_stops_early_by_margin(self, tmp_path):
+        conf = self._conf(tmp_path, ttl=1.0)
+        lease = lease_mod.MaintenanceLease(conf, owner="a")
+        assert lease.try_acquire()
+        # A degraded store (slow CAS round-trips) widens the margin:
+        # the holder stands down BEFORE its wall-clock expiry.
+        lease._lat_ewma_s = 0.2          # margin = 0.333 (ttl/3 clamp)
+        lease._expires_at = time.time() + 0.3
+        assert not lease.holds()         # inside the margin: stop acting
+        lease._lat_ewma_s = 0.001        # healthy store: margin = 0.02
+        assert lease.holds()
+
+    def test_zombie_renew_is_fenced_after_takeover(self, tmp_path):
+        """The partition drill: holder A's renew is black-holed past
+        the TTL (modeled as the CAS arriving late), B takes over with
+        a bumped epoch, and A's late CAS loses — A is fenced, stands
+        down, and the journal carries the whole story."""
+        conf = self._conf(tmp_path, ttl=0.5)
+        a = lease_mod.MaintenanceLease(conf, owner="zombie")
+        b = lease_mod.MaintenanceLease(conf, owner="successor")
+        fenced0 = _counter("lease.fenced")
+        assert a.ensure()
+        assert a.epoch == 1
+        assert not b.ensure()            # live holder: B idles
+        time.sleep(0.6)                  # A's renews black-hole past TTL
+        assert not a.holds()             # wall clock already stopped A
+        assert b.ensure()                # expired: B takes over
+        assert b.epoch == 2
+        # A's delayed CAS finally lands — at a stale generation.
+        assert not a.renew()
+        assert not a._held
+        assert _counter("lease.fenced") - fenced0 == 1
+        status = lease_mod.status(conf)
+        assert status["holder"] == "successor"
+        assert status["epoch"] == 2
+        recs = lifecycle_journal.records(conf)
+        modes = [r.get("mode") for r in recs
+                 if r.get("decision") == "lease"]
+        assert "takeover" in modes and "fence" in modes
+        # Exactly one holder may execute: A re-competes as an ordinary
+        # candidate and loses while B's lease is fresh.
+        assert not a.ensure()
+        assert b.ensure()                # renew
+
+    def test_journal_proves_exactly_once_under_contention(self, tmp_path):
+        """Two processes' worth of lease handles racing ensure():
+        every round has at most ONE winner."""
+        conf = self._conf(tmp_path, ttl=5.0)
+        holders = [lease_mod.MaintenanceLease(conf, owner=f"h{i}")
+                   for i in range(3)]
+        for _ in range(4):
+            winners = [h for h in holders if h.ensure()]
+            assert len(winners) == 1
+            assert winners[0].owner == holders[0].owner  # stable holder
+
+
+# ---------------------------------------------------------------------------
+# Doctor: the client check
+# ---------------------------------------------------------------------------
+class TestDoctorClientCheck:
+    def test_warns_while_breaker_open(self, env):
+        s, _data = env
+        hs = Hyperspace(s)
+        metrics.set_gauge("client.breaker.open_now", 2.0)
+        try:
+            check = hs.doctor().check("client")
+            assert check.status == "warn"
+            assert "breaker" in check.summary
+        finally:
+            metrics.set_gauge("client.breaker.open_now", 0.0)
+
+    def test_ok_with_closed_breakers(self, env):
+        s, _data = env
+        metrics.set_gauge("client.breaker.open_now", 0.0)
+        check = Hyperspace(s).doctor().check("client")
+        assert check.status == "ok"
