@@ -1047,7 +1047,7 @@ SECTION_NAMES = ("setup", "sf1_queries", "device_agg_probe",
                  "warm_q10", "window_bench", "kernel_bench",
                  "calibration", "telemetry_overhead", "advisor",
                  "integrity", "build_profile", "timeline",
-                 "build_pipeline", "multichip", "serving",
+                 "build_pipeline", "multichip", "multihost", "serving",
                  "flight_recorder", "fleet_obs", "fleet", "chaos",
                  "ingest", "sf10", "sf100")
 
@@ -1103,6 +1103,7 @@ def main() -> int:
             harness.section("build_pipeline",
                             lambda: _sec_build_pipeline(root))
             harness.section("multichip", lambda: _sec_multichip(root))
+            harness.section("multihost", lambda: _sec_multihost(root))
             harness.section("serving", lambda: _sec_serving(ctx))
             harness.section("flight_recorder",
                             lambda: _sec_flight_recorder(ctx))
@@ -2555,6 +2556,174 @@ def _sec_multichip(root: str) -> dict:
         "bit_equal": True,
         "mesh_devices_8dev": legs[8]["mesh_devices"],
         "join_strategies_8dev": legs[8]["join_strategies"],
+    }}
+
+
+def _sec_multihost(root: str) -> dict:
+    """Fault-tolerant multi-host build acceptance (docs/21): the SAME
+    source builds at hosts=1 and hosts=2 through the claim pipeline,
+    recording per-phase claim-span medians (route / finalize — measured
+    from the claim records themselves, so subprocess interpreter spin-up
+    never pollutes the ratio) and the route speedup for ``--compare``.
+    Correctness-gated: every leg's index tree must be BYTE-identical to
+    the ordinary single-process build (per-bucket sha256).  The speedup
+    is gated only on hosts with >= 4 cores (two hosts + coordinator
+    share cores below that; the ratio is still recorded).  Ends with a
+    recovery drill — one host SIGKILLed once the claim table is live —
+    whose survivor must finish bit-equal with exactly ONE journaled
+    commit."""
+    import hashlib
+    import statistics
+    import threading
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig
+    from hyperspace_tpu.io.parquet import bucket_id_of_file
+    from hyperspace_tpu.lifecycle import journal as lifecycle_journal
+    from hyperspace_tpu.lifecycle.lease import WorkClaims
+    from hyperspace_tpu.parallel import multihost_build
+
+    n = max(24_000, N_LINEITEM // 60)
+    files = 4
+    mh_root = os.path.join(root, "multihost")
+    src = os.path.join(mh_root, "src")
+    os.makedirs(src, exist_ok=True)
+    rng = np.random.default_rng(43)
+    table = pa.table({
+        "k": pa.array(rng.integers(0, max(64, n // 16), size=n),
+                      type=pa.int64()),
+        "g": pa.array(rng.integers(0, 11, size=n), type=pa.int64()),
+        "v": pa.array(rng.integers(0, 1000, size=n), type=pa.int64()),
+    })
+    step = -(-n // files)
+    for f in range(files):
+        pq.write_table(table.slice(f * step, step),
+                       os.path.join(src, f"part-{f:05d}.parquet"))
+
+    def build(tag: str, hosts: int):
+        sess = HyperspaceSession(
+            system_path=os.path.join(mh_root, f"ix_{tag}"))
+        sess.conf.num_buckets = NUM_BUCKETS
+        sess.conf.device_batch_rows = max(4096, n // 12)
+        sess.conf.device_build_min_rows = 0
+        sess.conf.multihost_build_hosts = hosts
+        sess.conf.multihost_build_claim_ttl_s = 2.0
+        sess.conf.multihost_build_poll_s = 0.02
+        hs = Hyperspace(sess)
+        t0 = time.perf_counter()
+        hs.create_index(sess.read.parquet(src),
+                        IndexConfig("mh", ["k"], ["g", "v"]))
+        return sess, hs, time.perf_counter() - t0
+
+    def digests(sess):
+        entry = sess.index_collection_manager.get_index("mh")
+        out: dict = {}
+        for fi in entry.content.file_infos():
+            with open(fi.name, "rb") as fh:
+                out.setdefault(bucket_id_of_file(fi.name), []).append(
+                    hashlib.sha256(fh.read()).hexdigest())
+        return {b: sorted(v) for b, v in out.items()}
+
+    base_sess, _base_hs, base_wall = build("base", 0)
+    want = digests(base_sess)
+
+    reps = min(3, REPEATS)
+    walls: dict = {h: {"route": [], "finalize": [], "total": []}
+                   for h in (1, 2)}
+    for hosts in (1, 2):
+        for rep in range(reps):
+            sess, hs, _wall = build(f"{hosts}h_{rep}", hosts)
+            if digests(sess) != want:
+                raise SystemExit(
+                    f"multihost bench: the {hosts}-host index tree "
+                    "diverged from the single-process one — claims move "
+                    "work between hosts, never the layout")
+            props = hs.last_build_report().properties
+            walls[hosts]["route"].append(
+                float(props["multihost_route_wall_s"]))
+            walls[hosts]["finalize"].append(
+                float(props["multihost_finalize_wall_s"]))
+            walls[hosts]["total"].append(
+                float(props["multihost_total_wall_s"]))
+
+    med = statistics.median
+    route_speedup = med(walls[1]["route"]) / max(
+        med(walls[2]["route"]), 1e-9)
+    cores = os.cpu_count() or 1
+    gated = cores >= 4
+    if gated and route_speedup < 1.4:
+        raise SystemExit(
+            f"multihost bench: 2-host route only {route_speedup:.2f}x "
+            f"the 1-host route on a {cores}-core host "
+            f"(scaling gate: >= 1.4x)")
+
+    # Recovery drill: SIGKILL host 0 once the claim table is live; the
+    # survivor must reclaim its expired work and finish bit-equal, and
+    # the coordinator must commit exactly once (journal-proven).
+    killed: dict = {}
+    orig = multihost_build.spawn_hosts
+
+    def spawn_and_kill(conf, build_id, n_hosts):
+        procs = orig(conf, build_id, n_hosts)
+        store = multihost_build._store(conf, build_id)
+
+        def reaper():
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                if store.list_keys(WorkClaims.PREFIX):
+                    break
+                time.sleep(0.02)
+            p = procs[0]
+            if p.poll() is None:
+                try:
+                    os.kill(p.pid, signal.SIGKILL)
+                except OSError:
+                    pass
+            killed["pid"] = p.pid
+
+        threading.Thread(target=reaper, daemon=True).start()
+        return procs
+
+    multihost_build.spawn_hosts = spawn_and_kill
+    try:
+        t0 = time.perf_counter()
+        drill_sess, _drill_hs, _ = build("drill", 2)
+        recovery_wall = time.perf_counter() - t0
+    finally:
+        multihost_build.spawn_hosts = orig
+    if not killed:
+        raise SystemExit(
+            "multihost bench: the recovery drill never killed a host")
+    if digests(drill_sess) != want:
+        raise SystemExit(
+            "multihost bench: the post-SIGKILL survivor build diverged "
+            "from the single-process one — recovery must be bit-exact")
+    commits = sum(
+        1 for r in lifecycle_journal.records(drill_sess.conf)
+        if r.get("decision") == "claim" and r.get("mode") == "commit")
+    if commits != 1:
+        raise SystemExit(
+            f"multihost bench: expected exactly one journaled commit "
+            f"after the recovery drill, saw {commits}")
+
+    return {"multihost": {
+        "rows": n,
+        "cores": cores,
+        "reps": reps,
+        "build_s_inprocess": round(base_wall, 4),
+        "route_s_1host": round(med(walls[1]["route"]), 4),
+        "route_s_2host": round(med(walls[2]["route"]), 4),
+        "finalize_s_1host": round(med(walls[1]["finalize"]), 4),
+        "finalize_s_2host": round(med(walls[2]["finalize"]), 4),
+        "total_s_2host": round(med(walls[2]["total"]), 4),
+        "route_speedup_x": round(route_speedup, 3),
+        "speedup_gated": gated,
+        "bit_equal": True,
+        "recovery_wall_s": round(recovery_wall, 4),
+        "recovery_commits": commits,
     }}
 
 

@@ -338,6 +338,17 @@ class CreateActionBase(Action):
             str(self.conf.parallel_build).lower() in ("on", "true")
             and self._use_distributed_build())
         self._phase("plan_s", _time.perf_counter() - _t0)
+        from hyperspace_tpu.parallel import multihost_build
+        if multihost_build.armed(self.conf):
+            # Fault-tolerant multi-host build: N subprocess hosts route
+            # and finalize under crash-recoverable work claims; this
+            # action coordinates, validates the staged union, and keeps
+            # the ordinary base_id+2 commit as the single transaction.
+            multihost_build.run_multihost_build(
+                self, files, columns, relation, resolved, lineage,
+                batch_rows)
+            self._publish_build_stats()
+            return
         if streaming and resolved.layout == "zorder":
             # Z-order builds beyond one batch take a dedicated two-pass
             # path that preserves the GLOBAL layout (hash-partition
@@ -865,8 +876,12 @@ class _BucketSpill:
         # Contiguous bucket ranges per group: group of bucket b is the
         # gid with _bounds[gid] <= b < _bounds[gid + 1] — contiguous in
         # the chunk's sorted order, so a group's rows are one slice.
-        self._bounds = [-(-gid * self._num_buckets // self._groups)
-                        for gid in range(self._groups + 1)]
+        # The cuts are the shared ownership contract
+        # (parallel/sharded_build.bucket_group_bounds): the multi-host
+        # build claims the SAME ranges cross-host.
+        from hyperspace_tpu.parallel.sharded_build import bucket_group_bounds
+
+        self._bounds = bucket_group_bounds(self._num_buckets, self._groups)
         self._chunk_no = 0
         self._schema = None
         self._code_cols: tuple = ()

@@ -54,6 +54,10 @@ MESH_AGG_MIN_ROWS = "hyperspace.tpu.meshAggMinRows"
 BUILD_PIPELINE_ENABLED = "hyperspace.index.build.pipeline.enabled"
 BUILD_PREFETCH_DEPTH = "hyperspace.index.build.prefetchDepth"
 BUILD_FINALIZE_WORKERS = "hyperspace.index.build.finalizeWorkers"
+MULTIHOST_BUILD_HOSTS = "hyperspace.index.build.multihost.hosts"
+MULTIHOST_BUILD_CLAIM_TTL_S = "hyperspace.index.build.multihost.claimTtlS"
+MULTIHOST_BUILD_POLL_S = "hyperspace.index.build.multihost.pollS"
+MULTIHOST_BUILD_DEADLINE_S = "hyperspace.index.build.multihost.deadlineS"
 GLOBBING_PATTERN = "hyperspace.source.globbingPattern"
 DISPLAY_MODE = "hyperspace.explain.displayMode"
 HIGHLIGHT_BEGIN_TAG = "hyperspace.explain.displayMode.highlight.beginTag"
@@ -302,6 +306,29 @@ class HyperspaceConf:
     build_pipeline_enabled: bool = True
     build_prefetch_depth: int = 2
     build_finalize_workers: int = 4
+    # Fault-tolerant multi-host build (parallel/multihost_build.py;
+    # docs/21):
+    #   - multihost.hosts >= 2 runs createIndex as N subprocess hosts
+    #     cooperating through crash-recoverable work claims over the
+    #     LogStore CAS seam — each host routes claimed chunks, then
+    #     finalizes claimed bucket GROUPS into its own staging dir; the
+    #     coordinating action CAS-commits the union or nothing.  1 runs
+    #     one subprocess host through the same claim pipeline (the bench
+    #     baseline for the scaling ratio; also handy for debugging the
+    #     protocol without host interleaving).  0 = the ordinary
+    #     single-process build (zero multihost code runs).
+    #   - claimTtlS: a work claim expires this long after its last
+    #     renew; a SIGKILLed host's claims are reclaimed by survivors
+    #     after at most one TTL (epoch fencing keeps the zombie out).
+    #   - pollS: claim-table poll interval for hosts waiting on the
+    #     route phase to drain and for the coordinator.
+    #   - deadlineS: coordinator wall-clock budget; if claims stop
+    #     progressing (every host dead) the build fails loudly instead
+    #     of hanging.
+    multihost_build_hosts: int = 0
+    multihost_build_claim_ttl_s: float = 10.0
+    multihost_build_poll_s: float = 0.05
+    multihost_build_deadline_s: float = 600.0
     # Comma-separated glob pattern(s); when set, createIndex records the
     # pattern as the indexed root paths so later-appearing directories that
     # match are picked up by refresh (IndexConstants.scala:108-114).
@@ -610,6 +637,10 @@ class HyperspaceConf:
         BUILD_PIPELINE_ENABLED: "build_pipeline_enabled",
         BUILD_PREFETCH_DEPTH: "build_prefetch_depth",
         BUILD_FINALIZE_WORKERS: "build_finalize_workers",
+        MULTIHOST_BUILD_HOSTS: "multihost_build_hosts",
+        MULTIHOST_BUILD_CLAIM_TTL_S: "multihost_build_claim_ttl_s",
+        MULTIHOST_BUILD_POLL_S: "multihost_build_poll_s",
+        MULTIHOST_BUILD_DEADLINE_S: "multihost_build_deadline_s",
         DISPLAY_MODE: "display_mode",
         HIGHLIGHT_BEGIN_TAG: "highlight_begin_tag",
         HIGHLIGHT_END_TAG: "highlight_end_tag",

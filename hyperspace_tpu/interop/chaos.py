@@ -20,6 +20,11 @@ fleet under sustained mixed load:
   - **Maintenance churn** — every child runs lease-elected maintenance
     cycles while the drill appends source data, so exactly-once
     execution is contested, not vacuous.
+  - **Build-host death** — ``kill-build-host`` runs a concurrent
+    2-host multi-host index build (parallel/multihost_build.py) and
+    SIGKILLs one of its hosts once claims exist; the survivor must
+    finish a byte-identical index with exactly one journalled commit
+    while the serving fleet's own invariants keep holding.
 
 The schedule is a PURE function of the seed (:func:`build_schedule`):
 same seed ⇒ identical event list, which is what makes a chaos failure
@@ -34,7 +39,10 @@ they are end-state properties:
      the lifecycle journal with outcome ``done`` exactly once;
   4. metrics accounting: ``client.hedge.wins ≤ client.hedge.sent``,
      ``client.failover ≤ client.retry``, breaker closes ≤ opens, and
-     the ``client.breaker.open_now`` gauge within [0, servers].
+     the ``client.breaker.open_now`` gauge within [0, servers];
+  5. every ``kill-build-host`` drill completed: a host really died,
+     the survivor's index is byte-identical to the single-host
+     baseline, and the claim journal shows exactly one commit.
 
 Entry points: ``tools/chaos.py`` (CLI), the bench ``chaos`` section,
 and tests/test_chaos.py (smoke + schedule determinism).
@@ -45,6 +53,7 @@ from __future__ import annotations
 import json
 import os
 import random
+import shutil
 import signal
 import subprocess
 import sys
@@ -131,7 +140,7 @@ def build_schedule(seed: int, duration_s: float,
                            "server": target,
                            "stop_s": round(rng.uniform(0.4, 1.0), 3)})
             t += 1.4
-        elif roll < 0.80:
+        elif roll < 0.72:
             site, kind = _CLIENT_FAULTS[
                 rng.randrange(len(_CLIENT_FAULTS))]
             events.append({"t": round(t, 3), "op": "client-fault",
@@ -139,6 +148,14 @@ def build_schedule(seed: int, duration_s: float,
                            "at": rng.randrange(1, 4),
                            "count": rng.randrange(1, 4)})
             t += 0.8
+        elif roll < 0.80:
+            # Concurrent multi-host index build with one of ITS hosts
+            # SIGKILLed mid-route: the claim protocol (not the serving
+            # fleet) must absorb this one — the survivor finishes the
+            # byte-identical index while the drill's load keeps running.
+            events.append({"t": round(t, 3), "op": "kill-build-host",
+                           "victim": rng.randrange(2)})
+            t += 1.6
         else:
             site, kind = _SERVER_FAULTS[
                 rng.randrange(len(_SERVER_FAULTS))]
@@ -151,6 +168,80 @@ def build_schedule(seed: int, duration_s: float,
         events.append({"t": round(duration_s * 0.5, 3), "op": "append"})
         events.sort(key=lambda e: e["t"])
     return events
+
+
+def _build_drill(workdir: str, src: str, tag: int,
+                 victim: int) -> Dict[str, Any]:
+    """One ``kill-build-host`` drill: a 2-host multi-host build of
+    ``src`` with host ``victim`` SIGKILLed once claims exist, graded
+    byte-equal against a single-host build of the same snapshot and
+    exactly-once against its claim journal."""
+    import hashlib
+
+    from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig
+    from hyperspace_tpu.io.parquet import bucket_id_of_file
+    from hyperspace_tpu.lifecycle import journal as lifecycle_journal
+    from hyperspace_tpu.lifecycle.lease import WorkClaims
+    from hyperspace_tpu.parallel import multihost_build
+
+    def build(path: str, hosts: int):
+        sess = HyperspaceSession(system_path=path)
+        sess.conf.num_buckets = 4
+        sess.conf.multihost_build_hosts = hosts
+        sess.conf.multihost_build_claim_ttl_s = 1.0
+        sess.conf.multihost_build_poll_s = 0.02
+        Hyperspace(sess).create_index(
+            sess.read.parquet(src), IndexConfig("bix", ["k"], ["v"]))
+        return sess
+
+    def digests(sess) -> Dict[int, List[str]]:
+        entry = sess.index_collection_manager.get_index("bix")
+        out: Dict[int, List[str]] = {}
+        for fi in entry.content.file_infos():
+            with open(fi.name, "rb") as fh:
+                out.setdefault(bucket_id_of_file(fi.name), []).append(
+                    hashlib.sha256(fh.read()).hexdigest())
+        return {b: sorted(v) for b, v in out.items()}
+
+    base = build(os.path.join(workdir, f"bix-base-{tag}"), 0)
+    want = digests(base)
+
+    killed: Dict[str, Any] = {}
+    orig = multihost_build.spawn_hosts
+
+    def spawn_and_kill(conf, build_id, n):
+        procs = orig(conf, build_id, n)
+        store = multihost_build._store(conf, build_id)
+
+        def reaper():
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                if store.list_keys(WorkClaims.PREFIX):
+                    break
+                time.sleep(0.02)
+            p = procs[min(victim, len(procs) - 1)]
+            if p.poll() is None:
+                try:
+                    os.kill(p.pid, signal.SIGKILL)
+                except OSError:
+                    pass
+            killed["pid"] = p.pid
+
+        threading.Thread(target=reaper, daemon=True).start()
+        return procs
+
+    multihost_build.spawn_hosts = spawn_and_kill
+    try:
+        mh = build(os.path.join(workdir, f"bix-mh-{tag}"), 2)
+    finally:
+        multihost_build.spawn_hosts = orig
+    bit_equal = digests(mh) == want
+    commits = sum(
+        1 for r in lifecycle_journal.records(mh.conf)
+        if r.get("decision") == "claim" and r.get("mode") == "commit")
+    return {"tag": tag, "victim": victim, "killed": bool(killed),
+            "bit_equal": bit_equal, "commits": commits,
+            "ok": bool(killed) and bit_equal and commits == 1}
 
 
 class _Fleet:
@@ -257,6 +348,14 @@ def run_chaos(seed: int = 0, duration_s: float = 6.0, servers: int = 3,
         "k": pa.array(np.arange(n, dtype=np.int64)),
         "v": pa.array(np.arange(n, dtype=np.int64) * 3 + 1),
     }), os.path.join(data, "part-00000000.parquet"))
+    # A STABLE snapshot for the kill-build-host drills: the mid-drill
+    # append mutates ``data``, and the build drill's byte-equality
+    # baseline must see the same files as its 2-host leg.
+    bsrc = os.path.join(workdir, "bsrc")
+    os.makedirs(bsrc, exist_ok=True)
+    # hslint: allow[io-seam] drill-source snapshot copy, not index data
+    shutil.copy(os.path.join(data, "part-00000000.parquet"),
+                os.path.join(bsrc, "part-00000000.parquet"))
     # The mid-drill append adds keys >= n, so every load-thread answer
     # stays bit-equal across the append: point probes stay below n and
     # the aggregate filters to k < n.  The appended rows exist to make
@@ -292,6 +391,9 @@ def run_chaos(seed: int = 0, duration_s: float = 6.0, servers: int = 3,
 
     fleet = _Fleet(system_path, servers, base_conf)
     stop = threading.Event()
+    build_drills: List[Dict[str, Any]] = []
+    build_state: Dict[str, Any] = {"thread": None, "count": 0,
+                                   "skipped": 0}
     stats_lock = threading.Lock()
     stats = {"sent": 0, "answered": 0, "mismatch": 0, "lost": 0}
     clean_lat: List[float] = []
@@ -393,6 +495,27 @@ def run_chaos(seed: int = 0, duration_s: float = 6.0, servers: int = 3,
                     latency_ms=40.0, hang_s=0.3))
                 time.sleep(0.4)
                 faults.clear()
+            elif op == "kill-build-host":
+                prev = build_state["thread"]
+                if prev is not None and prev.is_alive():
+                    build_state["skipped"] += 1
+                else:
+                    tag = build_state["count"]
+                    build_state["count"] += 1
+
+                    def _drill(tag=tag, victim=event["victim"]):
+                        try:
+                            build_drills.append(
+                                _build_drill(workdir, bsrc, tag, victim))
+                        except Exception as exc:  # noqa: BLE001 — a
+                            # crashed drill IS the violation, not ours
+                            build_drills.append(
+                                {"tag": tag, "ok": False,
+                                 "error": str(exc)})
+
+                    th = threading.Thread(target=_drill, daemon=True)
+                    build_state["thread"] = th
+                    th.start()
             elif op == "bounce-armed":
                 fleet.kill(event["server"])
                 fleet.spawn(event["server"], extra_conf={
@@ -407,6 +530,9 @@ def run_chaos(seed: int = 0, duration_s: float = 6.0, servers: int = 3,
                 })
         # Let the fleet settle and the last retries land.
         time.sleep(1.0)
+        th = build_state["thread"]
+        if th is not None:
+            th.join(timeout=90.0)
         stop.set()
         for t in threads:
             t.join(timeout=deadline_ms / 1000.0 + 5.0)
@@ -461,6 +587,8 @@ def run_chaos(seed: int = 0, duration_s: float = 6.0, servers: int = 3,
         "pool_evicted": deltas["client.pool.evicted"],
         "retries": deltas["client.retry"],
         "failovers": deltas["client.failover"],
+        "build_drills": build_drills,
+        "build_drills_skipped": build_state["skipped"],
     })
     violations: List[str] = []
     if stats["lost"]:
@@ -482,6 +610,11 @@ def run_chaos(seed: int = 0, duration_s: float = 6.0, servers: int = 3,
     if not 0 <= open_now <= servers:
         violations.append(
             f"breaker open_now gauge {open_now} outside [0, {servers}]")
+    bad_builds = sum(1 for d in build_drills if not d.get("ok"))
+    if bad_builds:
+        violations.append(
+            f"{bad_builds} kill-build-host drill(s) failed "
+            f"(non-bit-equal, missing kill, or commits != 1)")
     report["violations"] = violations
     report["ok"] = not violations
     return report

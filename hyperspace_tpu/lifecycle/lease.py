@@ -271,3 +271,210 @@ class MaintenanceLease:
         if error:
             rec["error"] = error[:500]
         journal.append(self.conf, rec)
+
+
+class WorkClaims:
+    """A crash-recoverable work-claim table: the maintenance lease's
+    TTL + epoch-fencing protocol generalized from ONE singleton lease to
+    a SET of named work items (the multi-host build's chunk and
+    bucket-group claims, ``parallel/multihost_build.py``).
+
+    One JSON record per item at ``<store root>/claim-<item>`` (keys
+    stay flat: both LogStore backends list one level):
+
+      pending: ``{"v": 1, "item", "holder", "epoch", "acquired_at",
+                  "expires_at", "done": false}``
+      done:    ``{"v": 1, "item", "holder", "epoch", "done": true,
+                  "completed_at", "result": {...}}``
+
+    The protocol is the lease's, per item:
+
+      - ``try_claim`` CASes a fresh record over absent / torn / expired
+        pending records (an epoch bump per takeover); a done record is
+        final and never reclaimed.
+      - ``renew`` CASes against the holder's own last generation; a CAS
+        loss means the item was reclaimed while this process was paused
+        — the holder is **fenced** and must discard its work.
+      - ``complete`` commits the done record through the SAME CAS, so a
+        fenced zombie's completion loses deterministically: exactly one
+        done record per item ever lands, which is what makes the
+        downstream commit exactly-once.
+      - ``holds`` applies the store-RTT margin (``margin_s``): a holder
+        whose clock runs slow, or whose store link degraded, stands
+        down ``margin_s`` BEFORE wall-clock expiry — before a successor
+        can legitimately reclaim against the wall clock.
+
+    Acquire / reclaim / fence / complete events land in the lifecycle
+    journal under decision kind ``claim`` — the durable record the
+    exactly-once tests assert against, like ``lease.fenced``."""
+
+    PREFIX = "claim-"
+
+    def __init__(self, store, conf, owner: Optional[str] = None,
+                 ttl_s: float = 10.0, index: str = "") -> None:
+        from hyperspace_tpu.telemetry import fleet
+
+        self.store = store
+        self.conf = conf
+        self.owner = owner or fleet.process_identity()
+        self.ttl_s = max(0.1, float(ttl_s))
+        self.index = index
+        self._lat_ewma_s = 0.0
+
+    # -- state ---------------------------------------------------------------
+    def margin_s(self) -> float:
+        """Same headroom rule as :meth:`MaintenanceLease.margin_s`: two
+        measured store round-trips, clamped to [2% TTL, TTL/3]."""
+        return min(self.ttl_s / 3.0,
+                   max(2.0 * self._lat_ewma_s, 0.02 * self.ttl_s))
+
+    def holds(self, claim: Dict[str, Any]) -> bool:
+        """The claim is still safely ours: within ``margin_s`` of local
+        expiry the holder must stand down even though nobody fenced it
+        yet — the successor acquires against the wall clock, and the
+        margin covers the store latency our own operations measured."""
+        return time.time() < \
+            float(claim.get("expires_at", 0.0)) - self.margin_s()
+
+    def _observe_latency(self, elapsed_s: float) -> None:
+        self._lat_ewma_s = elapsed_s if self._lat_ewma_s <= 0.0 \
+            else 0.7 * self._lat_ewma_s + 0.3 * elapsed_s
+
+    def _key(self, item: str) -> str:
+        return self.PREFIX + item
+
+    def get(self, item: str):
+        """(record-or-None, generation) — a torn put reads as (None, g)
+        with its REAL burned generation, so reclaim CASes over it."""
+        t0 = time.monotonic()
+        payload, gen = self.store.read_with_generation(self._key(item))
+        self._observe_latency(time.monotonic() - t0)
+        return _parse(payload), gen
+
+    def result(self, item: str) -> Optional[Dict[str, Any]]:
+        """The completed item's result payload, or None while pending."""
+        rec, _gen = self.get(item)
+        if rec is not None and rec.get("done"):
+            return rec.get("result", {})
+        return None
+
+    def pending(self, items) -> list:
+        """The subset of ``items`` with no done record yet."""
+        return [it for it in items if self.result(it) is None]
+
+    # -- protocol ------------------------------------------------------------
+    def try_claim(self, item: str) -> Optional[Dict[str, Any]]:
+        """Claim one item if it is absent, torn, or expired.  Returns a
+        claim handle (``{"item", "epoch", "gen", "expires_at"}``) to
+        pass to renew/complete, or None (done, fresh holder, or a CAS
+        loss to a racing claimant)."""
+        from hyperspace_tpu.telemetry import metrics
+
+        rec, gen = self.get(item)
+        now = time.time()
+        if rec is not None:
+            if rec.get("done"):
+                return None  # final; never reclaimed
+            if float(rec.get("expires_at", 0.0)) > now:
+                return None  # live holder; poll again later
+        # A torn record hides its epoch, but every commit bumps the
+        # generation by at least one, so gen+1 stays monotonic past any
+        # epoch the burned record could have carried.
+        prior_epoch = int(rec.get("epoch", gen)) if rec is not None else gen
+        epoch = prior_epoch + 1
+        body = json.dumps({
+            "v": RECORD_VERSION, "item": item, "holder": self.owner,
+            "epoch": epoch, "acquired_at": now,
+            "expires_at": now + self.ttl_s, "done": False,
+        }).encode("utf-8")
+        t0 = time.monotonic()
+        committed = self.store.put_if_generation_match(
+            self._key(item), body, gen)
+        self._observe_latency(time.monotonic() - t0)
+        if not committed:
+            metrics.inc("claims.conflicts")
+            return None
+        claim = {"item": item, "epoch": epoch, "gen": gen + 1,
+                 "acquired_at": now, "expires_at": now + self.ttl_s}
+        if rec is not None or gen:
+            metrics.inc("claims.reclaims")
+            holder = rec.get("holder", "?") if rec is not None else "?"
+            self._note("reclaim", item, epoch,
+                       reason=f"expired/torn claim (holder {holder}) "
+                              f"taken over as epoch {epoch}")
+        else:
+            metrics.inc("claims.acquires")
+            self._note("acquire", item, epoch,
+                       reason=f"fresh claim, epoch {epoch}")
+        return claim
+
+    def renew(self, claim: Dict[str, Any]) -> bool:
+        """Extend our claim; False ⇒ FENCED (reclaimed under us) — the
+        caller must abandon the item's work immediately."""
+        from hyperspace_tpu.telemetry import metrics
+
+        now = time.time()
+        body = json.dumps({
+            "v": RECORD_VERSION, "item": claim["item"],
+            "holder": self.owner, "epoch": claim["epoch"],
+            "acquired_at": now, "expires_at": now + self.ttl_s,
+            "done": False,
+        }).encode("utf-8")
+        t0 = time.monotonic()
+        renewed = self.store.put_if_generation_match(
+            self._key(claim["item"]), body, claim["gen"])
+        self._observe_latency(time.monotonic() - t0)
+        if renewed:
+            claim["gen"] += 1
+            claim["expires_at"] = now + self.ttl_s
+            return True
+        metrics.inc("claims.fenced")
+        self._note("fence", claim["item"], claim["epoch"], outcome="error",
+                   reason=f"renew lost the CAS at epoch {claim['epoch']}; "
+                          f"claim reclaimed — standing down")
+        return False
+
+    def complete(self, claim: Dict[str, Any],
+                 result: Optional[Dict[str, Any]] = None) -> bool:
+        """Commit the item's done record through the claim's CAS.  False
+        ⇒ fenced: another holder reclaimed the item, and whatever this
+        one produced must be discarded (its staged files are orphans)."""
+        from hyperspace_tpu.telemetry import metrics
+
+        body = json.dumps({
+            "v": RECORD_VERSION, "item": claim["item"],
+            "holder": self.owner, "epoch": claim["epoch"], "done": True,
+            "acquired_at": claim.get("acquired_at", 0.0),
+            "completed_at": time.time(), "result": result or {},
+        }).encode("utf-8")
+        t0 = time.monotonic()
+        committed = self.store.put_if_generation_match(
+            self._key(claim["item"]), body, claim["gen"])
+        self._observe_latency(time.monotonic() - t0)
+        if committed:
+            claim["gen"] += 1
+            metrics.inc("claims.completes")
+            self._note("complete", claim["item"], claim["epoch"],
+                       reason=f"epoch {claim['epoch']} done")
+            return True
+        metrics.inc("claims.fenced")
+        self._note("fence", claim["item"], claim["epoch"], outcome="error",
+                   reason=f"complete lost the CAS at epoch "
+                          f"{claim['epoch']}; output discarded")
+        return False
+
+    # -- internals -----------------------------------------------------------
+    def _note(self, event: str, item: str, epoch: int, reason: str = "",
+              outcome: str = "done") -> None:
+        from hyperspace_tpu.lifecycle import journal
+
+        journal.append(self.conf, {
+            "decision": "claim",
+            "index": self.index,
+            "mode": event,
+            "reason": reason,
+            "outcome": outcome,
+            "holder": self.owner,
+            "epoch": epoch,
+            "item": item,
+        })

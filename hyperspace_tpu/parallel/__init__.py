@@ -20,7 +20,10 @@ from hyperspace_tpu.parallel.mesh import (
     make_shard_and_gather_fns,
     match_partition_rules,
 )
-from hyperspace_tpu.parallel.sharded_build import mesh_route_partition
+from hyperspace_tpu.parallel.sharded_build import (
+    bucket_group_bounds,
+    mesh_route_partition,
+)
 from hyperspace_tpu.parallel.multihost import (
     DCN_AXIS,
     ICI_AXIS,
@@ -40,6 +43,7 @@ __all__ = [
     "bucket_shuffle",
     "hierarchical_bucket_shuffle",
     "initialize_distributed",
+    "bucket_group_bounds",
     "match_partition_rules",
     "make_shard_and_gather_fns",
     "mesh_grouped_aggregate",

@@ -24,6 +24,15 @@ the flattened (slice, chip) order), so the result is BIT-IDENTICAL to
 pattern changes.  Capacity is padded per stage (the MoE-dispatch pattern)
 with overflow counted and retried, like the flat path.
 
+This collective path assumes every participant stays alive: a SIGKILLed
+host poisons the all_to_all and wedges the survivors.  The
+crash-TOLERANT cross-host build is ``parallel/multihost_build.py`` —
+the same bucket-ownership contract (``sharded_build.bucket_group_bounds``)
+executed through crash-recoverable work claims over the LogStore seam,
+where losing a host costs one claim TTL, not the build.  Use this
+module's collectives for healthy-pod throughput; use the claim build
+when partial failure is in scope.
+
 On real multi-host pods, call ``initialize_distributed()`` first (one
 process per host; jax.distributed wires the DCN coordinator), then
 ``build_mesh_2d(n_slices, chips_per_slice)``.  Single-host validation uses

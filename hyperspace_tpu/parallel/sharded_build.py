@@ -58,6 +58,16 @@ from hyperspace_tpu.parallel.shuffle import (
 )
 
 
+def bucket_group_bounds(num_buckets: int, groups: int) -> list:
+    """Contiguous bucket-range cuts shared by every ownership layer:
+    group (or host) ``g`` owns buckets ``bounds[g] <= b < bounds[g+1]``.
+    ``actions/create._BucketSpill`` cuts its spill/finalize groups with
+    this, and ``parallel/multihost_build`` claims the SAME ranges
+    cross-host — one contract, so a group finalized on any host is the
+    byte-identical unit a single process would have produced."""
+    return [-(-g * num_buckets // groups) for g in range(groups + 1)]
+
+
 def _route_body(num_buckets: int, num_devices: int, capacity: int,
                 n_key_cols: int, n_order_cols: int, pallas: bool,
                 hash_words, order_words, row_words, valid):

@@ -161,6 +161,10 @@ class _Parser:
         # FROM-order source registry: ({names}, [columns] or None) per
         # source, for qualified-reference validation.
         self.sources: List[Tuple[set, Optional[List[str]]]] = []
+        # Comma-style self-join lift: alias -> column prefix for later
+        # occurrences of an already-seen table, whose columns are
+        # renamed so every column has exactly one owning source.
+        self.qual_rename: Dict[str, str] = {}
         self._in_join_on = False
 
     # -- token plumbing --------------------------------------------------
@@ -448,11 +452,33 @@ class _Parser:
                 f"Unknown table {name!r}; pass it in sql(..., tables="
                 f"{{{name!r}: dataset_or_parquet_path}})")
         ds = self.session.read.parquet(src) if isinstance(src, str) else src
-        names = {name}
-        self.aliases.append(name)
+        alias = None
         if self.peek()[0] in _NAME_KINDS \
                 and not self._at_clause_kw():
             alias = self.next()[1]
+        if alias is not None and any(name in ns for ns, _c in self.sources):
+            # Self-join lift: a LATER occurrence of an already-seen
+            # table becomes an independent scan instance with its
+            # columns renamed to ``<alias>__<column>`` — every column
+            # then has exactly one owning source, so the comma-join
+            # assembly's owner() resolution (and qualified-reference
+            # validation) work unchanged.  Only the alias addresses the
+            # instance; unaliased select items keep the lifted engine
+            # name (``m.name`` -> output column ``m__name``) — use AS
+            # for SQL-style output names.
+            try:
+                cols = list(ds.columns)
+            except Exception:
+                self.fail(f"self-joined table {name!r} needs a "
+                          f"resolvable schema")
+            ds = ds.select(**{f"{alias}__{c}": Col(c) for c in cols})
+            self.qual_rename[alias] = f"{alias}__"
+            self.aliases.append(alias)
+            self._register_source({alias}, ds)
+            return ds
+        names = {name}
+        self.aliases.append(name)
+        if alias is not None:
             self.aliases.append(alias)
             names.add(alias)
         self._register_source(names, ds)
@@ -471,6 +497,7 @@ class _Parser:
         child.outer_columns = frozenset()
         child.aliases = []
         child.sources = []
+        child.qual_rename = {}
         child._in_join_on = False
         return child
 
@@ -720,14 +747,18 @@ class _Parser:
         """``alias.column`` with BINDING validation: the engine's Col has
         no qualifier, and a joined table exposes the FIRST (leftmost)
         source's copy under an ambiguous name — so a reference that
-        would silently bind to a different table must error instead."""
+        would silently bind to a different table must error instead.
+        A self-join-lifted alias translates to its renamed column."""
+        prefix = self.qual_rename.get(alias, "")
+        column = prefix + column
         target = next((cols for names, cols in self.sources
                        if alias in names), None)
         if target is not None:
             if column not in target:
+                shown = [c[len(prefix):] if prefix else c for c in target]
                 raise SqlError(
-                    f"Column {column!r} does not exist in table "
-                    f"{alias!r} (columns: {target})")
+                    f"Column {column[len(prefix):]!r} does not exist in "
+                    f"table {alias!r} (columns: {shown})")
             first = next((names for names, cols in self.sources
                           if cols is not None and column in cols), None)
             if not self._in_join_on and first is not None \
@@ -1303,19 +1334,18 @@ def _assemble_comma_join(p: "_Parser", items, where):
             progressed = True
             break
         if not progressed:
-            # Distinguish the REAL limitation: two aliases of the same
-            # table make every shared column ambiguous to owner(), so no
-            # equi conjunct can ever connect them — that is a self-join
-            # gap, not a cross join, and saying "cross joins" sent users
-            # down the wrong path.
+            # Distinguish the REAL limitation: an UNALIASED duplicate of
+            # a table leaves every shared column ambiguous to owner(),
+            # so no equi conjunct can ever connect them.  (An ALIASED
+            # duplicate is lifted into an independent renamed instance
+            # by parse_source and never reaches this branch.)
             pending = [i for i in range(len(items)) if i not in joined]
             if any(cols_of[i] == cols_of[j]
                    for i in pending for j in range(len(items)) if i != j):
                 p.fail(
-                    "comma-style self-joins (the same table under two "
-                    "aliases) are not supported: the join columns are "
-                    "ambiguous — use explicit JOIN ... ON with "
-                    "qualified aliases")
+                    "comma-style self-join needs an alias on each "
+                    "occurrence (FROM emp e, emp m): identical column "
+                    "sets make the join columns ambiguous")
             p.fail(
                 "comma-separated FROM requires WHERE equi-join "
                 "predicates connecting every table (cross joins are "

@@ -690,6 +690,8 @@ def fleet_checks(session) -> List[Any]:
         _guarded("fleet.daemons", lambda: _check_daemons(conf)),
         _guarded("fleet.serving", lambda: _check_fleet_serving(conf)),
         _guarded("fleet.skew", lambda: _check_fleet_skew(conf)),
+        _guarded("fleet.build_claims",
+                 lambda: _check_build_claims(conf)),
     ]
 
 
@@ -868,6 +870,67 @@ def _check_fleet_skew(conf):
     return DoctorCheck("fleet.skew", "ok",
                        "no cross-process or cross-device kernel-ms "
                        "skew", data)
+
+
+def _check_build_claims(conf):
+    """Leftover multi-host build claims (parallel/multihost_build.py)
+    graded against the heartbeats (docs/21): an EXPIRED claim with no
+    live holder is routine crash debris — any claimant reclaims it and
+    the next build reaps the dead coordinator's scratch (warn); a FRESH
+    claim whose holder publishes no fresh heartbeat is a dead or hung
+    host still fencing the item — the build stalls until the claim TTL
+    runs out (crit).  Read-only like every fleet check (the doctor verb
+    serves inline while the admission queue sheds, so no store writes
+    here); the JOURNALED trail comes from the claim protocol itself —
+    the coordinator records every expired-claim sighting and WorkClaims
+    records every reclaim/fence, so post-mortems see what doctor saw."""
+    from hyperspace_tpu.parallel.multihost_build import scan_build_claims
+    from hyperspace_tpu.telemetry.doctor import DoctorCheck
+
+    claims = scan_build_claims(conf)
+    if not claims:
+        return DoctorCheck("fleet.build_claims", "ok",
+                           "no leftover multi-host build claims", {})
+    fresh = {str(s.get("process", "")) for s in fresh_snapshots(conf)}
+    now = time.time()
+    expired_orphans, fresh_dead = [], []
+    for rec in claims:
+        live = str(rec.get("holder", "")) in fresh
+        if float(rec.get("expires_at", 0.0)) < now:
+            if not live:
+                expired_orphans.append(rec)
+        elif fresh and not live:
+            # Only gradeable when SOMEBODY heartbeats: with fleet
+            # telemetry off there is nothing to cross-check a live
+            # claim against, like fleet.daemons' lease-only case.
+            fresh_dead.append(rec)
+
+    def brief(recs):
+        return [{"build": r.get("build_id"), "item": r.get("item"),
+                 "holder": r.get("holder")} for r in recs]
+
+    data = {"pending": len(claims),
+            "expired_no_heartbeat": brief(expired_orphans),
+            "fresh_dead_holder": brief(fresh_dead)}
+    if fresh_dead:
+        check = DoctorCheck(
+            "fleet.build_claims", "crit",
+            f"{len(fresh_dead)} fresh build claim(s) held by "
+            f"process(es) with no fresh heartbeat — a dead or hung "
+            f"host is fencing work; the build stalls until the claim "
+            f"TTL expires", data)
+    elif expired_orphans:
+        check = DoctorCheck(
+            "fleet.build_claims", "warn",
+            f"{len(expired_orphans)} expired build claim(s) with no "
+            f"live holder — crash debris; survivors (or the next "
+            f"build) reclaim them after the TTL", data)
+    else:
+        check = DoctorCheck(
+            "fleet.build_claims", "ok",
+            f"{len(claims)} in-flight build claim(s), every holder "
+            f"heartbeating", data)
+    return check
 
 
 def deregister_process(conf) -> None:

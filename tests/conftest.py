@@ -46,6 +46,7 @@ _HEAVY_MODULES = frozenset({
     "test_fuzz_queries",
     "test_concurrency",         # cross-process races (spawn pools)
     "test_multiprocess",        # multi-host jax.distributed smoke
+    "test_multihost_build",     # subprocess host fleets + SIGKILL drill
     "test_interop",             # Arrow-IPC server + C++ client build
     "test_external_build",      # streaming spill builds
     "test_bench_resilience",    # runs bench.py end-to-end in subprocesses

@@ -23,8 +23,9 @@ SECTIONS = ("setup", "sf1_queries", "device_agg_probe", "resident_agg",
             "warm_resident_join", "warm_q3", "warm_q10", "window_bench",
             "kernel_bench", "calibration", "telemetry_overhead",
             "advisor", "integrity", "build_profile", "timeline",
-            "build_pipeline", "multichip", "serving", "flight_recorder",
-            "fleet_obs", "fleet", "chaos", "ingest", "sf10", "sf100")
+            "build_pipeline", "multichip", "multihost", "serving",
+            "flight_recorder", "fleet_obs", "fleet", "chaos", "ingest",
+            "sf10", "sf100")
 
 
 def _env(tmp_path, budget: str) -> dict:

@@ -31,15 +31,28 @@ class TestSchedule:
         assert stamps == sorted(stamps)
         for e in events:
             assert e["op"] in ("kill", "stop", "client-fault",
-                               "bounce-armed", "append")
+                               "bounce-armed", "append",
+                               "kill-build-host")
             if e["op"] in ("kill", "stop", "bounce-armed"):
                 assert 0 <= e["server"] < 3
+            if e["op"] == "kill-build-host":
+                assert e["victim"] in (0, 1)
 
     def test_append_scheduled_exactly_once(self):
         for seed in range(8):
             events = chaos.build_schedule(seed=seed, duration_s=6.0,
                                           servers=3)
             assert sum(1 for e in events if e["op"] == "append") == 1
+
+    def test_kill_build_host_band_reachable(self):
+        # The new band must actually fire for SOME seed (not dead code),
+        # always naming a victim in the 2-host build.
+        hits = [e for seed in range(12)
+                for e in chaos.build_schedule(seed=seed, duration_s=6.0,
+                                              servers=3)
+                if e["op"] == "kill-build-host"]
+        assert hits
+        assert all(e["victim"] in (0, 1) for e in hits)
 
     def test_client_faults_only_arm_wire_kinds(self):
         for seed in range(8):

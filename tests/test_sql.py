@@ -697,9 +697,20 @@ class TestCommaJoinDiagnostics:
     limitation (round-5 advisor #3): a duplicate-schema self-join is not
     a cross join."""
 
-    def test_self_join_reports_self_join_gap(self, env):
+    def test_unaliased_self_join_asks_for_aliases(self, env):
+        # Without aliases there is nothing to lift: every shared column
+        # stays ambiguous, and the message must say what to add.
         s, paths = env
-        with pytest.raises(SqlError, match="comma-style self-joins"):
+        with pytest.raises(SqlError, match="needs an alias"):
+            sql(s, "SELECT o_orderkey FROM orders, orders "
+                   "WHERE o_totalprice > 1",
+                {"orders": s.read.parquet(paths["orders"])})
+
+    def test_aliased_self_join_without_equi_is_cross_join(self, env):
+        # The lift makes the instances independent, so a missing equi
+        # conjunct is now an ordinary cross-join rejection.
+        s, paths = env
+        with pytest.raises(SqlError, match="cross joins are not supported"):
             sql(s, "SELECT o_orderkey FROM orders o1, orders o2 "
                    "WHERE o_totalprice > 1",
                 {"orders": s.read.parquet(paths["orders"])})
@@ -711,3 +722,68 @@ class TestCommaJoinDiagnostics:
                    "WHERE o_totalprice > 1",
                 {"orders": s.read.parquet(paths["orders"]),
                  "customer": s.read.parquet(paths["customer"])})
+
+
+class TestCommaSelfJoin:
+    """Comma-style self-joins lift the LATER occurrence into an
+    independent scan with ``<alias>__``-prefixed columns, so qualified
+    aliases resolve to distinct instances and the implicit-join assembly
+    connects them through WHERE equi conjuncts like any other pair."""
+
+    def test_self_join_equi_matches_pandas(self, env):
+        s, paths = env
+        out = sql(s, """
+            SELECT count(*) AS n FROM orders o1, orders o2
+            WHERE o1.o_custkey = o2.o_custkey
+        """, {"orders": s.read.parquet(paths["orders"])}).collect()
+        odf = pd.read_parquet(paths["orders"])
+        want = int((odf.groupby("o_custkey").size() ** 2).sum())
+        assert out.column("n").to_pylist() == [want]
+
+    def test_self_join_filter_and_projection(self, env):
+        # The classic pattern: pair rows of one table against rows of
+        # the same table with extra predicates on EACH side.
+        s, paths = env
+        out = sql(s, """
+            SELECT o1.o_orderkey AS a, o2.o_orderkey AS b
+            FROM orders o1, orders o2
+            WHERE o1.o_custkey = o2.o_custkey
+              AND o1.o_totalprice > 900 AND o2.o_totalprice < 100
+        """, {"orders": s.read.parquet(paths["orders"])}).collect()
+        odf = pd.read_parquet(paths["orders"])
+        m = odf.merge(odf, on="o_custkey", suffixes=("_1", "_2"))
+        m = m[(m.o_totalprice_1 > 900) & (m.o_totalprice_2 < 100)]
+        got = sorted(zip(out.column("a").to_pylist(),
+                         out.column("b").to_pylist()))
+        want = sorted(zip(m.o_orderkey_1.tolist(), m.o_orderkey_2.tolist()))
+        assert got == want
+
+    def test_unaliased_item_keeps_lifted_name(self, env):
+        # An unaliased select item of the lifted instance surfaces the
+        # engine name (alias__column); AS restores SQL-style naming.
+        s, paths = env
+        out = sql(s, """
+            SELECT o1.o_orderkey, o2.o_orderkey
+            FROM orders o1, orders o2
+            WHERE o1.o_custkey = o2.o_custkey LIMIT 1
+        """, {"orders": s.read.parquet(paths["orders"])}).collect()
+        assert out.column_names == ["o_orderkey", "o2__o_orderkey"]
+
+    def test_lifted_alias_validates_columns(self, env):
+        # Qualified-reference validation reports the ORIGINAL names.
+        s, paths = env
+        with pytest.raises(SqlError, match="does not exist in table 'o2'"):
+            sql(s, "SELECT o2.nope FROM orders o1, orders o2 "
+                   "WHERE o1.o_custkey = o2.o_custkey",
+                {"orders": s.read.parquet(paths["orders"])})
+
+    def test_three_way_self_join(self, env):
+        s, paths = env
+        out = sql(s, """
+            SELECT count(*) AS n FROM customer c1, customer c2, customer c3
+            WHERE c1.c_mktsegment = c2.c_mktsegment
+              AND c2.c_mktsegment = c3.c_mktsegment
+        """, {"customer": s.read.parquet(paths["customer"])}).collect()
+        cdf = pd.read_parquet(paths["customer"])
+        want = int((cdf.groupby("c_mktsegment").size() ** 3).sum())
+        assert out.column("n").to_pylist() == [want]
