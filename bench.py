@@ -1048,8 +1048,8 @@ SECTION_NAMES = ("setup", "sf1_queries", "device_agg_probe",
                  "calibration", "telemetry_overhead", "advisor",
                  "integrity", "build_profile", "timeline",
                  "build_pipeline", "multichip", "multihost", "serving",
-                 "flight_recorder", "fleet_obs", "fleet", "chaos",
-                 "ingest", "sf10", "sf100")
+                 "flight_recorder", "alerts", "fleet_obs", "fleet",
+                 "chaos", "ingest", "sf10", "sf100")
 
 
 def main() -> int:
@@ -1107,6 +1107,7 @@ def main() -> int:
             harness.section("serving", lambda: _sec_serving(ctx))
             harness.section("flight_recorder",
                             lambda: _sec_flight_recorder(ctx))
+            harness.section("alerts", lambda: _sec_alerts(ctx))
             harness.section("fleet_obs", lambda: _sec_fleet_obs(ctx))
             harness.section("fleet", lambda: _sec_fleet(ctx))
             harness.section("chaos", lambda: _sec_chaos())
@@ -2980,6 +2981,89 @@ def _sec_flight_recorder(ctx: dict) -> dict:
         (session.conf.flight_recorder_enabled,
          session.conf.flight_recorder_slow_ms) = saved
     return {"flight_recorder": out}
+
+
+def _sec_alerts(ctx: dict) -> dict:
+    """SLO alert engine cost + fire/resolve contract
+    (docs/16-observability.md): the engine is a conf-gated sampler
+    THREAD riding the heartbeat cadence — the serve path carries no
+    alert hook at all — so enabling it must be invisible on the
+    serving workload (correctness-gated at < 3% median overhead with
+    the usual 2 ms absolute noise floor).  Then the full loop is
+    proven end-to-end with the chaos alert drill: armed ``net.send``
+    wire faults must FIRE the availability fast-burn alert, capture an
+    incident bundle that reads back from the diagnostics store, and
+    RESOLVE after disarm (emitting ``alert.evaluate`` /
+    ``alert.capture`` spans along the way)."""
+    from hyperspace_tpu.interop.chaos import _alert_drill
+    from hyperspace_tpu.interop.server import QueryClient, QueryServer
+    from hyperspace_tpu.telemetry import alerts as _alerts
+
+    _require(ctx, "session", "lineitem_dir")
+    session = ctx["session"]
+    session.enable_hyperspace()
+    li = ctx["lineitem_dir"]
+    keys = [N_ORDERS // 11, N_ORDERS // 5, N_ORDERS // 2]
+    templates = [
+        {"source": {"format": "parquet", "path": li},
+         "filter": {"op": "==", "col": "l_orderkey", "value": k},
+         "select": ["l_orderkey", "l_quantity"]} for k in keys]
+    reqs = 24
+    reps = max(3, REPEATS)
+    out: dict = {}
+    engine = None
+    try:
+        with QueryServer(session) as server:
+            def batch() -> None:
+                with QueryClient(server.address) as qc:
+                    for r in range(reqs):
+                        qc.query(dict(templates[r % len(templates)]))
+
+            batch()  # warm: plan cache, readers, sockets
+            t_off = _time(batch, repeats=reps)  # engine disabled
+            session.conf.set("hyperspace.alerts.enabled", True)
+            session.conf.set("hyperspace.alerts.intervalS", 0.1)
+            engine = _alerts.engine_for(session)
+            engine.start()
+            t_on = _time(batch, repeats=reps)
+            overhead_pct = ((t_on["median"] - t_off["median"])
+                            / t_off["median"] * 100.0)
+            abs_ms = ((t_on["median"] - t_off["median"])
+                      * 1000.0 / reqs)
+            out["engine_off_s"] = _stat(t_off)
+            out["engine_on_s"] = _stat(t_on)
+            out["requests_per_batch"] = reqs
+            out["overhead_pct"] = round(overhead_pct, 2)
+            out["overhead_ratio"] = round(
+                t_on["median"] / max(1e-9, t_off["median"]), 4)
+            out["overhead_ms_per_request"] = round(abs_ms, 3)
+            if overhead_pct > 3.0 and abs_ms > 2.0:
+                raise SystemExit(
+                    f"alerts bench: engine overhead {overhead_pct:.1f}% "
+                    f"(> 3% and {abs_ms:.2f} ms/request) on the "
+                    f"serving workload")
+    finally:
+        if engine is not None:
+            engine.stop()
+        session.conf.set("hyperspace.alerts.enabled", False)
+        session.conf.set("hyperspace.alerts.intervalS", 0.0)
+
+    drill = _alert_drill(session)
+    if not drill.get("ok"):
+        raise SystemExit(
+            f"alerts bench: fire→bundle→resolve drill failed: "
+            f"fired={drill.get('fired')} "
+            f"bundle_ok={drill.get('bundle_ok')} "
+            f"resolved={drill.get('resolved')}")
+    out["drill_fired"] = int(bool(drill["fired"]))
+    out["drill_resolved"] = int(bool(drill["resolved"]))
+    out["drill_bundle_ok"] = int(bool(drill["bundle_ok"]))
+    # Post-drill steady state: nothing left burning (lower-better for
+    # bench_compare, like the alerts.firing gauge it mirrors).
+    out["firing"] = len([
+        a for a in _alerts.carried_alerts(session.conf)
+        if a.get("state") == "firing"])
+    return {"alerts": out}
 
 
 def _sec_fleet_obs(ctx: dict) -> dict:

@@ -131,6 +131,21 @@ CLIENT_HEDGE_DELAY_MS = "hyperspace.client.hedge.delayMs"
 CLIENT_BREAKER_ENABLED = "hyperspace.client.breaker.enabled"
 CLIENT_BREAKER_FAILURES = "hyperspace.client.breaker.failures"
 CLIENT_BREAKER_COOLDOWN_MS = "hyperspace.client.breaker.cooldownMs"
+ALERTS_ENABLED = "hyperspace.alerts.enabled"
+ALERTS_INTERVAL_S = "hyperspace.alerts.intervalS"
+ALERTS_AVAILABILITY_TARGET = "hyperspace.alerts.availabilityTarget"
+ALERTS_LATENCY_TARGET = "hyperspace.alerts.latencyTarget"
+ALERTS_FAST_SHORT_S = "hyperspace.alerts.fastShortS"
+ALERTS_FAST_LONG_S = "hyperspace.alerts.fastLongS"
+ALERTS_FAST_FACTOR = "hyperspace.alerts.fastFactor"
+ALERTS_SLOW_SHORT_S = "hyperspace.alerts.slowShortS"
+ALERTS_SLOW_LONG_S = "hyperspace.alerts.slowLongS"
+ALERTS_SLOW_FACTOR = "hyperspace.alerts.slowFactor"
+ALERTS_PENDING_EVALS = "hyperspace.alerts.pendingEvals"
+ALERTS_RESOLVE_EVALS = "hyperspace.alerts.resolveEvals"
+ALERTS_STALENESS_WARN_S = "hyperspace.alerts.stalenessWarnS"
+ALERTS_MAX_ENTRIES = "hyperspace.alerts.maxEntries"
+ALERTS_NOTIFY_COMMAND = "hyperspace.alerts.notify.command"
 
 _DEFAULT_NUM_BUCKETS = 200  # IndexConstants.scala:31-32 (spark.sql.shuffle.partitions default)
 
@@ -591,6 +606,33 @@ class HyperspaceConf:
     client_breaker_enabled: bool = False
     client_breaker_failures: int = 5
     client_breaker_cooldown_ms: float = 2000.0
+    # The SLO alert engine (telemetry/alerts.py + telemetry/slo.py).
+    # Default OFF; when on, an evaluator thread samples the metrics
+    # registry every intervalS (0 = ride the fleet-heartbeat cadence)
+    # and evaluates multi-window multi-burn-rate rules: the fast pair
+    # (fastShortS+fastLongS at fastFactor budgets/window) pages, the
+    # slow pair warns.  availabilityTarget/latencyTarget set the error
+    # budgets (latency splits serve.latency_ms at
+    # hyperspace.doctor.latencySloMs); stalenessWarnS thresholds the
+    # staleness objective; pendingEvals/resolveEvals flap-damp the
+    # pending -> firing -> resolved machine; maxEntries bounds the
+    # persisted transition log; notify.command runs off-thread on
+    # firing/resolved with the record as JSON on stdin.
+    alerts_enabled: bool = False
+    alerts_interval_s: float = 0.0
+    alerts_availability_target: float = 0.999
+    alerts_latency_target: float = 0.99
+    alerts_fast_short_s: float = 300.0
+    alerts_fast_long_s: float = 3600.0
+    alerts_fast_factor: float = 14.4
+    alerts_slow_short_s: float = 21600.0
+    alerts_slow_long_s: float = 259200.0
+    alerts_slow_factor: float = 1.0
+    alerts_pending_evals: int = 2
+    alerts_resolve_evals: int = 2
+    alerts_staleness_warn_s: float = 600.0
+    alerts_max_entries: int = 512
+    alerts_notify_command: str = ""
     # Keys explicitly applied through set(); drives canonical-vs-legacy key
     # precedence.
     _set_keys: set = dataclasses.field(default_factory=set, repr=False,
@@ -711,6 +753,21 @@ class HyperspaceConf:
         CLIENT_BREAKER_ENABLED: "client_breaker_enabled",
         CLIENT_BREAKER_FAILURES: "client_breaker_failures",
         CLIENT_BREAKER_COOLDOWN_MS: "client_breaker_cooldown_ms",
+        ALERTS_ENABLED: "alerts_enabled",
+        ALERTS_INTERVAL_S: "alerts_interval_s",
+        ALERTS_AVAILABILITY_TARGET: "alerts_availability_target",
+        ALERTS_LATENCY_TARGET: "alerts_latency_target",
+        ALERTS_FAST_SHORT_S: "alerts_fast_short_s",
+        ALERTS_FAST_LONG_S: "alerts_fast_long_s",
+        ALERTS_FAST_FACTOR: "alerts_fast_factor",
+        ALERTS_SLOW_SHORT_S: "alerts_slow_short_s",
+        ALERTS_SLOW_LONG_S: "alerts_slow_long_s",
+        ALERTS_SLOW_FACTOR: "alerts_slow_factor",
+        ALERTS_PENDING_EVALS: "alerts_pending_evals",
+        ALERTS_RESOLVE_EVALS: "alerts_resolve_evals",
+        ALERTS_STALENESS_WARN_S: "alerts_staleness_warn_s",
+        ALERTS_MAX_ENTRIES: "alerts_max_entries",
+        ALERTS_NOTIFY_COMMAND: "alerts_notify_command",
     }
 
     # Auto-calibrated routing thresholds: None = derive from measured

@@ -315,6 +315,49 @@ class Hyperspace:
 
         publisher_for(self.session).stop()
 
+    # -- SLO alerting (docs/16-observability.md) ----------------------------
+    def alerts(self, fleet: bool = False) -> pa.Table:
+        """Current SLO alert states (telemetry/alerts.py), one row per
+        declared objective — availability, latency, staleness,
+        build-claim liveness — with state (pending/firing/resolved),
+        severity, the since timestamp, and the incident-bundle key
+        captured at the moment of firing.  The same table the inline
+        interop ``alerts`` verb serves, so it answers during overload.
+
+        ``fleet=True`` federates: every fresh heartbeat's carried
+        active alerts ride along with a ``process`` column attributing
+        each row — "which server is paging" in one call."""
+        from hyperspace_tpu.telemetry.alerts import alerts_table
+
+        return alerts_table(self.session, fleet=fleet)
+
+    def alert_history(self) -> pa.Table:
+        """The persisted alert transition log as an arrow table, oldest
+        first — every state change (pending → firing → resolved) the
+        engine recorded under ``<systemPath>/_hyperspace_alerts``
+        through the LogStore seam, restart-proof across both
+        backends."""
+        from hyperspace_tpu.telemetry.alerts import history_table
+
+        return history_table(self.session.conf)
+
+    def start_alerting(self):
+        """Start the SLO evaluator thread
+        (``hyperspace.alerts.enabled`` must be true; evaluation rides
+        the fleet-heartbeat cadence unless
+        ``hyperspace.alerts.intervalS`` overrides it).  Returns the
+        :class:`~hyperspace_tpu.telemetry.alerts.AlertEngine`."""
+        from hyperspace_tpu.telemetry.alerts import engine_for
+
+        return engine_for(self.session).start()
+
+    def stop_alerting(self) -> None:
+        """Stop the SLO evaluator thread (idempotent; the persisted
+        alert state survives for the next engine)."""
+        from hyperspace_tpu.telemetry.alerts import engine_for
+
+        engine_for(self.session).stop()
+
     def diagnostics(self) -> dict:
         """The live diagnostics bundle: the flight recorder's retained
         ring, a metrics snapshot, and the recent perf-ledger tail — the

@@ -55,6 +55,7 @@ import os
 import random
 import shutil
 import signal
+import socket
 import subprocess
 import sys
 import tempfile
@@ -242,6 +243,112 @@ def _build_drill(workdir: str, src: str, tag: int,
     return {"tag": tag, "victim": victim, "killed": bool(killed),
             "bit_equal": bit_equal, "commits": commits,
             "ok": bool(killed) and bit_equal and commits == 1}
+
+
+def _alert_drill(session, deadline_s: float = 30.0) -> Dict[str, Any]:
+    """The SLO-alert invariant (docs/16): armed wire faults must FIRE
+    the availability fast-burn alert with an incident bundle captured,
+    and disarming must RESOLVE it.  Runs an in-process server on the
+    driver session so the alert engine, the serve counters, and the
+    armed ``net.send`` seam all live in one metrics registry; the
+    probe client speaks the wire protocol over a RAW socket so the
+    armed seam tears only the SERVER's sends, not the probe's."""
+    from hyperspace_tpu.interop.server import QueryServer
+    from hyperspace_tpu.io import faults
+    from hyperspace_tpu.telemetry import alerts as alerts_mod
+    from hyperspace_tpu.telemetry import flight_recorder
+
+    out: Dict[str, Any] = {"fired": False, "resolved": False,
+                           "bundle_ok": False, "ok": False}
+    # Tiny windows so the fast-burn rule decides in drill time, not SRE
+    # time; pending/resolve damping of 1 keeps the round-trip short.
+    for key, value in (
+            ("hyperspace.alerts.enabled", True),
+            ("hyperspace.alerts.intervalS", 0.1),
+            ("hyperspace.alerts.availabilityTarget", 0.9),
+            ("hyperspace.alerts.fastShortS", 0.4),
+            ("hyperspace.alerts.fastLongS", 0.8),
+            ("hyperspace.alerts.fastFactor", 1.5),
+            ("hyperspace.alerts.pendingEvals", 1),
+            ("hyperspace.alerts.resolveEvals", 1)):
+        session.conf.set(key, value)
+
+    def probe(port: int, read: bool = True,
+              timeout_s: float = 1.5) -> None:
+        # Fire-and-forget during the fault phase (read=False): the
+        # armed seam eats the response anyway, and not blocking on a
+        # read that will never come keeps the bad-event rate high.
+        sock = socket.create_connection(("127.0.0.1", port),
+                                        timeout=timeout_s)
+        try:
+            sock.sendall(b'{"verb": "metrics"}\n')
+            if read:
+                sock.recv(65536)
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def state_of(engine, name: str) -> str:
+        return engine.current_states().get(name, {}).get("state", "")
+
+    server = QueryServer(session, port=0).start()  # starts the engine
+    engine = alerts_mod.engine_for(session)
+    port = server.address[1]
+    deadline = time.monotonic() + deadline_s
+    try:
+        # Good traffic first: the burn windows need a baseline.
+        settle = time.monotonic() + 0.6
+        while time.monotonic() < settle:
+            probe(port)
+            time.sleep(0.02)
+        # Arm the wire fault: every response send black-holes, so each
+        # probe lands as a ``serve.send_timeouts`` bad event.
+        faults.install(faults.FaultPlan(
+            site="net.send", kind="black-hole", at=1, count=10 ** 6,
+            hang_s=0.01))
+        while (state_of(engine, "availability") != "firing"
+               and time.monotonic() < deadline):
+            try:
+                probe(port, read=False)
+            except OSError:
+                pass  # the fault eats the answer — that IS the drill
+            time.sleep(0.02)
+        out["fired"] = state_of(engine, "availability") == "firing"
+        # The bundle commits right AFTER the state flips (capture runs
+        # outside the engine's state lock), so give it a beat to land.
+        bundle_key = ""
+        while not bundle_key and time.monotonic() < deadline:
+            bundle_key = engine.current_states().get(
+                "availability", {}).get("bundle_key") or ""
+            if not bundle_key:
+                time.sleep(0.05)
+        out["bundle_key"] = bundle_key
+        faults.clear()
+        out["bundle_ok"] = bool(bundle_key) and any(
+            b.get("key") == bundle_key and "incident" in b
+            for b in flight_recorder.bundles(session.conf))
+        # Disarm + good traffic: the alert must come back down.
+        while (state_of(engine, "availability") == "firing"
+               and time.monotonic() < deadline):
+            try:
+                probe(port)
+            except OSError:
+                pass
+            time.sleep(0.02)
+        out["resolved"] = \
+            state_of(engine, "availability") in ("resolved", "")
+    finally:
+        faults.clear()
+        try:
+            server.stop()
+        except Exception as exc:  # noqa: BLE001 — teardown best-effort,
+            out["teardown_error"] = str(exc)  # but visible in the report
+        engine.stop()
+        session.conf.set("hyperspace.alerts.enabled", False)
+    out["ok"] = (out["fired"] and out["bundle_ok"] and out["resolved"])
+    return out
 
 
 class _Fleet:
@@ -590,6 +697,14 @@ def run_chaos(seed: int = 0, duration_s: float = 6.0, servers: int = 3,
         "build_drills": build_drills,
         "build_drills_skipped": build_state["skipped"],
     })
+    # SLO-alert invariant, after the fleet is torn down: the driver's
+    # own serve counters are untouched by the storm above, so the
+    # availability objective grades EXACTLY the drill's armed fault.
+    try:
+        report["alert_drill"] = _alert_drill(s)
+    except Exception as exc:  # noqa: BLE001 — a crashed drill IS the
+        report["alert_drill"] = {"ok": False,  # violation, not ours
+                                 "error": str(exc)}
     violations: List[str] = []
     if stats["lost"]:
         violations.append(f"{stats['lost']} lost request(s)")
@@ -615,6 +730,13 @@ def run_chaos(seed: int = 0, duration_s: float = 6.0, servers: int = 3,
         violations.append(
             f"{bad_builds} kill-build-host drill(s) failed "
             f"(non-bit-equal, missing kill, or commits != 1)")
+    if not report["alert_drill"].get("ok"):
+        ad = report["alert_drill"]
+        violations.append(
+            "alert drill failed: "
+            f"fired={ad.get('fired')} bundle_ok={ad.get('bundle_ok')} "
+            f"resolved={ad.get('resolved')} "
+            f"error={ad.get('error', '')!r}")
     report["violations"] = violations
     report["ok"] = not violations
     return report

@@ -1165,6 +1165,18 @@ def _serve_verb(session, spec: Dict[str, Any],
                                       "which of my servers is sick"
                                       surface, answering inline so it
                                       works during overload
+      {"verb": "alerts",
+       "fleet"?: true}             -> current SLO alert states
+                                      (telemetry/alerts.py): one row per
+                                      objective — availability, latency,
+                                      staleness, build-claim liveness —
+                                      with state/severity/since and the
+                                      incident-bundle key captured at
+                                      firing; ``fleet`` merges every
+                                      fresh heartbeat's active alerts
+                                      with process attribution.
+                                      Answers inline, so "am I paging"
+                                      works during overload
       {"verb": "lifecycle"}        -> the lifecycle decision journal
                                       (lifecycle/journal.py): every
                                       maintenance-daemon decision —
@@ -1276,6 +1288,13 @@ def _serve_verb(session, spec: Dict[str, Any],
         from hyperspace_tpu.telemetry.fleet import fleet_status_table
 
         return fleet_status_table(session.conf)
+    if verb == "alerts":
+        from hyperspace_tpu.telemetry.alerts import alerts_table
+
+        fleet = spec.get("fleet", False)
+        if not isinstance(fleet, bool):
+            raise ValueError('"fleet" must be a boolean')
+        return alerts_table(session, fleet=fleet)
     if verb == "lifecycle":
         from hyperspace_tpu.lifecycle.journal import history_table
 
@@ -1301,7 +1320,7 @@ def _serve_verb(session, spec: Dict[str, Any],
     raise ValueError(f"Unknown verb {verb!r}; expected metrics, "
                      f"last_run_report, workload, perf_history, "
                      f"build_report, slow_queries, trace, doctor, "
-                     f"fleet_status, lifecycle, or tenants")
+                     f"fleet_status, alerts, lifecycle, or tenants")
 
 
 def _is_loopback(host: str) -> bool:
@@ -1470,13 +1489,16 @@ class QueryServer:
         # heartbeat carries this server's address so the front door can
         # match fleet rows to endpoints, and a fresh start clears any
         # draining flag a previous in-process server left behind.
-        from hyperspace_tpu.telemetry import fleet
+        from hyperspace_tpu.telemetry import alerts, fleet
 
         fleet.set_process_role("server")
         host, port = self.address[0], self.address[1]
         fleet.set_serving_address(f"{host}:{port}")
         fleet.set_serving_draining(False)
         fleet.maybe_start(self.session)
+        # The SLO alert engine watches this server's counters; same
+        # conf-gated never-raises start (hyperspace.alerts.enabled).
+        alerts.maybe_start(self.session)
         self._server.pool.start()
         if self._io_mode == "async":
             self._async = _AsyncIOLoop(self, self._server)

@@ -290,6 +290,9 @@ REQUIRED_BENCH_SPANS = (
     "bench.serving",
     "serve.request",
     "bench.flight_recorder",
+    "bench.alerts",
+    "alert.evaluate",
+    "alert.capture",
     "bench.fleet_obs",
     "fleet.publish",
     "bench.ingest",

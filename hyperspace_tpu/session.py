@@ -141,9 +141,13 @@ class HyperspaceSession:
         # construction the daemon thread starts here so every process
         # of a fleet shows up in fleet_status() without extra wiring
         # (conf set later goes through Hyperspace.start_fleet_telemetry).
-        from hyperspace_tpu.telemetry import fleet
+        from hyperspace_tpu.telemetry import alerts, fleet
 
         fleet.maybe_start(self)
+        # SLO alert engine (telemetry/alerts.py): same conf-gated,
+        # never-raises pattern (hyperspace.alerts.enabled; conf set
+        # later goes through Hyperspace.start_alerting).
+        alerts.maybe_start(self)
 
     @property
     def _lake_schema_memo(self) -> Optional[Dict[object, Dict[str, str]]]:

@@ -456,7 +456,18 @@ class _Parser:
         if self.peek()[0] in _NAME_KINDS \
                 and not self._at_clause_kw():
             alias = self.next()[1]
-        if alias is not None and any(name in ns for ns, _c in self.sources):
+        seen_before = any(name in ns for ns, _c in self.sources)
+        if alias is None and seen_before:
+            # Without an alias there is nothing to address the second
+            # instance by: every qualified reference would bind to
+            # whichever registration happened to come first.  Error
+            # crisply instead of answering from an ambiguous plan.
+            raise SqlError(
+                f"Table {name!r} appears more than once in FROM and "
+                f"the later occurrence needs an alias (e.g. "
+                f"{name} a JOIN {name} b ON ...) so qualified "
+                f"references are unambiguous")
+        if alias is not None and seen_before:
             # Self-join lift: a LATER occurrence of an already-seen
             # table becomes an independent scan instance with its
             # columns renamed to ``<alias>__<column>`` — every column
@@ -1410,7 +1421,12 @@ def _parse_query(p: "_Parser"):
                 dedup = False
             else:
                 p.take_kw("DISTINCT")
-            nxt = _parse_intersect_chain(p, allow_tail=False)
+            # Each branch is its own select scope (fresh sources /
+            # aliases, like the INTERSECT fork): `FROM orders` in both
+            # branches is two scans, not a duplicate registration.
+            branch = p.fork()
+            nxt = _parse_intersect_chain(branch, allow_tail=False)
+            p.i = branch.i
             ds = ds.union(_align_positional("UNION", ds, nxt))
             if dedup:
                 ds = ds.distinct()
@@ -1418,7 +1434,9 @@ def _parse_query(p: "_Parser"):
             if p.take_kw("ALL"):
                 p.fail("EXCEPT ALL is not supported; use EXCEPT")
             p.take_kw("DISTINCT")
-            nxt = _parse_intersect_chain(p, allow_tail=False)
+            branch = p.fork()
+            nxt = _parse_intersect_chain(branch, allow_tail=False)
+            p.i = branch.i
             ds = ds.subtract(_align_positional("EXCEPT", ds, nxt))
         else:
             break

@@ -188,12 +188,25 @@ def _direction(path: str) -> Optional[str]:
         return "higher"
     if "speedup" in last or last == "geomean_speedup":
         return "higher"
+    if last == "firing" or last.endswith("_ratio"):
+        # Alert gauges and overhead ratios: fewer firing alerts and a
+        # smaller ratio are better.  Unitless — the seconds floor does
+        # not apply (and ``*_rate`` stays out: hedge_win_rate is
+        # neither better high nor low).
+        return "lower"
     if last.endswith("_s"):
         return "lower"
     if last == "median" and len(parts) >= 2 and parts[-2].endswith("_s") \
             and not parts[-2].endswith("_per_s"):
         return "lower"
     return None
+
+
+def _seconds_metric(path: str) -> bool:
+    """True when the metric is in seconds — the only unit the
+    ``min_abs_s`` absolute floor is meaningful for."""
+    last = path.split(".")[-1]
+    return last.endswith("_s") or last == "median"
 
 
 def _section_of(run: RunMetrics, path: str) -> str:
@@ -262,9 +275,10 @@ def compare_runs(current: RunMetrics, baseline: RunMetrics,
                    "delta_pct": round(delta_pct, 1),
                    "direction": direction}
         if direction == "lower":
-            if delta_pct > threshold_pct and (cur - base) > min_abs_s:
+            floor = min_abs_s if _seconds_metric(path) else 0.0
+            if delta_pct > threshold_pct and (cur - base) > floor:
                 regressions.append(finding)
-            elif delta_pct < -threshold_pct and (base - cur) > min_abs_s:
+            elif delta_pct < -threshold_pct and (base - cur) > floor:
                 improvements.append(finding)
         else:
             # Higher is better (ratios/rates): the abs floor applies to

@@ -460,3 +460,95 @@ def _check_degraded(session) -> DoctorCheck:
             {"fallbacks": int(fallbacks), "quarantines": int(contained)})
     return DoctorCheck("degraded", "ok",
                        "no degraded events this process", {})
+
+
+# ---------------------------------------------------------------------------
+# Headless CLI (tools/doctor.py shim): cron/CI gate on health without Python
+# ---------------------------------------------------------------------------
+def _alerts_check(conf) -> DoctorCheck:
+    """Persisted SLO alert states folded into the CLI gate: a FIRING
+    page is crit, a firing warn-severity alert (or any pending one)
+    warns — so ``tools/doctor.py --alerts`` exits nonzero while an
+    incident the engine already detected is still open."""
+    from hyperspace_tpu.telemetry import alerts as _alerts
+
+    states = _alerts.load_states(conf)
+    firing = {n: s for n, s in states.items()
+              if s.get("state") == "firing"}
+    pending = {n: s for n, s in states.items()
+               if s.get("state") == "pending"}
+    data = {"firing": sorted(firing), "pending": sorted(pending)}
+    if firing:
+        pages = [n for n, s in firing.items()
+                 if s.get("severity") == "page"]
+        status = "crit" if pages else "warn"
+        return DoctorCheck(
+            "alerts", status,
+            f"{len(firing)} firing SLO alert(s): "
+            f"{', '.join(sorted(firing))} — see alert_history() and "
+            f"the captured incident bundle(s)", data)
+    if pending:
+        return DoctorCheck(
+            "alerts", "warn",
+            f"{len(pending)} pending SLO alert(s): "
+            f"{', '.join(sorted(pending))}", data)
+    return DoctorCheck("alerts", "ok",
+                       f"{len(states)} alert(s) tracked, none active",
+                       data)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """Headless doctor: grade a system path and exit ok=0 / warn=1 /
+    crit=2 so cron and CI gate on health without writing Python::
+
+        python tools/doctor.py --system-path /lake/indexes
+        python tools/doctor.py --system-path /lake/indexes --fleet --json
+        python tools/doctor.py --system-path /lake/indexes --alerts
+
+    ``--fleet`` adds the cluster checks over the published heartbeats
+    (including ``fleet.alerts``); ``--alerts`` folds the PERSISTED SLO
+    alert states into the grade (a firing page exits 2 even from a
+    fresh process); ``--json`` prints the machine-readable report;
+    ``--conf key=value`` passes extra session conf (repeatable)."""
+    import argparse
+    import json as _json
+
+    parser = argparse.ArgumentParser(
+        prog="doctor",
+        description="Aggregated ok/warn/crit health report "
+                    "(exit code 0/1/2)")
+    parser.add_argument("--system-path", default=None,
+                        help="hyperspace.system.path to grade "
+                             "(default: the conf default)")
+    parser.add_argument("--fleet", action="store_true",
+                        help="add the cluster checks over published "
+                             "fleet heartbeats")
+    parser.add_argument("--alerts", action="store_true",
+                        help="fold persisted SLO alert states into the "
+                             "grade (firing page = exit 2)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="print the full report as JSON")
+    parser.add_argument("--conf", action="append", default=[],
+                        metavar="KEY=VALUE",
+                        help="extra session conf (repeatable)")
+    args = parser.parse_args(argv)
+
+    from hyperspace_tpu.session import HyperspaceSession
+
+    session = HyperspaceSession(args.system_path)
+    for item in args.conf:
+        key, sep, value = item.partition("=")
+        if not sep:
+            parser.error(f"--conf needs KEY=VALUE, got {item!r}")
+        session.conf.set(key, value)
+    report = doctor(session, fleet=args.fleet)
+    checks = list(report.checks)
+    if args.alerts:
+        checks.append(_guarded("alerts",
+                               lambda: _alerts_check(session.conf)))
+        report = DoctorReport(checks)
+    if args.as_json:
+        print(_json.dumps(report.to_dict(), default=str, indent=2))
+    else:
+        print(report.render())
+    return SEVERITY[report.status]

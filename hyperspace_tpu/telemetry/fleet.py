@@ -178,7 +178,7 @@ def build_snapshot(conf) -> Dict[str, Any]:
     """This process's current fleet snapshot: identity/role, the typed
     metrics snapshot, the health grade, the per-device kernel-ms map,
     and the bounded interesting flight-recorder tail."""
-    from hyperspace_tpu.telemetry import flight_recorder, metrics
+    from hyperspace_tpu.telemetry import alerts, flight_recorder, metrics
 
     typed = metrics.registry().typed_snapshot()
     interesting = [r for r in flight_recorder.recorder().records()
@@ -196,6 +196,10 @@ def build_snapshot(conf) -> Dict[str, Any]:
         "metrics": typed,
         "device_kernel_ms": device_kernel_ms_map(typed["counters"]),
         "records": interesting[-FLEET_RECORDS_MAX:],
+        # Active SLO alerts (telemetry/alerts.py; [] when the engine is
+        # off) — what alerts(fleet=True) and the fleet.alerts doctor
+        # check federate with process attribution.
+        "alerts": alerts.carried_alerts(conf),
     }
 
 
@@ -692,7 +696,17 @@ def fleet_checks(session) -> List[Any]:
         _guarded("fleet.skew", lambda: _check_fleet_skew(conf)),
         _guarded("fleet.build_claims",
                  lambda: _check_build_claims(conf)),
+        _guarded("fleet.alerts", lambda: _check_fleet_alerts(session)),
     ]
+
+
+def _check_fleet_alerts(session):
+    """A FIRING SLO alert anywhere in the fleet grades the cluster —
+    the page the engine already decided to send (telemetry/alerts.py
+    owns the grading so the check and the engine cannot drift)."""
+    from hyperspace_tpu.telemetry.alerts import fleet_alert_check
+
+    return fleet_alert_check(session)
 
 
 def _check_heartbeats(conf):

@@ -24,8 +24,8 @@ SECTIONS = ("setup", "sf1_queries", "device_agg_probe", "resident_agg",
             "kernel_bench", "calibration", "telemetry_overhead",
             "advisor", "integrity", "build_profile", "timeline",
             "build_pipeline", "multichip", "multihost", "serving",
-            "flight_recorder", "fleet_obs", "fleet", "chaos", "ingest",
-            "sf10", "sf100")
+            "flight_recorder", "alerts", "fleet_obs", "fleet", "chaos",
+            "ingest", "sf10", "sf100")
 
 
 def _env(tmp_path, budget: str) -> dict:

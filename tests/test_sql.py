@@ -787,3 +787,105 @@ class TestCommaSelfJoin:
         cdf = pd.read_parquet(paths["customer"])
         want = int((cdf.groupby("c_mktsegment").size() ** 3).sum())
         assert out.column("n").to_pylist() == [want]
+
+
+class TestExplicitSelfJoin:
+    """Aliased self-joins through explicit ``JOIN ... ON`` ride the same
+    lift as the comma style: the later occurrence becomes an independent
+    scan, so qualified aliases resolve in ON, WHERE, GROUP BY and ORDER
+    BY.  An UNALIASED duplicate has nothing to address the second
+    instance by and must error crisply instead of binding ambiguously."""
+
+    def test_inner_self_join_on_matches_pandas(self, env):
+        s, paths = env
+        out = sql(s, """
+            SELECT count(*) AS n
+            FROM orders o1 JOIN orders o2
+              ON o1.o_custkey = o2.o_custkey
+        """, {"orders": s.read.parquet(paths["orders"])}).collect()
+        odf = pd.read_parquet(paths["orders"])
+        want = int((odf.groupby("o_custkey").size() ** 2).sum())
+        assert out.column("n").to_pylist() == [want]
+
+    def test_self_join_on_plus_where_each_side(self, env):
+        s, paths = env
+        out = sql(s, """
+            SELECT o1.o_orderkey AS a, o2.o_orderkey AS b
+            FROM orders o1 JOIN orders o2
+              ON o1.o_custkey = o2.o_custkey
+            WHERE o1.o_totalprice > 900 AND o2.o_totalprice < 100
+        """, {"orders": s.read.parquet(paths["orders"])}).collect()
+        odf = pd.read_parquet(paths["orders"])
+        m = odf.merge(odf, on="o_custkey", suffixes=("_1", "_2"))
+        m = m[(m.o_totalprice_1 > 900) & (m.o_totalprice_2 < 100)]
+        got = sorted(zip(out.column("a").to_pylist(),
+                         out.column("b").to_pylist()))
+        want = sorted(zip(m.o_orderkey_1.tolist(),
+                          m.o_orderkey_2.tolist()))
+        assert got == want
+
+    def test_left_self_join(self, env):
+        # LEFT keeps every o1 row; probes pair high-price rows against
+        # low-price rows of the SAME customer, which often don't exist.
+        s, paths = env
+        out = sql(s, """
+            SELECT count(*) AS n
+            FROM orders o1 LEFT JOIN orders o2
+              ON o1.o_custkey = o2.o_custkey
+            WHERE o1.o_totalprice > 990
+        """, {"orders": s.read.parquet(paths["orders"])}).collect()
+        odf = pd.read_parquet(paths["orders"])
+        left = odf[odf.o_totalprice > 990]
+        m = left.merge(odf, on="o_custkey", how="left",
+                       suffixes=("_1", "_2"))
+        assert out.column("n").to_pylist() == [len(m)]
+
+    def test_self_join_group_order_by_qualified(self, env):
+        s, paths = env
+        out = sql(s, """
+            SELECT o1.o_custkey AS k, count(*) AS n
+            FROM orders o1 JOIN orders o2
+              ON o1.o_custkey = o2.o_custkey
+            GROUP BY o1.o_custkey
+            ORDER BY o1.o_custkey
+        """, {"orders": s.read.parquet(paths["orders"])}).collect()
+        odf = pd.read_parquet(paths["orders"])
+        sizes = odf.groupby("o_custkey").size()
+        want_k = sorted(sizes.index.tolist())
+        assert out.column("k").to_pylist() == want_k
+        assert out.column("n").to_pylist() == \
+            [int(sizes[k] ** 2) for k in want_k]
+
+    def test_three_way_explicit_self_join(self, env):
+        s, paths = env
+        out = sql(s, """
+            SELECT count(*) AS n
+            FROM customer c1
+            JOIN customer c2 ON c1.c_mktsegment = c2.c_mktsegment
+            JOIN customer c3 ON c2.c_mktsegment = c3.c_mktsegment
+        """, {"customer": s.read.parquet(paths["customer"])}).collect()
+        cdf = pd.read_parquet(paths["customer"])
+        want = int((cdf.groupby("c_mktsegment").size() ** 3).sum())
+        assert out.column("n").to_pylist() == [want]
+
+    def test_unaliased_duplicate_join_errors(self, env):
+        s, paths = env
+        with pytest.raises(SqlError, match="more than once"):
+            sql(s, "SELECT count(*) AS n FROM orders JOIN orders "
+                   "ON o_custkey = o_custkey",
+                {"orders": s.read.parquet(paths["orders"])})
+
+    def test_unaliased_duplicate_comma_errors(self, env):
+        s, paths = env
+        with pytest.raises(SqlError, match="more than once"):
+            sql(s, "SELECT count(*) AS n FROM orders, orders",
+                {"orders": s.read.parquet(paths["orders"])})
+
+    def test_one_aliased_one_not_still_errors(self, env):
+        # The FIRST occurrence grabbed the bare name; a later unaliased
+        # occurrence is exactly the ambiguous case.
+        s, paths = env
+        with pytest.raises(SqlError, match="more than once"):
+            sql(s, "SELECT count(*) AS n FROM orders o1 JOIN orders "
+                   "ON o1.o_custkey = o_custkey",
+                {"orders": s.read.parquet(paths["orders"])})

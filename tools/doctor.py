@@ -1,0 +1,24 @@
+#!/usr/bin/env python
+"""Headless health gate: ``python tools/doctor.py --system-path PATH``.
+
+Runs the ``Hyperspace.doctor()`` checks (``--fleet`` adds the cluster
+checks over published heartbeats; ``--alerts`` folds persisted SLO
+alert states in) and exits ok=0 / warn=1 / crit=2 so cron and CI gate
+on health without writing Python.  ``--json`` prints the full report
+machine-readably.  See docs/16-observability.md.
+"""
+
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    sys.path.insert(0, _ROOT)
+    from hyperspace_tpu.telemetry.doctor import main as doctor_main
+    return doctor_main(sys.argv[1:])
+
+
+if __name__ == "__main__":
+    sys.exit(main())
