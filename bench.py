@@ -1049,7 +1049,7 @@ SECTION_NAMES = ("setup", "sf1_queries", "device_agg_probe",
                  "integrity", "build_profile", "timeline",
                  "build_pipeline", "multichip", "multihost", "serving",
                  "flight_recorder", "alerts", "fleet_obs", "fleet",
-                 "chaos", "ingest", "sf10", "sf100")
+                 "chaos", "ingest", "cdc", "sf10", "sf100")
 
 
 def main() -> int:
@@ -1112,6 +1112,7 @@ def main() -> int:
             harness.section("fleet", lambda: _sec_fleet(ctx))
             harness.section("chaos", lambda: _sec_chaos())
             harness.section("ingest", lambda: _sec_ingest(root))
+            harness.section("cdc", lambda: _sec_cdc(root))
             harness.section("sf10", lambda: _sec_sf10(ctx, root, harness))
             harness.section("sf100", lambda: _sec_sf100(ctx, root, harness))
         except _Finalize:
@@ -3700,6 +3701,267 @@ def _sec_ingest(root: str) -> dict:
         "staleness_s": round(staleness_s, 3),
         "daemon_decisions": dict(sorted(decisions.items())),
         "journal_records": len(recs),
+    }}
+
+
+def _sec_cdc(root: str) -> dict:
+    """Row-level CDC ingest (docs/19-lifecycle.md, the merge-on-read
+    half of the lifecycle).  Four proofs, all correctness-gated:
+
+      1. sustained upsert/delete stream through the Delta commit log
+         with a CONCURRENT reader: every version-stable collect must be
+         BIT-EQUAL to a pyarrow read of exactly that snapshot's live
+         files, and the lifecycle journal must show the CDC quick
+         (merge-on-read) refreshes riding the stream — row-level
+         changes served without a rebuild.
+      2. merge-on-read scan overhead — the overlaid query (delete
+         vector + replaced rows applied at scan time) vs the same query
+         after the debt-clearing incremental refresh; gated <= 10x so
+         the overlay stays in the clean index's cost class.
+      3. staleness under watch — a daemon on a 30s interval with the
+         poll watcher must refresh an append in single-digit seconds:
+         the wake event, not the interval, bounds staleness.
+      4. autonomous compaction — a shredded index journals the
+         optimize decision and the next cycle converges.
+
+    Self-contained (own sources, throwaway sessions), like ingest."""
+    import threading
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig, col
+    from hyperspace_tpu.lifecycle import journal as lifecycle_journal
+    from hyperspace_tpu.lifecycle.daemon import daemon_for
+    from hyperspace_tpu.sources.delta import DeltaLog, write_delta
+    from hyperspace_tpu.sources.delta.writer import (delete_rows_delta,
+                                                     upsert_delta)
+
+    rng = np.random.default_rng(47)
+
+    def make(ids, tag: int = 0) -> pa.Table:
+        ids = np.asarray(list(ids), dtype=np.int64)
+        return pa.table({
+            "id": pa.array(ids),
+            "v": pa.array(ids * 10 + tag, type=pa.int64()),
+            "w": rng.random(len(ids)),
+        })
+
+    # -- (1) the stream: 20-commit Delta table, upsert/delete cycles with
+    # a concurrent reader comparing at version-stable points ---------------
+    path = os.path.join(root, "cdc_delta")
+    files = 20
+    rows_per = 3_000
+    for i in range(files):
+        write_delta(make(range(i * rows_per, (i + 1) * rows_per)), path,
+                    mode="append")
+    session = HyperspaceSession(system_path=os.path.join(root, "cdc_ix"))
+    session.conf.num_buckets = 4
+    session.conf.lineage_enabled = True
+    session.conf.hybrid_scan_enabled = True
+    session.conf.lifecycle_cdc_enabled = True
+    session.conf.lifecycle_cdc_merge_debt_ratio = 10.0  # stream never escalates
+    session.conf.parallel_build = "off"
+    hs = Hyperspace(session)
+    hs.create_index(session.read.delta(path),
+                    IndexConfig("cdc_ix", ["id"], ["v"]))
+    session.enable_hyperspace()
+    log = DeltaLog(path)
+
+    stop = threading.Event()
+    failures: list = []
+    cycles = 4
+
+    def writer() -> None:
+        try:
+            for i in range(cycles):
+                upsert_delta(make([7 + i * 11, files * rows_per + i],
+                                  tag=i + 1), path, "id")
+                delete_rows_delta(path, "id", [501 + i * 13])
+                recs = hs.maintenance_cycle()
+                if not any(r["decision"] == "refresh" and r["mode"] == "quick"
+                           and r["outcome"] == "done"
+                           and "CDC merge-on-read" in r["reason"]
+                           for r in recs):
+                    failures.append(f"cycle {i}: no CDC quick refresh "
+                                    f"journaled: {[(r['decision'], r.get('mode'), r['outcome']) for r in recs]}")
+                    return
+                time.sleep(0.02)
+        except Exception as e:  # noqa: BLE001 — surfaced as a gate below
+            failures.append(f"CDC writer died: {e!r}")
+        finally:
+            stop.set()
+
+    def reference(version: int) -> list:
+        t = pq.read_table([f.path for f in log.snapshot(version).files],
+                          columns=["id", "v"])
+        return sorted(zip(t.column("id").to_pylist(),
+                          t.column("v").to_pylist()))
+
+    def one_read(require_stable: bool) -> bool:
+        """Compares when the Delta version stayed stable across the
+        collect (rewritten part files are new paths — old snapshots
+        stay physically intact, so the pinned-version reference reads
+        exactly what the collect could see)."""
+        v1 = log.latest_version()
+        res = (session.read.delta(path).filter(col("id") >= 0)
+               .select("id", "v").collect())
+        if log.latest_version() != v1:
+            if require_stable:
+                failures.append("final quiescent read saw an unstable "
+                                "version")
+            return False
+        got = sorted(zip(res.column("id").to_pylist(),
+                         res.column("v").to_pylist()))
+        if got != reference(v1):
+            failures.append(f"CDC divergence at version {v1}: {len(got)} "
+                            f"rows vs pinned-snapshot reference")
+        return True
+
+    writer_thread = threading.Thread(target=writer, daemon=True)
+    writer_thread.start()
+    reads = compares = 0
+    while not stop.is_set() and not failures and reads < 200:
+        compares += 1 if one_read(require_stable=False) else 0
+        reads += 1
+    writer_thread.join(timeout=120)
+    if not failures:
+        compares += 1 if one_read(require_stable=True) else 0
+    if failures:
+        raise SystemExit(f"cdc bench: {failures[0]}")
+    if compares == 0:
+        raise SystemExit("cdc bench: no version-stable comparison "
+                         "completed")
+    from hyperspace_tpu.lifecycle import cdc as cdc_mod
+
+    entry = session.index_collection_manager.get_index("cdc_ix")
+    debt = cdc_mod.merge_debt(entry)
+    if debt.total_bytes <= 0 or not debt.readable:
+        raise SystemExit(f"cdc bench: stream left no readable merge debt "
+                         f"({debt.to_dict()})")
+
+    # -- (2) merge-on-read overhead: overlaid scan vs debt-cleared scan ----
+    def timed(reps: int = 5) -> float:
+        xs = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            session.read.delta(path).filter(col("id") >= 0) \
+                .select("id", "v").collect()
+            xs.append(time.perf_counter() - t0)
+        return sorted(xs)[len(xs) // 2]
+
+    overlaid_s = timed()
+    session.conf.lifecycle_cdc_merge_debt_ratio = 1e-9
+    recs = hs.maintenance_cycle()
+    if not any(r["decision"] == "refresh" and r["mode"] == "incremental"
+               and r["outcome"] == "done" for r in recs):
+        raise SystemExit(f"cdc bench: tightening the debt budget did not "
+                         f"escalate to the incremental refresh: "
+                         f"{[(r['decision'], r.get('mode'), r['outcome']) for r in recs]}")
+    entry = session.index_collection_manager.get_index("cdc_ix")
+    if cdc_mod.merge_debt(entry).total_bytes != 0:
+        raise SystemExit("cdc bench: incremental refresh left merge debt")
+    clean_s = timed()
+    overhead = overlaid_s / max(1e-9, clean_s)
+    if overhead > 10.0:
+        raise SystemExit(
+            f"cdc bench: merge-on-read overlay costs {overhead:.1f}x the "
+            f"clean-index scan ({overlaid_s:.3f}s vs {clean_s:.3f}s); the "
+            f"acceptance bar is 10x")
+
+    # -- (3) staleness bounded by the watch event, not the interval --------
+    src3 = os.path.join(root, "cdc_watch_src")
+    os.makedirs(src3, exist_ok=True)
+    pq.write_table(make(range(5_000)), os.path.join(src3, "p0.parquet"))
+    s3 = HyperspaceSession(system_path=os.path.join(root, "cdc_watch_ix"))
+    s3.conf.num_buckets = 4
+    s3.conf.lineage_enabled = True
+    s3.conf.lifecycle_enabled = True
+    s3.conf.lifecycle_interval_s = 30.0   # the POLL bound
+    s3.conf.watch_enabled = True
+    s3.conf.watch_mode = "poll"
+    s3.conf.watch_poll_interval_s = 0.05
+    s3.conf.watch_debounce_ms = 10.0
+    hs3 = Hyperspace(s3)
+    hs3.create_index(s3.read.parquet(src3),
+                     IndexConfig("cdc_wix", ["id"], ["v"]))
+    hs3.start_maintenance()
+    try:
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:  # first cycle ran
+            if lifecycle_journal.records(s3.conf):
+                break
+            time.sleep(0.05)
+        else:
+            raise SystemExit("cdc bench: daemon never completed its first "
+                             "cycle")
+        watch_mode = getattr(daemon_for(s3).watcher(), "mode", None)
+        t_append = time.time()
+        pq.write_table(make(range(5_000, 5_200)),
+                       os.path.join(src3, "p1.parquet"))
+        deadline = time.monotonic() + 20.0
+        staleness_s = None
+        while time.monotonic() < deadline:
+            if any(r.get("decision") == "refresh"
+                   and r.get("outcome") == "done"
+                   for r in lifecycle_journal.records(s3.conf)):
+                staleness_s = time.time() - t_append
+                break
+            time.sleep(0.05)
+        if staleness_s is None or staleness_s >= 20.0:
+            raise SystemExit(f"cdc bench: watch-bounded staleness "
+                             f"{staleness_s}; the 30s interval never "
+                             f"elapsed, the wake event must do this")
+    finally:
+        hs3.stop_maintenance()
+
+    # -- (4) compaction: shredded index -> journaled optimize -> converges -
+    src4 = os.path.join(root, "cdc_opt_src")
+    os.makedirs(src4, exist_ok=True)
+    pq.write_table(make(range(10_000)), os.path.join(src4, "p0.parquet"))
+    s4 = HyperspaceSession(system_path=os.path.join(root, "cdc_opt_ix"))
+    s4.conf.num_buckets = 2
+    s4.conf.lineage_enabled = True
+    s4.conf.parallel_build = "off"
+    hs4 = Hyperspace(s4)
+    hs4.create_index(s4.read.parquet(src4),
+                     IndexConfig("cdc_oix", ["id"], ["v"]))
+    for i in range(3):  # shred: one small file per bucket per refresh
+        pq.write_table(make(range(20_000 + i * 500, 20_000 + i * 500 + 300)),
+                       os.path.join(src4, f"p{i + 1}.parquet"))
+        hs4.refresh_index("cdc_oix", "incremental")
+    s4.conf.lifecycle_compaction_enabled = True
+    s4.conf.lifecycle_compaction_min_small_files = 2
+    recs4 = hs4.maintenance_cycle()
+    opt = [r for r in recs4 if r["decision"] == "optimize"]
+    if not opt or opt[0]["outcome"] != "done":
+        raise SystemExit(f"cdc bench: compaction rung never fired on the "
+                         f"shredded index: "
+                         f"{[(r['decision'], r['outcome']) for r in recs4]}")
+    recs4b = hs4.maintenance_cycle()
+    if any(r["decision"] == "optimize" and r["outcome"] == "done"
+           for r in recs4b):
+        raise SystemExit("cdc bench: compaction did not converge — the "
+                         "second cycle compacted again")
+    s4.enable_hyperspace()
+    t4 = s4.read.parquet(src4).filter(col("id") == 42).select("v").collect()
+    if t4.column("v").to_pylist() != [420]:
+        raise SystemExit(f"cdc bench: post-compaction read wrong: "
+                         f"{t4.column('v').to_pylist()}")
+
+    return {"cdc": {
+        "stream_cycles": cycles,
+        "stream_reads": reads,
+        "stream_compares": compares,
+        "merge_debt_ratio_peak": round(debt.ratio, 4),
+        "overlaid_scan_s": round(overlaid_s, 4),
+        "clean_scan_s": round(clean_s, 4),
+        "merge_on_read_overhead_x": round(overhead, 2),
+        "watch_mode": watch_mode,
+        "watch_staleness_s": round(staleness_s, 3),
+        "poll_bound_s": 30.0,
+        "compaction_decisions": len(opt),
     }}
 
 

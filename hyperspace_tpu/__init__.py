@@ -10,6 +10,7 @@ Public API mirrors the reference surface (Hyperspace.scala:26-166,
 package.scala:47-79, python/hyperspace/hyperspace.py:9).
 """
 
+from hyperspace_tpu.actions.optimize import OptimizeSummary
 from hyperspace_tpu.actions.refresh import RefreshSummary
 from hyperspace_tpu.config import HyperspaceConf
 from hyperspace_tpu.dataset import Dataset
@@ -48,6 +49,7 @@ __all__ = [
     "DataSkippingIndexConfig",
     "Dataset",
     "RefreshSummary",
+    "OptimizeSummary",
     "col",
     "lit",
     "when",

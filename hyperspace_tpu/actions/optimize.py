@@ -16,9 +16,10 @@ Reference contract: actions/OptimizeAction.scala:46-175 —
 from __future__ import annotations
 
 import copy
+import dataclasses
 import os
 from collections import defaultdict
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 import pyarrow as pa
 import pyarrow.parquet as pq
@@ -41,6 +42,27 @@ from hyperspace_tpu.io.parquet import (
     write_bucket_run,
 )
 from hyperspace_tpu.telemetry.events import OptimizeActionEvent
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizeSummary:
+    """What an optimize actually did — the return value of
+    ``Hyperspace.optimize_index`` (it used to return None, leaving the
+    caller to re-read the log to count the compaction).  ``outcome`` is
+    ``"ok"`` for a committed compaction and ``"noop"`` when no bucket
+    held mergeable files; ``version`` is the committed log id, or None
+    for a no-op."""
+
+    index: str
+    mode: str                   # quick | full
+    outcome: str                # "ok" | "noop"
+    compacted_files: int = 0    # small files merged away
+    compacted_buckets: int = 0  # buckets rewritten
+    written_files: int = 0      # files the merge produced
+    version: Optional[int] = None
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
 
 
 class OptimizeAction(Action):
@@ -190,3 +212,15 @@ class OptimizeAction(Action):
                                       -1, integrity.recorded_digest(path)))
         entry.content = Content.from_leaf_files(self._retained + new_infos)
         return entry
+
+    def summary(self, outcome: str) -> OptimizeSummary:
+        """The user-facing summary of a completed run (``outcome`` is
+        what ``Action.run()`` returned)."""
+        mergeable = getattr(self, "_candidates_cache", None) or {}
+        return OptimizeSummary(
+            index=self.index_name, mode=self.mode,
+            outcome="ok" if outcome == "ok" else "noop",
+            compacted_files=sum(len(fs) for fs in mergeable.values()),
+            compacted_buckets=len(mergeable),
+            written_files=len(self._new_files),
+            version=self.base_id + 2 if outcome == "ok" else None)

@@ -119,6 +119,16 @@ LIFECYCLE_BACKOFF_INITIAL_S = "hyperspace.lifecycle.backoff.initialS"
 LIFECYCLE_BACKOFF_MAX_S = "hyperspace.lifecycle.backoff.maxS"
 LIFECYCLE_LEASE_ENABLED = "hyperspace.lifecycle.lease.enabled"
 LIFECYCLE_LEASE_TTL_S = "hyperspace.lifecycle.lease.ttlS"
+LIFECYCLE_CDC_ENABLED = "hyperspace.lifecycle.cdc.enabled"
+LIFECYCLE_CDC_MERGE_DEBT_RATIO = "hyperspace.lifecycle.cdc.mergeDebtRatio"
+LIFECYCLE_COMPACTION_ENABLED = "hyperspace.lifecycle.compaction.enabled"
+LIFECYCLE_COMPACTION_MIN_SMALL_FILES = \
+    "hyperspace.lifecycle.compaction.minSmallFiles"
+LIFECYCLE_COMPACTION_MODE = "hyperspace.lifecycle.compaction.mode"
+WATCH_ENABLED = "hyperspace.system.watch.enabled"
+WATCH_MODE = "hyperspace.system.watch.mode"
+WATCH_POLL_INTERVAL_S = "hyperspace.system.watch.pollIntervalS"
+WATCH_DEBOUNCE_MS = "hyperspace.system.watch.debounceMs"
 FAULT_INJECTION_ENABLED = "hyperspace.system.faultInjection.enabled"
 FAULT_INJECTION_SITE = "hyperspace.system.faultInjection.site"
 FAULT_INJECTION_KIND = "hyperspace.system.faultInjection.kind"
@@ -578,6 +588,34 @@ class HyperspaceConf:
     lifecycle_backoff_max_s: float = 300.0
     lifecycle_lease_enabled: bool = False
     lifecycle_lease_ttl_s: float = 30.0
+    # Row-level CDC ingest (lifecycle/cdc.py, docs/19-lifecycle.md):
+    #   - cdc.enabled: merge-on-read — deletes/mutations with lineage take
+    #     the metadata-only quick refresh (the hybrid rule applies the
+    #     delete overlay at scan time, bit-equal to a rebuild) while the
+    #     accumulated merge debt stays under cdc.mergeDebtRatio of the
+    #     recorded source bytes; past it, the real incremental refresh.
+    #   - compaction.enabled/.minSmallFiles/.mode: optimizeIndex joins
+    #     the policy ladder — when an otherwise-idle index carries at
+    #     least minSmallFiles mergeable small files (below
+    #     hyperspace.index.optimizeFileSizeThreshold, sharing a bucket),
+    #     the daemon schedules an optimize in ``mode`` and journals it
+    #     like every other decision.
+    lifecycle_cdc_enabled: bool = False
+    lifecycle_cdc_merge_debt_ratio: float = 0.2
+    lifecycle_compaction_enabled: bool = False
+    lifecycle_compaction_min_small_files: int = 8
+    lifecycle_compaction_mode: str = "quick"
+    # Push-based source change detection (io/watch.py): the maintenance
+    # daemon wakes on source events instead of sleeping the full
+    # lifecycle interval, so measured staleness is bounded by event
+    # latency.  mode: "auto" picks inotify on Linux, else the store
+    # notification bus, else stat polling; "inotify"/"store"/"poll"
+    # force a backend.  pollIntervalS paces the poll/store watchers;
+    # debounceMs coalesces event bursts into one wake.
+    watch_enabled: bool = False
+    watch_mode: str = "auto"
+    watch_poll_interval_s: float = 0.5
+    watch_debounce_ms: float = 50.0
     # Deterministic fault injection (io/faults.py): fire ``kind`` at the
     # ``at``-th call of ``site``, ``count`` times.  Test-only machinery;
     # disabled costs one None check per file-level IO op.
@@ -741,6 +779,16 @@ class HyperspaceConf:
         LIFECYCLE_BACKOFF_MAX_S: "lifecycle_backoff_max_s",
         LIFECYCLE_LEASE_ENABLED: "lifecycle_lease_enabled",
         LIFECYCLE_LEASE_TTL_S: "lifecycle_lease_ttl_s",
+        LIFECYCLE_CDC_ENABLED: "lifecycle_cdc_enabled",
+        LIFECYCLE_CDC_MERGE_DEBT_RATIO: "lifecycle_cdc_merge_debt_ratio",
+        LIFECYCLE_COMPACTION_ENABLED: "lifecycle_compaction_enabled",
+        LIFECYCLE_COMPACTION_MIN_SMALL_FILES:
+            "lifecycle_compaction_min_small_files",
+        LIFECYCLE_COMPACTION_MODE: "lifecycle_compaction_mode",
+        WATCH_ENABLED: "watch_enabled",
+        WATCH_MODE: "watch_mode",
+        WATCH_POLL_INTERVAL_S: "watch_poll_interval_s",
+        WATCH_DEBOUNCE_MS: "watch_debounce_ms",
         FAULT_INJECTION_ENABLED: "fault_injection_enabled",
         FAULT_INJECTION_SITE: "fault_injection_site",
         FAULT_INJECTION_KIND: "fault_injection_kind",

@@ -55,8 +55,15 @@ class Hyperspace:
         ``refresh_index(name, mode="repair")`` rebuilds them."""
         return self.index_manager.verify(name, mode)
 
-    def optimize_index(self, name: str, mode: str = "quick") -> None:
-        self.index_manager.optimize(name, mode)
+    def optimize_index(self, name: str, mode: str = "quick"):
+        """Compact small index files bucket-wise (``quick`` merges only
+        files below ``hyperspace.index.optimize.fileSizeThreshold``,
+        ``full`` rewrites every bucket).  Returns an
+        :class:`~hyperspace_tpu.actions.optimize.OptimizeSummary`:
+        files/buckets compacted, files written, the committed log
+        version — or ``outcome="noop"`` when no bucket held mergeable
+        files (a benign no-op, not an exception)."""
+        return self.index_manager.optimize(name, mode)
 
     def cancel(self, name: str) -> None:
         self.index_manager.cancel(name)

@@ -97,7 +97,9 @@ class CachingIndexCollectionManager(IndexCollectionManager):
         finally:
             self.clear_cache()
 
-    def optimize(self, name: str, mode: str = "quick") -> None:
+    def optimize(self, name: str, mode: str = "quick"):
         self.clear_cache()
-        super().optimize(name, mode)
-        self.clear_cache()
+        try:
+            return super().optimize(name, mode)
+        finally:
+            self.clear_cache()

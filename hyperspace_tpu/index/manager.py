@@ -202,15 +202,20 @@ class IndexCollectionManager:
             outcome="ok" if outcome == "ok" else "noop",
             version=action.base_id + 2 if outcome == "ok" else None)
 
-    def optimize(self, name: str, mode: str = "quick") -> None:
+    def optimize(self, name: str, mode: str = "quick"):
+        """Dispatch one compaction; returns an
+        :class:`~hyperspace_tpu.actions.optimize.OptimizeSummary` —
+        what was merged and the committed version (``outcome="noop"``
+        when no bucket held mergeable files, not an exception)."""
         from hyperspace_tpu.actions.optimize import OptimizeAction
 
         if mode not in ("quick", "full"):
             raise HyperspaceError(f"Unknown optimize mode {mode!r}")
         self._maybe_recover(name)
-        self._dispatch(OptimizeAction(self._log_manager(name),
-                                      self._data_manager(name),
-                                      self.session, mode))
+        action = OptimizeAction(self._log_manager(name),
+                                self._data_manager(name),
+                                self.session, mode)
+        return action.summary(self._dispatch(action))
 
     # -- queries (IndexCollectionManager.scala:109-170) ---------------------
     def _degrade(self, name: str, reason: str) -> None:

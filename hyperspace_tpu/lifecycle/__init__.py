@@ -14,8 +14,19 @@ that mutate continuously with nobody watching (ROADMAP item 4):
   - :mod:`~hyperspace_tpu.lifecycle.journal` — every decision
     (including "did nothing, here's why") persisted through the
     LogStore seam under ``<systemPath>/_hyperspace_lifecycle``
+  - :mod:`~hyperspace_tpu.lifecycle.cdc` — row-level CDC ingest:
+    merge-on-read debt measurement and the autonomous compaction rung
+    of the policy ladder (with the :mod:`~hyperspace_tpu.io.watch`
+    seam feeding push-based change detection)
 """
 
+from hyperspace_tpu.lifecycle.cdc import (
+    CompactionStats,
+    MergeDebt,
+    compaction_stats,
+    decide_compaction,
+    merge_debt,
+)
 from hyperspace_tpu.lifecycle.change_detector import (
     ChangeSummary,
     detect_changes,
@@ -26,8 +37,13 @@ from hyperspace_tpu.lifecycle.policy import MaintenanceDecision
 
 __all__ = [
     "ChangeSummary",
+    "CompactionStats",
     "MaintenanceDaemon",
     "MaintenanceDecision",
+    "MergeDebt",
+    "compaction_stats",
+    "decide_compaction",
     "detect_changes",
     "diff_file_sets",
+    "merge_debt",
 ]

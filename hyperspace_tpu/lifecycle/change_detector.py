@@ -80,6 +80,8 @@ class ChangeSummary:
     recorded_bytes: int
     hybrid_debt_bytes: int = 0  # quick-refresh appends awaiting indexing
     newest_change_ms: int = 0   # max mtime over appended files (epoch ms)
+    deleted_bytes: int = 0      # bytes of the newly deleted files
+    merge_debt_bytes: int = 0   # pending delete-overlay bytes (CDC debt)
 
     @property
     def changed(self) -> bool:
@@ -101,13 +103,25 @@ class ChangeSummary:
         return (self.appended_bytes + self.hybrid_debt_bytes) \
             / max(1, self.recorded_bytes)
 
+    @property
+    def merge_debt_ratio(self) -> float:
+        """Merge-on-read debt a quick refresh would leave behind: new
+        appends + deletes PLUS the overlay already pending (both
+        directions), over recorded bytes — the number the CDC policy
+        bounds with ``hyperspace.lifecycle.cdc.mergeDebtRatio``."""
+        return (self.appended_bytes + self.hybrid_debt_bytes
+                + self.deleted_bytes + self.merge_debt_bytes) \
+            / max(1, self.recorded_bytes)
+
     def to_dict(self) -> dict:
         return {"index": self.index, "appended": self.appended,
                 "deleted": self.deleted, "mutated": self.mutated,
                 "appended_bytes": self.appended_bytes,
                 "recorded_files": self.recorded_files,
                 "recorded_bytes": self.recorded_bytes,
-                "hybrid_debt_bytes": self.hybrid_debt_bytes}
+                "hybrid_debt_bytes": self.hybrid_debt_bytes,
+                "deleted_bytes": self.deleted_bytes,
+                "merge_debt_bytes": self.merge_debt_bytes}
 
 
 def _mtime_epoch_ms(mtime) -> int:
@@ -174,6 +188,8 @@ def detect_changes(session, entry: IndexLogEntry) -> ChangeSummary:
             hybrid_debt_bytes=sum(f.size for f in entry.appended_files()),
             newest_change_ms=max((_mtime_epoch_ms(f.mtime)
                                   for f in appended), default=0),
+            deleted_bytes=sum(f.size for f in deleted),
+            merge_debt_bytes=sum(f.size for f in entry.deleted_files()),
         )
         sp.set(appended=summary.appended, deleted=summary.deleted,
                mutated=summary.mutated)

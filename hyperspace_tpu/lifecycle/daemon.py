@@ -77,6 +77,12 @@ class MaintenanceDaemon:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._cycle = 0
+        # Watch seam (io/watch.py): the watcher sets this when a source
+        # mutates, so the daemon's between-cycle sleep ends early and
+        # measured staleness is bounded by event latency, not by
+        # ``lifecycle_interval_s``.
+        self._wake = threading.Event()
+        self._watcher = None
         # index name -> (consecutive failures, monotonic not-before)
         self._backoff: Dict[str, Tuple[int, float]] = {}
         # candidate name -> advisor Candidate, for executing CREATE
@@ -111,6 +117,7 @@ class MaintenanceDaemon:
 
     def stop(self, timeout_s: float = 10.0) -> None:
         self._stop.set()
+        self._wake.set()  # unblock a watch-event wait immediately
         if self._thread is not None:
             self._thread.join(timeout=timeout_s)
             self._thread = None
@@ -118,6 +125,11 @@ class MaintenanceDaemon:
             # Clean handoff: the next candidate takes over on its next
             # poll instead of waiting out the TTL.
             self._lease.release()
+
+    def watcher(self):
+        """The running :class:`SourceWatcher`, or None (watch disabled,
+        or the daemon thread has not started one yet)."""
+        return self._watcher
 
     def lease(self) -> Optional[_lease.MaintenanceLease]:
         """This daemon's lease handle, or None before the first
@@ -136,17 +148,56 @@ class MaintenanceDaemon:
                 if not_before > now}
 
     def _run(self) -> None:
-        while not self._stop.is_set():
-            try:
-                self.run_once()
-            except Exception:  # noqa: BLE001 — a cycle must never kill
-                # the daemon; per-decision failures are journaled, this
-                # catches only gather-phase surprises.
-                from hyperspace_tpu.telemetry import metrics
+        self._watcher = self._maybe_watch()
+        try:
+            while not self._stop.is_set():
+                # Arm BEFORE the cycle: an event that lands while we
+                # are maintaining still shortens the next sleep.
+                self._wake.clear()
+                try:
+                    self.run_once()
+                except Exception:  # noqa: BLE001 — a cycle must never
+                    # kill the daemon; per-decision failures are
+                    # journaled, this catches only gather-phase
+                    # surprises.
+                    from hyperspace_tpu.telemetry import metrics
 
-                metrics.inc("lifecycle.actions.errors")
-            self._stop.wait(float(getattr(self.session.conf,
-                                          "lifecycle_interval_s", 30.0)))
+                    metrics.inc("lifecycle.actions.errors")
+                # Watch-aware sleep: a source event ends it early; the
+                # interval stays the fallback bound when no watcher
+                # runs (or it lost an event).
+                self._wake.wait(float(getattr(self.session.conf,
+                                              "lifecycle_interval_s",
+                                              30.0)))
+        finally:
+            if self._watcher is not None:
+                self._watcher.stop()
+                self._watcher = None
+
+    def _maybe_watch(self):
+        """Start the io/watch.py seam over every ACTIVE index's source
+        roots when ``hyperspace.system.watch.enabled`` — best-effort:
+        a watcher that cannot start just leaves the interval poll."""
+        conf = self.session.conf
+        if not bool(getattr(conf, "watch_enabled", False)):
+            return None
+        try:
+            from hyperspace_tpu.index.log_entry import States
+            from hyperspace_tpu.io.watch import SourceWatcher
+
+            roots = []
+            for entry in self.session.index_collection_manager \
+                    .get_indexes([States.ACTIVE]):
+                for rel in entry.relations:
+                    roots.extend(rel.root_paths)
+            return SourceWatcher(conf, sorted(set(roots)),
+                                 wake=self._wake).start()
+        except Exception:  # noqa: BLE001 — push detection is advisory;
+            # the interval poll below still bounds staleness.
+            from hyperspace_tpu.telemetry import metrics
+
+            metrics.inc("lifecycle.watch.errors")
+            return None
 
     # -- one cycle (Hyperspace.maintenance_cycle) ----------------------------
     def run_once(self) -> List[dict]:
@@ -252,15 +303,40 @@ class MaintenanceDaemon:
             quick_append_ratio=float(getattr(
                 conf, "lifecycle_quick_append_ratio", 0.1)),
             full_churn_ratio=float(getattr(
-                conf, "lifecycle_full_churn_ratio", 0.5)))
+                conf, "lifecycle_full_churn_ratio", 0.5)),
+            cdc_merge_on_read=bool(getattr(
+                conf, "lifecycle_cdc_enabled", False)),
+            merge_debt_ratio=float(getattr(
+                conf, "lifecycle_cdc_merge_debt_ratio", 0.2)))
         if decision.kind == policy.KIND_NONE:
             self._backoff.pop(name, None)
+            compaction = self._decide_compaction(entry)
+            if compaction is not None:
+                return self._execute(compaction, change=change)
             return self._journal(decision, outcome="noop", change=change)
         if change.newest_change_ms > 0:
             metrics.set_gauge(
                 "lifecycle.staleness_s",
                 max(0.0, time.time() - change.newest_change_ms / 1000.0))
         return self._execute(decision, change=change)
+
+    def _decide_compaction(self, entry):
+        """The compaction rung (lifecycle/cdc.py): only consulted when
+        the refresh ladder left the index idle, so an optimize never
+        races a refresh the same cycle scheduled."""
+        conf = self.session.conf
+        if not bool(getattr(conf, "lifecycle_compaction_enabled", False)):
+            return None
+        from hyperspace_tpu.lifecycle import cdc
+
+        stats = cdc.compaction_stats(
+            entry, int(getattr(conf, "optimize_file_size_threshold",
+                               256 * 1024 * 1024)))
+        return cdc.decide_compaction(
+            stats,
+            min_small_files=int(getattr(
+                conf, "lifecycle_compaction_min_small_files", 8)),
+            mode=str(getattr(conf, "lifecycle_compaction_mode", "quick")))
 
     def _execute(self, decision: policy.MaintenanceDecision,
                  change=None) -> dict:
@@ -281,6 +357,11 @@ class MaintenanceDaemon:
                 if decision.kind in (policy.KIND_REFRESH,
                                      policy.KIND_REPAIR):
                     summary = manager.refresh(name, decision.mode)
+                    if summary is not None and summary.outcome == "noop":
+                        outcome = "noop"
+                elif decision.kind == policy.KIND_OPTIMIZE:
+                    summary = manager.optimize(name,
+                                               decision.mode or "quick")
                     if summary is not None and summary.outcome == "noop":
                         outcome = "noop"
                 elif decision.kind == policy.KIND_DELETE:

@@ -40,6 +40,7 @@ KIND_REFRESH = "refresh"
 KIND_REPAIR = "repair"
 KIND_CREATE = "create"
 KIND_DELETE = "delete"
+KIND_OPTIMIZE = "optimize"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -59,8 +60,18 @@ class MaintenanceDecision:
 def decide_refresh(change: ChangeSummary, *, quarantined: int,
                    lineage: bool, hybrid_scan: bool,
                    quick_append_ratio: float,
-                   full_churn_ratio: float) -> MaintenanceDecision:
-    """The per-index decision for one detection pass."""
+                   full_churn_ratio: float,
+                   cdc_merge_on_read: bool = False,
+                   merge_debt_ratio: float = 0.2) -> MaintenanceDecision:
+    """The per-index decision for one detection pass.
+
+    With ``cdc_merge_on_read`` (``hyperspace.lifecycle.cdc.enabled``),
+    row-level deletes/mutations with lineage + hybrid scan take the
+    metadata-only quick refresh too — the hybrid rule applies the
+    delete overlay at scan time, bit-equal to a rebuild — until the
+    accumulated merge debt outgrows ``merge_debt_ratio`` of the
+    recorded source bytes, when the real incremental refresh runs.
+    """
     name = change.index
     if quarantined > 0:
         return MaintenanceDecision(
@@ -68,12 +79,15 @@ def decide_refresh(change: ChangeSummary, *, quarantined: int,
             reason=f"{quarantined} quarantined index file(s); rebuilding "
                    f"damaged buckets from the recorded snapshot")
     over_debt = change.append_ratio > quick_append_ratio
-    if not change.changed and not over_debt:
-        if change.hybrid_debt_bytes > 0:
+    cdc_over_debt = cdc_merge_on_read \
+        and change.merge_debt_ratio > merge_debt_ratio
+    if not change.changed and not over_debt and not cdc_over_debt:
+        if change.hybrid_debt_bytes + change.merge_debt_bytes > 0:
             return MaintenanceDecision(
                 KIND_NONE, name,
                 reason=f"no new source changes; "
-                       f"{change.hybrid_debt_bytes} pending bytes within "
+                       f"{change.hybrid_debt_bytes + change.merge_debt_bytes}"
+                       f" pending bytes within "
                        f"the hybrid-scan debt budget")
         return MaintenanceDecision(KIND_NONE, name,
                                    reason="source unchanged")
@@ -90,24 +104,49 @@ def decide_refresh(change: ChangeSummary, *, quarantined: int,
                 reason=f"{change.deleted} deleted / {change.mutated} "
                        f"mutated file(s) without lineage: incremental "
                        f"refresh cannot exclude their rows")
+        if cdc_merge_on_read and hybrid_scan and not cdc_over_debt:
+            # CDC merge-on-read: record the overlay metadata-only; the
+            # hybrid rule merges it at scan time (bit-equal).
+            return MaintenanceDecision(
+                KIND_REFRESH, name, mode="quick",
+                reason=f"CDC merge-on-read: {change.appended} appended / "
+                       f"{change.deleted} deleted / {change.mutated} "
+                       f"mutated file(s) recorded as merge debt (ratio "
+                       f"{change.merge_debt_ratio:.3f} <= "
+                       f"{merge_debt_ratio:.3f}); hybrid scan applies "
+                       f"the overlay at query time")
         return MaintenanceDecision(
             KIND_REFRESH, name, mode="incremental",
             reason=f"{change.appended} appended / {change.deleted} "
-                   f"deleted / {change.mutated} mutated file(s)")
+                   f"deleted / {change.mutated} mutated file(s)"
+                   + (f"; merge debt ratio {change.merge_debt_ratio:.3f}"
+                      f" > {merge_debt_ratio:.3f}" if cdc_over_debt
+                      else ""))
     # Appends only from here.
-    if hybrid_scan and not over_debt:
+    if hybrid_scan and not over_debt and not cdc_over_debt:
         return MaintenanceDecision(
             KIND_REFRESH, name, mode="quick",
             reason=f"{change.appended} small appended file(s) "
                    f"(append ratio {change.append_ratio:.3f} <= "
                    f"{quick_append_ratio:.3f}): metadata-only, hybrid "
                    f"scan serves them from source")
+    if cdc_over_debt and not change.changed:
+        # Nothing new, but the CARRIED overlay outgrew the budget: the
+        # incremental refresh exists to clear it.
+        return MaintenanceDecision(
+            KIND_REFRESH, name, mode="incremental",
+            reason=f"no new source changes, but accumulated merge debt "
+                   f"ratio {change.merge_debt_ratio:.3f} > "
+                   f"{merge_debt_ratio:.3f}: incremental refresh clears "
+                   f"the scan-time overlay")
     return MaintenanceDecision(
         KIND_REFRESH, name, mode="incremental",
-        reason=f"{change.appended} appended file(s) "
-               f"({change.appended_bytes + change.hybrid_debt_bytes} "
-               f"bytes beyond the quick budget)"
-        if over_debt or not hybrid_scan else "appended files")
+        reason=(f"{change.appended} appended file(s) "
+                f"({change.appended_bytes + change.hybrid_debt_bytes} "
+                f"bytes beyond the quick budget)"
+                if over_debt or not hybrid_scan else "appended files")
+        + (f"; merge debt ratio {change.merge_debt_ratio:.3f} > "
+           f"{merge_debt_ratio:.3f}" if cdc_over_debt else ""))
 
 
 @dataclasses.dataclass(frozen=True)

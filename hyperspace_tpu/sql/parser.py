@@ -783,10 +783,20 @@ class _Parser:
         return Col(column)
 
     def parse_case(self) -> Expr:
+        """Both CASE forms.  The simple form (``CASE expr WHEN v THEN r
+        ...``) desugars to the searched form with ``expr = v``
+        conditions, exactly as Spark's parser does — so a NULL operand
+        matches no WHEN (NULL = v is NULL, never true) and falls
+        through to ELSE."""
         self.expect_kw("CASE")
+        operand: Optional[Expr] = None
+        if not self.at_kw("WHEN"):
+            operand = self.parse_expr()
         branches = []
         while self.take_kw("WHEN"):
             cond = self.parse_expr()
+            if operand is not None:
+                cond = BinOp("==", operand, cond)
             self.expect_kw("THEN")
             branches.append((cond, self.parse_expr()))
         otherwise: Expr = Lit(None)

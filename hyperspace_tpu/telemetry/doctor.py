@@ -146,6 +146,8 @@ def doctor(session, fleet: bool = False) -> DoctorReport:
         checks = [
             _guarded("integrity", lambda: _check_integrity(session)),
             _guarded("staleness", lambda: _check_staleness(session)),
+            _guarded("cdc.merge_debt",
+                     lambda: _check_merge_debt(session)),
             _guarded("maintenance", lambda: _check_maintenance(session)),
             _guarded("perf", lambda: _check_perf(session)),
             _guarded("serving", lambda: _check_serving(session)),
@@ -234,6 +236,51 @@ def _check_staleness(session) -> DoctorCheck:
     return DoctorCheck("staleness", "ok",
                        f"{len(entries)} ACTIVE index(es) current",
                        {"indexes": len(entries)})
+
+
+def _check_merge_debt(session) -> DoctorCheck:
+    """CDC merge-on-read debt (lifecycle/cdc.py): WARN when an index's
+    pending overlay outgrew the ``hyperspace.lifecycle.cdc.
+    mergeDebtRatio`` budget (a refresh is overdue), CRIT when an index
+    carries a delete overlay it cannot apply at scan time — no lineage
+    column, or hybrid scan disabled — because hybrid candidate math
+    drops such an entry and every query over it silently falls back to
+    a full source scan."""
+    from hyperspace_tpu.index.log_entry import States
+    from hyperspace_tpu.lifecycle.cdc import merge_debt
+
+    conf = session.conf
+    budget = float(getattr(conf, "lifecycle_cdc_merge_debt_ratio", 0.2))
+    hybrid_on = bool(getattr(conf, "hybrid_scan_enabled", False))
+    entries = [e for e in session.index_collection_manager.get_indexes()
+               if e.state == States.ACTIVE]
+    unreadable: Dict[str, Dict[str, Any]] = {}
+    over: Dict[str, Dict[str, Any]] = {}
+    for entry in entries:
+        debt = merge_debt(entry)
+        if debt.total_bytes == 0:
+            continue
+        if debt.deleted_files > 0 and (not debt.readable or not hybrid_on):
+            unreadable[entry.name] = debt.to_dict()
+        elif debt.ratio > budget:
+            over[entry.name] = debt.to_dict()
+    if unreadable:
+        return DoctorCheck(
+            "cdc.merge_debt", "crit",
+            f"{len(unreadable)} index(es) carry a delete overlay they "
+            f"cannot apply at scan time — queries fall back to source; "
+            f"run refresh_index(mode=\"incremental\")",
+            {"unreadable": unreadable})
+    if over:
+        return DoctorCheck(
+            "cdc.merge_debt", "warn",
+            f"{len(over)} index(es) past the merge-debt budget "
+            f"({budget:.2f}) — a real refresh is overdue",
+            {"over_budget": over, "budget": budget})
+    return DoctorCheck(
+        "cdc.merge_debt", "ok",
+        f"{len(entries)} ACTIVE index(es) within the merge-debt budget",
+        {"budget": budget})
 
 
 def _check_maintenance(session) -> DoctorCheck:

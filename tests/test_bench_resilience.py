@@ -25,7 +25,7 @@ SECTIONS = ("setup", "sf1_queries", "device_agg_probe", "resident_agg",
             "advisor", "integrity", "build_profile", "timeline",
             "build_pipeline", "multichip", "multihost", "serving",
             "flight_recorder", "alerts", "fleet_obs", "fleet", "chaos",
-            "ingest", "sf10", "sf100")
+            "ingest", "cdc", "sf10", "sf100")
 
 
 def _env(tmp_path, budget: str) -> dict:

@@ -276,6 +276,43 @@ def test_answers_match_pandas(env):
     np.testing.assert_array_equal(got["q"], want["l_quantity"])
 
 
+def test_simple_case_matches_pandas(env):
+    """``CASE operand WHEN value ...`` (the SIMPLE form) desugars to the
+    searched form with equality conditions; answers must match pandas'
+    map-with-default."""
+    s, paths = env
+    got = sql(s, "SELECT l_orderkey, "
+                 "CASE l_returnflag WHEN 'R' THEN 'returned' "
+                 "WHEN 'A' THEN 'accepted' ELSE 'other' END AS status "
+                 "FROM lineitem",
+              tables=_tables(s, paths)).collect().to_pandas()
+    df = pd.read_parquet(paths["lineitem"])
+    want = df.assign(status=df["l_returnflag"].map(
+        {"R": "returned", "A": "accepted"}).fillna("other"))
+    # l_orderkey is non-unique, so sort BOTH sides by (key, status) to
+    # compare order-independently.
+    np.testing.assert_array_equal(
+        got.sort_values(["l_orderkey", "status"])["status"],
+        want.sort_values(["l_orderkey", "status"])["status"])
+
+
+def test_simple_case_no_else_yields_null(env):
+    """Simple CASE without ELSE is NULL for unmatched operands (Spark
+    semantics), and works inside aggregates."""
+    s, paths = env
+    got = sql(s, "SELECT sum(CASE l_shipmode WHEN 'AIR' THEN l_quantity "
+                 "END) AS air_qty FROM lineitem",
+              tables=_tables(s, paths)).collect().to_pandas()
+    df = pd.read_parquet(paths["lineitem"])
+    want = df.loc[df["l_shipmode"] == "AIR", "l_quantity"].sum()
+    assert got["air_qty"][0] == want
+    # Unmatched rows are NULL, not zero/false-y values.
+    nulls = sql(s, "SELECT count(*) AS n FROM lineitem "
+                   "WHERE CASE l_shipmode WHEN 'AIR' THEN 1 END IS NULL",
+                tables=_tables(s, paths)).collect().to_pandas()
+    assert nulls["n"][0] == int((df["l_shipmode"] != "AIR").sum())
+
+
 class TestErrors:
     def test_unknown_table(self, env):
         s, paths = env

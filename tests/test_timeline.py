@@ -452,8 +452,9 @@ class TestDoctorMatrix:
         report = hs.doctor()
         assert report.status == "ok", report.render()
         assert {c.name for c in report.checks} == {
-            "integrity", "staleness", "maintenance", "perf", "serving",
-            "degraded", "lint", "device_skew", "client"}
+            "integrity", "staleness", "cdc.merge_debt", "maintenance",
+            "perf", "serving", "degraded", "lint", "device_skew",
+            "client"}
         assert metrics.snapshot().get("health.status") == 0
 
     def test_seeded_quarantine_is_crit_and_repair_restores_ok(
